@@ -1,0 +1,42 @@
+"""Rank-aware logging.
+
+Parity: reference apex/__init__.py:31-43 (``RankInfoFormatter`` injects the
+(dp, tp, pp, vpp) rank tuple into every record) and apex/__init__.py:62-68
+(``deprecated_warning``).
+
+On TPU there is one Python process per host rather than per chip, so the
+"rank" is the JAX process index plus the model-parallel ranks registered with
+``apex_tpu.transformer.parallel_state`` (which are mesh-coordinate based).
+"""
+
+import logging
+import warnings
+
+
+def _get_rank_info():
+    try:
+        from apex_tpu.transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            return parallel_state.get_rank_info()
+    except Exception:
+        pass
+    try:
+        import jax
+
+        return (jax.process_index(),)
+    except Exception:
+        return (0,)
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Formatter prefixing each record with the parallel rank tuple."""
+
+    def format(self, record):
+        record.rank_info = str(_get_rank_info())
+        return super().format(record)
+
+
+def deprecated_warning(msg: str) -> None:
+    """Emit a deprecation warning once (reference apex/__init__.py:62-68)."""
+    warnings.warn(msg, DeprecationWarning, stacklevel=3)

@@ -1,0 +1,40 @@
+"""apex_tpu.amp — automatic mixed precision for TPU.
+
+Parity: reference apex/amp (frontend.py:197 ``initialize``, handle.py:16
+``scale_loss``, amp.py:30-70 registry decorators, frontend.py:365-404
+``state_dict``/``load_state_dict``).
+
+TPU design: fp16+loss-scaling on GPU becomes bf16-first on TPU. O1's
+runtime monkey-patching of the torch namespace has no JAX analog — tracing
+happens once under jit — so O1 maps to a *dtype policy* that apex_tpu's
+layers consult (``amp.autocast`` / ``amp.policy``), while O2/O3 map to
+whole-model casts with fp32 master weights kept by the wrapped optimizer.
+The ``LossScaler`` keeps the reference's dynamic-scaling semantics (init
+2^16, window 2000, halve on overflow) in a functional, jit-friendly state.
+"""
+
+from apex_tpu.amp.frontend import (  # noqa: F401
+    initialize,
+    state_dict,
+    load_state_dict,
+    Properties,
+    O0,
+    O1,
+    O2,
+    O3,
+)
+from apex_tpu.amp.handle import scale_loss, disable_casts  # noqa: F401
+from apex_tpu.amp.scaler import LossScaler, ScalerState  # noqa: F401
+from apex_tpu.amp.policy import (  # noqa: F401
+    autocast,
+    current_policy,
+    DtypePolicy,
+    half_function,
+    float_function,
+    promote_function,
+    register_half_function,
+    register_float_function,
+    register_promote_function,
+)
+from apex_tpu.amp.amp_optimizer import AmpOptimizer  # noqa: F401
+from apex_tpu.amp._amp_state import _amp_state  # noqa: F401

@@ -1,0 +1,36 @@
+"""Process-global amp state.
+
+Parity: reference apex/amp/_amp_state.py:8-59 (singleton holding handle,
+loss_scalers, opt_properties, verbosity).
+"""
+
+
+class AmpState(object):
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoming_model_not_fp32 = False
+        self.verbosity = 1
+        self.opt_properties = None
+        self.loss_scalers = []
+        self.optimizers = []
+
+    def reset(self):
+        self.__init__()
+
+
+_amp_state = AmpState()
+
+
+def maybe_print(msg, rank0=False):
+    if _amp_state.verbosity > 0:
+        print(msg)
+
+
+def master_params(optimizer):
+    """Iterate over the fp32 master params of an AmpOptimizer
+    (parity: apex/amp/_amp_state.py master_params)."""
+    import jax
+
+    state = getattr(optimizer, "last_state", None)
+    if state is not None and "master" in state.get("inner", {}):
+        yield from jax.tree_util.tree_leaves(state["inner"]["master"])

@@ -1,0 +1,228 @@
+"""amp frontend: opt-level property system + ``initialize``.
+
+Parity: reference apex/amp/frontend.py — ``Properties`` (9-99), ``O0``-``O3``
+presets (104-193), ``initialize`` (197-362), ``state_dict``/
+``load_state_dict`` (365-404).
+
+TPU mapping of the opt levels (fp16 -> bf16):
+  O0: pure fp32 (no casts, loss_scale=1).
+  O1: params fp32, compute ops in bf16 via the dtype policy
+      (``amp.autocast``); dynamic loss scale kept for API parity.
+  O2: params cast to bf16 except normalization layers; fp32 master weights
+      in the optimizer; dynamic loss scale.
+  O3: pure bf16, no masters, loss_scale=1.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp._amp_state import _amp_state, maybe_print
+from apex_tpu.amp.amp_optimizer import AmpOptimizer
+from apex_tpu.amp.scaler import LossScaler
+
+
+class Properties(object):
+    """Mutable option bundle (reference frontend.py:9-99)."""
+
+    def __init__(self):
+        self.options = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,
+            "patch_torch_functions": False,  # name kept for parity; means "use dtype policy"
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+        }
+
+    def _update_options_dict(self, new_options):
+        for k, v in new_options.items():
+            if k in self.options:
+                self.options[k] = v
+            else:
+                raise ValueError("Tried to set unexpected option {}".format(k))
+
+    def __getattr__(self, name):
+        if "options" in self.__dict__ and name in self.__dict__["options"]:
+            return self.options[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if "options" in self.__dict__ and name in self.options:
+            self.options[name] = value
+        else:
+            super(Properties, self).__setattr__(name, value)
+
+
+class O3:
+    brief = "O3: Pure (b)f16 training."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = jnp.bfloat16
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O2:
+    brief = "O2: (b)f16 model with fp32 master weights."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = jnp.bfloat16
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O1:
+    brief = "O1: Insert automatic casts around compute ops (dtype policy)."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.patch_torch_functions = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O0:
+    brief = "O0: Pure fp32 training."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.patch_torch_functions = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
+
+
+_BN_MARKERS = ("batchnorm", "batch_norm", "bn", "norm")
+
+
+def _is_norm_path(path) -> bool:
+    keys = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    return any(any(m in str(k).lower() for m in _BN_MARKERS) for k in keys)
+
+
+def cast_model(params, dtype, keep_batchnorm_fp32=False):
+    """Cast a parameter pytree, optionally keeping norm-layer params fp32
+    (reference fp16util.convert_network keeps BN fp32,
+    apex/amp/_initialize.py:178-184)."""
+    def cast(path, leaf):
+        if not (hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            return leaf
+        if keep_batchnorm_fp32 and _is_norm_path(path):
+            return leaf.astype(jnp.float32)
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def initialize(models, optimizers=None, enabled=True, opt_level="O1",
+               cast_model_type=None, patch_torch_functions=None,
+               keep_batchnorm_fp32=None, master_weights=None, loss_scale=None,
+               cast_model_outputs=None, num_losses=1, verbosity=1,
+               min_loss_scale=None, max_loss_scale=2.0 ** 24):
+    """Initialize amp (reference frontend.py:197-362).
+
+    Args:
+      models: a parameter pytree (or list of pytrees). In JAX, "the model"
+        is its parameters; apply fns are pure and need no patching.
+      optimizers: an apex_tpu fused optimizer (or list). Wrapped in
+        :class:`AmpOptimizer` which owns unscale/master-weight handling.
+    Returns:
+      (models, optimizers) with params cast per the opt level and
+      optimizers wrapped.
+    """
+    _amp_state.verbosity = verbosity
+    if not enabled:
+        return models, optimizers
+
+    if opt_level not in opt_levels:
+        raise RuntimeError("Unexpected optimization level {}".format(opt_level))
+
+    _amp_state.opt_properties = opt_levels[opt_level](Properties())
+    maybe_print("Selected optimization level {}".format(opt_levels[opt_level].brief))
+    for k, v in {
+        "cast_model_type": cast_model_type,
+        "patch_torch_functions": patch_torch_functions,
+        "keep_batchnorm_fp32": keep_batchnorm_fp32,
+        "master_weights": master_weights,
+        "loss_scale": loss_scale,
+    }.items():
+        if v is not None:
+            setattr(_amp_state.opt_properties, k, v)
+
+    props = _amp_state.opt_properties
+
+    models_was_list = isinstance(models, list)
+    models_list = models if models_was_list else [models]
+    if props.cast_model_type is not None and props.cast_model_type != jnp.float32:
+        models_list = [
+            cast_model(m, props.cast_model_type,
+                       keep_batchnorm_fp32=bool(props.keep_batchnorm_fp32))
+            for m in models_list
+        ]
+
+    out_optimizers = optimizers
+    _amp_state.loss_scalers = []
+    for _ in range(num_losses):
+        _amp_state.loss_scalers.append(
+            LossScaler(props.loss_scale, min_loss_scale=min_loss_scale,
+                       max_loss_scale=max_loss_scale))
+
+    if optimizers is not None:
+        opt_was_list = isinstance(optimizers, list)
+        opt_list = optimizers if opt_was_list else [optimizers]
+        wrapped = [
+            AmpOptimizer(opt, _amp_state.loss_scalers[min(i, num_losses - 1)],
+                         master_weights=bool(props.master_weights),
+                         model_dtype=props.cast_model_type)
+            for i, opt in enumerate(opt_list)
+        ]
+        _amp_state.optimizers = wrapped
+        out_optimizers = wrapped if opt_was_list else wrapped[0]
+
+    out_models = models_list if models_was_list else models_list[0]
+    return out_models, out_optimizers
+
+
+def state_dict(destination=None):
+    """Checkpoint all loss scalers (reference frontend.py:365-381)."""
+    if destination is None:
+        destination = {}
+    for idx, ls in enumerate(_amp_state.loss_scalers):
+        destination["loss_scaler%d" % idx] = ls.state_dict()
+    return destination
+
+
+def load_state_dict(state_dict):
+    """Restore loss scalers (reference frontend.py:384-404)."""
+    if len(state_dict) != len(_amp_state.loss_scalers):
+        import warnings
+
+        warnings.warn("Found {} loss scalers in state_dict, expected {}".format(
+            len(state_dict), len(_amp_state.loss_scalers)))
+    for idx, ls in enumerate(_amp_state.loss_scalers):
+        key = "loss_scaler%d" % idx
+        if key in state_dict:
+            ls.load_state_dict(state_dict[key])

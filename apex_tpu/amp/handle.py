@@ -1,0 +1,59 @@
+"""``amp.scale_loss`` context manager + ``disable_casts``.
+
+Parity: reference apex/amp/handle.py:16-158 (scale on enter, unscale +
+overflow-check + step-skip patching on exit) and 163-167 (disable_casts).
+
+TPU design: JAX grads are values, not ``.grad`` attributes, so the eager
+context manager scales the loss and arms the optimizer's scaler; the
+actual unscale/skip happens inside ``AmpOptimizer.step`` (branch-free under
+jit). For fully-jitted training loops prefer the functional API:
+``scaled = opt.scale_loss(loss, state)`` then ``opt.step(grads, state, params)``.
+"""
+
+import contextlib
+import warnings
+
+from apex_tpu.amp._amp_state import _amp_state
+
+
+class _ScaledLoss:
+    def __init__(self, loss, scaler):
+        self.loss = loss
+        self.scaler = scaler
+
+    def value(self):
+        return self.loss
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizers, loss_id=0, model=None,
+               delay_unscale=False, delay_overflow_check=False):
+    """Yield ``loss * current_loss_scale``.
+
+    Unlike the reference, exiting the context does not mutate gradients —
+    compute grads of the yielded scaled loss and pass them to
+    ``optimizer.step``, which unscales and skips on overflow
+    (reference handle.py:128-154 semantics).
+    """
+    if _amp_state.opt_properties is None or not _amp_state.opt_properties.enabled:
+        yield loss
+        return
+    if loss_id < len(_amp_state.loss_scalers):
+        scaler = _amp_state.loss_scalers[loss_id]
+    else:
+        raise RuntimeError("Invalid loss_id {}".format(loss_id))
+    yield scaler.scale(loss)
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Disable the O1 dtype policy inside the context
+    (reference handle.py:163-167)."""
+    from apex_tpu.amp import policy
+
+    prev = getattr(policy._local, "policy", None)
+    policy._local.policy = policy.DtypePolicy(enabled=False)
+    try:
+        yield
+    finally:
+        policy._local.policy = prev

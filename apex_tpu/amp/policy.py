@@ -1,0 +1,125 @@
+"""Dtype policy — the TPU analog of amp O1 function patching.
+
+Parity: reference apex/amp/amp.py:30-183 (monkey-patching torch namespaces
+per white/black lists, ``half_function``/``float_function``/
+``promote_function`` decorators and ``register_*`` registry) and the cast
+lists in apex/amp/lists/.
+
+TPU design: under jit the program is traced once, so instead of patching a
+namespace at runtime we maintain a context-scoped *policy* object that
+apex_tpu layers (and user code, via the decorators) consult at trace time:
+- compute ops (matmul/conv classes, the functional_overrides white list)
+  run in ``compute_dtype`` (bf16 by default),
+- reduction/loss ops (the black list) run in fp32,
+- promote ops follow ``jnp.promote_types`` of their inputs.
+"""
+
+import contextlib
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class DtypePolicy(object):
+    def __init__(self, enabled=False, compute_dtype=jnp.bfloat16,
+                 cast_model_outputs=None):
+        self.enabled = enabled
+        self.compute_dtype = compute_dtype
+        self.cast_model_outputs = cast_model_outputs
+
+    def cast_to_compute(self, *args):
+        if not self.enabled:
+            return args if len(args) > 1 else args[0]
+        out = tuple(_cast_tree(a, self.compute_dtype) for a in args)
+        return out if len(out) > 1 else out[0]
+
+    def cast_to_float(self, *args):
+        if not self.enabled:
+            return args if len(args) > 1 else args[0]
+        out = tuple(_cast_tree(a, jnp.float32) for a in args)
+        return out if len(out) > 1 else out[0]
+
+
+def _is_float_array(x):
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _cast_tree(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float_array(x) else x, tree)
+
+
+_local = threading.local()
+
+
+def current_policy() -> DtypePolicy:
+    return getattr(_local, "policy", None) or DtypePolicy(enabled=False)
+
+
+@contextlib.contextmanager
+def autocast(enabled=True, dtype=jnp.bfloat16):
+    """Context manager enabling the compute-dtype policy (amp O1)."""
+    prev = getattr(_local, "policy", None)
+    _local.policy = DtypePolicy(enabled=enabled, compute_dtype=dtype)
+    try:
+        yield _local.policy
+    finally:
+        _local.policy = prev
+
+
+# -- decorators (reference apex/amp/amp.py:30-70) ---------------------------
+
+def half_function(fn):
+    """Run ``fn`` with inputs cast to the compute dtype when amp is active."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        pol = current_policy()
+        if pol.enabled:
+            args = _cast_tree(args, pol.compute_dtype)
+            kwargs = _cast_tree(kwargs, pol.compute_dtype)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def float_function(fn):
+    """Run ``fn`` in fp32 when amp is active (loss-like ops)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        pol = current_policy()
+        if pol.enabled:
+            args = _cast_tree(args, jnp.float32)
+            kwargs = _cast_tree(kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def promote_function(fn):
+    """Promote all floating inputs to the widest input dtype."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        pol = current_policy()
+        if pol.enabled:
+            dtypes = [a.dtype for a in jax.tree_util.tree_leaves((args, kwargs))
+                      if _is_float_array(a)]
+            if dtypes:
+                widest = functools.reduce(jnp.promote_types, dtypes)
+                args = _cast_tree(args, widest)
+                kwargs = _cast_tree(kwargs, widest)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+# register_* operate on modules/objects in place (reference amp.py:42-70).
+
+def register_half_function(module, name):
+    setattr(module, name, half_function(getattr(module, name)))
+
+
+def register_float_function(module, name):
+    setattr(module, name, float_function(getattr(module, name)))
+
+
+def register_promote_function(module, name):
+    setattr(module, name, promote_function(getattr(module, name)))

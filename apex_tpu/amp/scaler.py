@@ -1,0 +1,114 @@
+"""Loss scaler with static + dynamic modes.
+
+Parity: reference apex/amp/scaler.py:33-217 — dynamic init 2^16,
+``scale_window=2000``, halve on overflow / double after 2000 clean steps
+(197-217); ``unscale`` via ``multi_tensor_scale`` with overflow detection.
+
+TPU design: the scaler state is a small pytree (scale, unskipped counter) so
+the whole scale/unscale/update cycle lives inside one jitted train step —
+no host sync on the overflow flag (the reference D2H-syncs at
+scaler.py:200). On bf16 the scaler degenerates to scale=1 but the API and
+state survive, as required for checkpoint parity.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops import multi_tensor_scale
+
+
+class ScalerState(NamedTuple):
+    loss_scale: jnp.ndarray  # f32 scalar
+    unskipped: jnp.ndarray   # i32 steps since last overflow
+
+
+class LossScaler(object):
+    warned_no_fused_kernel = False
+    warned_unscaling_non_fp32_grad = False
+    has_fused_kernel = True
+
+    def __init__(self, loss_scale, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_loss_scale=None, max_loss_scale=2.0 ** 24):
+        if loss_scale == "dynamic":
+            self.dynamic = True
+            self._loss_scale = min(max_loss_scale, init_scale)
+        else:
+            self.dynamic = False
+            self._loss_scale = loss_scale
+        self._max_loss_scale = max_loss_scale
+        self._min_loss_scale = min_loss_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        # Eager-mode mirror of the functional state.
+        self._state = self.init_state()
+
+    # -- functional API (jit-friendly) -------------------------------------
+    def init_state(self) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.asarray(self._loss_scale, jnp.float32),
+            unskipped=jnp.zeros((), jnp.int32),
+        )
+
+    def scale(self, loss, state: ScalerState = None):
+        s = (state or self._state).loss_scale
+        return loss.astype(jnp.float32) * s
+
+    def unscale_grads(self, grads, state: ScalerState = None):
+        """Unscale a grad pytree; returns (unscaled_grads, found_inf f32)."""
+        state = state or self._state
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        inv = 1.0 / state.loss_scale
+        outs, found_inf = multi_tensor_applier(
+            multi_tensor_scale, jnp.zeros((), jnp.float32), [leaves, leaves], inv)
+        return jax.tree_util.tree_unflatten(treedef, outs), found_inf
+
+    def update(self, state: ScalerState, found_inf) -> ScalerState:
+        """Dynamic scale update (reference scaler.py:197-217)."""
+        if not self.dynamic:
+            return state
+        overflow = found_inf > 0
+        new_scale = jnp.where(
+            overflow,
+            jnp.maximum(state.loss_scale / self._scale_factor,
+                        self._min_loss_scale if self._min_loss_scale else 1.0),
+            jnp.where(state.unskipped + 1 >= self._scale_window,
+                      jnp.minimum(state.loss_scale * self._scale_factor,
+                                  self._max_loss_scale),
+                      state.loss_scale))
+        new_unskipped = jnp.where(
+            overflow | (state.unskipped + 1 >= self._scale_window),
+            0, state.unskipped + 1).astype(jnp.int32)
+        return ScalerState(new_scale, new_unskipped)
+
+    # -- eager/stateful API (reference parity) -----------------------------
+    def loss_scale(self):
+        return float(self._state.loss_scale)
+
+    def unscale(self, grads):
+        grads, found_inf = self.unscale_grads(grads, self._state)
+        self._last_found_inf = found_inf
+        return grads
+
+    def update_scale(self):
+        found_inf = getattr(self, "_last_found_inf", jnp.zeros((), jnp.float32))
+        self._state = self.update(self._state, found_inf)
+        self._last_found_inf = jnp.zeros((), jnp.float32)
+        return bool(found_inf > 0)
+
+    # -- checkpointing (reference frontend.py:365-404) ---------------------
+    def state_dict(self):
+        return {
+            "loss_scale": float(self._state.loss_scale),
+            "unskipped": int(self._state.unskipped),
+            "dynamic": self.dynamic,
+        }
+
+    def load_state_dict(self, sd):
+        self.dynamic = sd.get("dynamic", self.dynamic)
+        self._state = ScalerState(
+            loss_scale=jnp.asarray(sd["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(sd.get("unskipped", 0), jnp.int32),
+        )

@@ -1,0 +1,12 @@
+"""apex_tpu.contrib — fused extras.
+
+Parity: reference apex/contrib (each subpackage behind its own build flag,
+README.md:155-182). On TPU no build flags are needed; everything is
+importable, with Pallas kernels engaging on TPU backends.
+"""
+
+from apex_tpu.contrib import clip_grad  # noqa: F401
+from apex_tpu.contrib import fmha  # noqa: F401
+from apex_tpu.contrib import focal_loss  # noqa: F401
+from apex_tpu.contrib import index_mul_2d  # noqa: F401
+from apex_tpu.contrib import xentropy  # noqa: F401

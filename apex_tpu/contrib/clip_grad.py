@@ -1,0 +1,32 @@
+"""Fused gradient clipping.
+
+Parity: reference apex/contrib/clip_grad/clip_grad.py:128 —
+``clip_grad_norm_`` drop-in built on multi_tensor_l2norm + multi_tensor_scale.
+Functional on TPU: returns (clipped_grads, total_norm).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops import multi_tensor_l2norm, multi_tensor_scale
+
+
+def clip_grad_norm_(grads, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """Clip a grad pytree by global norm; returns (new_grads, total_norm)."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if norm_type == 2.0:
+        total_norm, _ = multi_tensor_applier(
+            multi_tensor_l2norm, jnp.zeros(()), [leaves])
+    elif norm_type == float("inf"):
+        total_norm = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in leaves]))
+    else:
+        total_norm = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(l.astype(jnp.float32)), norm_type))
+                for l in leaves), 1.0 / norm_type)
+    clip_coef = max_norm / (total_norm + 1e-6)
+    clip_coef = jnp.minimum(clip_coef, 1.0)
+    outs, _ = multi_tensor_applier(
+        multi_tensor_scale, jnp.zeros(()), [leaves, leaves], clip_coef)
+    return jax.tree_util.tree_unflatten(treedef, outs), total_norm

@@ -1,0 +1,40 @@
+"""Fused focal loss.
+
+Parity: reference apex/contrib/focal_loss (focal_loss.py:60 +
+csrc/focal_loss) — ``focal_loss_forward`` over class logits for detection
+workloads: FL(p_t) = -alpha_t (1-p_t)^gamma log(p_t), with label smoothing.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
+               num_real_classes, alpha=0.25, gamma=2.0, label_smoothing=0.0):
+    """Sigmoid focal loss (reference focal_loss.py semantics).
+
+    cls_output: [..., num_classes] logits; targets: [...] int class ids with
+    -1/-2 conventions: <0 means ignore (-2) or background (-1).
+    Returns scalar loss normalized by num_positives_sum.
+    """
+    num_classes = cls_output.shape[-1]
+    valid = cls_targets_at_level >= -1
+    t = jnp.clip(cls_targets_at_level, 0, num_real_classes - 1)
+    onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.float32)
+    onehot = jnp.where((cls_targets_at_level >= 0)[..., None], onehot, 0.0)
+    if label_smoothing > 0:
+        onehot = onehot * (1 - label_smoothing) + label_smoothing / num_classes
+    x = cls_output.astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * onehot + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * onehot + (1 - p) * (1 - onehot)
+    alpha_t = alpha * onehot + (1 - alpha) * (1 - onehot)
+    fl = alpha_t * jnp.power(1 - p_t, gamma) * ce
+    fl = jnp.where(valid[..., None], fl, 0.0)
+    return jnp.sum(fl) / num_positives_sum
+
+
+class FocalLoss:
+    @staticmethod
+    def apply(*args, **kwargs):
+        return focal_loss(*args, **kwargs)

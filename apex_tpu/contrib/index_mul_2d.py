@@ -1,0 +1,13 @@
+"""Fused indexed multiply.
+
+Parity: reference apex/contrib/index_mul_2d (index_mul_2d.py:144 +
+csrc/index_mul_2d) — ``out[i] = in1[idx[i]] * in2[i]`` fused
+gather-multiply with matching backward. One XLA gather+mul on TPU.
+"""
+
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1, in2, idx1):
+    """out[i, :] = in1[idx1[i], :] * in2[i, :]."""
+    return in1[idx1] * in2

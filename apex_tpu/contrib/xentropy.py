@@ -1,0 +1,42 @@
+"""Fused softmax cross-entropy with label smoothing.
+
+Parity: reference apex/contrib/xentropy (softmax_xentropy.py:30 +
+csrc/xentropy/xentropy_kernel.cu:718) — ``SoftmaxCrossEntropyLoss`` with
+``label_smoothing``, ``padding_idx``, half-to-float.
+
+TPU design: one jitted fp32 log-softmax chain; XLA fuses it into a single
+pass (the CUDA kernel's job). Differentiable via autodiff — the backward
+(softmax - smoothed-onehot) falls out of the vjp.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0,
+                               padding_idx=0, half_to_float=False):
+    """Per-token loss [N] over logits [N, V] (reference SoftmaxCrossEntropyLoss
+    semantics; ``padding_idx`` tokens get zero loss)."""
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits32, axis=-1)
+    picked = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+    loss = logz - picked
+    if smoothing > 0:
+        mean_logits = jnp.mean(logits32, axis=-1)
+        smooth_loss = logz - mean_logits
+        loss = (1.0 - smoothing) * loss + smoothing * smooth_loss
+    if padding_idx is not None:
+        loss = jnp.where(labels == padding_idx, 0.0, loss)
+    if half_to_float:
+        return loss
+    return loss.astype(logits.dtype) if logits.dtype == jnp.float32 else loss
+
+
+class SoftmaxCrossEntropyLoss:
+    """Module-style alias (reference softmax_xentropy.py)."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0,
+              half_to_float=False):
+        return softmax_cross_entropy_loss(logits, labels, smoothing,
+                                          padding_idx, half_to_float)

@@ -1,0 +1,11 @@
+from apex_tpu.fp16_utils.fp16util import (  # noqa: F401
+    BN_convert_float,
+    network_to_half,
+    convert_network,
+    prep_param_lists,
+    model_grads_to_master_grads,
+    master_params_to_model_params,
+    to_python_float,
+)
+from apex_tpu.fp16_utils.loss_scaler import LossScaler, DynamicLossScaler  # noqa: F401
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401

@@ -1,0 +1,98 @@
+"""FP16_Optimizer — legacy master-weight optimizer wrapper.
+
+Parity: reference apex/fp16_utils/fp16_optimizer.py:13-557
+(``backward``/``update_master_grads``/``clip_master_grads``/``step``,
+static or dynamic loss scaling, fp32 master params).
+
+TPU design: a functional wrapper over any apex_tpu fused optimizer that
+keeps fp32 masters and runs the scale -> grads -> unscale -> clip -> step
+cycle in one jittable call.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+
+class FP16_Optimizer(object):
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        self.inner = init_optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+        self.first_closure_call_this_step = True
+
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale
+
+    def init(self, params):
+        inner_state = self.inner.init(params)
+        inner_state["fp32_master"] = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params)
+        return inner_state
+
+    def backward(self, loss):
+        """Return the scaled loss (the caller differentiates it);
+        reference fp16_optimizer.py backward() scales then calls
+        loss.backward()."""
+        return loss * self.loss_scaler.loss_scale
+
+    def update_master_grads(self, grads):
+        """Unscale fp16 grads into fp32 master grads; detect overflow."""
+        inv = 1.0 / self.loss_scaler.loss_scale
+        master_grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
+        bad = jnp.zeros((), jnp.bool_)
+        for g in jax.tree_util.tree_leaves(master_grads):
+            bad = bad | ~jnp.all(jnp.isfinite(g))
+        self.overflow = bool(bad)
+        self.loss_scaler.update_scale(self.overflow)
+        return master_grads
+
+    def clip_master_grads(self, grads, max_norm, norm_type=2):
+        """Clip master grads by global norm (reference clip_master_grads)."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads), total
+
+    def step(self, grads, state, params, *, lr=None):
+        """Full cycle: unscale -> overflow check -> inner step on masters ->
+        cast back to model dtype. Jit-safe (branch-free skip)."""
+        inv = 1.0 / self.loss_scaler.loss_scale
+        master_grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads)
+        bad = jnp.zeros((), jnp.float32)
+        for g in jax.tree_util.tree_leaves(master_grads):
+            bad = jnp.maximum(bad, (~jnp.all(jnp.isfinite(g))).astype(jnp.float32))
+        masters = state["fp32_master"]
+        inner_state = {k: v for k, v in state.items() if k != "fp32_master"}
+        new_masters, new_inner = self.inner.step(
+            master_grads, inner_state, masters, lr=lr, found_inf=bad)
+        new_params = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), new_masters, params)
+        new_inner["fp32_master"] = new_masters
+        return new_params, new_inner
+
+    def state_dict(self):
+        sd = {"loss_scale": self.loss_scaler.loss_scale,
+              "overflow": self.overflow}
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            sd["cur_iter"] = self.loss_scaler.cur_iter
+            sd["last_overflow_iter"] = self.loss_scaler.last_overflow_iter
+        return sd
+
+    def load_state_dict(self, sd):
+        self.overflow = sd.get("overflow", False)
+        if isinstance(self.loss_scaler, DynamicLossScaler):
+            self.loss_scaler.cur_scale = sd["loss_scale"]
+            self.loss_scaler.cur_iter = sd.get("cur_iter", 0)
+            self.loss_scaler.last_overflow_iter = sd.get("last_overflow_iter", -1)
+        else:
+            self.loss_scaler.cur_scale = sd["loss_scale"]

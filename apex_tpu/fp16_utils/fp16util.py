@@ -1,0 +1,81 @@
+"""Legacy manual mixed-precision helpers.
+
+Parity: reference apex/fp16_utils/fp16util.py (189 LoC): ``network_to_half``,
+``convert_network``, ``prep_param_lists``, ``master_params_to_model_params``,
+``model_grads_to_master_grads``, ``to_python_float``.
+
+In JAX a "network" is its parameter pytree; conversion helpers are tree
+casts. bf16 is the TPU-native half type; fp16 is accepted for parity.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import _is_norm_path
+
+
+def _cast_leaf(leaf, dtype):
+    if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+        return leaf.astype(dtype)
+    return leaf
+
+
+def network_to_half(params, dtype=jnp.bfloat16):
+    """Cast all floating params to half precision, keeping norm layers fp32
+    (reference fp16util.py network_to_half keeps BN fp32 via BN_convert_float)."""
+    return convert_network(params, dtype)
+
+
+def BN_convert_float(params):
+    """Restore norm-layer params to fp32 (reference BN_convert_float)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _cast_leaf(leaf, jnp.float32) if _is_norm_path(path) else leaf,
+        params)
+
+
+def convert_network(params, dtype):
+    """Cast params to ``dtype`` except normalization layers
+    (reference convert_network, used by amp O2 at _initialize.py:178-184)."""
+    def cast(path, leaf):
+        if _is_norm_path(path):
+            return _cast_leaf(leaf, jnp.float32)
+        return _cast_leaf(leaf, dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(params, flat_master=False):
+    """Return (model_params, fp32 master copies).
+
+    Parity: reference prep_param_lists; with ``flat_master=True`` masters are
+    one flat fp32 vector (the reference's _flatten_dense_tensors path).
+    """
+    model_leaves = jax.tree_util.tree_leaves(params)
+    if flat_master:
+        flat = jnp.concatenate([p.reshape(-1).astype(jnp.float32) for p in model_leaves])
+        return model_leaves, [flat]
+    return model_leaves, [p.astype(jnp.float32) for p in model_leaves]
+
+
+def model_grads_to_master_grads(model_grads, master_params, flat_master=False):
+    if flat_master:
+        return [jnp.concatenate([g.reshape(-1).astype(jnp.float32) for g in model_grads])]
+    return [g.astype(jnp.float32) for g in model_grads]
+
+
+def master_params_to_model_params(model_params, master_params, flat_master=False):
+    if flat_master:
+        flat = master_params[0]
+        outs, off = [], 0
+        for p in model_params:
+            n = p.size
+            outs.append(flat[off:off + n].reshape(p.shape).astype(p.dtype))
+            off += n
+        return outs
+    return [m.astype(p.dtype) for m, p in zip(master_params, model_params)]
+
+
+def to_python_float(t):
+    if hasattr(t, "item"):
+        return t.item()
+    return float(t)

@@ -1,0 +1,73 @@
+"""Legacy loss scalers.
+
+Parity: reference apex/fp16_utils/loss_scaler.py (188 LoC): ``LossScaler``
+(static) and ``DynamicLossScaler`` (overflow backoff / growth-interval).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _has_overflow(grads):
+    leaves = jax.tree_util.tree_leaves(grads)
+    bad = jnp.zeros((), jnp.bool_)
+    for g in leaves:
+        bad = bad | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+    return bad
+
+
+class LossScaler(object):
+    """Static loss scaler (reference loss_scaler.py LossScaler)."""
+
+    def __init__(self, scale=1.0):
+        self.cur_scale = scale
+
+    def has_overflow(self, params):
+        return False
+
+    def update_scale(self, overflow):
+        pass
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
+
+    def backward(self, loss):
+        return loss * self.loss_scale
+
+
+class DynamicLossScaler(object):
+    """Dynamic loss scaler (reference loss_scaler.py DynamicLossScaler:
+    backoff 0.5 on overflow, x2 every ``scale_window`` clean steps)."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0, scale_window=1000):
+        self.cur_scale = init_scale
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, grads):
+        return bool(_has_overflow(grads))
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1)
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
+
+    def backward(self, loss):
+        return loss * self.loss_scale

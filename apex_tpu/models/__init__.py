@@ -1,0 +1,18 @@
+"""apex_tpu.models — model families used by examples, tests and benches.
+
+Parity: reference apex/transformer/testing/standalone_transformer_lm.py
+(GPT/BERT Megatron models, 1,574 LoC), examples/imagenet (ResNet),
+examples/dcgan (DCGAN).
+"""
+
+from apex_tpu.models.transformer_lm import (  # noqa: F401
+    ParallelAttention,
+    ParallelMLP,
+    ParallelTransformerLayer,
+    ParallelTransformer,
+    TransformerConfig,
+)
+from apex_tpu.models.gpt import GPTModel, gpt_loss_fn  # noqa: F401
+from apex_tpu.models.bert import BertModel, bert_loss_fn  # noqa: F401
+from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
+from apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401

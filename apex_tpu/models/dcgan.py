@@ -1,0 +1,67 @@
+"""DCGAN generator/discriminator (NHWC).
+
+Parity: reference examples/dcgan/main_amp.py models (standard DCGAN:
+transposed-conv generator, strided-conv discriminator, BN + (leaky)ReLU) —
+the multi-loss amp example (``num_losses=3``).
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    ngf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        # z: [b, 1, 1, nz]
+        x = z.astype(self.dtype)
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,  # noqa: E731
+                                         dtype=self.dtype,
+                                         param_dtype=jnp.float32, name=name)
+        x = nn.ConvTranspose(self.ngf * 8, (4, 4), (1, 1), padding="VALID",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(norm("bn1")(x))
+        x = nn.ConvTranspose(self.ngf * 4, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(norm("bn2")(x))
+        x = nn.ConvTranspose(self.ngf * 2, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(norm("bn3")(x))
+        x = nn.ConvTranspose(self.ngf, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(norm("bn4")(x))
+        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+                             use_bias=False, dtype=self.dtype)(x)
+        return jnp.tanh(x.astype(jnp.float32))
+
+
+class Discriminator(nn.Module):
+    ndf: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, img, train: bool = True):
+        x = img.astype(self.dtype)
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,  # noqa: E731
+                                         dtype=self.dtype,
+                                         param_dtype=jnp.float32, name=name)
+        x = nn.Conv(self.ndf, (4, 4), (2, 2), padding=1, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(self.ndf * 2, (4, 4), (2, 2), padding=1, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(norm("bn1")(x), 0.2)
+        x = nn.Conv(self.ndf * 4, (4, 4), (2, 2), padding=1, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(norm("bn2")(x), 0.2)
+        x = nn.Conv(self.ndf * 8, (4, 4), (2, 2), padding=1, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(norm("bn3")(x), 0.2)
+        x = nn.Conv(1, (4, 4), (1, 1), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(x)
+        return x.reshape(x.shape[0], -1).astype(jnp.float32)

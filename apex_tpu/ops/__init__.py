@@ -1,0 +1,24 @@
+"""apex_tpu.ops — fused op implementations (jnp + Pallas TPU kernels).
+
+This layer is the TPU-native equivalent of the reference's ``csrc/`` CUDA
+extension layer (reference setup.py:109-359). Each CUDA kernel family gets
+either (a) a Pallas TPU kernel, or (b) a jitted jnp composition that XLA
+fuses into one loop — whichever profiles better on the MXU/VPU. Python entry
+points mirror the pybind exports (reference csrc/amp_C_frontend.cpp:160-188).
+"""
+
+from apex_tpu.ops.multi_tensor import (  # noqa: F401
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_l2norm_mp,
+    multi_tensor_l2norm_scale,
+    multi_tensor_sgd,
+    multi_tensor_adam,
+    multi_tensor_adam_capturable,
+    multi_tensor_adam_capturable_master,
+    multi_tensor_adagrad,
+    multi_tensor_novograd,
+    multi_tensor_lamb,
+    multi_tensor_lamb_mp,
+)

@@ -1,0 +1,314 @@
+"""Fused LayerNorm / RMSNorm — Pallas TPU kernels with custom VJP.
+
+Parity: reference csrc/layer_norm_cuda.cpp (442) + layer_norm_cuda_kernel.cu
+(1,170) exporting ``forward[_affine]``, ``backward[_affine]``,
+``rms_forward*``, ``rms_backward*`` — consumed by
+apex/normalization/fused_layer_norm.py:32-165.
+
+TPU design: one Pallas kernel per (fwd, bwd-dx) pass, gridded over row
+blocks with the full hidden dim resident in VMEM; per-row statistics are
+computed in fp32 on the VPU. The backward *recomputes* the row stats from
+the stashed input instead of round-tripping them through HBM (stats are
+VPU-cheap; HBM bandwidth is the bottleneck). Weight/bias grads are
+column-sum reductions that XLA already does optimally, so they stay as jnp
+reductions in the VJP. On non-TPU backends (CPU tests) a pure-jnp path
+with identical math is used — the same strategy as the reference's CPU
+fallback (fused_layer_norm.py:411-413 "CPU path is here mainly for
+unittest sake").
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_INTERPRET = False  # flipped by tests to debug kernels
+
+
+def _use_pallas(*arrays) -> bool:
+    import os
+
+    if os.environ.get("APEX_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _row_block(n_rows: int, hidden: int) -> int:
+    # Keep x, y and temps for a block within a few MB of VMEM.
+    budget = 4 * 1024 * 1024
+    rows = max(8, budget // max(1, 4 * hidden * 4))
+    rows = min(rows, 512)
+    rows = max(8, (rows // 8) * 8)
+    return rows
+
+
+def _ln_stats(x):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    return mean, var
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm kernels
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, *, eps, affine):
+    x = x_ref[...].astype(jnp.float32)
+    mean, var = _ln_stats(x)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if affine:
+        y = y * w_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _ln_bwd_kernel(dy_ref, x_ref, w_ref, dx_ref, *, eps, affine):
+    dy = dy_ref[...].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
+    mean, var = _ln_stats(x)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    wdy = dy * w_ref[...].astype(jnp.float32) if affine else dy
+    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _pallas_rowwise(kernel, outs_dtype, x2d, *vectors):
+    """Launch a row-blocked kernel: x2d [n, h] gridded over rows, each
+    vector arg [h] broadcast to every block."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h = x2d.shape
+    rb = _row_block(n, h)
+    grid = (pl.cdiv(n, rb),)
+    in_specs = [pl.BlockSpec((rb, h), lambda i: (i, 0), memory_space=pltpu.VMEM)]
+    args = [x2d]
+    for v in vectors:
+        if v.ndim == 2 and v.shape[0] == n:
+            in_specs.append(pl.BlockSpec((rb, h), lambda i: (i, 0),
+                                         memory_space=pltpu.VMEM))
+        else:
+            in_specs.append(pl.BlockSpec((h,), lambda i: (0,),
+                                         memory_space=pltpu.VMEM))
+        args.append(v)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rb, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, h), outs_dtype),
+        interpret=_INTERPRET,
+    )(*args)
+
+
+def _ones(h):
+    return jnp.ones((h,), jnp.float32)
+
+
+def _ln_fwd(x2d, weight, bias, eps):
+    if _use_pallas(x2d):
+        h = x2d.shape[1]
+        affine = weight is not None
+        kernel = functools.partial(_ln_fwd_kernel, eps=eps, affine=affine)
+        w = weight if affine else _ones(h)
+        b = bias if bias is not None else jnp.zeros((h,), jnp.float32)
+        # kernel signature: (x, w, b, y)
+        def k(x_ref, w_ref, b_ref, y_ref):
+            kernel(x_ref, w_ref, b_ref, y_ref)
+        return _pallas_rowwise(k, x2d.dtype, x2d, w, b)
+    x = x2d.astype(jnp.float32)
+    mean, var = _ln_stats(x)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x2d.dtype)
+
+
+def _ln_bwd_dx(dy2d, x2d, weight, eps):
+    if _use_pallas(x2d):
+        h = x2d.shape[1]
+        affine = weight is not None
+        w = weight if affine else _ones(h)
+        kernel = functools.partial(_ln_bwd_kernel, eps=eps, affine=affine)
+
+        def k(x_ref, dy_ref, w_ref, dx_ref):
+            kernel(dy_ref, x_ref, w_ref, dx_ref)
+        return _pallas_rowwise(k, x2d.dtype, x2d, dy2d, w)
+    dy = dy2d.astype(jnp.float32)
+    x = x2d.astype(jnp.float32)
+    mean, var = _ln_stats(x)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    wdy = dy * weight.astype(jnp.float32) if weight is not None else dy
+    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * rstd
+    return dx.astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _layer_norm_affine(x2d, weight, bias, eps, out_dtype):
+    return _ln_fwd(x2d, weight, bias, eps).astype(out_dtype)
+
+
+def _layer_norm_affine_fwd(x2d, weight, bias, eps, out_dtype):
+    y = _ln_fwd(x2d, weight, bias, eps)
+    return y.astype(out_dtype), (x2d, weight)
+
+
+def _layer_norm_affine_bwd(eps, out_dtype, res, dy):
+    x2d, weight = res
+    dy2d = dy.astype(x2d.dtype)
+    dx = _ln_bwd_dx(dy2d, x2d, weight, eps)
+    if weight is not None:
+        x = x2d.astype(jnp.float32)
+        mean, var = _ln_stats(x)
+        xhat = (x - mean) * jax.lax.rsqrt(var + eps)
+        dyf = dy.astype(jnp.float32)
+        dw = jnp.sum(dyf * xhat, axis=0).astype(weight.dtype)
+        db = jnp.sum(dyf, axis=0).astype(weight.dtype)
+    else:
+        dw = None
+        db = None
+    return dx, dw, db
+
+
+_layer_norm_affine.defvjp(_layer_norm_affine_fwd, _layer_norm_affine_bwd)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
+               out_dtype=None):
+    """Fused layer norm over the trailing ``normalized_shape`` dims.
+
+    Entry-point parity: fused_layer_norm_cuda.forward[_affine]
+    (reference apex/normalization/fused_layer_norm.py:43-77).
+    """
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    normalized_shape = tuple(normalized_shape)
+    h = 1
+    for d in normalized_shape:
+        h *= d
+    orig_shape = x.shape
+    x2d = x.reshape(-1, h)
+    w = weight.reshape(h) if weight is not None else None
+    b = bias.reshape(h) if bias is not None else None
+    out_dtype = out_dtype or x.dtype
+    y = _layer_norm_affine(x2d, w, b, float(eps), out_dtype)
+    return y.reshape(orig_shape)
+
+
+# ---------------------------------------------------------------------------
+# RMSNorm kernels
+# ---------------------------------------------------------------------------
+
+def _rms_fwd_kernel(x_ref, w_ref, y_ref, *, eps, affine):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps)
+    if affine:
+        y = y * w_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _rms_bwd_kernel(dy_ref, x_ref, w_ref, dx_ref, *, eps, affine):
+    dy = dy_ref[...].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    xhat = x * rstd
+    wdy = dy * w_ref[...].astype(jnp.float32) if affine else dy
+    c = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - xhat * c) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _rms_fwd(x2d, weight, eps):
+    if _use_pallas(x2d):
+        h = x2d.shape[1]
+        affine = weight is not None
+        w = weight if affine else _ones(h)
+        kernel = functools.partial(_rms_fwd_kernel, eps=eps, affine=affine)
+
+        def k(x_ref, w_ref, y_ref):
+            kernel(x_ref, w_ref, y_ref)
+        return _pallas_rowwise(k, x2d.dtype, x2d, w)
+    x = x2d.astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    return y.astype(x2d.dtype)
+
+
+def _rms_bwd_dx(dy2d, x2d, weight, eps):
+    if _use_pallas(x2d):
+        h = x2d.shape[1]
+        affine = weight is not None
+        w = weight if affine else _ones(h)
+        kernel = functools.partial(_rms_bwd_kernel, eps=eps, affine=affine)
+
+        def k(x_ref, dy_ref, w_ref, dx_ref):
+            kernel(dy_ref, x_ref, w_ref, dx_ref)
+        return _pallas_rowwise(k, x2d.dtype, x2d, dy2d, w)
+    dy = dy2d.astype(jnp.float32)
+    x = x2d.astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    xhat = x * rstd
+    wdy = dy * weight.astype(jnp.float32) if weight is not None else dy
+    c = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - xhat * c) * rstd
+    return dx.astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm_affine(x2d, weight, eps, out_dtype):
+    return _rms_fwd(x2d, weight, eps).astype(out_dtype)
+
+
+def _rms_norm_affine_fwd(x2d, weight, eps, out_dtype):
+    y = _rms_fwd(x2d, weight, eps)
+    return y.astype(out_dtype), (x2d, weight)
+
+
+def _rms_norm_affine_bwd(eps, out_dtype, res, dy):
+    x2d, weight = res
+    dy2d = dy.astype(x2d.dtype)
+    dx = _rms_bwd_dx(dy2d, x2d, weight, eps)
+    if weight is not None:
+        x = x2d.astype(jnp.float32)
+        ms = jnp.mean(x * x, axis=-1, keepdims=True)
+        xhat = x * jax.lax.rsqrt(ms + eps)
+        dw = jnp.sum(dy.astype(jnp.float32) * xhat, axis=0).astype(weight.dtype)
+    else:
+        dw = None
+    return dx, dw
+
+
+_rms_norm_affine.defvjp(_rms_norm_affine_fwd, _rms_norm_affine_bwd)
+
+
+def rms_norm(x, normalized_shape, weight=None, eps=1e-5, out_dtype=None):
+    """Fused RMSNorm (entry-point parity: fused_layer_norm_cuda.rms_forward*,
+    reference apex/normalization/fused_layer_norm.py:80-164)."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    normalized_shape = tuple(normalized_shape)
+    h = 1
+    for d in normalized_shape:
+        h *= d
+    orig_shape = x.shape
+    x2d = x.reshape(-1, h)
+    w = weight.reshape(h) if weight is not None else None
+    out_dtype = out_dtype or x.dtype
+    y = _rms_norm_affine(x2d, w, float(eps), out_dtype)
+    return y.reshape(orig_shape)

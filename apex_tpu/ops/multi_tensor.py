@@ -1,0 +1,406 @@
+"""Multi-tensor fused update ops (TPU-native ``amp_C``).
+
+Parity surface: reference csrc/amp_C_frontend.cpp:160-188 exports
+``multi_tensor_scale/sgd/axpby/l2norm[_mp|_scale]/adam[_capturable]/adagrad/
+novograd/lamb[_mp]`` — chunked CUDA kernels over lists of tensors
+(csrc/multi_tensor_apply.cuh:15-26 takes <=110 tensors per launch with a
+device-side ``noop_flag`` for overflow-abort).
+
+TPU design: the GPU problem these kernels solve — thousands of tiny kernel
+launches — does not exist under XLA. Every op here is a pure function over
+*lists of arrays* that is called inside one ``jit``; XLA fuses the whole
+parameter sweep into a handful of loops over HBM. The ``noop_flag`` becomes a
+functional overflow scalar threaded through the update (the same scheme the
+reference's ``capturable`` CUDA-graph path uses, apex/optimizers/
+fused_adam.py:171-229): updates are computed unconditionally and selected
+with ``jnp.where(noop, old, new)`` so the step stays branch-free under jit.
+
+All ops are functional: they *return* new lists instead of mutating in place.
+"""
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+
+Arrays = List[jnp.ndarray]
+
+
+def _finite_flag(tensors: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Return 1.0 if any tensor contains inf/nan else 0.0 (the noop flag)."""
+    bad = jnp.zeros((), jnp.bool_)
+    for t in tensors:
+        bad = bad | ~jnp.all(jnp.isfinite(t.astype(jnp.float32)))
+    return bad.astype(jnp.float32)
+
+
+def _keep(noop, old, new):
+    """Select ``old`` where the overflow flag is set (branch-free skip)."""
+    return jnp.where(noop > 0, old, new).astype(old.dtype)
+
+
+# ---------------------------------------------------------------------------
+# scale / axpby / l2norm — the amp + DDP helpers
+# ---------------------------------------------------------------------------
+
+def multi_tensor_scale(noop_flag, tensor_lists, scale):
+    """out[i] = in[i] * scale, with inf/nan detection.
+
+    Parity: csrc/multi_tensor_scale_kernel.cu via apex/amp/scaler.py:57-71.
+    ``tensor_lists`` = [ins, outs]; the outs only matter for dtype. Returns
+    (new_outs, noop_flag_out).
+    """
+    ins, outs = tensor_lists
+    new_outs = []
+    bad = noop_flag
+    for x, o in zip(ins, outs):
+        y = x.astype(jnp.float32) * scale
+        bad = jnp.maximum(bad, _finite_flag([y]))
+        new_outs.append(y.astype(o.dtype))
+    return new_outs, bad
+
+
+def multi_tensor_axpby(noop_flag, tensor_lists, a, b, arg_to_check=-1):
+    """out[i] = a*x[i] + b*y[i] with inf/nan detection.
+
+    Parity: csrc/multi_tensor_axpby_kernel.cu via apex/amp/scaler.py:152-189
+    (grad accumulation with stashed fp32 grads).
+    """
+    xs, ys, outs = tensor_lists
+    new_outs = []
+    bad = noop_flag
+    for x, y, o in zip(xs, ys, outs):
+        r = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        bad = jnp.maximum(bad, _finite_flag([r]))
+        new_outs.append(r.astype(o.dtype))
+    return new_outs, bad
+
+
+def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
+    """Global (and optionally per-tensor) L2 norm over a list of tensors.
+
+    Parity: csrc/multi_tensor_l2norm_kernel.cu via
+    apex/optimizers/fused_lamb.py:124-133.
+    Returns (global_norm, per_tensor_norms or None).
+    """
+    (xs,) = tensor_lists
+    sq = jnp.zeros((), jnp.float32)
+    per = []
+    for x in xs:
+        s = jnp.sum(jnp.square(x.astype(jnp.float32)))
+        sq = sq + s
+        if per_tensor:
+            per.append(jnp.sqrt(s))
+    total = jnp.sqrt(sq)
+    return total, (jnp.stack(per) if per_tensor else None)
+
+
+def multi_tensor_l2norm_mp(noop_flag, tensor_lists, per_tensor=False):
+    """Mixed-precision variant: upcasts before reduction (same math here)."""
+    return multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor)
+
+
+def multi_tensor_l2norm_scale(noop_flag, tensor_lists, scale, per_tensor=False):
+    """L2 norm of scale*x (used for pre-unscaled grad norms)."""
+    (xs,) = tensor_lists
+    return multi_tensor_l2norm(noop_flag, [[x.astype(jnp.float32) * scale for x in xs]], per_tensor)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops
+# ---------------------------------------------------------------------------
+
+def multi_tensor_sgd(
+    noop_flag,
+    tensor_lists,
+    wd,
+    momentum,
+    dampening,
+    lr,
+    nesterov,
+    first_run,
+    wd_after_momentum,
+    scale=1.0,
+):
+    """Fused SGD with momentum.
+
+    Parity: csrc/multi_tensor_sgd_kernel.cu via
+    apex/optimizers/fused_sgd.py:211-213. tensor_lists = [grads, params,
+    momentum_buffers]. Returns (new_params, new_momentum, noop).
+    """
+    grads, params, moms = tensor_lists
+    new_params, new_moms = [], []
+    for g, p, m in zip(grads, params, moms):
+        g32 = g.astype(jnp.float32) * scale
+        p32 = p.astype(jnp.float32)
+        if wd != 0 and not wd_after_momentum:
+            g32 = g32 + wd * p32
+        if momentum != 0:
+            m32 = jnp.where(first_run, g32, momentum * m.astype(jnp.float32) + (1 - dampening) * g32)
+            d = g32 + momentum * m32 if nesterov else m32
+        else:
+            m32 = m.astype(jnp.float32)
+            d = g32
+        if wd != 0 and wd_after_momentum:
+            d = d + wd * p32
+        p_new = p32 - lr * d
+        new_params.append(_keep(noop_flag, p, p_new))
+        new_moms.append(_keep(noop_flag, m, m32))
+    return new_params, new_moms, noop_flag
+
+
+def multi_tensor_adam(
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    mode,
+    bias_correction,
+    weight_decay,
+):
+    """Fused Adam/AdamW.
+
+    Parity: csrc/multi_tensor_adam.cu via apex/optimizers/fused_adam.py:231-269.
+    tensor_lists = [grads, params, exp_avgs, exp_avg_sqs].
+    ``mode``: 0 = L2 regularization (classic Adam), 1 = decoupled wd (AdamW).
+    Returns (new_params, new_m, new_v, noop).
+    """
+    grads, params, ms, vs = tensor_lists
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+        bc2 = 1.0 - beta2 ** step
+    else:
+        bc1 = bc2 = 1.0
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(grads, params, ms, vs):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if mode == 0 and weight_decay != 0:
+            g32 = g32 + weight_decay * p32
+        m32 = beta1 * m.astype(jnp.float32) + (1 - beta1) * g32
+        v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * jnp.square(g32)
+        m_hat = m32 / bc1
+        v_hat = v32 / bc2
+        update = m_hat / (jnp.sqrt(v_hat) + eps)
+        if mode == 1 and weight_decay != 0:
+            update = update + weight_decay * p32
+        p_new = p32 - lr * update
+        new_p.append(_keep(noop_flag, p, p_new))
+        new_m.append(_keep(noop_flag, m, m32))
+        new_v.append(_keep(noop_flag, v, v32))
+    return new_p, new_m, new_v, noop_flag
+
+
+def multi_tensor_adam_capturable(noop_flag, tensor_lists, lr, beta1, beta2, eps, step, mode, bias_correction, weight_decay, inv_scale=1.0):
+    """Capturable Adam: grads arrive still scaled; unscale inside the update.
+
+    Parity: multi_tensor_adam_capturable (csrc/multi_tensor_adam.cu) used by
+    apex/optimizers/fused_adam.py:188-229 for CUDA-graph capture. On TPU the
+    whole step is always "captured" (jitted) so this simply folds the
+    unscale into the update.
+    """
+    grads, params, ms, vs = tensor_lists
+    grads = [g.astype(jnp.float32) * inv_scale for g in grads]
+    return multi_tensor_adam(
+        noop_flag, [grads, params, ms, vs], lr, beta1, beta2, eps, step, mode, bias_correction, weight_decay
+    )
+
+
+def multi_tensor_adam_capturable_master(noop_flag, tensor_lists, lr, beta1, beta2, eps, step, mode, bias_correction, weight_decay, inv_scale=1.0):
+    """Capturable Adam with fp32 master weights.
+
+    tensor_lists = [grads, params(low-prec), exp_avgs, exp_avg_sqs, masters].
+    The update is computed on the fp32 masters; low-precision params are a
+    cast of the masters (reference multi_tensor_adam.cu master variant).
+    """
+    grads, params, ms, vs, masters = tensor_lists
+    grads = [g.astype(jnp.float32) * inv_scale for g in grads]
+    new_masters, new_m, new_v, noop = multi_tensor_adam(
+        noop_flag, [grads, masters, ms, vs], lr, beta1, beta2, eps, step, mode, bias_correction, weight_decay
+    )
+    new_params = [nm.astype(p.dtype) for nm, p in zip(new_masters, params)]
+    return new_params, new_m, new_v, new_masters, noop
+
+
+def multi_tensor_adagrad(noop_flag, tensor_lists, lr, eps, mode, weight_decay):
+    """Fused Adagrad. Parity: csrc/multi_tensor_adagrad.cu via
+    apex/optimizers/fused_adagrad.py:5-121. tensor_lists = [grads, params, h]."""
+    grads, params, hs = tensor_lists
+    new_p, new_h = [], []
+    for g, p, h in zip(grads, params, hs):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if mode == 0 and weight_decay != 0:
+            g32 = g32 + weight_decay * p32
+        h32 = h.astype(jnp.float32) + jnp.square(g32)
+        update = g32 / (jnp.sqrt(h32) + eps)
+        if mode == 1 and weight_decay != 0:
+            update = update + weight_decay * p32
+        p_new = p32 - lr * update
+        new_p.append(_keep(noop_flag, p, p_new))
+        new_h.append(_keep(noop_flag, h, h32))
+    return new_p, new_h, noop_flag
+
+
+def multi_tensor_novograd(
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    bias_correction,
+    weight_decay,
+    grad_averaging,
+    moment_mode,
+    norm_type,
+):
+    """Fused NovoGrad: per-*tensor* second moment (layer-wise ||g||).
+
+    Parity: csrc/multi_tensor_novograd.cu via
+    apex/optimizers/fused_novograd.py:183-198. tensor_lists = [grads, params,
+    exp_avgs]; the per-tensor second moments ride in a stacked vector.
+    ``moment_mode``: 0 = L2-into-grad before moments, 1 = decoupled wd.
+    Returns (new_params, new_m, new_v_vector, noop).
+    """
+    grads, params, ms, v_vec = tensor_lists[0], tensor_lists[1], tensor_lists[2], tensor_lists[3]
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+    else:
+        bc1 = 1.0
+    beta3 = (1 - beta1) if grad_averaging else 1.0
+    new_p, new_m, new_v = [], [], []
+    for i, (g, p, m) in enumerate(zip(grads, params, ms)):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if norm_type == 2:
+            gnorm_sq = jnp.sum(jnp.square(g32))
+        else:  # max-norm
+            gnorm_sq = jnp.square(jnp.max(jnp.abs(g32)))
+        v_prev = v_vec[i].astype(jnp.float32)
+        v32 = jnp.where(step == 1, gnorm_sq, beta2 * v_prev + (1 - beta2) * gnorm_sq)
+        denom = jnp.sqrt(v32) + eps
+        gn = g32 / denom
+        if weight_decay != 0 and moment_mode == 0:
+            gn = gn + weight_decay * p32
+        m32 = beta1 * m.astype(jnp.float32) + beta3 * gn
+        update = m32 / bc1
+        if weight_decay != 0 and moment_mode == 1:
+            update = update + weight_decay * p32
+        p_new = p32 - lr * update
+        new_p.append(_keep(noop_flag, p, p_new))
+        new_m.append(_keep(noop_flag, m, m32))
+        new_v.append(jnp.where(noop_flag > 0, v_prev, v32))
+    return new_p, new_m, jnp.stack(new_v), noop_flag
+
+
+def _lamb_update_lists(
+    noop_flag, grads, params, ms, vs, lr, beta1, beta2, eps, step, bias_correction,
+    weight_decay, grad_averaging, mode, global_grad_norm, max_grad_norm, use_nvlamb,
+):
+    """Shared LAMB math for the fused and mixed-precision variants."""
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+        bc2 = 1.0 - beta2 ** step
+    else:
+        bc1 = bc2 = 1.0
+    beta3 = (1 - beta1) if grad_averaging else 1.0
+    # Global gradient clipping (csrc/multi_tensor_lamb.cu scales by
+    # clipped_global_grad_norm = max(gnorm/max_norm, 1)).
+    if max_grad_norm is not None and max_grad_norm > 0:
+        clip = jnp.maximum(global_grad_norm / max_grad_norm, 1.0)
+    else:
+        clip = jnp.asarray(1.0, jnp.float32)
+    new_p, new_m, new_v = [], [], []
+    for g, p, m, v in zip(grads, params, ms, vs):
+        g32 = g.astype(jnp.float32) / clip
+        p32 = p.astype(jnp.float32)
+        if mode == 0 and weight_decay != 0:  # L2 into grad
+            g32 = g32 + weight_decay * p32
+        m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
+        v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * jnp.square(g32)
+        m_hat = m32 / bc1
+        v_hat = v32 / bc2
+        update = m_hat / (jnp.sqrt(v_hat) + eps)
+        if mode == 1 and weight_decay != 0:  # decoupled (LAMB default)
+            update = update + weight_decay * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        # Trust ratio; NVLAMB applies it even when wd == 0.
+        apply_trust = (weight_decay != 0) or use_nvlamb
+        if apply_trust:
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        else:
+            ratio = jnp.asarray(1.0, jnp.float32)
+        p_new = p32 - lr * ratio * update
+        new_p.append(_keep(noop_flag, p, p_new))
+        new_m.append(_keep(noop_flag, m, m32))
+        new_v.append(_keep(noop_flag, v, v32))
+    return new_p, new_m, new_v
+
+
+def multi_tensor_lamb(
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    bias_correction,
+    weight_decay,
+    grad_averaging,
+    mode,
+    global_grad_norm,
+    max_grad_norm,
+    use_nvlamb=False,
+):
+    """Fused LAMB. Parity: csrc/multi_tensor_lamb.cu via
+    apex/optimizers/fused_lamb.py:183-199. tensor_lists = [grads, params, m, v]."""
+    grads, params, ms, vs = tensor_lists
+    new_p, new_m, new_v = _lamb_update_lists(
+        noop_flag, grads, params, ms, vs, lr, beta1, beta2, eps, step,
+        bias_correction, weight_decay, grad_averaging, mode, global_grad_norm,
+        max_grad_norm, use_nvlamb,
+    )
+    return new_p, new_m, new_v, noop_flag
+
+
+def multi_tensor_lamb_mp(
+    noop_flag,
+    tensor_lists,
+    lr,
+    beta1,
+    beta2,
+    eps,
+    step,
+    bias_correction,
+    weight_decay,
+    grad_averaging,
+    mode,
+    global_grad_norm,
+    max_grad_norm,
+    use_nvlamb,
+    found_inf,
+    inv_scale,
+):
+    """Mixed-precision LAMB with fp32 master params.
+
+    Parity: csrc/multi_tensor_lamb_mp.cu via
+    apex/optimizers/fused_mixed_precision_lamb.py:8-256.
+    tensor_lists = [grads, params(low-prec), m, v, masters].
+    """
+    grads, params, ms, vs, masters = tensor_lists
+    noop = jnp.maximum(noop_flag, found_inf)
+    grads32 = [g.astype(jnp.float32) * inv_scale for g in grads]
+    new_masters, new_m, new_v = _lamb_update_lists(
+        noop, grads32, masters, ms, vs, lr, beta1, beta2, eps, step,
+        bias_correction, weight_decay, grad_averaging, mode, global_grad_norm,
+        max_grad_norm, use_nvlamb,
+    )
+    new_params = [nm.astype(p.dtype) for nm, p in zip(new_masters, params)]
+    return new_params, new_m, new_v, new_masters, noop

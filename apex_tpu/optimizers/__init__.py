@@ -1,0 +1,21 @@
+"""apex_tpu.optimizers — fused optimizers.
+
+Parity: reference apex/optimizers/__init__.py exports FusedAdam, FusedLAMB,
+FusedSGD, FusedNovoGrad, FusedAdagrad, FusedMixedPrecisionLamb.
+
+TPU design: each optimizer is a pure functional stepper over parameter
+pytrees (``init(params) -> state``, ``step(grads, state, params) ->
+(params, state)``) built on :mod:`apex_tpu.ops.multi_tensor`; the entire
+update for the whole model fuses into one XLA computation — the same effect
+the CUDA multi-tensor kernels achieve with batched launches. Every optimizer
+also exposes ``as_gradient_transformation()`` for optax interop.
+"""
+
+from apex_tpu.optimizers.fused_adam import FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    FusedMixedPrecisionLamb,
+)

@@ -1,0 +1,64 @@
+"""Shared machinery for fused optimizers.
+
+The reference optimizers operate on torch param_groups segregated by dtype
+(apex/optimizers/fused_adam.py:133-167). The TPU equivalents operate on JAX
+pytrees: ``init`` builds a state pytree, ``step`` is a pure jittable function
+``(grads, state, params) -> (new_params, new_state)``. Overflow skipping is
+branch-free (``jnp.where`` on a ``found_inf`` scalar), mirroring the
+reference's ``capturable`` CUDA-graph path (fused_adam.py:171-229).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_leaves_and_def(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return leaves, treedef
+
+
+def zeros_like_tree(params, dtype=jnp.float32):
+    return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, dtype), params)
+
+
+def cast_tree(params, dtype):
+    return jax.tree_util.tree_map(lambda p: p.astype(dtype), params)
+
+
+class FusedOptimizerBase:
+    """Base class giving the stateful-eager and optax views of a stepper."""
+
+    def init(self, params) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self, grads, state, params, *, lr: Optional[float] = None,
+             found_inf=None, scale: float = 1.0):
+        raise NotImplementedError
+
+    # -- optax interop ------------------------------------------------------
+    def as_gradient_transformation(self):
+        """Return an optax.GradientTransformation computing ``new - old``
+        updates so that ``optax.apply_updates`` matches ``self.step``."""
+        import optax
+
+        def init_fn(params):
+            return {"inner": self.init(params), "params": params}
+
+        def update_fn(grads, state, params=None):
+            if params is None:
+                params = state["params"]
+            new_params, new_inner = self.step(grads, state["inner"], params)
+            updates = jax.tree_util.tree_map(
+                lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+                new_params, params)
+            return updates, {"inner": new_inner, "params": new_params}
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+
+def resolve_found_inf(found_inf):
+    if found_inf is None:
+        return jnp.zeros((), jnp.float32)
+    return jnp.asarray(found_inf, jnp.float32).reshape(())

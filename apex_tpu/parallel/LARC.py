@@ -1,0 +1,53 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/Scaling.
+
+Parity: reference apex/parallel/LARC.py:5-107: wraps any optimizer; per
+param computes ``adaptive_lr = trust_coefficient * ||p|| / (||g|| +
+weight_decay * ||p|| + eps)``; in ``clip`` mode the effective lr is
+``min(adaptive_lr / lr, 1)``; grads are rescaled before the wrapped
+optimizer's step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class LARC(object):
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True, eps=1e-8,
+                 weight_decay=0.0):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    @property
+    def lr(self):
+        return self.optim.lr
+
+    def init(self, params):
+        return self.optim.init(params)
+
+    def _rescale(self, grads, params, lr):
+        def scale_one(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive_lr = self.trust_coefficient * p_norm / (
+                g_norm + self.weight_decay * p_norm + self.eps)
+            # Zero-norm params fall back to the plain lr (reference LARC.py:95).
+            adaptive_lr = jnp.where((p_norm > 0) & (g_norm > 0), adaptive_lr, lr)
+            if self.clip:
+                ratio = jnp.minimum(adaptive_lr / lr, 1.0)
+            else:
+                ratio = adaptive_lr / lr
+            g32 = g32 + self.weight_decay * p32
+            return (g32 * ratio).astype(g.dtype)
+
+        return jax.tree_util.tree_map(scale_one, grads, params)
+
+    def step(self, grads, state, params, *, lr=None, found_inf=None, scale=1.0):
+        eff_lr = self.optim.lr if lr is None else lr
+        grads = self._rescale(grads, params, eff_lr)
+        return self.optim.step(grads, state, params, lr=lr,
+                               found_inf=found_inf, scale=scale)

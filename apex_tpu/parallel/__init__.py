@@ -1,0 +1,62 @@
+"""apex_tpu.parallel — data-parallel runtime.
+
+Parity: reference apex/parallel/__init__.py exports DistributedDataParallel,
+Reducer, SyncBatchNorm, convert_syncbn_model, create_syncbn_process_group,
+LARC.
+
+TPU design: data parallelism is a mesh axis, not a process group. DDP's
+autograd-hook/bucket/stream machinery (reference apex/parallel/
+distributed.py:323-479) collapses into a gradient ``psum`` inside one
+jitted train step; XLA's latency-hiding scheduler overlaps the allreduce
+with the backward pass — the same overlap the reference hand-builds with
+CUDA streams.
+"""
+
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    all_reduce_gradients,
+    broadcast_params,
+    flatten,
+    unflatten,
+)
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, sync_batch_norm  # noqa: F401
+from apex_tpu.parallel.LARC import LARC  # noqa: F401
+
+
+def convert_syncbn_model(module, process_group=None, channel_last=False):
+    """Swap BatchNorm layers for SyncBatchNorm in an apex_tpu model.
+
+    Parity: reference apex/parallel/__init__.py:21-97. Works on apex_tpu
+    model classes that expose a ``norm_cls``/``bn_axis_name`` knob (flax
+    modules are frozen dataclasses, so conversion is a ``replace``).
+    """
+    import dataclasses
+
+    import flax.linen as nn
+
+    if hasattr(module, "norm_cls"):
+        return dataclasses.replace(module, norm_cls=SyncBatchNorm)
+    if hasattr(module, "bn_axis_name"):
+        return dataclasses.replace(module, bn_axis_name=process_group or "dp")
+    if isinstance(module, nn.BatchNorm):
+        return SyncBatchNorm(
+            use_running_average=module.use_running_average,
+            momentum=module.momentum, epsilon=module.epsilon,
+            axis_name=process_group or "dp")
+    raise TypeError(
+        "convert_syncbn_model: pass an apex_tpu model exposing `norm_cls` or "
+        "`bn_axis_name`, or build with apex_tpu.parallel.SyncBatchNorm directly.")
+
+
+def create_syncbn_process_group(group_size):
+    """Return the mesh-axis spec for group-limited sync-BN.
+
+    Parity: reference apex/parallel/__init__.py create_syncbn_process_group
+    (sync within subgroups of ``group_size`` ranks). On a mesh this is a
+    reshaped dp axis: callers split 'dp' into ('dp_outer', 'dp_bn') and
+    sync-BN over 'dp_bn' only.
+    """
+    if group_size == 0:
+        return None
+    return ("dp_bn", group_size)

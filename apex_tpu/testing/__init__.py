@@ -1,0 +1,5 @@
+from apex_tpu.testing.commons import (  # noqa: F401
+    set_random_seed,
+    shard_map,
+    tp_shard_map,
+)

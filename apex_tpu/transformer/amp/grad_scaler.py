@@ -1,0 +1,57 @@
+"""Model-parallel grad scaler.
+
+Parity: reference apex/transformer/amp/grad_scaler.py:21-125 — a GradScaler
+whose found_inf is all-reduced across the *model-parallel* group (tp x pp)
+before the optimizer step and scale update, so all model-parallel ranks
+skip (or step) together.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.transformer.parallel_state import (
+    PIPELINE_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+)
+
+
+class GradScaler(LossScaler):
+    """LossScaler whose overflow flag is maxed over the model-parallel axes
+    (reference grad_scaler.py:48-51 all_reduce(found_inf, MAX, mp_group))."""
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000, enabled=True,
+                 axis_names=(TENSOR_PARALLEL_AXIS, PIPELINE_PARALLEL_AXIS)):
+        super().__init__("dynamic", init_scale=init_scale,
+                         scale_factor=growth_factor,
+                         scale_window=growth_interval)
+        self._backoff_factor = backoff_factor
+        self.axis_names = axis_names
+        self.enabled = enabled
+
+    def all_reduce_found_inf(self, found_inf):
+        for ax in self.axis_names:
+            try:
+                found_inf = lax.pmax(found_inf, ax)
+            except Exception:
+                pass  # axis not bound (single-device / host-level call)
+        return found_inf
+
+    def unscale_grads(self, grads, state=None):
+        grads, found_inf = super().unscale_grads(grads, state)
+        return grads, self.all_reduce_found_inf(found_inf)
+
+    def update(self, state, found_inf):
+        found_inf = self.all_reduce_found_inf(found_inf)
+        overflow = found_inf > 0
+        new_scale = jnp.where(
+            overflow, state.loss_scale * self._backoff_factor,
+            jnp.where(state.unskipped + 1 >= self._scale_window,
+                      state.loss_scale * self._scale_factor, state.loss_scale))
+        new_unskipped = jnp.where(
+            overflow | (state.unskipped + 1 >= self._scale_window),
+            0, state.unskipped + 1).astype(jnp.int32)
+        from apex_tpu.amp.scaler import ScalerState
+
+        return ScalerState(new_scale, new_unskipped)

@@ -1,0 +1,5 @@
+from apex_tpu.transformer.layers.layer_norm import (  # noqa: F401
+    FastLayerNorm,
+    FusedLayerNorm,
+    MixedFusedLayerNorm,
+)

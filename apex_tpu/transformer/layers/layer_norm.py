@@ -1,0 +1,37 @@
+"""Sequence-parallel-aware LayerNorm wrappers.
+
+Parity: reference apex/transformer/layers/layer_norm.py:33-99 — subclasses
+of FusedLayerNorm / FastLayerNorm / MixedFusedLayerNorm that tag their
+params with ``sequence_parallel_enabled`` so grad sync knows to allreduce
+them across the TP group.
+
+TPU design: under shard_map the LN params of a sequence-parallel region are
+*replicated* over tp while activations are seq-sharded; their grads need a
+tp psum. The tag is a module attribute; ``allreduce_sequence_parallel_grads``
+in pipeline_parallel.utils consumes it.
+"""
+
+from typing import Any, Sequence, Union
+
+import jax.numpy as jnp
+
+from apex_tpu import normalization as _norm
+
+
+class FusedLayerNorm(_norm.FusedLayerNorm):
+    """LayerNorm carrying the sequence_parallel_enabled tag
+    (reference layer_norm.py:33-64)."""
+
+    sequence_parallel_enabled: bool = False
+
+
+class FastLayerNorm(FusedLayerNorm):
+    """Contrib FastLayerNorm alias (reference layer_norm.py:66-80): same
+    Pallas kernel; the CUDA distinction (hidden sizes <= 64k fast path)
+    does not exist on TPU."""
+
+
+class MixedFusedLayerNorm(_norm.MixedFusedLayerNorm):
+    """Mixed-dtype LayerNorm with the SP tag (reference layer_norm.py:82-99)."""
+
+    sequence_parallel_enabled: bool = False

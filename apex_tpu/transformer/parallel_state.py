@@ -1,0 +1,321 @@
+"""Model-parallel topology over a ``jax.sharding.Mesh``.
+
+Parity: reference apex/transformer/parallel_state.py:84-708 —
+``initialize_model_parallel`` builds DP / TP / PP / model / embedding /
+position-embedding / relative-position-embedding / amax process groups from
+a (tp, pp) grid, tracks virtual-pipeline ranks and the encoder-decoder
+split rank, and exposes ~40 getters.
+
+TPU design: process groups become mesh axes. The world is
+``len(devices) = pp * dp * tp`` laid out as ``Mesh(devices.reshape(pp, dp,
+tp), ("pp", "dp", "tp"))`` — tp innermost so TP collectives ride the
+fastest ICI links, matching the reference's rank-ordering convention
+(parallel_state.py:140-167: "tensor ranks contiguous"). Rank getters return
+``lax.axis_index`` when called inside ``shard_map`` (the only place a
+per-device rank exists) and process-level values otherwise. Embedding /
+amax "groups" are derivable subsets of the pp axis; helpers here expose the
+membership logic the schedules need.
+"""
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+# Mesh axis names (the TPU analog of the 8 group types).
+DATA_PARALLEL_AXIS = "dp"
+TENSOR_PARALLEL_AXIS = "tp"
+PIPELINE_PARALLEL_AXIS = "pp"
+
+_MESH: Optional[Mesh] = None
+_TENSOR_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_DATA_PARALLEL_WORLD_SIZE: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_PIPELINE_MODEL_PARALLEL_SPLIT_RANK: Optional[int] = None
+# Host-level rank overrides used by eager helpers/tests.
+_EXPLICIT_TP_RANK: Optional[int] = None
+_EXPLICIT_PP_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    *,
+    devices=None,
+    default_backend: Optional[str] = None,
+    p2p_backend: Optional[str] = None,
+) -> Mesh:
+    """Build the global mesh (reference parallel_state.py:84-331).
+
+    ``default_backend``/``p2p_backend`` are accepted for API parity (the
+    reference selects nccl/ucc; XLA picks ICI/DCN automatically).
+    Returns the mesh; also installs it globally so the getters work.
+    """
+    global _MESH, _TENSOR_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_WORLD_SIZE, _DATA_PARALLEL_WORLD_SIZE
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    world_size = devices.size
+    tp = tensor_model_parallel_size_
+    pp = pipeline_model_parallel_size_
+    if world_size % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world_size ({world_size}) is not divisible by "
+            f"tensor_model_parallel_size ({tp}) x pipeline_model_parallel_size ({pp})")
+    dp = world_size // (tp * pp)
+
+    if virtual_pipeline_model_parallel_size_ is not None:
+        if pp < 2:
+            raise RuntimeError(
+                "pipeline-model-parallel size should be greater than 2 with "
+                "interleaved schedule")
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = 0
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = (
+            virtual_pipeline_model_parallel_size_)
+    else:
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
+
+    mesh_devices = devices.reshape(pp, dp, tp)
+    _MESH = Mesh(mesh_devices, (PIPELINE_PARALLEL_AXIS, DATA_PARALLEL_AXIS,
+                                TENSOR_PARALLEL_AXIS))
+    _TENSOR_MODEL_PARALLEL_WORLD_SIZE = tp
+    _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = pp
+    _DATA_PARALLEL_WORLD_SIZE = dp
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("model parallel mesh is not initialized")
+    return _MESH
+
+
+def destroy_model_parallel():
+    """Tear down global state (reference parallel_state.py:673-704)."""
+    global _MESH, _TENSOR_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_WORLD_SIZE, _DATA_PARALLEL_WORLD_SIZE
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK, _EXPLICIT_TP_RANK, _EXPLICIT_PP_RANK
+    _MESH = None
+    _TENSOR_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _DATA_PARALLEL_WORLD_SIZE = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
+    _EXPLICIT_TP_RANK = None
+    _EXPLICIT_PP_RANK = None
+
+
+# ---------------------------------------------------------------------------
+# world sizes
+# ---------------------------------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    if _TENSOR_MODEL_PARALLEL_WORLD_SIZE is None:
+        return 1
+    return _TENSOR_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    if _PIPELINE_MODEL_PARALLEL_WORLD_SIZE is None:
+        return 1
+    return _PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_data_parallel_world_size() -> int:
+    if _DATA_PARALLEL_WORLD_SIZE is None:
+        return 1
+    return _DATA_PARALLEL_WORLD_SIZE
+
+
+def get_model_parallel_world_size() -> int:
+    return get_tensor_model_parallel_world_size() * get_pipeline_model_parallel_world_size()
+
+
+# ---------------------------------------------------------------------------
+# ranks — lax.axis_index inside shard_map, host override / 0 outside
+# ---------------------------------------------------------------------------
+
+def _axis_rank(axis_name: str, explicit: Optional[int]):
+    if explicit is not None:
+        return explicit
+    try:
+        return lax.axis_index(axis_name)
+    except Exception:
+        return 0
+
+
+def set_tensor_model_parallel_rank(rank: Optional[int]):
+    """Host-level override (used by eager tests; reference
+    parallel_state.py set_tensor_model_parallel_rank)."""
+    global _EXPLICIT_TP_RANK
+    _EXPLICIT_TP_RANK = rank
+
+
+def set_pipeline_model_parallel_rank(rank: Optional[int]):
+    global _EXPLICIT_PP_RANK
+    _EXPLICIT_PP_RANK = rank
+
+
+def set_tensor_model_parallel_world_size(size: Optional[int]):
+    global _TENSOR_MODEL_PARALLEL_WORLD_SIZE
+    _TENSOR_MODEL_PARALLEL_WORLD_SIZE = size
+
+
+def set_pipeline_model_parallel_world_size(size: Optional[int]):
+    global _PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = size
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(TENSOR_PARALLEL_AXIS, _EXPLICIT_TP_RANK)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPELINE_PARALLEL_AXIS, _EXPLICIT_PP_RANK)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_PARALLEL_AXIS, None)
+
+
+def get_tensor_model_parallel_src_rank():
+    """Rank 0 of the local TP group (reference parallel_state.py:612-620).
+    On a mesh this is simply tp-coordinate 0."""
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline-stage predicates (reference parallel_state.py:430-520)
+# ---------------------------------------------------------------------------
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        if (_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE is not None
+                and _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK != 0):
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vws = _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+        if vws is not None and _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK != vws - 1:
+            return False
+    return get_pipeline_model_parallel_rank() == (
+        get_pipeline_model_parallel_world_size() - 1)
+
+
+def is_pipeline_stage_before_split(rank=None):
+    """Encoder-decoder split support (reference parallel_state.py:469-486)."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    if _PIPELINE_MODEL_PARALLEL_SPLIT_RANK is None:
+        return True
+    return rank < _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def is_pipeline_stage_after_split(rank=None):
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    if _PIPELINE_MODEL_PARALLEL_SPLIT_RANK is None:
+        return True
+    return rank >= _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def is_pipeline_stage_at_split():
+    rank = get_pipeline_model_parallel_rank()
+    return is_pipeline_stage_before_split(rank) and is_pipeline_stage_after_split(rank + 1)
+
+
+def get_pipeline_model_parallel_split_rank():
+    return _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank):
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = rank
+
+
+# virtual pipeline (interleaved schedule) bookkeeping -----------------------
+
+def get_virtual_pipeline_model_parallel_rank():
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank):
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size():
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def set_virtual_pipeline_model_parallel_world_size(size):
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = size
+
+
+# pipeline neighbours (reference parallel_state.py:622-646) -----------------
+
+def get_pipeline_model_parallel_next_rank():
+    rank = get_pipeline_model_parallel_rank()
+    return (rank + 1) % get_pipeline_model_parallel_world_size()
+
+
+def get_pipeline_model_parallel_prev_rank():
+    rank = get_pipeline_model_parallel_rank()
+    return (rank - 1) % get_pipeline_model_parallel_world_size()
+
+
+# embedding-group membership (reference parallel_state.py:243-331) ----------
+
+def is_rank_in_embedding_group(ignore_virtual: bool = False):
+    """True on the first and last pipeline stages (tied-embedding grad sync)."""
+    return bool(is_pipeline_first_stage(ignore_virtual)) or bool(
+        is_pipeline_last_stage(ignore_virtual))
+
+
+def is_rank_in_position_embedding_group():
+    return bool(is_pipeline_first_stage(ignore_virtual=True))
+
+
+def get_rank_info():
+    """(dp, tp, pp, vpp) tuple for logging (reference apex/__init__.py:36-41)."""
+    return (
+        int(get_data_parallel_rank()) if _EXPLICIT_TP_RANK is None else 0,
+        int(_EXPLICIT_TP_RANK or 0),
+        int(_EXPLICIT_PP_RANK or 0),
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK,
+    )
+
+
+# amax-reduction groups (fp8 bookkeeping, reference parallel_state.py:204-216)
+
+def get_amax_reduction_axes():
+    """fp8 amax reductions span the full model-parallel block: tp x pp."""
+    return (TENSOR_PARALLEL_AXIS, PIPELINE_PARALLEL_AXIS)

@@ -1,0 +1,8 @@
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    get_forward_backward_func,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
+from apex_tpu.transformer.pipeline_parallel import utils  # noqa: F401

@@ -1,0 +1,86 @@
+"""Pipeline point-to-point communication.
+
+Parity: reference apex/transformer/pipeline_parallel/p2p_communication.py —
+``_communicate`` (117-~400) with batched isend/irecv, ``send_forward`` /
+``recv_forward`` / ``send_forward_recv_backward`` / ... wrappers, optional
+scatter-gather tensor compression over TP chunks, fp32-or-params dtype.
+
+TPU design: stage-to-stage transfer is ``lax.ppermute`` along the 'pp'
+mesh axis inside one jitted step — XLA lowers it to an ICI
+collective-permute, which is asynchronous and overlapped by the
+latency-hiding scheduler (the role of the reference's batch_isend_irecv +
+FutureTensor). "Scatter-gather optimization" (chunking over the TP group)
+is subsumed by giving the communicated tensor a tp-sharded layout.
+
+All helpers must be called inside ``shard_map`` with the 'pp' axis bound.
+Boundary ranks receive zeros (non-circular permutes), which schedules mask.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import (
+    PIPELINE_PARALLEL_AXIS,
+    get_pipeline_model_parallel_world_size,
+)
+
+
+def _perm_fwd(world):
+    return [(i, i + 1) for i in range(world - 1)]
+
+
+def _perm_bwd(world):
+    return [(i + 1, i) for i in range(world - 1)]
+
+
+def send_forward_recv_forward(output_tensor, axis_name=PIPELINE_PARALLEL_AXIS,
+                              world: Optional[int] = None):
+    """Shift activations one stage forward: rank r's value arrives at r+1;
+    rank 0 receives zeros. (reference recv_forward + send_forward pair)"""
+    world = world or get_pipeline_model_parallel_world_size()
+    if world == 1:
+        return jnp.zeros_like(output_tensor)
+    return lax.ppermute(output_tensor, axis_name, _perm_fwd(world))
+
+
+def send_backward_recv_backward(input_tensor_grad,
+                                axis_name=PIPELINE_PARALLEL_AXIS,
+                                world: Optional[int] = None):
+    """Shift gradients one stage backward: rank r's value arrives at r-1;
+    the last rank receives zeros."""
+    world = world or get_pipeline_model_parallel_world_size()
+    if world == 1:
+        return jnp.zeros_like(input_tensor_grad)
+    return lax.ppermute(input_tensor_grad, axis_name, _perm_bwd(world))
+
+
+# Aliases matching the reference wrapper names
+# (fwd_bwd_pipelining_without_interleaving.py:87-240). Under SPMD every
+# rank runs the same ppermute, so send and recv are one op.
+
+def recv_forward(output_tensor, **kw):
+    return send_forward_recv_forward(output_tensor, **kw)
+
+
+def send_forward(output_tensor, **kw):
+    return send_forward_recv_forward(output_tensor, **kw)
+
+
+def recv_backward(input_tensor_grad, **kw):
+    return send_backward_recv_backward(input_tensor_grad, **kw)
+
+
+def send_backward(input_tensor_grad, **kw):
+    return send_backward_recv_backward(input_tensor_grad, **kw)
+
+
+def send_forward_recv_backward(output_tensor, input_tensor_grad, **kw):
+    return (send_forward_recv_forward(output_tensor, **kw),
+            send_backward_recv_backward(input_tensor_grad, **kw))
+
+
+def send_backward_recv_forward(input_tensor_grad, output_tensor, **kw):
+    return (send_backward_recv_backward(input_tensor_grad, **kw),
+            send_forward_recv_forward(output_tensor, **kw))

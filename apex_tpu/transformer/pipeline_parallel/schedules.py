@@ -1,0 +1,347 @@
+"""Pipeline-parallel forward-backward schedules.
+
+Parity: reference apex/transformer/pipeline_parallel/schedules/ —
+``get_forward_backward_func`` (schedules/__init__.py:22-35) selecting
+(a) no-pipelining with grad sync on last microbatch
+    (fwd_bwd_no_pipelining.py:23-124),
+(b) 1F1B non-interleaved (fwd_bwd_pipelining_without_interleaving.py:241-597),
+(c) interleaved 1F1B with virtual chunks
+    (fwd_bwd_pipelining_with_interleaving.py).
+
+TPU design: the reference schedules are eager Python loops over blocking
+NCCL p2p calls. Here each schedule is ONE jitted SPMD program: a
+``lax.fori_loop`` over schedule ticks with ``lax.ppermute`` moving
+activations/grads along the 'pp' mesh axis. Activation memory is bounded
+by stashing only each microbatch's *stage input* and rematerializing the
+forward in the backward tick (``jax.vjp`` over the stage fn) — the
+TPU-idiomatic replacement for 1F1B's early-backward memory bound, with the
+same pipeline bubble (M + P - 1 ticks per phase).
+
+Stage-fn contract (replaces the reference's forward_step_func protocol,
+common.py:253-324):
+
+    forward_step_func(params, input_tensor, microbatch, is_first_stage)
+        -> output_tensor
+    loss_func(params, output_tensor, microbatch) -> scalar loss
+
+``input_tensor`` is None under the no-pipelining schedule (one stage owns
+the whole model — build the input from the microbatch unconditionally).
+
+Every pp rank holds ``params`` with the same pytree structure (its own
+stage's weights). ``is_first_stage`` is a traced bool that is True only on
+the *global* first stage (chunk 0 of rank 0 under virtual pipelining) —
+the stage fn builds its input from the microbatch there (embedding) via
+``jnp.where(is_first_stage, embed(mb), input_tensor)``. ``loss_func`` is
+evaluated on the last stage only (masked by the schedule).
+"""
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import (
+    PIPELINE_PARALLEL_AXIS,
+    get_pipeline_model_parallel_world_size,
+    get_virtual_pipeline_model_parallel_world_size,
+)
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    send_backward_recv_backward,
+    send_forward_recv_forward,
+)
+
+
+def listify_model(model):
+    if isinstance(model, list):
+        return model
+    return [model]
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
+                              pipeline_model_parallel_size=None):
+    """Select a schedule (reference schedules/__init__.py:22-35)."""
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = get_pipeline_model_parallel_world_size()
+    if virtual_pipeline_model_parallel_size is None:
+        virtual_pipeline_model_parallel_size = (
+            get_virtual_pipeline_model_parallel_world_size())
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+def forward_backward_no_pipelining(forward_step_func, loss_func, params,
+                                   microbatches, *, num_microbatches,
+                                   grad_scale=1.0, **unused):
+    """Accumulate grads over microbatches without pipelining
+    (reference fwd_bwd_no_pipelining.py:23-124; grad sync deferral to the
+    last microbatch is automatic — sync happens once on the returned
+    accumulated grads)."""
+
+    def one_microbatch(params, mb):
+        def full(p):
+            y = forward_step_func(p, None, mb, jnp.asarray(True))
+            return loss_func(p, y, mb)
+
+        loss, grads = jax.value_and_grad(full)(params)
+        return loss, grads
+
+    def scan_body(carry, mb):
+        loss_sum, grads_acc = carry
+        loss, grads = one_microbatch(params, mb)
+        grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+        return (loss_sum + loss, grads_acc), loss
+
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (loss_sum, grads), losses = lax.scan(
+        scan_body, (jnp.zeros((), jnp.float32), zero_grads), microbatches)
+    n = jnp.asarray(num_microbatches, jnp.float32)
+    grads = jax.tree_util.tree_map(lambda g: g * (grad_scale / n), grads)
+    return losses, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+        forward_step_func: Callable, loss_func: Callable, params,
+        microbatches, *, num_microbatches: int,
+        tensor_shape, dtype=jnp.float32,
+        axis_name: str = PIPELINE_PARALLEL_AXIS,
+        grad_scale: float = 1.0,
+        pp_size: Optional[int] = None,
+        **unused):
+    """Pipelined forward-backward over the 'pp' axis (one jitted program).
+
+    Parity target: fwd_bwd_pipelining_without_interleaving.py:241-597.
+    Returns (per-microbatch losses [M] — nonzero on the last stage only,
+    grads pytree scaled by grad_scale / num_microbatches).
+
+    Must run inside shard_map with the 'pp' axis bound; ``tensor_shape`` is
+    the (seq, microbatch, hidden) activation shape crossing stage
+    boundaries (reference get_tensor_shapes, ...without_interleaving.py:29-86).
+    """
+    P = pp_size or get_pipeline_model_parallel_world_size()
+    M = num_microbatches
+    rank = lax.axis_index(axis_name)
+    is_first = rank == 0
+    is_last = rank == P - 1
+
+    def take_mb(i):
+        return jax.tree_util.tree_map(lambda a: a[i], microbatches)
+
+    def stage_and_loss(p, h, mb):
+        y = forward_step_func(p, h, mb, is_first)
+        loss = loss_func(p, y, mb)
+        return y, loss
+
+    zero_h = jnp.zeros(tensor_shape, dtype)
+    ticks = M + P - 1
+
+    # ---------------- forward phase ----------------
+    def fwd_tick(t, carry):
+        xs, y_prev, losses = carry
+        recv = send_forward_recv_forward(y_prev, axis_name, world=P)
+        mb_idx = t - rank
+        active = (mb_idx >= 0) & (mb_idx < M)
+        mb_safe = jnp.clip(mb_idx, 0, M - 1)
+        mb = take_mb(mb_safe)
+        h_in = jnp.where(is_first, zero_h, recv).astype(dtype)
+        y, loss = stage_and_loss(params, h_in, mb)
+        # stash the stage input for rematerialized backward
+        xs = lax.dynamic_update_index_in_dim(
+            xs, jnp.where(active, h_in, xs[mb_safe]), mb_safe, 0)
+        losses = losses.at[mb_safe].add(
+            jnp.where(active & is_last, loss, 0.0))
+        y_prev = jnp.where(active, y, jnp.zeros_like(y))
+        return xs, y_prev, losses
+
+    xs0 = jnp.zeros((M,) + tuple(tensor_shape), dtype)
+    losses0 = jnp.zeros((M,), jnp.float32)
+    xs, _, losses = lax.fori_loop(
+        0, ticks, fwd_tick, (xs0, zero_h, losses0))
+
+    # ---------------- backward phase ----------------
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+    def bwd_tick(t, carry):
+        grads_acc, dx_prev = carry
+        dy_recv = send_backward_recv_backward(dx_prev, axis_name, world=P)
+        mb_idx = (M - 1) - (t - (P - 1 - rank))
+        active = (mb_idx >= 0) & (mb_idx < M)
+        mb_safe = jnp.clip(mb_idx, 0, M - 1)
+        mb = take_mb(mb_safe)
+        h_in = xs[mb_safe]
+        _, pullback = jax.vjp(
+            lambda p, h: stage_and_loss(p, h, mb), params, h_in)
+        dy_cot = jnp.where(active & ~is_last, dy_recv,
+                           jnp.zeros_like(dy_recv)).astype(dtype)
+        loss_cot = jnp.where(active & is_last,
+                             jnp.asarray(grad_scale, jnp.float32), 0.0)
+        dparams, dh = pullback((dy_cot, loss_cot))
+        grads_acc = jax.tree_util.tree_map(
+            lambda a, d: a + jnp.where(active, d.astype(jnp.float32), 0.0),
+            grads_acc, dparams)
+        dx_prev = jnp.where(active, dh, jnp.zeros_like(dh)).astype(dtype)
+        return grads_acc, dx_prev
+
+    grads, _ = lax.fori_loop(0, ticks, bwd_tick, (zero_grads, zero_h))
+    n = jnp.asarray(M, jnp.float32)
+    grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+    return losses, grads
+
+
+def forward_backward_pipelining_with_interleaving(
+        forward_step_func: Callable, loss_func: Callable, params,
+        microbatches, *, num_microbatches: int, tensor_shape,
+        dtype=jnp.float32, axis_name: str = PIPELINE_PARALLEL_AXIS,
+        grad_scale: float = 1.0, pp_size: Optional[int] = None,
+        num_model_chunks: Optional[int] = None, **unused):
+    """Interleaved (virtual-pipeline) schedule.
+
+    Parity target: fwd_bwd_pipelining_with_interleaving.py (516 LoC).
+    ``params`` is a pytree whose leaves carry a leading ``num_model_chunks``
+    dim (stacked virtual chunks per rank); the model ring is traversed
+    ``num_model_chunks`` times: chunk c on rank r is global stage
+    c * P + r. Implemented as V sequential pipeline passes over the ring:
+    chunk c's rank-(P-1) outputs are stored per microbatch and handed to
+    chunk c+1's rank 0 with a single-edge ppermute; the backward walks the
+    chunks in reverse, handing input-grads from rank 0 back to rank P-1.
+    Each pass pipelines its M microbatches exactly like the
+    non-interleaved schedule.
+    """
+    P = pp_size or get_pipeline_model_parallel_world_size()
+    V = num_model_chunks or get_virtual_pipeline_model_parallel_world_size() or 1
+    if V == 1:
+        return forward_backward_pipelining_without_interleaving(
+            forward_step_func, loss_func, params, microbatches,
+            num_microbatches=num_microbatches, tensor_shape=tensor_shape,
+            dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
+            pp_size=P)
+    M = num_microbatches
+    S = V * P  # global stages
+    rank = lax.axis_index(axis_name)
+
+    def take_mb(i):
+        return jax.tree_util.tree_map(lambda a: a[i], microbatches)
+
+    def chunk_params(c):
+        return jax.tree_util.tree_map(lambda a: a[c], params)
+
+    zero_h = jnp.zeros(tensor_shape, dtype)
+    ticks = M + P - 1
+    losses_total = jnp.zeros((M,), jnp.float32)
+    # per-chunk stashed stage inputs for rematerialized backward
+    xs_all = jnp.zeros((V, M) + tuple(tensor_shape), dtype)
+    # chunk-boundary activations: outputs of rank P-1, inputs for next chunk
+    boundary = jnp.zeros((M,) + tuple(tensor_shape), dtype)
+
+    # ---------------- forward: V sequential ring passes ----------------
+    for c in range(V):
+        p_c = chunk_params(c)
+        is_first = (rank == 0) & (c == 0)
+        is_last = (rank == P - 1) & (c == V - 1)
+
+        def stage_and_loss(p, h, mb, is_first=is_first, is_last=is_last):
+            y = forward_step_func(p, h, mb, is_first)
+            loss = jnp.where(is_last, loss_func(p, y, mb), 0.0)
+            return y, loss
+
+        def fwd_tick(t, carry, c=c, p_c=p_c, is_first=is_first,
+                     stage_and_loss=stage_and_loss):
+            xs, y_prev, losses, new_boundary = carry
+            recv = send_forward_recv_forward(y_prev, axis_name, world=P)
+            # hand chunk c-1's stored boundary from rank P-1 to rank 0
+            if c > 0:
+                mb_t = jnp.clip(t, 0, M - 1)
+                handoff = lax.ppermute(boundary[mb_t], axis_name, [(P - 1, 0)])
+                first_in = handoff
+            else:
+                first_in = zero_h
+            mb_idx = t - rank
+            active = (mb_idx >= 0) & (mb_idx < M)
+            mb_safe = jnp.clip(mb_idx, 0, M - 1)
+            mb = take_mb(mb_safe)
+            h_in = jnp.where(rank == 0, first_in, recv).astype(dtype)
+            y, loss = stage_and_loss(p_c, h_in, mb)
+            xs = lax.dynamic_update_index_in_dim(
+                xs, jnp.where(active, h_in, xs[mb_safe]), mb_safe, 0)
+            losses = losses.at[mb_safe].add(jnp.where(active, loss, 0.0))
+            new_boundary = lax.dynamic_update_index_in_dim(
+                new_boundary,
+                jnp.where(active & (rank == P - 1), y, new_boundary[mb_safe]),
+                mb_safe, 0)
+            y_prev = jnp.where(active, y, jnp.zeros_like(y))
+            return xs, y_prev, losses, new_boundary
+
+        xs0 = jnp.zeros((M,) + tuple(tensor_shape), dtype)
+        xs_c, _, losses_total, boundary = lax.fori_loop(
+            0, ticks, fwd_tick,
+            (xs0, zero_h, losses_total, jnp.zeros_like(boundary)))
+        xs_all = xs_all.at[c].set(xs_c)
+
+    # ---------------- backward: V reverse ring passes ----------------
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    grads = zero_grads
+    # grads of chunk c's first-stage input (on rank 0), cotangent for
+    # chunk c-1's boundary outputs (needed on rank P-1)
+    dboundary = jnp.zeros((M,) + tuple(tensor_shape), dtype)
+
+    for c in reversed(range(V)):
+        p_c = chunk_params(c)
+        is_last = (rank == P - 1) & (c == V - 1)
+
+        is_first_c = (rank == 0) & (c == 0)
+
+        def stage_and_loss(p, h, mb, is_first=is_first_c, is_last=is_last):
+            y = forward_step_func(p, h, mb, is_first)
+            loss = jnp.where(is_last, loss_func(p, y, mb), 0.0)
+            return y, loss
+
+        def bwd_tick(t, carry, c=c, p_c=p_c, is_last=is_last,
+                     stage_and_loss=stage_and_loss):
+            grads_acc, dx_prev, new_dboundary = carry
+            dy_recv = send_backward_recv_backward(dx_prev, axis_name, world=P)
+            if c < V - 1:
+                # cotangent for this chunk's rank-(P-1) outputs, stored on
+                # rank 0 during chunk c+1's pass
+                mb_t = jnp.clip(M - 1 - t, 0, M - 1)
+                handoff = lax.ppermute(dboundary[mb_t], axis_name, [(0, P - 1)])
+                last_dy = handoff
+            else:
+                last_dy = jnp.zeros_like(zero_h)
+            mb_idx = (M - 1) - (t - (P - 1 - rank))
+            active = (mb_idx >= 0) & (mb_idx < M)
+            mb_safe = jnp.clip(mb_idx, 0, M - 1)
+            mb = take_mb(mb_safe)
+            h_in = xs_all[c, mb_safe]
+            _, pullback = jax.vjp(
+                lambda p, h: stage_and_loss(p, h, mb), p_c, h_in)
+            dy_cot = jnp.where(rank == P - 1, last_dy, dy_recv)
+            dy_cot = jnp.where(active & ~is_last, dy_cot,
+                               jnp.zeros_like(dy_cot)).astype(dtype)
+            loss_cot = jnp.where(active & is_last,
+                                 jnp.asarray(grad_scale, jnp.float32), 0.0)
+            dparams, dh = pullback((dy_cot, loss_cot))
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, d: a.at[c].add(
+                    jnp.where(active, d.astype(jnp.float32), 0.0)),
+                grads_acc, dparams)
+            new_dboundary = lax.dynamic_update_index_in_dim(
+                new_dboundary,
+                jnp.where(active & (rank == 0), dh.astype(dtype),
+                          new_dboundary[mb_safe]),
+                mb_safe, 0)
+            dx_prev = jnp.where(active, dh, jnp.zeros_like(dh)).astype(dtype)
+            return grads_acc, dx_prev, new_dboundary
+
+        grads, _, dboundary = lax.fori_loop(
+            0, ticks, bwd_tick, (grads, zero_h, jnp.zeros_like(dboundary)))
+
+    n = jnp.asarray(M, jnp.float32)
+    grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+    return losses_total, grads

@@ -1,0 +1,246 @@
+"""Pipeline-parallel utilities.
+
+Parity: reference apex/transformer/pipeline_parallel/utils.py (357 LoC):
+microbatch slicing, ``listify_model``/``unwrap_model``, params-l2-norm
+across model-parallel ranks, ``average_losses_across_data_parallel_group``,
+``report_memory``, ``print_rank_0``/``print_rank_last``,
+``get_ltor_masks_and_position_ids``, microbatch-calculator globals, timers.
+"""
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.parallel_state import (
+    DATA_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+    get_pipeline_model_parallel_rank,
+    get_pipeline_model_parallel_world_size,
+    get_tensor_model_parallel_rank,
+)
+from apex_tpu.transformer.pipeline_parallel._timers import _Timers
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TIMERS = None
+_GLOBAL_AUTORESUME = None
+
+
+def setup_microbatch_calculator(rank, rampup_batch_size, global_batch_size,
+                                micro_batch_size, data_parallel_size):
+    """Reference pipeline_parallel/utils.py:58-77."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _ensure_var_is_not_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def _reconfigure_microbatch_calculator(rank, rampup_batch_size,
+                                       global_batch_size, micro_batch_size,
+                                       data_parallel_size):
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def _ensure_var_is_initialized(var, name):
+    assert var is not None, "{} is not initialized.".format(name)
+
+
+def _ensure_var_is_not_initialized(var, name):
+    assert var is None, "{} is already initialized.".format(name)
+
+
+def get_micro_batch_size():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def get_num_microbatches():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True):
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples, consistency_check)
+
+
+def get_timers():
+    """Reference pipeline_parallel/utils.py:146-157."""
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = _Timers()
+    return _GLOBAL_TIMERS
+
+
+def get_autoresume():
+    """ADLR autoresume hook (reference utils.py:142-144) — None unless an
+    external autoresume module is installed."""
+    return _GLOBAL_AUTORESUME
+
+
+def listify_model(model):
+    if isinstance(model, list):
+        return model
+    return [model]
+
+
+def unwrap_model(model, module_instances=None):
+    """Reference utils.py:185-198; JAX models are pure pytrees/callables,
+    wrappers expose ``.module``."""
+    return_list = True
+    if not isinstance(model, list):
+        model = [model]
+        return_list = False
+    unwrapped = []
+    for m in model:
+        while hasattr(m, "module"):
+            m = m.module
+        unwrapped.append(m)
+    if not return_list:
+        return unwrapped[0]
+    return unwrapped
+
+
+def get_kth_microbatch(batch, k):
+    """Slice microbatch k out of a global batch pytree
+    (reference utils.py:122-137)."""
+    if batch is None:
+        return None
+    micro = get_micro_batch_size()
+    return jax.tree_util.tree_map(
+        lambda x: lax.dynamic_slice_in_dim(x, k * micro, micro, axis=0), batch)
+
+
+def split_into_microbatches(batch, num_microbatches):
+    """Reshape a global batch [G, ...] into [M, G/M, ...] for lax.scan-style
+    schedules (TPU-native companion to get_kth_microbatch)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((num_microbatches, x.shape[0] // num_microbatches)
+                            + x.shape[1:]), batch)
+
+
+def average_losses_across_data_parallel_group(losses,
+                                              axis_name=DATA_PARALLEL_AXIS):
+    """Reference utils.py:242-250."""
+    averaged = jnp.stack([l.astype(jnp.float32) for l in losses])
+    try:
+        averaged = lax.pmean(averaged, axis_name)
+    except Exception:
+        pass
+    return averaged
+
+
+def calc_params_l2_norm(params, tp_duplicate_mask=None,
+                        axis_names=(TENSOR_PARALLEL_AXIS,)):
+    """Global param l2 norm excluding TP duplicates
+    (reference utils.py:213-241).
+
+    ``tp_duplicate_mask``: pytree of bools, True where a param is replicated
+    over tp (counted on tp-rank 0 only).
+    """
+    leaves = jax.tree_util.tree_leaves(params)
+    masks = (jax.tree_util.tree_leaves(tp_duplicate_mask)
+             if tp_duplicate_mask is not None else [False] * len(leaves))
+    try:
+        tp_rank = lax.axis_index(TENSOR_PARALLEL_AXIS)
+    except Exception:
+        tp_rank = 0
+    sq = jnp.zeros((), jnp.float32)
+    for p, dup in zip(leaves, masks):
+        s = jnp.sum(jnp.square(p.astype(jnp.float32)))
+        if dup:
+            s = jnp.where(tp_rank == 0, s, 0.0)
+        sq = sq + s
+    for ax in axis_names:
+        try:
+            sq = lax.psum(sq, ax)
+        except Exception:
+            pass
+    return jnp.sqrt(sq)
+
+
+def report_memory(name):
+    """Device memory report (reference utils.py:253-263; NVML -> jax
+    memory_stats)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        string = name + " memory (MB)"
+        string += " | allocated: {:.1f}".format(
+            stats.get("bytes_in_use", 0) / 1024 / 1024)
+        string += " | peak: {:.1f}".format(
+            stats.get("peak_bytes_in_use", 0) / 1024 / 1024)
+        string += " | limit: {:.1f}".format(
+            stats.get("bytes_limit", 0) / 1024 / 1024)
+        print(string, flush=True)
+    except Exception:
+        pass
+
+
+def print_rank_0(message):
+    """Reference utils.py:159-166."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def is_last_rank():
+    return jax.process_index() == jax.process_count() - 1
+
+
+def print_rank_last(message):
+    if is_last_rank():
+        print(message, flush=True)
+
+
+def param_is_not_shared(attrs) -> bool:
+    return not (attrs or {}).get("shared", False)
+
+
+def get_ltor_masks_and_position_ids(data, eod_token=None,
+                                    reset_position_ids=False,
+                                    reset_attention_mask=False,
+                                    eod_mask_loss=False):
+    """Left-to-right masks and position ids (reference utils.py:303-357).
+
+    The per-document reset variants require data-dependent segment ids; on
+    TPU these are expressed with segment-id comparisons instead of mask
+    mutation loops.
+    """
+    micro_batch_size, seq_length = data.shape
+    att_mask = jnp.tril(jnp.ones((seq_length, seq_length), bool))
+    loss_mask = jnp.ones(data.shape, jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+    position_ids = jnp.broadcast_to(
+        jnp.arange(seq_length, dtype=jnp.int32)[None, :], data.shape)
+    if reset_position_ids or reset_attention_mask:
+        assert eod_token is not None
+        # segment id = number of EODs strictly before each position
+        eod = (data == eod_token).astype(jnp.int32)
+        seg = jnp.cumsum(eod, axis=1) - eod
+        if reset_attention_mask:
+            same_seg = seg[:, :, None] == seg[:, None, :]
+            att_mask = att_mask[None, :, :] & same_seg
+            att_mask = att_mask[:, None, :, :]  # [b, 1, s, s]
+        if reset_position_ids:
+            seg_start = jnp.concatenate(
+                [jnp.zeros((micro_batch_size, 1), jnp.int32),
+                 jnp.where(eod[:, :-1] == 1,
+                           jnp.arange(1, seq_length)[None, :], 0)], axis=1)
+            seg_start = jax.lax.associative_scan(jnp.maximum, seg_start, axis=1)
+            position_ids = jnp.arange(seq_length)[None, :] - seg_start
+    else:
+        att_mask = jnp.broadcast_to(att_mask[None, None, :, :],
+                                    (micro_batch_size, 1, seq_length, seq_length))
+    # Reference returns attention_mask with True where masked OUT.
+    attention_mask = ~att_mask
+    return attention_mask, loss_mask, position_ids

@@ -1,0 +1,35 @@
+"""TP data broadcast.
+
+Parity: reference apex/transformer/tensor_parallel/data.py:80-122
+``broadcast_data`` — broadcast a keyed dict of tensors from tp-rank-0
+(sizes first, then one flattened payload).
+
+TPU design: under SPMD the host feeds identical data to every device in a
+tp group by construction (inputs are replicated over the tp mesh axis), so
+broadcast is an assert-and-cast. Inside shard_map an explicit collective
+variant is provided for parity with rank-divergent callers.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+
+
+def broadcast_data(keys, data, datatype, axis_name=TENSOR_PARALLEL_AXIS):
+    """Broadcast ``{key: array}`` from tp-rank 0 to the tp group.
+
+    Inside shard_map this psums the rank-0 copy (a true broadcast); outside
+    it casts and returns (data is already replicated by the host feed).
+    """
+    out = {}
+    for k in keys:
+        v = jnp.asarray(data[k], datatype)
+        try:
+            rank = lax.axis_index(axis_name)
+            masked = jnp.where(rank == 0, v, jnp.zeros_like(v))
+            v = lax.psum(masked, axis_name)
+        except Exception:
+            pass
+        out[k] = v
+    return out

@@ -1,0 +1,117 @@
+"""Forward/backward-paired TP collectives.
+
+Parity: reference apex/transformer/tensor_parallel/mappings.py:31-312 —
+``_CopyToModelParallelRegion`` (identity fwd / allreduce bwd),
+``_ReduceFromModelParallelRegion`` (allreduce fwd / identity bwd),
+``_ScatterToModelParallelRegion`` / ``_GatherFromModelParallelRegion``
+(last-dim split/gather) and the sequence-parallel first-dim variants
+(213-268).
+
+TPU design: each region op is a ``jax.custom_vjp`` over ``lax`` collectives
+bound to the 'tp' mesh axis inside ``shard_map``. XLA lowers these to ICI
+all-reduce / all-gather / reduce-scatter.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+
+
+# -- raw helpers (reference mappings.py:31-138) -----------------------------
+
+def _reduce(x, axis_name=TENSOR_PARALLEL_AXIS):
+    return lax.psum(x, axis_name)
+
+
+def _split(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    rank = lax.axis_index(axis_name)
+    shard = x.shape[dim] // size
+    return lax.dynamic_slice_in_dim(x, rank * shard, shard, axis=dim)
+
+
+def _gather(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def _region_op(fwd_fn, bwd_fn):
+    """Build a custom-vjp op from forward/backward transforms."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def op(x, axis_name=TENSOR_PARALLEL_AXIS):
+        return fwd_fn(x, axis_name)
+
+    def op_fwd(x, axis_name):
+        return fwd_fn(x, axis_name), None
+
+    def op_bwd(axis_name, _, g):
+        return (bwd_fn(g, axis_name),)
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+# -- region ops (reference mappings.py:141-268) -----------------------------
+
+# identity fwd / allreduce bwd (mappings.py:141 _CopyToModelParallelRegion)
+copy_to_tensor_model_parallel_region = _region_op(
+    lambda x, ax: x, lambda g, ax: _reduce(g, ax))
+
+# allreduce fwd / identity bwd (mappings.py:159 _ReduceFromModelParallelRegion)
+reduce_from_tensor_model_parallel_region = _region_op(
+    lambda x, ax: _reduce(x, ax), lambda g, ax: g)
+
+# split last dim fwd / gather bwd (mappings.py:177 _ScatterToModelParallelRegion)
+scatter_to_tensor_model_parallel_region = _region_op(
+    lambda x, ax: _split(x, -1, ax), lambda g, ax: _gather(g, -1, ax))
+
+# gather last dim fwd / split bwd (mappings.py:195 _GatherFromModelParallelRegion)
+gather_from_tensor_model_parallel_region = _region_op(
+    lambda x, ax: _gather(x, -1, ax), lambda g, ax: _split(g, -1, ax))
+
+# SP: split first dim fwd / gather bwd (mappings.py:213 _ScatterToSequenceParallelRegion)
+scatter_to_sequence_parallel_region = _region_op(
+    lambda x, ax: _split(x, 0, ax), lambda g, ax: _gather(g, 0, ax))
+
+# SP: reduce-scatter first dim fwd / gather bwd
+# (mappings.py:253 _ReduceScatterToSequenceParallelRegion)
+reduce_scatter_to_sequence_parallel_region = _region_op(
+    lambda x, ax: _reduce_scatter(x, 0, ax), lambda g, ax: _gather(g, 0, ax))
+
+
+# SP gather needs the tensor_parallel_output_grad switch
+# (mappings.py:231 _GatherFromSequenceParallelRegion).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, tensor_parallel_output_grad=True,
+                                         axis_name=TENSOR_PARALLEL_AXIS):
+    return _gather(x, 0, axis_name)
+
+
+def _gfspr_fwd(x, tensor_parallel_output_grad, axis_name):
+    return _gather(x, 0, axis_name), None
+
+
+def _gfspr_bwd(tensor_parallel_output_grad, axis_name, _, g):
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter(g, 0, axis_name),)
+    return (_split(g, 0, axis_name),)
+
+
+gather_from_sequence_parallel_region.defvjp(_gfspr_fwd, _gfspr_bwd)

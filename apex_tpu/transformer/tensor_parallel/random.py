@@ -1,0 +1,104 @@
+"""TP RNG discipline + activation checkpointing.
+
+Parity: reference apex/transformer/tensor_parallel/random.py —
+``CudaRNGStatesTracker`` (124-196), ``model_parallel_cuda_manual_seed``
+(204: tp seed = seed + 2718 + tp_rank), ``checkpoint`` with RNG restore
+(237-311).
+
+TPU design: JAX RNG is functional, so "states" are keys. The tracker maps
+names to keys; ``fork`` yields a fresh per-use key split from the named
+stream — the same duplicated-vs-partitioned discipline without mutable
+device state. Activation checkpointing is ``jax.checkpoint``
+(rematerialization), which replays RNG correctly by construction — the
+manual state save/restore of the reference is unnecessary.
+"""
+
+import contextlib
+
+import jax
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+def model_parallel_rng_tracker_name():
+    return _MODEL_PARALLEL_RNG_TRACKER_NAME
+
+
+class RNGStatesTracker:
+    """Named RNG streams (reference CudaRNGStatesTracker, random.py:124-196)."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed_or_key):
+        if name in self.states_:
+            raise Exception("RNG state {} already exists".format(name))
+        if isinstance(seed_or_key, int):
+            key = jax.random.PRNGKey(seed_or_key)
+        else:
+            key = seed_or_key
+        self.states_[name] = key
+
+    @contextlib.contextmanager
+    def fork(self, name=_MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield a fresh key from the named stream and advance it."""
+        if name not in self.states_:
+            raise Exception("RNG state {} is not added".format(name))
+        key, next_key = jax.random.split(self.states_[name])
+        self.states_[name] = next_key
+        yield key
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_xla_manual_seed(seed: int):
+    """Seed the duplicated and tp-partitioned streams.
+
+    Parity: reference random.py:204 — default stream gets ``seed``;
+    the model-parallel stream gets ``seed + 2718 + tp_rank``. The rank is
+    folded in at *use* time (inside shard_map) via ``fold_in`` so one host
+    call serves all devices.
+    """
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("default", seed)
+    _RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, seed + 2718)
+
+
+# Name kept for drop-in parity.
+model_parallel_cuda_manual_seed = model_parallel_xla_manual_seed
+
+
+def fold_in_tp_rank(key, axis_name=TENSOR_PARALLEL_AXIS):
+    """Per-device partitioned key: fold the tp rank into a base key."""
+    try:
+        rank = jax.lax.axis_index(axis_name)
+    except Exception:
+        rank = 0
+    return jax.random.fold_in(key, rank)
+
+
+def checkpoint(function, distribute_saved_activations=False, *args, **kwargs):
+    """Activation checkpointing (recompute).
+
+    Parity: reference random.py:237-311 ``CheckpointFunction``. Maps to
+    ``jax.checkpoint``; ``distribute_saved_activations`` (sharding the
+    stashed input across TP ranks) is subsumed by XLA's SPMD partitioner —
+    saved residuals inside shard_map are already per-device shards.
+    """
+    return jax.checkpoint(function)(*args, **kwargs)

@@ -1,0 +1,191 @@
+"""amp: opt levels, loss scaling, overflow skip, checkpoint round-trip.
+
+Mirrors reference tests/L0/run_amp (test_basic_casts.py, test_checkpointing.py,
+test_multiple_models_optimizers_losses.py patterns).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import LossScaler
+from apex_tpu.amp._amp_state import _amp_state
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+@pytest.fixture(autouse=True)
+def _reset_amp():
+    yield
+    _amp_state.reset()
+
+
+def make_params(rng):
+    return {"dense": {"kernel": jnp.asarray(rng.randn(4, 4).astype(np.float32))},
+            "bn": {"scale": jnp.ones((4,), jnp.float32)}}
+
+
+class TestOptLevels:
+    def test_o0_keeps_fp32(self, rng):
+        params, opt = amp.initialize(make_params(rng), FusedAdam(lr=1e-3),
+                                     opt_level="O0", verbosity=0)
+        for l in jax.tree_util.tree_leaves(params):
+            assert l.dtype == jnp.float32
+
+    def test_o1_keeps_params_fp32(self, rng):
+        params, opt = amp.initialize(make_params(rng), FusedAdam(lr=1e-3),
+                                     opt_level="O1", verbosity=0)
+        for l in jax.tree_util.tree_leaves(params):
+            assert l.dtype == jnp.float32
+        assert _amp_state.opt_properties.patch_torch_functions
+
+    def test_o2_casts_but_keeps_bn(self, rng):
+        params, opt = amp.initialize(make_params(rng), FusedAdam(lr=1e-3),
+                                     opt_level="O2", verbosity=0)
+        assert params["dense"]["kernel"].dtype == jnp.bfloat16
+        assert params["bn"]["scale"].dtype == jnp.float32
+        assert opt.master_weights
+
+    def test_o3_casts_everything(self, rng):
+        params, opt = amp.initialize(make_params(rng), FusedAdam(lr=1e-3),
+                                     opt_level="O3", verbosity=0)
+        assert params["dense"]["kernel"].dtype == jnp.bfloat16
+        assert params["bn"]["scale"].dtype == jnp.bfloat16
+
+    def test_bad_opt_level(self, rng):
+        with pytest.raises(RuntimeError):
+            amp.initialize(make_params(rng), None, opt_level="O4")
+
+
+class TestLossScaler:
+    def test_static_scale(self):
+        s = LossScaler(128.0)
+        assert not s.dynamic
+        loss = jnp.asarray(2.0)
+        assert float(s.scale(loss)) == 256.0
+
+    def test_dynamic_halves_on_overflow(self):
+        s = LossScaler("dynamic")
+        state = s.init_state()
+        assert float(state.loss_scale) == 2.0 ** 16
+        state = s.update(state, jnp.ones((), jnp.float32))
+        assert float(state.loss_scale) == 2.0 ** 15
+
+    def test_dynamic_doubles_after_window(self):
+        s = LossScaler("dynamic", init_scale=4.0, scale_window=3)
+        state = s.init_state()
+        for _ in range(3):
+            state = s.update(state, jnp.zeros((), jnp.float32))
+        assert float(state.loss_scale) == 8.0
+
+    def test_unscale_detects_inf(self, rng):
+        s = LossScaler("dynamic")
+        grads = {"a": jnp.asarray([1.0, jnp.inf])}
+        _, found = s.unscale_grads(grads, s.init_state())
+        assert float(found) == 1.0
+
+    def test_state_dict_roundtrip(self):
+        s = LossScaler("dynamic")
+        state = s.init_state()
+        s._state = s.update(state, jnp.ones((), jnp.float32))
+        sd = s.state_dict()
+        s2 = LossScaler("dynamic")
+        s2.load_state_dict(sd)
+        assert float(s2._state.loss_scale) == float(s._state.loss_scale)
+
+
+class TestAmpOptimizerStep:
+    def test_o2_training_converges(self, rng):
+        """O2 end-to-end: bf16 params + fp32 masters converge on a
+        quadratic."""
+        params = {"w": jnp.asarray(rng.randn(8).astype(np.float32))}
+        target = jnp.asarray(rng.randn(8).astype(np.float32))
+        params, opt = amp.initialize(params, FusedSGD(lr=0.1),
+                                     opt_level="O2", verbosity=0)
+        state = opt.init(params)
+
+        def loss_fn(p):
+            return jnp.sum((p["w"].astype(jnp.float32) - target) ** 2)
+
+        losses = []
+        for _ in range(50):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            scaled_grads = jax.tree_util.tree_map(
+                lambda g: g * float(state["scaler"].loss_scale), grads)
+            params, state = opt.step(scaled_grads, state, params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_overflow_skips_and_backs_off(self, rng):
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        params, opt = amp.initialize(params, FusedAdam(lr=1.0),
+                                     opt_level="O2", loss_scale="dynamic",
+                                     verbosity=0)
+        state = opt.init(params)
+        scale0 = float(state["scaler"].loss_scale)
+        bad_grads = {"w": jnp.full((4,), jnp.inf)}
+        new_params, state = opt.step(bad_grads, state, params)
+        np.testing.assert_array_equal(
+            np.asarray(new_params["w"], dtype=np.float32),
+            np.asarray(params["w"], dtype=np.float32))
+        assert float(state["scaler"].loss_scale) == scale0 / 2
+
+    def test_scale_loss_context(self, rng):
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        params, opt = amp.initialize(params, FusedAdam(lr=1e-3),
+                                     opt_level="O2", loss_scale=8.0,
+                                     verbosity=0)
+        loss = jnp.asarray(3.0)
+        with amp.scale_loss(loss, opt) as scaled:
+            assert float(scaled) == 24.0
+
+
+class TestStateDict:
+    def test_amp_state_roundtrip(self, rng):
+        params, opt = amp.initialize(make_params(rng), FusedAdam(lr=1e-3),
+                                     opt_level="O2", num_losses=2,
+                                     verbosity=0)
+        sd = amp.state_dict()
+        assert "loss_scaler0" in sd and "loss_scaler1" in sd
+        amp.load_state_dict(sd)
+
+
+class TestAutocastPolicy:
+    def test_half_function_casts(self):
+        @amp.half_function
+        def f(x):
+            return x
+
+        x = jnp.ones((2,), jnp.float32)
+        with amp.autocast():
+            assert f(x).dtype == jnp.bfloat16
+        assert f(x).dtype == jnp.float32
+
+    def test_float_function(self):
+        @amp.float_function
+        def f(x):
+            return x
+
+        x = jnp.ones((2,), jnp.bfloat16)
+        with amp.autocast():
+            assert f(x).dtype == jnp.float32
+
+    def test_promote_function(self):
+        @amp.promote_function
+        def f(x, y):
+            return x + y
+
+        with amp.autocast():
+            out = f(jnp.ones((2,), jnp.bfloat16), jnp.ones((2,), jnp.float32))
+            assert out.dtype == jnp.float32
+
+    def test_disable_casts(self):
+        @amp.half_function
+        def f(x):
+            return x
+
+        x = jnp.ones((2,), jnp.float32)
+        with amp.autocast():
+            with amp.disable_casts():
+                assert f(x).dtype == jnp.float32

@@ -1,0 +1,80 @@
+"""Multi-tensor op numerics vs pure-numpy references.
+
+Mirrors reference tests/L0/run_amp/test_multi_tensor_scale.py,
+test_multi_tensor_axpby.py, test_multi_tensor_l2norm.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)
+
+
+def _tensors(rng, shapes, dtype=np.float32):
+    return [jnp.asarray(rng.randn(*s).astype(dtype)) for s in shapes]
+
+
+SHAPES = [(5,), (7, 3), (2, 4, 8)]
+
+
+class TestMultiTensorScale:
+    def test_scale(self, rng):
+        xs = _tensors(rng, SHAPES)
+        outs, noop = multi_tensor_applier(
+            multi_tensor_scale, jnp.zeros(()), [xs, xs], 0.125)
+        for x, o in zip(xs, outs):
+            np.testing.assert_allclose(np.asarray(o), np.asarray(x) * 0.125,
+                                       rtol=1e-6)
+        assert float(noop) == 0.0
+
+    def test_overflow_detected(self, rng):
+        xs = _tensors(rng, SHAPES)
+        xs[1] = xs[1].at[0, 0].set(jnp.inf)
+        _, noop = multi_tensor_applier(
+            multi_tensor_scale, jnp.zeros(()), [xs, xs], 1.0)
+        assert float(noop) == 1.0
+
+    def test_nan_detected(self, rng):
+        xs = _tensors(rng, SHAPES)
+        xs[2] = xs[2].at[0, 0, 0].set(jnp.nan)
+        _, noop = multi_tensor_applier(
+            multi_tensor_scale, jnp.zeros(()), [xs, xs], 1.0)
+        assert float(noop) == 1.0
+
+    def test_dtype_cast(self, rng):
+        xs = _tensors(rng, SHAPES)
+        outs_b = [x.astype(jnp.bfloat16) for x in xs]
+        outs, _ = multi_tensor_applier(
+            multi_tensor_scale, jnp.zeros(()), [xs, outs_b], 2.0)
+        for o in outs:
+            assert o.dtype == jnp.bfloat16
+
+
+class TestMultiTensorAxpby:
+    def test_axpby(self, rng):
+        xs = _tensors(rng, SHAPES)
+        ys = _tensors(rng, SHAPES)
+        outs, noop = multi_tensor_applier(
+            multi_tensor_axpby, jnp.zeros(()), [xs, ys, xs], 2.0, -1.0)
+        for x, y, o in zip(xs, ys, outs):
+            np.testing.assert_allclose(
+                np.asarray(o), 2.0 * np.asarray(x) - np.asarray(y), rtol=1e-6)
+        assert float(noop) == 0.0
+
+
+class TestMultiTensorL2Norm:
+    def test_l2norm(self, rng):
+        xs = _tensors(rng, SHAPES)
+        total, per = multi_tensor_applier(
+            multi_tensor_l2norm, jnp.zeros(()), [xs], True)
+        flat = np.concatenate([np.asarray(x).ravel() for x in xs])
+        np.testing.assert_allclose(float(total), np.linalg.norm(flat), rtol=1e-6)
+        for x, p in zip(xs, np.asarray(per)):
+            np.testing.assert_allclose(p, np.linalg.norm(np.asarray(x).ravel()),
+                                       rtol=1e-6)

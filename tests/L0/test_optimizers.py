@@ -1,0 +1,234 @@
+"""Fused optimizers vs torch.optim / manual references.
+
+Mirrors reference tests/L0/run_optimizers/test_adam.py,
+test_fused_optimizer.py, test_lamb.py (step-by-step comparisons vs
+torch.optim references).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.optimizers import (
+    FusedAdam,
+    FusedAdagrad,
+    FusedLAMB,
+    FusedMixedPrecisionLamb,
+    FusedNovoGrad,
+    FusedSGD,
+)
+
+
+def make_params(rng):
+    return {
+        "w": jnp.asarray(rng.randn(4, 8).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(8).astype(np.float32)),
+    }
+
+
+def make_grads(rng, params):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.randn(*p.shape).astype(np.float32)), params)
+
+
+def to_torch(tree):
+    return [torch.tensor(np.asarray(l), requires_grad=True)
+            for l in jax.tree_util.tree_leaves(tree)]
+
+
+class TestFusedAdamVsTorch:
+    @pytest.mark.parametrize("adam_w_mode,weight_decay", [
+        (True, 0.0), (True, 0.01), (False, 0.0), (False, 0.01)])
+    def test_matches_torch_adam(self, rng, adam_w_mode, weight_decay):
+        params = make_params(rng)
+        opt = FusedAdam(lr=1e-3, adam_w_mode=adam_w_mode,
+                        weight_decay=weight_decay)
+        state = opt.init(params)
+
+        tparams = to_torch(params)
+        cls = torch.optim.AdamW if adam_w_mode else torch.optim.Adam
+        topt = cls(tparams, lr=1e-3, weight_decay=weight_decay)
+
+        for _ in range(5):
+            grads = make_grads(rng, params)
+            for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+                tp.grad = torch.tensor(np.asarray(g))
+            topt.step()
+            params, state = opt.step(grads, state, params)
+
+        for ours, theirs in zip(jax.tree_util.tree_leaves(params), tparams):
+            np.testing.assert_allclose(np.asarray(ours),
+                                       theirs.detach().numpy(), atol=1e-5)
+
+    def test_overflow_skips_step(self, rng):
+        params = make_params(rng)
+        opt = FusedAdam(lr=1e-1)
+        state = opt.init(params)
+        grads = make_grads(rng, params)
+        p1, s1 = opt.step(grads, state, params,
+                          found_inf=jnp.ones((), jnp.float32))
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(s1["step"]) == 0
+
+    def test_master_weights(self, rng):
+        params16 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), make_params(rng))
+        opt = FusedAdam(lr=1e-3, master_weights=True)
+        state = opt.init(params16)
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.ones_like(p), params16)
+        p1, s1 = opt.step(grads, state, params16)
+        for l in jax.tree_util.tree_leaves(p1):
+            assert l.dtype == jnp.bfloat16
+        for l in jax.tree_util.tree_leaves(s1["master"]):
+            assert l.dtype == jnp.float32
+
+
+class TestFusedSGDVsTorch:
+    @pytest.mark.parametrize("momentum,nesterov,weight_decay", [
+        (0.0, False, 0.0), (0.9, False, 0.0), (0.9, True, 0.0),
+        (0.9, False, 1e-4)])
+    def test_matches_torch_sgd(self, rng, momentum, nesterov, weight_decay):
+        params = make_params(rng)
+        opt = FusedSGD(lr=0.1, momentum=momentum, nesterov=nesterov,
+                       weight_decay=weight_decay)
+        state = opt.init(params)
+        tparams = to_torch(params)
+        topt = torch.optim.SGD(tparams, lr=0.1, momentum=momentum,
+                               nesterov=nesterov, weight_decay=weight_decay)
+        for _ in range(5):
+            grads = make_grads(rng, params)
+            for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+                tp.grad = torch.tensor(np.asarray(g))
+            topt.step()
+            params, state = opt.step(grads, state, params)
+        for ours, theirs in zip(jax.tree_util.tree_leaves(params), tparams):
+            np.testing.assert_allclose(np.asarray(ours),
+                                       theirs.detach().numpy(), atol=1e-5)
+
+
+class TestFusedAdagradVsTorch:
+    def test_matches_torch_adagrad(self, rng):
+        params = make_params(rng)
+        opt = FusedAdagrad(lr=0.01, eps=1e-10)
+        state = opt.init(params)
+        tparams = to_torch(params)
+        topt = torch.optim.Adagrad(tparams, lr=0.01, eps=1e-10)
+        for _ in range(3):
+            grads = make_grads(rng, params)
+            for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+                tp.grad = torch.tensor(np.asarray(g))
+            topt.step()
+            params, state = opt.step(grads, state, params)
+        for ours, theirs in zip(jax.tree_util.tree_leaves(params), tparams):
+            np.testing.assert_allclose(np.asarray(ours),
+                                       theirs.detach().numpy(), atol=1e-4)
+
+
+class TestFusedLAMB:
+    def test_decreases_loss(self, rng):
+        """LAMB sanity: optimizing a quadratic decreases the loss
+        (the reference compares against its own CUDA kernel; we assert
+        optimizer behavior)."""
+        params = make_params(rng)
+        target = make_params(rng)
+        opt = FusedLAMB(lr=0.05, weight_decay=0.01)
+        state = opt.init(params)
+
+        def loss_fn(p):
+            return sum(jnp.sum((a - b) ** 2) for a, b in
+                       zip(jax.tree_util.tree_leaves(p),
+                           jax.tree_util.tree_leaves(target)))
+
+        losses = []
+        for _ in range(20):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.step(grads, state, params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_trust_ratio_scale_invariance(self, rng):
+        """LAMB's update direction is invariant to grad scale (layer-wise
+        normalization property)."""
+        params = make_params(rng)
+        opt = FusedLAMB(lr=0.01, weight_decay=0.0, use_nvlamb=True,
+                        max_grad_norm=0.0)
+        grads = make_grads(rng, params)
+        s1 = opt.init(params)
+        p_a, _ = opt.step(grads, s1, params)
+        s2 = opt.init(params)
+        grads_scaled = jax.tree_util.tree_map(lambda g: g * 1000.0, grads)
+        p_b, _ = opt.step(grads_scaled, s2, params)
+        for a, b in zip(jax.tree_util.tree_leaves(p_a),
+                        jax.tree_util.tree_leaves(p_b)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+class TestFusedNovoGrad:
+    def test_decreases_loss(self, rng):
+        params = make_params(rng)
+        target = make_params(rng)
+        opt = FusedNovoGrad(lr=0.3)
+        state = opt.init(params)
+
+        def loss_fn(p):
+            return sum(jnp.sum((a - b) ** 2) for a, b in
+                       zip(jax.tree_util.tree_leaves(p),
+                           jax.tree_util.tree_leaves(target)))
+
+        losses = []
+        for _ in range(50):
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.step(grads, state, params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestFusedMixedPrecisionLamb:
+    def test_bf16_params_fp32_master(self, rng):
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), make_params(rng))
+        opt = FusedMixedPrecisionLamb(lr=0.01)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(lambda p: jnp.ones_like(p), params)
+        p1, s1 = opt.step(grads, state, params)
+        for l in jax.tree_util.tree_leaves(p1):
+            assert l.dtype == jnp.bfloat16
+        for l in jax.tree_util.tree_leaves(s1["master"]):
+            assert l.dtype == jnp.float32
+        assert int(s1["step"]) == 1
+
+    def test_found_inf_skips(self, rng):
+        params = make_params(rng)
+        opt = FusedMixedPrecisionLamb(lr=0.01)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.full_like(p, jnp.inf), params)
+        # found_inf computed internally from grads via noop path: pass flag
+        p1, s1 = opt.step(grads, state, params,
+                          found_inf=jnp.ones((), jnp.float32))
+        for a, b in zip(jax.tree_util.tree_leaves(p1),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestOptaxInterop:
+    def test_gradient_transformation(self, rng):
+        import optax
+
+        params = make_params(rng)
+        opt = FusedAdam(lr=1e-3)
+        tx = opt.as_gradient_transformation()
+        state = tx.init(params)
+        grads = make_grads(rng, params)
+        updates, state = tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        # must equal direct step
+        direct, _ = opt.step(grads, opt.init(params), params)
+        for a, b in zip(jax.tree_util.tree_leaves(new_params),
+                        jax.tree_util.tree_leaves(direct)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -1,0 +1,208 @@
+"""Pipeline-parallel schedule correctness.
+
+Mirrors reference tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py
+(716 LoC): end-to-end pipelined fwd+bwd with toy models, asserting loss and
+gradient equivalence vs the unpipelined computation.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from apex_tpu.testing import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+    get_forward_backward_func,
+)
+
+PP = 4
+M = 6  # microbatches
+HID = 8
+MB = 2  # microbatch size
+
+
+def pp_mesh():
+    return Mesh(np.asarray(jax.devices()[:PP]), ("pp",))
+
+
+def stage_fn(params, h, mb, is_first):
+    """One pipeline stage: a linear + gelu. On the global first stage the
+    microbatch's input x is injected (the 'embedding'). ``h`` is None for
+    the no-pipelining schedule (single stage owns the whole model)."""
+    h = mb["x"] if h is None else jnp.where(is_first, mb["x"], h)
+    return jax.nn.gelu(h @ params["w"] + params["b"])
+
+
+def loss_fn(params, y, mb):
+    return jnp.mean((y - mb["t"]) ** 2)
+
+
+def make_data(rng):
+    # stage-local params: every rank has its own stage weights -> emulate by
+    # identical weights per rank for comparison vs a stacked reference.
+    ws = rng.randn(PP, HID, HID).astype(np.float32) * 0.3
+    bs = rng.randn(PP, HID).astype(np.float32) * 0.1
+    xs = rng.randn(M, MB, HID).astype(np.float32)
+    ts = rng.randn(M, MB, HID).astype(np.float32)
+    return ws, bs, xs, ts
+
+
+def reference_loss_and_grads(ws, bs, xs, ts):
+    """Unpipelined reference: sequential stages over all microbatches."""
+    def full(params, x, t):
+        h = jnp.zeros_like(x) + x
+        for i in range(PP):
+            h = jax.nn.gelu(h @ params["w"][i] + params["b"][i])
+        return jnp.mean((h - t) ** 2)
+
+    params = {"w": jnp.asarray(ws), "b": jnp.asarray(bs)}
+
+    def total(params):
+        losses = [full(params, jnp.asarray(xs[m]), jnp.asarray(ts[m]))
+                  for m in range(M)]
+        return sum(losses) / M, jnp.stack(losses)
+
+    (loss, losses), grads = jax.value_and_grad(total, has_aux=True)(params)
+    return np.asarray(losses), grads
+
+
+class TestNoPipelining:
+    def test_matches_reference_single_stage(self, rng):
+        w = rng.randn(HID, HID).astype(np.float32) * 0.3
+        b = rng.randn(HID).astype(np.float32) * 0.1
+        xs = rng.randn(M, MB, HID).astype(np.float32)
+        ts = rng.randn(M, MB, HID).astype(np.float32)
+        params = {"w": jnp.asarray(w), "b": jnp.asarray(b)}
+        mbs = {"x": jnp.asarray(xs), "t": jnp.asarray(ts)}
+
+        losses, grads = forward_backward_no_pipelining(
+            stage_fn, loss_fn, params, mbs, num_microbatches=M)
+
+        def ref(params):
+            tot = 0.0
+            for m in range(M):
+                y = jax.nn.gelu(jnp.asarray(xs[m]) @ params["w"] + params["b"])
+                tot = tot + jnp.mean((y - jnp.asarray(ts[m])) ** 2)
+            return tot / M
+
+        ref_grads = jax.grad(ref)(params)
+        for a, b_ in zip(jax.tree_util.tree_leaves(grads),
+                         jax.tree_util.tree_leaves(ref_grads)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestPipelining1F1B:
+    def test_matches_unpipelined_reference(self, rng):
+        ws, bs, xs, ts = make_data(rng)
+        ref_losses, ref_grads = reference_loss_and_grads(ws, bs, xs, ts)
+        mesh = pp_mesh()
+        parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size_=PP, devices=jax.devices()[:PP])
+
+        # microbatch pytree: stage 0 sees x, last stage sees t; other
+        # stages see zeros of the right shape (replicated feed).
+        mbs = {"x": jnp.asarray(xs), "t": jnp.asarray(ts)}
+        params_stacked = {"w": jnp.asarray(ws), "b": jnp.asarray(bs)}
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("pp"), P(), P()), out_specs=(P("pp"), P("pp")))
+        def run(p_stage, mb_x, mb_t):
+            p = jax.tree_util.tree_map(lambda a: a[0], p_stage)
+            mb = {"x": mb_x, "t": mb_t}
+            losses, grads = forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, p, mb, num_microbatches=M,
+                tensor_shape=(MB, HID), dtype=jnp.float32, pp_size=PP)
+            grads = jax.tree_util.tree_map(lambda a: a[None], grads)
+            return losses[None], grads
+
+        losses, grads = run(params_stacked, mbs["x"], mbs["t"])
+        # losses live on the last stage (row PP-1)
+        np.testing.assert_allclose(np.asarray(losses)[PP - 1], ref_losses,
+                                   rtol=1e-4, atol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]), np.asarray(ref_grads[k]),
+                rtol=1e-3, atol=1e-4)
+
+    def test_get_forward_backward_func_dispatch(self):
+        parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size_=4, devices=jax.devices()[:8])
+        f = get_forward_backward_func(None, 4)
+        assert f is forward_backward_pipelining_without_interleaving
+        f = get_forward_backward_func(2, 4)
+        assert f is forward_backward_pipelining_with_interleaving
+        f = get_forward_backward_func(None, 1)
+        assert f is forward_backward_no_pipelining
+
+
+class TestPipeliningInterleaved:
+    def test_matches_unpipelined_reference(self, rng):
+        """V=2 virtual chunks on PP=2 ranks == 4 sequential stages."""
+        V, P_ = 2, 2
+        ws = rng.randn(V * P_, HID, HID).astype(np.float32) * 0.3
+        bs = rng.randn(V * P_, HID).astype(np.float32) * 0.1
+        xs = rng.randn(M, MB, HID).astype(np.float32)
+        ts = rng.randn(M, MB, HID).astype(np.float32)
+
+        # reference over 4 sequential stages (global stage c*P + r)
+        def full(params, x, t):
+            h = x
+            for s in range(V * P_):
+                h = jax.nn.gelu(h @ params["w"][s] + params["b"][s])
+            return jnp.mean((h - t) ** 2)
+
+        pref = {"w": jnp.asarray(ws), "b": jnp.asarray(bs)}
+
+        def total(params):
+            return sum(full(params, jnp.asarray(xs[m]), jnp.asarray(ts[m]))
+                       for m in range(M)) / M
+
+        ref_grads = jax.grad(total)(pref)
+
+        mesh = Mesh(np.asarray(jax.devices()[:P_]), ("pp",))
+        parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size_=P_, devices=jax.devices()[:P_])
+
+        # rank r holds chunks [c, ...] with global stage c*P + r:
+        # stacked leaf shape [P, V, ...] -> shard over pp axis
+        w_rank = np.stack([[ws[c * P_ + r] for c in range(V)]
+                           for r in range(P_)])
+        b_rank = np.stack([[bs[c * P_ + r] for c in range(V)]
+                           for r in range(P_)])
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("pp"), P(), P()), out_specs=(P("pp"), P("pp")))
+        def run(p_stage, mb_x, mb_t):
+            p = jax.tree_util.tree_map(lambda a: a[0], p_stage)  # [V, ...]
+            mb = {"x": mb_x, "t": mb_t}
+            losses, grads = forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, p, mb, num_microbatches=M,
+                tensor_shape=(MB, HID), dtype=jnp.float32, pp_size=P_,
+                num_model_chunks=V)
+            return losses[None], jax.tree_util.tree_map(
+                lambda a: a[None], grads)
+
+        losses, grads = run({"w": jnp.asarray(w_rank), "b": jnp.asarray(b_rank)},
+                            jnp.asarray(xs), jnp.asarray(ts))
+        # reassemble grads [P, V, ...] -> [S, ...]
+        gw = np.asarray(grads["w"])
+        gb = np.asarray(grads["b"])
+        for r in range(P_):
+            for c in range(V):
+                s = c * P_ + r
+                np.testing.assert_allclose(
+                    gw[r, c], np.asarray(ref_grads["w"])[s],
+                    rtol=1e-3, atol=1e-4)
+                np.testing.assert_allclose(
+                    gb[r, c], np.asarray(ref_grads["b"])[s],
+                    rtol=1e-3, atol=1e-4)

@@ -1,0 +1,313 @@
+"""Tensor-parallel mappings, layers, and vocab-parallel cross entropy.
+
+Mirrors reference tests/L0/run_transformer/test_mapping.py, test_layers.py
+(TP layers vs non-parallel reference), test_cross_entropy.py,
+test_parallel_state.py, test_microbatches.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from apex_tpu.testing import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    copy_to_tensor_model_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+    scatter_to_sequence_parallel_region,
+    gather_from_sequence_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    vocab_parallel_cross_entropy,
+)
+
+
+def tp_mesh(tp=4):
+    devices = np.asarray(jax.devices()[:tp])
+    return Mesh(devices, ("tp",))
+
+
+class TestParallelState:
+    def test_grid_math(self):
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2,
+            devices=jax.devices()[:8])
+        assert parallel_state.get_tensor_model_parallel_world_size() == 2
+        assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+        assert parallel_state.get_data_parallel_world_size() == 2
+        assert parallel_state.get_model_parallel_world_size() == 4
+        assert parallel_state.model_parallel_is_initialized()
+
+    def test_bad_grid_raises(self):
+        with pytest.raises(RuntimeError):
+            parallel_state.initialize_model_parallel(
+                tensor_model_parallel_size_=3, pipeline_model_parallel_size_=1,
+                devices=jax.devices()[:8])
+
+    def test_destroy(self):
+        parallel_state.initialize_model_parallel(devices=jax.devices()[:8])
+        parallel_state.destroy_model_parallel()
+        assert not parallel_state.model_parallel_is_initialized()
+
+    def test_virtual_pipeline_requires_pp(self):
+        with pytest.raises(RuntimeError):
+            parallel_state.initialize_model_parallel(
+                tensor_model_parallel_size_=1,
+                pipeline_model_parallel_size_=1,
+                virtual_pipeline_model_parallel_size_=2,
+                devices=jax.devices()[:8])
+
+    def test_split_rank_predicates(self):
+        parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size_=4,
+            pipeline_model_parallel_split_rank_=2,
+            devices=jax.devices()[:8])
+        parallel_state.set_pipeline_model_parallel_rank(1)
+        assert parallel_state.is_pipeline_stage_before_split()
+        parallel_state.set_pipeline_model_parallel_rank(2)
+        assert parallel_state.is_pipeline_stage_after_split()
+
+
+class TestMappings:
+    """Forward + backward semantics of each region op
+    (reference test_mapping.py)."""
+
+    def setup_method(self, method):
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=4, devices=jax.devices()[:4])
+
+    def _run(self, fn, x, in_spec, out_spec):
+        mesh = tp_mesh(4)
+        return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec)(x)
+
+    def test_copy_identity_fwd_psum_bwd(self, rng):
+        x = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+
+        def f(x_):
+            return copy_to_tensor_model_parallel_region(x_)
+
+        out = self._run(f, x, P(), P())
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+        def g(x_):
+            return jax.grad(lambda a: jnp.sum(
+                copy_to_tensor_model_parallel_region(a)))(x_)
+
+        grads = self._run(g, x, P(), P())
+        # each replica contributes ones; psum over 4 -> 4
+        np.testing.assert_array_equal(np.asarray(grads),
+                                      4 * np.ones_like(np.asarray(x)))
+
+    def test_reduce_fwd(self, rng):
+        x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+
+        def f(x_):
+            return reduce_from_tensor_model_parallel_region(x_)
+
+        # shard over dim0: psum of shards
+        out = self._run(f, x, P("tp"), P("tp"))
+        # each device's shard [1, 8] -> psum across devices sums all rows
+        expected = np.broadcast_to(np.asarray(x).sum(0, keepdims=True), (4, 8))
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+    def test_scatter_gather_roundtrip(self, rng):
+        x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+
+        def f(x_):
+            s = scatter_to_tensor_model_parallel_region(x_)
+            return gather_from_tensor_model_parallel_region(s)
+
+        out = self._run(f, x, P(), P())
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_sequence_parallel_roundtrip(self, rng):
+        x = jnp.asarray(rng.randn(8, 3).astype(np.float32))
+
+        def f(x_):
+            s = scatter_to_sequence_parallel_region(x_)
+            return gather_from_sequence_parallel_region(s, False)
+
+        out = self._run(f, x, P(), P())
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_reduce_scatter_fwd(self, rng):
+        x = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+
+        def f(x_):
+            return reduce_scatter_to_sequence_parallel_region(x_)
+
+        # replicated input -> each shard = 4 * its slice
+        out = self._run(f, x, P(), P("tp"))
+        np.testing.assert_allclose(np.asarray(out), 4 * np.asarray(x),
+                                   rtol=1e-6)
+
+
+class TestColumnRowParallel:
+    """TP layers match a non-parallel reference (reference test_layers.py)."""
+
+    def setup_method(self, method):
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=4, devices=jax.devices()[:4])
+
+    def test_column_times_row_matches_dense(self, rng):
+        mesh = tp_mesh(4)
+        B, H, F = 2, 8, 16
+        x = jnp.asarray(rng.randn(B, H).astype(np.float32))
+        col = ColumnParallelLinear(input_size=H, output_size=F,
+                                   gather_output=False, bias=True)
+        row = RowParallelLinear(input_size=F, output_size=H,
+                                input_is_parallel=True, bias=True)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=P())
+        def init_and_apply(key, x_):
+            cp = col.init(key, x_)
+            h = col.apply(cp, x_)
+            rp = row.init(jax.random.fold_in(key, 7), h)
+            y = row.apply(rp, h)
+            return y, cp, rp
+
+        y, cp, rp = init_and_apply(jax.random.PRNGKey(0), x)
+
+        # Reference: gather the full weights and do a dense matmul.
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P(), P(), P()), out_specs=P())
+        def dense_ref(x_, cp_, rp_):
+            wc = jax.lax.all_gather(cp_["params"]["weight"], "tp", axis=1,
+                                    tiled=True)
+            bc = jax.lax.all_gather(cp_["params"]["bias"], "tp", axis=0,
+                                    tiled=True)
+            wr = jax.lax.all_gather(rp_["params"]["weight"], "tp", axis=0,
+                                    tiled=True)
+            br = rp_["params"]["bias"]
+            h = x_ @ wc + bc
+            return h @ wr + br
+
+        expected = dense_ref(x, cp, rp)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expected),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gather_output(self, rng):
+        mesh = tp_mesh(4)
+        x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+        col = ColumnParallelLinear(input_size=8, output_size=16,
+                                   gather_output=True, bias=False)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=P())
+        def f(key, x_):
+            p = col.init(key, x_)
+            return col.apply(p, x_)
+
+        y = f(jax.random.PRNGKey(0), x)
+        assert y.shape == (2, 16)
+
+    def test_sequence_parallel_column(self, rng):
+        """SP column linear: seq-sharded input, gathered internally."""
+        mesh = tp_mesh(4)
+        S, B, H, F = 8, 2, 8, 16
+        x = jnp.asarray(rng.randn(S, B, H).astype(np.float32))
+        col = ColumnParallelLinear(input_size=H, output_size=F,
+                                   gather_output=False, bias=False,
+                                   sequence_parallel_enabled=True)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P("tp")),
+                           out_specs=P())
+        def f(key, x_shard):
+            p = col.init(key, x_shard)
+            return col.apply(p, x_shard), p
+
+        y, p = f(jax.random.PRNGKey(0), x)
+        assert y.shape == (S, 2, F // 4)  # full seq, sharded feature
+
+    def test_vocab_parallel_embedding(self, rng):
+        mesh = tp_mesh(4)
+        V, D = 16, 8
+        ids = jnp.asarray(rng.randint(0, V, size=(2, 5)))
+        emb = VocabParallelEmbedding(num_embeddings=V, embedding_dim=D)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=(P(), P()))
+        def f(key, ids_):
+            p = emb.init(key, ids_)
+            return emb.apply(p, ids_), p["params"]["weight"]
+
+        out, wshard = f(jax.random.PRNGKey(0), ids)
+        assert out.shape == (2, 5, D)
+
+        # reference lookup from the gathered table
+        @functools.partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P())
+        def gather_w(w):
+            return jax.lax.all_gather(w, "tp", axis=0, tiled=True)
+
+        full_w = np.asarray(gather_w(wshard))[:V]
+        expected = full_w[np.asarray(ids)]
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+class TestVocabParallelCrossEntropy:
+    def setup_method(self, method):
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=4, devices=jax.devices()[:4])
+
+    def test_matches_dense_cross_entropy(self, rng):
+        mesh = tp_mesh(4)
+        B, S, V = 2, 3, 16
+        logits = jnp.asarray(rng.randn(B, S, V).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, V, size=(B, S)))
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(None, None, "tp"), P()),
+                           out_specs=P())
+        def f(logits_shard, tgt):
+            return vocab_parallel_cross_entropy(logits_shard, tgt)
+
+        loss = np.asarray(f(logits, target))
+        # dense reference
+        lse = np.log(np.exp(np.asarray(logits) -
+                            np.asarray(logits).max(-1, keepdims=True)).sum(-1))
+        picked = np.take_along_axis(
+            np.asarray(logits) - np.asarray(logits).max(-1, keepdims=True),
+            np.asarray(target)[..., None], axis=-1)[..., 0]
+        expected = lse - picked
+        np.testing.assert_allclose(loss, expected, rtol=1e-4, atol=1e-5)
+
+    def test_gradient_is_softmax_minus_onehot(self, rng):
+        mesh = tp_mesh(4)
+        B, V = 4, 8
+        logits = jnp.asarray(rng.randn(B, V).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, V, size=(B,)))
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P(None, "tp"), P()),
+                           out_specs=P(None, "tp"))
+        def g(logits_shard, tgt):
+            return jax.grad(
+                lambda l: jnp.sum(vocab_parallel_cross_entropy(l, tgt))
+            )(logits_shard)
+
+        grads = np.asarray(g(logits, target))
+        p = np.exp(np.asarray(logits))
+        p /= p.sum(-1, keepdims=True)
+        onehot = np.eye(V)[np.asarray(target)]
+        np.testing.assert_allclose(grads, p - onehot, rtol=1e-4, atol=1e-5)
+
+    def test_label_smoothing(self, rng):
+        mesh = tp_mesh(4)
+        logits = jnp.asarray(rng.randn(4, 16).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 16, size=(4,)))
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P(None, "tp"), P()), out_specs=P())
+        def f(l, t):
+            return vocab_parallel_cross_entropy(l, t, label_smoothing=0.1)
+
+        loss = np.asarray(f(logits, target))
+        assert np.all(np.isfinite(loss))

@@ -1,0 +1,43 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Mirrors the reference test strategy (SURVEY.md §4): the reference spawns
+one process per GPU via MultiProcessTestCase; here multi-device tests use a
+virtual 8-device CPU mesh (SPMD shard_map) — chips stand in for processes.
+Must set XLA flags before jax initializes.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+# Tests always run on the virtual CPU mesh (the env-var route is ignored
+# when a TPU PJRT plugin registers itself, so set the config directly);
+# run bench.py / examples for real-TPU execution.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)
+
+
+@pytest.fixture
+def mesh8():
+    """2x2x2 (pp, dp, tp) mesh over the 8 virtual devices."""
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    return Mesh(devices, ("pp", "dp", "tp"))
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    yield
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
