@@ -1,0 +1,13 @@
+"""apex_tpu.RNN — half-precision-friendly RNN re-implementations.
+
+Parity: reference apex/RNN (models.py LSTM/GRU/ReLU/Tanh/mLSTM,
+RNNBackend.py bidirectionalRNN/stackedRNN/RNNCell — deprecated in the
+reference but part of its surface).
+
+TPU design: cells are scanned with ``lax.scan`` (single compiled loop);
+gates compute in fp32 with bf16 matmuls.
+"""
+
+from apex_tpu.RNN.models import GRU, LSTM, ReLU, Tanh, mLSTM  # noqa: F401
+from apex_tpu.RNN.cells import GRUCell, LSTMCell, RNNCell, mLSTMCell  # noqa: F401
+from apex_tpu.RNN.rnn_backend import StackedRNN, BidirectionalRNN  # noqa: F401

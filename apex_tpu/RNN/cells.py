@@ -1,0 +1,131 @@
+"""RNN cells (parity: reference apex/RNN/RNNBackend.py RNNCell + cell fns)."""
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class RNNCell(nn.Module):
+    """Vanilla RNN cell with configurable nonlinearity
+    (reference RNNBackend.RNNCell with gate_multiplier=1)."""
+
+    hidden_size: int
+    nonlinearity: Callable = jnp.tanh
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h = carry
+        wi = self.param("w_ih", nn.initializers.lecun_normal(),
+                        (x.shape[-1], self.hidden_size), self.param_dtype)
+        wh = self.param("w_hh", nn.initializers.lecun_normal(),
+                        (self.hidden_size, self.hidden_size), self.param_dtype)
+        b = self.param("bias", nn.initializers.zeros, (self.hidden_size,),
+                       self.param_dtype)
+        new_h = self.nonlinearity(
+            (x @ wi + h @ wh + b).astype(jnp.float32)).astype(h.dtype)
+        return new_h, new_h
+
+    @staticmethod
+    def init_carry(batch, hidden, dtype=jnp.float32):
+        return jnp.zeros((batch, hidden), dtype)
+
+
+class LSTMCell(nn.Module):
+    """LSTM cell (reference RNNBackend gate_multiplier=4)."""
+
+    hidden_size: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        wi = self.param("w_ih", nn.initializers.lecun_normal(),
+                        (x.shape[-1], 4 * self.hidden_size), self.param_dtype)
+        wh = self.param("w_hh", nn.initializers.lecun_normal(),
+                        (self.hidden_size, 4 * self.hidden_size),
+                        self.param_dtype)
+        b = self.param("bias", nn.initializers.zeros,
+                       (4 * self.hidden_size,), self.param_dtype)
+        gates = (x @ wi + h @ wh + b).astype(jnp.float32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        new_c = f * c.astype(jnp.float32) + i * g
+        new_h = o * jnp.tanh(new_c)
+        return (new_h.astype(h.dtype), new_c.astype(c.dtype)), new_h.astype(h.dtype)
+
+    @staticmethod
+    def init_carry(batch, hidden, dtype=jnp.float32):
+        return (jnp.zeros((batch, hidden), dtype),
+                jnp.zeros((batch, hidden), dtype))
+
+
+class GRUCell(nn.Module):
+    """GRU cell (reference RNNBackend gate_multiplier=3)."""
+
+    hidden_size: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h = carry
+        wi = self.param("w_ih", nn.initializers.lecun_normal(),
+                        (x.shape[-1], 3 * self.hidden_size), self.param_dtype)
+        wh = self.param("w_hh", nn.initializers.lecun_normal(),
+                        (self.hidden_size, 3 * self.hidden_size),
+                        self.param_dtype)
+        b = self.param("bias", nn.initializers.zeros,
+                       (3 * self.hidden_size,), self.param_dtype)
+        xi = (x @ wi + b).astype(jnp.float32)
+        hh = (h @ wh).astype(jnp.float32)
+        xr, xz, xn = jnp.split(xi, 3, axis=-1)
+        hr, hz, hn = jnp.split(hh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        new_h = (1 - z) * n + z * h.astype(jnp.float32)
+        new_h = new_h.astype(h.dtype)
+        return new_h, new_h
+
+    @staticmethod
+    def init_carry(batch, hidden, dtype=jnp.float32):
+        return jnp.zeros((batch, hidden), dtype)
+
+
+class mLSTMCell(nn.Module):
+    """Multiplicative LSTM (reference apex/RNN mLSTMRNNCell)."""
+
+    hidden_size: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        wi = self.param("w_ih", nn.initializers.lecun_normal(),
+                        (x.shape[-1], 4 * self.hidden_size), self.param_dtype)
+        wh = self.param("w_hh", nn.initializers.lecun_normal(),
+                        (self.hidden_size, 4 * self.hidden_size),
+                        self.param_dtype)
+        wmx = self.param("w_mih", nn.initializers.lecun_normal(),
+                         (x.shape[-1], self.hidden_size), self.param_dtype)
+        wmh = self.param("w_mhh", nn.initializers.lecun_normal(),
+                         (self.hidden_size, self.hidden_size),
+                         self.param_dtype)
+        b = self.param("bias", nn.initializers.zeros,
+                       (4 * self.hidden_size,), self.param_dtype)
+        m = (x @ wmx) * (h @ wmh)
+        gates = (x @ wi + m @ wh + b).astype(jnp.float32)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        new_c = f * c.astype(jnp.float32) + i * g
+        new_h = o * jnp.tanh(new_c)
+        return (new_h.astype(h.dtype), new_c.astype(c.dtype)), new_h.astype(h.dtype)
+
+    @staticmethod
+    def init_carry(batch, hidden, dtype=jnp.float32):
+        return (jnp.zeros((batch, hidden), dtype),
+                jnp.zeros((batch, hidden), dtype))

@@ -1,0 +1,45 @@
+"""User-facing RNN constructors.
+
+Parity: reference apex/RNN/models.py ``LSTM/GRU/ReLU/Tanh/mLSTM`` factory
+functions (bidirectional unsupported for mLSTM, like the reference).
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.RNN.cells import GRUCell, LSTMCell, RNNCell, mLSTMCell
+from apex_tpu.RNN.rnn_backend import BidirectionalRNN, StackedRNN
+
+
+def _build(cell_cls, input_size, hidden_size, num_layers=1, bias=True,
+           batch_first=False, dropout=0.0, bidirectional=False):
+    del input_size, bias, batch_first  # inferred / always-on / seq-major
+    if bidirectional:
+        assert num_layers == 1, "bidirectional stacks: compose manually"
+        return BidirectionalRNN(cell_cls, hidden_size)
+    return StackedRNN(cell_cls, hidden_size, num_layers, dropout)
+
+
+def LSTM(*args, **kwargs):
+    return _build(LSTMCell, *args, **kwargs)
+
+
+def GRU(*args, **kwargs):
+    return _build(GRUCell, *args, **kwargs)
+
+
+def ReLU(*args, **kwargs):
+    import functools
+
+    relu_cell = functools.partial(
+        RNNCell, nonlinearity=lambda x: jnp.maximum(x, 0.0))
+    return _build(relu_cell, *args, **kwargs)
+
+
+def Tanh(*args, **kwargs):
+    return _build(RNNCell, *args, **kwargs)
+
+
+def mLSTM(*args, **kwargs):
+    assert not kwargs.get("bidirectional", False), (
+        "bidirectional mLSTM not supported (parity with reference)")
+    return _build(mLSTMCell, *args, **kwargs)

@@ -1,0 +1,73 @@
+"""Stacked / bidirectional RNN runners.
+
+Parity: reference apex/RNN/RNNBackend.py ``stackedRNN`` / ``bidirectionalRNN``.
+TPU design: ``nn.scan`` over the time axis — one compiled loop, weights
+held in VMEM across steps.
+"""
+
+from typing import Any, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _ScanRunner(nn.Module):
+    cell_cls: Type
+    hidden_size: int
+    reverse: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs):
+        # xs: [seq, batch, features]
+        cell = nn.scan(
+            self.cell_cls,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0, out_axes=0, reverse=self.reverse,
+        )(hidden_size=self.hidden_size, param_dtype=self.param_dtype)
+        cell_base = getattr(self.cell_cls, "func", self.cell_cls)
+        carry = cell_base.init_carry(xs.shape[1], self.hidden_size, xs.dtype)
+        carry, ys = cell(carry, xs)
+        return ys, carry
+
+
+class StackedRNN(nn.Module):
+    """num_layers cells stacked, optional dropout between layers
+    (reference stackedRNN)."""
+
+    cell_cls: Type
+    hidden_size: int
+    num_layers: int = 1
+    dropout: float = 0.0
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs, deterministic: bool = True):
+        h = xs
+        final = []
+        for i in range(self.num_layers):
+            h, carry = _ScanRunner(self.cell_cls, self.hidden_size,
+                                   param_dtype=self.param_dtype,
+                                   name=f"layer_{i}")(h)
+            final.append(carry)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return h, final
+
+
+class BidirectionalRNN(nn.Module):
+    """Forward + reverse cells, outputs concatenated
+    (reference bidirectionalRNN)."""
+
+    cell_cls: Type
+    hidden_size: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs):
+        fwd, cf = _ScanRunner(self.cell_cls, self.hidden_size,
+                              param_dtype=self.param_dtype, name="fwd")(xs)
+        bwd, cb = _ScanRunner(self.cell_cls, self.hidden_size, reverse=True,
+                              param_dtype=self.param_dtype, name="bwd")(xs)
+        return jnp.concatenate([fwd, bwd], axis=-1), (cf, cb)

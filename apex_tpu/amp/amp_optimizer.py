@@ -39,7 +39,10 @@ class AmpOptimizer(object):
 
     def init(self, params):
         inner_state = self.inner.init(params)
-        if self.master_weights and "master" not in inner_state:
+        # If the wrapped optimizer maintains its own fp32 masters
+        # (e.g. FusedAdam(master_weights=True)), defer to it entirely.
+        self._inner_owns_master = "master" in inner_state
+        if self.master_weights and not self._inner_owns_master:
             inner_state["master"] = jax.tree_util.tree_map(
                 lambda p: p.astype(jnp.float32), params)
         return {"inner": inner_state, "scaler": self.scaler.init_state()}
@@ -52,7 +55,9 @@ class AmpOptimizer(object):
             multi_tensor_scale, jnp.zeros((), jnp.float32), [leaves, leaves], inv)
         grads = jax.tree_util.tree_unflatten(treedef, unscaled)
 
-        if self.master_weights and "master" in state["inner"]:
+        inner_owns_master = getattr(self, "_inner_owns_master", False)
+        if (self.master_weights and not inner_owns_master
+                and "master" in state["inner"]):
             # Update runs on fp32 masters; model params are re-cast copies.
             masters = state["inner"]["master"]
             inner_wo_master = {k: v for k, v in state["inner"].items() if k != "master"}

@@ -16,15 +16,6 @@ import warnings
 from apex_tpu.amp._amp_state import _amp_state
 
 
-class _ScaledLoss:
-    def __init__(self, loss, scaler):
-        self.loss = loss
-        self.scaler = scaler
-
-    def value(self):
-        return self.loss
-
-
 @contextlib.contextmanager
 def scale_loss(loss, optimizers, loss_id=0, model=None,
                delay_unscale=False, delay_overflow_check=False):

@@ -5,8 +5,15 @@ README.md:155-182). On TPU no build flags are needed; everything is
 importable, with Pallas kernels engaging on TPU backends.
 """
 
+from apex_tpu.contrib import bottleneck  # noqa: F401
 from apex_tpu.contrib import clip_grad  # noqa: F401
 from apex_tpu.contrib import fmha  # noqa: F401
 from apex_tpu.contrib import focal_loss  # noqa: F401
+from apex_tpu.contrib import groupbn  # noqa: F401
 from apex_tpu.contrib import index_mul_2d  # noqa: F401
+from apex_tpu.contrib import multihead_attn  # noqa: F401
+from apex_tpu.contrib import optimizers  # noqa: F401
+from apex_tpu.contrib import peer_memory  # noqa: F401
+from apex_tpu.contrib import sparsity  # noqa: F401
+from apex_tpu.contrib import transducer  # noqa: F401
 from apex_tpu.contrib import xentropy  # noqa: F401

@@ -1,0 +1,125 @@
+"""Fused ResNet bottleneck + spatial-parallel variant.
+
+Parity: reference apex/contrib/bottleneck (bottleneck.py:749 ``Bottleneck``
+/ ``SpatialBottleneck`` + csrc/bottleneck.cpp 4,073 LoC cuDNN-frontend
+fusions; halo_exchangers.py:180) and apex/contrib/conv_bias_relu.
+
+TPU design: the conv+bias+relu fusion is XLA's bread and butter (one
+fused HLO); the spatial-parallel 3x3 conv shards H across the 'spatial'
+mesh axis and stitches a 1-row halo per side with
+:func:`apex_tpu.contrib.peer_memory.halo_exchange_1d` before a VALID conv.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.contrib.peer_memory import halo_exchange_1d
+
+
+def conv_bias_relu(x, kernel, bias=None, stride=1, padding="SAME",
+                   relu=True):
+    """Fused Conv+Bias[+ReLU] (parity: apex/contrib/conv_bias_relu)."""
+    import jax
+
+    y = jax.lax.conv_general_dilated(
+        x, kernel, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if bias is not None:
+        y = y + bias
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y
+
+
+def conv_bias_mask_relu(x, kernel, bias, mask, stride=1):
+    """Parity: ConvBiasMaskReLU (reference conv_bias_relu.py)."""
+    y = conv_bias_relu(x, kernel, bias, stride, relu=False)
+    return jnp.maximum(y * mask, 0.0)
+
+
+class Bottleneck(nn.Module):
+    """Standard ResNet bottleneck with fused epilogues
+    (reference bottleneck.py Bottleneck: 1x1 -> 3x3 -> 1x1 + residual)."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+    use_cudnn: bool = True  # accepted for parity; XLA always fuses
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        residual = x
+        y = nn.Conv(self.bottleneck_channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        y = nn.Conv(self.bottleneck_channels, (3, 3),
+                    strides=(self.stride, self.stride), use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.relu(norm("bn2")(y))
+        y = nn.Conv(self.out_channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv3")(y)
+        y = norm("bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.out_channels, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype,
+                               name="conv_proj")(x)
+            residual = norm("bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class SpatialBottleneck(nn.Module):
+    """Bottleneck whose 3x3 conv runs on an H-sharded input with halo
+    exchange (reference SpatialBottleneck + halo_exchangers.py).
+
+    Must run inside shard_map with ``spatial_axis`` bound; the input is the
+    local H shard [N, H/world, W, C].
+    """
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    spatial_axis: str = "spatial"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        assert self.stride == 1, "spatial-parallel stride-1 blocks only"
+        norm = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=self.dtype, param_dtype=jnp.float32, name=name,
+            axis_name=self.spatial_axis)
+        residual = x
+        y = nn.Conv(self.bottleneck_channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        # 3x3 with halo: fetch one row from each neighbor, then VALID conv
+        # over H (padding stays SAME over W).
+        top, bottom = halo_exchange_1d(y, 1, self.spatial_axis, dim=1)
+        y_h = jnp.concatenate([top, y, bottom], axis=1)
+        import jax
+
+        kernel = self.param("conv2_kernel", nn.initializers.lecun_normal(),
+                            (3, 3, self.bottleneck_channels,
+                             self.bottleneck_channels), jnp.float32)
+        y = jax.lax.conv_general_dilated(
+            y_h.astype(self.dtype), kernel.astype(self.dtype),
+            window_strides=(1, 1), padding=[(0, 0), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = nn.relu(norm("bn2")(y))
+        y = nn.Conv(self.out_channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv3")(y)
+        y = norm("bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.out_channels, (1, 1), use_bias=False,
+                               dtype=self.dtype, name="conv_proj")(x)
+            residual = norm("bn_proj")(residual)
+        return nn.relu(y + residual)

@@ -37,18 +37,18 @@ def _use_pallas():
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
                       block_q, block_k, seq_len):
-    # q_ref: [block_q, d]; k_ref/v_ref: [seq, d]; o_ref: [block_q, d]
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq, d]; o_ref: [1, block_q, d]
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * scale
+    q = q_ref[0].astype(jnp.float32) * scale
     d = q.shape[-1]
     num_kv = seq_len // block_k
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             q_ids = qi * block_q + jax.lax.broadcasted_iota(
@@ -75,7 +75,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_kv_eff, body, (acc0, m0, l0))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):

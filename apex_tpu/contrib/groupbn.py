@@ -1,0 +1,42 @@
+"""Group BatchNorm (NHWC) with fused add+relu.
+
+Parity: reference apex/contrib/groupbn (batch_norm.py:225 ``BatchNorm2d_NHWC``
++ ~7k LoC CUDA incl. inter-GPU IPC) and apex/contrib/cudnn_gbn: NHWC batch
+norm synchronized within groups of ranks ("bn_group"), fused elementwise
+add + relu epilogues.
+
+TPU design: NHWC is the native layout; group sync = psum over a sub-axis
+of the dp mesh axis (callers split 'dp' into ('dp_outer', 'dp_bn') and
+pass ``axis_name='dp_bn'``). The IPC machinery disappears — ICI collectives
+do the exchange.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+class BatchNorm2d_NHWC(SyncBatchNorm):
+    """NHWC group batch norm (reference groupbn/batch_norm.py:225).
+
+    ``fuse_relu`` and the additive ``z`` input mirror the reference's
+    bn_add_relu path. ``bn_group`` > 1 maps to syncing over ``axis_name``.
+    """
+
+    fuse_relu: bool = False
+    bn_group: int = 1
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: Optional[bool] = None):
+        axis = self.axis_name if self.bn_group != 1 else None
+        # Re-dispatch through SyncBatchNorm with group-limited axis.
+        return SyncBatchNorm(
+            use_running_average=self.use_running_average,
+            axis_name=axis, momentum=self.momentum, epsilon=self.epsilon,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            use_bias=self.use_bias, use_scale=self.use_scale,
+            fuse_relu=self.fuse_relu, name="bn")(
+                x, use_running_average=use_running_average, z=z)

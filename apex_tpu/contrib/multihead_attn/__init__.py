@@ -1,0 +1,6 @@
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import (  # noqa: F401
+    SelfMultiheadAttn,
+)
+from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import (  # noqa: F401
+    EncdecMultiheadAttn,
+)

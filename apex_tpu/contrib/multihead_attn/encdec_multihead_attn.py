@@ -1,0 +1,81 @@
+"""Encoder-decoder multi-head attention.
+
+Parity: reference apex/contrib/multihead_attn/encdec_multihead_attn.py —
+Q from the decoder stream, fused KV projection from the encoder stream.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.fmha import flash_attention
+from apex_tpu.normalization import FusedLayerNorm
+
+
+class EncdecMultiheadAttn(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, value=None, key_padding_mask=None,
+                 need_weights=False, attn_mask=None, is_training=True):
+        h = self.embed_dim
+        nh = self.num_heads
+        hd = h // nh
+        sq, b, _ = query.shape
+        sk = key.shape[0]
+
+        residual = query
+        if self.include_norm_add:
+            query = FusedLayerNorm(normalized_shape=h, param_dtype=jnp.float32,
+                                   name="lyr_norm")(query.astype(jnp.float32)
+                                                    ).astype(query.dtype)
+
+        q_w = self.param("q_weight", nn.initializers.xavier_uniform(),
+                         (h, h), self.param_dtype)
+        kv_w = self.param("kv_weight", nn.initializers.xavier_uniform(),
+                          (h, 2 * h), self.param_dtype)
+        q = query @ q_w
+        kv = key @ kv_w
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        def to_heads(x, s):
+            return x.reshape(s, b, nh, hd).transpose(1, 2, 0, 3)
+
+        qh = to_heads(q, sq)
+        kh = to_heads(k, sk)
+        vh = to_heads(v, sk)
+        scale = 1.0 / (hd ** 0.5)
+
+        if attn_mask is None and key_padding_mask is None and sq == sk:
+            ctx = flash_attention(qh, kh, vh, False, scale)
+        else:
+            scores = jnp.einsum("bnqd,bnkd->bnqk", qh.astype(jnp.float32),
+                                kh.astype(jnp.float32)) * scale
+            if attn_mask is not None:
+                scores = jnp.where(attn_mask.astype(bool), -10000.0, scores)
+            if key_padding_mask is not None:
+                scores = jnp.where(
+                    key_padding_mask[:, None, None, :].astype(bool),
+                    -10000.0, scores)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bnqk,bnkd->bnqd", probs,
+                             vh.astype(jnp.float32)).astype(query.dtype)
+
+        out = ctx.transpose(2, 0, 1, 3).reshape(sq, b, h)
+        out_w = self.param("out_proj_weight", nn.initializers.xavier_uniform(),
+                           (h, h), self.param_dtype)
+        out = out @ out_w
+        if self.bias:
+            out = out + self.param("out_proj_bias", nn.initializers.zeros,
+                                   (h,), self.param_dtype)
+        if self.include_norm_add:
+            out = out + residual
+        return (out, None) if need_weights else out

@@ -1,0 +1,142 @@
+"""DistributedFusedAdam — ZeRO-sharded Adam over the data-parallel axis.
+
+Parity: reference apex/contrib/optimizers/distributed_fused_adam.py
+(2,075 LoC): parameters/grads flattened into fragments+buckets sharded
+across the process group, overlapped reduce-scatter grad sync, param
+all-gather, fp32 master shards.
+
+TPU design: the bucket machinery collapses to three collectives inside one
+jitted step:
+  1. flatten grads -> ``lax.psum_scatter`` over 'dp' (the overlapped
+     reduce-scatter),
+  2. fused Adam update on the local fp32 master/moment shard (1/dp of the
+     state per device — the ZeRO memory saving),
+  3. ``lax.all_gather`` of the updated shard back to full params.
+XLA's latency-hiding scheduler overlaps (1) with the tail of the backward
+when the whole train step is one jit.
+
+Must run inside shard_map with the 'dp' axis bound; falls back to
+single-device (no collectives) when the axis is absent.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
+
+
+def _flat_size(params):
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))
+
+
+def _flatten_f32(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
+
+
+def _unflatten_like(flat, params):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    outs, off = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape))
+        outs.append(flat[off:off + n].reshape(l.shape).astype(l.dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+class DistributedFusedAdam:
+    """Args mirror the reference's core knobs (distributed_fused_adam.py:147):
+    lr, bias_correction, betas, eps, weight_decay, adam_w_mode,
+    grad_sync_dtype (bucket dtype), process-group options map to
+    ``axis_name``."""
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, adam_w_mode=True, weight_decay=0.0,
+                 axis_name: str = "dp", grad_sync_dtype=None,
+                 store_params=False, store_param_remainders=False):
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.axis_name = axis_name
+        self.grad_sync_dtype = grad_sync_dtype
+
+    def _shard_info(self, params):
+        n = _flat_size(params)
+        world = _axis_size(self.axis_name)
+        padded = ((n + world - 1) // world) * world
+        return n, padded, world
+
+    def init(self, params):
+        """State: local fp32 master/moment shards of size padded/world."""
+        n, padded, world = self._shard_info(params)
+        flat = _flatten_f32(params)
+        flat = jnp.pad(flat, (0, padded - n))
+        if world > 1:
+            rank = lax.axis_index(self.axis_name)
+            shard = lax.dynamic_slice_in_dim(flat, rank * (padded // world),
+                                             padded // world)
+        else:
+            shard = flat
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "master_shard": shard,
+            "exp_avg_shard": jnp.zeros_like(shard),
+            "exp_avg_sq_shard": jnp.zeros_like(shard),
+        }
+
+    def step(self, grads, state, params, *, lr: Optional[float] = None,
+             found_inf=None, scale: float = 1.0):
+        lr = self.lr if lr is None else lr
+        n, padded, world = self._shard_info(params)
+        noop = (jnp.zeros((), jnp.float32) if found_inf is None
+                else jnp.asarray(found_inf, jnp.float32))
+
+        flat_g = _flatten_f32(grads) / scale
+        flat_g = jnp.pad(flat_g, (0, padded - n))
+        if world > 1:
+            # overlapped reduce-scatter grad sync (reference hook pipeline)
+            g_shard = lax.psum_scatter(flat_g, self.axis_name, tiled=True)
+            g_shard = g_shard / world  # gradient averaging
+        else:
+            g_shard = flat_g
+
+        step = state["step"] + jnp.where(noop > 0, 0, 1).astype(jnp.int32)
+        b1, b2 = self.betas
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step
+            bc2 = 1.0 - b2 ** step
+        else:
+            bc1 = bc2 = 1.0
+        p = state["master_shard"]
+        if self.adam_w_mode == 0 or not self.adam_w_mode:
+            g_shard = g_shard + self.weight_decay * p
+        m = b1 * state["exp_avg_shard"] + (1 - b1) * g_shard
+        v = b2 * state["exp_avg_sq_shard"] + (1 - b2) * jnp.square(g_shard)
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.adam_w_mode and self.weight_decay != 0:
+            update = update + self.weight_decay * p
+        p_new = p - lr * update
+
+        keep = noop > 0
+        p_new = jnp.where(keep, p, p_new)
+        m = jnp.where(keep, state["exp_avg_shard"], m)
+        v = jnp.where(keep, state["exp_avg_sq_shard"], v)
+
+        if world > 1:
+            flat_p = lax.all_gather(p_new, self.axis_name, tiled=True)
+        else:
+            flat_p = p_new
+        new_params = _unflatten_like(flat_p[:n], params)
+        return new_params, {
+            "step": step,
+            "master_shard": p_new,
+            "exp_avg_shard": m,
+            "exp_avg_sq_shard": v,
+        }

@@ -1,0 +1,173 @@
+"""DistributedFusedLAMB — ZeRO-sharded LAMB over the data-parallel axis.
+
+Parity: reference apex/contrib/optimizers/distributed_fused_lamb.py
+(1,061 LoC): allreduce-hook-driven flat buffers, fused L2 norms,
+clip-after-allreduce, per-layer trust ratios on sharded state.
+
+TPU design: like :class:`DistributedFusedAdam` (reduce-scatter ->
+shard update -> all-gather) plus LAMB's per-*tensor* norms, computed on
+the flat shards with a static segment-id map and completed with one psum:
+``segment_sum(local shard) -> psum over dp -> full per-tensor norms``.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    _flat_size,
+    _flatten_f32,
+    _unflatten_like,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
+
+
+class DistributedFusedLAMB:
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-6, weight_decay=0.01, max_grad_norm=1.0,
+                 adam_w_mode=True, grad_averaging=True, use_nvlamb=False,
+                 clip_after_ar=True, axis_name: str = "dp"):
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.max_grad_norm = max_grad_norm
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.use_nvlamb = use_nvlamb
+        self.clip_after_ar = clip_after_ar
+        self.axis_name = axis_name
+
+    def _layout(self, params):
+        leaves = jax.tree_util.tree_leaves(params)
+        sizes = [int(np.prod(l.shape)) for l in leaves]
+        n = sum(sizes)
+        world = _axis_size(self.axis_name)
+        padded = ((n + world - 1) // world) * world
+        # static segment ids over the padded flat vector (pad -> segment T)
+        seg = np.repeat(np.arange(len(sizes)), sizes)
+        seg = np.concatenate([seg, np.full(padded - n, len(sizes))])
+        return n, padded, world, len(sizes), seg
+
+    def _shard_segments(self, seg, padded, world):
+        return seg.reshape(world, padded // world)
+
+    def init(self, params):
+        n, padded, world, T, seg = self._layout(params)
+        flat = jnp.pad(_flatten_f32(params), (0, padded - n))
+        if world > 1:
+            rank = lax.axis_index(self.axis_name)
+            shard = lax.dynamic_slice_in_dim(flat, rank * (padded // world),
+                                             padded // world)
+        else:
+            shard = flat
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "master_shard": shard,
+            "exp_avg_shard": jnp.zeros_like(shard),
+            "exp_avg_sq_shard": jnp.zeros_like(shard),
+        }
+
+    def _per_tensor_sq(self, x_shard, seg_shards, world, T):
+        """Per-tensor sum-of-squares from a local flat shard + psum."""
+        if world > 1:
+            rank = lax.axis_index(self.axis_name)
+            seg_local = jnp.asarray(seg_shards)[rank]
+        else:
+            seg_local = jnp.asarray(seg_shards).reshape(-1)
+        partial = jax.ops.segment_sum(jnp.square(x_shard), seg_local,
+                                      num_segments=T + 1)
+        if world > 1:
+            partial = lax.psum(partial, self.axis_name)
+        return partial[:T]
+
+    def step(self, grads, state, params, *, lr: Optional[float] = None,
+             found_inf=None, scale: float = 1.0):
+        lr = self.lr if lr is None else lr
+        n, padded, world, T, seg = self._layout(params)
+        seg_shards = self._shard_segments(seg, padded, world)
+        noop = (jnp.zeros((), jnp.float32) if found_inf is None
+                else jnp.asarray(found_inf, jnp.float32))
+
+        flat_g = _flatten_f32(grads) / scale
+        flat_g = jnp.pad(flat_g, (0, padded - n))
+        if world > 1:
+            g_shard = lax.psum_scatter(flat_g, self.axis_name, tiled=True)
+            if self.grad_averaging:
+                g_shard = g_shard / world
+        else:
+            g_shard = flat_g
+
+        # global grad norm + clipping (reference: fused L2 norm then
+        # clip-after-allreduce)
+        gsq = jnp.sum(jnp.square(g_shard))
+        if world > 1:
+            gsq = lax.psum(gsq, self.axis_name)
+        gnorm = jnp.sqrt(gsq)
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            clip = jnp.maximum(gnorm / self.max_grad_norm, 1.0)
+        else:
+            clip = jnp.asarray(1.0, jnp.float32)
+        g_shard = g_shard / clip
+
+        step = state["step"] + jnp.where(noop > 0, 0, 1).astype(jnp.int32)
+        b1, b2 = self.betas
+        beta3 = (1 - b1) if self.grad_averaging else 1.0
+        bc1 = 1.0 - b1 ** step if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** step if self.bias_correction else 1.0
+
+        p = state["master_shard"]
+        if not self.adam_w_mode and self.weight_decay != 0:
+            g_shard = g_shard + self.weight_decay * p
+        m = b1 * state["exp_avg_shard"] + beta3 * g_shard
+        v = b2 * state["exp_avg_sq_shard"] + (1 - b2) * jnp.square(g_shard)
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.adam_w_mode and self.weight_decay != 0:
+            update = update + self.weight_decay * p
+
+        # per-tensor trust ratios from sharded norms
+        w_sq = self._per_tensor_sq(p, seg_shards, world, T)
+        u_sq = self._per_tensor_sq(update, seg_shards, world, T)
+        w_norm = jnp.sqrt(w_sq)
+        u_norm = jnp.sqrt(u_sq)
+        apply_trust = (self.weight_decay != 0) or self.use_nvlamb
+        if apply_trust:
+            ratio_t = jnp.where((w_norm > 0) & (u_norm > 0),
+                                w_norm / u_norm, 1.0)
+        else:
+            ratio_t = jnp.ones((T,), jnp.float32)
+        if world > 1:
+            rank = lax.axis_index(self.axis_name)
+            seg_local = jnp.asarray(seg_shards)[rank]
+        else:
+            seg_local = jnp.asarray(seg_shards).reshape(-1)
+        ratio = jnp.concatenate([ratio_t, jnp.ones((1,), jnp.float32)])[seg_local]
+
+        p_new = p - lr * ratio * update
+        keep = noop > 0
+        p_new = jnp.where(keep, p, p_new)
+        m = jnp.where(keep, state["exp_avg_shard"], m)
+        v = jnp.where(keep, state["exp_avg_sq_shard"], v)
+
+        if world > 1:
+            flat_p = lax.all_gather(p_new, self.axis_name, tiled=True)
+        else:
+            flat_p = p_new
+        new_params = _unflatten_like(flat_p[:n], params)
+        return new_params, {
+            "step": step,
+            "master_shard": p_new,
+            "exp_avg_shard": m,
+            "exp_avg_sq_shard": v,
+        }
+
+    # reference-API hooks kept for drop-in use
+    def set_global_scale(self, global_scale):
+        self._global_scale = global_scale
+
+    def complete_reductions(self):
+        """No-op: reductions are part of the jitted step on TPU."""

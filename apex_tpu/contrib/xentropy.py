@@ -28,8 +28,8 @@ def softmax_cross_entropy_loss(logits, labels, smoothing=0.0,
     if padding_idx is not None:
         loss = jnp.where(labels == padding_idx, 0.0, loss)
     if half_to_float:
-        return loss
-    return loss.astype(logits.dtype) if logits.dtype == jnp.float32 else loss
+        return loss  # fp32 regardless of input dtype
+    return loss.astype(logits.dtype)
 
 
 class SoftmaxCrossEntropyLoss:

@@ -1,0 +1,73 @@
+"""Fused MLP.
+
+Parity: reference apex/mlp (mlp.py:33-86 ``MLP`` + csrc/mlp_cuda.cu 1,678
+LoC): a stack of Linear(+bias)+activation layers executed as one fused
+kernel chain (cuBLAS GEMMs with fused bias/activation epilogues).
+
+TPU design: the whole chain inside one jit — XLA fuses bias+activation
+into the matmul epilogue on the MXU, which is exactly what mlp_cuda hand
+-codes. Supports activation in {none, relu, sigmoid} like the reference.
+"""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_ACTS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_function(bias: bool, activation: str, x, *weights_and_biases):
+    """Functional fused MLP (parity: mlp.py MlpFunction.apply).
+
+    ``weights_and_biases``: w0, w1, ... then (if bias) b0, b1, ...
+    Weights are [out, in] like the reference.
+    """
+    act = _ACTS[activation]
+    n = len(weights_and_biases) // 2 if bias else len(weights_and_biases)
+    ws = weights_and_biases[:n]
+    bs = weights_and_biases[n:] if bias else [None] * n
+    h = x
+    for w, b in zip(ws, bs):
+        h = jnp.matmul(h, w.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        if b is not None:
+            h = h + b
+        h = act(h)
+    return h
+
+
+class MLP(nn.Module):
+    """Module parity with reference ``MLP(mlp_sizes, bias, relu/sigmoid)``
+    (mlp.py:33): ``mlp_sizes`` includes the input size.
+    """
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.activation not in _ACTS:
+            raise TypeError(f"activation must be relu or none or sigmoid, "
+                            f"got {self.activation}")
+        h = x
+        for i in range(len(self.mlp_sizes) - 1):
+            in_f, out_f = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            w = self.param(f"weight_{i}",
+                           nn.initializers.uniform(scale=2.0 / (in_f + out_f)),
+                           (out_f, in_f), self.param_dtype)
+            h = jnp.matmul(h, w.T, preferred_element_type=jnp.float32
+                           ).astype(x.dtype)
+            if self.bias:
+                b = self.param(f"bias_{i}",
+                               nn.initializers.uniform(scale=1.0 / in_f),
+                               (out_f,), self.param_dtype)
+                h = h + b
+            h = _ACTS[self.activation](h)
+        return h

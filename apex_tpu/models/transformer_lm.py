@@ -105,13 +105,19 @@ class ParallelAttention(nn.Module):
             scores = jnp.einsum("bnsd,bntd->bnst", qt, kt,
                                 preferred_element_type=jnp.float32)
             scores = scores / jnp.sqrt(kv).astype(jnp.float32)
-            softmax = FusedScaleMaskSoftmax(
-                input_in_fp16=False,
-                input_in_bf16=(cfg.compute_dtype == jnp.bfloat16),
-                attn_mask_type=cfg.attn_mask_type,
-                scaled_masked_softmax_fusion=True,
-                mask_func=_attn_mask_fn, softmax_in_fp32=True, scale=None)
-            probs = softmax(scores.astype(cfg.compute_dtype), attention_mask)
+            from apex_tpu.transformer.functional.fused_softmax import (
+                scaled_masked_softmax,
+                scaled_upper_triang_masked_softmax,
+            )
+
+            if (cfg.attn_mask_type == AttnMaskType.causal
+                    and attention_mask is None):
+                bsz, nh, sq, sk = scores.shape
+                probs = scaled_upper_triang_masked_softmax(
+                    scores.reshape(bsz * nh, sq, sk), 1.0
+                ).reshape(bsz, nh, sq, sk)
+            else:
+                probs = scaled_masked_softmax(scores, attention_mask, 1.0)
             ctx = jnp.einsum("bnst,bntd->bnsd", probs.astype(cfg.compute_dtype), vt,
                              preferred_element_type=jnp.float32)
             ctx = ctx.transpose(2, 0, 1, 3)  # [s, b, n, d]

@@ -258,6 +258,7 @@ def multi_tensor_novograd(
     grad_averaging,
     moment_mode,
     norm_type,
+    init_zero=False,
 ):
     """Fused NovoGrad: per-*tensor* second moment (layer-wise ||g||).
 
@@ -265,6 +266,8 @@ def multi_tensor_novograd(
     apex/optimizers/fused_novograd.py:183-198. tensor_lists = [grads, params,
     exp_avgs]; the per-tensor second moments ride in a stacked vector.
     ``moment_mode``: 0 = L2-into-grad before moments, 1 = decoupled wd.
+    ``init_zero``: seed v at 0 (first step uses (1-beta2)*||g||^2) instead
+    of ||g||^2 (reference fused_novograd.py init_zero).
     Returns (new_params, new_m, new_v_vector, noop).
     """
     grads, params, ms, v_vec = tensor_lists[0], tensor_lists[1], tensor_lists[2], tensor_lists[3]
@@ -282,7 +285,8 @@ def multi_tensor_novograd(
         else:  # max-norm
             gnorm_sq = jnp.square(jnp.max(jnp.abs(g32)))
         v_prev = v_vec[i].astype(jnp.float32)
-        v32 = jnp.where(step == 1, gnorm_sq, beta2 * v_prev + (1 - beta2) * gnorm_sq)
+        first_v = (1 - beta2) * gnorm_sq if init_zero else gnorm_sq
+        v32 = jnp.where(step == 1, first_v, beta2 * v_prev + (1 - beta2) * gnorm_sq)
         denom = jnp.sqrt(v32) + eps
         gn = g32 / denom
         if weight_decay != 0 and moment_mode == 0:

@@ -62,7 +62,7 @@ class FusedNovoGrad(FusedOptimizerBase):
             [g_leaves, p_leaves, m_leaves, state["exp_avg_sq"]],
             lr, self.betas[0], self.betas[1], self.eps, step,
             self.bias_correction, self.weight_decay, self.grad_averaging,
-            self.moment_mode, norm_code)
+            self.moment_mode, norm_code, self.init_zero)
         return (
             jax.tree_util.tree_unflatten(treedef, new_p),
             {"step": step,

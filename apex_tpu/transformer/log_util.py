@@ -1,0 +1,29 @@
+"""Transformer logging helpers.
+
+Parity: reference apex/transformer/log_util.py ``get_transformer_logger``
++ ``set_logging_level``, with the rank-aware formatter from
+apex/__init__.py:31-43.
+"""
+
+import logging
+
+from apex_tpu._logging import RankInfoFormatter
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(rank_info)s - %(message)s"
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    name_wo_ext = name.split(".")[0]
+    return logging.getLogger(name_wo_ext)
+
+
+def set_logging_level(verbosity) -> None:
+    """Change logging severity (reference log_util.py set_logging_level)."""
+    from apex_tpu import _logging  # noqa: F401
+
+    logger = logging.getLogger("apex_tpu")
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(RankInfoFormatter(_FORMAT))
+        logger.addHandler(handler)
+    logger.setLevel(verbosity)

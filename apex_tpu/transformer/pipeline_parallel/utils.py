@@ -231,6 +231,10 @@ def get_ltor_masks_and_position_ids(data, eod_token=None,
             same_seg = seg[:, :, None] == seg[:, None, :]
             att_mask = att_mask[None, :, :] & same_seg
             att_mask = att_mask[:, None, :, :]  # [b, 1, s, s]
+        else:
+            att_mask = jnp.broadcast_to(
+                att_mask[None, None, :, :],
+                (micro_batch_size, 1, seq_length, seq_length))
         if reset_position_ids:
             seg_start = jnp.concatenate(
                 [jnp.zeros((micro_batch_size, 1), jnp.int32),

@@ -23,12 +23,24 @@ from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
 
 # -- raw helpers (reference mappings.py:31-138) -----------------------------
 
+def _axis_size(axis_name) -> int:
+    """Size of the axis, or 1 when it is not bound (single-chip eager/jit
+    use outside shard_map — the reference likewise no-ops when the TP group
+    has world size 1, mappings.py:33-36)."""
+    try:
+        return lax.axis_size(axis_name)
+    except Exception:
+        return 1
+
+
 def _reduce(x, axis_name=TENSOR_PARALLEL_AXIS):
+    if _axis_size(axis_name) == 1:
+        return x
     return lax.psum(x, axis_name)
 
 
 def _split(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
-    size = lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     if size == 1:
         return x
     rank = lax.axis_index(axis_name)
@@ -37,14 +49,14 @@ def _split(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
 
 
 def _gather(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
-    size = lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     if size == 1:
         return x
     return lax.all_gather(x, axis_name, axis=dim, tiled=True)
 
 
 def _reduce_scatter(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
-    size = lax.axis_size(axis_name)
+    size = _axis_size(axis_name)
     if size == 1:
         return x
     return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)

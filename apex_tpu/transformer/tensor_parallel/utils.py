@@ -18,7 +18,9 @@ def divide(numerator, denominator):
 
 
 def split_tensor_along_last_dim(tensor, num_partitions, contiguous_split_chunks=False):
-    last_dim_size = divide(tensor.shape[-1], num_partitions)
+    # contiguous_split_chunks is accepted for API parity; jnp.split output
+    # is always contiguous (no torch-style views on TPU).
+    ensure_divisibility(tensor.shape[-1], num_partitions)
     return jnp.split(tensor, num_partitions, axis=-1)
 
 

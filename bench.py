@@ -1,0 +1,82 @@
+"""Benchmark: ResNet-50 training step, amp O2 + FusedAdam, imgs/sec/chip.
+
+This is BASELINE.json's headline metric ("ResNet-50 imgs/sec/chip (amp
+O2+FusedAdam)"). The reference publishes no number (BASELINE.md), so
+``vs_baseline`` is reported as 1.0 by convention until a measured baseline
+lands in BASELINE.json.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from apex_tpu import amp
+    from apex_tpu.models import ResNet50
+    from apex_tpu.optimizers import FusedAdam
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, 224, 224, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
+
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # amp O2: model params bf16 (norm layers fp32), fp32 masters in the
+    # optimizer, dynamic loss scaling.
+    params, opt = amp.initialize(params, FusedAdam(lr=1e-3), opt_level="O2",
+                                 verbosity=0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(
+                jnp.take_along_axis(logp, labels[:, None], axis=-1))
+            return loss, updates["batch_stats"]
+
+        scale = opt_state["scaler"].loss_scale
+        (loss, new_bs), grads = jax.value_and_grad(
+            lambda p: (lambda l, b: (l * scale, b))(*loss_fn(p)),
+            has_aux=True)(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_bs, new_opt_state, loss / scale
+
+    # warmup / compile
+    out = train_step(params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(out)
+    out = train_step(*out[:3], images, labels)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = train_step(*out[:3], images, labels)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_amp_o2_fused_adam_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

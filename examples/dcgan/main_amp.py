@@ -1,0 +1,118 @@
+"""DCGAN with amp multi-loss training.
+
+Parity: reference examples/dcgan/main_amp.py — two models (D, G), three
+losses (``num_losses=3``: D-real, D-fake, G), separate FusedAdam
+optimizers, amp O2 loss scaling per loss id.
+"""
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.models import Discriminator, Generator
+from apex_tpu.optimizers import FusedAdam
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--nz", type=int, default=100)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--opt-level", default="O2")
+    return p.parse_args()
+
+
+def bce_with_logits(logits, targets):
+    x = logits.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(x, 0) - x * targets +
+                    jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+
+def main():
+    args = parse_args()
+    rng = np.random.RandomState(0)
+    netG = Generator()
+    netD = Discriminator()
+
+    z0 = jnp.asarray(rng.randn(args.batch_size, 1, 1, args.nz).astype(np.float32))
+    img0 = jnp.asarray(rng.randn(args.batch_size, 64, 64, 3).astype(np.float32))
+    vG = netG.init(jax.random.PRNGKey(0), z0, train=True)
+    vD = netD.init(jax.random.PRNGKey(1), img0, train=True)
+    pG, bsG = vG["params"], vG.get("batch_stats", {})
+    pD, bsD = vD["params"], vD.get("batch_stats", {})
+
+    # Two models, two optimizers, three loss scalers (reference
+    # main_amp.py: amp.initialize([netD, netG], [optD, optG], num_losses=3).
+    (pD, pG), (optD, optG) = amp.initialize(
+        [pD, pG],
+        [FusedAdam(lr=args.lr, betas=(args.beta1, 0.999)),
+         FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))],
+        opt_level=args.opt_level, num_losses=3, verbosity=0)
+    sD = optD.init(pD)
+    sG = optG.init(pG)
+
+    @jax.jit
+    def train_step(pD, bsD, sD, pG, bsG, sG, real, z):
+        # ---- D step: real + fake losses (loss ids 0, 1)
+        def d_loss(pd):
+            out_real, new_bsD = netD.apply(
+                {"params": pd, "batch_stats": bsD}, real, train=True,
+                mutable=["batch_stats"])
+            fake, new_bsG = netG.apply(
+                {"params": pG, "batch_stats": bsG}, z, train=True,
+                mutable=["batch_stats"])
+            out_fake, new_bsD2 = netD.apply(
+                {"params": pd, "batch_stats": new_bsD["batch_stats"]},
+                jax.lax.stop_gradient(fake), train=True,
+                mutable=["batch_stats"])
+            errD_real = bce_with_logits(out_real, 1.0)
+            errD_fake = bce_with_logits(out_fake, 0.0)
+            return errD_real + errD_fake, (new_bsD2["batch_stats"],
+                                           new_bsG["batch_stats"], fake)
+
+        scaleD = sD["scaler"].loss_scale
+        (lossD, (bsD2, bsG2, fake)), gD = jax.value_and_grad(
+            lambda p: (lambda l, a: (l * scaleD, a))(*d_loss(p)),
+            has_aux=True)(pD)
+        pD2, sD2 = optD.step(gD, sD, pD)
+
+        # ---- G step (loss id 2)
+        def g_loss(pg):
+            fake, new_bsG = netG.apply(
+                {"params": pg, "batch_stats": bsG2}, z, train=True,
+                mutable=["batch_stats"])
+            out, _ = netD.apply({"params": pD2, "batch_stats": bsD2}, fake,
+                                train=True, mutable=["batch_stats"])
+            return bce_with_logits(out, 1.0), new_bsG["batch_stats"]
+
+        scaleG = sG["scaler"].loss_scale
+        (lossG, bsG3), gG = jax.value_and_grad(
+            lambda p: (lambda l, a: (l * scaleG, a))(*g_loss(p)),
+            has_aux=True)(pG)
+        pG2, sG2 = optG.step(gG, sG, pG)
+        return (pD2, bsD2, sD2, pG2, bsG3, sG2,
+                lossD / scaleD, lossG / scaleG)
+
+    t0 = time.time()
+    for step in range(args.steps):
+        real = jnp.asarray(
+            rng.randn(args.batch_size, 64, 64, 3).astype(np.float32))
+        z = jnp.asarray(
+            rng.randn(args.batch_size, 1, 1, args.nz).astype(np.float32))
+        pD, bsD, sD, pG, bsG, sG, lD, lG = train_step(
+            pD, bsD, sD, pG, bsG, sG, real, z)
+        if step % 10 == 0:
+            print(f"step {step} loss_D {float(lD):.4f} loss_G {float(lG):.4f}")
+    jax.block_until_ready(lG)
+    print(f"{args.steps} steps in {time.time()-t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""ZeRO-sharded optimizers vs their unsharded counterparts.
+
+Mirrors reference apex/contrib/test/optimizers/test_dist_adam.py (470 LoC:
+DistributedFusedAdam vs plain Adam step-by-step).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from apex_tpu.testing import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.optimizers import DistributedFusedAdam, DistributedFusedLAMB
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+
+def dp_mesh(n=4):
+    return Mesh(np.asarray(jax.devices()[:n]), ("dp",))
+
+
+def make_params(rng):
+    return {"w": jnp.asarray(rng.randn(4, 6).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(5).astype(np.float32))}
+
+
+class TestDistributedFusedAdam:
+    def test_matches_fused_adam(self, rng):
+        """Sharded Adam over 4 dp ranks == plain Adam on averaged grads
+        (the reference test's oracle)."""
+        mesh = dp_mesh(4)
+        params = make_params(rng)
+        per_rank_grads = [
+            jax.tree_util.tree_map(
+                lambda p: jnp.asarray(rng.randn(*p.shape).astype(np.float32)),
+                params)
+            for _ in range(4)
+        ]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_rank_grads)
+
+        dopt = DistributedFusedAdam(lr=1e-2, weight_decay=0.01)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P("dp")),
+                           out_specs=P())
+        def run(params, grads_stacked):
+            grads = jax.tree_util.tree_map(lambda a: a[0], grads_stacked)
+            state = dopt.init(params)
+            p, state = dopt.step(grads, state, params)
+            p, state = dopt.step(grads, state, p)
+            return p
+
+        out = run(params, stacked)
+
+        ref_opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+        avg_grads = jax.tree_util.tree_map(lambda a: a.mean(0), stacked)
+        rp = params
+        rs = ref_opt.init(params)
+        rp, rs = ref_opt.step(avg_grads, rs, rp)
+        rp, rs = ref_opt.step(avg_grads, rs, rp)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(rp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_single_device_path(self, rng):
+        params = make_params(rng)
+        opt = DistributedFusedAdam(lr=1e-2)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        p, s = opt.step(grads, state, params)
+        ref = FusedAdam(lr=1e-2)
+        rp, _ = ref.step(grads, ref.init(params), params)
+        for a, b in zip(jax.tree_util.tree_leaves(p),
+                        jax.tree_util.tree_leaves(rp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_overflow_skip(self, rng):
+        params = make_params(rng)
+        opt = DistributedFusedAdam(lr=1e-2)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        p, s = opt.step(grads, state, params,
+                        found_inf=jnp.ones((), jnp.float32))
+        for a, b in zip(jax.tree_util.tree_leaves(p),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-7)
+        assert int(s["step"]) == 0
+
+
+class TestDistributedFusedLAMB:
+    def test_matches_fused_lamb(self, rng):
+        mesh = dp_mesh(4)
+        params = make_params(rng)
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(rng.randn(*p.shape).astype(np.float32)),
+            params)
+
+        dopt = DistributedFusedLAMB(lr=1e-2, weight_decay=0.01,
+                                    grad_averaging=False)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=P())
+        def run(params, grads):
+            state = dopt.init(params)
+            # identical grads on every rank; reduce-scatter sums -> x4
+            grads4 = jax.tree_util.tree_map(lambda g: g / 4.0, grads)
+            p, _ = dopt.step(grads4, state, params)
+            return p
+
+        out = run(params, grads)
+
+        ref_opt = FusedLAMB(lr=1e-2, weight_decay=0.01, grad_averaging=False)
+        rp, _ = ref_opt.step(grads, ref_opt.init(params), params)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(rp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
