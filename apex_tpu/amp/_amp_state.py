@@ -32,5 +32,8 @@ def master_params(optimizer):
     import jax
 
     state = getattr(optimizer, "last_state", None)
-    if state is not None and "master" in state.get("inner", {}):
-        yield from jax.tree_util.tree_leaves(state["inner"]["master"])
+    inner = state.get("inner", {}) if state is not None else {}
+    for key in ("amp_master", "master"):
+        if key in inner:
+            yield from jax.tree_util.tree_leaves(inner[key])
+            return

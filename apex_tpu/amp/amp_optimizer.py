@@ -41,9 +41,10 @@ class AmpOptimizer(object):
         inner_state = self.inner.init(params)
         # If the wrapped optimizer maintains its own fp32 masters
         # (e.g. FusedAdam(master_weights=True)), defer to it entirely.
-        self._inner_owns_master = "master" in inner_state
-        if self.master_weights and not self._inner_owns_master:
-            inner_state["master"] = jax.tree_util.tree_map(
+        # Amp-owned masters live under a distinct key so ownership is
+        # derivable from a (possibly checkpoint-restored) state alone.
+        if self.master_weights and "master" not in inner_state:
+            inner_state["amp_master"] = jax.tree_util.tree_map(
                 lambda p: p.astype(jnp.float32), params)
         return {"inner": inner_state, "scaler": self.scaler.init_state()}
 
@@ -55,17 +56,16 @@ class AmpOptimizer(object):
             multi_tensor_scale, jnp.zeros((), jnp.float32), [leaves, leaves], inv)
         grads = jax.tree_util.tree_unflatten(treedef, unscaled)
 
-        inner_owns_master = getattr(self, "_inner_owns_master", False)
-        if (self.master_weights and not inner_owns_master
-                and "master" in state["inner"]):
+        if "amp_master" in state["inner"]:
             # Update runs on fp32 masters; model params are re-cast copies.
-            masters = state["inner"]["master"]
-            inner_wo_master = {k: v for k, v in state["inner"].items() if k != "master"}
+            masters = state["inner"]["amp_master"]
+            inner_wo_master = {k: v for k, v in state["inner"].items()
+                               if k != "amp_master"}
             new_masters, new_inner = self.inner.step(
                 grads, inner_wo_master, masters, lr=lr, found_inf=found_inf)
             new_params = jax.tree_util.tree_map(
                 lambda m, p: m.astype(p.dtype), new_masters, params)
-            new_inner["master"] = new_masters
+            new_inner["amp_master"] = new_masters
         else:
             new_params, new_inner = self.inner.step(
                 grads, state["inner"], params, lr=lr, found_inf=found_inf)

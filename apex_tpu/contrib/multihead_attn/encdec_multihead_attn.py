@@ -44,6 +44,11 @@ class EncdecMultiheadAttn(nn.Module):
                           (h, 2 * h), self.param_dtype)
         q = query @ q_w
         kv = key @ kv_w
+        if self.bias:
+            q = q + self.param("q_bias", nn.initializers.zeros,
+                               (h,), self.param_dtype)
+            kv = kv + self.param("kv_bias", nn.initializers.zeros,
+                                 (2 * h,), self.param_dtype)
         k, v = jnp.split(kv, 2, axis=-1)
 
         def to_heads(x, s):
@@ -54,7 +59,13 @@ class EncdecMultiheadAttn(nn.Module):
         vh = to_heads(v, sk)
         scale = 1.0 / (hd ** 0.5)
 
-        if attn_mask is None and key_padding_mask is None and sq == sk:
+        # flash path has no dropout hook; use the einsum path when
+        # attention dropout is live (reference applies dropout in the
+        # fused attn kernel, encdec_multihead_attn_func.py)
+        use_flash = (attn_mask is None and key_padding_mask is None
+                     and sq == sk
+                     and not (self.dropout > 0 and is_training))
+        if use_flash:
             ctx = flash_attention(qh, kh, vh, False, scale)
         else:
             scores = jnp.einsum("bnqd,bnkd->bnqk", qh.astype(jnp.float32),
@@ -66,6 +77,9 @@ class EncdecMultiheadAttn(nn.Module):
                     key_padding_mask[:, None, None, :].astype(bool),
                     -10000.0, scores)
             probs = jax.nn.softmax(scores, axis=-1)
+            if self.dropout > 0 and is_training:
+                probs = nn.Dropout(self.dropout,
+                                   deterministic=not is_training)(probs)
             ctx = jnp.einsum("bnqk,bnkd->bnqd", probs,
                              vh.astype(jnp.float32)).astype(query.dtype)
 

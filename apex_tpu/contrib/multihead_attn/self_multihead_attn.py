@@ -17,7 +17,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.contrib.fmha import _attention_reference, flash_attention
+from apex_tpu.contrib.fmha import flash_attention
 from apex_tpu.normalization import FusedLayerNorm
 
 
@@ -56,6 +56,13 @@ class SelfMultiheadAttn(nn.Module):
             v_w = self.param("v_weight", nn.initializers.xavier_uniform(),
                              (cfg_h, cfg_h), self.param_dtype)
             q, k, v = query @ q_w, query @ k_w, query @ v_w
+            if self.bias:
+                q = q + self.param("q_bias", nn.initializers.zeros,
+                                   (cfg_h,), self.param_dtype)
+                k = k + self.param("k_bias", nn.initializers.zeros,
+                                   (cfg_h,), self.param_dtype)
+                v = v + self.param("v_bias", nn.initializers.zeros,
+                                   (cfg_h,), self.param_dtype)
         else:
             qkv_w = self.param("qkv_weight", nn.initializers.xavier_uniform(),
                                (cfg_h, 3 * cfg_h), self.param_dtype)
@@ -72,7 +79,12 @@ class SelfMultiheadAttn(nn.Module):
         qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
         scale = 1.0 / (hd ** 0.5)
 
-        if attn_mask is None and key_padding_mask is None and self.impl == "fast":
+        # flash path has no dropout hook; route through the einsum path
+        # whenever attention dropout must actually be applied
+        use_flash = (attn_mask is None and key_padding_mask is None
+                     and self.impl == "fast"
+                     and not (self.dropout > 0 and is_training))
+        if use_flash:
             ctx = flash_attention(qh, kh, vh, False, scale)
         else:
             scores = jnp.einsum("bnqd,bnkd->bnqk",

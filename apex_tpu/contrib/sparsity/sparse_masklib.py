@@ -29,6 +29,8 @@ def m4n2_2d_best(weights2d):
     orientation with larger retained magnitude (a vectorized stand-in for
     the reference's exhaustive permutation search)."""
     row_mask = m4n2_1d(weights2d)
+    if weights2d.shape[0] % 4 != 0:
+        return row_mask  # column orientation unavailable for this shape
     col_mask = m4n2_1d(weights2d.T).T
     row_score = jnp.sum(jnp.abs(weights2d) * row_mask)
     col_score = jnp.sum(jnp.abs(weights2d) * col_mask)

@@ -59,14 +59,17 @@ class MLP(nn.Module):
         h = x
         for i in range(len(self.mlp_sizes) - 1):
             in_f, out_f = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            # zero-mean normal, std matching reference mlp.py:71-79
             w = self.param(f"weight_{i}",
-                           nn.initializers.uniform(scale=2.0 / (in_f + out_f)),
+                           nn.initializers.normal(
+                               stddev=(2.0 / (in_f + out_f)) ** 0.5),
                            (out_f, in_f), self.param_dtype)
             h = jnp.matmul(h, w.T, preferred_element_type=jnp.float32
                            ).astype(x.dtype)
             if self.bias:
                 b = self.param(f"bias_{i}",
-                               nn.initializers.uniform(scale=1.0 / in_f),
+                               nn.initializers.normal(
+                                   stddev=(1.0 / out_f) ** 0.5),
                                (out_f,), self.param_dtype)
                 h = h + b
             h = _ACTS[self.activation](h)

@@ -211,3 +211,18 @@ class ParallelTransformer(nn.Module):
             hidden_states = layer(cfg, name=f"layer_{i}")(
                 hidden_states, attention_mask)
         return hidden_states
+
+
+def is_sequence_parallel_param(path: str) -> bool:
+    """Path predicate for ``allreduce_sequence_parallel_grads`` on this
+    model family: layernorm scales/biases, position embeddings, and the
+    replicated biases of the row-parallel linears ('dense', 'dense_4h_to_h')
+    are seq-partial under sequence parallelism. Column-parallel biases
+    ('query_key_value', 'dense_h_to_4h') are per-rank shards with complete
+    grads and must NOT be reduced."""
+    if "layernorm" in path or "position_embeddings" in path:
+        return True
+    if path.endswith("bias"):
+        parent = path.rsplit("/", 1)[0].rsplit("/", 1)[-1]
+        return parent in ("dense", "dense_4h_to_h")
+    return False

@@ -1,0 +1,36 @@
+"""Context (sequence) parallelism for long sequences.
+
+The reference has NO ring-attention/context-parallel support (SURVEY.md
+§2.3 note: its only long-context mechanism is Megatron SP + activation
+checkpointing). This package is the TPU-first long-context capability the
+framework treats as first-class:
+
+- :mod:`ring_attention` — ring self-attention over a ``cp`` mesh axis:
+  K/V blocks rotate around the ring via ``lax.ppermute`` while each device
+  keeps its Q shard, with online-softmax accumulation (blockwise attention,
+  arXiv 2310.01889 "Ring Attention with Blockwise Transformers").
+- :mod:`ulysses` — DeepSpeed-Ulysses-style all-to-all sequence
+  parallelism (arXiv 2309.14509): heads scatter / sequence gathers on
+  entry, inverse on exit, full attention runs locally on 1/cp of heads.
+
+Both compose with the tp/dp/pp axes from
+``parallel_state.initialize_model_parallel(context_parallel_size_=...)``.
+"""
+
+from apex_tpu.transformer.context_parallel.ring_attention import (
+    ring_attention,
+    ring_self_attention,
+)
+from apex_tpu.transformer.context_parallel.ulysses import (
+    ulysses_attention,
+    all_to_all_heads_to_seq,
+    all_to_all_seq_to_heads,
+)
+
+__all__ = [
+    "ring_attention",
+    "ring_self_attention",
+    "ulysses_attention",
+    "all_to_all_heads_to_seq",
+    "all_to_all_seq_to_heads",
+]

@@ -1,0 +1,130 @@
+"""Ring attention over a context-parallel mesh axis.
+
+No reference equivalent (the reference's long-context story is Megatron SP
+only — SURVEY.md §5 "Long-context"); this is the TPU-native capability:
+sequence sharded over the ``cp`` axis, K/V blocks rotating by
+``lax.ppermute`` while a blockwise online-softmax accumulates the local
+Q-shard's output (arXiv 2310.01889). Communication rides ICI and overlaps
+with the per-block attention matmuls thanks to XLA's latency-hiding
+scheduler; each step's FLOPs are one [s_q, d] x [d, s_kv] and one
+[s_q, s_kv] x [s_kv, d] MXU matmul.
+
+Memory: the ring body is wrapped in ``jax.checkpoint`` so autodiff
+recomputes per-step attention instead of stashing every rotated K/V block
+— per-device activation memory stays O(s_local^2 / cp) per step.
+
+Causal masking is applied per ring step from global positions (shards are
+laid out contiguously in ring-rank order): the diagonal block gets the
+triangular mask, fully-future blocks mask to -inf. Every step still runs
+both matmuls — uniform shapes keep the scan body a single fused XLA
+computation; masked-out FLOPs are the price of static control flow.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import CONTEXT_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
+
+
+def _block_attention(q, k, v, m_prev, l_prev, o_prev, scale, mask):
+    """One blockwise-attention accumulation step (online softmax).
+
+    q: [s_q, h, d]; k, v: [s_kv, h, d]; mask: [s_q, s_kv] additive or None.
+    m/l: [h, s_q] running max / normalizer; o: [s_q, h, d] unnormalized.
+    """
+    # scores: [h, s_q, s_kv]
+    scores = jnp.einsum("qhd,khd->hqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = scores + mask[None, :, :]
+    m_cur = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (m_new = -inf): keep them neutral
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe[:, :, None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("hqk,khd->qhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o_new = alpha.transpose(1, 0)[:, :, None] * o_prev + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, *, causal=False, axis_name=CONTEXT_PARALLEL_AXIS,
+                   scale=None):
+    """Ring self-attention on sequence shards.
+
+    Args:
+      q, k, v: [s_local, num_heads, head_dim] — this device's sequence
+        shard (call inside ``shard_map`` with the sequence dim split over
+        ``axis_name``). A leading batch dim is supported via vmap in
+        :func:`ring_self_attention`.
+      causal: apply a causal mask consistent with the *global* sequence
+        (shards are assumed laid out contiguously in ring-rank order).
+      axis_name: the context-parallel mesh axis.
+      scale: softmax scale; default 1/sqrt(head_dim).
+
+    Returns [s_local, num_heads, head_dim] attention output for the local
+    Q shard, numerically identical (up to fp assoc.) to full attention on
+    the gathered sequence.
+    """
+    s_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    cp = _axis_size(axis_name)
+
+    if cp == 1:
+        mask = None
+        if causal:
+            mask = jnp.where(
+                jnp.arange(s_local)[:, None] >= jnp.arange(s_local)[None, :],
+                0.0, -jnp.inf)
+        m0 = jnp.full((h, s_local), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((h, s_local), jnp.float32)
+        o0 = jnp.zeros((s_local, h, d), jnp.float32)
+        m, l, o = _block_attention(q, k, v, m0, l0, o0, scale, mask)
+        return (o / jnp.maximum(l, 1e-30).transpose(1, 0)[:, :, None]).astype(q.dtype)
+
+    rank = lax.axis_index(axis_name)
+    # send each device's K/V to its ring successor every step
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    q_pos = rank * s_local + jnp.arange(s_local)
+
+    @functools.partial(jax.checkpoint,
+                       policy=jax.checkpoint_policies.nothing_saveable)
+    def body(carry, step):
+        m_prev, l_prev, o_prev, k_cur, v_cur = carry
+        # K/V block currently held arrived from rank - step (mod cp)
+        kv_rank = (rank - step) % cp
+        kv_pos = kv_rank * s_local + jnp.arange(s_local)
+        if causal:
+            mask = jnp.where(q_pos[:, None] >= kv_pos[None, :], 0.0, -jnp.inf)
+        else:
+            mask = None
+        m_new, l_new, o_new = _block_attention(
+            q, k_cur, v_cur, m_prev, l_prev, o_prev, scale, mask)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, o_new, k_nxt, v_nxt), None
+
+    m0 = jnp.full((h, s_local), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h, s_local), jnp.float32)
+    o0 = jnp.zeros((s_local, h, d), jnp.float32)
+    (m, l, o, _, _), _ = lax.scan(
+        body, (m0, l0, o0, k, v), jnp.arange(cp))
+    out = o / jnp.maximum(l, 1e-30).transpose(1, 0)[:, :, None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, *, causal=False,
+                        axis_name=CONTEXT_PARALLEL_AXIS, scale=None):
+    """Batched ring attention: q/k/v [batch, s_local, heads, head_dim]."""
+    fn = functools.partial(ring_attention, causal=causal,
+                           axis_name=axis_name, scale=scale)
+    return jax.vmap(fn)(q, k, v)

@@ -1,0 +1,84 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+No reference equivalent (SURVEY.md §5: the reference's only sequence
+mechanism is Megatron SP). DeepSpeed-Ulysses (arXiv 2309.14509) pattern,
+TPU-native: activations are sequence-sharded over the ``cp`` mesh axis;
+on attention entry an ``lax.all_to_all`` redistributes so each device
+holds the FULL sequence for ``heads/cp`` heads, full attention (any
+kernel — here jnp, optionally flash) runs locally, and the inverse
+all_to_all restores sequence sharding. Two all-to-alls per attention vs
+ring's cp permutes; cheaper when heads >= cp and the sequence fits.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import CONTEXT_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
+
+
+def all_to_all_seq_to_heads(x, axis_name=CONTEXT_PARALLEL_AXIS):
+    """[s/cp, h, d] (seq-sharded) -> [s, h/cp, d] (head-sharded)."""
+    if _axis_size(axis_name) == 1:
+        return x
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                          tiled=True)
+
+
+def all_to_all_heads_to_seq(x, axis_name=CONTEXT_PARALLEL_AXIS):
+    """[s, h/cp, d] (head-sharded) -> [s/cp, h, d] (seq-sharded)."""
+    if _axis_size(axis_name) == 1:
+        return x
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+
+
+def _full_attention(q, k, v, causal, scale):
+    """Plain full attention, [s, h, d] -> [s, h, d] (fp32 softmax)."""
+    s = q.shape[0]
+    scores = jnp.einsum("qhd,khd->hqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.where(
+            jnp.arange(s)[:, None] >= jnp.arange(s)[None, :], 0.0, -jnp.inf)
+        scores = scores + mask[None]
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, causal=False,
+                      axis_name=CONTEXT_PARALLEL_AXIS, scale=None,
+                      attention_fn=None):
+    """Sequence-parallel attention via head/sequence all-to-all.
+
+    Args:
+      q, k, v: [s_local, num_heads, head_dim] sequence shards (inside
+        ``shard_map`` with seq split over ``axis_name``); num_heads must
+        be divisible by the axis size.
+      attention_fn: optional ``f(q, k, v, causal, scale) -> out`` applied
+        on the gathered-[s, h/cp, d] views (e.g. a Pallas flash kernel);
+        defaults to fused jnp full attention.
+
+    Returns [s_local, num_heads, head_dim].
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    cp = _axis_size(axis_name)
+    if cp > 1 and q.shape[1] % cp != 0:
+        raise ValueError(
+            f"num_heads ({q.shape[1]}) not divisible by cp axis size ({cp})")
+    fn = attention_fn or _full_attention
+    qh = all_to_all_seq_to_heads(q, axis_name)
+    kh = all_to_all_seq_to_heads(k, axis_name)
+    vh = all_to_all_seq_to_heads(v, axis_name)
+    out = fn(qh, kh, vh, causal, scale)
+    return all_to_all_heads_to_seq(out, axis_name)
+
+
+def ulysses_self_attention(q, k, v, **kw):
+    """Batched variant: [batch, s_local, heads, head_dim]."""
+    return jax.vmap(functools.partial(ulysses_attention, **kw))(q, k, v)

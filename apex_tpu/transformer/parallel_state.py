@@ -28,8 +28,10 @@ from jax.sharding import Mesh
 DATA_PARALLEL_AXIS = "dp"
 TENSOR_PARALLEL_AXIS = "tp"
 PIPELINE_PARALLEL_AXIS = "pp"
+CONTEXT_PARALLEL_AXIS = "cp"  # long-context axis; no reference equivalent
 
 _MESH: Optional[Mesh] = None
+_CONTEXT_PARALLEL_WORLD_SIZE: Optional[int] = None
 _TENSOR_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 _PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 _DATA_PARALLEL_WORLD_SIZE: Optional[int] = None
@@ -46,6 +48,7 @@ def initialize_model_parallel(
     pipeline_model_parallel_size_: int = 1,
     virtual_pipeline_model_parallel_size_: Optional[int] = None,
     pipeline_model_parallel_split_rank_: Optional[int] = None,
+    context_parallel_size_: int = 1,
     *,
     devices=None,
     default_backend: Optional[str] = None,
@@ -61,7 +64,7 @@ def initialize_model_parallel(
     global _PIPELINE_MODEL_PARALLEL_WORLD_SIZE, _DATA_PARALLEL_WORLD_SIZE
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
-    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK, _CONTEXT_PARALLEL_WORLD_SIZE
 
     if devices is None:
         devices = jax.devices()
@@ -69,11 +72,13 @@ def initialize_model_parallel(
     world_size = devices.size
     tp = tensor_model_parallel_size_
     pp = pipeline_model_parallel_size_
-    if world_size % (tp * pp) != 0:
+    cp = context_parallel_size_
+    if world_size % (tp * pp * cp) != 0:
         raise RuntimeError(
             f"world_size ({world_size}) is not divisible by "
-            f"tensor_model_parallel_size ({tp}) x pipeline_model_parallel_size ({pp})")
-    dp = world_size // (tp * pp)
+            f"tensor_model_parallel_size ({tp}) x pipeline_model_parallel_size ({pp})"
+            f" x context_parallel_size ({cp})")
+    dp = world_size // (tp * pp * cp)
 
     if virtual_pipeline_model_parallel_size_ is not None:
         if pp < 2:
@@ -88,12 +93,23 @@ def initialize_model_parallel(
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
 
-    mesh_devices = devices.reshape(pp, dp, tp)
-    _MESH = Mesh(mesh_devices, (PIPELINE_PARALLEL_AXIS, DATA_PARALLEL_AXIS,
-                                TENSOR_PARALLEL_AXIS))
+    if cp > 1:
+        # cp sits between dp and tp: sequence blocks ring on fast links,
+        # tp innermost still owns the fastest ICI hops.
+        mesh_devices = devices.reshape(pp, dp, cp, tp)
+        _MESH = Mesh(mesh_devices, (PIPELINE_PARALLEL_AXIS,
+                                    DATA_PARALLEL_AXIS,
+                                    CONTEXT_PARALLEL_AXIS,
+                                    TENSOR_PARALLEL_AXIS))
+    else:
+        mesh_devices = devices.reshape(pp, dp, tp)
+        _MESH = Mesh(mesh_devices, (PIPELINE_PARALLEL_AXIS,
+                                    DATA_PARALLEL_AXIS,
+                                    TENSOR_PARALLEL_AXIS))
     _TENSOR_MODEL_PARALLEL_WORLD_SIZE = tp
     _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = pp
     _DATA_PARALLEL_WORLD_SIZE = dp
+    _CONTEXT_PARALLEL_WORLD_SIZE = cp
     return _MESH
 
 
@@ -114,10 +130,12 @@ def destroy_model_parallel():
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK, _EXPLICIT_TP_RANK, _EXPLICIT_PP_RANK
+    global _CONTEXT_PARALLEL_WORLD_SIZE
     _MESH = None
     _TENSOR_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _DATA_PARALLEL_WORLD_SIZE = None
+    _CONTEXT_PARALLEL_WORLD_SIZE = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
@@ -145,6 +163,16 @@ def get_data_parallel_world_size() -> int:
     if _DATA_PARALLEL_WORLD_SIZE is None:
         return 1
     return _DATA_PARALLEL_WORLD_SIZE
+
+
+def get_context_parallel_world_size() -> int:
+    if _CONTEXT_PARALLEL_WORLD_SIZE is None:
+        return 1
+    return _CONTEXT_PARALLEL_WORLD_SIZE
+
+
+def get_context_parallel_rank():
+    return _axis_rank(CONTEXT_PARALLEL_AXIS, None)
 
 
 def get_model_parallel_world_size() -> int:

@@ -245,3 +245,30 @@ class VocabParallelEmbedding(nn.Module):
         else:
             out = weight[input_]
         return out
+
+
+# -- sequence-parallel gradient sync ----------------------------------------
+# The reference tags tp-replicated params with ``sequence_parallel_enabled``
+# and allreduces their grads over the TP group (layers.py sequence_parallel
+# attr + transformer/layers/layer_norm.py:26-99). JAX param pytrees carry no
+# attributes, so the tagging is a path predicate: True for params whose
+# forward consumed only the local sequence shard (layernorms, position
+# embeddings, row-parallel biases added after the reduce-scatter) and whose
+# grads are therefore partial sums over the tp axis.
+
+def allreduce_sequence_parallel_grads(grads, is_sequence_parallel_param,
+                                      axis_name=TENSOR_PARALLEL_AXIS):
+    """psum the grads of seq-partial params over the tp axis.
+
+    ``is_sequence_parallel_param(path: str) -> bool`` receives the
+    '/'-joined param path. Call inside shard_map when
+    ``sequence_parallel_enabled`` models train with tp > 1.
+    """
+
+    def fix(path, g):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if is_sequence_parallel_param(name):
+            return lax.psum(g, axis_name)
+        return g
+
+    return jax.tree_util.tree_map_with_path(fix, grads)

@@ -66,6 +66,32 @@ def synthetic_batches(global_batch, steps, seed=0):
         yield imgs, labels
 
 
+def imagefolder_batches(root, global_batch, seed=0):
+    """Minimal ImageFolder loader (reference uses
+    torchvision.datasets.ImageFolder, main_amp.py:205-214)."""
+    try:
+        from torchvision import datasets, transforms
+        import torch
+    except ImportError as e:
+        raise SystemExit(
+            f"--data requires torchvision for ImageFolder loading ({e}); "
+            "omit the data argument to run on synthetic batches") from e
+    tfm = transforms.Compose([
+        transforms.RandomResizedCrop(224),
+        transforms.RandomHorizontalFlip(),
+        transforms.PILToTensor(),
+    ])
+    ds = datasets.ImageFolder(os.path.join(root, "train"), tfm)
+    g = torch.Generator().manual_seed(seed)
+    loader = torch.utils.data.DataLoader(
+        ds, batch_size=global_batch, shuffle=True, drop_last=True,
+        num_workers=4, generator=g)
+    for imgs, labels in loader:
+        # NCHW uint8 -> NHWC uint8
+        yield (imgs.permute(0, 2, 3, 1).contiguous().numpy(),
+               labels.numpy().astype(np.int32))
+
+
 def main():
     args = parse_args()
     devices = jax.devices()
@@ -148,12 +174,22 @@ def main():
     for epoch in range(start_epoch, args.epochs):
         t0 = time.time()
         seen = 0
-        for step, (imgs, labels) in enumerate(
-                synthetic_batches(args.batch_size, args.steps_per_epoch,
-                                  seed=epoch)):
+        if args.data:
+            batches = imagefolder_batches(args.data, args.batch_size,
+                                          seed=epoch)
+        else:
+            batches = synthetic_batches(args.batch_size,
+                                        args.steps_per_epoch, seed=epoch)
+        for step, (imgs, labels) in enumerate(batches):
+            if args.prof and epoch == start_epoch and step == 1:
+                jax.profiler.start_trace("/tmp/apex_tpu_trace")
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, jnp.asarray(imgs),
                 jnp.asarray(labels))
+            if args.prof and epoch == start_epoch and step == 10:
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                print("profiler trace written to /tmp/apex_tpu_trace")
             seen += args.batch_size
             if step % args.print_freq == 0:
                 jax.block_until_ready(loss)

@@ -1,0 +1,139 @@
+"""Sequence-parallel gradient sync vs a tp=1 oracle.
+
+Verifies ``allreduce_sequence_parallel_grads`` + the model path predicate:
+under SP, row-parallel output biases (added after the reduce-scatter) have
+seq-partial grads that need the tp psum, while column-parallel biases are
+per-rank shards whose grads are already complete and must NOT be touched
+(reference: sequence_parallel_enabled tagging, apex/transformer/layers/
+layer_norm.py:26-99 and tensor_parallel/layers.py).
+"""
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    allreduce_sequence_parallel_grads,
+)
+from apex_tpu.models.transformer_lm import is_sequence_parallel_param
+
+H, FFN, S, B = 8, 16, 8, 2
+
+
+class TinyParallelMLP(nn.Module):
+    sequence_parallel: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = ColumnParallelLinear(
+            H, FFN, bias=True, gather_output=False,
+            sequence_parallel_enabled=self.sequence_parallel,
+            name="dense_h_to_4h")(x)
+        x = jax.nn.gelu(x)
+        x = RowParallelLinear(
+            FFN, H, bias=True, input_is_parallel=True,
+            sequence_parallel_enabled=self.sequence_parallel,
+            name="dense_4h_to_h")(x)
+        return x
+
+
+@pytest.fixture
+def tp2_mesh():
+    return Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+
+
+def test_sp_grads_match_tp1_oracle(tp2_mesh, rng):
+    x = jnp.asarray(rng.randn(S, B, H).astype(np.float32))
+    w = jnp.asarray(rng.randn(S, B, H).astype(np.float32))
+
+    # ---- tp=1 oracle -------------------------------------------------
+    parallel_state.destroy_model_parallel()
+    model1 = TinyParallelMLP(sequence_parallel=False)
+    params1 = model1.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss1(p):
+        return jnp.sum(model1.apply({"params": p}, x) * w)
+
+    g_ref = jax.grad(loss1)(params1)
+
+    # ---- tp=2 + SP ---------------------------------------------------
+    parallel_state.set_tensor_model_parallel_world_size(2)
+    model2 = TinyParallelMLP(sequence_parallel=True)
+
+    def shard(params1, rank):
+        col_k = params1["dense_h_to_4h"]["weight"]  # [H, FFN] -> [H, FFN/2]
+        col_b = params1["dense_h_to_4h"]["bias"]
+        row_k = params1["dense_4h_to_h"]["weight"]  # [FFN, H] -> [FFN/2, H]
+        row_b = params1["dense_4h_to_h"]["bias"]    # replicated
+        f = FFN // 2
+        return {
+            "dense_h_to_4h": {"weight": col_k[:, rank * f:(rank + 1) * f],
+                              "bias": col_b[rank * f:(rank + 1) * f]},
+            "dense_4h_to_h": {"weight": row_k[rank * f:(rank + 1) * f],
+                              "bias": row_b},
+        }
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), shard(params1, 0), shard(params1, 1))
+
+    @functools.partial(jax.shard_map, mesh=tp2_mesh,
+                       in_specs=(P("tp"), P("tp"), P("tp")),
+                       out_specs=P("tp"), check_vma=False)
+    def grads_sp(stacked_params, x_shard, w_shard):
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+
+        def loss(p):
+            # local summand only: cross-rank terms reach this rank's param
+            # grads through the collectives' transposes (a psum here would
+            # double-seed the replicated loss)
+            out = model2.apply({"params": p}, x_shard)  # [S/2, B, H]
+            return jnp.sum(out * w_shard)
+
+        g = jax.grad(loss)(params)
+        g = allreduce_sequence_parallel_grads(g, is_sequence_parallel_param)
+        return jax.tree_util.tree_map(lambda a: a[None], g)
+
+    g2 = grads_sp(stacked, x, w)
+
+    # column shards must equal the oracle slices (NOT summed over tp)
+    f = FFN // 2
+    for r in range(2):
+        np.testing.assert_allclose(
+            np.asarray(g2["dense_h_to_4h"]["bias"][r]),
+            np.asarray(g_ref["dense_h_to_4h"]["bias"][r * f:(r + 1) * f]),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(g2["dense_h_to_4h"]["weight"][r]),
+            np.asarray(g_ref["dense_h_to_4h"]["weight"][:, r * f:(r + 1) * f]),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(g2["dense_4h_to_h"]["weight"][r]),
+            np.asarray(g_ref["dense_4h_to_h"]["weight"][r * f:(r + 1) * f]),
+            rtol=1e-4, atol=1e-4)
+        # row bias is replicated: after the SP psum each rank holds the
+        # full grad
+        np.testing.assert_allclose(
+            np.asarray(g2["dense_4h_to_h"]["bias"][r]),
+            np.asarray(g_ref["dense_4h_to_h"]["bias"]),
+            rtol=1e-4, atol=1e-4)
+
+
+def test_predicate_classification():
+    assert is_sequence_parallel_param("layers_0/input_layernorm/scale")
+    assert is_sequence_parallel_param("position_embeddings/weight")
+    assert is_sequence_parallel_param("layers_0/attention/dense/bias")
+    assert is_sequence_parallel_param("layers_0/mlp/dense_4h_to_h/bias")
+    assert not is_sequence_parallel_param(
+        "layers_0/attention/query_key_value/bias")
+    assert not is_sequence_parallel_param("layers_0/mlp/dense_h_to_4h/bias")
+    assert not is_sequence_parallel_param("layers_0/mlp/dense_4h_to_h/kernel")
