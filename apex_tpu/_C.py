@@ -1,0 +1,75 @@
+"""Loader for the native runtime extension with numpy fallbacks.
+
+Mirrors the reference's lazy-import pattern for its C++ extensions (each
+Python module imports its kernel lib and degrades to a Python path when
+absent, e.g. apex/parallel/distributed.py:15-25 for apex_C.flatten).
+
+``HAVE_NATIVE`` tells callers whether apex_tpu_C is loaded. All four
+entry points below work identically either way:
+
+    flatten(arrays, out)        -> bytes copied
+    unflatten_into(flat, outs)  -> bytes copied
+    assign_buckets(sizes, cap)  -> list[int] bucket ids (greedy, in order)
+    pack_batch(samples, out)    -> batch size
+"""
+
+import numpy as np
+
+try:
+    import apex_tpu_C as _ext
+
+    HAVE_NATIVE = True
+except ImportError:  # Python-only build (APEX_TPU_NO_EXT=1)
+    _ext = None
+    HAVE_NATIVE = False
+
+
+def flatten(arrays, out):
+    if _ext is not None:
+        return _ext.flatten(arrays, out)
+    off = 0
+    flat = out.reshape(-1).view(np.uint8)
+    for a in arrays:
+        b = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+        flat[off:off + b.size] = b
+        off += b.size
+    return off
+
+
+def unflatten_into(flat, outs):
+    if _ext is not None:
+        return _ext.unflatten_into(flat, outs)
+    src = flat.reshape(-1).view(np.uint8)
+    off = 0
+    for o in outs:
+        n = o.nbytes
+        o.reshape(-1).view(np.uint8)[:] = src[off:off + n]
+        off += n
+    return off
+
+
+def assign_buckets(sizes, cap):
+    if _ext is not None:
+        return _ext.assign_buckets(list(sizes), int(cap))
+    if cap <= 0:
+        raise ValueError("assign_buckets: cap must be positive")
+    out, acc, bucket, empty = [], 0, 0, True
+    for sz in sizes:
+        if not empty and acc + sz > cap:
+            bucket += 1
+            acc = 0
+            empty = True
+        acc += sz
+        empty = False
+        out.append(bucket)
+    return out
+
+
+def pack_batch(samples, out):
+    if _ext is not None:
+        return _ext.pack_batch(samples, out)
+    if len(samples) == 0:
+        raise ValueError("pack_batch: empty sample list")
+    batch = np.stack([np.asarray(s) for s in samples])
+    out.reshape(-1).view(np.uint8)[:] = batch.reshape(-1).view(np.uint8)
+    return len(samples)

@@ -1,0 +1,3 @@
+from apex_tpu.data.loader import PrefetchLoader  # noqa: F401
+
+__all__ = ["PrefetchLoader"]

@@ -1,0 +1,120 @@
+"""Background-prefetching host data loader.
+
+Parity: the reference's ``data_prefetcher`` (examples/imagenet/
+main_amp.py:256-290) overlaps H2D copies with compute on a side CUDA
+stream. The TPU equivalent overlaps *host-side batch assembly +
+device transfer* with the device step: a worker thread assembles batches
+(native parallel gather via apex_tpu_C.pack_batch when built) and calls
+``jax.device_put`` ahead of consumption, keeping a bounded queue of
+in-flight batches so the accelerator never waits on the host.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+from apex_tpu import _C
+
+
+class PrefetchLoader:
+    """Wrap a sample iterable into an iterator of device-ready batches.
+
+    Args:
+      samples: iterable yielding per-sample pytrees of equally-shaped
+        numpy arrays (or (x, y) tuples of arrays).
+      batch_size: batch size to assemble.
+      prefetch: max number of assembled batches in flight.
+      device_put: optional callable applied to each assembled batch on the
+        worker thread (e.g. ``jax.device_put`` or a sharding-aware
+        ``functools.partial(jax.device_put, device=sharding)``).
+      drop_last: drop the trailing partial batch.
+    """
+
+    def __init__(self, samples, batch_size, *, prefetch=2, device_put=None,
+                 drop_last=True):
+        self.samples = samples
+        self.batch_size = int(batch_size)
+        self.prefetch = int(prefetch)
+        self.device_put = device_put
+        self.drop_last = drop_last
+
+    def _assemble(self, group):
+        first = group[0]
+        if isinstance(first, tuple):
+            cols = tuple(
+                self._assemble([g[i] for g in group])
+                for i in range(len(first)))
+            return cols
+        raw = [np.asarray(g) for g in group]
+        for a in raw[1:]:
+            # byte count alone can't distinguish e.g. (480,640) from
+            # (640,480); the native pack only checks bytes
+            if a.shape != raw[0].shape or a.dtype != raw[0].dtype:
+                raise ValueError(
+                    f"PrefetchLoader: sample shape/dtype mismatch "
+                    f"({a.shape} {a.dtype} vs {raw[0].shape} {raw[0].dtype})")
+        # note: ascontiguousarray promotes 0-d scalars to (1,); the batch
+        # shape comes from the pre-promotion sample shape
+        out = np.empty((len(raw),) + raw[0].shape, raw[0].dtype)
+        _C.pack_batch([np.ascontiguousarray(a) for a in raw], out)
+        return out
+
+    def __iter__(self):
+        q = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+        halt = threading.Event()  # consumer gone: worker must exit
+        err = []
+
+        def put(item):
+            """Blocking put that aborts when the consumer stopped early."""
+            while not halt.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                group = []
+                for s in self.samples:
+                    group.append(s)
+                    if len(group) == self.batch_size:
+                        batch = self._assemble(group)
+                        if self.device_put is not None:
+                            batch = self.device_put(batch)
+                        if not put(batch):
+                            return
+                        group = []
+                if group and not self.drop_last:
+                    batch = self._assemble(group)
+                    if self.device_put is not None:
+                        batch = self.device_put(batch)
+                    if not put(batch):
+                        return
+            except BaseException as e:  # surface worker errors to consumer
+                err.append(e)
+            finally:
+                put(stop)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # early break / exception in the consumer: release the worker
+            halt.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)

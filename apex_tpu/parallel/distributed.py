@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu import _C
+
 
 def flatten(tensors):
     """Coalesce a list of arrays into one flat fp32-width buffer
@@ -40,25 +42,78 @@ def unflatten(flat, tensors):
     return outs
 
 
+def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
+                      gradient_predivide_factor):
+    """The DDP reduction policy (reference distributed.py:429-479
+    ``allreduce_bucket``): optional fp32 comm dtype, predivide before /
+    postdivide after the psum, cast back to the original dtype."""
+    orig_dtype = g.dtype
+    if allreduce_always_fp32:
+        g = g.astype(jnp.float32)
+    if gradient_predivide_factor != 1.0:
+        g = g / gradient_predivide_factor
+    g = lax.psum(g, axis_name)
+    if gradient_average:
+        n = lax.axis_size(axis_name)
+        g = g / (n / gradient_predivide_factor)
+    return g.astype(orig_dtype)
+
+
 def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
                          gradient_average=True, gradient_predivide_factor=1.0):
-    """Allreduce a grad pytree over a mesh axis (the DDP hot path,
-    reference distributed.py:429-479 ``allreduce_bucket``)."""
-    def reduce_one(g):
-        orig_dtype = g.dtype
-        if allreduce_always_fp32:
-            g = g.astype(jnp.float32)
-        if gradient_predivide_factor != 1.0:
-            g = g / gradient_predivide_factor
-        g = lax.psum(g, axis_name)
-        if gradient_average:
-            n = lax.axis_size(axis_name)
-            g = g / (n / gradient_predivide_factor)
-        if allreduce_always_fp32:
-            g = g.astype(orig_dtype)
-        return g
+    """Allreduce a grad pytree over a mesh axis (the DDP hot path)."""
+    return jax.tree_util.tree_map(
+        lambda g: _psum_with_policy(g, axis_name, allreduce_always_fp32,
+                                    gradient_average,
+                                    gradient_predivide_factor), grads)
 
-    return jax.tree_util.tree_map(reduce_one, grads)
+
+def plan_buckets(leaves, message_size=10000000):
+    """Host-side bucket planning (reference distributed.py:287-320
+    ``sync_bucket_structure``): group the flat leaf list into
+    dtype-segregated, in-order buckets capped at ``message_size`` elements.
+    Planning runs in the native runtime (apex_tpu_C.assign_buckets).
+
+    Returns a list of buckets, each a list of leaf indices.
+    """
+    by_dtype = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype).name, []).append(i)
+    buckets = []
+    for idxs in by_dtype.values():
+        sizes = [int(leaves[i].size) for i in idxs]
+        ids = _C.assign_buckets(sizes, message_size)
+        cur, cur_id = [], 0
+        for i, b in zip(idxs, ids):
+            if b != cur_id:
+                buckets.append(cur)
+                cur, cur_id = [], b
+            cur.append(i)
+        if cur:
+            buckets.append(cur)
+    return buckets
+
+
+def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
+                                  message_size=10000000,
+                                  allreduce_always_fp32=False,
+                                  gradient_average=True,
+                                  gradient_predivide_factor=1.0):
+    """Bucketed DDP allreduce: flatten same-dtype runs of leaves into
+    ``message_size``-element buckets and psum each bucket as ONE collective
+    (reference allreduce_bucket over apex_C-flattened buffers,
+    distributed.py:429-479). Fewer, larger ICI collectives than the
+    per-leaf path; use inside a jitted step."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out = [None] * len(leaves)
+    for bucket in plan_buckets(leaves, message_size):
+        flat = flatten([leaves[i] for i in bucket])
+        flat = _psum_with_policy(flat, axis_name, allreduce_always_fp32,
+                                 gradient_average, gradient_predivide_factor)
+        for i, piece in zip(bucket, unflatten(flat,
+                                              [leaves[i] for i in bucket])):
+            out[i] = piece
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def broadcast_params(params, axis_name="dp"):
