@@ -24,11 +24,24 @@ except ImportError:  # Python-only build (APEX_TPU_NO_EXT=1)
     HAVE_NATIVE = False
 
 
+def _require_contiguous(a, what):
+    """The native path rejects non-C-contiguous buffers via the buffer
+    protocol; the fallback must match (reshape(-1) on a non-contiguous
+    array would copy, silently dropping the writes)."""
+    if not a.flags["C_CONTIGUOUS"]:
+        raise ValueError(f"{what}: ndarray is not C-contiguous")
+    return a
+
+
 def flatten(arrays, out):
     if _ext is not None:
         return _ext.flatten(arrays, out)
     off = 0
-    flat = out.reshape(-1).view(np.uint8)
+    flat = _require_contiguous(out, "flatten").reshape(-1).view(np.uint8)
+    total = sum(np.asarray(a).nbytes for a in arrays)
+    if total > out.nbytes:
+        raise ValueError(
+            f"flatten: output buffer too small ({out.nbytes} < {total} bytes)")
     for a in arrays:
         b = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
         flat[off:off + b.size] = b
@@ -39,10 +52,16 @@ def flatten(arrays, out):
 def unflatten_into(flat, outs):
     if _ext is not None:
         return _ext.unflatten_into(flat, outs)
-    src = flat.reshape(-1).view(np.uint8)
+    src = np.ascontiguousarray(flat).reshape(-1).view(np.uint8)
+    total = sum(o.nbytes for o in outs)
+    if total > flat.nbytes:
+        raise ValueError(
+            f"unflatten_into: flat buffer too small ({flat.nbytes} < "
+            f"{total} bytes)")
     off = 0
     for o in outs:
         n = o.nbytes
+        _require_contiguous(o, "unflatten_into")
         o.reshape(-1).view(np.uint8)[:] = src[off:off + n]
         off += n
     return off
@@ -70,6 +89,15 @@ def pack_batch(samples, out):
         return _ext.pack_batch(samples, out)
     if len(samples) == 0:
         raise ValueError("pack_batch: empty sample list")
-    batch = np.stack([np.asarray(s) for s in samples])
+    arrays = [np.asarray(s) for s in samples]
+    item = arrays[0].nbytes
+    if any(a.nbytes != item for a in arrays):
+        raise ValueError("pack_batch: samples must be equally sized")
+    if out.nbytes != item * len(arrays):
+        raise ValueError(
+            f"pack_batch: out must be batch*sample bytes ({out.nbytes} != "
+            f"{len(arrays)}*{item})")
+    batch = np.stack(arrays)
+    _require_contiguous(out, "pack_batch")
     out.reshape(-1).view(np.uint8)[:] = batch.reshape(-1).view(np.uint8)
     return len(samples)

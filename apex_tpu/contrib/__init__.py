@@ -7,9 +7,12 @@ importable, with Pallas kernels engaging on TPU backends.
 
 from apex_tpu.contrib import bottleneck  # noqa: F401
 from apex_tpu.contrib import clip_grad  # noqa: F401
+from apex_tpu.contrib import conv_bias_relu  # noqa: F401
+from apex_tpu.contrib import cudnn_gbn  # noqa: F401
 from apex_tpu.contrib import fmha  # noqa: F401
 from apex_tpu.contrib import focal_loss  # noqa: F401
 from apex_tpu.contrib import groupbn  # noqa: F401
+from apex_tpu.contrib import layer_norm  # noqa: F401
 from apex_tpu.contrib import index_mul_2d  # noqa: F401
 from apex_tpu.contrib import multihead_attn  # noqa: F401
 from apex_tpu.contrib import optimizers  # noqa: F401

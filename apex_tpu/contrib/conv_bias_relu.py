@@ -1,0 +1,58 @@
+"""Fused Conv+Bias[+ReLU/+Mask] ops.
+
+Parity: reference apex/contrib/conv_bias_relu/conv_bias_relu.py (81 LoC +
+csrc/conv_bias_relu.cpp 1,639 LoC of cuDNN-frontend fusion): four NHWC
+ops — ConvBiasReLU, ConvBias, ConvBiasMaskReLU, ConvFrozenScaleBiasReLU —
+each a conv2d with epilogue fused into one kernel.
+
+TPU design: ``lax.conv_general_dilated`` in NHWC with the epilogue
+expressed inline; XLA fuses bias/scale/relu/mask into the convolution the
+same way the cuDNN runtime-fusion engine does, and the MXU executes the
+conv. Weights are OHWI ([out, kh, kw, in]) to match NHWC activations.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+_DN = ("NHWC", "OHWI", "NHWC")
+
+
+def _conv(x, weight, padding, stride):
+    pad = ((padding, padding), (padding, padding))
+    return lax.conv_general_dilated(
+        x, weight, window_strides=(stride, stride), padding=pad,
+        dimension_numbers=_DN, preferred_element_type=jnp.float32)
+
+
+def conv_bias_relu(x, weight, bias, padding, stride):
+    """ReLU(conv(x, w) + b) (reference ConvBiasReLU)."""
+    out = _conv(x, weight, padding, stride) + bias.astype(jnp.float32)
+    return jnp.maximum(out, 0.0).astype(x.dtype)
+
+
+def conv_bias(x, weight, bias, padding, stride):
+    """conv(x, w) + b (reference ConvBias)."""
+    out = _conv(x, weight, padding, stride) + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def conv_bias_mask_relu(x, weight, bias, mask, padding, stride):
+    """ReLU((conv(x, w) + b) * mask) (reference ConvBiasMaskReLU)."""
+    out = _conv(x, weight, padding, stride) + bias.astype(jnp.float32)
+    out = out * mask.astype(jnp.float32)
+    return jnp.maximum(out, 0.0).astype(x.dtype)
+
+
+def conv_frozen_scale_bias_relu(x, weight, scale, bias, padding, stride):
+    """ReLU(conv(x, w) * scale + b) — frozen-BN folding
+    (reference ConvFrozenScaleBiasReLU)."""
+    out = _conv(x, weight, padding, stride)
+    out = out * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return jnp.maximum(out, 0.0).astype(x.dtype)
+
+
+# reference exports capitalized autograd-function aliases
+ConvBiasReLU = conv_bias_relu
+ConvBias = conv_bias
+ConvBiasMaskReLU = conv_bias_mask_relu
+ConvFrozenScaleBiasReLU = conv_frozen_scale_bias_relu

@@ -1,0 +1,54 @@
+"""GroupBatchNorm2d — cudnn_gbn-parity entry point.
+
+Parity: reference apex/contrib/cudnn_gbn/batch_norm.py:44-130
+(``GroupBatchNorm2d(num_features, group_size, ...)``: NHWC batch norm
+synchronized within ``group_size``-rank groups via peer-memory IPC +
+cuDNN-frontend kernels).
+
+TPU design: the peer-memory/IPC plumbing disappears — group sync is a
+collective over a mesh sub-axis. Callers lay out the dp axis as
+('dp_outer', 'dp_bn') with ``dp_bn`` of size ``group_size`` and this
+module reduces Welford stats over ``axis_name`` exactly like
+apex_tpu.parallel.SyncBatchNorm (one shared implementation; this class is
+the cudnn_gbn-flavored constructor, like contrib groupbn's
+BatchNorm2d_NHWC is the groupbn-flavored one).
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+class GroupBatchNorm2d(nn.Module):
+    """NHWC group batch norm (reference cudnn_gbn/batch_norm.py:44).
+
+    ``group_size`` is carried for API parity; the actual group is the mesh
+    axis named ``axis_name`` (size must equal group_size when both given).
+    """
+
+    num_features: int
+    group_size: int = 1
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = "dp_bn"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        if x.ndim != 4:
+            raise ValueError(f"expected 4D NHWC input (got {x.ndim}D input)")
+        if x.shape[-1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} channels, got {x.shape[-1]}")
+        axis = self.axis_name if self.group_size != 1 else None
+        # torch-style momentum (weight of the NEW stat) -> flax-style
+        # momentum (weight of the OLD running stat)
+        return SyncBatchNorm(
+            axis_name=axis, momentum=1.0 - self.momentum, epsilon=self.eps,
+            dtype=self.dtype, use_bias=self.affine, use_scale=self.affine,
+            name="bn")(x, use_running_average=use_running_average)

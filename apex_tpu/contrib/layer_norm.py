@@ -1,0 +1,41 @@
+"""FastLayerNorm — the contrib high-performance LayerNorm entry point.
+
+Parity: reference apex/contrib/layer_norm/layer_norm.py:34-56
+(``FastLayerNorm(hidden_size, eps)`` module + ``_fast_layer_norm``
+functional, backed by csrc/layer_norm/ kernels for hidden sizes up to
+64k). On TPU the same Pallas layernorm kernel that serves
+``apex_tpu.normalization.FusedLayerNorm`` is the fast path — there is one
+kernel, exposed under both entry points like the reference wires contrib
+FastLayerNorm into transformer/layers/layer_norm.py:11-16.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
+
+
+def _fast_layer_norm(x, weight, bias, epsilon):
+    """Functional form (reference layer_norm.py:34-37)."""
+    return fused_layer_norm_affine(x, weight, bias, (x.shape[-1],),
+                                   eps=epsilon)
+
+
+class FastLayerNorm(nn.Module):
+    """Module parity with reference FastLayerNorm(hidden_size, eps=1e-5):
+    affine LayerNorm over the last dim; param names match FusedLayerNorm
+    so checkpoints interchange between the two entry points."""
+
+    hidden_size: int
+    eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param("weight", nn.initializers.ones,
+                            (self.hidden_size,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.hidden_size,), self.param_dtype)
+        return _fast_layer_norm(x, weight, bias, self.eps)

@@ -4,3 +4,11 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: F401
 from apex_tpu.contrib.optimizers.distributed_fused_lamb import (  # noqa: F401
     DistributedFusedLAMB,
 )
+# deprecated set (reference apex/contrib/optimizers/: older duplicates kept
+# for backward compatibility; these warn and defer to apex_tpu.optimizers)
+from apex_tpu.contrib.optimizers.fused_adam import FusedAdam  # noqa: F401
+from apex_tpu.contrib.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.contrib.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.contrib.optimizers.fp16_optimizer import (  # noqa: F401
+    FP16_Optimizer,
+)

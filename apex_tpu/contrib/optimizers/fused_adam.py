@@ -1,0 +1,21 @@
+"""Deprecated contrib FusedAdam.
+
+Parity: reference apex/contrib/optimizers/fused_adam.py (206 LoC) — an
+older FusedAdam kept for backward compatibility; the reference's version
+warns and defers behavior to apex.optimizers.FusedAdam. Same here.
+"""
+
+import warnings
+
+from apex_tpu.optimizers.fused_adam import FusedAdam as _FusedAdam
+
+
+class FusedAdam(_FusedAdam):
+    def __init__(self, *args, **kwargs):
+        warnings.warn(
+            "apex_tpu.contrib.optimizers.FusedAdam is deprecated; use "
+            "apex_tpu.optimizers.FusedAdam", DeprecationWarning, stacklevel=2)
+        # old contrib kwarg names accepted and dropped
+        kwargs.pop("use_mt", None)
+        kwargs.pop("amp_scale_adjustment", None)
+        super().__init__(*args, **kwargs)

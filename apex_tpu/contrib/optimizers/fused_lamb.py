@@ -1,0 +1,14 @@
+"""Deprecated contrib FusedLAMB (reference apex/contrib/optimizers/
+fused_lamb.py, 208 LoC). Defers to apex_tpu.optimizers.FusedLAMB."""
+
+import warnings
+
+from apex_tpu.optimizers.fused_lamb import FusedLAMB as _FusedLAMB
+
+
+class FusedLAMB(_FusedLAMB):
+    def __init__(self, *args, **kwargs):
+        warnings.warn(
+            "apex_tpu.contrib.optimizers.FusedLAMB is deprecated; use "
+            "apex_tpu.optimizers.FusedLAMB", DeprecationWarning, stacklevel=2)
+        super().__init__(*args, **kwargs)

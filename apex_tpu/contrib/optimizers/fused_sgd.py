@@ -1,0 +1,14 @@
+"""Deprecated contrib FusedSGD (reference apex/contrib/optimizers/
+fused_sgd.py, 211 LoC). Defers to apex_tpu.optimizers.FusedSGD."""
+
+import warnings
+
+from apex_tpu.optimizers.fused_sgd import FusedSGD as _FusedSGD
+
+
+class FusedSGD(_FusedSGD):
+    def __init__(self, *args, **kwargs):
+        warnings.warn(
+            "apex_tpu.contrib.optimizers.FusedSGD is deprecated; use "
+            "apex_tpu.optimizers.FusedSGD", DeprecationWarning, stacklevel=2)
+        super().__init__(*args, **kwargs)

@@ -16,10 +16,13 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
     Reducer,
     all_reduce_gradients,
+    all_reduce_gradients_bucketed,
     broadcast_params,
     flatten,
+    plan_buckets,
     unflatten,
 )
+from apex_tpu.parallel import multiproc  # noqa: F401
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, sync_batch_norm  # noqa: F401
 from apex_tpu.parallel.LARC import LARC  # noqa: F401
 

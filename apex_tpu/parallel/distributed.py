@@ -158,6 +158,7 @@ class DistributedDataParallel:
                  axis_name: str = "dp"):
         self.module = module
         self.axis_name = axis_name
+        self.message_size = message_size
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
@@ -165,6 +166,15 @@ class DistributedDataParallel:
         self.needs_refresh = True
 
     def sync(self, grads):
+        """Bucketed grad allreduce honoring ``message_size`` (reference
+        create_hooks bucketing); pass ``message_size=None`` at construction
+        for the per-leaf path."""
+        if self.message_size:
+            return all_reduce_gradients_bucketed(
+                grads, self.axis_name, message_size=self.message_size,
+                allreduce_always_fp32=self.allreduce_always_fp32,
+                gradient_average=self.gradient_average,
+                gradient_predivide_factor=self.gradient_predivide_factor)
         return all_reduce_gradients(
             grads, self.axis_name,
             allreduce_always_fp32=self.allreduce_always_fp32,

@@ -1,0 +1,64 @@
+"""Multi-host process launcher.
+
+Parity: reference apex/parallel/multiproc.py (35 LoC): a pre-torchrun
+helper that spawns one training process per GPU with RANK/WORLD_SIZE env.
+
+TPU design: a single process drives all local chips (SPMD), so per-chip
+spawning is unnecessary; the launcher's job is *multi-host* bring-up:
+set the jax.distributed coordinates and exec the training script once per
+host. Usage (one invocation per host, e.g. from your scheduler):
+
+    python -m apex_tpu.parallel.multiproc --nnodes 4 --node_rank $I \
+        --coordinator host0:1234 train.py --arg ...
+"""
+
+import os
+import subprocess
+import sys
+
+
+def initialize_distributed(coordinator=None, num_processes=None,
+                           process_id=None):
+    """Initialize jax.distributed from args or the env this launcher sets
+    (the analog of torch.distributed.init_process_group)."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("APEX_TPU_COORDINATOR")
+    num_processes = num_processes or os.environ.get("APEX_TPU_NUM_PROCESSES")
+    process_id = process_id or os.environ.get("APEX_TPU_PROCESS_ID")
+    if coordinator is None:
+        return  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes),
+        process_id=int(process_id))
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    nnodes, node_rank, coordinator = 1, 0, None
+    while argv and argv[0].startswith("--"):
+        flag = argv.pop(0)
+        if flag == "--nnodes":
+            nnodes = int(argv.pop(0))
+        elif flag == "--node_rank":
+            node_rank = int(argv.pop(0))
+        elif flag == "--coordinator":
+            coordinator = argv.pop(0)
+        else:
+            raise SystemExit(f"unknown flag {flag}")
+    if not argv:
+        raise SystemExit(
+            "usage: multiproc [--nnodes N --node_rank I --coordinator "
+            "host:port] script.py [args...]")
+    env = dict(os.environ)
+    if coordinator is not None:
+        env["APEX_TPU_COORDINATOR"] = coordinator
+        env["APEX_TPU_NUM_PROCESSES"] = str(nnodes)
+        env["APEX_TPU_PROCESS_ID"] = str(node_rank)
+    cmd = [sys.executable] + argv
+    raise SystemExit(subprocess.call(cmd, env=env))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Preallocated memory buffers for activation checkpointing.
+
+Parity: reference apex/transformer/tensor_parallel/memory.py —
+``MemoryBuffer`` (37-133): one preallocated flat tensor handed out as
+zero-copy views; ``RingMemBuffer`` (135-151): a rotating ring of them.
+
+TPU design note: XLA owns device allocation, so these buffers manage
+*host-side* staging storage (numpy) — useful for checkpoint IO and the
+data path. On-device "preallocation" is expressed with buffer donation in
+jit, not with manual pools; the classes keep the reference API for code
+that expects it.
+"""
+
+import numpy as np
+
+
+class MemoryBuffer:
+    """A contiguous preallocated buffer that hands out shaped views
+    (reference memory.py:37-133)."""
+
+    def __init__(self, name, numel, dtype, track_usage=False):
+        self.name = name
+        self.numel = int(numel)
+        self.dtype = np.dtype(dtype)
+        self.data = np.zeros(self.numel, dtype=self.dtype)
+        # usage tracking (reference memory.py:60-70)
+        self.track_usage = track_usage
+        if track_usage:
+            self.in_use_value = 0.0
+            self.total_value = 0.0
+        self._start = 0
+
+    def reset(self):
+        self._start = 0
+
+    def is_in_use(self):
+        return self._start > 0
+
+    def add(self, shape):
+        """Allocate a zero-copy view of ``shape`` (reference ``add``)."""
+        numel = int(np.prod(shape))
+        new_start = self._start + numel
+        if new_start > self.numel:
+            raise MemoryError(
+                f"MemoryBuffer {self.name}: out of space "
+                f"({new_start} > {self.numel} elements)")
+        view = self.data[self._start:new_start].reshape(shape)
+        if self.track_usage:
+            self.in_use_value = float(new_start)
+            self.total_value = max(self.total_value, float(new_start))
+        self._start = new_start
+        return view
+
+    def get_data(self):
+        return self.data
+
+    def print_average_usage(self):
+        if not self.track_usage:
+            return
+        if self.total_value == 0:
+            print(f"> memory buffer {self.name}: unused")
+            return
+        print(f"> memory buffer {self.name}: peak usage "
+              f"{100.0 * self.total_value / self.numel:.1f}%")
+
+
+class RingMemBuffer:
+    """Ring of ``num_buffers`` MemoryBuffers handed out round-robin
+    (reference memory.py:135-151)."""
+
+    def __init__(self, name, num_buffers, numel, dtype, track_usage=False):
+        self.num_buffers = num_buffers
+        self.buffers = [
+            MemoryBuffer(f"{name} {i}", numel, dtype, track_usage)
+            for i in range(num_buffers)]
+        self._index = -1
+
+    def get_next_buffer(self):
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        if buf.is_in_use():
+            raise RuntimeError("buffer is already in use")
+        return buf

@@ -1,0 +1,21 @@
+"""In-package testing harness.
+
+Parity: reference apex/transformer/testing/ — standalone GPT/BERT model
+providers for integration tests, the Megatron-style argument parser,
+process-global state (args/timers/microbatch calculator), and shared
+helpers. The standalone models live in :mod:`apex_tpu.models`; this
+package wires them to the reference harness API.
+"""
+
+from apex_tpu.transformer.testing.arguments import parse_args  # noqa: F401
+from apex_tpu.transformer.testing.global_vars import (  # noqa: F401
+    get_args,
+    get_timers,
+    set_global_variables,
+)
+from apex_tpu.transformer.testing.standalone_gpt import (  # noqa: F401
+    gpt_model_provider,
+)
+from apex_tpu.transformer.testing.standalone_bert import (  # noqa: F401
+    bert_model_provider,
+)

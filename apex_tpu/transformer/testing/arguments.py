@@ -1,0 +1,135 @@
+"""Megatron-style argument parser for the testing harness.
+
+Parity: reference apex/transformer/testing/arguments.py (977 LoC, ~180
+flags). This carries the subset the harness and tests actually consume —
+model geometry, parallelism degrees, batching, precision, checkpointing,
+logging — with the same flag names and defaulting/validation behavior
+(world-size divisibility, global-batch derivation) so Megatron-style
+launch commands work unchanged.
+"""
+
+import argparse
+import os
+
+
+def parse_args(extra_args_provider=None, defaults=None,
+               ignore_unknown_args=True, args=None):
+    """Parse harness arguments (reference arguments.py:parse_args)."""
+    parser = argparse.ArgumentParser(
+        description="apex_tpu testing harness arguments",
+        allow_abbrev=False)
+
+    g = parser.add_argument_group("model")
+    g.add_argument("--num-layers", type=int, default=2)
+    g.add_argument("--hidden-size", type=int, default=64)
+    g.add_argument("--num-attention-heads", type=int, default=4)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--kv-channels", type=int, default=None)
+    g.add_argument("--max-position-embeddings", type=int, default=128)
+    g.add_argument("--seq-length", type=int, default=64)
+    g.add_argument("--vocab-size", type=int, default=1024)
+    g.add_argument("--padded-vocab-size", type=int, default=None)
+    g.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--attention-dropout", type=float, default=0.1)
+
+    g = parser.add_argument_group("parallelism")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--pipeline-model-parallel-split-rank", type=int,
+                   default=None)
+    g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--distributed-backend", default="xla",
+                   choices=["xla", "nccl", "gloo", "ucc"])
+
+    g = parser.add_argument_group("batching")
+    g.add_argument("--micro-batch-size", type=int, default=2)
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs="*", default=None)
+
+    g = parser.add_argument_group("precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None)
+    g.add_argument("--initial-loss-scale", type=float, default=2 ** 32)
+    g.add_argument("--min-loss-scale", type=float, default=1.0)
+    g.add_argument("--loss-scale-window", type=float, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--accumulate-allreduce-grads-in-fp32",
+                   action="store_true")
+    g.add_argument("--params-dtype", default="float32")
+
+    g = parser.add_argument_group("training")
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--train-iters", type=int, default=10)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--init-method-std", type=float, default=0.02)
+    g.add_argument("--optimizer", default="adam",
+                   choices=["adam", "sgd", "lamb"])
+
+    g = parser.add_argument_group("checkpointing")
+    g.add_argument("--save", default=None)
+    g.add_argument("--load", default=None)
+    g.add_argument("--save-interval", type=int, default=None)
+    g.add_argument("--activations-checkpoint-method", default=None,
+                   choices=[None, "uniform", "block"])
+    g.add_argument("--activations-checkpoint-num-layers", type=int,
+                   default=1)
+    g.add_argument("--distribute-saved-activations", action="store_true")
+
+    g = parser.add_argument_group("logging")
+    g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--tensorboard-dir", default=None)
+    g.add_argument("--timing-log-level", type=int, default=0)
+
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if ignore_unknown_args:
+        parsed, _ = parser.parse_known_args(args)
+    else:
+        parsed = parser.parse_args(args)
+
+    if defaults:
+        for k, v in defaults.items():
+            if getattr(parsed, k, None) is None:
+                setattr(parsed, k, v)
+
+    # -- derivations/validation (reference arguments.py validate_args) ----
+    parsed.world_size = int(os.environ.get("WORLD_SIZE", "0")) or None
+    if parsed.world_size is None:
+        import jax
+
+        parsed.world_size = len(jax.devices())
+    mp = (parsed.tensor_model_parallel_size
+          * parsed.pipeline_model_parallel_size
+          * parsed.context_parallel_size)
+    if parsed.world_size % mp != 0:
+        raise ValueError(
+            f"world size ({parsed.world_size}) is not divisible by "
+            f"tp*pp*cp ({mp})")
+    parsed.data_parallel_size = parsed.world_size // mp
+    if parsed.global_batch_size is None:
+        parsed.global_batch_size = (parsed.micro_batch_size
+                                    * parsed.data_parallel_size)
+    if parsed.ffn_hidden_size is None:
+        parsed.ffn_hidden_size = 4 * parsed.hidden_size
+    if parsed.kv_channels is None:
+        parsed.kv_channels = (parsed.hidden_size
+                              // parsed.num_attention_heads)
+    if parsed.padded_vocab_size is None:
+        mult = (parsed.make_vocab_size_divisible_by
+                * parsed.tensor_model_parallel_size)
+        parsed.padded_vocab_size = (
+            (parsed.vocab_size + mult - 1) // mult * mult)
+    if parsed.fp16 and parsed.bf16:
+        raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    if parsed.sequence_parallel and parsed.tensor_model_parallel_size == 1:
+        parsed.sequence_parallel = False
+    return parsed

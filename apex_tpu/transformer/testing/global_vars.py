@@ -1,0 +1,84 @@
+"""Process-global harness state.
+
+Parity: reference apex/transformer/testing/global_vars.py — singletons for
+args, the microbatch calculator, tensorboard writer, autoresume hook, and
+timers, with ensure-initialized/ensure-not-initialized guards.
+"""
+
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.pipeline_parallel._timers import _Timers as Timers
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_ADLR_AUTORESUME = None
+_GLOBAL_TIMERS = None
+
+
+def _ensure_var_is_initialized(var, name):
+    if var is None:
+        raise RuntimeError(f"{name} is not initialized.")
+
+
+def _ensure_var_is_not_initialized(var, name):
+    if var is not None:
+        raise RuntimeError(f"{name} is already initialized.")
+
+
+def get_args():
+    _ensure_var_is_initialized(_GLOBAL_ARGS, "args")
+    return _GLOBAL_ARGS
+
+
+def get_num_microbatches():
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size():
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True):
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples,
+                                               consistency_check)
+
+
+def get_tensorboard_writer():
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    return _GLOBAL_ADLR_AUTORESUME
+
+
+def get_timers():
+    _ensure_var_is_initialized(_GLOBAL_TIMERS, "timers")
+    return _GLOBAL_TIMERS
+
+
+def set_global_variables(args):
+    """Install args + derived singletons (reference set_global_variables)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    _ensure_var_is_not_initialized(_GLOBAL_ARGS, "args")
+    _GLOBAL_ARGS = args
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank=0,
+        rampup_batch_size=getattr(args, "rampup_batch_size", None),
+        global_batch_size=args.global_batch_size,
+        micro_batch_size=args.micro_batch_size,
+        data_parallel_size=args.data_parallel_size,
+    )
+    _GLOBAL_TIMERS = Timers()
+
+
+def destroy_global_vars():
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TIMERS = None

@@ -1,0 +1,34 @@
+"""Standalone BERT for integration tests.
+
+Parity: reference apex/transformer/testing/standalone_bert.py:
+``bert_model_provider(pre_process, post_process, cpu_offload)``. The TPU
+model is :class:`apex_tpu.models.BertModel` (padding-mask attention, MLM +
+NSP heads, vocab-parallel logits).
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.models import BertModel, TransformerConfig
+from apex_tpu.models.bert import bert_loss_fn  # noqa: F401
+from apex_tpu.transformer.enums import AttnMaskType
+
+
+def bert_model_provider(pre_process=True, post_process=True, *,
+                        config=None, num_tokentypes=2, **kwargs):
+    """Build a BERT model from harness args (reference signature parity)."""
+    if config is None:
+        from apex_tpu.transformer.testing.global_vars import get_args
+
+        args = get_args()
+        config = TransformerConfig(
+            hidden_size=args.hidden_size,
+            num_layers=args.num_layers,
+            num_attention_heads=args.num_attention_heads,
+            vocab_size=args.padded_vocab_size or args.vocab_size,
+            max_position_embeddings=args.max_position_embeddings,
+            sequence_parallel=args.sequence_parallel,
+            params_dtype=jnp.float32,
+            compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+            attn_mask_type=AttnMaskType.padding,
+        )
+    return BertModel(config, num_tokentypes=num_tokentypes, **kwargs)

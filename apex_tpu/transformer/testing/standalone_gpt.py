@@ -1,0 +1,35 @@
+"""Standalone GPT for integration tests.
+
+Parity: reference apex/transformer/testing/standalone_gpt.py:
+``gpt_model_provider(pre_process, post_process, cpu_offload)`` returning a
+Megatron GPT built from the parallel transformer stack. The TPU model
+itself is :class:`apex_tpu.models.GPTModel` (tensor/sequence-parallel
+layers over the mesh, vocab-parallel loss).
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.models import GPTModel, TransformerConfig
+from apex_tpu.models.gpt import gpt_loss_fn  # noqa: F401
+
+
+def gpt_model_provider(pre_process=True, post_process=True, *,
+                       config=None, **kwargs):
+    """Build a GPT model from harness args (reference signature parity;
+    pre/post_process select pipeline-stage roles)."""
+    if config is None:
+        from apex_tpu.transformer.testing.global_vars import get_args
+
+        args = get_args()
+        config = TransformerConfig(
+            hidden_size=args.hidden_size,
+            num_layers=args.num_layers,
+            num_attention_heads=args.num_attention_heads,
+            vocab_size=args.padded_vocab_size or args.vocab_size,
+            max_position_embeddings=args.max_position_embeddings,
+            sequence_parallel=args.sequence_parallel,
+            params_dtype=jnp.float32,
+            compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        )
+    return GPTModel(config, pre_process=pre_process,
+                    post_process=post_process, **kwargs)

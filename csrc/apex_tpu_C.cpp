@@ -33,11 +33,6 @@
 
 namespace {
 
-struct BufView {
-  Py_buffer view;
-  bool acquired = false;
-};
-
 /* Acquire C-contiguous buffers for every element of a sequence. Returns
    false (with a Python error set) on failure; releases everything it
    acquired. */
@@ -60,14 +55,19 @@ void release_all(std::vector<Py_buffer> *views) {
   views->clear();
 }
 
-/* Run fn(i) for i in [0, n) on up to `threads` std::threads. */
-void parallel_for(size_t n, unsigned threads,
+/* Below this many total bytes, thread create+join overhead exceeds the
+   memcpy cost; copy serially. */
+constexpr Py_ssize_t kParallelThresholdBytes = 1 << 20;
+
+/* Run fn(i) for i in [0, n) on up to `threads` std::threads; serial when
+   total_bytes is under the threshold. */
+void parallel_for(size_t n, unsigned threads, Py_ssize_t total_bytes,
                   const std::function<void(size_t)> &fn) {
   if (n == 0) return;
   unsigned hw = std::thread::hardware_concurrency();
   unsigned t = std::min<unsigned>(threads ? threads : 1,
                                   std::min<size_t>(hw ? hw : 1, n));
-  if (t <= 1) {
+  if (t <= 1 || total_bytes < kParallelThresholdBytes) {
     for (size_t i = 0; i < n; ++i) fn(i);
     return;
   }
@@ -123,7 +123,7 @@ PyObject *flatten(PyObject *, PyObject *args) {
 
   char *dst = static_cast<char *>(out.buf);
   Py_BEGIN_ALLOW_THREADS
-  parallel_for(srcs.size(), 8, [&](size_t i) {
+  parallel_for(srcs.size(), 8, total, [&](size_t i) {
     std::memcpy(dst + offsets[i], srcs[i].buf,
                 static_cast<size_t>(srcs[i].len));
   });
@@ -175,7 +175,7 @@ PyObject *unflatten_into(PyObject *, PyObject *args) {
 
   const char *src = static_cast<const char *>(flat.buf);
   Py_BEGIN_ALLOW_THREADS
-  parallel_for(dsts.size(), 8, [&](size_t i) {
+  parallel_for(dsts.size(), 8, total, [&](size_t i) {
     std::memcpy(dsts[i].buf, src + offsets[i],
                 static_cast<size_t>(dsts[i].len));
   });
@@ -281,7 +281,7 @@ PyObject *pack_batch(PyObject *, PyObject *args) {
 
   char *dst = static_cast<char *>(out.buf);
   Py_BEGIN_ALLOW_THREADS
-  parallel_for(srcs.size(), 8, [&](size_t i) {
+  parallel_for(srcs.size(), 8, out.len, [&](size_t i) {
     std::memcpy(dst + static_cast<Py_ssize_t>(i) * item, srcs[i].buf,
                 static_cast<size_t>(item));
   });

@@ -1,0 +1,260 @@
+"""Contrib parity tier 3: FastLayerNorm, conv_bias_relu, cudnn_gbn,
+deprecated optimizers, memory buffers, testing harness, multiproc.
+
+Mirrors the reference per-extension numerics pattern
+(apex/contrib/test/<pkg>/test_*.py): each fused entry point vs a plain
+jnp/flax oracle.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.conv_bias_relu import (
+    conv_bias,
+    conv_bias_mask_relu,
+    conv_bias_relu,
+    conv_frozen_scale_bias_relu,
+)
+from apex_tpu.contrib.cudnn_gbn import GroupBatchNorm2d
+from apex_tpu.contrib.layer_norm import FastLayerNorm, _fast_layer_norm
+from apex_tpu.transformer.tensor_parallel.memory import (
+    MemoryBuffer,
+    RingMemBuffer,
+)
+
+
+# -- FastLayerNorm ----------------------------------------------------------
+
+def test_fast_layer_norm_matches_oracle(rng):
+    x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+    w = jnp.asarray(rng.randn(32).astype(np.float32))
+    b = jnp.asarray(rng.randn(32).astype(np.float32))
+    out = _fast_layer_norm(x, w, b, 1e-5)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / jnp.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fast_layer_norm_module_checkpoint_compat(rng):
+    from apex_tpu.normalization import FusedLayerNorm
+
+    x = jnp.asarray(rng.randn(2, 16).astype(np.float32))
+    fast = FastLayerNorm(hidden_size=16)
+    p = fast.init(jax.random.PRNGKey(0), x)
+    # param names interchange with FusedLayerNorm
+    fused = FusedLayerNorm(normalized_shape=16)
+    out_fast = fast.apply(p, x)
+    out_fused = fused.apply(p, x)
+    np.testing.assert_allclose(np.asarray(out_fast), np.asarray(out_fused),
+                               rtol=1e-6, atol=1e-6)
+
+
+# -- conv_bias_relu ---------------------------------------------------------
+
+def _conv_ref(x, w, padding, stride):
+    from jax import lax
+
+    return lax.conv_general_dilated(
+        x.astype(jnp.float32), w.astype(jnp.float32), (stride, stride),
+        ((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "OHWI", "NHWC"))
+
+
+@pytest.fixture
+def conv_inputs(rng):
+    x = jnp.asarray(rng.randn(2, 8, 8, 4).astype(np.float32))
+    w = jnp.asarray(rng.randn(6, 3, 3, 4).astype(np.float32) * 0.1)
+    b = jnp.asarray(rng.randn(6).astype(np.float32))
+    return x, w, b
+
+
+def test_conv_bias_relu(conv_inputs):
+    x, w, b = conv_inputs
+    out = conv_bias_relu(x, w, b, padding=1, stride=1)
+    ref = jnp.maximum(_conv_ref(x, w, 1, 1) + b, 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    assert out.shape == (2, 8, 8, 6)
+
+
+def test_conv_bias_stride2(conv_inputs):
+    x, w, b = conv_inputs
+    out = conv_bias(x, w, b, padding=1, stride=2)
+    ref = _conv_ref(x, w, 1, 2) + b
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    assert out.shape == (2, 4, 4, 6)
+
+
+def test_conv_bias_mask_relu(conv_inputs, rng):
+    x, w, b = conv_inputs
+    mask = jnp.asarray((rng.rand(2, 8, 8, 6) > 0.5).astype(np.float32))
+    out = conv_bias_mask_relu(x, w, b, mask, padding=1, stride=1)
+    ref = jnp.maximum((_conv_ref(x, w, 1, 1) + b) * mask, 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_frozen_scale_bias_relu(conv_inputs, rng):
+    x, w, b = conv_inputs
+    scale = jnp.asarray(rng.rand(6).astype(np.float32) + 0.5)
+    out = conv_frozen_scale_bias_relu(x, w, scale, b, padding=1, stride=1)
+    ref = jnp.maximum(_conv_ref(x, w, 1, 1) * scale + b, 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_bias_relu_bf16_keeps_dtype(conv_inputs):
+    x, w, b = conv_inputs
+    out = conv_bias_relu(x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+                         b, padding=1, stride=1)
+    assert out.dtype == jnp.bfloat16
+
+
+# -- cudnn_gbn --------------------------------------------------------------
+
+def test_group_batch_norm_single_group_matches_flax(rng):
+    import flax.linen as nn
+
+    x = jnp.asarray(rng.randn(4, 6, 6, 8).astype(np.float32))
+    gbn = GroupBatchNorm2d(num_features=8, group_size=1)
+    vs = gbn.init(jax.random.PRNGKey(0), x, use_running_average=False)
+    out, _ = gbn.apply(vs, x, use_running_average=False,
+                       mutable=["batch_stats"])
+    ref_bn = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                          epsilon=1e-5)
+    ref_vs = ref_bn.init(jax.random.PRNGKey(0), x)
+    ref, _ = ref_bn.apply(ref_vs, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_group_batch_norm_input_validation():
+    gbn = GroupBatchNorm2d(num_features=8)
+    with pytest.raises(ValueError, match="4D"):
+        gbn.init(jax.random.PRNGKey(0), jnp.zeros((2, 8)))
+    with pytest.raises(ValueError, match="channels"):
+        gbn.init(jax.random.PRNGKey(0), jnp.zeros((2, 4, 4, 3)))
+
+
+# -- deprecated contrib optimizers -----------------------------------------
+
+def test_deprecated_optimizers_warn_and_step(rng):
+    from apex_tpu.contrib.optimizers import FusedAdam, FusedSGD
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        opt = FusedAdam(lr=1e-3, use_mt=True)  # old kwarg accepted
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    params = {"w": jnp.asarray(rng.randn(4).astype(np.float32))}
+    grads = {"w": jnp.ones(4, jnp.float32)}
+    state = opt.init(params)
+    new_params, _ = opt.step(grads, state, params)
+    assert not np.allclose(np.asarray(new_params["w"]),
+                           np.asarray(params["w"]))
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        FusedSGD(lr=0.1)
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+# -- memory buffers ---------------------------------------------------------
+
+def test_memory_buffer_views_and_overflow():
+    buf = MemoryBuffer("test", 16, np.float32, track_usage=True)
+    a = buf.add((2, 4))
+    b = buf.add((8,))
+    assert a.shape == (2, 4) and b.shape == (8,)
+    a[:] = 1.0  # views alias the backing store
+    assert buf.get_data()[:8].sum() == 8.0
+    with pytest.raises(MemoryError):
+        buf.add((1,))
+    buf.reset()
+    assert not buf.is_in_use()
+    assert buf.add((16,)).shape == (16,)
+
+
+def test_ring_mem_buffer_rotation():
+    ring = RingMemBuffer("ring", 2, 8, np.float32)
+    b0 = ring.get_next_buffer()
+    b1 = ring.get_next_buffer()
+    assert b0 is not b1
+    b0.add((4,))
+    with pytest.raises(RuntimeError):
+        for _ in range(2):  # wraps to b0 which is in use
+            ring.get_next_buffer()
+
+
+# -- testing harness --------------------------------------------------------
+
+def test_arguments_and_global_vars():
+    from apex_tpu.transformer.testing import (
+        arguments,
+        global_vars,
+    )
+
+    args = arguments.parse_args(args=[
+        "--num-layers", "4", "--hidden-size", "32",
+        "--num-attention-heads", "4", "--micro-batch-size", "2",
+        "--vocab-size", "1000", "--bf16"])
+    assert args.padded_vocab_size == 1024  # rounded to 128*tp
+    assert args.ffn_hidden_size == 128
+    assert args.data_parallel_size >= 1
+    global_vars.destroy_global_vars()
+    global_vars.set_global_variables(args)
+    assert global_vars.get_args() is args
+    assert global_vars.get_num_microbatches() >= 1
+    global_vars.get_timers()("tick").start()
+    global_vars.get_timers()("tick").stop()
+    global_vars.destroy_global_vars()
+
+
+def test_model_providers_from_args():
+    from apex_tpu.transformer.testing import (
+        bert_model_provider,
+        global_vars,
+        gpt_model_provider,
+        parse_args,
+    )
+
+    global_vars.destroy_global_vars()
+    args = parse_args(args=["--num-layers", "2", "--hidden-size", "32",
+                            "--num-attention-heads", "4",
+                            "--vocab-size", "256"])
+    global_vars.set_global_variables(args)
+    gpt = gpt_model_provider()
+    bert = bert_model_provider()
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    p = gpt.init(jax.random.PRNGKey(0), tokens)
+    logits = gpt.apply(p, tokens)
+    assert logits.shape == (2, 8, args.padded_vocab_size)
+    pb = bert.init(jax.random.PRNGKey(0), tokens)
+    mlm, nsp = bert.apply(pb, tokens)
+    assert mlm.shape == (2, 8, args.padded_vocab_size)
+    global_vars.destroy_global_vars()
+
+
+def test_multiproc_env_wiring(tmp_path):
+    import subprocess
+    import sys
+
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os\n"
+        "print(os.environ.get('APEX_TPU_COORDINATOR'),"
+        " os.environ.get('APEX_TPU_NUM_PROCESSES'),"
+        " os.environ.get('APEX_TPU_PROCESS_ID'))\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.parallel.multiproc",
+         "--nnodes", "4", "--node_rank", "2",
+         "--coordinator", "host0:1234", str(script)],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "host0:1234 4 2"

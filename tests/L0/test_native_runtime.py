@@ -26,7 +26,16 @@ def test_native_extension_is_built():
     assert _C.HAVE_NATIVE, "apex_tpu_C should be built in this environment"
 
 
-def test_flatten_unflatten_roundtrip(rng):
+@pytest.fixture(params=["native", "fallback"])
+def c_impl(request, monkeypatch):
+    """Run the _C entry points through both the native extension and the
+    numpy fallback (the APEX_TPU_NO_EXT build)."""
+    if request.param == "fallback":
+        monkeypatch.setattr(_C, "_ext", None)
+    return request.param
+
+
+def test_flatten_unflatten_roundtrip(rng, c_impl):
     arrays = [rng.randn(*s).astype(np.float32)
               for s in [(3, 4), (7,), (2, 2, 2)]]
     total = sum(a.size for a in arrays)
@@ -39,12 +48,24 @@ def test_flatten_unflatten_roundtrip(rng):
         np.testing.assert_array_equal(a, o)
 
 
-def test_flatten_out_too_small():
+def test_flatten_out_too_small(c_impl):
     with pytest.raises(ValueError):
         _C.flatten([np.zeros(4, np.float32)], np.zeros(2, np.float32))
 
 
-def test_assign_buckets_semantics():
+def test_flatten_noncontiguous_out_raises(rng, c_impl):
+    # non-contiguous out must raise in BOTH impls (the numpy fallback once
+    # silently dropped the writes into a reshape temporary)
+    arrays = [np.ones((2, 2), np.float32)]
+    out = np.zeros((4, 2), np.float32).T[:, ::1]  # transposed view
+    assert not out.flags["C_CONTIGUOUS"]
+    with pytest.raises(ValueError, match="contiguous"):
+        _C.flatten(arrays, out)
+    with pytest.raises(ValueError, match="contiguous"):
+        _C.unflatten_into(np.zeros(8, np.float32), [out])
+
+
+def test_assign_buckets_semantics(c_impl):
     # greedy in-order: consecutive tensors share until cap exceeded
     assert _C.assign_buckets([4, 4, 4, 4], 8) == [0, 0, 1, 1]
     assert _C.assign_buckets([10, 1, 1], 8) == [0, 1, 1]  # oversized alone
@@ -53,14 +74,14 @@ def test_assign_buckets_semantics():
         _C.assign_buckets([1], 0)
 
 
-def test_pack_batch_matches_stack(rng):
+def test_pack_batch_matches_stack(rng, c_impl):
     samples = [rng.randn(4, 5).astype(np.float32) for _ in range(8)]
     out = np.zeros((8, 4, 5), np.float32)
     assert _C.pack_batch(samples, out) == 8
     np.testing.assert_array_equal(out, np.stack(samples))
 
 
-def test_pack_batch_size_mismatch():
+def test_pack_batch_size_mismatch(c_impl):
     with pytest.raises(ValueError):
         _C.pack_batch([np.zeros(3, np.float32), np.zeros(4, np.float32)],
                       np.zeros(7, np.float32))
