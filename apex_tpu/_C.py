@@ -58,10 +58,11 @@ def unflatten_into(flat, outs):
         raise ValueError(
             f"unflatten_into: flat buffer too small ({flat.nbytes} < "
             f"{total} bytes)")
+    for o in outs:  # validate ALL before writing ANY (native acquires
+        _require_contiguous(o, "unflatten_into")  # every buffer up front)
     off = 0
     for o in outs:
         n = o.nbytes
-        _require_contiguous(o, "unflatten_into")
         o.reshape(-1).view(np.uint8)[:] = src[off:off + n]
         off += n
     return off

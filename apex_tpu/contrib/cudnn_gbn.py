@@ -46,6 +46,17 @@ class GroupBatchNorm2d(nn.Module):
             raise ValueError(
                 f"expected {self.num_features} channels, got {x.shape[-1]}")
         axis = self.axis_name if self.group_size != 1 else None
+        if axis is not None:
+            from jax import lax
+
+            try:
+                axis_size = lax.axis_size(axis)
+            except Exception:
+                axis_size = None  # axis unbound (eager/single-device use)
+            if axis_size is not None and axis_size != self.group_size:
+                raise ValueError(
+                    f"GroupBatchNorm2d: mesh axis '{axis}' has size "
+                    f"{axis_size} but group_size={self.group_size}")
         # torch-style momentum (weight of the NEW stat) -> flax-style
         # momentum (weight of the OLD running stat)
         return SyncBatchNorm(

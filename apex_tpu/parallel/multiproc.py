@@ -23,9 +23,12 @@ def initialize_distributed(coordinator=None, num_processes=None,
     (the analog of torch.distributed.init_process_group)."""
     import jax
 
-    coordinator = coordinator or os.environ.get("APEX_TPU_COORDINATOR")
-    num_processes = num_processes or os.environ.get("APEX_TPU_NUM_PROCESSES")
-    process_id = process_id or os.environ.get("APEX_TPU_PROCESS_ID")
+    if coordinator is None:
+        coordinator = os.environ.get("APEX_TPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = os.environ.get("APEX_TPU_NUM_PROCESSES")
+    if process_id is None:  # explicit 0 (host 0) must win over the env
+        process_id = os.environ.get("APEX_TPU_PROCESS_ID")
     if coordinator is None:
         return  # single host
     jax.distributed.initialize(
@@ -39,14 +42,16 @@ def main(argv=None):
     nnodes, node_rank, coordinator = 1, 0, None
     while argv and argv[0].startswith("--"):
         flag = argv.pop(0)
+        if flag not in ("--nnodes", "--node_rank", "--coordinator"):
+            raise SystemExit(f"unknown flag {flag}")
+        if not argv:
+            raise SystemExit(f"{flag} requires a value")
         if flag == "--nnodes":
             nnodes = int(argv.pop(0))
         elif flag == "--node_rank":
             node_rank = int(argv.pop(0))
-        elif flag == "--coordinator":
-            coordinator = argv.pop(0)
         else:
-            raise SystemExit(f"unknown flag {flag}")
+            coordinator = argv.pop(0)
     if not argv:
         raise SystemExit(
             "usage: multiproc [--nnodes N --node_rank I --coordinator "

@@ -251,10 +251,14 @@ def test_multiproc_env_wiring(tmp_path):
         "print(os.environ.get('APEX_TPU_COORDINATOR'),"
         " os.environ.get('APEX_TPU_NUM_PROCESSES'),"
         " os.environ.get('APEX_TPU_PROCESS_ID'))\n")
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     out = subprocess.run(
         [sys.executable, "-m", "apex_tpu.parallel.multiproc",
          "--nnodes", "4", "--node_rank", "2",
          "--coordinator", "host0:1234", str(script)],
-        capture_output=True, text=True, cwd="/root/repo")
+        capture_output=True, text=True, cwd=repo_root)
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "host0:1234 4 2"

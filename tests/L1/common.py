@@ -1,0 +1,149 @@
+"""L1 harness: run a short training job at an opt level, dump traces.
+
+Parity: reference tests/L1/common/main_amp.py (dumps per-iteration loss +
+grad-norm per opt level) + compare.py (asserts closeness against the O0
+baseline). Models are compact stand-ins (small CNN, small GPT) so traces
+run in seconds on the CPU mesh; tolerances account for bf16 vs the
+reference's fp16.
+"""
+
+import dataclasses
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+@dataclasses.dataclass
+class Trace:
+    losses: List[float]
+    grad_norms: List[float]
+
+
+def _global_norm(grads):
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def run_cnn_trace(opt_level, optimizer_name="sgd", iters=20, seed=0,
+                  loss_scale=None):
+    """Train a small CNN classifier; return per-iteration loss/grad-norm
+    (reference main_amp.py trace dump)."""
+    import flax.linen as nn
+
+    class SmallCNN(nn.Module):
+        dtype: jnp.dtype = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            x = x.astype(self.dtype)
+            x = nn.Conv(16, (3, 3), dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.avg_pool(x, (2, 2), (2, 2))
+            x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = x.reshape(x.shape[0], -1)
+            return nn.Dense(10, dtype=self.dtype)(x).astype(jnp.float32)
+
+    rng = np.random.RandomState(seed)
+    images = jnp.asarray(rng.randn(16, 16, 16, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, size=(16,)))
+
+    compute_dtype = (jnp.float32 if opt_level in ("O0",)
+                     else jnp.bfloat16)
+    model = SmallCNN(dtype=compute_dtype)
+    params = model.init(jax.random.PRNGKey(seed), images[:2])["params"]
+
+    if optimizer_name == "sgd":
+        base_opt = FusedSGD(lr=0.05, momentum=0.9)
+    else:
+        base_opt = FusedAdam(lr=1e-3)
+    params, opt = amp.initialize(params, base_opt, opt_level=opt_level,
+                                 loss_scale=loss_scale, verbosity=0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, images, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, images)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], -1))
+
+        scale = opt_state["scaler"].loss_scale
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p) * scale)(params)
+        gnorm = _global_norm(grads) / scale
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss / scale, gnorm
+
+    losses, gnorms = [], []
+    for _ in range(iters):
+        params, opt_state, loss, gnorm = train_step(
+            params, opt_state, images, labels)
+        losses.append(float(loss))
+        gnorms.append(float(gnorm))
+    return Trace(losses, gnorms)
+
+
+def run_gpt_trace(opt_level, iters=15, seed=0):
+    """Train a toy GPT; return the trace."""
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.models.gpt import gpt_loss_fn
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    compute_dtype = jnp.float32 if opt_level == "O0" else jnp.bfloat16
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        vocab_size=256, max_position_embeddings=64,
+        compute_dtype=compute_dtype, use_flash_attention=False)
+    model = GPTModel(cfg)
+    rng = np.random.RandomState(seed)
+    tokens = jnp.asarray(rng.randint(0, 256, size=(4, 32)))
+    params = model.init(jax.random.PRNGKey(seed), tokens)
+    params, opt = amp.initialize(params, FusedAdam(lr=1e-3),
+                                 opt_level=opt_level, verbosity=0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return gpt_loss_fn(logits[:, :-1], tokens[:, 1:]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        gnorm = _global_norm(grads)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss, gnorm
+
+    losses, gnorms = [], []
+    for _ in range(iters):
+        params, opt_state, loss, gnorm = train_step(params, opt_state, tokens)
+        losses.append(float(loss))
+        gnorms.append(float(gnorm))
+    return Trace(losses, gnorms)
+
+
+def compare_traces(baseline: Trace, candidate: Trace, *, loss_rtol,
+                   gnorm_rtol, label=""):
+    """Assert trace closeness (reference tests/L1/common/compare.py
+    semantics: per-iteration relative comparison vs the O0 baseline)."""
+    bl = np.asarray(baseline.losses)
+    cl = np.asarray(candidate.losses)
+    rel = np.abs(bl - cl) / np.maximum(np.abs(bl), 1e-6)
+    assert rel.max() < loss_rtol, (
+        f"{label}: loss trace diverged (max rel {rel.max():.4f} at iter "
+        f"{int(rel.argmax())}: baseline {bl[rel.argmax()]:.5f} vs "
+        f"{cl[rel.argmax()]:.5f})")
+    bg = np.asarray(baseline.grad_norms)
+    cg = np.asarray(candidate.grad_norms)
+    relg = np.abs(bg - cg) / np.maximum(np.abs(bg), 1e-6)
+    assert relg.max() < gnorm_rtol, (
+        f"{label}: grad-norm trace diverged (max rel {relg.max():.4f})")
+    # both must actually train
+    assert cl[-1] < cl[0], f"{label}: candidate loss did not decrease"

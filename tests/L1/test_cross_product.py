@@ -1,0 +1,59 @@
+"""L1 cross-product: opt-level x optimizer loss-trace comparison vs O0.
+
+Parity: reference tests/L1/cross_product/run.sh runs {O0..O3} x
+{SGD, FusedSGD/Adam} through run_test.sh and compares each trace to the
+O0 baseline (common/compare.py). bf16 tolerances are looser than the
+reference's fp16 ones (bf16 has 8 mantissa bits); what must hold is that
+every opt level *trains the same model the same way* within precision.
+"""
+
+import pytest
+
+from tests.L1.common import compare_traces, run_cnn_trace, run_gpt_trace
+
+# bf16 per-iteration tolerances (empirically ~1e-2 observed; headroom 3x)
+LOSS_RTOL = {"O1": 0.05, "O2": 0.08, "O3": 0.10}
+GNORM_RTOL = {"O1": 0.15, "O2": 0.20, "O3": 0.25}
+
+
+@pytest.fixture(scope="module")
+def cnn_baseline_sgd():
+    return run_cnn_trace("O0", "sgd")
+
+
+@pytest.fixture(scope="module")
+def cnn_baseline_adam():
+    return run_cnn_trace("O0", "adam")
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O3"])
+def test_cnn_sgd_opt_levels_match_O0(cnn_baseline_sgd, opt_level):
+    trace = run_cnn_trace(opt_level, "sgd")
+    compare_traces(cnn_baseline_sgd, trace,
+                   loss_rtol=LOSS_RTOL[opt_level],
+                   gnorm_rtol=GNORM_RTOL[opt_level],
+                   label=f"cnn/sgd/{opt_level}")
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_cnn_adam_opt_levels_match_O0(cnn_baseline_adam, opt_level):
+    trace = run_cnn_trace(opt_level, "adam")
+    compare_traces(cnn_baseline_adam, trace,
+                   loss_rtol=LOSS_RTOL[opt_level],
+                   gnorm_rtol=GNORM_RTOL[opt_level],
+                   label=f"cnn/adam/{opt_level}")
+
+
+def test_cnn_static_loss_scale_matches_dynamic(cnn_baseline_sgd):
+    trace = run_cnn_trace("O2", "sgd", loss_scale=128.0)
+    compare_traces(cnn_baseline_sgd, trace, loss_rtol=LOSS_RTOL["O2"],
+                   gnorm_rtol=GNORM_RTOL["O2"], label="cnn/sgd/O2/static128")
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_gpt_opt_levels_match_O0(opt_level):
+    baseline = run_gpt_trace("O0")
+    trace = run_gpt_trace(opt_level)
+    compare_traces(baseline, trace, loss_rtol=LOSS_RTOL[opt_level],
+                   gnorm_rtol=GNORM_RTOL[opt_level],
+                   label=f"gpt/{opt_level}")
