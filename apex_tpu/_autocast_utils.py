@@ -1,0 +1,51 @@
+"""Autocast interop helpers.
+
+Parity: reference apex/_autocast_utils.py — ``_get_autocast_dtypes`` (9-12)
+and ``_cast_if_autocast_enabled`` (22-26), used by custom autograd
+functions so they respect an ambient torch autocast context.
+
+TPU design: the ambient context is apex_tpu's amp O1 policy
+(:mod:`apex_tpu.amp.policy`); these helpers consult it so fused ops cast
+their inputs the same way patched ops do.
+"""
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from apex_tpu.amp._amp_state import _amp_state
+
+
+def _get_autocast_dtypes() -> Sequence:
+    """Dtypes an autocast region may produce (reference: [half, float] or
+    [bfloat16, half, float])."""
+    return [jnp.bfloat16, jnp.float16, jnp.float32]
+
+
+def _get_current_dtype(dtype=None):
+    """The active autocast compute dtype, or ``dtype`` when given
+    (reference _autocast_utils.py:15-19)."""
+    if dtype is not None:
+        return dtype
+    opt_properties = getattr(_amp_state, "opt_properties", None)
+    if opt_properties is not None and getattr(opt_properties, "enabled", False):
+        return getattr(opt_properties, "cast_model_type", None) or jnp.bfloat16
+    return jnp.float32
+
+
+def _cast_if_autocast_enabled(*args):
+    """Cast floating args to the active autocast dtype when amp O1 casting
+    is enabled; identity otherwise (reference _autocast_utils.py:22-26)."""
+    opt_properties = getattr(_amp_state, "opt_properties", None)
+    enabled = (opt_properties is not None
+               and getattr(opt_properties, "patch_torch_functions", False))
+    if not enabled:
+        return args
+    target = jnp.bfloat16
+
+    def cast(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(target)
+        return a
+
+    return tuple(cast(a) for a in args)

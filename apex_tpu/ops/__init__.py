@@ -21,4 +21,6 @@ from apex_tpu.ops.multi_tensor import (  # noqa: F401
     multi_tensor_novograd,
     multi_tensor_lamb,
     multi_tensor_lamb_mp,
+    multi_tensor_lamb_stage1,
+    multi_tensor_lamb_stage2,
 )

@@ -408,3 +408,66 @@ def multi_tensor_lamb_mp(
     )
     new_params = [nm.astype(p.dtype) for nm, p in zip(new_masters, params)]
     return new_params, new_m, new_v, new_masters, noop
+
+
+def multi_tensor_lamb_stage1(noop_flag, tensor_lists, per_tensor_decay,
+                             step, beta1, beta2, beta3, bias_correction,
+                             eps, grad_averaging, mode, global_grad_norm,
+                             max_global_grad_norm):
+    """Legacy two-stage LAMB, stage 1 (parity: csrc/
+    multi_tensor_lamb_stage_1.cu via contrib fused_lamb): computes the
+    per-parameter update direction u = m_hat/(sqrt(v_hat)+eps) + wd*p.
+    tensor_lists = [grads, params, m, v, update_out]; returns
+    (m, v, updates, noop_flag). ``beta3`` overrides the momentum mix when
+    given; otherwise it derives from ``grad_averaging`` like the fused op.
+    """
+    grads, params, ms, vs, _ = tensor_lists
+    if beta3 is None:
+        beta3 = (1.0 - beta1) if grad_averaging else 1.0
+    if max_global_grad_norm is not None and max_global_grad_norm > 0:
+        clip = jnp.maximum(global_grad_norm / max_global_grad_norm, 1.0)
+    else:
+        clip = jnp.asarray(1.0, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - beta1 ** step
+        bc2 = 1.0 - beta2 ** step
+    else:
+        bc1 = bc2 = 1.0
+    new_m, new_v, updates = [], [], []
+    for g, p, m, v, wd in zip(grads, params, ms, vs, per_tensor_decay):
+        g32 = g.astype(jnp.float32) / clip
+        p32 = p.astype(jnp.float32)
+        if mode == 0 and wd != 0:
+            g32 = g32 + wd * p32
+        m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
+        v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * jnp.square(g32)
+        u = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+        if mode == 1 and wd != 0:
+            u = u + wd * p32
+        new_m.append(_keep(noop_flag, m, m32))
+        new_v.append(_keep(noop_flag, v, v32))
+        updates.append(u)
+    return new_m, new_v, updates, noop_flag
+
+
+def multi_tensor_lamb_stage2(noop_flag, tensor_lists, per_tensor_decay, lr,
+                             use_nvlamb=False):
+    """Legacy two-stage LAMB, stage 2 (parity: csrc/
+    multi_tensor_lamb_stage_2.cu:45): applies the update, scaled by the
+    trust ratio only when ``use_nvlamb`` or that tensor's decay != 0 —
+    matching the fused op's ``apply_trust`` gate.
+    tensor_lists = [params, updates]; returns (params, noop_flag).
+    """
+    params, updates = tensor_lists
+    new_p = []
+    for p, u, wd in zip(params, updates, per_tensor_decay):
+        p32 = p.astype(jnp.float32)
+        if use_nvlamb or wd != 0:
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(u)))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              w_norm / u_norm, 1.0)
+        else:
+            ratio = jnp.asarray(1.0, jnp.float32)
+        new_p.append(_keep(noop_flag, p, p32 - lr * ratio * u))
+    return new_p, noop_flag

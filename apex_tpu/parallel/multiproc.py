@@ -31,6 +31,11 @@ def initialize_distributed(coordinator=None, num_processes=None,
         process_id = os.environ.get("APEX_TPU_PROCESS_ID")
     if coordinator is None:
         return  # single host
+    if num_processes is None or process_id is None:
+        raise ValueError(
+            "initialize_distributed: num_processes and process_id are "
+            "required when a coordinator is set (pass them or export "
+            "APEX_TPU_NUM_PROCESSES / APEX_TPU_PROCESS_ID)")
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=int(num_processes),
@@ -42,16 +47,16 @@ def main(argv=None):
     nnodes, node_rank, coordinator = 1, 0, None
     while argv and argv[0].startswith("--"):
         flag = argv.pop(0)
-        if flag not in ("--nnodes", "--node_rank", "--coordinator"):
-            raise SystemExit(f"unknown flag {flag}")
-        if not argv:
+        if flag in ("--nnodes", "--node_rank", "--coordinator") and not argv:
             raise SystemExit(f"{flag} requires a value")
         if flag == "--nnodes":
             nnodes = int(argv.pop(0))
         elif flag == "--node_rank":
             node_rank = int(argv.pop(0))
-        else:
+        elif flag == "--coordinator":
             coordinator = argv.pop(0)
+        else:
+            raise SystemExit(f"unknown flag {flag}")
     if not argv:
         raise SystemExit(
             "usage: multiproc [--nnodes N --node_rank I --coordinator "

@@ -78,3 +78,49 @@ class TestMultiTensorL2Norm:
         for x, p in zip(xs, np.asarray(per)):
             np.testing.assert_allclose(p, np.linalg.norm(np.asarray(x).ravel()),
                                        rtol=1e-6)
+
+
+class TestLambStages:
+    """Legacy two-stage LAMB (amp_C lamb_stage1/2 parity): composing the
+    stages must match the fused multi_tensor_lamb update."""
+
+    @pytest.mark.parametrize("weight_decay", [0.01, 0.0])
+    def test_stages_match_fused(self, rng, weight_decay):
+        # weight_decay=0.0 exercises the apply_trust gate: fused LAMB skips
+        # the trust ratio for zero-decay tensors, so stage2 must too
+        import jax.numpy as jnp
+        from apex_tpu.ops import (
+            multi_tensor_lamb,
+            multi_tensor_lamb_stage1,
+            multi_tensor_lamb_stage2,
+        )
+
+        n = 3
+        grads = [jnp.asarray(rng.randn(5).astype(np.float32)) for _ in range(n)]
+        params = [jnp.asarray(rng.randn(5).astype(np.float32)) for _ in range(n)]
+        ms = [jnp.zeros(5, jnp.float32) for _ in range(n)]
+        vs = [jnp.zeros(5, jnp.float32) for _ in range(n)]
+        noop = jnp.zeros((), jnp.float32)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+        kw = dict(lr=0.01, beta1=0.9, beta2=0.99, eps=1e-6, step=1,
+                  bias_correction=1, weight_decay=weight_decay,
+                  grad_averaging=1, mode=1, global_grad_norm=gnorm,
+                  max_grad_norm=1.0)
+
+        new_p_f, new_m_f, new_v_f, _ = multi_tensor_lamb(
+            noop, [grads, params, ms, vs], use_nvlamb=False, **kw)
+
+        decay = [weight_decay] * n
+        new_m, new_v, updates, _ = multi_tensor_lamb_stage1(
+            noop, [grads, params, ms, vs, [None] * n],
+            per_tensor_decay=decay, step=1, beta1=0.9, beta2=0.99,
+            beta3=None, bias_correction=1, eps=1e-6, grad_averaging=1,
+            mode=1, global_grad_norm=gnorm, max_global_grad_norm=1.0)
+        new_p, _ = multi_tensor_lamb_stage2(noop, [params, updates],
+                                            per_tensor_decay=decay, lr=0.01)
+
+        for got, want in ((new_p, new_p_f), (new_m, new_m_f),
+                          (new_v, new_v_f)):
+            for a, b in zip(got, want):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-6)

@@ -50,9 +50,14 @@ def test_cnn_static_loss_scale_matches_dynamic(cnn_baseline_sgd):
                    gnorm_rtol=GNORM_RTOL["O2"], label="cnn/sgd/O2/static128")
 
 
+@pytest.fixture(scope="module")
+def gpt_baseline():
+    return run_gpt_trace("O0")
+
+
 @pytest.mark.parametrize("opt_level", ["O1", "O2"])
-def test_gpt_opt_levels_match_O0(opt_level):
-    baseline = run_gpt_trace("O0")
+def test_gpt_opt_levels_match_O0(gpt_baseline, opt_level):
+    baseline = gpt_baseline
     trace = run_gpt_trace(opt_level)
     compare_traces(baseline, trace, loss_rtol=LOSS_RTOL[opt_level],
                    gnorm_rtol=GNORM_RTOL[opt_level],

@@ -137,7 +137,8 @@ def test_amp_master_params_identical_across_replicas():
         per_replica = np.asarray(leaf)
         for r in range(1, per_replica.shape[0]):
             np.testing.assert_array_equal(per_replica[0], per_replica[r])
-    masters = new_state["inner"].get("amp_master", {})
+    masters = new_state["inner"]["amp_master"]  # O2 must create masters
+    assert jax.tree_util.tree_leaves(masters), "no master params in state"
     for leaf in jax.tree_util.tree_leaves(masters):
         per_replica = np.asarray(leaf)
         assert per_replica.dtype == np.float32
