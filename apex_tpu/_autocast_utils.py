@@ -41,7 +41,7 @@ def _cast_if_autocast_enabled(*args):
                and getattr(opt_properties, "patch_torch_functions", False))
     if not enabled:
         return args
-    target = jnp.bfloat16
+    target = _get_current_dtype()
 
     def cast(a):
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):

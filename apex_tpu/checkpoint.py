@@ -1,0 +1,149 @@
+"""Checkpoint / resume for training state.
+
+Parity: the reference's checkpoint story (SURVEY.md §5) is amp
+``state_dict``/``load_state_dict`` (apex/amp/frontend.py:365-404) plus
+example-level ``torch.save`` of model+optimizer+amp
+(examples/imagenet/main_amp.py:95-101). The TPU-native equivalent is a
+single utility that snapshots the whole training state — params, optimizer
+state (incl. fp32 masters and the loss-scaler state), batch stats, step —
+via orbax when available (async, sharding-aware) with a pickle fallback.
+
+    from apex_tpu import checkpoint
+    checkpoint.save("ckpt/", step, params=params, opt_state=opt_state,
+                    batch_stats=batch_stats)
+    state = checkpoint.restore("ckpt/")          # latest step
+    state = checkpoint.restore("ckpt/", step=5)  # specific step
+"""
+
+import os
+import pickle
+import re
+from typing import Any, Dict, Optional
+
+import jax
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAVE_ORBAX = True
+except Exception:  # orbax missing or incompatible
+    ocp = None
+    _HAVE_ORBAX = False
+
+
+def _step_dir(directory: str, step: int) -> str:
+    # orbax/tensorstore require absolute paths
+    return os.path.join(os.path.abspath(directory), f"step_{step:010d}")
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest checkpointed step in ``directory``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        m = re.fullmatch(r"step_(\d+)", name)
+        if m:
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def save(directory: str, step: int, state: Optional[Dict[str, Any]] = None,
+         *, use_orbax: Optional[bool] = None, **extra: Any) -> str:
+    """Snapshot ``state`` (a dict of pytrees, merged with ``extra``
+    kwargs) under ``directory/step_N``.
+
+    Returns the checkpoint path. Device arrays are fetched to host;
+    orbax (when available) writes the tree natively.
+    """
+    state = {**(state or {}), **extra}
+    if use_orbax is None:
+        use_orbax = _HAVE_ORBAX
+    path = _step_dir(directory, step)
+    os.makedirs(directory, exist_ok=True)
+    host_state = jax.device_get(state)
+    if use_orbax:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, host_state, force=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            pickle.dump(host_state, f)
+    return path
+
+
+def restore(directory: str, step: Optional[int] = None, *,
+            use_orbax: Optional[bool] = None,
+            template: Any = None) -> Dict[str, Any]:
+    """Load the state dict saved by :func:`save`.
+
+    ``step=None`` loads the newest step. ``template`` (a pytree with the
+    wanted structure/custom node types, e.g. the live training state) makes
+    the orbax path restore into that structure — orbax stores custom pytree
+    nodes (NamedTuples, dataclasses) structurally and returns plain dicts
+    otherwise. Raises FileNotFoundError when no checkpoints exist.
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _step_dir(directory, step)
+    pkl = os.path.join(path, "state.pkl")
+    if use_orbax is None:
+        use_orbax = _HAVE_ORBAX and not os.path.exists(pkl)
+    if use_orbax:
+        ckptr = ocp.PyTreeCheckpointer()
+        if template is not None:
+            restored = ckptr.restore(path, item=jax.device_get(template))
+        else:
+            restored = ckptr.restore(path)
+        return dict(restored)
+    with open(pkl, "rb") as f:
+        return pickle.load(f)
+
+
+def save_training_state(directory: str, step: int, params, opt_state,
+                        batch_stats=None, extra=None, **kw) -> str:
+    """Convenience wrapper bundling the common training tuple + amp scaler
+    state (the reference's model+optimizer+amp torch.save pattern)."""
+    from apex_tpu import amp
+
+    state = {"params": params, "opt_state": opt_state, "step": step}
+    if batch_stats is not None:
+        state["batch_stats"] = batch_stats
+    if extra is not None:
+        state["extra"] = extra
+    try:
+        state["amp"] = amp.state_dict()
+    except Exception as e:
+        import warnings
+
+        warnings.warn(f"checkpoint: amp state not saved ({e})")
+    return save(directory, step, state, **kw)
+
+
+def restore_training_state(directory: str, step: Optional[int] = None,
+                           **kw) -> Dict[str, Any]:
+    """Load what :func:`save_training_state` wrote; re-installs amp scaler
+    state when present and rebuilds the optimizer ScalerState (orbax
+    stores NamedTuples structurally — pass ``template=`` for full custom-
+    node fidelity on arbitrary states)."""
+    from apex_tpu import amp
+    from apex_tpu.amp.scaler import ScalerState
+
+    state = restore(directory, step, **kw)
+    opt_state = state.get("opt_state")
+    if isinstance(opt_state, dict) and isinstance(opt_state.get("scaler"),
+                                                  dict):
+        opt_state["scaler"] = ScalerState(**opt_state["scaler"])
+    if "amp" in state:
+        try:
+            amp.load_state_dict(state["amp"])
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint: amp scaler state failed to load ({e}); "
+                "resuming with the current scaler — loss scale may differ "
+                "from the saved run")
+    return state

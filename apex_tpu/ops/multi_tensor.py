@@ -302,45 +302,63 @@ def multi_tensor_novograd(
     return new_p, new_m, jnp.stack(new_v), noop_flag
 
 
+def _lamb_grad_clip(global_grad_norm, max_grad_norm):
+    """Global grad clipping scale (csrc/multi_tensor_lamb.cu scales by
+    clipped_global_grad_norm = max(gnorm/max_norm, 1))."""
+    if max_grad_norm is not None and max_grad_norm > 0:
+        return jnp.maximum(global_grad_norm / max_grad_norm, 1.0)
+    return jnp.asarray(1.0, jnp.float32)
+
+
+def _lamb_tensor_direction(g, p, m, v, wd, *, beta1, beta2, beta3, bc1, bc2,
+                           eps, mode, clip):
+    """One tensor's LAMB moment update + update direction (stage-1 math,
+    shared by the fused op and the legacy two-stage ops)."""
+    g32 = g.astype(jnp.float32) / clip
+    p32 = p.astype(jnp.float32)
+    if mode == 0 and wd != 0:  # L2 into grad
+        g32 = g32 + wd * p32
+    m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
+    v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * jnp.square(g32)
+    update = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+    if mode == 1 and wd != 0:  # decoupled (LAMB default)
+        update = update + wd * p32
+    return m32, v32, update
+
+
+def _lamb_apply_trust(p32, update, lr, apply_trust):
+    """Trust-ratio-scaled parameter step (stage-2 math); NVLAMB applies
+    the ratio even when wd == 0."""
+    if apply_trust:
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+    else:
+        ratio = jnp.asarray(1.0, jnp.float32)
+    return p32 - lr * ratio * update
+
+
+def _lamb_bias_correction(bias_correction, beta1, beta2, step):
+    if bias_correction:
+        return 1.0 - beta1 ** step, 1.0 - beta2 ** step
+    return 1.0, 1.0
+
+
 def _lamb_update_lists(
     noop_flag, grads, params, ms, vs, lr, beta1, beta2, eps, step, bias_correction,
     weight_decay, grad_averaging, mode, global_grad_norm, max_grad_norm, use_nvlamb,
 ):
     """Shared LAMB math for the fused and mixed-precision variants."""
-    if bias_correction:
-        bc1 = 1.0 - beta1 ** step
-        bc2 = 1.0 - beta2 ** step
-    else:
-        bc1 = bc2 = 1.0
+    bc1, bc2 = _lamb_bias_correction(bias_correction, beta1, beta2, step)
     beta3 = (1 - beta1) if grad_averaging else 1.0
-    # Global gradient clipping (csrc/multi_tensor_lamb.cu scales by
-    # clipped_global_grad_norm = max(gnorm/max_norm, 1)).
-    if max_grad_norm is not None and max_grad_norm > 0:
-        clip = jnp.maximum(global_grad_norm / max_grad_norm, 1.0)
-    else:
-        clip = jnp.asarray(1.0, jnp.float32)
+    clip = _lamb_grad_clip(global_grad_norm, max_grad_norm)
     new_p, new_m, new_v = [], [], []
     for g, p, m, v in zip(grads, params, ms, vs):
-        g32 = g.astype(jnp.float32) / clip
-        p32 = p.astype(jnp.float32)
-        if mode == 0 and weight_decay != 0:  # L2 into grad
-            g32 = g32 + weight_decay * p32
-        m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
-        v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * jnp.square(g32)
-        m_hat = m32 / bc1
-        v_hat = v32 / bc2
-        update = m_hat / (jnp.sqrt(v_hat) + eps)
-        if mode == 1 and weight_decay != 0:  # decoupled (LAMB default)
-            update = update + weight_decay * p32
-        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
-        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
-        # Trust ratio; NVLAMB applies it even when wd == 0.
-        apply_trust = (weight_decay != 0) or use_nvlamb
-        if apply_trust:
-            ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
-        else:
-            ratio = jnp.asarray(1.0, jnp.float32)
-        p_new = p32 - lr * ratio * update
+        m32, v32, update = _lamb_tensor_direction(
+            g, p, m, v, weight_decay, beta1=beta1, beta2=beta2, beta3=beta3,
+            bc1=bc1, bc2=bc2, eps=eps, mode=mode, clip=clip)
+        p_new = _lamb_apply_trust(p.astype(jnp.float32), update, lr,
+                                  (weight_decay != 0) or use_nvlamb)
         new_p.append(_keep(noop_flag, p, p_new))
         new_m.append(_keep(noop_flag, m, m32))
         new_v.append(_keep(noop_flag, v, v32))
@@ -424,26 +442,13 @@ def multi_tensor_lamb_stage1(noop_flag, tensor_lists, per_tensor_decay,
     grads, params, ms, vs, _ = tensor_lists
     if beta3 is None:
         beta3 = (1.0 - beta1) if grad_averaging else 1.0
-    if max_global_grad_norm is not None and max_global_grad_norm > 0:
-        clip = jnp.maximum(global_grad_norm / max_global_grad_norm, 1.0)
-    else:
-        clip = jnp.asarray(1.0, jnp.float32)
-    if bias_correction:
-        bc1 = 1.0 - beta1 ** step
-        bc2 = 1.0 - beta2 ** step
-    else:
-        bc1 = bc2 = 1.0
+    clip = _lamb_grad_clip(global_grad_norm, max_global_grad_norm)
+    bc1, bc2 = _lamb_bias_correction(bias_correction, beta1, beta2, step)
     new_m, new_v, updates = [], [], []
     for g, p, m, v, wd in zip(grads, params, ms, vs, per_tensor_decay):
-        g32 = g.astype(jnp.float32) / clip
-        p32 = p.astype(jnp.float32)
-        if mode == 0 and wd != 0:
-            g32 = g32 + wd * p32
-        m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
-        v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * jnp.square(g32)
-        u = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
-        if mode == 1 and wd != 0:
-            u = u + wd * p32
+        m32, v32, u = _lamb_tensor_direction(
+            g, p, m, v, wd, beta1=beta1, beta2=beta2, beta3=beta3,
+            bc1=bc1, bc2=bc2, eps=eps, mode=mode, clip=clip)
         new_m.append(_keep(noop_flag, m, m32))
         new_v.append(_keep(noop_flag, v, v32))
         updates.append(u)
@@ -461,13 +466,7 @@ def multi_tensor_lamb_stage2(noop_flag, tensor_lists, per_tensor_decay, lr,
     params, updates = tensor_lists
     new_p = []
     for p, u, wd in zip(params, updates, per_tensor_decay):
-        p32 = p.astype(jnp.float32)
-        if use_nvlamb or wd != 0:
-            w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
-            u_norm = jnp.sqrt(jnp.sum(jnp.square(u)))
-            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
-                              w_norm / u_norm, 1.0)
-        else:
-            ratio = jnp.asarray(1.0, jnp.float32)
-        new_p.append(_keep(noop_flag, p, p32 - lr * ratio * u))
+        p_new = _lamb_apply_trust(p.astype(jnp.float32), u, lr,
+                                  use_nvlamb or wd != 0)
+        new_p.append(_keep(noop_flag, p, p_new))
     return new_p, noop_flag

@@ -106,13 +106,17 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
     per-leaf path; use inside a jitted step."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     out = [None] * len(leaves)
-    for bucket in plan_buckets(leaves, message_size):
-        flat = flatten([leaves[i] for i in bucket])
-        flat = _psum_with_policy(flat, axis_name, allreduce_always_fp32,
-                                 gradient_average, gradient_predivide_factor)
-        for i, piece in zip(bucket, unflatten(flat,
-                                              [leaves[i] for i in bucket])):
-            out[i] = piece
+    for n, bucket in enumerate(plan_buckets(leaves, message_size)):
+        # named_scope = the TPU analog of the reference's NVTX ranges
+        # around allreduce_bucket (distributed.py:429, prof flag)
+        with jax.named_scope(f"ddp_allreduce_bucket_{n}"):
+            flat = flatten([leaves[i] for i in bucket])
+            flat = _psum_with_policy(flat, axis_name, allreduce_always_fp32,
+                                     gradient_average,
+                                     gradient_predivide_factor)
+            for i, piece in zip(
+                    bucket, unflatten(flat, [leaves[i] for i in bucket])):
+                out[i] = piece
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -142,21 +142,24 @@ def forward_backward_pipelining_without_interleaving(
 
     # ---------------- forward phase ----------------
     def fwd_tick(t, carry):
-        xs, y_prev, losses = carry
-        recv = send_forward_recv_forward(y_prev, axis_name, world=P)
-        mb_idx = t - rank
-        active = (mb_idx >= 0) & (mb_idx < M)
-        mb_safe = jnp.clip(mb_idx, 0, M - 1)
-        mb = take_mb(mb_safe)
-        h_in = jnp.where(is_first, zero_h, recv).astype(dtype)
-        y, loss = stage_and_loss(params, h_in, mb)
-        # stash the stage input for rematerialized backward
-        xs = lax.dynamic_update_index_in_dim(
-            xs, jnp.where(active, h_in, xs[mb_safe]), mb_safe, 0)
-        losses = losses.at[mb_safe].add(
-            jnp.where(active & is_last, loss, 0.0))
-        y_prev = jnp.where(active, y, jnp.zeros_like(y))
-        return xs, y_prev, losses
+        # named_scope = the reference's NVTX/timer annotations around
+        # forward_step (_timers.py usage in the schedules)
+        with jax.named_scope("pp_fwd_tick"):
+            xs, y_prev, losses = carry
+            recv = send_forward_recv_forward(y_prev, axis_name, world=P)
+            mb_idx = t - rank
+            active = (mb_idx >= 0) & (mb_idx < M)
+            mb_safe = jnp.clip(mb_idx, 0, M - 1)
+            mb = take_mb(mb_safe)
+            h_in = jnp.where(is_first, zero_h, recv).astype(dtype)
+            y, loss = stage_and_loss(params, h_in, mb)
+            # stash the stage input for rematerialized backward
+            xs = lax.dynamic_update_index_in_dim(
+                xs, jnp.where(active, h_in, xs[mb_safe]), mb_safe, 0)
+            losses = losses.at[mb_safe].add(
+                jnp.where(active & is_last, loss, 0.0))
+            y_prev = jnp.where(active, y, jnp.zeros_like(y))
+            return xs, y_prev, losses
 
     xs0 = jnp.zeros((M,) + tuple(tensor_shape), dtype)
     losses0 = jnp.zeros((M,), jnp.float32)
@@ -168,25 +171,26 @@ def forward_backward_pipelining_without_interleaving(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
 
     def bwd_tick(t, carry):
-        grads_acc, dx_prev = carry
-        dy_recv = send_backward_recv_backward(dx_prev, axis_name, world=P)
-        mb_idx = (M - 1) - (t - (P - 1 - rank))
-        active = (mb_idx >= 0) & (mb_idx < M)
-        mb_safe = jnp.clip(mb_idx, 0, M - 1)
-        mb = take_mb(mb_safe)
-        h_in = xs[mb_safe]
-        _, pullback = jax.vjp(
-            lambda p, h: stage_and_loss(p, h, mb), params, h_in)
-        dy_cot = jnp.where(active & ~is_last, dy_recv,
-                           jnp.zeros_like(dy_recv)).astype(dtype)
-        loss_cot = jnp.where(active & is_last,
-                             jnp.asarray(grad_scale, jnp.float32), 0.0)
-        dparams, dh = pullback((dy_cot, loss_cot))
-        grads_acc = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(active, d.astype(jnp.float32), 0.0),
-            grads_acc, dparams)
-        dx_prev = jnp.where(active, dh, jnp.zeros_like(dh)).astype(dtype)
-        return grads_acc, dx_prev
+        with jax.named_scope("pp_bwd_tick"):
+            grads_acc, dx_prev = carry
+            dy_recv = send_backward_recv_backward(dx_prev, axis_name, world=P)
+            mb_idx = (M - 1) - (t - (P - 1 - rank))
+            active = (mb_idx >= 0) & (mb_idx < M)
+            mb_safe = jnp.clip(mb_idx, 0, M - 1)
+            mb = take_mb(mb_safe)
+            h_in = xs[mb_safe]
+            _, pullback = jax.vjp(
+                lambda p, h: stage_and_loss(p, h, mb), params, h_in)
+            dy_cot = jnp.where(active & ~is_last, dy_recv,
+                               jnp.zeros_like(dy_recv)).astype(dtype)
+            loss_cot = jnp.where(active & is_last,
+                                 jnp.asarray(grad_scale, jnp.float32), 0.0)
+            dparams, dh = pullback((dy_cot, loss_cot))
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(active, d.astype(jnp.float32), 0.0),
+                grads_acc, dparams)
+            dx_prev = jnp.where(active, dh, jnp.zeros_like(dh)).astype(dtype)
+            return grads_acc, dx_prev
 
     grads, _ = lax.fori_loop(0, ticks, bwd_tick, (zero_grads, zero_h))
     n = jnp.asarray(M, jnp.float32)

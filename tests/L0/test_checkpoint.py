@@ -1,0 +1,100 @@
+"""Checkpoint/resume utility tests.
+
+Mirrors the reference's checkpoint story (SURVEY.md §5): amp
+state_dict round-trip (reference test_checkpointing.py) extended to the
+full training-state snapshot.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, checkpoint
+from apex_tpu.optimizers import FusedAdam
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize("use_orbax", [False, True])
+def test_save_restore_roundtrip(tmp_path, rng, use_orbax):
+    if use_orbax and not checkpoint._HAVE_ORBAX:
+        pytest.skip("orbax not installed")
+    state = {
+        "params": {"w": jnp.asarray(rng.randn(4, 3).astype(np.float32)),
+                   "b": jnp.asarray(rng.randn(3).astype(np.float32))},
+        "step": jnp.asarray(7),
+    }
+    d = str(tmp_path / "ckpt")
+    checkpoint.save(d, 7, state, use_orbax=use_orbax)
+    restored = checkpoint.restore(d, use_orbax=use_orbax)
+    _tree_equal(state["params"], restored["params"])
+    assert int(np.asarray(restored["step"])) == 7
+
+
+def test_latest_step_and_explicit_step(tmp_path):
+    d = str(tmp_path / "ckpt")
+    assert checkpoint.latest_step(d) is None
+    with pytest.raises(FileNotFoundError):
+        checkpoint.restore(d)
+    checkpoint.save(d, 1, use_orbax=False, x=jnp.zeros(2))
+    checkpoint.save(d, 5, use_orbax=False, x=jnp.ones(2))
+    assert checkpoint.latest_step(d) == 5
+    np.testing.assert_array_equal(
+        np.asarray(checkpoint.restore(d, use_orbax=False)["x"]), np.ones(2))
+    np.testing.assert_array_equal(
+        np.asarray(checkpoint.restore(d, step=1, use_orbax=False)["x"]),
+        np.zeros(2))
+
+
+@pytest.mark.parametrize("use_orbax", [False, True])
+def test_training_state_resume_continues_identically(tmp_path, rng,
+                                                     use_orbax):
+    """Save mid-training, restore, continue — must match the uninterrupted
+    run exactly (the reference L0 checkpoint test's core assertion). The
+    orbax case also guards the ScalerState-rebuild path (orbax returns
+    plain dicts for NamedTuple nodes)."""
+    if use_orbax and not checkpoint._HAVE_ORBAX:
+        pytest.skip("orbax not installed")
+    x = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+    w0 = {"w": jnp.asarray(rng.randn(4, 2).astype(np.float32))}
+
+    params, opt = amp.initialize(w0, FusedAdam(lr=1e-2), opt_level="O2",
+                                 verbosity=0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        scale = opt_state["scaler"].loss_scale
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.mean((x @ p["w"].astype(jnp.float32) - y) ** 2)
+            * scale)(params)
+        p2, s2 = opt.step(grads, opt_state, params)
+        return p2, s2, loss / scale
+
+    # uninterrupted: 6 steps
+    p_ref, s_ref = params, opt_state
+    for _ in range(6):
+        p_ref, s_ref, _ = step(p_ref, s_ref)
+
+    # interrupted: 3 steps, checkpoint, restore, 3 more
+    p, s = params, opt_state
+    for _ in range(3):
+        p, s, _ = step(p, s)
+    d = str(tmp_path / "ckpt")
+    checkpoint.save_training_state(d, 3, p, s, use_orbax=use_orbax)
+    restored = checkpoint.restore_training_state(d, use_orbax=use_orbax)
+    p, s = restored["params"], restored["opt_state"]
+    assert int(np.asarray(restored["step"])) == 3 or restored["step"] == 3
+    for _ in range(3):
+        p, s, _ = step(p, s)  # would crash if ScalerState came back a dict
+
+    _tree_equal(p_ref, p)
+    _tree_equal(s_ref["inner"]["amp_master"], s["inner"]["amp_master"])
