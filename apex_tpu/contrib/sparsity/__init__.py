@@ -5,3 +5,10 @@ from apex_tpu.contrib.sparsity.sparse_masklib import (  # noqa: F401
     m4n2_2d_best,
     unstructured_fraction,
 )
+from apex_tpu.contrib.sparsity.permutation_lib import (  # noqa: F401
+    apply_permutation_in_C_dim,
+    apply_permutation_in_K_dim,
+    permutation_improvement,
+    search_for_good_permutation,
+    sum_after_2_to_4,
+)

@@ -1,0 +1,154 @@
+"""Channel-permutation search for 2:4 structured sparsity.
+
+Parity: reference apex/contrib/sparsity/permutation_lib.py (927 LoC) +
+permutation_search_kernels/ (exhaustive + greedy CUDA search): permute a
+weight's input channels so that large-magnitude weights land in positions
+the m4n2 mask keeps ("Channel Permutations for N:M Sparsity",
+NeurIPS 2021). The reference drives this through a torch.fx graph walk to
+propagate permutations across layers; here the graph plumbing is the
+user's (JAX models are functional pytrees), and this module provides the
+search itself, fully vectorized:
+
+- :func:`sum_after_2_to_4` — magnitude retained by the 2:4 mask.
+- :func:`search_for_good_permutation` — greedy pairwise column-swap
+  search; each sweep scores ALL (i, j) swap gains as one batched
+  computation (the XLA analog of the reference's CUDA search kernels)
+  and applies the best non-conflicting swaps.
+- :func:`apply_permutation_in_C_dim` / ``..._K_dim`` — apply a found
+  permutation to weights (and the inverse to producing layers).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _group_kept_sum(groups):
+    """groups: [..., K, 4] -> [...]: magnitude kept by keep-2-of-4."""
+    a = jnp.abs(groups)
+    top2 = jnp.sort(a, axis=-1)[..., 2:]
+    return jnp.sum(top2, axis=tuple(range(top2.ndim - 2, top2.ndim)))
+
+
+def sum_after_2_to_4(weight2d):
+    """Total |w| kept by the m4n2 mask (reference
+    permutation_search_kernels/permutation_utilities.sum_after_2_to_4)."""
+    k, c = weight2d.shape
+    assert c % 4 == 0, "C must be divisible by 4"
+    groups = weight2d.reshape(k, c // 4, 4).transpose(1, 0, 2)
+    return jnp.sum(_group_kept_sum(groups))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _swap_gains_chunk(weight2d, chunk, i_start):
+    """Swap gains for columns [i_start, i_start+chunk) vs ALL columns.
+
+    Returns [chunk, C]. Memory is O(chunk * C * K * 4) — chunking over i
+    bounds the replacement tensor the way the reference CUDA kernels
+    stripe their search.
+    """
+    k, c = weight2d.shape
+    g = c // 4
+    groups = weight2d.reshape(k, g, 4).transpose(1, 0, 2)  # [g, K, 4]
+    base = _group_kept_sum(groups)                          # [g]
+    gid = jnp.arange(c) // 4
+    pos = jnp.arange(c) % 4
+    cols = weight2d.T                                       # [C, K]
+
+    def rep_row(i):
+        # kept(g_i with col i replaced by col j) for all j -> [C]
+        grp = groups[gid[i]]                                # [K, 4]
+        def one(j):
+            return _group_kept_sum(grp.at[:, pos[i]].set(cols[j]))
+        return jax.vmap(one)(jnp.arange(c))
+
+    i_idx = i_start + jnp.arange(chunk)
+    rep_i = jax.vmap(rep_row)(i_idx)                        # [chunk, C]
+    # transposed term: kept(g_j with col j replaced by col i)
+    def rep_col(i):
+        def one(j):
+            grp = groups[gid[j]]
+            return _group_kept_sum(grp.at[:, pos[j]].set(cols[i]))
+        return jax.vmap(one)(jnp.arange(c))
+    rep_t = jax.vmap(rep_col)(i_idx)                        # [chunk, C]
+    gains = (rep_i - base[gid[i_idx]][:, None]) + (rep_t - base[gid][None, :])
+    same_group = gid[i_idx][:, None] == gid[None, :]
+    return jnp.where(same_group, 0.0, gains)
+
+
+def _swap_gains(weight2d, chunk=64):
+    """Full [C, C] swap-gain matrix, computed in jitted chunks."""
+    c = weight2d.shape[1]
+    chunk = min(chunk, c)
+    rows = []
+    for i0 in range(0, c, chunk):
+        n = min(chunk, c - i0)
+        rows.append(np.asarray(_swap_gains_chunk(weight2d, n, i0)))
+    return np.concatenate(rows, axis=0)
+
+
+def _disjoint_positive_swaps(gains, tol=1e-7):
+    """Greedy selection of non-conflicting positive-gain (i, j) swaps:
+    best first, skipping any pair touching an already-swapped group."""
+    c = gains.shape[0]
+    order = np.argsort(gains, axis=None)[::-1]
+    used_groups = set()
+    chosen = []
+    for flat in order:
+        i, j = divmod(int(flat), c)
+        if gains[i, j] <= tol:
+            break
+        gi, gj = i // 4, j // 4
+        if gi in used_groups or gj in used_groups:
+            continue
+        used_groups.update((gi, gj))
+        chosen.append((i, j))
+    return chosen
+
+
+def search_for_good_permutation(weight2d, num_iters=10, chunk=64):
+    """Greedy vectorized permutation search.
+
+    Each sweep scores all pairwise swaps (jitted, chunked to bound
+    memory) and applies EVERY positive-gain swap whose groups don't
+    conflict, so convergence takes a handful of sweeps independent of C.
+    Returns (permutation indices [C], permuted weight).
+    """
+    w = jnp.asarray(weight2d, jnp.float32)
+    k, c = w.shape
+    assert c % 4 == 0, "C must be divisible by 4"
+    perm = np.arange(c)
+    for _ in range(num_iters):
+        gains = _swap_gains(w, chunk=chunk)
+        swaps = _disjoint_positive_swaps(gains)
+        if not swaps:
+            break
+        src = np.arange(c)
+        for i, j in swaps:
+            src[[i, j]] = src[[j, i]]
+        perm = perm[src]
+        w = w[:, src]
+    return perm, w
+
+
+def apply_permutation_in_C_dim(weight, perm):
+    """Permute input channels (last dim of a [K, C] weight; reference
+    permutation_lib.apply_permutation_in_C_dim)."""
+    return jnp.asarray(weight)[:, jnp.asarray(perm)]
+
+
+def apply_permutation_in_K_dim(weight, perm):
+    """Permute output channels (first dim) — applied to the producing
+    layer so the network function is preserved (reference
+    apply_permutation_in_K_dim)."""
+    return jnp.asarray(weight)[jnp.asarray(perm)]
+
+
+def permutation_improvement(weight2d, perm):
+    """(kept_before, kept_after) magnitude for reporting."""
+    before = float(sum_after_2_to_4(jnp.asarray(weight2d, jnp.float32)))
+    after = float(sum_after_2_to_4(
+        apply_permutation_in_C_dim(jnp.asarray(weight2d, jnp.float32), perm)))
+    return before, after

@@ -262,3 +262,53 @@ def test_multiproc_env_wiring(tmp_path):
         capture_output=True, text=True, cwd=repo_root)
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "host0:1234 4 2"
+
+
+# -- sparsity permutation search --------------------------------------------
+
+def test_permutation_search_improves_retained_magnitude(rng):
+    from apex_tpu.contrib.sparsity.permutation_lib import (
+        apply_permutation_in_C_dim,
+        permutation_improvement,
+        search_for_good_permutation,
+        sum_after_2_to_4,
+    )
+
+    # adversarial layout: large weights concentrated in the same 4-groups
+    # so the 2:4 mask must drop some; permuting spreads them out
+    w = rng.randn(8, 16).astype(np.float32) * 0.1
+    w[:, :4] += np.sign(w[:, :4]) * 3.0  # one hot group
+    w = jnp.asarray(w)
+
+    perm, w_perm = search_for_good_permutation(w, num_iters=30)
+    before, after = permutation_improvement(w, perm)
+    assert after > before, (before, after)
+    # permuted result matches applying perm to the original
+    np.testing.assert_allclose(
+        np.asarray(apply_permutation_in_C_dim(w, perm)), np.asarray(w_perm),
+        rtol=1e-6, atol=1e-6)
+    # perm is a permutation
+    assert sorted(perm.tolist()) == list(range(16))
+    # identity on already-uniform weights: no spurious swaps reduce kept sum
+    u = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    pu, wu = search_for_good_permutation(u, num_iters=5)
+    assert float(sum_after_2_to_4(wu)) >= float(sum_after_2_to_4(u)) - 1e-6
+
+
+def test_permutation_k_dim_inverse(rng):
+    from apex_tpu.contrib.sparsity.permutation_lib import (
+        apply_permutation_in_C_dim,
+        apply_permutation_in_K_dim,
+    )
+
+    # consumer permutes C; producer permutes K with the same perm: the
+    # composition y = W2 @ relu-free (W1 x) is preserved for linear chains
+    w1 = jnp.asarray(rng.randn(16, 8).astype(np.float32))  # [C=16 out, 8 in]
+    w2 = jnp.asarray(rng.randn(4, 16).astype(np.float32))  # consumes C=16
+    x = jnp.asarray(rng.randn(8).astype(np.float32))
+    perm = np.asarray(rng.permutation(16))
+    y_ref = w2 @ (w1 @ x)
+    y_perm = apply_permutation_in_C_dim(w2, perm) @ (
+        apply_permutation_in_K_dim(w1, perm) @ x)
+    np.testing.assert_allclose(np.asarray(y_perm), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
