@@ -13,15 +13,90 @@ entry points below work identically either way:
     pack_batch(samples, out)    -> batch size
 """
 
+import os
+
 import numpy as np
+
+
+def _build_in_place():
+    """Compile csrc/apex_tpu_C.cpp into the source tree on first import.
+
+    The reference requires an explicit `pip install --cpp_ext` step; here
+    the extension is one self-contained C++17 file, so an editable/source
+    checkout self-heals instead of silently running the numpy fallback.
+    Returns the imported module or None."""
+    import importlib.util
+    import shutil
+    import subprocess
+    import sysconfig
+    import warnings
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(here, "csrc", "apex_tpu_C.cpp")
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if not os.path.exists(src) or cxx is None:
+        return None
+    so = os.path.join(
+        here, "apex_tpu_C" + sysconfig.get_config_var("EXT_SUFFIX"))
+
+    def _load(path):
+        import sys
+
+        spec = importlib.util.spec_from_file_location("apex_tpu_C", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules["apex_tpu_C"] = mod  # later imports reuse this instance
+        return mod
+
+    # Serialize concurrent importers (the multiproc launcher's workers all
+    # import at once) behind an flock: one process compiles, the rest wait
+    # and load the finished artifact. Compile lands in a temp path then an
+    # atomic rename, so a crashed builder never leaves a truncated .so.
+    tmp = f"{so}.{os.getpid()}.tmp"
+    lock_path = so + ".lock"
+    try:
+        import fcntl
+
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(so):  # another process won the race
+                    return _load(so)
+                cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC",
+                       "-pthread", "-I" + sysconfig.get_path("include"),
+                       src, "-o", tmp]
+                proc = subprocess.run(cmd, capture_output=True, timeout=120)
+                if proc.returncode != 0:
+                    warnings.warn(
+                        "apex_tpu_C build failed; using the numpy "
+                        "fallback.\n"
+                        + proc.stderr.decode(errors="replace")[-2000:])
+                    return None
+                os.replace(tmp, so)
+                return _load(so)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+    except Exception as e:  # no write permission, timeout, bad artifact
+        warnings.warn(f"apex_tpu_C build unavailable ({e!r}); "
+                      "using the numpy fallback")
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
 
 try:
     import apex_tpu_C as _ext
 
     HAVE_NATIVE = True
 except ImportError:  # Python-only build (APEX_TPU_NO_EXT=1)
-    _ext = None
-    HAVE_NATIVE = False
+    _no_ext = os.environ.get("APEX_TPU_NO_EXT", "").lower() not in (
+        "", "0", "false", "no")
+    _ext = None if _no_ext else _build_in_place()
+    HAVE_NATIVE = _ext is not None
 
 
 def _require_contiguous(a, what):

@@ -26,16 +26,16 @@ class Generator(nn.Module):
         x = nn.ConvTranspose(self.ngf * 8, (4, 4), (1, 1), padding="VALID",
                              use_bias=False, dtype=self.dtype)(x)
         x = nn.relu(norm("bn1")(x))
-        x = nn.ConvTranspose(self.ngf * 4, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+        x = nn.ConvTranspose(self.ngf * 4, (4, 4), (2, 2), padding="SAME",
                              use_bias=False, dtype=self.dtype)(x)
         x = nn.relu(norm("bn2")(x))
-        x = nn.ConvTranspose(self.ngf * 2, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+        x = nn.ConvTranspose(self.ngf * 2, (4, 4), (2, 2), padding="SAME",
                              use_bias=False, dtype=self.dtype)(x)
         x = nn.relu(norm("bn3")(x))
-        x = nn.ConvTranspose(self.ngf, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+        x = nn.ConvTranspose(self.ngf, (4, 4), (2, 2), padding="SAME",
                              use_bias=False, dtype=self.dtype)(x)
         x = nn.relu(norm("bn4")(x))
-        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding=((1, 2), (1, 2)),
+        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding="SAME",
                              use_bias=False, dtype=self.dtype)(x)
         return jnp.tanh(x.astype(jnp.float32))
 

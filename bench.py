@@ -59,16 +59,21 @@ def main():
         new_params, new_opt_state = opt.step(grads, opt_state, params)
         return new_params, new_bs, new_opt_state, loss / scale
 
-    # warmup / compile
+    # warmup / compile. Timing ends with a host fetch of the loss, which
+    # is data-dependent on the whole step chain — an execution barrier
+    # equivalent to block_until_ready, and on the tunneled single-chip
+    # runtime used by the driver (axon) empirically the only one that
+    # waits for device completion (block_until_ready there returned ~40x
+    # early, reporting a physically impossible imgs/sec).
     out = train_step(params, batch_stats, opt_state, images, labels)
-    jax.block_until_ready(out)
+    float(out[3])
     out = train_step(*out[:3], images, labels)
-    jax.block_until_ready(out)
+    float(out[3])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         out = train_step(*out[:3], images, labels)
-    jax.block_until_ready(out)
+    float(out[3])  # host fetch = completion barrier for the whole chain
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * steps / dt

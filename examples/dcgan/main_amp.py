@@ -13,6 +13,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os
+import sys
+
+_d = os.path.dirname(os.path.abspath(__file__))
+while _d != os.path.dirname(_d) and not os.path.isdir(os.path.join(_d, "apex_tpu")):
+    _d = os.path.dirname(_d)
+sys.path.insert(0, _d)  # repo root (walk up: examples may be nested)
+
 from apex_tpu import amp
 from apex_tpu.models import Discriminator, Generator
 from apex_tpu.optimizers import FusedAdam

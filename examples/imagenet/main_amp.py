@@ -23,6 +23,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+import os
+import sys
+
+_d = os.path.dirname(os.path.abspath(__file__))
+while _d != os.path.dirname(_d) and not os.path.isdir(os.path.join(_d, "apex_tpu")):
+    _d = os.path.dirname(_d)
+sys.path.insert(0, _d)  # repo root (walk up: examples may be nested)
+
 from apex_tpu import amp
 from apex_tpu.models import ResNet50
 from apex_tpu.optimizers import FusedAdam, FusedSGD
@@ -137,6 +145,7 @@ def main():
     mean = jnp.asarray(MEAN)
     std = jnp.asarray(STD)
 
+    @jax.jit
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(P(), P(), P(), P("dp"), P("dp")),

@@ -13,6 +13,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+import os
+import sys
+
+_d = os.path.dirname(os.path.abspath(__file__))
+while _d != os.path.dirname(_d) and not os.path.isdir(os.path.join(_d, "apex_tpu")):
+    _d = os.path.dirname(_d)
+sys.path.insert(0, _d)  # repo root (walk up: examples may be nested)
+
 from apex_tpu import amp
 from apex_tpu.optimizers import FusedSGD
 from apex_tpu.parallel import DistributedDataParallel
@@ -40,6 +48,7 @@ def main():
     x = jnp.asarray(rng.randn(ndev * 8, N_FEATURES).astype(np.float32))
     y = jnp.asarray(rng.randn(ndev * 8, N_OUT).astype(np.float32))
 
+    @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(P(), P(), P("dp"), P("dp")),
                        out_specs=(P(), P(), P()),
