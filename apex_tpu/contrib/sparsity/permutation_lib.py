@@ -42,17 +42,19 @@ def sum_after_2_to_4(weight2d):
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
-def _swap_gains_chunk(weight2d, chunk, i_start):
-    """Swap gains for columns [i_start, i_start+chunk) vs ALL columns.
+def _replacement_chunk(weight2d, chunk, i_start):
+    """R[i, j] = kept(group(i) with i's slot replaced by column j), for
+    i in [i_start, i_start+chunk) and all j.
 
     Returns [chunk, C]. Memory is O(chunk * C * K * 4) — chunking over i
     bounds the replacement tensor the way the reference CUDA kernels
-    stripe their search.
+    stripe their search. The transposed term of the swap gain is R.T
+    (kept(g_j with slot j <- col i) = R[j, i]), so only this one matrix
+    is ever computed — the full gain assembles on the host.
     """
     k, c = weight2d.shape
     g = c // 4
     groups = weight2d.reshape(k, g, 4).transpose(1, 0, 2)  # [g, K, 4]
-    base = _group_kept_sum(groups)                          # [g]
     gid = jnp.arange(c) // 4
     pos = jnp.arange(c) % 4
     cols = weight2d.T                                       # [C, K]
@@ -65,28 +67,26 @@ def _swap_gains_chunk(weight2d, chunk, i_start):
         return jax.vmap(one)(jnp.arange(c))
 
     i_idx = i_start + jnp.arange(chunk)
-    rep_i = jax.vmap(rep_row)(i_idx)                        # [chunk, C]
-    # transposed term: kept(g_j with col j replaced by col i)
-    def rep_col(i):
-        def one(j):
-            grp = groups[gid[j]]
-            return _group_kept_sum(grp.at[:, pos[j]].set(cols[i]))
-        return jax.vmap(one)(jnp.arange(c))
-    rep_t = jax.vmap(rep_col)(i_idx)                        # [chunk, C]
-    gains = (rep_i - base[gid[i_idx]][:, None]) + (rep_t - base[gid][None, :])
-    same_group = gid[i_idx][:, None] == gid[None, :]
-    return jnp.where(same_group, 0.0, gains)
+    return jax.vmap(rep_row)(i_idx)                         # [chunk, C]
 
 
 def _swap_gains(weight2d, chunk=64):
-    """Full [C, C] swap-gain matrix, computed in jitted chunks."""
-    c = weight2d.shape[1]
+    """Full [C, C] swap-gain matrix:
+    gains[i, j] = (R[i, j] - base[g_i]) + (R[j, i] - base[g_j]),
+    zeroed within a group. R is computed once in jitted chunks."""
+    k, c = weight2d.shape
     chunk = min(chunk, c)
     rows = []
     for i0 in range(0, c, chunk):
         n = min(chunk, c - i0)
-        rows.append(np.asarray(_swap_gains_chunk(weight2d, n, i0)))
-    return np.concatenate(rows, axis=0)
+        rows.append(np.asarray(_replacement_chunk(weight2d, n, i0)))
+    rep = np.concatenate(rows, axis=0)                      # [C, C]
+    groups = np.asarray(weight2d).reshape(k, c // 4, 4).transpose(1, 0, 2)
+    base = np.asarray(_group_kept_sum(jnp.asarray(groups)))  # [g]
+    gid = np.arange(c) // 4
+    gains = (rep - base[gid][:, None]) + (rep.T - base[gid][None, :])
+    same_group = gid[:, None] == gid[None, :]
+    return np.where(same_group, 0.0, gains)
 
 
 def _disjoint_positive_swaps(gains, tol=1e-7):

@@ -39,7 +39,10 @@ class BertModel(nn.Module):
     def __call__(self, tokens, padding_mask=None, tokentype_ids=None,
                  position_ids=None):
         cfg = self.config
-        assert cfg.attn_mask_type == AttnMaskType.padding or True
+        assert cfg.attn_mask_type == AttnMaskType.padding, (
+            "BERT is bidirectional: config.attn_mask_type must be "
+            "AttnMaskType.padding (got causal; the transformer stack would "
+            "silently apply a causal mask)")
         emb = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
             params_dtype=cfg.params_dtype, name="word_embeddings")

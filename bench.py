@@ -1,9 +1,14 @@
-"""Benchmark: ResNet-50 training step, amp O2 + FusedAdam, imgs/sec/chip.
+"""Benchmark: the two headline metrics from BASELINE.json.
 
-This is BASELINE.json's headline metric ("ResNet-50 imgs/sec/chip (amp
-O2+FusedAdam)"). The reference publishes no number (BASELINE.md), so
-``vs_baseline`` is reported as 1.0 by convention until a measured baseline
-lands in BASELINE.json.
+    python bench.py [batch] [steps]        ResNet-50 amp O2 + FusedAdam
+                                           imgs/sec/chip  (default; the
+                                           driver runs this form)
+    python bench.py bert [batch] [steps]   BERT-large FusedLAMB
+                                           samples/sec/chip
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+reported as 1.0 by convention until a measured baseline lands in
+BASELINE.json.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -17,10 +22,72 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def bench_bert(batch, steps):
+    """BERT-large (24x1024, 16 heads, seq 128) MLM+NSP with FusedLAMB —
+    BASELINE.json metric 2 / config 4 (FusedLAMB + FusedLayerNorm)."""
+    from apex_tpu.models import BertModel, TransformerConfig, bert_loss_fn
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.enums import AttnMaskType
+
+    parallel_state.destroy_model_parallel()
+    seq = 128
+    cfg = TransformerConfig(
+        hidden_size=1024, num_layers=24, num_attention_heads=16,
+        vocab_size=30528, max_position_embeddings=512,
+        compute_dtype=jnp.bfloat16, use_flash_attention=False,
+        attn_mask_type=AttnMaskType.padding)
+    model = BertModel(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    padding_mask = jnp.ones((batch, seq), jnp.int32)
+    tokentype = jnp.zeros((batch, seq), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    loss_mask = jnp.asarray(
+        (rng.rand(batch, seq) < 0.15).astype(np.float32))
+    nsp_labels = jnp.asarray(rng.randint(0, 2, (batch,)))
+
+    params = model.init(jax.random.PRNGKey(0), tokens, padding_mask,
+                        tokentype)
+    opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state):
+        def loss_fn(p):
+            mlm, nsp = model.apply(p, tokens, padding_mask, tokentype)
+            return bert_loss_fn(mlm, nsp, labels, loss_mask, nsp_labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss
+
+    out = train_step(params, opt_state)
+    float(out[2])
+    out = train_step(*out[:2])
+    float(out[2])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = train_step(*out[:2])
+    float(out[2])  # host fetch = completion barrier (see resnet bench)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bert_large_fused_lamb_samples_per_sec_per_chip",
+        "value": round(batch * steps / dt, 2),
+        "unit": "samples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
 def main():
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import FusedAdam
+
+    if len(sys.argv) > 1 and sys.argv[1] == "bert":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+        return bench_bert(batch, steps)
 
     # batch 256 measured ~1.7x faster per chip than 128 on the v5e/v6e
     # class chip (better MXU utilization); 50 steps amortize dispatch

@@ -1,0 +1,347 @@
+"""Transformer auxiliary subsystems: fused softmax, microbatch
+calculators, TP data broadcast, RNG streams, batch samplers.
+
+Parity: reference tests/L0/run_transformer/{test_fused_softmax.py,
+test_microbatches.py, test_data.py, test_random.py, test_batch_sampler.py}.
+Oracles are plain jax.nn.softmax / hand-computed schedules, mirroring the
+reference's "fused kernel vs torch.nn.Softmax" strategy.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional.fused_softmax import (
+    FusedScaleMaskSoftmax,
+    GenericFusedScaleMaskSoftmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+def _mask_func(scores, mask):
+    return jnp.where(mask.astype(bool), -10000.0, scores)
+
+
+class TestFusedSoftmaxNumerics:
+    """Fused forms vs jax.nn.softmax oracle (reference test_fused_softmax
+    compares kernels against a torch softmax + explicit masking)."""
+
+    def test_scaled_softmax_matches_oracle(self, rng):
+        x = jnp.asarray(rng.randn(2, 4, 8, 16).astype(np.float32))
+        out = scaled_softmax(x, 0.7)
+        ref = jax.nn.softmax(x * 0.7, axis=-1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_scaled_masked_softmax_matches_oracle(self, rng):
+        x = jnp.asarray(rng.randn(2, 4, 8, 16).astype(np.float32))
+        mask = jnp.asarray(rng.rand(2, 1, 8, 16) < 0.3)
+        out = scaled_masked_softmax(x, mask, 0.5)
+        ref = jax.nn.softmax(jnp.where(mask, -1e9, x * 0.5), axis=-1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+        # masked-out positions carry exactly zero probability
+        assert float(jnp.abs(jnp.where(mask, out, 0.0)).max()) == 0.0
+
+    def test_causal_matches_oracle(self, rng):
+        x = jnp.asarray(rng.randn(8, 16, 16).astype(np.float32))
+        out = scaled_upper_triang_masked_softmax(x, 1.3)
+        causal = np.tril(np.ones((16, 16), bool))
+        ref = jax.nn.softmax(jnp.where(jnp.asarray(causal), x * 1.3, -1e9),
+                             axis=-1)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+        # strictly-upper entries are exactly zero
+        assert float(jnp.abs(jnp.where(jnp.asarray(~causal), out,
+                                       0.0)).max()) == 0.0
+
+    def test_causal_rows_sum_to_one_bf16(self, rng):
+        x = jnp.asarray(rng.randn(4, 32, 32).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        out = scaled_upper_triang_masked_softmax(x, 1.0)
+        assert out.dtype == jnp.bfloat16
+        sums = jnp.sum(out.astype(jnp.float32), axis=-1)
+        np.testing.assert_allclose(np.asarray(sums), 1.0, atol=1e-2)
+
+    def test_grad_matches_oracle(self, rng):
+        x = jnp.asarray(rng.randn(2, 2, 8, 8).astype(np.float32))
+        mask = jnp.asarray(rng.rand(2, 1, 8, 8) < 0.25)
+
+        def fused(x):
+            return jnp.sum(scaled_masked_softmax(x, mask, 0.9) ** 2)
+
+        def oracle(x):
+            return jnp.sum(
+                jax.nn.softmax(jnp.where(mask, -1e9, x * 0.9), -1) ** 2)
+
+        gf = jax.grad(fused)(x)
+        go = jax.grad(oracle)(x)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(go), atol=1e-5)
+
+
+class TestFusedSoftmaxDispatch:
+    """Reference heuristics (fused_softmax.py:222-246): kernel chosen only
+    for fp16/bf16, 16 < sk <= 16384, divisibility conditions."""
+
+    def make(self, mask_type=AttnMaskType.padding, fusion=True):
+        return FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=True, attn_mask_type=mask_type,
+            scaled_masked_softmax_fusion=fusion, mask_func=_mask_func,
+            softmax_in_fp32=True, scale=2.0)
+
+    def test_kernel_available_for_standard_shape(self):
+        sm = self.make()
+        assert sm.is_kernel_available(None, 2, 4, 32, 64)
+
+    def test_kernel_unavailable_small_sk(self):
+        assert not self.make().is_kernel_available(None, 2, 4, 32, 16)
+
+    def test_kernel_unavailable_without_fusion_flag(self):
+        assert not self.make(fusion=False).is_kernel_available(
+            None, 2, 4, 32, 64)
+
+    def test_kernel_unavailable_fp32_input(self):
+        sm = FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=False,
+            attn_mask_type=AttnMaskType.padding,
+            scaled_masked_softmax_fusion=True, mask_func=_mask_func,
+            softmax_in_fp32=True, scale=None)
+        assert not sm.is_kernel_available(None, 2, 4, 32, 64)
+
+    def test_fused_and_fallback_agree(self, rng):
+        sm = self.make()
+        x = jnp.asarray(rng.randn(2, 4, 32, 64).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        mask = jnp.asarray(rng.rand(2, 1, 32, 64) < 0.3)
+        fused = sm.forward_fused_softmax(x, mask)
+        fallback = sm.forward_torch_softmax(x, mask)
+        np.testing.assert_allclose(
+            np.asarray(fused.astype(jnp.float32)),
+            np.asarray(fallback.astype(jnp.float32)), atol=2e-2)
+
+    def test_causal_dispatch_applies_triangle(self, rng):
+        sm = self.make(mask_type=AttnMaskType.causal)
+        x = jnp.asarray(rng.randn(2, 4, 32, 32).astype(np.float32),
+                        dtype=jnp.bfloat16)
+        out = sm(x, None)
+        upper = jnp.triu(jnp.ones((32, 32), bool), k=1)
+        assert float(jnp.abs(jnp.where(upper, out.astype(jnp.float32),
+                                       0.0)).max()) == 0.0
+
+    def test_generic_always_available(self):
+        g = GenericFusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=False, mask_func=_mask_func,
+            softmax_in_fp32=True, scale=None)
+        assert g.is_kernel_available(None, 1, 1, 3, 5)
+
+    def test_scale_requires_fp32_softmax(self):
+        with pytest.raises(AssertionError):
+            FusedScaleMaskSoftmax(
+                input_in_fp16=False, input_in_bf16=True,
+                attn_mask_type=AttnMaskType.padding,
+                scaled_masked_softmax_fusion=True, mask_func=_mask_func,
+                softmax_in_fp32=False, scale=2.0)
+
+
+class TestMicrobatchCalculators:
+    """Reference tests/L0/run_transformer/test_microbatches.py."""
+
+    def test_constant(self):
+        from apex_tpu.transformer.microbatches import (
+            build_num_microbatches_calculator,
+        )
+
+        calc = build_num_microbatches_calculator(
+            rank=1, rampup_batch_size=None, global_batch_size=32,
+            micro_batch_size=2, data_parallel_size=4)
+        assert calc.get() == 4
+        assert calc.get_current_global_batch_size() == 32
+        calc.update(10_000, consistency_check=True)  # no-op
+        assert calc.get() == 4
+
+    def test_constant_indivisible_raises(self):
+        from apex_tpu.transformer.microbatches import ConstantNumMicroBatches
+
+        with pytest.raises(AssertionError):
+            ConstantNumMicroBatches(global_batch_size=30, micro_batch_size=4,
+                                    data_parallel_size=2)
+
+    def test_rampup_schedule(self):
+        from apex_tpu.transformer.microbatches import (
+            build_num_microbatches_calculator,
+        )
+
+        # 16 -> 32 in +8 steps over 64 samples: increments at 32-sample
+        # boundaries (2 increments, 32 samples each).
+        calc = build_num_microbatches_calculator(
+            rank=1, rampup_batch_size=[16, 8, 64], global_batch_size=32,
+            micro_batch_size=2, data_parallel_size=2)
+        assert calc.get_current_global_batch_size() == 16
+        assert calc.get() == 4
+        calc.update(32, consistency_check=True)
+        assert calc.get_current_global_batch_size() == 24
+        assert calc.get() == 6
+        calc.update(64, consistency_check=True)
+        assert calc.get_current_global_batch_size() == 32
+        calc.update(65, consistency_check=True)  # past ramp: final size
+        assert calc.get_current_global_batch_size() == 32
+        assert calc.get() == 8
+
+
+class TestBroadcastData:
+    """Reference tests/L0/run_transformer/test_data.py: the keyed dict
+    arrives identically on every tp rank."""
+
+    def test_broadcast_inside_tp_mesh(self, rng):
+        from apex_tpu.transformer.tensor_parallel.data import broadcast_data
+
+        devices = np.asarray(jax.devices()[:4])
+        mesh = Mesh(devices, ("tp",))
+        data = {"text": jnp.asarray(rng.randint(0, 100, (4, 8))),
+                "types": jnp.asarray(rng.randint(0, 2, (4, 8)))}
+
+        @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=(P("tp"), P("tp")), check_vma=False)
+        def f(text, types):
+            rank = jax.lax.axis_index("tp")
+            # simulate rank-divergent inputs: only rank 0 has real data
+            local = {"text": jnp.where(rank == 0, text, 0),
+                     "types": jnp.where(rank == 0, types, 0)}
+            out = broadcast_data(["text", "types"], local, jnp.int32)
+            return (out["text"][None], out["types"][None])
+
+        text_all, types_all = f(data["text"], data["types"])
+        for r in range(4):
+            np.testing.assert_array_equal(np.asarray(text_all[r]),
+                                          np.asarray(data["text"]))
+            np.testing.assert_array_equal(np.asarray(types_all[r]),
+                                          np.asarray(data["types"]))
+
+
+class TestRNGStreams:
+    """Reference tests/L0/run_transformer/test_random.py semantics."""
+
+    def test_seed_layout(self):
+        from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+        tp_random.model_parallel_xla_manual_seed(123)
+        tr = tp_random.get_rng_state_tracker()
+        states = tr.get_states()
+        assert set(states) == {"default",
+                               tp_random.model_parallel_rng_tracker_name()}
+
+    def test_fork_advances_stream(self):
+        from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+        tp_random.model_parallel_xla_manual_seed(123)
+        tr = tp_random.get_rng_state_tracker()
+        with tr.fork() as k1:
+            a = jax.random.normal(k1, (4,))
+        with tr.fork() as k2:
+            b = jax.random.normal(k2, (4,))
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    def test_set_get_states_roundtrip_reproduces(self):
+        from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+        tp_random.model_parallel_xla_manual_seed(7)
+        tr = tp_random.get_rng_state_tracker()
+        saved = tr.get_states()
+        with tr.fork() as k:
+            a = jax.random.normal(k, (4,))
+        tr.set_states(saved)
+        with tr.fork() as k:
+            b = jax.random.normal(k, (4,))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_duplicate_add_raises(self):
+        from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+        tp_random.model_parallel_xla_manual_seed(1)
+        tr = tp_random.get_rng_state_tracker()
+        with pytest.raises(Exception):
+            tr.add("default", 5)
+
+    def test_fold_in_tp_rank_differs_per_rank(self):
+        from apex_tpu.transformer.tensor_parallel.random import (
+            fold_in_tp_rank,
+        )
+
+        devices = np.asarray(jax.devices()[:4])
+        mesh = Mesh(devices, ("tp",))
+
+        @functools.partial(jax.shard_map, mesh=mesh, in_specs=P(),
+                           out_specs=P("tp"), check_vma=False)
+        def f(key):
+            k = fold_in_tp_rank(key)
+            return jax.random.normal(k, (3,))[None]
+
+        out = np.asarray(f(jax.random.PRNGKey(0)))
+        for r in range(1, 4):
+            assert not np.allclose(out[0], out[r])
+
+
+class TestBatchSamplers:
+    """Reference tests/L0/run_transformer/test_batch_sampler.py."""
+
+    def test_sequential_shards_disjoint_and_ordered(self):
+        from apex_tpu.transformer._data._batchsampler import (
+            MegatronPretrainingSampler,
+        )
+
+        shards = []
+        for rank in range(2):
+            s = MegatronPretrainingSampler(
+                total_samples=16, consumed_samples=0, micro_batch_size=2,
+                data_parallel_rank=rank, data_parallel_size=2)
+            shards.append(list(s))
+        # each global granule of 4 splits 2/2 between the ranks
+        assert shards[0][0] == [0, 1] and shards[1][0] == [2, 3]
+        flat = sorted(i for sh in shards for b in sh for i in b)
+        assert flat == list(range(16))
+
+    def test_sequential_resume_from_consumed(self):
+        from apex_tpu.transformer._data._batchsampler import (
+            MegatronPretrainingSampler,
+        )
+
+        s = MegatronPretrainingSampler(
+            total_samples=16, consumed_samples=8, micro_batch_size=2,
+            data_parallel_rank=0, data_parallel_size=2)
+        assert list(s)[0] == [8, 9]
+
+    def test_sequential_drop_last(self):
+        from apex_tpu.transformer._data._batchsampler import (
+            MegatronPretrainingSampler,
+        )
+
+        kw = dict(total_samples=10, consumed_samples=0, micro_batch_size=2,
+                  data_parallel_rank=0, data_parallel_size=2)
+        assert len(list(MegatronPretrainingSampler(drop_last=True, **kw))) == 2
+        assert len(list(MegatronPretrainingSampler(drop_last=False,
+                                                   **kw))) == 3
+
+    def test_random_sampler_covers_shard_deterministically(self):
+        from apex_tpu.transformer._data._batchsampler import (
+            MegatronPretrainingRandomSampler,
+        )
+
+        def collect(rank):
+            s = MegatronPretrainingRandomSampler(
+                total_samples=16, consumed_samples=0, micro_batch_size=2,
+                data_parallel_rank=rank, data_parallel_size=2, seed=5)
+            return [b for b, _ in zip(iter(s), range(4))]
+
+        a0, a1 = collect(0), collect(1)
+        assert collect(0) == a0  # same seed/epoch -> same order
+        flat0 = {i for b in a0 for i in b}
+        flat1 = {i for b in a1 for i in b}
+        assert flat0.isdisjoint(flat1)
+        assert len(flat0) == 8 and len(flat1) == 8

@@ -1,0 +1,98 @@
+"""Perf breakdown for the ResNet-50 bench: where does the step time go?
+
+Variants timed on the real chip (host-fetch barrier, see bench.py):
+  fwd        — forward pass only (bf16)
+  fwd+bwd    — value_and_grad, no optimizer
+  full O2    — the bench.py step (amp O2 + FusedAdam)
+  full O2 donate — same with buffer donation
+  full O0    — fp32, plain FusedAdam
+
+Usage: python tools/bench_sweep.py [batch] [steps]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from apex_tpu import amp
+from apex_tpu.models import ResNet50
+from apex_tpu.optimizers import FusedAdam
+
+
+def timed(fn, args, steps, chain, fetch):
+    out = fn(*args)
+    fetch(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*chain(out, args))
+    fetch(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, 224, 224, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+    params0, bs0 = variables["params"], variables["batch_stats"]
+
+    def loss_of(p, bs):
+        logits, updates = model.apply(
+            {"params": p, "batch_stats": bs}, images, train=True,
+            mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return (-jnp.mean(jnp.take_along_axis(logp, labels[:, None], -1)),
+                updates["batch_stats"])
+
+    # --- forward only
+    pbf = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params0)
+    fwd = jax.jit(lambda p: loss_of(p, bs0)[0])
+    dt = timed(fwd, (pbf,), steps, lambda o, a: a, lambda o: float(o))
+    print(f"fwd-only:        {batch/dt:9.1f} imgs/s  ({dt*1e3:.1f} ms)")
+
+    # --- fwd+bwd
+    fb = jax.jit(lambda p: jax.value_and_grad(
+        lambda q: loss_of(q, bs0)[0])(p))
+    dt = timed(fb, (pbf,), steps, lambda o, a: a, lambda o: float(o[0]))
+    print(f"fwd+bwd:         {batch/dt:9.1f} imgs/s  ({dt*1e3:.1f} ms)")
+
+    # --- full amp O2 step (bench.py step)
+    def make_step(opt, donate):
+        def train_step(params, batch_stats, opt_state):
+            def loss_fn(p):
+                l, b = loss_of(p, batch_stats)
+                return l * opt_state["scaler"].loss_scale, b
+
+            (sl, nbs), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            np_, ns = opt.step(g, opt_state, params)
+            return np_, nbs, ns, sl
+        kw = dict(donate_argnums=(0, 1, 2)) if donate else {}
+        return jax.jit(train_step, **kw)
+
+    for label, opt_level, donate in [("full O2:       ", "O2", False),
+                                     ("full O2 donate:", "O2", True),
+                                     ("full O0:       ", "O0", False),
+                                     ("full O0 donate:", "O0", True)]:
+        p, opt = amp.initialize(params0, FusedAdam(lr=1e-3),
+                                opt_level=opt_level, verbosity=0)
+        st = opt.init(p)
+        step = make_step(opt, donate)
+        # fresh batch_stats per variant: donate variants delete theirs
+        bs = jax.tree.map(jnp.copy, bs0)
+        dt = timed(step, (p, bs, st), steps,
+                   lambda o, a: o[:3], lambda o: float(o[3]))
+        print(f"{label} {batch/dt:9.1f} imgs/s  ({dt*1e3:.1f} ms)")
+
+
+if __name__ == "__main__":
+    main()
