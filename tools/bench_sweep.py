@@ -6,6 +6,7 @@ Variants timed on the real chip (host-fetch barrier, see bench.py):
   full O2    — the bench.py step (amp O2 + FusedAdam)
   full O2 donate — same with buffer donation
   full O0    — fp32, plain FusedAdam
+  full O0 donate — fp32 with buffer donation
 
 Usage: python tools/bench_sweep.py [batch] [steps]
 """
