@@ -1,0 +1,115 @@
+"""Shared 3D-parallel (pp x dp x tp) GPT training harness.
+
+One full training step — 1F1B pipeline schedule, DP grad pmean, sequence-
+parallel grad allreduce, model-parallel GradScaler, fused optimizer —
+shard_mapped over the global mesh. Used by both the driver entry
+(``__graft_entry__.dryrun_multichip``) and the minimal end-to-end test
+(tests/L0/test_gpt_minimal.py), mirroring how the reference ships its
+integration-test harness inside the package
+(apex/transformer/testing/standalone_gpt.py + commons.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.gpt_stage import GPTStage
+from apex_tpu.models.transformer_lm import is_sequence_parallel_param
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_pipelining_without_interleaving,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    allreduce_sequence_parallel_grads,
+)
+
+
+def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
+                         num_microbatches):
+    """Return ``(init_state, step)`` for a pipelined GPT training loop.
+
+    ``init_state(key, tokens, labels)`` builds per-stage stacked params,
+    optimizer state, and scaler state. ``step(stacked_params, stacked_opt,
+    scaler_state, tokens, labels)`` is jitted and returns the new state
+    plus the per-(pp, dp)-cell loss grid; only the last pipeline stage's
+    rows are nonzero.
+
+    ``tokens``/``labels`` are [global_batch, seq] with
+    global_batch = microbatch * num_microbatches * dp.
+    """
+    stage = GPTStage(cfg, cfg.num_layers // pp)
+    MB, M = microbatch, num_microbatches
+    # Activations crossing stage boundaries: [s(/tp under SP), mb, h]
+    seq_shard = seq // mesh.shape.get("tp", 1) if cfg.sequence_parallel \
+        else seq
+    tensor_shape = (seq_shard, MB, cfg.hidden_size)
+
+    def stage_fn(params, h, mb, is_first):
+        return stage.apply({"params": params}, mb["tokens"], h, is_first)
+
+    def loss_fn(params, y, mb):
+        return stage.apply({"params": params}, y, mb["labels"],
+                           method=GPTStage.loss)
+
+    def train_step(params, opt_state, scaler_state, tokens, labels):
+        mbs = {"tokens": tokens.reshape(M, MB, seq),
+               "labels": labels.reshape(M, MB, seq)}
+        # scale the loss up by the live scale; unscale_grads divides it
+        # back out (and pmaxes found_inf over tp x pp)
+        losses, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, params, mbs, num_microbatches=M,
+            tensor_shape=tensor_shape, dtype=jnp.bfloat16,
+            grad_scale=scaler_state.loss_scale, pp_size=pp)
+        # DP gradient sync (DDP semantics: average over the dp axis).
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, "dp"), grads)
+        # SP: grads of tp-replicated params (layernorms, position
+        # embeddings, row-parallel biases) are partial over seq shards —
+        # allreduce them over tp (reference layer_norm.py:26-99 tagging).
+        if cfg.sequence_parallel:
+            grads = allreduce_sequence_parallel_grads(
+                grads, is_sequence_parallel_param)
+        grads, found_inf = scaler.unscale_grads(grads, scaler_state)
+        new_params, new_opt_state = opt.step(
+            grads, opt_state, params, found_inf=found_inf)
+        new_scaler_state = scaler.update(scaler_state, found_inf)
+        return new_params, new_opt_state, new_scaler_state, jnp.sum(losses)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("pp"), P("pp"), P(), P("dp"), P("dp")),
+        out_specs=(P("pp"), P("pp"), P(), P(("pp", "dp"))),
+        check_vma=False)
+    def sharded_step(stacked_params, stacked_opt, scaler_state, tok, lab):
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0], stacked_opt)
+        p, o, s, l = train_step(params, opt_state, scaler_state,
+                                tok.reshape(-1, seq), lab.reshape(-1, seq))
+        stack = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)  # noqa: E731
+        return stack(p), stack(o), s, l.reshape(1, 1)
+
+    # Per-stage params: init under shard_map so TP layers see local shards.
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(), P(), P()), out_specs=P("pp"),
+                       check_vma=False)
+    def init_params(key, tok, lab):
+        rank = jax.lax.axis_index("pp")
+        key = jax.random.fold_in(key, rank)
+        h0 = jnp.zeros(tensor_shape, jnp.bfloat16)
+        variables = stage.init(key, tok[:MB], h0, jnp.asarray(False),
+                               lab[:MB], method=GPTStage.full)
+        return jax.tree_util.tree_map(lambda a: a[None],
+                                      variables["params"])
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("pp"),
+                       out_specs=P("pp"), check_vma=False)
+    def init_opt(stacked_params):
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        return jax.tree_util.tree_map(lambda a: a[None], opt.init(params))
+
+    def init_state(key, tokens, labels):
+        stacked_params = init_params(key, tokens[:MB], labels[:MB])
+        return stacked_params, init_opt(stacked_params), scaler.init_state()
+
+    return init_state, jax.jit(sharded_step)

@@ -13,6 +13,7 @@ BASELINE.json.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
+import functools
 import json
 import sys
 import time
@@ -52,7 +53,7 @@ def bench_bert(batch, steps):
     opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
     opt_state = opt.init(params)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state):
         def loss_fn(p):
             mlm, nsp = model.apply(p, tokens, padding_mask, tokentype)
@@ -108,6 +109,11 @@ def main():
                                  verbosity=0)
     opt_state = opt.init(params)
 
+    # NOTE: no donation here — donating any of this step's buffers
+    # (params, batch_stats or opt_state, in any combination) trips an
+    # INVALID_ARGUMENT in the tunneled TPU backend and wedges the device
+    # session; the BERT bench's donation works fine. Revisit on a
+    # directly-attached runtime.
     @jax.jit
     def train_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):

@@ -7,28 +7,16 @@ CPU mesh, 1F1B pipeline schedule, sequence parallelism on, DP grad pmean,
 model-parallel GradScaler, FusedAdam with master weights.
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import PartitionSpec as P
 
-from apex_tpu.models.gpt_stage import GPTStage
-from apex_tpu.models.transformer_lm import (
-    TransformerConfig,
-    is_sequence_parallel_param,
-)
+from apex_tpu.models.transformer_lm import TransformerConfig
 from apex_tpu.optimizers import FusedAdam
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.amp.grad_scaler import GradScaler
-from apex_tpu.transformer.pipeline_parallel.schedules import (
-    forward_backward_pipelining_without_interleaving,
-)
-from apex_tpu.transformer.tensor_parallel.layers import (
-    allreduce_sequence_parallel_grads,
-)
+from apex_tpu.transformer.testing.gpt_3d import build_gpt_3d_harness
 
 PP, DP, TP = 2, 2, 2
 SEQ, MB, M = 16, 2, 2  # seq, microbatch, num microbatches
@@ -51,7 +39,6 @@ def gpt_setup():
 
 def test_gpt_3d_parallel_training_loss_decreases(gpt_setup):
     mesh, cfg = gpt_setup
-    stage = GPTStage(cfg, cfg.num_layers // PP)
     global_b = MB * M * DP
     rng = np.random.RandomState(0)
     # A learnable (repetitive) token stream so a few steps visibly reduce
@@ -62,72 +49,12 @@ def test_gpt_3d_parallel_training_loss_decreases(gpt_setup):
 
     opt = FusedAdam(lr=5e-3, master_weights=True)
     scaler = GradScaler(enabled=True)
-    tensor_shape = (SEQ // TP, MB, cfg.hidden_size)
+    init_state, step = build_gpt_3d_harness(
+        cfg, mesh, opt, scaler, pp=PP, seq=SEQ, microbatch=MB,
+        num_microbatches=M)
 
-    def stage_fn(params, h, mb, is_first):
-        return stage.apply({"params": params}, mb["tokens"], h, is_first)
-
-    def loss_fn(params, y, mb):
-        return stage.apply({"params": params}, y, mb["labels"],
-                           method=GPTStage.loss)
-
-    def train_step(params, opt_state, scaler_state, tokens, labels):
-        mbs = {"tokens": tokens.reshape(M, MB, SEQ),
-               "labels": labels.reshape(M, MB, SEQ)}
-        # scale the loss up by the live scale; unscale_grads divides it
-        # back out (and pmaxes found_inf over tp x pp)
-        losses, grads = forward_backward_pipelining_without_interleaving(
-            stage_fn, loss_fn, params, mbs, num_microbatches=M,
-            tensor_shape=tensor_shape, dtype=jnp.bfloat16,
-            grad_scale=scaler_state.loss_scale, pp_size=PP)
-        grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.pmean(g, "dp"), grads)
-        grads = allreduce_sequence_parallel_grads(
-            grads, is_sequence_parallel_param)
-        grads, found_inf = scaler.unscale_grads(grads, scaler_state)
-        new_params, new_opt_state = opt.step(
-            grads, opt_state, params, found_inf=found_inf)
-        new_scaler_state = scaler.update(scaler_state, found_inf)
-        return new_params, new_opt_state, new_scaler_state, jnp.sum(losses)
-
-    @functools.partial(
-        jax.shard_map, mesh=mesh,
-        in_specs=(P("pp"), P("pp"), P(), P("dp"), P("dp")),
-        out_specs=(P("pp"), P("pp"), P(), P(("pp", "dp"))),
-        check_vma=False)
-    def sharded_step(stacked_params, stacked_opt, scaler_state, tok, lab):
-        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
-        opt_state = jax.tree_util.tree_map(lambda a: a[0], stacked_opt)
-        p, o, s, l = train_step(params, opt_state, scaler_state,
-                                tok.reshape(-1, SEQ), lab.reshape(-1, SEQ))
-        stack = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)  # noqa: E731
-        return stack(p), stack(o), s, l.reshape(1, 1)
-
-    @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(P(), P(), P()), out_specs=P("pp"),
-                       check_vma=False)
-    def init_params(key, tok, lab):
-        rank = jax.lax.axis_index("pp")
-        key = jax.random.fold_in(key, rank)
-        h0 = jnp.zeros(tensor_shape, jnp.bfloat16)
-        variables = stage.init(key, tok[:MB], h0, jnp.asarray(False),
-                               lab[:MB], method=GPTStage.full)
-        return jax.tree_util.tree_map(lambda a: a[None], variables["params"])
-
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("pp"),
-                       out_specs=P("pp"), check_vma=False)
-    def init_opt(stacked_params):
-        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
-        return jax.tree_util.tree_map(lambda a: a[None], opt.init(params))
-
-    stacked_params = init_params(jax.random.PRNGKey(0), tokens[:MB],
-                                 labels[:MB])
-    stacked_opt = init_opt(stacked_params)
-    scaler_state = scaler.init_state()
-
-    step = jax.jit(sharded_step)
     losses = []
-    state = (stacked_params, stacked_opt, scaler_state)
+    state = init_state(jax.random.PRNGKey(0), tokens, labels)
     for _ in range(12):
         *state, loss = step(*state, tokens, labels)
         # only the last pp stage contributes a nonzero loss; sum over the
