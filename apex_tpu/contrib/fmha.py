@@ -6,12 +6,17 @@ apex/contrib/multihead_attn (CUTLASS-based fused attention). The TPU
 version is a general flash-attention: online-softmax over KV blocks, fp32
 accumulators, causal or full, any seq multiple of the block size.
 
-Forward is a Pallas kernel over a 3-D grid (batch*heads x q-blocks x
-kv-blocks, kv innermost/"arbitrary"): K/V stream through VMEM one
-[block_k, d] tile at a time with running (acc, max, sum) scratch state, so
-VMEM use is independent of sequence length (validated to seq 65536
-on-chip; see PERF.md). Backward rematerializes through the reference
-einsum path (a Pallas backward kernel is the planned next optimization).
+Forward and backward are Pallas kernels over 3-D grids (batch*heads x
+outer-blocks x streamed-blocks, innermost/"arbitrary"): K/V (forward, dq)
+or Q/dO (dk/dv) stream through VMEM one tile at a time with fp32 scratch
+accumulators, so VMEM use is independent of sequence length (validated to
+seq 65536 on-chip; see PERF.md). The forward emits the per-row
+log-sum-exp; the backward recomputes p = exp(q k^T scale - lse) per tile
+(flash-attention v2 style) instead of materializing the [s, s] matrix.
+Off-TPU both passes fall back to the reference einsum path; on TPU,
+sequence lengths that no block fits (not a multiple of any of 512/256/128
+and larger than 512) fall back the same way, while short sequences use
+the whole sequence as one block.
 """
 
 import functools
@@ -39,8 +44,17 @@ def _use_pallas():
         return False
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                      scale, causal, block_q, block_k, num_kv):
+def _causal_mask(scores, qi, kj, block_q, block_k):
+    """Mask score entries above the diagonal for a (qi, kj) block pair."""
+    q_ids = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_ids = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_ids >= k_ids, scores, NEG_INF)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                      l_ref, *, scale, causal, block_q, block_k, num_kv):
     """One (head, q-block, kv-block) grid cell of online-softmax attention.
 
     K/V arrive as [1, block_k, d] VMEM tiles streamed by the grid — VMEM
@@ -70,11 +84,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            q_ids = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_ids = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_cur = jnp.max(s, axis=-1)[:, None]
@@ -90,6 +100,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finish():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # log-sum-exp of the scaled scores, for the backward kernels
+        lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
@@ -119,7 +131,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
         def kv_index(h, i, j):
             return (h, j, 0)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -130,9 +142,16 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), kv_index,
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * n, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * n, s, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -142,7 +161,184 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
     )(q3, k3, v3)
-    return out.reshape(b, n, s, d)
+    return out.reshape(b, n, s, d), lse.reshape(b, n, s)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                     num_kv):
+    """dq for one q block, streaming kv blocks (innermost grid dim):
+    p = exp(q k^T scale - lse); ds = p * (do v^T - delta); dq += ds k scale.
+    """
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc[...] += jnp.dot(ds, k,
+                               preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kj == num_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                      block_q, block_k, num_q):
+    """dk/dv for one kv block, streaming q blocks (innermost grid dim):
+    dv += p^T do;  dk += ds^T q scale."""
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # Causal: q blocks entirely above this kv block contribute nothing.
+    run = ((qi + 1) * block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dv_acc[...] += jnp.dot(p.T, do,
+                               preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc[...] += jnp.dot(ds.T, q,
+                               preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
+                      block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n, s, d = q.shape
+    q3, k3, v3 = (x.reshape(b * n, s, d) for x in (q, k, v))
+    o3, do3 = (x.reshape(b * n, s, d) for x in (o, do))
+    lse3 = lse.reshape(b * n, s, 1)
+    # delta_i = rowsum(do_i * o_i) — cheap elementwise+reduce, XLA-fused
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    num_q = s // block_q
+    num_kv = s // block_k
+
+    if causal:
+        def kv_index(h, i, j):
+            last = ((i + 1) * block_q - 1) // block_k
+            return (h, jnp.minimum(j, last), 0)
+
+        def q_index_for_kv(h, j, i):
+            first = (j * block_k) // block_q
+            return (h, jnp.maximum(i, first), 0)
+    else:
+        kv_index = lambda h, i, j: (h, j, 0)            # noqa: E731
+        q_index_for_kv = lambda h, j, i: (h, i, 0)      # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kv=num_kv),
+        grid=(b * n, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), kv_index,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * n, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(q3, k3, v3, do3, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=num_q),
+        grid=(b * n, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), q_index_for_kv,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), q_index_for_kv,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), q_index_for_kv,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), q_index_for_kv,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * n, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(k3, v3, q3, do3, lse3, delta)
+
+    rs = lambda x: x.reshape(b, n, s, d)  # noqa: E731
+    return rs(dq), rs(dk), rs(dv)
 
 
 def _attention_reference(q, k, v, scale, causal):
@@ -169,30 +365,40 @@ def _fit_block(block, s):
     return None
 
 
+def _resolve(q, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = q.shape[-2]
+    return scale, _fit_block(block_q, s), _fit_block(block_k, s)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=True, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Flash attention over [batch, heads, seq, head_dim] inputs."""
-    if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = q.shape[-2]
-    bq, bk = _fit_block(block_q, s), _fit_block(block_k, s)
+    scale, bq, bk = _resolve(q, scale, block_q, block_k)
     if _use_pallas() and bq is not None and bk is not None:
-        return _flash_fwd_pallas(q, k, v, scale, causal, bq, bk)
+        return _flash_fwd_pallas(q, k, v, scale, causal, bq, bk)[0]
     return _attention_reference(q, k, v, scale, causal)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    scale_, bq, bk = _resolve(q, scale, block_q, block_k)
+    if _use_pallas() and bq is not None and bk is not None:
+        out, lse = _flash_fwd_pallas(q, k, v, scale_, causal, bq, bk)
+        return out, (q, k, v, out, lse)
+    return _attention_reference(q, k, v, scale_, causal), (q, k, v, None,
+                                                           None)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
+    q, k, v, out, lse = res
+    scale_, bq, bk = _resolve(q, scale, block_q, block_k)
+    if lse is not None and _use_pallas():
+        return _flash_bwd_pallas(q, k, v, out, lse, g, scale_, causal,
+                                 bq, bk)
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale, causal),
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale_, causal),
         q, k, v)
     return vjp(g)
 

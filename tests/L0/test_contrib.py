@@ -56,6 +56,31 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("bq,bk", [(64, 64), (64, 128), (128, 64)])
+    def test_streamed_backward_multiblock(self, rng, causal, bq, bk):
+        """The Pallas dq/dkv kernels stream multiple blocks here (s=256)
+        including unequal block_q/block_k — covers accumulator
+        init/finish and both causal clamp derivations."""
+        b, n, s, d = 1, 2, 256, 64
+        q = jnp.asarray(rng.randn(b, n, s, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, n, s, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, n, s, d).astype(np.float32))
+
+        def f(q_, k_, v_):
+            return jnp.sum(
+                flash_attention(q_, k_, v_, causal, None, bq, bk) ** 2)
+
+        def f_ref(q_, k_, v_):
+            return jnp.sum(_attention_reference(
+                q_, k_, v_, 1.0 / np.sqrt(d), causal) ** 2)
+
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
 
 class TestXentropy:
     def test_matches_torch(self, rng):
