@@ -368,6 +368,14 @@ def _fit_block(block, s):
 def _resolve(q, scale, block_q, block_k):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    elif not isinstance(scale, (int, float)):
+        # scale sits in custom_vjp nondiff_argnums: a traced value (e.g.
+        # 1/jnp.sqrt(d)) surfaces as a cryptic UnexpectedTracerError deep
+        # inside autodiff — fail fast with the actual contract instead.
+        raise TypeError(
+            "flash_attention scale must be a python number (it is a "
+            f"static argument of the custom_vjp), got {type(scale)}; "
+            "pass scale=None for the 1/sqrt(head_dim) default")
     s = q.shape[-2]
     return scale, _fit_block(block_q, s), _fit_block(block_k, s)
 

@@ -85,7 +85,11 @@ class ParallelAttention(nn.Module):
         qkv = qkv.reshape(seq_full, b, np_local, 3 * kv)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        if cfg.use_flash_attention and _flash_available(seq_full, kv):
+        # flash handles only the built-in causal/full patterns: an
+        # explicit attention_mask (e.g. padding) must take the masked
+        # softmax path below or it would be silently ignored.
+        if (cfg.use_flash_attention and attention_mask is None
+                and _flash_available(seq_full, kv)):
             from apex_tpu.contrib.fmha import flash_attention
 
             # [s, b, n, d] -> [b, n, s, d]
@@ -94,8 +98,7 @@ class ParallelAttention(nn.Module):
             vt = v.transpose(1, 2, 0, 3)
             ctx = flash_attention(
                 qt, kt, vt,
-                causal=(cfg.attn_mask_type == AttnMaskType.causal),
-                scale=1.0 / jnp.sqrt(kv).astype(jnp.float32))
+                causal=(cfg.attn_mask_type == AttnMaskType.causal))
             ctx = ctx.transpose(2, 0, 1, 3)  # [s, b, n, d]
         else:
             # core attention (reference CoreAttention): [b, n, s, s] scores

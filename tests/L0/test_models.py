@@ -95,3 +95,39 @@ def test_dcgan_one_amp_step_finite(rng):
     assert np.isfinite(gmax)
     for leaf in jax.tree_util.tree_leaves(pD2):
         assert bool(jnp.isfinite(leaf.astype(jnp.float32)).all())
+
+
+def test_gpt_flash_attention_path_jits(monkeypatch, rng):
+    """The model-level flash path must survive jit+grad (regression: the
+    attention layer once passed a traced jnp scale into the flash
+    custom_vjp's static nondiff argument, blowing up only when
+    use_flash_attention was actually enabled on TPU)."""
+    import apex_tpu.contrib.fmha as fmha_mod
+    import apex_tpu.models.transformer_lm as tlm
+
+    monkeypatch.setattr(fmha_mod, "_INTERPRET", True)
+    monkeypatch.setattr(fmha_mod, "_use_pallas", lambda: True)
+    monkeypatch.setattr(tlm, "_flash_available", lambda s, d: True)
+
+    from apex_tpu.models import GPTModel, TransformerConfig
+
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=1,
+        vocab_size=128, max_position_embeddings=128,
+        compute_dtype=jnp.float32, use_flash_attention=True)
+    model = GPTModel(cfg)
+    tokens = jnp.asarray(rng.randint(0, 128, (1, 128)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    @jax.jit
+    def loss_and_grad(p):
+        def loss_fn(p):
+            logits = model.apply(p, tokens).astype(jnp.float32)
+            return jnp.mean(logits ** 2)
+        return jax.value_and_grad(loss_fn)(p)
+
+    loss, grads = loss_and_grad(params)
+    assert bool(jnp.isfinite(loss))
+    gmax = max(float(jnp.abs(x).max())
+               for x in jax.tree_util.tree_leaves(grads))
+    assert np.isfinite(gmax) and gmax > 0
