@@ -366,9 +366,11 @@ def _fit_block(block, s):
 
 
 def _resolve(q, scale, block_q, block_k):
+    import numbers
+
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    elif not isinstance(scale, (int, float)):
+    elif not isinstance(scale, numbers.Number):
         # scale sits in custom_vjp nondiff_argnums: a traced value (e.g.
         # 1/jnp.sqrt(d)) surfaces as a cryptic UnexpectedTracerError deep
         # inside autodiff — fail fast with the actual contract instead.

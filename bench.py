@@ -5,6 +5,8 @@
                                            driver runs this form)
     python bench.py bert [batch] [steps]   BERT-large FusedLAMB
                                            samples/sec/chip
+    python bench.py gpt [seq] [steps]      long-context GPT (16x1024,
+                                           flash attention) tokens/sec/chip
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
@@ -21,6 +23,23 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _time_steps(train_step, state, steps, loss_index):
+    """Warm up (compile + one steady step), then time `steps` chained
+    steps. Each boundary is a host fetch of the loss — data-dependent on
+    the whole step chain, the only reliable completion barrier on the
+    tunneled TPU runtime (block_until_ready returns early there; see the
+    resnet bench note). Returns (elapsed_seconds, final_out)."""
+    out = train_step(*state)
+    float(out[loss_index])
+    out = train_step(*out[:loss_index])
+    float(out[loss_index])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = train_step(*out[:loss_index])
+    float(out[loss_index])
+    return time.perf_counter() - t0, out
 
 
 def bench_bert(batch, steps):
@@ -63,19 +82,54 @@ def bench_bert(batch, steps):
         new_params, new_opt_state = opt.step(grads, opt_state, params)
         return new_params, new_opt_state, loss
 
-    out = train_step(params, opt_state)
-    float(out[2])
-    out = train_step(*out[:2])
-    float(out[2])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = train_step(*out[:2])
-    float(out[2])  # host fetch = completion barrier (see resnet bench)
-    dt = time.perf_counter() - t0
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
     print(json.dumps({
         "metric": "bert_large_fused_lamb_samples_per_sec_per_chip",
         "value": round(batch * steps / dt, 2),
         "unit": "samples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+def bench_gpt_long(seq, steps):
+    """Long-context GPT (16 layers x 1024, flash attention) — the
+    capability beyond the reference (its long-context story is SP only;
+    SURVEY.md §5). Numbers in PERF.md."""
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    cfg = TransformerConfig(
+        hidden_size=1024, num_layers=16, num_attention_heads=16,
+        vocab_size=32000, max_position_embeddings=seq,
+        compute_dtype=jnp.bfloat16, use_flash_attention=True)
+    model = GPTModel(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, seq)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state):
+        def loss_fn(p):
+            logp = jax.nn.log_softmax(
+                model.apply(p, tokens).astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(logp, labels[..., None],
+                                                 -1))
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss
+
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
+    print(json.dumps({
+        "metric": f"gpt_long_context_seq{seq}_tokens_per_sec_per_chip",
+        "value": round(seq * steps / dt, 2),
+        "unit": "tokens/sec",
         "vs_baseline": 1.0,
     }))
 
@@ -89,6 +143,10 @@ def main():
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 30
         return bench_bert(batch, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "gpt":
+        seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
+        return bench_gpt_long(seq, steps)
 
     # batch 256 measured ~1.7x faster per chip than 128 on the v5e/v6e
     # class chip (better MXU utilization); 50 steps amortize dispatch
