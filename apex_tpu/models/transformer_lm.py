@@ -47,6 +47,17 @@ class TransformerConfig:
     sequence_parallel: bool = False
     use_flash_attention: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
+    # Mixture-of-experts (no reference equivalent; SURVEY.md §2.3 note).
+    # None -> dense ParallelMLP everywhere. Every ``moe_layer_freq``-th
+    # layer (starting at layer 0) becomes a SwitchMLP with this many
+    # global experts, sharded over the 'ep' mesh axis.
+    num_moe_experts: Optional[int] = None
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_layer_freq: int = 1
+    moe_jitter_eps: float = 0.0
+    moe_aux_loss_coeff: float = 1e-2
+    moe_z_loss_coeff: float = 0.0
 
     @property
     def ffn_size(self):
@@ -172,6 +183,12 @@ class ParallelTransformerLayer(nn.Module):
     """Pre-LN transformer block (reference ParallelTransformerLayer)."""
 
     config: TransformerConfig
+    layer_number: int = 0
+
+    def _is_moe_layer(self) -> bool:
+        cfg = self.config
+        return (cfg.num_moe_experts is not None
+                and self.layer_number % cfg.moe_layer_freq == 0)
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None):
@@ -188,7 +205,21 @@ class ParallelTransformerLayer(nn.Module):
                              eps=cfg.layernorm_epsilon,
                              param_dtype=jnp.float32,
                              name="post_attention_layernorm")
-        mlp_out = ParallelMLP(cfg, name="mlp")(
+        if self._is_moe_layer():
+            from apex_tpu.transformer.moe import SwitchMLP
+
+            mlp = SwitchMLP(
+                hidden_size=cfg.hidden_size,
+                ffn_hidden_size=cfg.ffn_size,
+                num_experts=cfg.num_moe_experts, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                jitter_eps=cfg.moe_jitter_eps,
+                params_dtype=cfg.params_dtype,
+                compute_dtype=cfg.compute_dtype,
+                sequence_parallel_enabled=cfg.sequence_parallel, name="mlp")
+        else:
+            mlp = ParallelMLP(cfg, name="mlp")
+        mlp_out = mlp(
             ln2(hidden_states.astype(jnp.float32)).astype(cfg.compute_dtype))
         return hidden_states + mlp_out.astype(hidden_states.dtype)
 
@@ -211,7 +242,7 @@ class ParallelTransformer(nn.Module):
             layer = nn.checkpoint(ParallelTransformerLayer,
                                   static_argnums=())
         for i in range(n):
-            hidden_states = layer(cfg, name=f"layer_{i}")(
+            hidden_states = layer(cfg, layer_number=i, name=f"layer_{i}")(
                 hidden_states, attention_mask)
         return hidden_states
 

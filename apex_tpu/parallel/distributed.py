@@ -42,11 +42,24 @@ def unflatten(flat, tensors):
     return outs
 
 
+def _axis_size_total(axis_name):
+    """Axis size, with tuple axes multiplied (dp x ep replica sets)."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= lax.axis_size(a)
+        return n
+    return lax.axis_size(axis_name)
+
+
 def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
                       gradient_predivide_factor):
     """The DDP reduction policy (reference distributed.py:429-479
     ``allreduce_bucket``): optional fp32 comm dtype, predivide before /
-    postdivide after the psum, cast back to the original dtype."""
+    postdivide after the psum, cast back to the original dtype.
+    ``axis_name`` may be a tuple of mesh axes (e.g.
+    ``parallel_state.get_data_parallel_axes()`` = ('dp', 'ep') when expert
+    parallelism borrows devices from the replica axis)."""
     orig_dtype = g.dtype
     if allreduce_always_fp32:
         g = g.astype(jnp.float32)
@@ -54,18 +67,42 @@ def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
         g = g / gradient_predivide_factor
     g = lax.psum(g, axis_name)
     if gradient_average:
-        n = lax.axis_size(axis_name)
+        n = _axis_size_total(axis_name)
         g = g / (n / gradient_predivide_factor)
     return g.astype(orig_dtype)
 
 
+def _leaf_path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
 def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
-                         gradient_average=True, gradient_predivide_factor=1.0):
-    """Allreduce a grad pytree over a mesh axis (the DDP hot path)."""
-    return jax.tree_util.tree_map(
-        lambda g: _psum_with_policy(g, axis_name, allreduce_always_fp32,
-                                    gradient_average,
-                                    gradient_predivide_factor), grads)
+                         gradient_average=True, gradient_predivide_factor=1.0,
+                         expert_param_predicate=None, expert_axis_name="dp"):
+    """Allreduce a grad pytree over a mesh axis (the DDP hot path).
+
+    With expert parallelism (mesh has an 'ep' axis), dense params replicate
+    over dp x ep while expert shards replicate over dp alone: pass
+    ``axis_name=parallel_state.get_data_parallel_axes()`` plus
+    ``expert_param_predicate=transformer.moe.is_expert_param`` (matched
+    against the '/'-joined leaf path) so each group reduces over the right
+    replica set. Reducing an MoE model over 'dp' alone silently diverges
+    the dense params across ep.
+    """
+    if expert_param_predicate is None:
+        return jax.tree_util.tree_map(
+            lambda g: _psum_with_policy(g, axis_name, allreduce_always_fp32,
+                                        gradient_average,
+                                        gradient_predivide_factor), grads)
+
+    def fix(path, g):
+        ax = (expert_axis_name if expert_param_predicate(_leaf_path_str(path))
+              else axis_name)
+        return _psum_with_policy(g, ax, allreduce_always_fp32,
+                                 gradient_average, gradient_predivide_factor)
+
+    return jax.tree_util.tree_map_with_path(fix, grads)
 
 
 def plan_buckets(leaves, message_size=10000000):
@@ -98,35 +135,60 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
                                   message_size=10000000,
                                   allreduce_always_fp32=False,
                                   gradient_average=True,
-                                  gradient_predivide_factor=1.0):
+                                  gradient_predivide_factor=1.0,
+                                  expert_param_predicate=None,
+                                  expert_axis_name="dp"):
     """Bucketed DDP allreduce: flatten same-dtype runs of leaves into
     ``message_size``-element buckets and psum each bucket as ONE collective
     (reference allreduce_bucket over apex_C-flattened buffers,
     distributed.py:429-479). Fewer, larger ICI collectives than the
-    per-leaf path; use inside a jitted step."""
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    per-leaf path; use inside a jitted step. Expert-parallel handling as in
+    :func:`all_reduce_gradients` — expert leaves bucket separately and
+    reduce over ``expert_axis_name``."""
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(grads)
+    leaves = [l for _, l in paths_leaves]
+    if expert_param_predicate is None:
+        groups = [(axis_name, list(range(len(leaves))))]
+    else:
+        expert = [i for i, (p, _) in enumerate(paths_leaves)
+                  if expert_param_predicate(_leaf_path_str(p))]
+        expert_set = set(expert)
+        dense = [i for i in range(len(leaves)) if i not in expert_set]
+        groups = [(axis_name, dense), (expert_axis_name, expert)]
     out = [None] * len(leaves)
-    for n, bucket in enumerate(plan_buckets(leaves, message_size)):
-        # named_scope = the TPU analog of the reference's NVTX ranges
-        # around allreduce_bucket (distributed.py:429, prof flag)
-        with jax.named_scope(f"ddp_allreduce_bucket_{n}"):
-            flat = flatten([leaves[i] for i in bucket])
-            flat = _psum_with_policy(flat, axis_name, allreduce_always_fp32,
-                                     gradient_average,
-                                     gradient_predivide_factor)
-            for i, piece in zip(
-                    bucket, unflatten(flat, [leaves[i] for i in bucket])):
-                out[i] = piece
+    n = 0
+    for ax, idxs in groups:
+        if not idxs:
+            continue
+        for bucket in plan_buckets([leaves[i] for i in idxs], message_size):
+            bucket = [idxs[j] for j in bucket]
+            # named_scope = the TPU analog of the reference's NVTX ranges
+            # around allreduce_bucket (distributed.py:429, prof flag)
+            with jax.named_scope(f"ddp_allreduce_bucket_{n}"):
+                flat = flatten([leaves[i] for i in bucket])
+                flat = _psum_with_policy(flat, ax, allreduce_always_fp32,
+                                         gradient_average,
+                                         gradient_predivide_factor)
+                for i, piece in zip(
+                        bucket, unflatten(flat, [leaves[i] for i in bucket])):
+                    out[i] = piece
+            n += 1
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def broadcast_params(params, axis_name="dp"):
-    """Make params bitwise-identical across the axis by broadcasting rank 0
-    (parity: DDP ctor broadcast, reference distributed.py:257)."""
+    """Make params bitwise-identical across the axis (or tuple of axes) by
+    broadcasting rank 0 (parity: DDP ctor broadcast, reference
+    distributed.py:257)."""
+    axes = (axis_name,) if not isinstance(axis_name, (tuple, list)) \
+        else tuple(axis_name)
+
     def bcast(p):
-        rank = lax.axis_index(axis_name)
+        rank = jnp.zeros((), jnp.int32)
+        for a in axes:
+            rank = rank * lax.axis_size(a) + lax.axis_index(a)
         masked = jnp.where(rank == 0, p, jnp.zeros_like(p))
-        return lax.psum(masked, axis_name)
+        return lax.psum(masked, axes)
 
     return jax.tree_util.tree_map(bcast, params)
 
@@ -159,7 +221,9 @@ class DistributedDataParallel:
                  gradient_predivide_factor: float = 1.0,
                  gradient_average_split_factor: Any = None,
                  prof: bool = False,
-                 axis_name: str = "dp"):
+                 axis_name: str = "dp",
+                 expert_param_predicate: Optional[Callable] = None,
+                 expert_axis_name: str = "dp"):
         self.module = module
         self.axis_name = axis_name
         self.message_size = message_size
@@ -168,6 +232,12 @@ class DistributedDataParallel:
         self.gradient_predivide_factor = gradient_predivide_factor
         self.delay_allreduce = delay_allreduce
         self.needs_refresh = True
+        # Expert parallelism: dense params sync over axis_name (pass
+        # parallel_state.get_data_parallel_axes() = ('dp','ep')), expert
+        # shards over expert_axis_name. Supported in .sync(); the
+        # module-wrapping mode syncs every param uniformly.
+        self.expert_param_predicate = expert_param_predicate
+        self.expert_axis_name = expert_axis_name
 
     def sync(self, grads):
         """Bucketed grad allreduce honoring ``message_size`` (reference
@@ -178,12 +248,16 @@ class DistributedDataParallel:
                 grads, self.axis_name, message_size=self.message_size,
                 allreduce_always_fp32=self.allreduce_always_fp32,
                 gradient_average=self.gradient_average,
-                gradient_predivide_factor=self.gradient_predivide_factor)
+                gradient_predivide_factor=self.gradient_predivide_factor,
+                expert_param_predicate=self.expert_param_predicate,
+                expert_axis_name=self.expert_axis_name)
         return all_reduce_gradients(
             grads, self.axis_name,
             allreduce_always_fp32=self.allreduce_always_fp32,
             gradient_average=self.gradient_average,
-            gradient_predivide_factor=self.gradient_predivide_factor)
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            expert_param_predicate=self.expert_param_predicate,
+            expert_axis_name=self.expert_axis_name)
 
     def __call__(self, fn=None, *args, **kwargs):
         """If constructed around a module/apply fn, call it; DDP on TPU is
@@ -200,6 +274,11 @@ class DistributedDataParallel:
         target = fn if callable(fn) and self.module is None else self.module
         if target is None:
             raise TypeError("DistributedDataParallel needs a callable module")
+        if self.expert_param_predicate is not None:
+            raise NotImplementedError(
+                "expert_param_predicate requires per-param axis selection; "
+                "use DistributedDataParallel(...).sync(grads) instead of "
+                "the module-wrapping mode")
         if fn is not None and target is self.module:
             args = (fn,) + args
 
@@ -234,11 +313,13 @@ def _ddp_bwd(fn, axis_name, gradient_average, vjp, g):
     # - unchecked (check_vma=False): cotangents stay per-device, so DDP
     #   performs the allreduce itself.
     # Discriminate via the vma type of axis_index (varying iff checking on).
-    checked = axis_name in getattr(
-        jax.typeof(lax.axis_index(axis_name)), "vma", frozenset())
+    first_axis = (axis_name[0] if isinstance(axis_name, (tuple, list))
+                  else axis_name)
+    checked = first_axis in getattr(
+        jax.typeof(lax.axis_index(first_axis)), "vma", frozenset())
     if checked:
         if gradient_average:
-            n = lax.axis_size(axis_name)
+            n = _axis_size_total(axis_name)
             g = jax.tree_util.tree_map(lambda c: c / n, g)
         return vjp(g)
     grads = vjp(g)

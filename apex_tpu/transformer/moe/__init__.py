@@ -1,0 +1,27 @@
+"""Mixture-of-experts with expert parallelism (TPU-native).
+
+No reference equivalent: juncongmoo/apex has no MoE / expert parallelism
+(SURVEY.md §2.3 note). This subsystem is a new capability, designed
+TPU-first: capacity-based GShard/Switch routing expressed as one-hot
+einsums (static shapes, MXU-friendly), grouped expert FFNs batched over a
+leading expert dim, and expert-parallel dispatch via ``lax.all_to_all``
+over the 'ep' mesh axis (ICI all-to-all), with the expert hidden dim
+tensor-parallel over 'tp'.
+"""
+
+from apex_tpu.transformer.moe.layer import (
+    ExpertMLP,
+    SwitchMLP,
+    is_expert_param,
+    moe_loss_from_variables,
+)
+from apex_tpu.transformer.moe.router import TopKRouter, compute_routing
+
+__all__ = [
+    "ExpertMLP",
+    "SwitchMLP",
+    "TopKRouter",
+    "compute_routing",
+    "is_expert_param",
+    "moe_loss_from_variables",
+]

@@ -1,0 +1,233 @@
+"""Expert-parallel Switch/GShard MLP layer.
+
+Dataflow per device (T local tokens, E global experts, C slots/expert,
+ep-way expert parallelism, tp-way tensor parallelism inside each expert):
+
+    [s, b, h] -> [T, h] -> router -> dispatch [T, E, C]
+    einsum dispatch: [E, C, h]
+    all_to_all over 'ep': [E/ep, ep*C, h]     (experts gain all ranks' slots)
+    grouped FFN (einsum over leading E/ep dim; ffn dim sharded over 'tp')
+    all_to_all back: [E, C, h]
+    einsum combine: [T, h] -> [s, b, h]
+
+Everything is static-shaped; dropped tokens get zero combine weight and
+ride the residual. Expert weights are per-(ep, tp)-rank shards initialized
+from rank-folded keys (the partitioned-init discipline of
+tensor_parallel/layers.py); dense params (router gate) replicate over ep
+and must be grad-synced over the full dp x ep set — see
+``parallel_state.get_data_parallel_axes`` and ``is_expert_param``.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.moe.router import TopKRouter
+from apex_tpu.transformer.parallel_state import (
+    EXPERT_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+    get_expert_model_parallel_world_size,
+    get_tensor_model_parallel_world_size,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    scatter_to_sequence_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+def moe_loss_from_variables(variables, aux_loss_coeff: float = 1e-2,
+                            z_loss_coeff: float = 0.0):
+    """Total auxiliary MoE loss from the 'moe_losses' collection returned
+    by ``model.apply(..., mutable=["moe_losses"])``. Accepts either the
+    full mutated-variables dict or the collection itself."""
+    import flax
+
+    losses = variables.get("moe_losses", variables)
+    aux = jnp.zeros((), jnp.float32)
+    z = jnp.zeros((), jnp.float32)
+    for path, val in flax.traverse_util.flatten_dict(dict(losses)).items():
+        total = sum(val) if isinstance(val, (tuple, list)) else val
+        if path[-1] == "aux_loss":
+            aux = aux + total
+        elif path[-1] == "z_loss":
+            z = z + total
+    return aux_loss_coeff * aux + z_loss_coeff * z
+
+
+_WARNED_DROPPED_LOSSES = False
+
+
+def _warn_dropped_losses_once():
+    global _WARNED_DROPPED_LOSSES
+    if _WARNED_DROPPED_LOSSES:
+        return
+    _WARNED_DROPPED_LOSSES = True
+    import warnings
+
+    warnings.warn(
+        "SwitchMLP router aux/z losses were discarded: apply the model "
+        "with mutable=['moe_losses'] and add moe_loss_from_variables(...) "
+        "to the training loss (for inference/eval, construct with "
+        "warn_on_dropped_losses=False).", stacklevel=3)
+
+
+def is_expert_param(path: str) -> bool:
+    """Param-path predicate: expert shards (different on every ep/tp rank)
+    vs dense params. Grad-sync rule: expert params average over 'dp' only;
+    dense params over ``get_data_parallel_axes()`` (dp and ep). Matches the
+    whole 'experts' path segment (a user module merely *containing* the
+    substring, e.g. 'experts_gate', holds dense params)."""
+    return "experts" in path.split("/")
+
+
+def _expert_rank_key(key):
+    """Fold ep and tp ranks into an init key so every expert shard draws
+    distinct weights (partitioned-init parity, tensor_parallel/layers.py:76)."""
+    for axis in (EXPERT_PARALLEL_AXIS, TENSOR_PARALLEL_AXIS):
+        try:
+            rank = lax.axis_index(axis)
+        except Exception:
+            rank = 0
+        key = jax.random.fold_in(key, rank)
+    return key
+
+
+class ExpertMLP(nn.Module):
+    """Grouped FFN over a leading local-expert dim: h -> ffn/tp -> h per
+    expert, gelu in fp32, tp-reduced output. Input [E_local, S, h]."""
+
+    hidden_size: int
+    ffn_hidden_size: int
+    num_local_experts: int
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        tp = get_tensor_model_parallel_world_size()
+        ffn_local = divide(self.ffn_hidden_size, tp)
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+
+        def shard_init(key, shape, dtype):
+            return init(_expert_rank_key(key), shape, dtype)
+
+        w1 = self.param("w1", shard_init,
+                        (self.num_local_experts, self.hidden_size, ffn_local),
+                        self.params_dtype)
+        b1 = self.param("b1", nn.initializers.zeros,
+                        (self.num_local_experts, ffn_local), self.params_dtype)
+        w2 = self.param("w2", shard_init,
+                        (self.num_local_experts, ffn_local, self.hidden_size),
+                        self.params_dtype)
+        b2 = self.param("b2", nn.initializers.zeros,
+                        (self.num_local_experts, self.hidden_size),
+                        self.params_dtype)
+
+        # Column-parallel in, row-parallel out (identity/psum vjp pairing).
+        x = copy_to_tensor_model_parallel_region(x)
+        x = x.astype(self.compute_dtype)
+        h1 = jnp.einsum("ech,ehf->ecf", x, w1.astype(self.compute_dtype),
+                        preferred_element_type=jnp.float32)
+        h1 = h1 + b1[:, None, :].astype(jnp.float32)
+        a = jax.nn.gelu(h1).astype(self.compute_dtype)
+        y = jnp.einsum("ecf,efh->ech", a, w2.astype(self.compute_dtype),
+                       preferred_element_type=jnp.float32)
+        y = reduce_from_tensor_model_parallel_region(y)
+        return y + b2[:, None, :].astype(jnp.float32)
+
+
+class SwitchMLP(nn.Module):
+    """Drop-in MoE replacement for ParallelMLP (Megatron names this
+    SwitchMLP). Sows 'aux_loss'/'z_loss' into the 'moe_losses' collection;
+    apply with ``mutable=["moe_losses"]`` to collect them."""
+
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    jitter_eps: float = 0.0
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    sequence_parallel_enabled: bool = False
+    # Warn (once per process) when aux losses are silently dropped because
+    # the caller didn't pass mutable=["moe_losses"]; set False for
+    # inference/eval modules where dropping them is intended.
+    warn_on_dropped_losses: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states):
+        ep = get_expert_model_parallel_world_size()
+        n_local = divide(self.num_experts, ep)
+
+        if self.sequence_parallel_enabled:
+            # Full sequence on every tp rank; routing is deterministic so
+            # tp ranks agree. The dispatch-path input grad is already
+            # tp-psummed by the copy_to region inside ExpertMLP and the
+            # router-path grad is tp-replicated, so the gather's backward
+            # must be a plain split (tensor_parallel_output_grad=False),
+            # and the exit below a plain scatter — a reduce-scatter pair
+            # here would double-count by tp.
+            hidden_states = gather_from_sequence_parallel_region(
+                hidden_states, False)
+        orig_shape = hidden_states.shape  # [s, b, h]
+        tokens = hidden_states.reshape(-1, orig_shape[-1])
+
+        routing = TopKRouter(
+            num_experts=self.num_experts, top_k=self.top_k,
+            capacity_factor=self.capacity_factor, jitter_eps=self.jitter_eps,
+            params_dtype=self.params_dtype, name="router")(tokens)
+        sown = self.sow("moe_losses", "aux_loss", routing.aux_loss)
+        self.sow("moe_losses", "z_loss", routing.z_loss)
+        if (not sown and not self.is_initializing()
+                and self.warn_on_dropped_losses):
+            # sow() into a non-mutable collection is a silent no-op; a
+            # training step that forgets mutable=["moe_losses"] would run
+            # with zero load-balancing pressure and collapse the router.
+            _warn_dropped_losses_once()
+
+        # Dispatch: [T, h] x [T, E, C] -> [E, C, h]
+        expert_in = jnp.einsum(
+            "th,tec->ech", tokens.astype(self.compute_dtype),
+            routing.dispatch_mask.astype(self.compute_dtype))
+        if ep > 1:
+            # [E, C, h] -> [E/ep, ep*C, h]: local expert shards gain every
+            # ep rank's capacity slots (rank r's block at offset r*C).
+            # Tiled form: the non-tiled reshape/all_to_all/reshape chain
+            # trips a JAX transpose bug when two all_to_alls are chained
+            # through reshapes (wrong cotangent shape at lowering).
+            expert_in = lax.all_to_all(expert_in, EXPERT_PARALLEL_AXIS,
+                                       split_axis=0, concat_axis=1,
+                                       tiled=True)
+
+        expert_out = ExpertMLP(
+            hidden_size=self.hidden_size,
+            ffn_hidden_size=self.ffn_hidden_size,
+            num_local_experts=n_local, params_dtype=self.params_dtype,
+            compute_dtype=self.compute_dtype, name="experts")(expert_in)
+        # compute_dtype over the wire: the return all_to_all otherwise
+        # ships fp32 (2x the dispatch path's ICI bytes).
+        expert_out = expert_out.astype(self.compute_dtype)
+
+        if ep > 1:
+            # [E/ep, ep*C, h] -> [E, C, h]: return each rank's slots.
+            expert_out = lax.all_to_all(expert_out, EXPERT_PARALLEL_AXIS,
+                                        split_axis=1, concat_axis=0,
+                                        tiled=True)
+
+        # Combine: [E, C, h] x [T, E, C] -> [T, h]; bf16 operands on the
+        # MXU (gates are probabilities — bf16 rounding is on par with the
+        # activations), fp32 accumulation.
+        out = jnp.einsum("ech,tec->th", expert_out,
+                         routing.combine_weights.astype(self.compute_dtype),
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(orig_shape).astype(self.compute_dtype)
+        if self.sequence_parallel_enabled:
+            out = scatter_to_sequence_parallel_region(out)
+        return out

@@ -1,0 +1,147 @@
+"""Top-k expert routing with static capacity (GShard / Switch Transformer).
+
+The routing decision is materialized as dense one-hot dispatch/combine
+tensors so the whole layer is static-shaped einsums — the TPU-idiomatic
+formulation (no gather/scatter, everything lands on the MXU and fuses).
+
+``compute_routing`` is the functional core; ``TopKRouter`` wraps it as a
+flax module owning the (dense, replicated) gate projection.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class RoutingResult:
+    """Static-shaped routing tensors for T tokens, E experts, capacity C."""
+
+    dispatch_mask: jnp.ndarray    # [T, E, C] {0,1} — token t fills slot (e, c)
+    combine_weights: jnp.ndarray  # [T, E, C] fp32 — gate weight per filled slot
+    aux_loss: jnp.ndarray         # scalar load-balancing loss (Switch eq. 4-6)
+    z_loss: jnp.ndarray           # scalar router z-loss (ST-MoE eq. 5)
+    probs: jnp.ndarray            # [T, E] softmax router probabilities
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert slot count; multiple of 8 for TPU lane layout."""
+    raw = max(1, int(num_tokens * top_k * capacity_factor / num_experts))
+    return -(-raw // 8) * 8 if raw > 8 else raw
+
+
+def compute_routing(logits, top_k: int, capacity: int,
+                    normalize_topk: bool = True) -> RoutingResult:
+    """Route tokens from fp32 router ``logits`` [T, E].
+
+    Position-in-expert is a cumsum over the token dim (arrival order, the
+    GShard discipline); tokens beyond ``capacity`` are dropped — their
+    combine weights are zero, so they ride the residual connection.
+    """
+    logits = logits.astype(jnp.float32)
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Iterative top-k: mask out prior choices and re-argmax.
+    choice_masks = []
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        choice_masks.append(onehot)
+        remaining = remaining * (1.0 - onehot)
+
+    gates = [jnp.sum(probs * m, axis=-1) for m in choice_masks]  # k x [T]
+    if normalize_topk and top_k > 1:
+        denom = sum(gates)
+        gates = [g / jnp.maximum(denom, 1e-9) for g in gates]
+
+    # Slot assignment: earlier choices claim slots before later ones.
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    expert_fill = jnp.zeros((1, E), jnp.float32)
+    for onehot, gate in zip(choice_masks, gates):
+        pos = jnp.cumsum(onehot, axis=0) - 1.0 + expert_fill  # [T, E]
+        expert_fill = expert_fill + jnp.sum(onehot, axis=0, keepdims=True)
+        keep = onehot * (pos < capacity)
+        slot = jax.nn.one_hot(jnp.sum(pos * onehot, axis=-1).astype(jnp.int32),
+                              capacity, dtype=jnp.float32)  # [T, C]
+        dispatch = dispatch + keep[:, :, None] * slot[:, None, :]
+        combine = combine + (keep * gate[:, None])[:, :, None] * slot[:, None, :]
+
+    # Load-balancing aux loss: E * sum_e f_e * P_e with f_e the fraction of
+    # routed (pre-drop) assignments and P_e the mean router probability.
+    f = sum(choice_masks).sum(axis=0) / (top_k * T)  # [E]
+    p = probs.mean(axis=0)
+    aux_loss = E * jnp.sum(f * p)
+    z = jax.scipy.special.logsumexp(logits, axis=-1)
+    z_loss = jnp.mean(z * z)
+    return RoutingResult(dispatch, combine, aux_loss, z_loss, probs)
+
+
+def _tp_uniform_key(key):
+    """Broadcast tp-rank-0's rng key across the tp axis (no-op outside
+    shard_map / when tp is unbound)."""
+    from jax import lax
+
+    from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+
+    try:
+        rank = lax.axis_index(TENSOR_PARALLEL_AXIS)
+    except Exception:
+        return key
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        data = jax.random.key_data(key)
+        data = lax.psum(jnp.where(rank == 0, data, jnp.zeros_like(data)),
+                        TENSOR_PARALLEL_AXIS)
+        return jax.random.wrap_key_data(data)
+    return lax.psum(jnp.where(rank == 0, key, jnp.zeros_like(key)),
+                    TENSOR_PARALLEL_AXIS)
+
+
+class TopKRouter(nn.Module):
+    """Learned gate: fp32 projection to expert logits + optional jitter.
+
+    The gate weight is a dense (replicated) param — with expert
+    parallelism its grads must sync over the full dp x ep replica set like
+    any other dense param.
+    """
+
+    num_experts: int
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    jitter_eps: float = 0.0
+    normalize_topk: bool = True
+    params_dtype: Any = jnp.float32
+    capacity: Optional[int] = None  # override for tests
+
+    @nn.compact
+    def __call__(self, tokens) -> RoutingResult:
+        """tokens: [T, h] -> RoutingResult with C from ``expert_capacity``.
+
+        Jitter activates when ``jitter_eps > 0`` AND the caller supplies a
+        'jitter' rng stream (``apply(..., rngs={"jitter": key})``) — eval
+        runs without the stream are deterministic by construction.
+        """
+        T = tokens.shape[0]
+        gate = self.param("gate_weight", nn.initializers.lecun_normal(),
+                          (tokens.shape[-1], self.num_experts),
+                          self.params_dtype)
+        x = tokens.astype(jnp.float32)
+        if self.jitter_eps > 0.0 and self.has_rng("jitter"):
+            # Routing must agree across tp ranks (the ExpertMLP copy/reduce
+            # pairing assumes identical dispatch per rank), so the jitter
+            # key is forced tp-uniform even if the caller folded the tp
+            # rank into it (the dropout-key discipline would).
+            key = _tp_uniform_key(self.make_rng("jitter"))
+            x = x * jax.random.uniform(
+                key, x.shape, jnp.float32,
+                1.0 - self.jitter_eps, 1.0 + self.jitter_eps)
+        logits = x @ gate.astype(jnp.float32)
+        cap = self.capacity if self.capacity is not None else expert_capacity(
+            T, self.num_experts, self.top_k, self.capacity_factor)
+        return compute_routing(logits, self.top_k, cap, self.normalize_topk)

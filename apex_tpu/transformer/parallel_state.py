@@ -29,9 +29,11 @@ DATA_PARALLEL_AXIS = "dp"
 TENSOR_PARALLEL_AXIS = "tp"
 PIPELINE_PARALLEL_AXIS = "pp"
 CONTEXT_PARALLEL_AXIS = "cp"  # long-context axis; no reference equivalent
+EXPERT_PARALLEL_AXIS = "ep"  # MoE expert axis; no reference equivalent
 
 _MESH: Optional[Mesh] = None
 _CONTEXT_PARALLEL_WORLD_SIZE: Optional[int] = None
+_EXPERT_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 _TENSOR_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 _PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 _DATA_PARALLEL_WORLD_SIZE: Optional[int] = None
@@ -49,6 +51,7 @@ def initialize_model_parallel(
     virtual_pipeline_model_parallel_size_: Optional[int] = None,
     pipeline_model_parallel_split_rank_: Optional[int] = None,
     context_parallel_size_: int = 1,
+    expert_model_parallel_size_: int = 1,
     *,
     devices=None,
     default_backend: Optional[str] = None,
@@ -65,6 +68,7 @@ def initialize_model_parallel(
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK, _CONTEXT_PARALLEL_WORLD_SIZE
+    global _EXPERT_MODEL_PARALLEL_WORLD_SIZE
 
     if devices is None:
         devices = jax.devices()
@@ -73,12 +77,14 @@ def initialize_model_parallel(
     tp = tensor_model_parallel_size_
     pp = pipeline_model_parallel_size_
     cp = context_parallel_size_
-    if world_size % (tp * pp * cp) != 0:
+    ep = expert_model_parallel_size_
+    if world_size % (tp * pp * cp * ep) != 0:
         raise RuntimeError(
             f"world_size ({world_size}) is not divisible by "
             f"tensor_model_parallel_size ({tp}) x pipeline_model_parallel_size ({pp})"
-            f" x context_parallel_size ({cp})")
-    dp = world_size // (tp * pp * cp)
+            f" x context_parallel_size ({cp})"
+            f" x expert_model_parallel_size ({ep})")
+    dp = world_size // (tp * pp * cp * ep)
 
     if virtual_pipeline_model_parallel_size_ is not None:
         if pp < 2:
@@ -93,23 +99,25 @@ def initialize_model_parallel(
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
 
+    # Axis order (outer to inner): pp, dp, ep, cp, tp. ep subdivides the
+    # data-parallel block (Megatron-core's expert-data decomposition: the
+    # dp*ep replicas of dense params are the dp replicas of each expert
+    # shard); cp sits between dp and tp so sequence blocks ring on fast
+    # links; tp innermost owns the fastest ICI hops. Size-1 ep/cp axes are
+    # omitted so existing 3-axis callers see an unchanged mesh.
+    dims = [(pp, PIPELINE_PARALLEL_AXIS), (dp, DATA_PARALLEL_AXIS)]
+    if ep > 1:
+        dims.append((ep, EXPERT_PARALLEL_AXIS))
     if cp > 1:
-        # cp sits between dp and tp: sequence blocks ring on fast links,
-        # tp innermost still owns the fastest ICI hops.
-        mesh_devices = devices.reshape(pp, dp, cp, tp)
-        _MESH = Mesh(mesh_devices, (PIPELINE_PARALLEL_AXIS,
-                                    DATA_PARALLEL_AXIS,
-                                    CONTEXT_PARALLEL_AXIS,
-                                    TENSOR_PARALLEL_AXIS))
-    else:
-        mesh_devices = devices.reshape(pp, dp, tp)
-        _MESH = Mesh(mesh_devices, (PIPELINE_PARALLEL_AXIS,
-                                    DATA_PARALLEL_AXIS,
-                                    TENSOR_PARALLEL_AXIS))
+        dims.append((cp, CONTEXT_PARALLEL_AXIS))
+    dims.append((tp, TENSOR_PARALLEL_AXIS))
+    mesh_devices = devices.reshape(*[d for d, _ in dims])
+    _MESH = Mesh(mesh_devices, tuple(name for _, name in dims))
     _TENSOR_MODEL_PARALLEL_WORLD_SIZE = tp
     _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = pp
     _DATA_PARALLEL_WORLD_SIZE = dp
     _CONTEXT_PARALLEL_WORLD_SIZE = cp
+    _EXPERT_MODEL_PARALLEL_WORLD_SIZE = ep
     return _MESH
 
 
@@ -130,12 +138,13 @@ def destroy_model_parallel():
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK, _EXPLICIT_TP_RANK, _EXPLICIT_PP_RANK
-    global _CONTEXT_PARALLEL_WORLD_SIZE
+    global _CONTEXT_PARALLEL_WORLD_SIZE, _EXPERT_MODEL_PARALLEL_WORLD_SIZE
     _MESH = None
     _TENSOR_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _DATA_PARALLEL_WORLD_SIZE = None
     _CONTEXT_PARALLEL_WORLD_SIZE = None
+    _EXPERT_MODEL_PARALLEL_WORLD_SIZE = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
@@ -173,6 +182,26 @@ def get_context_parallel_world_size() -> int:
 
 def get_context_parallel_rank():
     return _axis_rank(CONTEXT_PARALLEL_AXIS, None)
+
+
+def get_expert_model_parallel_world_size() -> int:
+    if _EXPERT_MODEL_PARALLEL_WORLD_SIZE is None:
+        return 1
+    return _EXPERT_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_expert_model_parallel_rank():
+    return _axis_rank(EXPERT_PARALLEL_AXIS, None)
+
+
+def get_data_parallel_axes():
+    """Mesh axes spanning the full data-parallel replica set for *dense*
+    (non-expert) params. With expert parallelism the ep axis borrows
+    devices from dp, so dense-grad sync must reduce over both; expert
+    params replicate over dp alone (sync them over just 'dp')."""
+    if get_expert_model_parallel_world_size() > 1:
+        return (DATA_PARALLEL_AXIS, EXPERT_PARALLEL_AXIS)
+    return (DATA_PARALLEL_AXIS,)
 
 
 def get_model_parallel_world_size() -> int:

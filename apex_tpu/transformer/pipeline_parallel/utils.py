@@ -129,9 +129,25 @@ def split_into_microbatches(batch, num_microbatches):
                             + x.shape[1:]), batch)
 
 
-def average_losses_across_data_parallel_group(losses,
-                                              axis_name=DATA_PARALLEL_AXIS):
-    """Reference utils.py:242-250."""
+def average_losses_across_data_parallel_group(losses, axis_name=None):
+    """Reference utils.py:242-250. Defaults to the full data-parallel
+    replica set — ('dp', 'ep') when expert parallelism is on (losses are
+    data-domain, so every replica cell participates)."""
+    if axis_name is None:
+        from apex_tpu.transformer.parallel_state import get_data_parallel_axes
+
+        # Use only the axes actually bound in this collective context, so
+        # a dp-only shard_map still averages over dp when global state has
+        # ep on (the except below must not swallow dp-averaging).
+        bound = []
+        for a in get_data_parallel_axes():
+            try:
+                lax.axis_size(a)
+                bound.append(a)
+            except Exception:
+                pass
+        axis_name = tuple(bound) if bound else DATA_PARALLEL_AXIS
+        axis_name = axis_name[0] if len(axis_name) == 1 else axis_name
     averaged = jnp.stack([l.astype(jnp.float32) for l in losses])
     try:
         averaged = lax.pmean(averaged, axis_name)

@@ -38,6 +38,16 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
     ``tokens``/``labels`` are [global_batch, seq] with
     global_batch = microbatch * num_microbatches * dp.
     """
+    if cfg.num_moe_experts is not None:
+        # Two unsolved compositions: (a) stage-local layer numbering means
+        # MoE placement only matches pp=1 when layers_per_stage divides
+        # moe_layer_freq, and (b) this schedule computes grads from the
+        # last-stage loss alone, so earlier stages' router aux losses
+        # could not reach their own routers — training would silently run
+        # without load-balancing pressure. Refuse rather than misbehave.
+        raise ValueError(
+            "MoE (num_moe_experts) is not supported under the pipelined "
+            "harness; use transformer.testing.gpt_moe (dp x ep x tp)")
     stage = GPTStage(cfg, cfg.num_layers // pp)
     MB, M = microbatch, num_microbatches
     # Activations crossing stage boundaries: [s(/tp under SP), mb, h]

@@ -1,0 +1,87 @@
+"""Shared expert-parallel (dp x ep x tp) MoE-GPT training harness.
+
+One full training step — MoE GPT forward with router aux losses, grads,
+the split data-parallel sync rule (dense params pmean over dp x ep, expert
+shards over dp alone — parallel_state.get_data_parallel_axes), fused
+optimizer — shard_mapped over the global mesh. Used by the driver entry
+(``__graft_entry__.dryrun_multichip``) and tests/L0/test_moe sibling
+end-to-end runs, like gpt_3d.py is for the pipelined dense path.
+"""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.gpt import GPTModel, gpt_loss_fn
+from apex_tpu.parallel.distributed import all_reduce_gradients
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.moe import is_expert_param, moe_loss_from_variables
+
+
+def build_gpt_moe_harness(cfg, mesh, opt):
+    """Return ``(init_params_and_opt, step)`` for an ep-parallel GPT.
+
+    ``tokens``/``labels`` are [global_batch, seq] with global_batch a
+    multiple of dp*ep; each of the dp x ep cells trains on its own shard
+    (expert parallelism borrows the replica axis for expert placement).
+    Model params come back stacked over a leading ep*tp axis; the step is
+    jitted and returns (params, opt_state, mean_loss).
+    """
+    assert not cfg.sequence_parallel, (
+        "this harness covers the dp x ep x tp plane; SP lives in gpt_3d")
+    model = GPTModel(cfg)
+    dense_axes = parallel_state.get_data_parallel_axes()  # ("dp","ep")
+    model_axes = tuple(a for a in ("ep", "tp") if a in mesh.shape)
+    batch_axes = tuple(a for a in ("dp", "ep") if a in mesh.shape)
+
+    def sync_grads(grads):
+        # The production DDP rule: dense params average over the full
+        # dp x ep replica set, expert shards over dp alone.
+        return all_reduce_gradients(
+            grads, axis_name=dense_axes,
+            expert_param_predicate=is_expert_param, expert_axis_name="dp")
+
+    def train_step(params, opt_state, tokens, labels):
+        def loss_fn(p):
+            logits, mut = model.apply({"params": p}, tokens,
+                                      mutable=["moe_losses"])
+            return gpt_loss_fn(logits, labels) + moe_loss_from_variables(
+                mut, cfg.moe_aux_loss_coeff, cfg.moe_z_loss_coeff)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = sync_grads(grads)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, jax.lax.pmean(loss, mesh.axis_names)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(model_axes), P(model_axes), P(batch_axes),
+                  P(batch_axes)),
+        out_specs=(P(model_axes), P(model_axes), P()),
+        check_vma=False)
+    def sharded_step(stacked_params, stacked_opt, tok, lab):
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        opt_state = jax.tree_util.tree_map(lambda a: a[0], stacked_opt)
+        p, o, l = train_step(params, opt_state, tok, lab)
+        stack = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)  # noqa: E731
+        return stack(p), stack(o), l
+
+    # Init under shard_map so TP/expert param inits see their local ranks.
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(), P(batch_axes)),
+                       out_specs=P(model_axes), check_vma=False)
+    def init_params(key, tok):
+        variables = model.init(key, tok)
+        return jax.tree_util.tree_map(lambda a: a[None], variables["params"])
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P(model_axes),
+                       out_specs=P(model_axes), check_vma=False)
+    def init_opt(stacked_params):
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        return jax.tree_util.tree_map(lambda a: a[None], opt.init(params))
+
+    def init_state(key, tokens):
+        stacked_params = init_params(key, tokens)
+        return stacked_params, init_opt(stacked_params)
+
+    return init_state, jax.jit(sharded_step)

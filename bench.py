@@ -7,6 +7,8 @@
                                            samples/sec/chip
     python bench.py gpt [seq] [steps]      long-context GPT (16x1024,
                                            flash attention) tokens/sec/chip
+    python bench.py moe [batch] [steps]    MoE GPT (8 experts top-1, every
+                                           other layer) tokens/sec/chip
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
@@ -134,6 +136,54 @@ def bench_gpt_long(seq, steps):
     }))
 
 
+def bench_moe(batch, steps):
+    """MoE GPT (16 layers x 1024, 8 experts top-1, seq 1024) single-chip
+    training throughput — the expert-parallel capability beyond the
+    reference; grouped expert FFNs ride the MXU as batched einsums."""
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.moe import moe_loss_from_variables
+
+    parallel_state.destroy_model_parallel()
+    seq = 1024
+    cfg = TransformerConfig(
+        hidden_size=1024, num_layers=16, num_attention_heads=16,
+        vocab_size=32000, max_position_embeddings=seq,
+        compute_dtype=jnp.bfloat16, use_flash_attention=True,
+        num_moe_experts=8, moe_layer_freq=2, moe_capacity_factor=1.25)
+    model = GPTModel(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state):
+        def loss_fn(p):
+            logits, mut = model.apply({"params": p}, tokens,
+                                      mutable=["moe_losses"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ce = -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+            return ce + moe_loss_from_variables(mut, cfg.moe_aux_loss_coeff,
+                                                cfg.moe_z_loss_coeff)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss
+
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
+    print(json.dumps({
+        "metric": "gpt_moe_8expert_tokens_per_sec_per_chip",
+        "value": round(batch * seq * steps / dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
 def main():
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
@@ -147,6 +197,10 @@ def main():
         seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
         return bench_gpt_long(seq, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "moe":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
+        return bench_moe(batch, steps)
 
     # batch 256 measured ~1.7x faster per chip than 128 on the v5e/v6e
     # class chip (better MXU utilization); 50 steps amortize dispatch
