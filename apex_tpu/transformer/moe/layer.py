@@ -185,6 +185,9 @@ class SwitchMLP(nn.Module):
             params_dtype=self.params_dtype, name="router")(tokens)
         sown = self.sow("moe_losses", "aux_loss", routing.aux_loss)
         self.sow("moe_losses", "z_loss", routing.z_loss)
+        # observability, not a loss: moe_loss_from_variables sums only the
+        # *_loss keys; watch this to tune capacity_factor
+        self.sow("moe_losses", "dropped_fraction", routing.dropped_fraction)
         if (not sown and not self.is_initializing()
                 and self.warn_on_dropped_losses):
             # sow() into a non-mutable collection is a silent no-op; a

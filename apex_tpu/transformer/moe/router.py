@@ -14,6 +14,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 @dataclasses.dataclass
@@ -25,6 +26,7 @@ class RoutingResult:
     aux_loss: jnp.ndarray         # scalar load-balancing loss (Switch eq. 4-6)
     z_loss: jnp.ndarray           # scalar router z-loss (ST-MoE eq. 5)
     probs: jnp.ndarray            # [T, E] softmax router probabilities
+    dropped_fraction: jnp.ndarray = None  # scalar: routed slots lost to capacity
 
 
 def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
@@ -80,7 +82,9 @@ def compute_routing(logits, top_k: int, capacity: int,
     aux_loss = E * jnp.sum(f * p)
     z = jax.scipy.special.logsumexp(logits, axis=-1)
     z_loss = jnp.mean(z * z)
-    return RoutingResult(dispatch, combine, aux_loss, z_loss, probs)
+    dropped = 1.0 - jnp.sum(dispatch) / (top_k * T)
+    return RoutingResult(dispatch, combine, aux_loss, z_loss, probs,
+                         lax.stop_gradient(dropped))
 
 
 def _tp_uniform_key(key):
