@@ -1,0 +1,93 @@
+"""Generalized-mesh integration: ring attention (cp) + MoE (ep x tp).
+
+Validates that the 'ep' and 'cp' axes coexist in one SPMD program — the
+five-axis mesh (pp, dp, ep, cp, tp) parallel_state builds — with each
+subsystem's collectives riding its own axis: ring attention ppermutes K/V
+around 'cp', the SwitchMLP all_to_alls experts over 'ep' and psums the
+expert ffn over 'tp'. No reference counterpart (the reference has neither
+capability; SURVEY.md §2.3 note).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.testing import shard_map
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.context_parallel import ring_self_attention
+from apex_tpu.transformer.moe import SwitchMLP
+
+B, NH, SEQ, D = 2, 2, 16, 8
+HID = NH * D
+EP, CP, TP = 2, 2, 2
+E = 4  # global experts
+
+
+def _reference(q, k, v, layer, params):
+    """Full attention per batch element, then SwitchMLP per (cp, ep)
+    token shard with all experts local (each device routes only its own
+    tokens, so the oracle processes shard-by-shard)."""
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k) / np.sqrt(D)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bnst,btnd->bsnd", probs, v)
+    h = attn.transpose(1, 0, 2, 3).reshape(SEQ, B, HID)  # [s, b, hid]
+    shards = []
+    for j in range(CP):  # seq shards
+        rows = []
+        for i in range(EP):  # batch shards
+            blk = h[j * (SEQ // CP):(j + 1) * (SEQ // CP), i:i + 1]
+            rows.append(layer.apply({"params": params}, blk))
+        shards.append(jnp.concatenate(rows, axis=1))
+    return jnp.concatenate(shards, axis=0)  # [s, b, hid]
+
+
+def test_ring_attention_plus_moe_on_five_axis_mesh():
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=TP, expert_model_parallel_size_=EP,
+        context_parallel_size_=CP, devices=jax.devices()[:8])
+    assert tuple(mesh.axis_names) == ("pp", "dp", "ep", "cp", "tp")
+    assert parallel_state.get_expert_model_parallel_world_size() == EP
+    assert parallel_state.get_context_parallel_world_size() == CP
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, SEQ, NH, D), jnp.float32)
+               for _ in range(3))
+
+    layer = SwitchMLP(hidden_size=HID, ffn_hidden_size=2 * HID,
+                      num_experts=E, capacity_factor=8.0,
+                      compute_dtype=jnp.float32)
+    h_probe = jnp.zeros((SEQ // CP, 1, HID), jnp.float32)
+
+    # Params: build once with ep=tp=1 so the oracle owns all E experts and
+    # the full ffn, then hand each (ep, tp) rank its slice via the specs.
+    saved_ep = parallel_state._EXPERT_MODEL_PARALLEL_WORLD_SIZE
+    saved_tp = parallel_state._TENSOR_MODEL_PARALLEL_WORLD_SIZE
+    parallel_state._EXPERT_MODEL_PARALLEL_WORLD_SIZE = 1
+    parallel_state._TENSOR_MODEL_PARALLEL_WORLD_SIZE = 1
+    params = layer.init(jax.random.PRNGKey(0), h_probe)["params"]
+    ref = _reference(q, k, v, layer, params)
+    parallel_state._EXPERT_MODEL_PARALLEL_WORLD_SIZE = saved_ep
+    parallel_state._TENSOR_MODEL_PARALLEL_WORLD_SIZE = saved_tp
+
+    pspec = {"router": {"gate_weight": P()},
+             "experts": {"w1": P("ep", None, "tp"), "b1": P("ep", "tp"),
+                         "w2": P("ep", "tp", None), "b2": P("ep", None)}}
+
+    @shard_map(mesh=mesh,
+               in_specs=(pspec, P(None, "cp"), P(None, "cp"), P(None, "cp")),
+               out_specs=P("cp", "ep", None))
+    def run(p, qs, ks, vs):
+        # ring attention over the cp axis (full heads per rank)
+        attn = ring_self_attention(qs, ks, vs, causal=False)
+        s_local = attn.shape[1]
+        h = attn.transpose(1, 0, 2, 3).reshape(s_local, B, HID)
+        # each ep rank keeps its batch shard for the MoE tokens
+        i = jax.lax.axis_index("ep")
+        h = jax.lax.dynamic_slice_in_dim(h, i * (B // EP), B // EP, axis=1)
+        return layer.apply({"params": p}, h)
+
+    out = run(params, q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
