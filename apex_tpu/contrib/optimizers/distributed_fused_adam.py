@@ -17,6 +17,18 @@ when the whole train step is one jit.
 
 Must run inside shard_map with the 'dp' axis bound; falls back to
 single-device (no collectives) when the axis is absent.
+
+Expert parallelism: the flat-vector sharding treats each (ep, tp) cell's
+local param view independently, and ``step``'s psum_scatter averages over
+'dp' alone — correct for expert shards, but *dense* params also replicate
+over 'ep'. Pre-average dense grads over 'ep' first::
+
+    grads = all_reduce_gradients(grads, axis_name="ep",
+                                 expert_param_predicate=is_expert_param,
+                                 expert_axis_name=())   # experts untouched
+    params, opt_state = opt.step(grads, opt_state, params)
+
+(total dense averaging = ep here x dp inside = the full replica set).
 """
 
 from typing import Optional

@@ -43,7 +43,8 @@ def unflatten(flat, tensors):
 
 
 def _axis_size_total(axis_name):
-    """Axis size, with tuple axes multiplied (dp x ep replica sets)."""
+    """Axis size, with tuple axes multiplied (dp x ep replica sets);
+    an empty tuple means "no reduction" (size 1)."""
     if isinstance(axis_name, (tuple, list)):
         n = 1
         for a in axis_name:
@@ -59,7 +60,12 @@ def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
     postdivide after the psum, cast back to the original dtype.
     ``axis_name`` may be a tuple of mesh axes (e.g.
     ``parallel_state.get_data_parallel_axes()`` = ('dp', 'ep') when expert
-    parallelism borrows devices from the replica axis)."""
+    parallelism borrows devices from the replica axis); an empty tuple
+    skips the reduction (used as ``expert_axis_name=()`` to leave expert
+    shards untouched in a pre-sync pass, e.g. before a ZeRO optimizer
+    that reduce-scatters over dp itself)."""
+    if isinstance(axis_name, (tuple, list)) and len(axis_name) == 0:
+        return g
     orig_dtype = g.dtype
     if allreduce_always_fp32:
         g = g.astype(jnp.float32)
