@@ -13,3 +13,5 @@ from apex_tpu.transformer import layers  # noqa: F401
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401
 from apex_tpu.transformer.microbatches import build_num_microbatches_calculator  # noqa: F401
 from apex_tpu.transformer import amp  # noqa: F401
+from apex_tpu.transformer import context_parallel  # noqa: F401
+from apex_tpu.transformer import moe  # noqa: F401
