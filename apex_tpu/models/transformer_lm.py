@@ -56,6 +56,7 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_layer_freq: int = 1
     moe_jitter_eps: float = 0.0
+    moe_router_type: str = "top_k"  # or "expert_choice"
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
 
@@ -214,6 +215,7 @@ class ParallelTransformerLayer(nn.Module):
                 num_experts=cfg.num_moe_experts, top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 jitter_eps=cfg.moe_jitter_eps,
+                router_type=cfg.moe_router_type,
                 params_dtype=cfg.params_dtype,
                 compute_dtype=cfg.compute_dtype,
                 sequence_parallel_enabled=cfg.sequence_parallel, name="mlp")

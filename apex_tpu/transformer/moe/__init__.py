@@ -15,12 +15,17 @@ from apex_tpu.transformer.moe.layer import (
     is_expert_param,
     moe_loss_from_variables,
 )
-from apex_tpu.transformer.moe.router import TopKRouter, compute_routing
+from apex_tpu.transformer.moe.router import (
+    TopKRouter,
+    compute_expert_choice_routing,
+    compute_routing,
+)
 
 __all__ = [
     "ExpertMLP",
     "SwitchMLP",
     "TopKRouter",
+    "compute_expert_choice_routing",
     "compute_routing",
     "is_expert_param",
     "moe_loss_from_variables",

@@ -153,6 +153,7 @@ class SwitchMLP(nn.Module):
     top_k: int = 1
     capacity_factor: float = 1.25
     jitter_eps: float = 0.0
+    router_type: str = "top_k"  # or "expert_choice" (balanced, no aux)
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     sequence_parallel_enabled: bool = False
@@ -182,6 +183,7 @@ class SwitchMLP(nn.Module):
         routing = TopKRouter(
             num_experts=self.num_experts, top_k=self.top_k,
             capacity_factor=self.capacity_factor, jitter_eps=self.jitter_eps,
+            router_type=self.router_type,
             params_dtype=self.params_dtype, name="router")(tokens)
         sown = self.sow("moe_losses", "aux_loss", routing.aux_loss)
         self.sow("moe_losses", "z_loss", routing.z_loss)

@@ -87,6 +87,33 @@ def compute_routing(logits, top_k: int, capacity: int,
                          lax.stop_gradient(dropped))
 
 
+def compute_expert_choice_routing(logits, capacity: int) -> RoutingResult:
+    """Expert-choice routing (Zhou et al. 2022, arXiv 2202.09368): each
+    expert picks its top-``capacity`` tokens by router probability.
+
+    Perfectly load-balanced by construction (every expert fills exactly C
+    slots), so the Switch aux loss degenerates — it is returned as 0. A
+    token may be chosen by several experts (contributions sum) or by none
+    (rides the residual; tracked in ``dropped_fraction``). TPU-friendly:
+    one ``lax.top_k`` over tokens per expert plus the same one-hot
+    dispatch/combine einsums as top-k routing.
+    """
+    logits = logits.astype(jnp.float32)
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    # per expert: weights + token indices of its top-C tokens
+    gates, idx = lax.top_k(probs.T, min(capacity, T))  # [E, C], [E, C]
+    dispatch = jax.nn.one_hot(idx, T, dtype=jnp.float32)  # [E, C, T]
+    dispatch = dispatch.transpose(2, 0, 1)                # [T, E, C]
+    combine = dispatch * gates[None, :, :]
+    picked = jnp.clip(jnp.sum(dispatch, axis=(1, 2)), 0.0, 1.0)  # [T]
+    dropped = 1.0 - jnp.mean(picked)
+    z = jax.scipy.special.logsumexp(logits, axis=-1)
+    z_loss = jnp.mean(z * z)
+    return RoutingResult(dispatch, combine, jnp.zeros((), jnp.float32),
+                         z_loss, probs, lax.stop_gradient(dropped))
+
+
 def _tp_uniform_key(key):
     """Broadcast tp-rank-0's rng key across the tp axis (no-op outside
     shard_map / when tp is unbound)."""
@@ -110,9 +137,11 @@ def _tp_uniform_key(key):
 class TopKRouter(nn.Module):
     """Learned gate: fp32 projection to expert logits + optional jitter.
 
-    The gate weight is a dense (replicated) param — with expert
-    parallelism its grads must sync over the full dp x ep replica set like
-    any other dense param.
+    ``router_type`` selects the assignment rule: "top_k" (tokens choose
+    experts — GShard/Switch) or "expert_choice" (experts choose tokens —
+    balanced by construction, no aux loss). The gate weight is a dense
+    (replicated) param — with expert parallelism its grads must sync over
+    the full dp x ep replica set like any other dense param.
     """
 
     num_experts: int
@@ -120,6 +149,7 @@ class TopKRouter(nn.Module):
     capacity_factor: float = 1.25
     jitter_eps: float = 0.0
     normalize_topk: bool = True
+    router_type: str = "top_k"
     params_dtype: Any = jnp.float32
     capacity: Optional[int] = None  # override for tests
 
@@ -148,4 +178,9 @@ class TopKRouter(nn.Module):
         logits = x @ gate.astype(jnp.float32)
         cap = self.capacity if self.capacity is not None else expert_capacity(
             T, self.num_experts, self.top_k, self.capacity_factor)
+        if self.router_type == "expert_choice":
+            return compute_expert_choice_routing(logits, cap)
+        if self.router_type != "top_k":
+            raise ValueError(f"unknown router_type {self.router_type!r}; "
+                             "expected 'top_k' or 'expert_choice'")
         return compute_routing(logits, self.top_k, cap, self.normalize_topk)

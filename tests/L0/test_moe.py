@@ -77,6 +77,133 @@ class TestRouting:
         assert expert_capacity(16, 8, 1, 1.0) == 2
 
 
+class TestExpertChoiceRouting:
+    def test_each_expert_fills_capacity(self):
+        from apex_tpu.transformer.moe import compute_expert_choice_routing
+
+        logits = jnp.asarray(np.random.RandomState(0).randn(8, 3),
+                             jnp.float32)
+        r = compute_expert_choice_routing(logits, capacity=2)
+        d = np.asarray(r.dispatch_mask)  # [T, E, C]
+        # every expert fills exactly its 2 slots — balanced by construction
+        np.testing.assert_array_equal(d.sum(axis=(0, 2)), [2, 2, 2])
+        assert float(r.aux_loss) == 0.0
+        # combine weight at a filled slot equals that token's prob
+        probs = np.asarray(r.probs)
+        c = np.asarray(r.combine_weights)
+        t, e, s = np.argwhere(d > 0)[0]
+        np.testing.assert_allclose(c[t, e, s], probs[t, e], rtol=1e-6)
+
+    def test_expert_picks_its_top_tokens(self):
+        from apex_tpu.transformer.moe import compute_expert_choice_routing
+
+        # expert 0 strongly prefers tokens 1 and 3
+        logits = jnp.array([[0.0, 1.0],
+                            [5.0, 0.0],
+                            [0.1, 1.0],
+                            [4.0, 0.0]])
+        r = compute_expert_choice_routing(logits, capacity=2)
+        d = np.asarray(r.dispatch_mask)
+        assert d[1, 0].sum() == 1 and d[3, 0].sum() == 1
+        # tokens 0 and 2 were not chosen by expert 0
+        assert d[0, 0].sum() == 0 and d[2, 0].sum() == 0
+
+    def test_dropped_fraction_counts_unpicked_tokens(self):
+        from apex_tpu.transformer.moe import compute_expert_choice_routing
+
+        # 4 tokens, 1 expert, capacity 2 -> 2 tokens unpicked
+        logits = jnp.asarray(np.random.RandomState(1).randn(4, 1),
+                             jnp.float32)
+        r = compute_expert_choice_routing(logits, capacity=2)
+        np.testing.assert_allclose(float(r.dropped_fraction), 0.5)
+
+    def test_switch_mlp_expert_choice_grads(self):
+        layer = SwitchMLP(hidden_size=16, ffn_hidden_size=32, num_experts=4,
+                          capacity_factor=2.0, router_type="expert_choice",
+                          compute_dtype=jnp.float32)
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 2, 16), jnp.float32)
+        params = layer.init(jax.random.PRNGKey(0), x)["params"]
+
+        def loss(p):
+            return jnp.sum(layer.apply({"params": p}, x,
+                                       mutable=["moe_losses"])[0] ** 2)
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(g["router"]["gate_weight"]).sum()) > 0
+        assert float(jnp.abs(g["experts"]["w1"]).sum()) > 0
+
+    def test_expert_choice_ep_matches_local(self):
+        E, ep = 4, 4
+        rng = np.random.RandomState(7)
+        params = {
+            "router": {"gate_weight": jnp.asarray(
+                rng.randn(16, E) * 0.2, jnp.float32)},
+            "experts": {
+                "w1": jnp.asarray(rng.randn(E, 16, 32) * 0.1, jnp.float32),
+                "b1": jnp.zeros((E, 32), jnp.float32),
+                "w2": jnp.asarray(rng.randn(E, 32, 16) * 0.1, jnp.float32),
+                "b2": jnp.zeros((E, 16), jnp.float32),
+            },
+        }
+        x = jnp.asarray(rng.randn(8, ep, 16), jnp.float32)
+        parallel_state.initialize_model_parallel(
+            expert_model_parallel_size_=ep, devices=jax.devices()[:ep])
+        mesh = parallel_state.get_mesh()
+        layer = SwitchMLP(hidden_size=16, ffn_hidden_size=32, num_experts=E,
+                          capacity_factor=2.0, router_type="expert_choice",
+                          compute_dtype=jnp.float32)
+
+        saved = parallel_state._EXPERT_MODEL_PARALLEL_WORLD_SIZE
+        parallel_state._EXPERT_MODEL_PARALLEL_WORLD_SIZE = 1
+        ref = jnp.concatenate(
+            [layer.apply({"params": params}, x[:, i:i + 1])
+             for i in range(ep)], axis=1)
+        parallel_state._EXPERT_MODEL_PARALLEL_WORLD_SIZE = saved
+
+        pspec = {"router": {"gate_weight": P()},
+                 "experts": {k: P("ep") for k in params["experts"]}}
+
+        @shard_map(mesh=mesh, in_specs=(pspec, P(None, "ep", None)),
+                   out_specs=P(None, "ep", None))
+        def run(p, xs):
+            return layer.apply({"params": p}, xs)
+
+        np.testing.assert_allclose(np.asarray(run(params, x)),
+                                   np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_unknown_router_type_raises(self):
+        layer = SwitchMLP(hidden_size=16, ffn_hidden_size=32, num_experts=2,
+                          router_type="nonsense", compute_dtype=jnp.float32)
+        x = jnp.ones((4, 1, 16))
+        with pytest.raises(ValueError, match="router_type"):
+            layer.init(jax.random.PRNGKey(0), x)
+
+    def test_gpt_expert_choice_config(self):
+        from apex_tpu.models import GPTModel, TransformerConfig
+        from apex_tpu.models.gpt import gpt_loss_fn
+
+        parallel_state.destroy_model_parallel()
+        cfg = TransformerConfig(
+            hidden_size=32, num_layers=2, num_attention_heads=4,
+            vocab_size=64, max_position_embeddings=16,
+            compute_dtype=jnp.float32, use_flash_attention=False,
+            num_moe_experts=4, moe_router_type="expert_choice")
+        model = GPTModel(cfg)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, 64, size=(2, 16)))
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+
+        def loss_fn(p):
+            logits, _ = model.apply({"params": p}, tokens,
+                                    mutable=["moe_losses"])
+            return gpt_loss_fn(logits, jnp.roll(tokens, -1, axis=-1))
+
+        loss, g = jax.value_and_grad(loss_fn)(variables["params"])
+        assert np.isfinite(float(loss))
+        router_g = g["transformer"]["layer_0"]["mlp"]["router"]["gate_weight"]
+        assert float(jnp.abs(router_g).sum()) > 0
+
+
 class TestSwitchMLP:
     def _make(self, num_experts=4, top_k=1, capacity=64, hidden=16, ffn=32):
         layer = SwitchMLP(hidden_size=hidden, ffn_hidden_size=ffn,
