@@ -47,6 +47,12 @@ class TransformerConfig:
     sequence_parallel: bool = False
     use_flash_attention: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
+    # Compile the layer stack as ONE lax.scan over stacked params instead
+    # of unrolling n layers (compile time O(1) in depth — the unrolled
+    # 24-layer GPT costs minutes of XLA time per bench variant). Params
+    # get a leading [num_layers] axis under 'layers'; requires a uniform
+    # stack (with MoE: moe_layer_freq == 1).
+    scan_layers: bool = False
     # Mixture-of-experts (no reference equivalent; SURVEY.md §2.3 note).
     # None -> dense ParallelMLP everywhere. Every ``moe_layer_freq``-th
     # layer (starting at layer 0) becomes a SwitchMLP with this many
@@ -226,6 +232,21 @@ class ParallelTransformerLayer(nn.Module):
         return hidden_states + mlp_out.astype(hidden_states.dtype)
 
 
+class _ScanBlock(nn.Module):
+    """lax.scan body for ParallelTransformer(scan_layers=True): one
+    uniform layer, (carry, out) signature; params carry a leading
+    [num_layers] axis under 'layers/layer'."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask):
+        h = ParallelTransformerLayer(self.config, layer_number=0,
+                                     name="layer")(hidden_states,
+                                                   attention_mask)
+        return h, None
+
+
 class ParallelTransformer(nn.Module):
     """A stack of layers, optionally rematerialized per layer
     (reference ParallelTransformer with activation checkpointing -> here
@@ -239,6 +260,23 @@ class ParallelTransformer(nn.Module):
     def __call__(self, hidden_states, attention_mask=None):
         cfg = self.config
         n = self.num_layers if self.num_layers is not None else cfg.num_layers
+        if cfg.scan_layers:
+            if cfg.num_moe_experts is not None and cfg.moe_layer_freq != 1:
+                raise ValueError(
+                    "scan_layers needs a uniform stack: moe_layer_freq "
+                    "must be 1 (every layer MoE) or num_moe_experts None")
+            block = _ScanBlock
+            if self.activation_checkpointing:
+                block = nn.remat(block, static_argnums=(),
+                                 prevent_cse=False)
+            scanned = nn.scan(
+                block,
+                variable_axes={"params": 0, "moe_losses": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast,), length=n,
+                metadata_params={nn.PARTITION_NAME: None})
+            h, _ = scanned(cfg, name="layers")(hidden_states, attention_mask)
+            return h
         layer = ParallelTransformerLayer
         if self.activation_checkpointing:
             layer = nn.checkpoint(ParallelTransformerLayer,

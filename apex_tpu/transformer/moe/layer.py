@@ -53,6 +53,7 @@ def moe_loss_from_variables(variables, aux_loss_coeff: float = 1e-2,
     z = jnp.zeros((), jnp.float32)
     for path, val in flax.traverse_util.flatten_dict(dict(losses)).items():
         total = sum(val) if isinstance(val, (tuple, list)) else val
+        total = jnp.sum(total)  # scan-stacked layers sow [L]-shaped entries
         if path[-1] == "aux_loss":
             aux = aux + total
         elif path[-1] == "z_loss":
