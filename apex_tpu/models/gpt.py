@@ -15,8 +15,8 @@ import jax.numpy as jnp
 from apex_tpu.models.transformer_lm import (
     ParallelTransformer,
     TransformerConfig,
+    _make_norm,
 )
-from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer.parallel_state import (
     get_tensor_model_parallel_world_size,
 )
@@ -48,29 +48,33 @@ class GPTModel(nn.Module):
                 num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
                 params_dtype=cfg.params_dtype, name="word_embeddings")
             h = emb(tokens)
-            if position_ids is None:
-                position_ids = jnp.arange(tokens.shape[-1])[None, :]
-            pos = self.param(
-                "position_embeddings", nn.initializers.normal(0.02),
-                (cfg.max_position_embeddings, cfg.hidden_size),
-                cfg.params_dtype)
-            h = h + pos[position_ids]
+            if cfg.position_embedding_type == "learned":
+                if position_ids is None:
+                    position_ids = jnp.arange(tokens.shape[-1])[None, :]
+                pos = self.param(
+                    "position_embeddings", nn.initializers.normal(0.02),
+                    (cfg.max_position_embeddings, cfg.hidden_size),
+                    cfg.params_dtype)
+                h = h + pos[position_ids]
             h = h.astype(cfg.compute_dtype)
             # [b, s, h] -> [s, b, h] (Megatron layout: seq-major for SP)
             h = h.transpose(1, 0, 2)
         else:
             h = hidden_input
 
+        # rope consumes positions inside attention (seq-major [s, b]);
+        # packed-sequence callers pass per-document position_ids [b, s]
+        rope_positions = (position_ids.transpose(1, 0)
+                          if (cfg.position_embedding_type == "rope"
+                              and position_ids is not None) else None)
         h = ParallelTransformer(cfg, num_layers=self.num_layers,
-                                name="transformer")(h, attention_mask)
+                                name="transformer")(h, attention_mask,
+                                                    rope_positions)
 
         if not self.post_process:
             return h
 
-        h = FusedLayerNorm(normalized_shape=cfg.hidden_size,
-                           eps=cfg.layernorm_epsilon,
-                           param_dtype=jnp.float32,
-                           name="final_layernorm")(h.astype(jnp.float32))
+        h = _make_norm(cfg, "final_layernorm")(h.astype(jnp.float32))
         # Output logits through a vocab-parallel projection. Weight tying
         # with the input embedding (reference parallel_lm_logits) requires
         # the embedding table; within one jitted SPMD program we re-declare

@@ -19,8 +19,8 @@ from apex_tpu.models.gpt import _fold_tp
 from apex_tpu.models.transformer_lm import (
     ParallelTransformer,
     TransformerConfig,
+    _make_norm,
 )
-from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer.parallel_state import (
     get_tensor_model_parallel_world_size,
 )
@@ -43,14 +43,14 @@ class GPTStage(nn.Module):
         self.word_embeddings = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
             params_dtype=cfg.params_dtype, name="word_embeddings")
-        self.position_embeddings = self.param(
-            "position_embeddings", nn.initializers.normal(0.02),
-            (cfg.max_position_embeddings, cfg.hidden_size), cfg.params_dtype)
+        if cfg.position_embedding_type == "learned":
+            self.position_embeddings = self.param(
+                "position_embeddings", nn.initializers.normal(0.02),
+                (cfg.max_position_embeddings, cfg.hidden_size),
+                cfg.params_dtype)
         self.transformer = ParallelTransformer(
             cfg, num_layers=self.layers_per_stage, name="transformer")
-        self.final_layernorm = FusedLayerNorm(
-            normalized_shape=cfg.hidden_size, eps=cfg.layernorm_epsilon,
-            param_dtype=jnp.float32, name="final_layernorm")
+        self.final_layernorm = _make_norm(cfg, "final_layernorm")
         tp = get_tensor_model_parallel_world_size()
         self.lm_head = self.param(
             "lm_head",
@@ -62,7 +62,8 @@ class GPTStage(nn.Module):
         cfg = self.config
         s = tokens.shape[-1]
         h = self.word_embeddings(tokens)
-        h = h + self.position_embeddings[:s][None, :, :]
+        if cfg.position_embedding_type == "learned":
+            h = h + self.position_embeddings[:s][None, :, :]
         h = h.astype(cfg.compute_dtype).transpose(1, 0, 2)  # [s, b, h]
         if cfg.sequence_parallel:
             h = scatter_to_sequence_parallel_region(h)

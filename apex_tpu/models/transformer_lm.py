@@ -65,6 +65,32 @@ class TransformerConfig:
     moe_router_type: str = "top_k"  # or "expert_choice"
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
+    # Modern-LLM (Llama-family) knobs — beyond the reference, which is
+    # GPT-2/BERT-era: grouped-query attention (fewer K/V head groups),
+    # rotary position embeddings, SwiGLU MLPs, RMSNorm blocks.
+    num_query_groups: Optional[int] = None  # None -> MHA (groups == heads)
+    position_embedding_type: str = "learned"  # or "rope"
+    rotary_base: float = 10000.0
+    activation: str = "gelu"  # or "swiglu"
+    normalization: str = "layernorm"  # or "rmsnorm"
+
+    def __post_init__(self):
+        if self.position_embedding_type not in ("learned", "rope"):
+            raise ValueError(
+                f"unknown position_embedding_type "
+                f"{self.position_embedding_type!r}; expected 'learned' or "
+                f"'rope'")
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown activation {self.activation!r}")
+        if self.normalization not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"unknown normalization {self.normalization!r}")
+        if self.num_query_groups is not None:
+            if (self.num_query_groups < 1
+                    or self.num_attention_heads % self.num_query_groups):
+                raise ValueError(
+                    f"num_attention_heads ({self.num_attention_heads}) must "
+                    f"be a positive multiple of num_query_groups "
+                    f"({self.num_query_groups})")
 
     @property
     def ffn_size(self):
@@ -74,9 +100,50 @@ class TransformerConfig:
     def kv_channels(self):
         return self.hidden_size // self.num_attention_heads
 
+    @property
+    def query_groups(self):
+        return self.num_query_groups or self.num_attention_heads
+
 
 def _attn_mask_fn(scores, mask):
     return jnp.where(mask.astype(bool), -10000.0, scores)
+
+
+def apply_rotary_emb(x, base: float = 10000.0, positions=None):
+    """Rotary position embedding (rotate-half convention) on [s, b, n, d].
+
+    ``positions`` is [s] (shared across the batch) or [s, b] (per-sequence
+    indices, e.g. packed documents); defaults to global indices 0..s-1 —
+    correct under sequence parallelism too, because the QKV projections
+    gather the full sequence before heads are formed. fp32 trig, cast
+    back to x.dtype.
+    """
+    s, _, _, d = x.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = positions[..., None].astype(jnp.float32) * inv  # [s(,b), d/2]
+    if freqs.ndim == 2:  # [s, d/2] -> broadcast over batch and heads
+        freqs = freqs[:, None, :]
+    cos = jnp.cos(freqs)[:, :, None, :]
+    sin = jnp.sin(freqs)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+def _make_norm(cfg, name):
+    if cfg.normalization == "rmsnorm":
+        from apex_tpu.normalization import FusedRMSNorm
+
+        return FusedRMSNorm(normalized_shape=cfg.hidden_size,
+                            eps=cfg.layernorm_epsilon,
+                            param_dtype=jnp.float32, name=name)
+    if cfg.normalization != "layernorm":
+        raise ValueError(f"unknown normalization {cfg.normalization!r}")
+    return FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                          eps=cfg.layernorm_epsilon,
+                          param_dtype=jnp.float32, name=name)
 
 
 class ParallelAttention(nn.Module):
@@ -86,22 +153,54 @@ class ParallelAttention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, hidden_states, attention_mask=None):
+    def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
         tp = get_tensor_model_parallel_world_size()
         np_local = cfg.num_attention_heads // tp
         kv = cfg.kv_channels
         s, b, h = hidden_states.shape[-3:]
+        x = hidden_states.astype(cfg.compute_dtype)
 
-        qkv = ColumnParallelLinear(
-            input_size=cfg.hidden_size, output_size=3 * cfg.hidden_size,
-            gather_output=False, bias=True, params_dtype=cfg.params_dtype,
-            sequence_parallel_enabled=cfg.sequence_parallel,
-            name="query_key_value")(hidden_states.astype(cfg.compute_dtype))
-        # [s, b, 3*h/tp] -> [s, b, np_local, 3*kv]
-        seq_full = qkv.shape[0]
-        qkv = qkv.reshape(seq_full, b, np_local, 3 * kv)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if cfg.query_groups == cfg.num_attention_heads:
+            qkv = ColumnParallelLinear(
+                input_size=cfg.hidden_size, output_size=3 * cfg.hidden_size,
+                gather_output=False, bias=True, params_dtype=cfg.params_dtype,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="query_key_value")(x)
+            # [s, b, 3*h/tp] -> [s, b, np_local, 3*kv]
+            seq_full = qkv.shape[0]
+            qkv = qkv.reshape(seq_full, b, np_local, 3 * kv)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            # Grouped-query attention: fewer K/V head groups; ONE fused
+            # projection (a single SP all-gather / matmul dispatch) whose
+            # per-rank columns lay out as [q heads | kv groups] — each tp
+            # rank holds whole groups, and per-rank pairing is
+            # self-consistent because shards are initialized per rank.
+            from apex_tpu.transformer.tensor_parallel.utils import divide
+
+            g_local = divide(cfg.query_groups, tp)
+            proj = ColumnParallelLinear(
+                input_size=cfg.hidden_size,
+                output_size=(cfg.num_attention_heads
+                             + 2 * cfg.query_groups) * kv,
+                gather_output=False, bias=True, params_dtype=cfg.params_dtype,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="query_key_value")(x)
+            seq_full = proj.shape[0]
+            q = proj[..., :np_local * kv].reshape(seq_full, b, np_local, kv)
+            kvp = proj[..., np_local * kv:].reshape(seq_full, b, g_local,
+                                                    2 * kv)
+            k, v = jnp.split(kvp, 2, axis=-1)
+
+        if cfg.position_embedding_type == "rope":
+            q = apply_rotary_emb(q, cfg.rotary_base, position_ids)
+            k = apply_rotary_emb(k, cfg.rotary_base, position_ids)
+        if k.shape[2] != np_local:
+            # broadcast each K/V group to its query heads
+            rep = np_local // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
         # flash handles only the built-in causal/full patterns: an
         # explicit attention_mask (e.g. padding) must take the masked
@@ -172,15 +271,32 @@ class ParallelMLP(nn.Module):
     @nn.compact
     def __call__(self, hidden_states):
         cfg = self.config
-        x = ColumnParallelLinear(
-            input_size=cfg.hidden_size, output_size=cfg.ffn_size,
-            gather_output=False, bias=True, params_dtype=cfg.params_dtype,
-            sequence_parallel_enabled=cfg.sequence_parallel,
-            name="dense_h_to_4h")(hidden_states.astype(cfg.compute_dtype))
-        x = jax.nn.gelu(x.astype(jnp.float32)).astype(cfg.compute_dtype)
+        if cfg.activation == "swiglu":
+            # Fused [gate | up] projection: each tp rank's local columns
+            # split into its own gate/up halves (per-rank pairing is
+            # self-consistent because shards are initialized per rank,
+            # not sliced from a global matrix).
+            gate_up = ColumnParallelLinear(
+                input_size=cfg.hidden_size, output_size=2 * cfg.ffn_size,
+                gather_output=False, bias=False,
+                params_dtype=cfg.params_dtype,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="dense_h_to_4h")(hidden_states.astype(cfg.compute_dtype))
+            gate, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
+            x = (jax.nn.silu(gate) * up).astype(cfg.compute_dtype)
+        elif cfg.activation == "gelu":
+            x = ColumnParallelLinear(
+                input_size=cfg.hidden_size, output_size=cfg.ffn_size,
+                gather_output=False, bias=True, params_dtype=cfg.params_dtype,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                name="dense_h_to_4h")(hidden_states.astype(cfg.compute_dtype))
+            x = jax.nn.gelu(x.astype(jnp.float32)).astype(cfg.compute_dtype)
+        else:
+            raise ValueError(f"unknown activation {cfg.activation!r}")
         x = RowParallelLinear(
             input_size=cfg.ffn_size, output_size=cfg.hidden_size,
-            input_is_parallel=True, bias=True, params_dtype=cfg.params_dtype,
+            input_is_parallel=True, bias=(cfg.activation != "swiglu"),
+            params_dtype=cfg.params_dtype,
             sequence_parallel_enabled=cfg.sequence_parallel,
             name="dense_4h_to_h")(x)
         return x
@@ -198,20 +314,14 @@ class ParallelTransformerLayer(nn.Module):
                 and self.layer_number % cfg.moe_layer_freq == 0)
 
     @nn.compact
-    def __call__(self, hidden_states, attention_mask=None):
+    def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
-        ln1 = FusedLayerNorm(normalized_shape=cfg.hidden_size,
-                             eps=cfg.layernorm_epsilon,
-                             param_dtype=jnp.float32,
-                             name="input_layernorm")
+        ln1 = _make_norm(cfg, "input_layernorm")
         attn_out = ParallelAttention(cfg, name="self_attention")(
             ln1(hidden_states.astype(jnp.float32)).astype(cfg.compute_dtype),
-            attention_mask)
+            attention_mask, position_ids)
         hidden_states = hidden_states + attn_out.astype(hidden_states.dtype)
-        ln2 = FusedLayerNorm(normalized_shape=cfg.hidden_size,
-                             eps=cfg.layernorm_epsilon,
-                             param_dtype=jnp.float32,
-                             name="post_attention_layernorm")
+        ln2 = _make_norm(cfg, "post_attention_layernorm")
         if self._is_moe_layer():
             from apex_tpu.transformer.moe import SwitchMLP
 
@@ -240,10 +350,11 @@ class _ScanBlock(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, hidden_states, attention_mask):
+    def __call__(self, hidden_states, attention_mask, position_ids):
         h = ParallelTransformerLayer(self.config, layer_number=0,
                                      name="layer")(hidden_states,
-                                                   attention_mask)
+                                                   attention_mask,
+                                                   position_ids)
         return h, None
 
 
@@ -257,7 +368,7 @@ class ParallelTransformer(nn.Module):
     activation_checkpointing: bool = True
 
     @nn.compact
-    def __call__(self, hidden_states, attention_mask=None):
+    def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
         n = self.num_layers if self.num_layers is not None else cfg.num_layers
         if cfg.scan_layers:
@@ -272,10 +383,13 @@ class ParallelTransformer(nn.Module):
             scanned = nn.scan(
                 block,
                 variable_axes={"params": 0, "moe_losses": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast,), length=n,
+                # split 'jitter' too: un-listed rng streams are DROPPED by
+                # nn.scan, which would silently disable router jitter
+                split_rngs={"params": True, "jitter": True},
+                in_axes=(nn.broadcast, nn.broadcast), length=n,
                 metadata_params={nn.PARTITION_NAME: None})
-            h, _ = scanned(cfg, name="layers")(hidden_states, attention_mask)
+            h, _ = scanned(cfg, name="layers")(hidden_states, attention_mask,
+                                               position_ids)
             return h
         layer = ParallelTransformerLayer
         if self.activation_checkpointing:
@@ -283,7 +397,7 @@ class ParallelTransformer(nn.Module):
                                   static_argnums=())
         for i in range(n):
             hidden_states = layer(cfg, layer_number=i, name=f"layer_{i}")(
-                hidden_states, attention_mask)
+                hidden_states, attention_mask, position_ids)
         return hidden_states
 
 

@@ -85,6 +85,22 @@ def test_scan_with_moe_collects_losses():
     assert float(total) > 2.0
 
 
+def test_scan_moe_jitter_rng_threaded():
+    """nn.scan must forward the 'jitter' rng stream (split per layer) —
+    unlisted streams are dropped, which would silently disable jitter."""
+    parallel_state.destroy_model_parallel()
+    cfg = _cfg(scan_layers=True, num_moe_experts=2, moe_capacity_factor=4.0,
+               moe_jitter_eps=0.3)
+    x = jnp.asarray(np.random.RandomState(4).randn(8, 2, 32), jnp.float32)
+    model = ParallelTransformer(cfg)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    base, _ = model.apply({"params": params}, x, mutable=["moe_losses"])
+    jittered, _ = model.apply({"params": params}, x,
+                              rngs={"jitter": jax.random.PRNGKey(7)},
+                              mutable=["moe_losses"])
+    assert not np.allclose(np.asarray(base), np.asarray(jittered))
+
+
 def test_scan_moe_requires_uniform_stack():
     import pytest
 
