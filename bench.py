@@ -9,6 +9,8 @@
                                            flash attention) tokens/sec/chip
     python bench.py moe [batch] [steps]    MoE GPT (8 experts top-1, every
                                            other layer) tokens/sec/chip
+    python bench.py llama [batch] [steps]  Llama-style GPT (RoPE + GQA +
+                                           SwiGLU + RMSNorm) tokens/sec/chip
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
@@ -136,6 +138,51 @@ def bench_gpt_long(seq, steps):
     }))
 
 
+def bench_llama(batch, steps):
+    """Llama-style GPT (16 layers x 1024, RoPE + GQA 4 groups + SwiGLU +
+    RMSNorm, flash attention, scan_layers) single-chip training
+    throughput — the modern-LLM architecture knobs end to end."""
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.models.gpt import gpt_loss_fn
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    seq = 1024
+    cfg = TransformerConfig(
+        hidden_size=1024, num_layers=16, num_attention_heads=16,
+        vocab_size=32000, max_position_embeddings=seq,
+        compute_dtype=jnp.bfloat16, use_flash_attention=True,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=4,
+        ffn_hidden_size=2816,  # ~8/3 * h, llama sizing
+        scan_layers=True)
+    model = GPTModel(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss_fn(model.apply({"params": p}, tokens),
+                                  labels))(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss
+
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
+    print(json.dumps({
+        "metric": "llama_style_gpt_tokens_per_sec_per_chip",
+        "value": round(batch * seq * steps / dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
 def bench_moe(batch, steps):
     """MoE GPT (16 layers x 1024, 8 experts top-1, seq 1024) single-chip
     training throughput — the expert-parallel capability beyond the
@@ -201,6 +248,10 @@ def main():
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
         return bench_moe(batch, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "llama":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
+        return bench_llama(batch, steps)
 
     # batch 256 measured ~1.7x faster per chip than 128 on the v5e/v6e
     # class chip (better MXU utilization); 50 steps amortize dispatch
