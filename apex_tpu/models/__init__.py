@@ -13,6 +13,11 @@ from apex_tpu.models.transformer_lm import (  # noqa: F401
     TransformerConfig,
 )
 from apex_tpu.models.gpt import GPTModel, gpt_loss_fn  # noqa: F401
+from apex_tpu.models.generation import (  # noqa: F401
+    generate,
+    init_cache,
+    sample_logits,
+)
 from apex_tpu.models.bert import BertModel, bert_loss_fn  # noqa: F401
 from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
 from apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401

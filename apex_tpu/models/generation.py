@@ -1,0 +1,152 @@
+"""Autoregressive generation with KV-cache decoding.
+
+No reference counterpart (NVIDIA Apex is training-only); this completes
+the model family with a serving-shaped path: prefill the cache in one
+pass over the prompt, then a jitted ``lax.scan`` of single-token steps —
+static shapes throughout, cache carried as scan state. Compiled step
+functions are cached per (model, shape, sampling-config), so a serving
+loop pays compile cost once.
+
+    model = GPTModel(cfg, decode=True)
+    out = generate(model, params, prompt_tokens, max_new_tokens=64,
+                   temperature=0.8, top_k=40, rng=jax.random.PRNGKey(0))
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    gather_from_tensor_model_parallel_region,
+)
+
+
+def sample_logits(logits, rng, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """Sample token ids from [batch, vocab] logits.
+
+    ``temperature=0`` is greedy argmax. top-k keeps the k highest logits
+    (clamped to the vocab size); top-p (nucleus) keeps the smallest
+    prefix of the sorted distribution with cumulative probability >= p.
+    Filters compose (k first, then p).
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -min(top_k, logits.shape[-1])]
+        logits = jnp.where(logits < kth[:, None], -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix reaching mass p: a token stays if the
+        # mass *before* it is < p (the top token always stays)
+        keep = (cum - probs) < top_p
+        threshold = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                            axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def _full_vocab(logits):
+    """Gather vocab-parallel logits over tp (no-op when tp is unbound /
+    size 1) so sampling sees the full vocabulary."""
+    return gather_from_tensor_model_parallel_region(logits)
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled(model, plen, max_new_tokens, temperature, top_k, top_p,
+              eos_token_id, pad_token_id):
+    """jitted prefill + scan-decode, cached per model/config (shape
+    specialization is jit's own cache)."""
+
+    @jax.jit
+    def prefill(params, cache, tokens):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tokens,
+            jnp.arange(plen)[None, :], mutable=["cache"])
+        return mut["cache"], _full_vocab(logits[:, -1])
+
+    def step(params, carry, _):
+        cache, logits, t, key, done = carry
+        b = logits.shape[0]
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p)
+        nxt = jnp.where(done, pad_token_id, nxt)
+        if eos_token_id is not None:
+            done = done | (nxt == eos_token_id)
+        pos = jnp.broadcast_to(t[None, None], (b, 1))
+        new_logits, mut = model.apply(
+            {"params": params, "cache": cache}, nxt[:, None], pos,
+            mutable=["cache"])
+        return ((mut["cache"], _full_vocab(new_logits[:, -1]), t + 1, key,
+                 done), nxt)
+
+    @jax.jit
+    def decode_all(params, init):
+        return jax.lax.scan(functools.partial(step, params), init, None,
+                            length=max_new_tokens)
+
+    return prefill, decode_all
+
+
+def init_cache(model, batch_size: int, dtype_token=jnp.int32):
+    """Zeroed KV cache for ``model`` (built with decode=True) without
+    materializing any parameters (shape-only trace)."""
+    dummy = jnp.zeros((batch_size, 1), dtype_token)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), dummy))["cache"]
+    return jax.tree_util.tree_map(
+        lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
+
+
+def generate(model, params, prompt_tokens, max_new_tokens: int, *,
+             rng=None, temperature: float = 1.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+    """Prefill + scan-decode. Returns [batch, prompt + max_new_tokens]
+    (generated positions after an eos are ``pad_token_id``).
+
+    ``model`` must be constructed with ``decode=True``; the prompt plus
+    generated tokens must fit ``max_position_embeddings``. Greedy when
+    ``rng`` is None or ``temperature == 0``. Prompts must be unpadded
+    (decode mode rejects attention masks — left-trim or batch by
+    length). This host-level loop drives a single-device (tp=1) model;
+    for tensor-parallel decoding build your own step inside shard_map
+    from ``model.apply`` + ``sample_logits`` (the compiled step already
+    gathers vocab-parallel logits over tp when the axis is bound).
+    """
+    if not getattr(model, "decode", False):
+        raise ValueError("generate() needs a model built with decode=True")
+    from apex_tpu.transformer.parallel_state import (
+        get_tensor_model_parallel_world_size,
+    )
+
+    if get_tensor_model_parallel_world_size() > 1:
+        raise NotImplementedError(
+            "generate() drives a tp=1 model; for tensor parallelism run "
+            "the decode step inside shard_map (see docstring)")
+    cfg = model.config
+    b, plen = prompt_tokens.shape
+    if plen + max_new_tokens > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings ({cfg.max_position_embeddings})")
+    if rng is None:
+        temperature = 0.0
+        rng = jax.random.PRNGKey(0)
+
+    prefill, decode_all = _compiled(
+        model, plen, max_new_tokens, float(temperature), top_k, top_p,
+        eos_token_id, pad_token_id)
+    cache = init_cache(model, b, prompt_tokens.dtype)
+    cache, last_logits = prefill(params, cache, prompt_tokens)
+    init = (cache, last_logits, jnp.asarray(plen, jnp.int32), rng,
+            jnp.zeros((b,), bool))
+    _, out = decode_all(params, init)  # [max_new, b]
+    return jnp.concatenate([prompt_tokens, out.T], axis=1)

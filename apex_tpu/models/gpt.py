@@ -36,6 +36,11 @@ class GPTModel(nn.Module):
     num_layers: Optional[int] = None
     pre_process: bool = True   # embed on entry (first pipeline stage)
     post_process: bool = True  # logits+loss on exit (last pipeline stage)
+    # KV-cache incremental decoding (apply with mutable=["cache"]). With
+    # learned positions, pass explicit position_ids on decode steps (the
+    # embed's arange default only suits the prefill chunk); rope offsets
+    # come from the cache index automatically.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, position_ids=None, attention_mask=None,
@@ -68,6 +73,7 @@ class GPTModel(nn.Module):
                           if (cfg.position_embedding_type == "rope"
                               and position_ids is not None) else None)
         h = ParallelTransformer(cfg, num_layers=self.num_layers,
+                                decode=self.decode,
                                 name="transformer")(h, attention_mask,
                                                     rope_positions)
 

@@ -148,9 +148,18 @@ def _make_norm(cfg, name):
 
 class ParallelAttention(nn.Module):
     """Self-attention with column-parallel QKV + row-parallel projection
-    (reference standalone_transformer_lm.py ParallelAttention)."""
+    (reference standalone_transformer_lm.py ParallelAttention).
+
+    ``decode=True`` enables KV-cache incremental decoding: 'cache'
+    variables hold rotated K/V (group heads, pre-GQA-broadcast) for
+    ``max_position_embeddings`` positions; each call appends its ``s``
+    tokens at ``cache_index`` and attends over the filled prefix. Apply
+    with ``mutable=["cache"]``; works for the prefill chunk (s = prompt
+    length) and single-token steps alike.
+    """
 
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
@@ -160,6 +169,9 @@ class ParallelAttention(nn.Module):
         kv = cfg.kv_channels
         s, b, h = hidden_states.shape[-3:]
         x = hidden_states.astype(cfg.compute_dtype)
+        if self.decode and cfg.sequence_parallel:
+            raise ValueError("decode mode does not compose with "
+                             "sequence parallelism")
 
         if cfg.query_groups == cfg.num_attention_heads:
             qkv = ColumnParallelLinear(
@@ -192,6 +204,14 @@ class ParallelAttention(nn.Module):
             kvp = proj[..., np_local * kv:].reshape(seq_full, b, g_local,
                                                     2 * kv)
             k, v = jnp.split(kvp, 2, axis=-1)
+
+        if self.decode:
+            if attention_mask is not None:
+                raise ValueError(
+                    "decode mode does not support attention_mask: batch "
+                    "unpadded prompts (left-trim or group by length)")
+            return self._decode_attention(cfg, q, k, v, position_ids,
+                                          np_local, kv, b)
 
         if cfg.position_embedding_type == "rope":
             q = apply_rotary_emb(q, cfg.rotary_base, position_ids)
@@ -242,13 +262,70 @@ class ParallelAttention(nn.Module):
                              preferred_element_type=jnp.float32)
             ctx = ctx.transpose(2, 0, 1, 3)  # [s, b, n, d]
 
-        ctx = ctx.reshape(ctx.shape[0], b, np_local * kv).astype(cfg.compute_dtype)
-        out = RowParallelLinear(
+        ctx = ctx.reshape(ctx.shape[0], b, np_local * kv)
+        return self._output_proj(cfg, ctx)
+
+    def _output_proj(self, cfg, ctx):
+        """Shared row-parallel output projection (both attention paths —
+        keep them on ONE 'dense' module so numerics can't diverge)."""
+        return RowParallelLinear(
             input_size=cfg.hidden_size, output_size=cfg.hidden_size,
             input_is_parallel=True, bias=True, params_dtype=cfg.params_dtype,
-            sequence_parallel_enabled=cfg.sequence_parallel,
-            name="dense")(ctx)
-        return out
+            sequence_parallel_enabled=(cfg.sequence_parallel
+                                       and not self.decode),
+            name="dense")(ctx.astype(cfg.compute_dtype))
+
+    def _decode_attention(self, cfg, q, k, v, position_ids, np_local, kv, b):
+        """KV-cache path: rotate at absolute positions, append to the
+        cache, attend over the filled prefix. The cache keeps K/V at
+        group granularity and the attention einsums are grouped
+        ([b, g, rep, s, t]) — no head-broadcast copy of the full cache
+        per step (the GQA memory saving survives decode)."""
+        s = q.shape[0]
+        n_kv = k.shape[2]
+        rep = np_local // n_kv
+        max_len = cfg.max_position_embeddings
+        initialized = self.has_variable("cache", "cached_key")
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (max_len, b, n_kv, kv), cfg.compute_dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (max_len, b, n_kv, kv), cfg.compute_dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        idx = ci.value
+        if cfg.position_embedding_type == "rope":
+            pos = (position_ids if position_ids is not None
+                   else idx + jnp.arange(s))
+            q = apply_rotary_emb(q, cfg.rotary_base, pos)
+            k = apply_rotary_emb(k, cfg.rotary_base, pos)
+        if not initialized:
+            # init pass: create the variables, plain causal attention over
+            # the given tokens (shapes/params identical to the real path)
+            k_full, v_full, kv_len, offset = k, v, s, jnp.zeros((), jnp.int32)
+        else:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.compute_dtype), (idx, 0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.compute_dtype), (idx, 0, 0, 0))
+            ci.value = idx + s
+            k_full, v_full, kv_len, offset = ck.value, cv.value, max_len, idx
+        qg = q.reshape(s, b, n_kv, rep, kv).astype(cfg.compute_dtype)
+        kt = k_full.astype(cfg.compute_dtype)
+        vt = v_full.astype(cfg.compute_dtype)
+        scores = jnp.einsum("sbgrd,tbgd->bgrst", qg, kt,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(kv).astype(jnp.float32)
+        # causal over absolute positions: query i (at offset+i) sees keys
+        # j <= offset+i; unfilled cache tail is masked the same way
+        jpos = jnp.arange(kv_len)[None, :]
+        ipos = offset + jnp.arange(s)[:, None]
+        scores = jnp.where(jpos > ipos, -1e30, scores)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bgrst,tbgd->sbgrd",
+                         probs.astype(cfg.compute_dtype), vt,
+                         preferred_element_type=jnp.float32)
+        ctx = ctx.reshape(s, b, np_local * kv)
+        return self._output_proj(cfg, ctx)
 
 
 def _flash_available(seq, head_dim):
@@ -307,6 +384,7 @@ class ParallelTransformerLayer(nn.Module):
 
     config: TransformerConfig
     layer_number: int = 0
+    decode: bool = False
 
     def _is_moe_layer(self) -> bool:
         cfg = self.config
@@ -317,7 +395,8 @@ class ParallelTransformerLayer(nn.Module):
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
         ln1 = _make_norm(cfg, "input_layernorm")
-        attn_out = ParallelAttention(cfg, name="self_attention")(
+        attn_out = ParallelAttention(cfg, decode=self.decode,
+                                     name="self_attention")(
             ln1(hidden_states.astype(jnp.float32)).astype(cfg.compute_dtype),
             attention_mask, position_ids)
         hidden_states = hidden_states + attn_out.astype(hidden_states.dtype)
@@ -348,10 +427,12 @@ class _ScanBlock(nn.Module):
     [num_layers] axis under 'layers/layer'."""
 
     config: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask, position_ids):
         h = ParallelTransformerLayer(self.config, layer_number=0,
+                                     decode=self.decode,
                                      name="layer")(hidden_states,
                                                    attention_mask,
                                                    position_ids)
@@ -366,6 +447,7 @@ class ParallelTransformer(nn.Module):
     config: TransformerConfig
     num_layers: Optional[int] = None
     activation_checkpointing: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
@@ -377,26 +459,27 @@ class ParallelTransformer(nn.Module):
                     "scan_layers needs a uniform stack: moe_layer_freq "
                     "must be 1 (every layer MoE) or num_moe_experts None")
             block = _ScanBlock
-            if self.activation_checkpointing:
+            if self.activation_checkpointing and not self.decode:
                 block = nn.remat(block, static_argnums=(),
                                  prevent_cse=False)
             scanned = nn.scan(
                 block,
-                variable_axes={"params": 0, "moe_losses": 0},
+                variable_axes={"params": 0, "moe_losses": 0, "cache": 0},
                 # split 'jitter' too: un-listed rng streams are DROPPED by
                 # nn.scan, which would silently disable router jitter
                 split_rngs={"params": True, "jitter": True},
                 in_axes=(nn.broadcast, nn.broadcast), length=n,
                 metadata_params={nn.PARTITION_NAME: None})
-            h, _ = scanned(cfg, name="layers")(hidden_states, attention_mask,
-                                               position_ids)
+            h, _ = scanned(cfg, decode=self.decode, name="layers")(
+                hidden_states, attention_mask, position_ids)
             return h
         layer = ParallelTransformerLayer
-        if self.activation_checkpointing:
+        if self.activation_checkpointing and not self.decode:
             layer = nn.checkpoint(ParallelTransformerLayer,
                                   static_argnums=())
         for i in range(n):
-            hidden_states = layer(cfg, layer_number=i, name=f"layer_{i}")(
+            hidden_states = layer(cfg, layer_number=i, decode=self.decode,
+                                  name=f"layer_{i}")(
                 hidden_states, attention_mask, position_ids)
         return hidden_states
 
