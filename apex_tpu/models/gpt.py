@@ -81,21 +81,27 @@ class GPTModel(nn.Module):
             return h
 
         h = _make_norm(cfg, "final_layernorm")(h.astype(jnp.float32))
-        # Output logits through a vocab-parallel projection. Weight tying
-        # with the input embedding (reference parallel_lm_logits) requires
-        # the embedding table; within one jitted SPMD program we re-declare
-        # the tied table via module sharing when pre and post live on the
-        # same stage, else an untied head is used (pipeline stages differ).
-        vocab_per_rank = divide(cfg.vocab_size, tp)
-        head = self.param(
-            "lm_head",
-            lambda key, shape, dtype: nn.initializers.normal(0.02)(
-                _fold_tp(key), shape, dtype),
-            (cfg.hidden_size, vocab_per_rank), cfg.params_dtype)
         h = copy_to_tensor_model_parallel_region(h.astype(cfg.compute_dtype))
-        logits = jnp.einsum("sbh,hv->sbv", h,
-                            head.astype(cfg.compute_dtype),
-                            preferred_element_type=jnp.float32)
+        if cfg.tie_word_embeddings:
+            # Tied head (reference parallel_lm_logits): logits through the
+            # embedding table. Requires embed and head on the same program
+            # (pre_process and post_process both true — pipeline stages
+            # must use the untied head instead).
+            if not self.pre_process:
+                raise ValueError(
+                    "tie_word_embeddings needs the embedding on this "
+                    "stage; pipeline-split models must untie")
+            logits = emb.attend(h)  # [s, b, vocab/tp]
+        else:
+            vocab_per_rank = divide(cfg.vocab_size, tp)
+            head = self.param(
+                "lm_head",
+                lambda key, shape, dtype: nn.initializers.normal(0.02)(
+                    _fold_tp(key), shape, dtype),
+                (cfg.hidden_size, vocab_per_rank), cfg.params_dtype)
+            logits = jnp.einsum("sbh,hv->sbv", h,
+                                head.astype(cfg.compute_dtype),
+                                preferred_element_type=jnp.float32)
         return logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
 
 

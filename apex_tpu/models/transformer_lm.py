@@ -73,6 +73,12 @@ class TransformerConfig:
     rotary_base: float = 10000.0
     activation: str = "gelu"  # or "swiglu"
     normalization: str = "layernorm"  # or "rmsnorm"
+    # Tie the LM head to the word-embedding table (reference
+    # parallel_lm_logits ties by default). Off here because the SPMD
+    # pipeline harness needs untied heads (first/last stages run the same
+    # program but hold different params); single-program models (dp/tp/ep)
+    # can and should tie.
+    tie_word_embeddings: bool = False
 
     def __post_init__(self):
         if self.position_embedding_type not in ("learned", "rope"):

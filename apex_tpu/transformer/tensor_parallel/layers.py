@@ -214,7 +214,10 @@ class RowParallelLinear(nn.Module):
 
 class VocabParallelEmbedding(nn.Module):
     """Embedding with vocab-dim partitioning (reference layers.py:174-276):
-    masked local lookup followed by an allreduce over the tp axis."""
+    masked local lookup followed by an allreduce over the tp axis.
+    ``attend`` projects hidden states back onto the vocab shard — the
+    tied LM head (reference parallel_lm_logits uses the embedding table).
+    """
 
     num_embeddings: int
     embedding_dim: int
@@ -223,13 +226,23 @@ class VocabParallelEmbedding(nn.Module):
     use_cpu_initialization: bool = False
     axis_name: str = TENSOR_PARALLEL_AXIS
 
-    @nn.compact
+    def setup(self):
+        world = get_tensor_model_parallel_world_size()
+        per_partition = divide(self.num_embeddings, world)
+        self.weight = self.param(
+            "weight", _partitioned_init(self.init_method),
+            (per_partition, self.embedding_dim), self.params_dtype)
+
+    def attend(self, h):
+        """[..., hidden] @ table.T -> vocab-parallel logits
+        [..., vocab/tp] (fp32 accumulation)."""
+        return jnp.einsum("...h,vh->...v", h, self.weight.astype(h.dtype),
+                          preferred_element_type=jnp.float32)
+
     def __call__(self, input_):
         world = get_tensor_model_parallel_world_size()
         per_partition = divide(self.num_embeddings, world)
-        weight = self.param(
-            "weight", _partitioned_init(self.init_method),
-            (per_partition, self.embedding_dim), self.params_dtype)
+        weight = self.weight
         if world > 1:
             try:
                 rank = lax.axis_index(self.axis_name)

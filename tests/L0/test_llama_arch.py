@@ -156,6 +156,64 @@ class TestSwiGLU:
         assert "bias" not in params["dense_h_to_4h"]  # llama-style no bias
 
 
+class TestTiedEmbeddings:
+    def test_tied_head_uses_embedding_table(self):
+        from apex_tpu.models import GPTModel
+
+        parallel_state.destroy_model_parallel()
+        cfg = _cfg(tie_word_embeddings=True)
+        model = GPTModel(cfg)
+        tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 8)))
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        assert "lm_head" not in params  # no separate head
+        table = np.asarray(params["word_embeddings"]["weight"])  # [v, h]
+
+        # logits == final hidden @ table.T: verify by zeroing... simpler:
+        # gradient of loss w.r.t. the table is nonzero from BOTH uses
+        # (lookup + head), and logits dimensionality matches the vocab.
+        logits = model.apply({"params": params}, tokens)
+        assert logits.shape == (2, 8, 64)
+
+        from apex_tpu.models.gpt import gpt_loss_fn
+
+        g = jax.grad(lambda p: gpt_loss_fn(
+            model.apply({"params": p}, tokens),
+            jnp.roll(tokens, -1, -1)))(params)
+        gt = np.asarray(g["word_embeddings"]["weight"])
+        # head-path grads touch every vocab row (softmax pulls all logits
+        # down), unlike lookup-only grads which are nonzero only for used
+        # token ids — so a fully-dense table grad proves the tied head.
+        assert (np.abs(gt).sum(axis=1) > 0).all()
+        assert table.shape == (64, 32)
+
+    def test_tied_trains_and_generates(self):
+        from apex_tpu.models import GPTModel
+        from apex_tpu.models.generation import generate
+
+        parallel_state.destroy_model_parallel()
+        cfg = _cfg(tie_word_embeddings=True,
+                   position_embedding_type="rope")
+        model = GPTModel(cfg)
+        prompt = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 5)))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        out = generate(GPTModel(cfg, decode=True), params, prompt,
+                       max_new_tokens=4)
+        assert out.shape == (2, 9)
+
+    def test_tied_requires_embedding_stage(self):
+        import pytest
+
+        from apex_tpu.models import GPTModel
+
+        parallel_state.destroy_model_parallel()
+        cfg = _cfg(tie_word_embeddings=True)
+        model = GPTModel(cfg, pre_process=False)
+        h = jnp.ones((8, 2, 32))
+        with pytest.raises(ValueError, match="untie"):
+            model.init(jax.random.PRNGKey(0), jnp.ones((2, 8), jnp.int32),
+                       hidden_input=h)
+
+
 def test_llama_style_gpt_trains():
     """RMSNorm + RoPE + SwiGLU + GQA end to end: loss decreases."""
     from apex_tpu.models import GPTModel
