@@ -21,12 +21,39 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 import functools
 import json
+import os
 import sys
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _arm_watchdog():
+    """Fail loudly instead of hanging forever when the tunneled TPU
+    session is wedged (observed: killing a run mid-compile leaves every
+    later device op blocking indefinitely — PERF.md pitfalls). Prints a
+    parseable JSON error line and exits. Override via
+    APEX_TPU_BENCH_TIMEOUT_S (0 disables)."""
+    budget = float(os.environ.get("APEX_TPU_BENCH_TIMEOUT_S", "2700"))
+    if budget <= 0:
+        return
+
+    def fire():
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": f"bench exceeded {budget:.0f}s (TPU tunnel wedged?)",
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(budget, fire)
+    t.daemon = True
+    t.start()
 
 
 def _time_steps(train_step, state, steps, loss_index):
@@ -232,6 +259,7 @@ def bench_moe(batch, steps):
 
 
 def main():
+    _arm_watchdog()
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import FusedAdam
