@@ -1,0 +1,126 @@
+"""Convert a HuggingFace GPT-2 checkpoint into apex_tpu GPTModel params.
+
+Migration tooling for users switching frameworks, and — tested against a
+randomly-initialized ``transformers`` GPT-2 (tests/L0/test_hf_convert.py)
+— an external numerics oracle for the whole transformer stack: identical
+weights must produce identical logits.
+
+Usage (offline, state-dict based):
+
+    from transformers import GPT2LMHeadModel
+    from tools.convert_hf_gpt2 import convert_gpt2
+
+    hf = GPT2LMHeadModel.from_pretrained(path)
+    cfg, params = convert_gpt2(hf.state_dict(), hf.config)
+    logits = GPTModel(cfg).apply({"params": params}, tokens)
+
+Layout notes:
+- HF ``c_attn`` packs columns as [q_all | k_all | v_all]; apex_tpu's fused
+  QKV packs per head as [q_n | k_n | v_n] blocks — columns are permuted.
+- HF ``Conv1D`` weights are already [in, out], matching our Linear layout.
+- GPT-2 ties the LM head to wte -> ``tie_word_embeddings=True``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def _qkv_permute(w, num_heads):
+    """[.., 3h] columns from [q|k|v] blocks to per-head [q_n|k_n|v_n]."""
+    h3 = w.shape[-1]
+    h = h3 // 3
+    kv = h // num_heads
+    q, k, v = np.split(w, 3, axis=-1)
+    parts = [p.reshape(*p.shape[:-1], num_heads, kv) for p in (q, k, v)]
+    out = np.stack(parts, axis=-2)  # [.., np, 3, kv]
+    return out.reshape(*w.shape[:-1], h3)
+
+
+def convert_gpt2(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a GPT2LMHeadModel
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    cfg = TransformerConfig(
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_attention_heads=hf_config.n_head,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.n_positions,
+        layernorm_epsilon=hf_config.layer_norm_epsilon,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        tie_word_embeddings=True,
+    )
+
+    def ln(prefix):
+        return {"weight": jnp.asarray(_t(sd[f"{prefix}.weight"])),
+                "bias": jnp.asarray(_t(sd[f"{prefix}.bias"]))}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"h.{i}"
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.ln_1"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(_qkv_permute(
+                        _t(sd[f"{p}.attn.c_attn.weight"]), cfg.num_attention_heads)),
+                    "bias": jnp.asarray(_qkv_permute(
+                        _t(sd[f"{p}.attn.c_attn.bias"]), cfg.num_attention_heads)),
+                },
+                "dense": {
+                    "weight": jnp.asarray(_t(sd[f"{p}.attn.c_proj.weight"])),
+                    "bias": jnp.asarray(_t(sd[f"{p}.attn.c_proj.bias"])),
+                },
+            },
+            "post_attention_layernorm": ln(f"{p}.ln_2"),
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(_t(sd[f"{p}.mlp.c_fc.weight"])),
+                    "bias": jnp.asarray(_t(sd[f"{p}.mlp.c_fc.bias"])),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(_t(sd[f"{p}.mlp.c_proj.weight"])),
+                    "bias": jnp.asarray(_t(sd[f"{p}.mlp.c_proj.bias"])),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {"weight": jnp.asarray(_t(sd["wte.weight"]))},
+        "position_embeddings": jnp.asarray(_t(sd["wpe.weight"])),
+        "transformer": layers,
+        "final_layernorm": ln("ln_f"),
+    }
+    return cfg, params
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path", help="HF model dir / hub id")
+    ap.add_argument("out_dir", help="apex_tpu checkpoint directory")
+    args = ap.parse_args()
+    from transformers import GPT2LMHeadModel
+
+    from apex_tpu import checkpoint
+
+    hf = GPT2LMHeadModel.from_pretrained(args.model_path)
+    cfg, params = convert_gpt2(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
