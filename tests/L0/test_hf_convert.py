@@ -47,6 +47,56 @@ def test_logits_match_hf_gpt2():
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
 
 
+def _tiny_llama(seed=0, kv_heads=2):
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=kv_heads, max_position_embeddings=32,
+        attention_dropout=0.0)
+    torch.manual_seed(seed)
+    return transformers.LlamaForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_logits_match_hf_llama(kv_heads):
+    """Oracle for the modern stack: RMSNorm + RoPE + SwiGLU + (GQA when
+    kv_heads < heads) against HF's independent implementation."""
+    from tools.convert_hf_llama import convert_llama
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_llama(kv_heads=kv_heads)
+    cfg, params = convert_llama(hf.state_dict(), hf_cfg)
+    assert cfg.normalization == "rmsnorm"
+
+    tokens = np.random.RandomState(0).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_greedy_generation_matches_hf():
+    from tools.convert_hf_llama import convert_llama
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_llama(seed=2)
+    cfg, params = convert_llama(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(2).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
 def test_greedy_generation_matches_hf():
     from tools.convert_hf_gpt2 import convert_gpt2
 

@@ -1,0 +1,141 @@
+"""Convert a HuggingFace Llama checkpoint into apex_tpu GPTModel params.
+
+Covers the modern-architecture stack: RMSNorm, RoPE (HF rotate-half
+convention — matches apex_tpu's), grouped-query attention (HF separate
+q/k/v projections -> our fused [q heads | k_g|v_g groups] column layout),
+SwiGLU (gate/up -> our fused [gate | up]), untied LM head. torch Linear
+weights are [out, in] and are transposed.
+
+    from transformers import LlamaForCausalLM
+    from tools.convert_hf_llama import convert_llama
+
+    hf = LlamaForCausalLM.from_pretrained(path)
+    cfg, params = convert_llama(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def _fused_qkv(wq, wk, wv, num_heads, num_groups, head_dim):
+    """[h, n*d], [h, g*d], [h, g*d] -> fused columns in apex_tpu's layout.
+
+    MHA (g == n): per-head [q_i | k_i | v_i] blocks (the model reshapes
+    to [.., heads, 3*d] and splits). GQA (g < n): all query heads first,
+    then per-group [k_g | v_g]."""
+    def head(w, i):
+        return w[..., i * head_dim:(i + 1) * head_dim]
+
+    if num_groups == num_heads:
+        blocks = []
+        for i in range(num_heads):
+            blocks += [head(wq, i), head(wk, i), head(wv, i)]
+        return np.concatenate(blocks, axis=-1)
+    kv = []
+    for g in range(num_groups):
+        kv += [head(wk, g), head(wv, g)]
+    return np.concatenate([wq] + kv, axis=-1)
+
+
+def convert_llama(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a LlamaForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = hf_config.hidden_size // n
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        wq = lin_t(f"{p}.self_attn.q_proj.weight")
+        wk = lin_t(f"{p}.self_attn.k_proj.weight")
+        wv = lin_t(f"{p}.self_attn.v_proj.weight")
+        fused = _fused_qkv(wq, wk, wv, n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {
+                "weight": jnp.asarray(_t(sd[f"{p}.input_layernorm.weight"]))},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.post_attention_layernorm.weight"]))},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(np.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {"weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {"weight": jnp.asarray(_t(sd["norm.weight"]))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import LlamaForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = LlamaForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_llama(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
