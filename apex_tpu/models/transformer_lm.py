@@ -47,6 +47,11 @@ class TransformerConfig:
     sequence_parallel: bool = False
     use_flash_attention: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
+    # Context parallelism: run the WHOLE model on sequence shards over
+    # the 'cp' mesh axis (ring attention rotates K/V around the ring;
+    # everything else is per-token). Callers shard tokens/labels over cp
+    # and pass global position_ids; see transformer/context_parallel.
+    context_parallel: bool = False
     # Compile the layer stack as ONE lax.scan over stacked params instead
     # of unrolling n layers (compile time O(1) in depth — the unrolled
     # 24-layer GPT costs minutes of XLA time per bench variant). Params
@@ -216,8 +221,19 @@ class ParallelAttention(nn.Module):
                 raise ValueError(
                     "decode mode does not support attention_mask: batch "
                     "unpadded prompts (left-trim or group by length)")
+            if cfg.context_parallel:
+                raise ValueError("decode mode does not compose with "
+                                 "context parallelism")
             return self._decode_attention(cfg, q, k, v, position_ids,
                                           np_local, kv, b)
+
+        if cfg.context_parallel:
+            if attention_mask is not None:
+                raise ValueError("context parallelism supports only the "
+                                 "built-in causal/full patterns, not an "
+                                 "explicit attention_mask")
+            return self._ring_attention(cfg, q, k, v, position_ids,
+                                        np_local, kv, b)
 
         if cfg.position_embedding_type == "rope":
             q = apply_rotary_emb(q, cfg.rotary_base, position_ids)
@@ -280,6 +296,39 @@ class ParallelAttention(nn.Module):
             sequence_parallel_enabled=(cfg.sequence_parallel
                                        and not self.decode),
             name="dense")(ctx.astype(cfg.compute_dtype))
+
+    def _ring_attention(self, cfg, q, k, v, position_ids, np_local, kv, b):
+        """Context-parallel core: hidden states are sequence shards over
+        the 'cp' axis; K/V rotate around the ring (ppermute), activations
+        never materialize the full sequence. RoPE uses global positions
+        (cp_rank * s_local + i) so shards agree with the unsharded model."""
+        from jax import lax
+
+        from apex_tpu.transformer.context_parallel import ring_self_attention
+        from apex_tpu.transformer.parallel_state import CONTEXT_PARALLEL_AXIS
+
+        s = q.shape[0]
+        if cfg.position_embedding_type == "rope":
+            if position_ids is None:
+                try:
+                    rank = lax.axis_index(CONTEXT_PARALLEL_AXIS)
+                except Exception:
+                    rank = 0
+                position_ids = rank * s + jnp.arange(s)
+            q = apply_rotary_emb(q, cfg.rotary_base, position_ids)
+            k = apply_rotary_emb(k, cfg.rotary_base, position_ids)
+        if k.shape[2] != np_local:
+            rep = np_local // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        # [s, b, n, d] -> [b, s, n, d]
+        ctx = ring_self_attention(
+            q.transpose(1, 0, 2, 3).astype(cfg.compute_dtype),
+            k.transpose(1, 0, 2, 3).astype(cfg.compute_dtype),
+            v.transpose(1, 0, 2, 3).astype(cfg.compute_dtype),
+            causal=(cfg.attn_mask_type == AttnMaskType.causal))
+        ctx = ctx.transpose(1, 0, 2, 3).reshape(s, b, np_local * kv)
+        return self._output_proj(cfg, ctx)
 
     def _decode_attention(self, cfg, q, k, v, position_ids, np_local, kv, b):
         """KV-cache path: rotate at absolute positions, append to the
