@@ -105,6 +105,107 @@ def init_cache(model, batch_size: int, dtype_token=jnp.int32):
         lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
 
 
+@functools.lru_cache(maxsize=16)
+def _compiled_beam(model, plen, max_new_tokens, num_beams, length_penalty,
+                   eos_token_id, pad_token_id):
+    k = num_beams
+
+    @jax.jit
+    def run(params, cache, prompt_tokens):
+        b = prompt_tokens.shape[0]
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, prompt_tokens,
+            jnp.arange(plen)[None, :], mutable=["cache"])
+        logp0 = jax.nn.log_softmax(
+            _full_vocab(logits[:, -1]).astype(jnp.float32))  # [b, v]
+        vocab = logp0.shape[-1]
+
+        # Tile the cache per beam: cached K/V are [s, b, g, d] with batch
+        # at axis 1; cache_index is a scalar.
+        cache = jax.tree_util.tree_map(
+            lambda x: x if x.ndim == 0 else jnp.repeat(x, k, axis=1),
+            mut["cache"])
+
+        scores, tok0 = jax.lax.top_k(logp0, k)            # [b, k]
+        done = (jnp.zeros((b, k), bool) if eos_token_id is None
+                else tok0 == eos_token_id)
+        lengths = jnp.ones((b, k), jnp.int32)
+        seqs = jnp.zeros((b * k, max_new_tokens), jnp.int32)
+        seqs = seqs.at[:, 0].set(tok0.reshape(b * k))
+
+        def step(carry, i):
+            cache, scores, done, lengths, seqs = carry
+            prev = seqs[jnp.arange(b * k), i - 1]
+            pos = jnp.full((b * k, 1), plen + i - 1, jnp.int32)
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, prev[:, None], pos,
+                mutable=["cache"])
+            cache = mut["cache"]
+            logp = jax.nn.log_softmax(
+                _full_vocab(logits[:, 0]).astype(jnp.float32)
+            ).reshape(b, k, vocab)
+            # frozen beams extend only with pad, at zero cost
+            frozen = jnp.full((vocab,), -jnp.inf).at[pad_token_id].set(0.0)
+            logp = jnp.where(done[:, :, None], frozen[None, None, :], logp)
+            total = scores[:, :, None] + logp             # [b, k, v]
+            scores, flat = jax.lax.top_k(total.reshape(b, k * vocab), k)
+            beam_idx = flat // vocab                      # [b, k]
+            tok = flat % vocab
+            gather = (jnp.arange(b)[:, None] * k + beam_idx).reshape(b * k)
+            cache = jax.tree_util.tree_map(
+                lambda x: x if x.ndim == 0 else jnp.take(x, gather, axis=1),
+                cache)
+            done = jnp.take_along_axis(done, beam_idx, axis=1)
+            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+            lengths = lengths + (~done).astype(jnp.int32)
+            seqs = jnp.take(seqs, gather, axis=0)
+            seqs = seqs.at[:, i].set(tok.reshape(b * k))
+            if eos_token_id is not None:
+                done = done | (tok == eos_token_id)
+            return (cache, scores, done, lengths, seqs), None
+
+        if max_new_tokens > 1:
+            (cache, scores, done, lengths, seqs), _ = jax.lax.scan(
+                step, (cache, scores, done, lengths, seqs),
+                jnp.arange(1, max_new_tokens))
+        adjusted = scores / (lengths.astype(jnp.float32) ** length_penalty)
+        best = jnp.argmax(adjusted, axis=-1)              # [b]
+        rows = jnp.arange(b) * k + best
+        return jnp.take(seqs, rows, axis=0), jnp.take_along_axis(
+            adjusted, best[:, None], axis=1)[:, 0]
+
+    return run
+
+
+def beam_search(model, params, prompt_tokens, max_new_tokens: int,
+                num_beams: int = 4, *, length_penalty: float = 1.0,
+                eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+    """Beam-search decoding with a KV cache per beam.
+
+    Returns ([batch, prompt + max_new_tokens] tokens, [batch] scores):
+    the highest-scoring beam per row, score = sum of token log-probs /
+    length**length_penalty (length counts tokens up to and including
+    eos). Beams share the prompt prefill; the cache is tiled to
+    batch*num_beams and reordered along its batch axis as beams are
+    reselected each step; finished beams are frozen (extend with pad at
+    zero cost). tp=1, like :func:`generate`.
+    """
+    if not getattr(model, "decode", False):
+        raise ValueError("beam_search() needs a model built with "
+                         "decode=True")
+    cfg = model.config
+    b, plen = prompt_tokens.shape
+    if plen + max_new_tokens > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings ({cfg.max_position_embeddings})")
+    run = _compiled_beam(model, plen, max_new_tokens, num_beams,
+                         float(length_penalty), eos_token_id, pad_token_id)
+    cache = init_cache(model, b, prompt_tokens.dtype)
+    best_seqs, best_scores = run(params, cache, prompt_tokens)
+    return jnp.concatenate([prompt_tokens, best_seqs], axis=1), best_scores
+
+
 def generate(model, params, prompt_tokens, max_new_tokens: int, *,
              rng=None, temperature: float = 1.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,

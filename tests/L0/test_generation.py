@@ -138,6 +138,60 @@ class TestGenerate:
             generate(GPTModel(cfg), params, prompt, max_new_tokens=2)
 
 
+class TestBeamSearch:
+    def _setup(self):
+        parallel_state.destroy_model_parallel()
+        cfg = _cfg()
+        model = GPTModel(cfg, decode=True)
+        prompt = jnp.asarray(np.random.RandomState(5).randint(0, 64, (2, 4)))
+        params = GPTModel(cfg).init(jax.random.PRNGKey(2), prompt)["params"]
+        return cfg, model, params, prompt
+
+    def _seq_logprob(self, cfg, params, seq, plen):
+        """Sum of log-probs of seq[plen:] under the full model."""
+        full = GPTModel(cfg)
+        logits = full.apply({"params": params}, seq[:, :-1])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tgt = seq[:, 1:]
+        tok_lp = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+        return np.asarray(tok_lp[:, plen - 1:]).sum(axis=-1)
+
+    def test_beam1_equals_greedy(self):
+        from apex_tpu.models.generation import beam_search
+
+        cfg, model, params, prompt = self._setup()
+        greedy = generate(model, params, prompt, max_new_tokens=5)
+        beams, _ = beam_search(model, params, prompt, max_new_tokens=5,
+                               num_beams=1)
+        np.testing.assert_array_equal(np.asarray(beams), np.asarray(greedy))
+
+    def test_beam_finds_no_worse_sequences(self):
+        from apex_tpu.models.generation import beam_search
+
+        cfg, model, params, prompt = self._setup()
+        greedy = generate(model, params, prompt, max_new_tokens=5)
+        beams, scores = beam_search(model, params, prompt, max_new_tokens=5,
+                                    num_beams=4)
+        g_lp = self._seq_logprob(cfg, params, greedy, 4)
+        b_lp = self._seq_logprob(cfg, params, beams, 4)
+        assert (b_lp >= g_lp - 1e-4).all(), (b_lp, g_lp)
+        # returned scores are the length-normalized sequence log-probs
+        np.testing.assert_allclose(np.asarray(scores), b_lp / 5.0,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_beam_eos_freezes(self):
+        from apex_tpu.models.generation import beam_search
+
+        _, model, params, prompt = self._setup()
+        beams, _ = beam_search(model, params, prompt, max_new_tokens=6,
+                               num_beams=3, eos_token_id=1, pad_token_id=63)
+        gen = np.asarray(beams)[:, 4:]
+        for row in gen:
+            hit = np.where(row == 1)[0]
+            if hit.size:
+                assert (row[hit[0] + 1:] == 63).all()
+
+
 class TestSampleLogits:
     def test_temperature_zero_is_greedy(self):
         logits = jnp.asarray(np.random.RandomState(0).randn(3, 16),
