@@ -48,10 +48,14 @@ class TransformerConfig:
     use_flash_attention: bool = True
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     # Context parallelism: run the WHOLE model on sequence shards over
-    # the 'cp' mesh axis (ring attention rotates K/V around the ring;
-    # everything else is per-token). Callers shard tokens/labels over cp
-    # and pass global position_ids; see transformer/context_parallel.
+    # the 'cp' mesh axis (attention communicates; everything else is
+    # per-token). Callers shard tokens/labels over cp and pass global
+    # position_ids; see transformer/context_parallel. Algorithms:
+    # "ring" (K/V ppermute around the ring — any head count) or
+    # "ulysses" (two all_to_alls, full attention on heads/cp heads —
+    # needs heads divisible by cp; cheaper when heads >= cp).
     context_parallel: bool = False
+    context_parallel_algo: str = "ring"
     # Compile the layer stack as ONE lax.scan over stacked params instead
     # of unrolling n layers (compile time O(1) in depth — the unrolled
     # 24-layer GPT costs minutes of XLA time per bench variant). Params
@@ -95,6 +99,9 @@ class TransformerConfig:
             raise ValueError(f"unknown activation {self.activation!r}")
         if self.normalization not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown normalization {self.normalization!r}")
+        if self.context_parallel_algo not in ("ring", "ulysses"):
+            raise ValueError(f"unknown context_parallel_algo "
+                             f"{self.context_parallel_algo!r}")
         if self.num_query_groups is not None:
             if (self.num_query_groups < 1
                     or self.num_attention_heads % self.num_query_groups):
@@ -299,12 +306,18 @@ class ParallelAttention(nn.Module):
 
     def _ring_attention(self, cfg, q, k, v, position_ids, np_local, kv, b):
         """Context-parallel core: hidden states are sequence shards over
-        the 'cp' axis; K/V rotate around the ring (ppermute), activations
-        never materialize the full sequence. RoPE uses global positions
-        (cp_rank * s_local + i) so shards agree with the unsharded model."""
+        the 'cp' axis and activations never materialize the full
+        sequence — K/V rotate around the ring (ppermute) or, with
+        ``context_parallel_algo="ulysses"``, two all_to_alls trade seq
+        sharding for head sharding around a local full attention. RoPE
+        uses global positions (cp_rank * s_local + i) so shards agree
+        with the unsharded model."""
         from jax import lax
 
-        from apex_tpu.transformer.context_parallel import ring_self_attention
+        from apex_tpu.transformer.context_parallel import (
+            ring_self_attention,
+            ulysses_self_attention,
+        )
         from apex_tpu.transformer.parallel_state import CONTEXT_PARALLEL_AXIS
 
         s = q.shape[0]
@@ -321,8 +334,11 @@ class ParallelAttention(nn.Module):
             rep = np_local // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
+        attn = (ulysses_self_attention
+                if cfg.context_parallel_algo == "ulysses"
+                else ring_self_attention)
         # [s, b, n, d] -> [b, s, n, d]
-        ctx = ring_self_attention(
+        ctx = attn(
             q.transpose(1, 0, 2, 3).astype(cfg.compute_dtype),
             k.transpose(1, 0, 2, 3).astype(cfg.compute_dtype),
             v.transpose(1, 0, 2, 3).astype(cfg.compute_dtype),

@@ -23,6 +23,7 @@ from apex_tpu.transformer.context_parallel.ring_attention import (
 )
 from apex_tpu.transformer.context_parallel.ulysses import (
     ulysses_attention,
+    ulysses_self_attention,
     all_to_all_heads_to_seq,
     all_to_all_seq_to_heads,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "ring_attention",
     "ring_self_attention",
     "ulysses_attention",
+    "ulysses_self_attention",
     "all_to_all_heads_to_seq",
     "all_to_all_seq_to_heads",
 ]

@@ -28,13 +28,16 @@ def _cfg(**kw):
     return TransformerConfig(**base)
 
 
-@pytest.mark.parametrize("variant", ["mha", "gqa", "learned_pos"])
+@pytest.mark.parametrize("variant",
+                         ["mha", "gqa", "learned_pos", "ulysses"])
 def test_cp_logits_match_unsharded(variant):
     kw = {}
     if variant == "gqa":
         kw = dict(num_query_groups=2)
     elif variant == "learned_pos":
         kw = dict(position_embedding_type="learned")
+    elif variant == "ulysses":
+        kw = dict(context_parallel_algo="ulysses")
     parallel_state.destroy_model_parallel()
     ref_cfg = _cfg(**kw)
     cp_cfg = _cfg(context_parallel=True, **kw)
