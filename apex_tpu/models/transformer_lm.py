@@ -482,6 +482,7 @@ class ParallelTransformerLayer(nn.Module):
                 capacity_factor=cfg.moe_capacity_factor,
                 jitter_eps=cfg.moe_jitter_eps,
                 router_type=cfg.moe_router_type,
+                activation=cfg.activation,
                 params_dtype=cfg.params_dtype,
                 compute_dtype=cfg.compute_dtype,
                 sequence_parallel_enabled=cfg.sequence_parallel, name="mlp")

@@ -101,11 +101,17 @@ def _expert_rank_key(key):
 
 class ExpertMLP(nn.Module):
     """Grouped FFN over a leading local-expert dim: h -> ffn/tp -> h per
-    expert, gelu in fp32, tp-reduced output. Input [E_local, S, h]."""
+    expert, activation in fp32, tp-reduced output. Input [E_local, S, h].
+
+    ``activation="swiglu"`` makes w1 a fused per-rank [gate | up]
+    projection (2 * ffn/tp local columns, bias-free — the Llama/Mixtral
+    expert shape); "gelu" is the Switch-Transformer shape with biases.
+    """
 
     hidden_size: int
     ffn_hidden_size: int
     num_local_experts: int
+    activation: str = "gelu"
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -114,32 +120,44 @@ class ExpertMLP(nn.Module):
         tp = get_tensor_model_parallel_world_size()
         ffn_local = divide(self.ffn_hidden_size, tp)
         init = nn.initializers.lecun_normal(batch_axis=(0,))
+        swiglu = self.activation == "swiglu"
+        if not swiglu and self.activation != "gelu":
+            raise ValueError(f"unknown activation {self.activation!r}")
 
         def shard_init(key, shape, dtype):
             return init(_expert_rank_key(key), shape, dtype)
 
         w1 = self.param("w1", shard_init,
-                        (self.num_local_experts, self.hidden_size, ffn_local),
+                        (self.num_local_experts, self.hidden_size,
+                         ffn_local * (2 if swiglu else 1)),
                         self.params_dtype)
-        b1 = self.param("b1", nn.initializers.zeros,
-                        (self.num_local_experts, ffn_local), self.params_dtype)
         w2 = self.param("w2", shard_init,
                         (self.num_local_experts, ffn_local, self.hidden_size),
                         self.params_dtype)
-        b2 = self.param("b2", nn.initializers.zeros,
-                        (self.num_local_experts, self.hidden_size),
-                        self.params_dtype)
+        if not swiglu:
+            b1 = self.param("b1", nn.initializers.zeros,
+                            (self.num_local_experts, ffn_local),
+                            self.params_dtype)
+            b2 = self.param("b2", nn.initializers.zeros,
+                            (self.num_local_experts, self.hidden_size),
+                            self.params_dtype)
 
         # Column-parallel in, row-parallel out (identity/psum vjp pairing).
         x = copy_to_tensor_model_parallel_region(x)
         x = x.astype(self.compute_dtype)
         h1 = jnp.einsum("ech,ehf->ecf", x, w1.astype(self.compute_dtype),
                         preferred_element_type=jnp.float32)
-        h1 = h1 + b1[:, None, :].astype(jnp.float32)
-        a = jax.nn.gelu(h1).astype(self.compute_dtype)
+        if swiglu:
+            gate, up = jnp.split(h1, 2, axis=-1)
+            a = (jax.nn.silu(gate) * up).astype(self.compute_dtype)
+        else:
+            h1 = h1 + b1[:, None, :].astype(jnp.float32)
+            a = jax.nn.gelu(h1).astype(self.compute_dtype)
         y = jnp.einsum("ecf,efh->ech", a, w2.astype(self.compute_dtype),
                        preferred_element_type=jnp.float32)
         y = reduce_from_tensor_model_parallel_region(y)
+        if swiglu:
+            return y
         return y + b2[:, None, :].astype(jnp.float32)
 
 
@@ -155,6 +173,7 @@ class SwitchMLP(nn.Module):
     capacity_factor: float = 1.25
     jitter_eps: float = 0.0
     router_type: str = "top_k"  # or "expert_choice" (balanced, no aux)
+    activation: str = "gelu"  # or "swiglu" (Llama/Mixtral-style experts)
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     sequence_parallel_enabled: bool = False
@@ -215,7 +234,8 @@ class SwitchMLP(nn.Module):
         expert_out = ExpertMLP(
             hidden_size=self.hidden_size,
             ffn_hidden_size=self.ffn_hidden_size,
-            num_local_experts=n_local, params_dtype=self.params_dtype,
+            num_local_experts=n_local, activation=self.activation,
+            params_dtype=self.params_dtype,
             compute_dtype=self.compute_dtype, name="experts")(expert_in)
         # compute_dtype over the wire: the return all_to_all otherwise
         # ships fp32 (2x the dispatch path's ICI bytes).

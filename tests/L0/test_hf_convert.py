@@ -97,6 +97,34 @@ def test_llama_greedy_generation_matches_hf():
     np.testing.assert_array_equal(np.asarray(ours), ref)
 
 
+def test_logits_match_hf_mixtral():
+    """Oracle for the MoE stack: top-2 routing + SwiGLU experts + GQA
+    attention vs HF Mixtral (dropless via capacity == all tokens)."""
+    from tools.convert_hf_mixtral import convert_mixtral
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=32, sliding_window=None,
+        attention_dropout=0.0)
+    torch.manual_seed(3)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+    cfg, params = convert_mixtral(hf.state_dict(), hf_cfg)
+    assert cfg.num_moe_experts == 4 and cfg.moe_top_k == 2
+
+    tokens = np.random.RandomState(3).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours, _ = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens),
+                                  mutable=["moe_losses"])
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
 def test_greedy_generation_matches_hf():
     from tools.convert_hf_gpt2 import convert_gpt2
 

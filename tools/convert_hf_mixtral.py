@@ -1,0 +1,133 @@
+"""Convert a HuggingFace Mixtral checkpoint into apex_tpu MoE-GPT params.
+
+Migration tooling and — via tests/L0/test_hf_convert.py — an external
+oracle for the whole MoE stack: top-2 routing (HF's softmax over the
+selected logits equals apex_tpu's full-softmax-then-renormalize, the
+ratios are identical), SwiGLU experts, GQA + RoPE attention. apex_tpu's
+capacity-based dispatch reproduces Mixtral's dropless semantics when
+``moe_capacity_factor = num_experts / top_k`` (capacity == all tokens).
+
+    from transformers import MixtralForCausalLM
+    from tools.convert_hf_mixtral import convert_mixtral
+
+    hf = MixtralForCausalLM.from_pretrained(path)
+    cfg, params = convert_mixtral(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.convert_hf_llama import _fused_qkv
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def convert_mixtral(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a MixtralForCausalLM
+    state_dict. Single-device layout (tp=1, ep=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = hf_config.hidden_size // n
+    E = hf_config.num_local_experts
+    k = hf_config.num_experts_per_tok
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        num_moe_experts=E,
+        moe_top_k=k,
+        moe_capacity_factor=float(E) / k,  # dropless
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        moe = f"{p}.block_sparse_moe"
+        # per expert: w1 = gate [ffn, h], w3 = up [ffn, h], w2 = down
+        # [h, ffn]; ours: w1 [E, h, 2*ffn] = [gate.T | up.T], w2 [E, ffn, h]
+        w1 = np.stack([np.concatenate(
+            [lin_t(f"{moe}.experts.{e}.w1.weight"),
+             lin_t(f"{moe}.experts.{e}.w3.weight")], axis=-1)
+            for e in range(E)])
+        w2 = np.stack([lin_t(f"{moe}.experts.{e}.w2.weight")
+                       for e in range(E)])
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {
+                "weight": jnp.asarray(_t(sd[f"{p}.input_layernorm.weight"]))},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.post_attention_layernorm.weight"]))},
+            "mlp": {
+                "router": {"gate_weight": jnp.asarray(
+                    lin_t(f"{moe}.gate.weight"))},
+                "experts": {"w1": jnp.asarray(w1), "w2": jnp.asarray(w2)},
+            },
+        }
+
+    params = {
+        "word_embeddings": {"weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {"weight": jnp.asarray(_t(sd["norm.weight"]))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import MixtralForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = MixtralForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_mixtral(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
