@@ -11,6 +11,8 @@
                                            other layer) tokens/sec/chip
     python bench.py llama [batch] [steps]  Llama-style GPT (RoPE + GQA +
                                            SwiGLU + RMSNorm) tokens/sec/chip
+    python bench.py decode [batch] [new]   KV-cache decode throughput
+                                           (serving) tokens/sec/chip
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
@@ -210,6 +212,42 @@ def bench_llama(batch, steps):
     }))
 
 
+def bench_decode(batch, steps):
+    """KV-cache decode throughput (tokens/sec) on the llama-style config:
+    prefill 128 tokens, then timed single-token steps through the jitted
+    scan — the serving-shaped metric."""
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    cfg = TransformerConfig(
+        hidden_size=1024, num_layers=16, num_attention_heads=16,
+        vocab_size=32000, max_position_embeddings=2048,
+        compute_dtype=jnp.bfloat16, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=4, ffn_hidden_size=2816)
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, 128)))
+    params = GPTModel(cfg).init(jax.random.PRNGKey(0), prompt)["params"]
+
+    # warm with the SAME scan length (compile prefill + decode scan),
+    # then time the cached path
+    out = generate(model, params, prompt, max_new_tokens=steps)
+    int(out[0, -1])
+    t0 = time.perf_counter()
+    out = generate(model, params, prompt, max_new_tokens=steps)
+    int(out[0, -1])  # host fetch = completion barrier
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "llama_style_decode_tokens_per_sec_per_chip",
+        "value": round(batch * steps / dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
 def bench_moe(batch, steps):
     """MoE GPT (16 layers x 1024, 8 experts top-1, seq 1024) single-chip
     training throughput — the expert-parallel capability beyond the
@@ -280,6 +318,10 @@ def main():
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
         return bench_llama(batch, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "decode":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+        return bench_decode(batch, steps)
 
     # batch 256 measured ~1.7x faster per chip than 128 on the v5e/v6e
     # class chip (better MXU utilization); 50 steps amortize dispatch
