@@ -9,7 +9,6 @@ pre_process/post_process flags (apex/transformer/pipeline_parallel/schedules/
 common.py:30-151) re-expressed as masked SPMD branches.
 """
 
-from typing import Optional
 
 import flax.linen as nn
 import jax
