@@ -97,6 +97,39 @@ def test_llama_greedy_generation_matches_hf():
     np.testing.assert_array_equal(np.asarray(ours), ref)
 
 
+def test_logits_match_hf_qwen2():
+    """Qwen2 = llama shape + QKV biases: oracles the fused bias layout."""
+    from tools.convert_hf_qwen2 import convert_qwen2
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        attention_dropout=0.0, sliding_window=None, use_sliding_window=False)
+    torch.manual_seed(4)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # HF zero-inits biases; randomize so the bias mapping is exercised
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if "self_attn" in name and name.endswith("bias"):
+                p.copy_(torch.randn_like(p) * 0.5)
+    cfg, params = convert_qwen2(hf.state_dict(), hf_cfg)
+    # qkv biases must be nonzero after conversion (llama zeros them)
+    b0 = params["transformer"]["layer_0"]["self_attention"][
+        "query_key_value"]["bias"]
+    assert float(jnp.abs(b0).sum()) > 0
+
+    tokens = np.random.RandomState(4).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
 def test_logits_match_hf_mixtral():
     """Oracle for the MoE stack: top-2 routing + SwiGLU experts + GQA
     attention vs HF Mixtral (dropless via capacity == all tokens)."""

@@ -1,0 +1,67 @@
+"""Convert a HuggingFace Qwen2 checkpoint into apex_tpu GPTModel params.
+
+Qwen2 is llama-shaped (RMSNorm, RoPE, SwiGLU, GQA) with QKV biases —
+this converter reuses the llama mapping and additionally maps the
+q/k/v biases through the same fused column layout.
+
+    from transformers import Qwen2ForCausalLM
+    from tools.convert_hf_qwen2 import convert_qwen2
+
+    hf = Qwen2ForCausalLM.from_pretrained(path)
+    cfg, params = convert_qwen2(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.convert_hf_llama import _fused_qkv, convert_llama
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def convert_qwen2(state_dict, hf_config):
+    """(TransformerConfig, params) from a Qwen2ForCausalLM state_dict.
+    Single-device layout (tp=1)."""
+    cfg, params = convert_llama(state_dict, hf_config)
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = hf_config.hidden_size // n
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        if f"{p}.self_attn.q_proj.bias" not in sd:
+            continue
+        fused_bias = _fused_qkv(_t(sd[f"{p}.self_attn.q_proj.bias"]),
+                                _t(sd[f"{p}.self_attn.k_proj.bias"]),
+                                _t(sd[f"{p}.self_attn.v_proj.bias"]),
+                                n, g, d)
+        params["transformer"][f"layer_{i}"]["self_attention"][
+            "query_key_value"]["bias"] = jnp.asarray(fused_bias)
+    return cfg, params
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Qwen2ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Qwen2ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_qwen2(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
