@@ -1,0 +1,34 @@
+"""Convert a HuggingFace Mistral checkpoint into apex_tpu GPTModel params.
+
+Mistral's tensor layout and naming are identical to Llama's (RMSNorm,
+RoPE, SwiGLU, GQA, no biases) — the mapping is convert_llama verbatim.
+Note: Mistral's sliding-window attention applies only beyond
+``sliding_window`` tokens (4096 by default); apex_tpu computes full
+causal attention, so logits match for sequences within the window.
+"""
+
+from tools.convert_hf_llama import convert_llama as convert_mistral  # noqa: F401
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import MistralForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = MistralForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_mistral(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
