@@ -7,8 +7,11 @@ Parity: reference apex/amp (frontend.py:197 ``initialize``, handle.py:16
 TPU design: fp16+loss-scaling on GPU becomes bf16-first on TPU. O1's
 runtime monkey-patching of the torch namespace has no JAX analog — tracing
 happens once under jit — so O1 maps to a *dtype policy* that apex_tpu's
-layers consult (``amp.autocast`` / ``amp.policy``), while O2/O3 map to
-whole-model casts with fp32 master weights kept by the wrapped optimizer.
+layers consult (``amp.autocast`` / ``amp.policy``) plus the
+``apex_tpu.amp.{jnp,nn,lax}`` shim namespaces: user code importing
+``from apex_tpu.amp import jnp`` gets the reference's O1 white/black-list
+casts (amp/lists.py) on its own ops. O2/O3 map to whole-model casts with
+fp32 master weights kept by the wrapped optimizer.
 The ``LossScaler`` keeps the reference's dynamic-scaling semantics (init
 2^16, window 2000, halve on overflow) in a functional, jit-friendly state.
 """
@@ -28,6 +31,7 @@ from apex_tpu.amp.scaler import LossScaler, ScalerState  # noqa: F401
 from apex_tpu.amp.policy import (  # noqa: F401
     autocast,
     current_policy,
+    set_global_policy,
     DtypePolicy,
     half_function,
     float_function,
@@ -38,3 +42,7 @@ from apex_tpu.amp.policy import (  # noqa: F401
 )
 from apex_tpu.amp.amp_optimizer import AmpOptimizer  # noqa: F401
 from apex_tpu.amp._amp_state import _amp_state  # noqa: F401
+from apex_tpu.amp import jnp  # noqa: F401  (O1 shim namespaces)
+from apex_tpu.amp import lax  # noqa: F401
+from apex_tpu.amp import lists  # noqa: F401
+from apex_tpu.amp import nn  # noqa: F401

@@ -174,6 +174,16 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
 
     props = _amp_state.opt_properties
 
+    # O1: activate the global dtype policy so the apex_tpu.amp.{jnp,nn,
+    # lax} shim namespaces cast user ops from here on (the reference
+    # patches the torch namespaces at this point, amp/_initialize.py:235-248).
+    from apex_tpu.amp import policy as _policy
+
+    _policy.set_global_policy(_policy.DtypePolicy(
+        enabled=bool(props.patch_torch_functions),
+        compute_dtype=jnp.bfloat16,
+        cast_model_outputs=cast_model_outputs))
+
     models_was_list = isinstance(models, list)
     models_list = models if models_was_list else [models]
     if props.cast_model_type is not None and props.cast_model_type != jnp.float32:

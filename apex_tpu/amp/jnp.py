@@ -1,0 +1,62 @@
+"""``apex_tpu.amp.jnp`` — the O1 shim namespace over ``jax.numpy``.
+
+Parity: reference apex/amp/amp.py:74-183. The reference implements O1 by
+monkey-patching the global torch namespaces so *user* code gets automatic
+casts; under jit that trick is both impossible (tracing) and rude (global
+mutation). The TPU-native equivalent is an import-swap: user code does
+
+    from apex_tpu.amp import jnp   # instead of: import jax.numpy as jnp
+
+and every listed op gets the reference's O1 cast semantics whenever the
+amp dtype policy is active (``amp.initialize(..., opt_level="O1")`` or an
+``amp.autocast()`` block) — matmuls in bf16, reductions/transcendentals
+in fp32, multi-arg elementwise ops promoted — while unlisted ops and
+disabled-policy runs pass straight through to ``jax.numpy``. Companion
+shims: ``apex_tpu.amp.nn`` (jax.nn) and ``apex_tpu.amp.lax`` (jax.lax
+convs/dots).
+
+Everything not explicitly wrapped is forwarded verbatim via module
+``__getattr__``, so the shim tracks jax.numpy's full surface.
+"""
+
+import jax.numpy as _jnp
+
+from apex_tpu.amp import lists as _lists
+from apex_tpu.amp.policy import (
+    float_function,
+    half_function,
+    promote_function,
+)
+
+
+class _WrappedLinalg:
+    """jnp.linalg proxy: decompositions/norms fp32, rest forwarded."""
+
+    def __getattr__(self, name):
+        fn = getattr(_jnp.linalg, name)
+        if name in _lists.LINALG_FLOAT:
+            return float_function(fn)
+        return fn
+
+
+linalg = _WrappedLinalg()
+
+_WRAPPED = {}
+for _name in _lists.JNP_HALF:
+    if hasattr(_jnp, _name):
+        _WRAPPED[_name] = half_function(getattr(_jnp, _name))
+for _name in _lists.JNP_FLOAT:
+    if hasattr(_jnp, _name):
+        _WRAPPED[_name] = float_function(getattr(_jnp, _name))
+for _name in _lists.JNP_PROMOTE:
+    if hasattr(_jnp, _name):
+        _WRAPPED[_name] = promote_function(getattr(_jnp, _name))
+globals().update(_WRAPPED)
+
+
+def __getattr__(name):  # PEP 562: forward the rest of jax.numpy
+    return getattr(_jnp, name)
+
+
+def __dir__():
+    return sorted(set(dir(_jnp)) | set(_WRAPPED) | {"linalg"})

@@ -1,0 +1,25 @@
+"""``apex_tpu.amp.lax`` — O1 shim over ``jax.lax`` (see amp/jnp.py).
+
+Parity: reference apex/amp/lists/functional_overrides.py FP16 conv ops —
+convolutions and dot_general are MXU-bound, so they run in the compute
+dtype under the policy.
+"""
+
+import jax.lax as _lax
+
+from apex_tpu.amp import lists as _lists
+from apex_tpu.amp.policy import half_function
+
+_WRAPPED = {}
+for _name in _lists.LAX_HALF:
+    if hasattr(_lax, _name):
+        _WRAPPED[_name] = half_function(getattr(_lax, _name))
+globals().update(_WRAPPED)
+
+
+def __getattr__(name):
+    return getattr(_lax, name)
+
+
+def __dir__():
+    return sorted(set(dir(_lax)) | set(_WRAPPED))

@@ -1,0 +1,63 @@
+"""amp O1 cast lists for the jnp/nn/lax shim namespaces.
+
+Parity: reference apex/amp/lists/{torch_overrides,functional_overrides,
+tensor_overrides}.py (~258 entries across the three) — translated from
+torch op names to their jax.numpy / jax.nn / jax.lax equivalents. Ops with
+no JAX analog (in-place variants, RNN cells, torch-only losses) have no
+entry; jnp ops not listed pass through untouched, which matches the
+reference's default of leaving unlisted ops alone.
+
+Three semantics (reference apex/amp/amp.py:74-183):
+- HALF  ("fp16 on GPU" -> bf16 on TPU): MXU-bound ops — matmuls, convs.
+- FLOAT (fp32): reductions, transcendentals, norms, losses — ops where
+  bf16 accumulation loses too much precision.
+- PROMOTE: multi-arg elementwise ops run in the widest input dtype
+  (jnp's numpy-style promotion already does this; wrapping pins the
+  documented semantics even if inputs carry weak types).
+"""
+
+# jax.numpy names (reference torch_overrides.py FP16 list: mm, matmul,
+# bmm, addmm/baddbmm family collapse to matmul/einsum in jnp)
+JNP_HALF = (
+    "matmul", "dot", "vdot", "inner", "outer", "tensordot", "einsum",
+    "kron",
+)
+
+# reference torch_overrides.py FP32 list: acos, asin, cosh, erfinv, exp,
+# expm1, log, log10, log1p, log2, reciprocal, rsqrt, sinh, tan, pow,
+# prod, sum, cumprod, cumsum, norm, dist, renorm, ...
+JNP_FLOAT = (
+    "exp", "expm1", "log", "log1p", "log2", "log10", "power", "float_power",
+    "prod", "sum", "cumprod", "cumsum", "mean", "var", "std", "median",
+    "reciprocal", "sinh", "cosh", "tan", "arcsin", "arccos", "arctan",
+    "arcsinh", "arccosh", "arctanh", "nansum", "nanprod", "nanmean",
+    "trace", "interp",
+)
+
+# reference torch_overrides.py CASTS/promote list: add, div, mul, sub,
+# cat, stack, equal-family, min/max, addcdiv/addcmul, ...
+JNP_PROMOTE = (
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "remainder", "mod", "concatenate", "stack", "hstack", "vstack",
+    "dstack", "column_stack", "where", "minimum", "maximum", "fmin",
+    "fmax", "hypot", "heaviside", "logaddexp", "logaddexp2", "equal",
+    "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "allclose", "isclose",
+)
+
+# jax.nn names (reference functional_overrides.py: FP16 = conv*/linear/
+# attention-ish, FP32 = softmax/log_softmax + the loss zoo)
+NN_HALF = ("relu", "gelu", "silu", "swish", "glu", "leaky_relu", "elu",
+           "celu", "selu", "hard_tanh", "relu6")
+NN_FLOAT = ("softmax", "log_softmax", "logsumexp", "standardize",
+            "softplus", "sigmoid", "log_sigmoid", "one_hot")
+
+# jax.lax names (conv kernels — functional_overrides FP16 conv1d..3d,
+# conv_transpose*; dot_general is the matmul primitive)
+LAX_HALF = ("conv", "conv_with_general_padding", "conv_general_dilated",
+            "conv_transpose", "dot", "dot_general", "batch_matmul")
+
+# jnp.linalg names forced fp32 (reference FP32 "norm", "dist")
+LINALG_FLOAT = ("norm", "cond", "det", "slogdet", "eigvals", "eigvalsh",
+                "svd", "qr", "cholesky", "inv", "pinv", "solve", "lstsq",
+                "matrix_power", "matrix_rank")

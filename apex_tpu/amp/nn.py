@@ -1,0 +1,27 @@
+"""``apex_tpu.amp.nn`` — O1 shim over ``jax.nn`` (see amp/jnp.py).
+
+Parity: reference apex/amp/lists/functional_overrides.py — softmax /
+log_softmax and friends run fp32, activations run in the compute dtype.
+"""
+
+import jax.nn as _nn
+
+from apex_tpu.amp import lists as _lists
+from apex_tpu.amp.policy import float_function, half_function
+
+_WRAPPED = {}
+for _name in _lists.NN_HALF:
+    if hasattr(_nn, _name):
+        _WRAPPED[_name] = half_function(getattr(_nn, _name))
+for _name in _lists.NN_FLOAT:
+    if hasattr(_nn, _name):
+        _WRAPPED[_name] = float_function(getattr(_nn, _name))
+globals().update(_WRAPPED)
+
+
+def __getattr__(name):
+    return getattr(_nn, name)
+
+
+def __dir__():
+    return sorted(set(dir(_nn)) | set(_WRAPPED))

@@ -53,9 +53,20 @@ def _cast_tree(tree, dtype):
 
 _local = threading.local()
 
+# Process-global default policy: amp.initialize(opt_level="O1") enables it
+# (the analog of the reference's initialize-time namespace patching,
+# apex/amp/amp.py:74-183 — active globally until changed). An autocast()
+# block overrides it thread-locally.
+_global_policy = DtypePolicy(enabled=False)
+
+
+def set_global_policy(policy: DtypePolicy) -> None:
+    global _global_policy
+    _global_policy = policy
+
 
 def current_policy() -> DtypePolicy:
-    return getattr(_local, "policy", None) or DtypePolicy(enabled=False)
+    return getattr(_local, "policy", None) or _global_policy
 
 
 @contextlib.contextmanager

@@ -193,6 +193,14 @@ def beam_search(model, params, prompt_tokens, max_new_tokens: int,
     if not getattr(model, "decode", False):
         raise ValueError("beam_search() needs a model built with "
                          "decode=True")
+    from apex_tpu.transformer.parallel_state import (
+        get_tensor_model_parallel_world_size,
+    )
+
+    if get_tensor_model_parallel_world_size() > 1:
+        raise NotImplementedError(
+            "beam_search() drives a tp=1 model; for tensor parallelism "
+            "run the decode step inside shard_map (see generate())")
     cfg = model.config
     b, plen = prompt_tokens.shape
     if plen + max_new_tokens > cfg.max_position_embeddings:

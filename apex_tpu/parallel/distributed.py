@@ -318,11 +318,21 @@ def _ddp_bwd(fn, axis_name, gradient_average, vjp, g):
     #   averaging policy by scaling the cotangent.
     # - unchecked (check_vma=False): cotangents stay per-device, so DDP
     #   performs the allreduce itself.
-    # Discriminate via the vma type of axis_index (varying iff checking on).
-    first_axis = (axis_name[0] if isinstance(axis_name, (tuple, list))
-                  else axis_name)
-    checked = first_axis in getattr(
-        jax.typeof(lax.axis_index(first_axis)), "vma", frozenset())
+    # Discriminate via the vma type of axis_index (varying iff checking
+    # on). shard_map sets check_vma uniformly, but probe every axis of a
+    # tuple axis_name and insist they agree rather than trusting the
+    # first one.
+    axes = (tuple(axis_name) if isinstance(axis_name, (tuple, list))
+            else (axis_name,))
+    states = {
+        ax in getattr(jax.typeof(lax.axis_index(ax)), "vma", frozenset())
+        for ax in axes}
+    if len(states) != 1:
+        raise ValueError(
+            f"mixed vma checking states across mesh axes {axes}; DDP "
+            f"cannot tell whether the shard_map boundary will psum "
+            f"cotangents")
+    checked = states.pop()
     if checked:
         if gradient_average:
             n = _axis_size_total(axis_name)

@@ -33,7 +33,7 @@ def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
                     capacity_factor: float) -> int:
     """Static per-expert slot count; multiple of 8 for TPU lane layout."""
     raw = max(1, int(num_tokens * top_k * capacity_factor / num_experts))
-    return -(-raw // 8) * 8 if raw > 8 else raw
+    return -(-raw // 8) * 8
 
 
 def compute_routing(logits, top_k: int, capacity: int,

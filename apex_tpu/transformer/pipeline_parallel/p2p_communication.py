@@ -13,7 +13,9 @@ FutureTensor). "Scatter-gather optimization" (chunking over the TP group)
 is subsumed by giving the communicated tensor a tp-sharded layout.
 
 All helpers must be called inside ``shard_map`` with the 'pp' axis bound.
-Boundary ranks receive zeros (non-circular permutes), which schedules mask.
+By default boundary ranks receive zeros (non-circular permutes), which
+schedules mask; ``circular=True`` wraps the ring (rank P-1 -> rank 0 and
+back) — the interleaved schedule rides chunk handoffs on the wrap edge.
 """
 
 from typing import Optional
@@ -27,33 +29,43 @@ from apex_tpu.transformer.parallel_state import (
 )
 
 
-def _perm_fwd(world):
+def _perm_fwd(world, circular=False):
+    if circular:
+        return [(i, (i + 1) % world) for i in range(world)]
     return [(i, i + 1) for i in range(world - 1)]
 
 
-def _perm_bwd(world):
+def _perm_bwd(world, circular=False):
+    if circular:
+        return [(i, (i - 1) % world) for i in range(world)]
     return [(i + 1, i) for i in range(world - 1)]
 
 
 def send_forward_recv_forward(output_tensor, axis_name=PIPELINE_PARALLEL_AXIS,
-                              world: Optional[int] = None):
+                              world: Optional[int] = None,
+                              circular: bool = False):
     """Shift activations one stage forward: rank r's value arrives at r+1;
-    rank 0 receives zeros. (reference recv_forward + send_forward pair)"""
+    rank 0 receives zeros (or rank P-1's value when ``circular``).
+    (reference recv_forward + send_forward pair)"""
     world = world or get_pipeline_model_parallel_world_size()
     if world == 1:
-        return jnp.zeros_like(output_tensor)
-    return lax.ppermute(output_tensor, axis_name, _perm_fwd(world))
+        return output_tensor if circular else jnp.zeros_like(output_tensor)
+    return lax.ppermute(output_tensor, axis_name,
+                        _perm_fwd(world, circular))
 
 
 def send_backward_recv_backward(input_tensor_grad,
                                 axis_name=PIPELINE_PARALLEL_AXIS,
-                                world: Optional[int] = None):
+                                world: Optional[int] = None,
+                                circular: bool = False):
     """Shift gradients one stage backward: rank r's value arrives at r-1;
-    the last rank receives zeros."""
+    the last rank receives zeros (or rank 0's value when ``circular``)."""
     world = world or get_pipeline_model_parallel_world_size()
     if world == 1:
-        return jnp.zeros_like(input_tensor_grad)
-    return lax.ppermute(input_tensor_grad, axis_name, _perm_bwd(world))
+        return (input_tensor_grad if circular
+                else jnp.zeros_like(input_tensor_grad))
+    return lax.ppermute(input_tensor_grad, axis_name,
+                        _perm_bwd(world, circular))
 
 
 # Aliases matching the reference wrapper names

@@ -4,18 +4,35 @@ Parity: reference apex/transformer/pipeline_parallel/schedules/ —
 ``get_forward_backward_func`` (schedules/__init__.py:22-35) selecting
 (a) no-pipelining with grad sync on last microbatch
     (fwd_bwd_no_pipelining.py:23-124),
-(b) 1F1B non-interleaved (fwd_bwd_pipelining_without_interleaving.py:241-597),
+(b) 1F1B non-interleaved (fwd_bwd_pipelining_without_interleaving.py:241-597,
+    warmup math at :345-349),
 (c) interleaved 1F1B with virtual chunks
-    (fwd_bwd_pipelining_with_interleaving.py).
+    (fwd_bwd_pipelining_with_interleaving.py, get_model_chunk_id scheduling).
 
 TPU design: the reference schedules are eager Python loops over blocking
-NCCL p2p calls. Here each schedule is ONE jitted SPMD program: a
-``lax.fori_loop`` over schedule ticks with ``lax.ppermute`` moving
-activations/grads along the 'pp' mesh axis. Activation memory is bounded
-by stashing only each microbatch's *stage input* and rematerializing the
-forward in the backward tick (``jax.vjp`` over the stage fn) — the
-TPU-idiomatic replacement for 1F1B's early-backward memory bound, with the
-same pipeline bubble (M + P - 1 ticks per phase).
+NCCL p2p calls. Here both pipelined schedules are ONE jitted SPMD program
+sharing one core (``_pipelined_fwd_bwd`` — non-interleaved is the V=1
+case): a ``lax.fori_loop`` over *global schedule ticks* with
+``lax.ppermute`` moving activations/grads along the 'pp' mesh axis. Three
+phases — a forward-only warmup, a steady state in which every tick
+performs one forward unit AND one backward unit (true 1F1B alternation),
+and a backward-only cooldown — so the executed compute per rank is
+(M + P - 1) * (t_fwd + t_bwd) at V=1, the same pipeline total as the
+reference's 1F1B, instead of the 2*(M + P - 1) full-ticks of a
+phase-split schedule.
+
+Memory is bounded like the reference's 1F1B: only each in-flight
+microbatch's *stage input* is stashed, in a ring buffer whose size is the
+in-flight bound (min(M, 2P-1) at V=1; min(MV, 2VP) interleaved) — O(P·V),
+not O(M) — and the forward is rematerialized inside the backward tick
+(``jax.vjp`` over the stage fn), the TPU-idiomatic activation-recompute
+tradeoff (reference random.py:237-311 makes the same trade when
+activation checkpointing is on).
+
+The loss (for GPT: the full vocab projection) is computed under a
+``lax.cond`` on ``is_last_stage``, so non-last ranks skip it at runtime in
+both the primal and the transpose (reference computes loss_func only on
+the last stage, common.py:305-310).
 
 Stage-fn contract (replaces the reference's forward_step_func protocol,
 common.py:253-324):
@@ -28,14 +45,14 @@ common.py:253-324):
 the whole model — build the input from the microbatch unconditionally).
 
 Every pp rank holds ``params`` with the same pytree structure (its own
-stage's weights). ``is_first_stage`` is a traced bool that is True only on
-the *global* first stage (chunk 0 of rank 0 under virtual pipelining) —
-the stage fn builds its input from the microbatch there (embedding) via
+stage's weights; stacked [V, ...] leaves under interleaving).
+``is_first_stage`` is a traced bool that is True only on the *global*
+first stage (chunk 0 of rank 0 under virtual pipelining) — the stage fn
+builds its input from the microbatch there (embedding) via
 ``jnp.where(is_first_stage, embed(mb), input_tensor)``. ``loss_func`` is
-evaluated on the last stage only (masked by the schedule).
+evaluated on the last global stage only.
 """
 
-import functools
 from typing import Callable, Optional
 
 import jax
@@ -57,6 +74,45 @@ def listify_model(model):
     if isinstance(model, list):
         return model
     return [model]
+
+
+def pipeline_schedule_plan(pp_size: int, num_microbatches: int,
+                           num_model_chunks: int = 1) -> dict:
+    """Static tick/memory plan of the pipelined schedules (pure Python).
+
+    The schedules below derive their loop bounds and stash sizes from this
+    function, so its numbers are the numbers — tests assert on them.
+
+    Forward unit k = round*P*V + c*P + j of (chunk c, microbatch
+    i = round*P + j) runs on rank r at tick k + r — microbatch groups of
+    size P cycling through chunks, the reference's get_model_chunk_id
+    order (V=1 degenerates to k = i) — and its backward mirrors it from
+    tick V*P - 1 (the last global stage's backward shares its forward's
+    tick). Chunk handoffs ride a circular ppermute with exactly-one-tick
+    latency, so rank 0's warmup before its first backward is
+    2(P-1) + (V-1)*P units, the reference's warmup formula
+    (fwd_bwd_pipelining_with_interleaving.py num_warmup_microbatches).
+    """
+    P, M, V = pp_size, num_microbatches, num_model_chunks
+    if V == 1:
+        return {
+            "warmup": P - 1,            # fwd-only ticks
+            "steady": M,                # fwd+bwd ticks
+            "cooldown": P - 1,          # bwd-only ticks
+            "total": M + 2 * P - 2,
+            "fwd_ticks": M + P - 1,     # ticks executing a fwd unit
+            "bwd_ticks": M + P - 1,
+            "stash": min(M, 2 * P - 1),  # in-flight stage inputs: O(P)
+        }
+    return {
+        "warmup": V * P - 1,
+        "steady": M * V,
+        "cooldown": P - 1,
+        "total": M * V + V * P + P - 2,
+        "fwd_ticks": M * V + V * P - 1,
+        "bwd_ticks": M * V + P - 1,
+        "stash": min(M * V, 2 * V * P),  # O(P*V) chunk-stage inputs
+    }
 
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
@@ -105,6 +161,133 @@ def forward_backward_no_pipelining(forward_step_func, loss_func, params,
     return losses, grads
 
 
+def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
+                       *, M, V, P, tensor_shape, dtype, axis_name,
+                       grad_scale):
+    """Shared 3-phase tick machine for both pipelined schedules
+    (see pipeline_schedule_plan for the tick/unit mapping)."""
+    plan = pipeline_schedule_plan(P, M, V)
+    S = plan["stash"]
+    PV, MV = P * V, M * V
+    T0 = V * P - 1  # first backward tick (mb 0 has crossed all V*P stages)
+    rank = lax.axis_index(axis_name)
+    interleaved = V > 1
+
+    def take_mb(i):
+        return jax.tree_util.tree_map(lambda a: a[i], microbatches)
+
+    if interleaved:
+        def take_params(c):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                params)
+
+        def add_grads(grads, dp, c, active):
+            return jax.tree_util.tree_map(
+                lambda a, d: a.at[c].add(
+                    jnp.where(active, d.astype(jnp.float32), 0.0)),
+                grads, dp)
+    else:
+        def take_params(c):
+            return params
+
+        def add_grads(grads, dp, c, active):
+            return jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(active, d.astype(jnp.float32),
+                                           0.0),
+                grads, dp)
+
+    def fwd_unit(k):
+        rnd, rem = k // PV, k % PV
+        c, j = rem // P, rem % P
+        return c, rnd * P + j, k % S
+
+    def bwd_unit(kb):
+        rnd, rem = kb // PV, kb % PV
+        c, j = (V - 1) - rem // P, rem % P
+        kf = rnd * PV + c * P + j
+        return c, rnd * P + j, kf % S
+
+    zero_h = jnp.zeros(tensor_shape, dtype)
+
+    def stage_and_maybe_loss(p, h, mb, is_first_u, is_last_u):
+        y = forward_step_func(p, h, mb, is_first_u)
+        # Only the last global stage pays for loss_func (for GPT: the
+        # vocab projection) — lax.cond skips it at runtime elsewhere, in
+        # both the primal and the transpose.
+        loss = lax.cond(
+            is_last_u,
+            lambda op: loss_func(*op).astype(jnp.float32),
+            lambda op: jnp.zeros((), jnp.float32),
+            (p, y, mb))
+        return y, loss
+
+    # state = (stash, y_prev, dx_prev, losses, grads)
+    def fwd_half(t, state):
+        with jax.named_scope("pp_fwd_unit"):
+            xs, y_prev, dx_prev, losses, grads = state
+            recv = send_forward_recv_forward(
+                y_prev, axis_name, world=P, circular=interleaved)
+            k = t - rank
+            active = (k >= 0) & (k < MV)
+            c, i, slot = fwd_unit(jnp.clip(k, 0, MV - 1))
+            mb = take_mb(i)
+            p_c = take_params(c)
+            is_first_u = (rank == 0) & (c == 0)
+            h_in = jnp.where(is_first_u, zero_h, recv).astype(dtype)
+            y = forward_step_func(p_c, h_in, mb, is_first_u)
+            xs = lax.dynamic_update_index_in_dim(
+                xs, jnp.where(active, h_in, xs[slot]), slot, 0)
+            y_prev = jnp.where(active, y, jnp.zeros_like(y))
+            return xs, y_prev, dx_prev, losses, grads
+
+    def bwd_half(t, state):
+        with jax.named_scope("pp_bwd_unit"):
+            xs, y_prev, dx_prev, losses, grads = state
+            dy_recv = send_backward_recv_backward(
+                dx_prev, axis_name, world=P, circular=interleaved)
+            kb = t - T0 - (P - 1 - rank)
+            active = (kb >= 0) & (kb < MV)
+            c, i, slot = bwd_unit(jnp.clip(kb, 0, MV - 1))
+            mb = take_mb(i)
+            p_c = take_params(c)
+            is_first_u = (rank == 0) & (c == 0)
+            is_last_u = (rank == P - 1) & (c == V - 1)
+            # the last global stage's backward shares its forward's tick,
+            # and fwd_half runs first in a steady tick, so the slot read
+            # here is the input stashed moments ago; other reads never
+            # collide with this tick's write (ring size >= in-flight).
+            h_in = xs[slot]
+            (_, loss), pullback = jax.vjp(
+                lambda p, h: stage_and_maybe_loss(p, h, mb, is_first_u,
+                                                  is_last_u), p_c, h_in)
+            dy_cot = jnp.where(active & ~is_last_u, dy_recv,
+                               jnp.zeros_like(dy_recv)).astype(dtype)
+            loss_cot = jnp.where(active & is_last_u,
+                                 jnp.asarray(grad_scale, jnp.float32), 0.0)
+            dp_c, dh = pullback((dy_cot, loss_cot))
+            grads = add_grads(grads, dp_c, c, active)
+            losses = losses.at[i].add(
+                jnp.where(active & is_last_u, loss, 0.0))
+            dx_prev = jnp.where(active, dh,
+                                jnp.zeros_like(dh)).astype(dtype)
+            return xs, y_prev, dx_prev, losses, grads
+
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    state = (jnp.zeros((S,) + tuple(tensor_shape), dtype), zero_h, zero_h,
+             jnp.zeros((M,), jnp.float32), zero_grads)
+    w, s = plan["warmup"], plan["steady"]
+    state = lax.fori_loop(0, w, fwd_half, state)
+    state = lax.fori_loop(w, w + s,
+                          lambda t, st: bwd_half(t, fwd_half(t, st)), state)
+    state = lax.fori_loop(w + s, plan["total"], bwd_half, state)
+    _, _, _, losses, grads = state
+    n = jnp.asarray(M, jnp.float32)
+    grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+    return losses, grads
+
+
 def forward_backward_pipelining_without_interleaving(
         forward_step_func: Callable, loss_func: Callable, params,
         microbatches, *, num_microbatches: int,
@@ -113,89 +296,22 @@ def forward_backward_pipelining_without_interleaving(
         grad_scale: float = 1.0,
         pp_size: Optional[int] = None,
         **unused):
-    """Pipelined forward-backward over the 'pp' axis (one jitted program).
+    """True 1F1B over the 'pp' axis in one jitted program (see module doc).
 
     Parity target: fwd_bwd_pipelining_without_interleaving.py:241-597.
     Returns (per-microbatch losses [M] — nonzero on the last stage only,
     grads pytree scaled by grad_scale / num_microbatches).
 
-    Must run inside shard_map with the 'pp' axis bound; ``tensor_shape`` is
-    the (seq, microbatch, hidden) activation shape crossing stage
-    boundaries (reference get_tensor_shapes, ...without_interleaving.py:29-86).
+    Must run inside shard_map with the 'pp' axis bound; ``tensor_shape``
+    is the (seq, microbatch, hidden) activation shape crossing stage
+    boundaries (reference get_tensor_shapes,
+    ...without_interleaving.py:29-86).
     """
     P = pp_size or get_pipeline_model_parallel_world_size()
-    M = num_microbatches
-    rank = lax.axis_index(axis_name)
-    is_first = rank == 0
-    is_last = rank == P - 1
-
-    def take_mb(i):
-        return jax.tree_util.tree_map(lambda a: a[i], microbatches)
-
-    def stage_and_loss(p, h, mb):
-        y = forward_step_func(p, h, mb, is_first)
-        loss = loss_func(p, y, mb)
-        return y, loss
-
-    zero_h = jnp.zeros(tensor_shape, dtype)
-    ticks = M + P - 1
-
-    # ---------------- forward phase ----------------
-    def fwd_tick(t, carry):
-        # named_scope = the reference's NVTX/timer annotations around
-        # forward_step (_timers.py usage in the schedules)
-        with jax.named_scope("pp_fwd_tick"):
-            xs, y_prev, losses = carry
-            recv = send_forward_recv_forward(y_prev, axis_name, world=P)
-            mb_idx = t - rank
-            active = (mb_idx >= 0) & (mb_idx < M)
-            mb_safe = jnp.clip(mb_idx, 0, M - 1)
-            mb = take_mb(mb_safe)
-            h_in = jnp.where(is_first, zero_h, recv).astype(dtype)
-            y, loss = stage_and_loss(params, h_in, mb)
-            # stash the stage input for rematerialized backward
-            xs = lax.dynamic_update_index_in_dim(
-                xs, jnp.where(active, h_in, xs[mb_safe]), mb_safe, 0)
-            losses = losses.at[mb_safe].add(
-                jnp.where(active & is_last, loss, 0.0))
-            y_prev = jnp.where(active, y, jnp.zeros_like(y))
-            return xs, y_prev, losses
-
-    xs0 = jnp.zeros((M,) + tuple(tensor_shape), dtype)
-    losses0 = jnp.zeros((M,), jnp.float32)
-    xs, _, losses = lax.fori_loop(
-        0, ticks, fwd_tick, (xs0, zero_h, losses0))
-
-    # ---------------- backward phase ----------------
-    zero_grads = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), params)
-
-    def bwd_tick(t, carry):
-        with jax.named_scope("pp_bwd_tick"):
-            grads_acc, dx_prev = carry
-            dy_recv = send_backward_recv_backward(dx_prev, axis_name, world=P)
-            mb_idx = (M - 1) - (t - (P - 1 - rank))
-            active = (mb_idx >= 0) & (mb_idx < M)
-            mb_safe = jnp.clip(mb_idx, 0, M - 1)
-            mb = take_mb(mb_safe)
-            h_in = xs[mb_safe]
-            _, pullback = jax.vjp(
-                lambda p, h: stage_and_loss(p, h, mb), params, h_in)
-            dy_cot = jnp.where(active & ~is_last, dy_recv,
-                               jnp.zeros_like(dy_recv)).astype(dtype)
-            loss_cot = jnp.where(active & is_last,
-                                 jnp.asarray(grad_scale, jnp.float32), 0.0)
-            dparams, dh = pullback((dy_cot, loss_cot))
-            grads_acc = jax.tree_util.tree_map(
-                lambda a, d: a + jnp.where(active, d.astype(jnp.float32), 0.0),
-                grads_acc, dparams)
-            dx_prev = jnp.where(active, dh, jnp.zeros_like(dh)).astype(dtype)
-            return grads_acc, dx_prev
-
-    grads, _ = lax.fori_loop(0, ticks, bwd_tick, (zero_grads, zero_h))
-    n = jnp.asarray(M, jnp.float32)
-    grads = jax.tree_util.tree_map(lambda g: g / n, grads)
-    return losses, grads
+    return _pipelined_fwd_bwd(
+        forward_step_func, loss_func, params, microbatches,
+        M=num_microbatches, V=1, P=P, tensor_shape=tensor_shape,
+        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale)
 
 
 def forward_backward_pipelining_with_interleaving(
@@ -204,18 +320,22 @@ def forward_backward_pipelining_with_interleaving(
         dtype=jnp.float32, axis_name: str = PIPELINE_PARALLEL_AXIS,
         grad_scale: float = 1.0, pp_size: Optional[int] = None,
         num_model_chunks: Optional[int] = None, **unused):
-    """Interleaved (virtual-pipeline) schedule.
+    """Interleaved (virtual-pipeline) 1F1B in one steady state.
 
     Parity target: fwd_bwd_pipelining_with_interleaving.py (516 LoC).
     ``params`` is a pytree whose leaves carry a leading ``num_model_chunks``
-    dim (stacked virtual chunks per rank); the model ring is traversed
-    ``num_model_chunks`` times: chunk c on rank r is global stage
-    c * P + r. Implemented as V sequential pipeline passes over the ring:
-    chunk c's rank-(P-1) outputs are stored per microbatch and handed to
-    chunk c+1's rank 0 with a single-edge ppermute; the backward walks the
-    chunks in reverse, handing input-grads from rank 0 back to rank P-1.
-    Each pass pipelines its M microbatches exactly like the
-    non-interleaved schedule.
+    dim (stacked virtual chunks per rank); chunk c on rank r is global
+    stage c * P + r. Unlike a sequential-passes scheme (bubble V*(P-1)
+    full passes), all chunks share ONE steady state: each global tick maps
+    to a (chunk, microbatch) unit per rank via the reference's
+    get_model_chunk_id order, so the forward wave fills in V*P - 1 ticks
+    and drains in P - 1 — per-rank overhead (V*P-1) fwd units + (P-1) bwd
+    units over the M*V useful ticks, matching the reference's rank-0
+    warmup of 2(P-1) + (V-1)P forward units. Chunk handoffs (rank P-1's
+    chunk-c output -> rank 0's chunk c+1 input, and the reverse for
+    grads) have exactly-one-tick latency under this order, so they ride
+    the same *circular* ppermute as the intra-chunk shifts — no boundary
+    buffers.
     """
     P = pp_size or get_pipeline_model_parallel_world_size()
     V = num_model_chunks or get_virtual_pipeline_model_parallel_world_size() or 1
@@ -225,127 +345,14 @@ def forward_backward_pipelining_with_interleaving(
             num_microbatches=num_microbatches, tensor_shape=tensor_shape,
             dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
             pp_size=P)
-    M = num_microbatches
-    S = V * P  # global stages
-    rank = lax.axis_index(axis_name)
-
-    def take_mb(i):
-        return jax.tree_util.tree_map(lambda a: a[i], microbatches)
-
-    def chunk_params(c):
-        return jax.tree_util.tree_map(lambda a: a[c], params)
-
-    zero_h = jnp.zeros(tensor_shape, dtype)
-    ticks = M + P - 1
-    losses_total = jnp.zeros((M,), jnp.float32)
-    # per-chunk stashed stage inputs for rematerialized backward
-    xs_all = jnp.zeros((V, M) + tuple(tensor_shape), dtype)
-    # chunk-boundary activations: outputs of rank P-1, inputs for next chunk
-    boundary = jnp.zeros((M,) + tuple(tensor_shape), dtype)
-
-    # ---------------- forward: V sequential ring passes ----------------
-    for c in range(V):
-        p_c = chunk_params(c)
-        is_first = (rank == 0) & (c == 0)
-        is_last = (rank == P - 1) & (c == V - 1)
-
-        def stage_and_loss(p, h, mb, is_first=is_first, is_last=is_last):
-            y = forward_step_func(p, h, mb, is_first)
-            loss = jnp.where(is_last, loss_func(p, y, mb), 0.0)
-            return y, loss
-
-        def fwd_tick(t, carry, c=c, p_c=p_c, is_first=is_first,
-                     stage_and_loss=stage_and_loss):
-            xs, y_prev, losses, new_boundary = carry
-            recv = send_forward_recv_forward(y_prev, axis_name, world=P)
-            # hand chunk c-1's stored boundary from rank P-1 to rank 0
-            if c > 0:
-                mb_t = jnp.clip(t, 0, M - 1)
-                handoff = lax.ppermute(boundary[mb_t], axis_name, [(P - 1, 0)])
-                first_in = handoff
-            else:
-                first_in = zero_h
-            mb_idx = t - rank
-            active = (mb_idx >= 0) & (mb_idx < M)
-            mb_safe = jnp.clip(mb_idx, 0, M - 1)
-            mb = take_mb(mb_safe)
-            h_in = jnp.where(rank == 0, first_in, recv).astype(dtype)
-            y, loss = stage_and_loss(p_c, h_in, mb)
-            xs = lax.dynamic_update_index_in_dim(
-                xs, jnp.where(active, h_in, xs[mb_safe]), mb_safe, 0)
-            losses = losses.at[mb_safe].add(jnp.where(active, loss, 0.0))
-            new_boundary = lax.dynamic_update_index_in_dim(
-                new_boundary,
-                jnp.where(active & (rank == P - 1), y, new_boundary[mb_safe]),
-                mb_safe, 0)
-            y_prev = jnp.where(active, y, jnp.zeros_like(y))
-            return xs, y_prev, losses, new_boundary
-
-        xs0 = jnp.zeros((M,) + tuple(tensor_shape), dtype)
-        xs_c, _, losses_total, boundary = lax.fori_loop(
-            0, ticks, fwd_tick,
-            (xs0, zero_h, losses_total, jnp.zeros_like(boundary)))
-        xs_all = xs_all.at[c].set(xs_c)
-
-    # ---------------- backward: V reverse ring passes ----------------
-    zero_grads = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    grads = zero_grads
-    # grads of chunk c's first-stage input (on rank 0), cotangent for
-    # chunk c-1's boundary outputs (needed on rank P-1)
-    dboundary = jnp.zeros((M,) + tuple(tensor_shape), dtype)
-
-    for c in reversed(range(V)):
-        p_c = chunk_params(c)
-        is_last = (rank == P - 1) & (c == V - 1)
-
-        is_first_c = (rank == 0) & (c == 0)
-
-        def stage_and_loss(p, h, mb, is_first=is_first_c, is_last=is_last):
-            y = forward_step_func(p, h, mb, is_first)
-            loss = jnp.where(is_last, loss_func(p, y, mb), 0.0)
-            return y, loss
-
-        def bwd_tick(t, carry, c=c, p_c=p_c, is_last=is_last,
-                     stage_and_loss=stage_and_loss):
-            grads_acc, dx_prev, new_dboundary = carry
-            dy_recv = send_backward_recv_backward(dx_prev, axis_name, world=P)
-            if c < V - 1:
-                # cotangent for this chunk's rank-(P-1) outputs, stored on
-                # rank 0 during chunk c+1's pass
-                mb_t = jnp.clip(M - 1 - t, 0, M - 1)
-                handoff = lax.ppermute(dboundary[mb_t], axis_name, [(0, P - 1)])
-                last_dy = handoff
-            else:
-                last_dy = jnp.zeros_like(zero_h)
-            mb_idx = (M - 1) - (t - (P - 1 - rank))
-            active = (mb_idx >= 0) & (mb_idx < M)
-            mb_safe = jnp.clip(mb_idx, 0, M - 1)
-            mb = take_mb(mb_safe)
-            h_in = xs_all[c, mb_safe]
-            _, pullback = jax.vjp(
-                lambda p, h: stage_and_loss(p, h, mb), p_c, h_in)
-            dy_cot = jnp.where(rank == P - 1, last_dy, dy_recv)
-            dy_cot = jnp.where(active & ~is_last, dy_cot,
-                               jnp.zeros_like(dy_cot)).astype(dtype)
-            loss_cot = jnp.where(active & is_last,
-                                 jnp.asarray(grad_scale, jnp.float32), 0.0)
-            dparams, dh = pullback((dy_cot, loss_cot))
-            grads_acc = jax.tree_util.tree_map(
-                lambda a, d: a.at[c].add(
-                    jnp.where(active, d.astype(jnp.float32), 0.0)),
-                grads_acc, dparams)
-            new_dboundary = lax.dynamic_update_index_in_dim(
-                new_dboundary,
-                jnp.where(active & (rank == 0), dh.astype(dtype),
-                          new_dboundary[mb_safe]),
-                mb_safe, 0)
-            dx_prev = jnp.where(active, dh, jnp.zeros_like(dh)).astype(dtype)
-            return grads_acc, dx_prev, new_dboundary
-
-        grads, _, dboundary = lax.fori_loop(
-            0, ticks, bwd_tick, (grads, zero_h, jnp.zeros_like(dboundary)))
-
-    n = jnp.asarray(M, jnp.float32)
-    grads = jax.tree_util.tree_map(lambda g: g / n, grads)
-    return losses_total, grads
+    if num_microbatches % P != 0:
+        # reference fwd_bwd_pipelining_with_interleaving.py asserts
+        # num_microbatches % pipeline_parallel_size == 0
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches "
+            f"({num_microbatches}) to be a multiple of "
+            f"pipeline_model_parallel_size ({P})")
+    return _pipelined_fwd_bwd(
+        forward_step_func, loss_func, params, microbatches,
+        M=num_microbatches, V=V, P=P, tensor_shape=tensor_shape,
+        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale)

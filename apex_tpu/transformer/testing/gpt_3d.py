@@ -25,6 +25,19 @@ from apex_tpu.transformer.tensor_parallel.layers import (
 )
 
 
+def boundary_tensor_shape(cfg, mesh, seq, microbatch):
+    """Per-device activation shape crossing pipeline-stage boundaries:
+    [s(/tp under SP), mb, h]. Under sequence parallelism the ppermute
+    payload is the *seq shard*, i.e. 1/tp of the full activation — the
+    layout-level realization of the reference's p2p scatter-gather
+    compression (p2p_communication.py:117-400 splits the tensor over the
+    TP group before isend and all-gathers after irecv; sharding makes
+    that the resting state, no extra collectives)."""
+    seq_shard = seq // mesh.shape.get("tp", 1) if cfg.sequence_parallel \
+        else seq
+    return (seq_shard, microbatch, cfg.hidden_size)
+
+
 def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
                          num_microbatches):
     """Return ``(init_state, step)`` for a pipelined GPT training loop.
@@ -50,10 +63,7 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
             "harness; use transformer.testing.gpt_moe (dp x ep x tp)")
     stage = GPTStage(cfg, cfg.num_layers // pp)
     MB, M = microbatch, num_microbatches
-    # Activations crossing stage boundaries: [s(/tp under SP), mb, h]
-    seq_shard = seq // mesh.shape.get("tp", 1) if cfg.sequence_parallel \
-        else seq
-    tensor_shape = (seq_shard, MB, cfg.hidden_size)
+    tensor_shape = boundary_tensor_shape(cfg, mesh, seq, microbatch)
 
     def stage_fn(params, h, mb, is_first):
         return stage.apply({"params": params}, mb["tokens"], h, is_first)

@@ -16,9 +16,15 @@
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
-BASELINE.json.
+BASELINE.json; the honest absolute metric is the roofline: every bench
+also reports achieved ``tflops_per_sec`` (model FLOPs / step time, PaLM
+appendix-B convention — 6N per token plus 12*L*h*s attention, no causal
+discount) and ``mfu`` = achieved / peak. Peak defaults to the measured
+154 bf16 TFLOP/s of this chip (PERF.md); override via
+APEX_TPU_PEAK_TFLOPS.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", "tflops_per_sec", "mfu"}.
 """
 
 import functools
@@ -56,6 +62,44 @@ def _arm_watchdog():
     t = threading.Timer(budget, fire)
     t.daemon = True
     t.start()
+
+
+PEAK_TFLOPS = float(os.environ.get("APEX_TPU_PEAK_TFLOPS", "154"))
+
+
+def _transformer_fwd_flops_per_token(cfg, seq):
+    """Forward model-FLOPs per token: 2 FLOPs per matmul parameter
+    touched (qkv/out/ffn/vocab head; MoE counts top_k experts only)
+    plus the 4*s*h*L attention matmuls (PaLM MFU convention: full
+    matmul, no causal discount)."""
+    h, L = cfg.hidden_size, cfg.num_layers
+    ffn = cfg.ffn_hidden_size or 4 * h
+    heads = cfg.num_attention_heads
+    groups = cfg.num_query_groups or heads
+    kv_h = h * groups // heads
+    attn_params = h * h + 2 * h * kv_h + h * h  # q, k+v, out projections
+    ffn_mults = 3 if cfg.activation == "swiglu" else 2
+    dense_ffn = ffn_mults * h * ffn
+    if cfg.num_moe_experts:
+        moe_layers = L // cfg.moe_layer_freq
+        moe_ffn = cfg.moe_top_k * dense_ffn + h * cfg.num_moe_experts
+        ffn_total = moe_layers * moe_ffn + (L - moe_layers) * dense_ffn
+    else:
+        ffn_total = L * dense_ffn
+    matmul_params = L * attn_params + ffn_total + h * cfg.vocab_size
+    return 2 * matmul_params + 4 * seq * h * L
+
+
+def _emit(metric, value, unit, flops_per_step, steps, dt):
+    tflops = flops_per_step * steps / dt / 1e12
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": 1.0,
+        "tflops_per_sec": round(tflops, 2),
+        "mfu": round(tflops / PEAK_TFLOPS, 4),
+    }))
 
 
 def _time_steps(train_step, state, steps, loss_index):
@@ -117,12 +161,9 @@ def bench_bert(batch, steps):
 
     dt, _ = _time_steps(train_step, (params, opt_state), steps,
                         loss_index=2)
-    print(json.dumps({
-        "metric": "bert_large_fused_lamb_samples_per_sec_per_chip",
-        "value": round(batch * steps / dt, 2),
-        "unit": "samples/sec",
-        "vs_baseline": 1.0,
-    }))
+    flops = 3 * batch * seq * _transformer_fwd_flops_per_token(cfg, seq)
+    _emit("bert_large_fused_lamb_samples_per_sec_per_chip",
+          batch * steps / dt, "samples/sec", flops, steps, dt)
 
 
 def bench_gpt_long(seq, steps):
@@ -159,12 +200,9 @@ def bench_gpt_long(seq, steps):
 
     dt, _ = _time_steps(train_step, (params, opt_state), steps,
                         loss_index=2)
-    print(json.dumps({
-        "metric": f"gpt_long_context_seq{seq}_tokens_per_sec_per_chip",
-        "value": round(seq * steps / dt, 2),
-        "unit": "tokens/sec",
-        "vs_baseline": 1.0,
-    }))
+    flops = 3 * seq * _transformer_fwd_flops_per_token(cfg, seq)
+    _emit(f"gpt_long_context_seq{seq}_tokens_per_sec_per_chip",
+          seq * steps / dt, "tokens/sec", flops, steps, dt)
 
 
 def bench_llama(batch, steps):
@@ -204,12 +242,9 @@ def bench_llama(batch, steps):
 
     dt, _ = _time_steps(train_step, (params, opt_state), steps,
                         loss_index=2)
-    print(json.dumps({
-        "metric": "llama_style_gpt_tokens_per_sec_per_chip",
-        "value": round(batch * seq * steps / dt, 2),
-        "unit": "tokens/sec",
-        "vs_baseline": 1.0,
-    }))
+    flops = 3 * batch * seq * _transformer_fwd_flops_per_token(cfg, seq)
+    _emit("llama_style_gpt_tokens_per_sec_per_chip",
+          batch * seq * steps / dt, "tokens/sec", flops, steps, dt)
 
 
 def bench_decode(batch, steps):
@@ -240,12 +275,12 @@ def bench_decode(batch, steps):
     out = generate(model, params, prompt, max_new_tokens=steps)
     int(out[0, -1])  # host fetch = completion barrier
     dt = time.perf_counter() - t0
-    print(json.dumps({
-        "metric": "llama_style_decode_tokens_per_sec_per_chip",
-        "value": round(batch * steps / dt, 2),
-        "unit": "tokens/sec",
-        "vs_baseline": 1.0,
-    }))
+    # fwd-only; attention reads an average KV length of prefill + half
+    # the generated span (prefill flops uncounted — slight understate)
+    flops = batch * steps * _transformer_fwd_flops_per_token(
+        cfg, 128 + steps // 2)
+    _emit("llama_style_decode_tokens_per_sec_per_chip",
+          batch * steps / dt, "tokens/sec", flops, 1, dt)
 
 
 def bench_moe(batch, steps):
@@ -288,12 +323,9 @@ def bench_moe(batch, steps):
 
     dt, _ = _time_steps(train_step, (params, opt_state), steps,
                         loss_index=2)
-    print(json.dumps({
-        "metric": "gpt_moe_8expert_tokens_per_sec_per_chip",
-        "value": round(batch * seq * steps / dt, 2),
-        "unit": "tokens/sec",
-        "vs_baseline": 1.0,
-    }))
+    flops = 3 * batch * seq * _transformer_fwd_flops_per_token(cfg, seq)
+    _emit("gpt_moe_8expert_tokens_per_sec_per_chip",
+          batch * seq * steps / dt, "tokens/sec", flops, steps, dt)
 
 
 def main():
@@ -383,12 +415,9 @@ def main():
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * steps / dt
-    print(json.dumps({
-        "metric": "resnet50_amp_o2_fused_adam_imgs_per_sec_per_chip",
-        "value": round(imgs_per_sec, 2),
-        "unit": "imgs/sec",
-        "vs_baseline": 1.0,
-    }))
+    # ResNet-50 fwd ~4.09 GFLOPs/image at 224x224; train = 3x fwd
+    _emit("resnet50_amp_o2_fused_adam_imgs_per_sec_per_chip",
+          imgs_per_sec, "imgs/sec", 3 * 4.09e9 * batch, steps, dt)
 
 
 if __name__ == "__main__":

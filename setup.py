@@ -33,4 +33,7 @@ setup(
     ext_modules=ext_modules,
     python_requires=">=3.10",
     install_requires=["jax", "flax", "numpy", "einops"],
+    # pytest.ini sets "-n auto", so the suite needs xdist present
+    extras_require={"test": ["pytest", "pytest-xdist", "optax", "orbax",
+                             "chex", "torch", "transformers"]},
 )

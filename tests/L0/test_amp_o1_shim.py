@@ -1,0 +1,132 @@
+"""amp O1 universal op interception via the shim namespaces.
+
+Mirrors reference tests/L0/run_amp/test_basic_casts.py +
+test_promotion.py: user code written against ``apex_tpu.amp.jnp`` (instead
+of ``jax.numpy``) gets white-list ops in bf16, black-list ops in fp32 and
+promote ops in the widest input dtype once ``amp.initialize(...,
+opt_level="O1")`` has run — without decorating anything (reference
+amp/amp.py:74-183 namespace patching; cast lists amp/lists/).
+"""
+
+import jax
+import jax.numpy as real_jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import jnp as ajnp
+from apex_tpu.amp import lax as alax
+from apex_tpu.amp import nn as ann
+from apex_tpu.amp.policy import DtypePolicy, set_global_policy
+
+
+@pytest.fixture(autouse=True)
+def _reset_policy():
+    yield
+    set_global_policy(DtypePolicy(enabled=False))
+
+
+def plain_flax_style_model(params, x):
+    """A user model written against the shim: two matmuls, a gelu, a
+    softmax head and a cross-entropy-ish loss — no apex_tpu layers, no
+    decorators."""
+    h = ajnp.matmul(x, params["w1"])
+    h = ann.gelu(h)
+    h = ajnp.matmul(h, params["w2"])
+    p = ann.log_softmax(h)
+    return h, p, -ajnp.mean(ajnp.sum(p * params["onehot"], axis=-1))
+
+
+def _params(rng):
+    return {
+        "w1": real_jnp.asarray(rng.randn(16, 32), real_jnp.float32),
+        "w2": real_jnp.asarray(rng.randn(32, 8), real_jnp.float32),
+        "onehot": real_jnp.asarray(np.eye(8)[rng.randint(0, 8, 4)],
+                                   real_jnp.float32),
+    }
+
+
+class TestO1Interception:
+    def test_disabled_passthrough(self, rng):
+        params = _params(rng)
+        x = real_jnp.asarray(rng.randn(4, 16), real_jnp.float32)
+        h, p, loss = plain_flax_style_model(params, x)
+        assert h.dtype == real_jnp.float32
+        assert p.dtype == real_jnp.float32
+        assert loss.dtype == real_jnp.float32
+
+    def test_o1_casts_user_ops(self, rng):
+        params = _params(rng)
+        x = real_jnp.asarray(rng.randn(4, 16), real_jnp.float32)
+        amp.initialize(params, None, opt_level="O1", verbosity=0)
+        h, p, loss = plain_flax_style_model(params, x)
+        # white list: matmuls ran (and produced) bf16
+        assert h.dtype == real_jnp.bfloat16
+        # black list: softmax + loss chain in fp32
+        assert p.dtype == real_jnp.float32
+        assert loss.dtype == real_jnp.float32
+
+    def test_o1_under_jit(self, rng):
+        params = _params(rng)
+        x = real_jnp.asarray(rng.randn(4, 16), real_jnp.float32)
+        amp.initialize(params, None, opt_level="O1", verbosity=0)
+        h, p, loss = jax.jit(plain_flax_style_model)(params, x)
+        assert h.dtype == real_jnp.bfloat16
+        assert p.dtype == real_jnp.float32
+        assert real_jnp.isfinite(loss)
+
+    def test_o0_does_not_enable_shim(self, rng):
+        params = _params(rng)
+        x = real_jnp.asarray(rng.randn(4, 16), real_jnp.float32)
+        amp.initialize(params, None, opt_level="O0", verbosity=0)
+        h, _, _ = plain_flax_style_model(params, x)
+        assert h.dtype == real_jnp.float32
+
+    def test_autocast_block_overrides(self, rng):
+        x = real_jnp.asarray(rng.randn(4, 16), real_jnp.float32)
+        w = real_jnp.asarray(rng.randn(16, 16), real_jnp.float32)
+        with amp.autocast():
+            assert ajnp.matmul(x, w).dtype == real_jnp.bfloat16
+        assert ajnp.matmul(x, w).dtype == real_jnp.float32
+
+    def test_float_list_upcasts_bf16_inputs(self, rng):
+        xb = real_jnp.asarray(rng.randn(4, 8), real_jnp.bfloat16)
+        with amp.autocast():
+            assert ajnp.sum(xb).dtype == real_jnp.float32
+            assert ajnp.exp(xb).dtype == real_jnp.float32
+            assert ann.softmax(xb).dtype == real_jnp.float32
+
+    def test_promote_mixed_dtypes(self, rng):
+        a = real_jnp.asarray(rng.randn(4, 8), real_jnp.bfloat16)
+        b = real_jnp.asarray(rng.randn(4, 8), real_jnp.float32)
+        with amp.autocast():
+            assert ajnp.add(a, b).dtype == real_jnp.float32
+            assert ajnp.concatenate([a, b]).dtype == real_jnp.float32
+
+    def test_lax_conv_half(self, rng):
+        x = real_jnp.asarray(rng.randn(2, 8, 8, 3), real_jnp.float32)
+        k = real_jnp.asarray(rng.randn(3, 3, 3, 4), real_jnp.float32)
+        with amp.autocast():
+            y = alax.conv_general_dilated(
+                x, k, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert y.dtype == real_jnp.bfloat16
+
+    def test_unlisted_ops_forwarded(self):
+        # the shim tracks jax.numpy's surface for everything unlisted
+        assert ajnp.arange(4).dtype == real_jnp.arange(4).dtype
+        assert ajnp.pi == real_jnp.pi
+        np.testing.assert_array_equal(
+            np.asarray(ajnp.tril(real_jnp.ones((3, 3)))),
+            np.tril(np.ones((3, 3))))
+
+    def test_grads_flow_through_shim(self, rng):
+        params = _params(rng)
+        x = real_jnp.asarray(rng.randn(4, 16), real_jnp.float32)
+        amp.initialize(params, None, opt_level="O1", verbosity=0)
+        grads = jax.grad(
+            lambda p: plain_flax_style_model(p, x)[2])(params)
+        for leaf in jax.tree_util.tree_leaves(grads):
+            assert np.isfinite(np.asarray(leaf, np.float32)).all()
+        # master-grad dtype preserved: grads of fp32 params come back fp32
+        assert grads["w1"].dtype == real_jnp.float32

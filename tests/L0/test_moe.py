@@ -74,7 +74,8 @@ class TestRouting:
 
     def test_capacity_rounding(self):
         assert expert_capacity(1024, 8, 1, 1.25) == 160
-        assert expert_capacity(16, 8, 1, 1.0) == 2
+        # tiny raw capacities round up to the TPU lane multiple too
+        assert expert_capacity(16, 8, 1, 1.0) == 8
 
 
 class TestExpertChoiceRouting:
