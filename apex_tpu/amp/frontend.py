@@ -155,6 +155,11 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
     """
     _amp_state.verbosity = verbosity
     if not enabled:
+        # a previously-armed O1 global policy must not leak into a
+        # disabled (fp32 control) run
+        from apex_tpu.amp import policy as _policy
+
+        _policy.set_global_policy(_policy.DtypePolicy(enabled=False))
         return models, optimizers
 
     if opt_level not in opt_levels:

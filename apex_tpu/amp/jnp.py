@@ -17,6 +17,14 @@ convs/dots).
 
 Everything not explicitly wrapped is forwarded verbatim via module
 ``__getattr__``, so the shim tracks jax.numpy's full surface.
+
+Ordering requirement: the policy is consulted at *trace* time, and jit
+caches traces. Call ``amp.initialize`` (or enter ``amp.autocast``)
+BEFORE the first call of any jitted function that uses the shim — a
+function traced while the policy was disabled keeps its fp32 trace on
+later cache hits (the reference's runtime patching has the mirror-image
+hazard: ops bound before ``amp.init`` keep their unpatched references,
+apex/amp/amp.py docs).
 """
 
 import jax.numpy as _jnp

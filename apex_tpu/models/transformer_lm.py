@@ -62,6 +62,13 @@ class TransformerConfig:
     # get a leading [num_layers] axis under 'layers'; requires a uniform
     # stack (with MoE: moe_layer_freq == 1).
     scan_layers: bool = False
+    # Per-layer activation recompute (reference tensor_parallel/random.py
+    # checkpoint). ON by default for the reference's memory profile; turn
+    # OFF when the model fits HBM without it — backward then reuses the
+    # forward's activations instead of re-running every layer (~25-30%
+    # fewer executed FLOPs per train step, the single biggest single-chip
+    # MFU lever at GPT-2-345M scale).
+    activation_checkpointing: bool = True
     # Mixture-of-experts (no reference equivalent; SURVEY.md §2.3 note).
     # None -> dense ParallelMLP everywhere. Every ``moe_layer_freq``-th
     # layer (starting at layer 0) becomes a SwitchMLP with this many
@@ -518,20 +525,24 @@ class ParallelTransformer(nn.Module):
 
     config: TransformerConfig
     num_layers: Optional[int] = None
-    activation_checkpointing: bool = True
+    # None -> follow config.activation_checkpointing
+    activation_checkpointing: Optional[bool] = None
     decode: bool = False
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
         n = self.num_layers if self.num_layers is not None else cfg.num_layers
+        remat_on = (cfg.activation_checkpointing
+                    if self.activation_checkpointing is None
+                    else self.activation_checkpointing)
         if cfg.scan_layers:
             if cfg.num_moe_experts is not None and cfg.moe_layer_freq != 1:
                 raise ValueError(
                     "scan_layers needs a uniform stack: moe_layer_freq "
                     "must be 1 (every layer MoE) or num_moe_experts None")
             block = _ScanBlock
-            if self.activation_checkpointing and not self.decode:
+            if remat_on and not self.decode:
                 block = nn.remat(block, static_argnums=(),
                                  prevent_cse=False)
             scanned = nn.scan(
@@ -546,7 +557,7 @@ class ParallelTransformer(nn.Module):
                 hidden_states, attention_mask, position_ids)
             return h
         layer = ParallelTransformerLayer
-        if self.activation_checkpointing and not self.decode:
+        if remat_on and not self.decode:
             layer = nn.checkpoint(ParallelTransformerLayer,
                                   static_argnums=())
         for i in range(n):

@@ -7,6 +7,9 @@
                                            samples/sec/chip
     python bench.py gpt [seq] [steps]      long-context GPT (16x1024,
                                            flash attention) tokens/sec/chip
+    python bench.py gpt2 [batch] [steps]   GPT-2 345M tokens/sec/chip + MFU
+                                           (flags: APEX_TPU_GPT2_FLASH=0,
+                                           APEX_TPU_GPT2_SCAN=1)
     python bench.py moe [batch] [steps]    MoE GPT (8 experts top-1, every
                                            other layer) tokens/sec/chip
     python bench.py llama [batch] [steps]  Llama-style GPT (RoPE + GQA +
@@ -81,7 +84,8 @@ def _transformer_fwd_flops_per_token(cfg, seq):
     ffn_mults = 3 if cfg.activation == "swiglu" else 2
     dense_ffn = ffn_mults * h * ffn
     if cfg.num_moe_experts:
-        moe_layers = L // cfg.moe_layer_freq
+        # layers 0, freq, 2*freq, ... are MoE -> ceil(L / freq) of them
+        moe_layers = -(-L // cfg.moe_layer_freq)
         moe_ffn = cfg.moe_top_k * dense_ffn + h * cfg.num_moe_experts
         ffn_total = moe_layers * moe_ffn + (L - moe_layers) * dense_ffn
     else:
@@ -278,9 +282,86 @@ def bench_decode(batch, steps):
     # fwd-only; attention reads an average KV length of prefill + half
     # the generated span (prefill flops uncounted — slight understate)
     flops = batch * steps * _transformer_fwd_flops_per_token(
-        cfg, 128 + steps // 2)
+        cfg, prompt.shape[1] + steps // 2)
     _emit("llama_style_decode_tokens_per_sec_per_chip",
           batch * steps / dt, "tokens/sec", flops, 1, dt)
+
+
+def bench_gpt2(batch, steps, *, flash=None, scan=None, remat=None,
+               loss="vocab_ce", tiny=False, emit=True):
+    """GPT-2 345M (24x1024, 16 heads, vocab 50304, seq 1024) single-chip
+    training throughput + MFU — the flagship tokens/sec target
+    (BASELINE.json config 5 model at tp=1; VERDICT r1 item 6 asks this
+    MFU pushed toward >=0.5). Also the engine for tools/mfu_sweep.py
+    (kwargs override the env-default knobs; ``tiny`` is the CPU smoke
+    config). Per-layer activation recompute defaults OFF here — 345M at
+    batch 8 fits HBM, and remat re-executes the whole forward in
+    backward (~25-30% of step FLOPs); set APEX_TPU_GPT2_REMAT=1 if a
+    memory-limited config needs it back.
+    """
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.models.gpt import gpt_loss_fn
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+
+    if flash is None:
+        flash = os.environ.get("APEX_TPU_GPT2_FLASH", "1") == "1"
+    if scan is None:
+        scan = os.environ.get("APEX_TPU_GPT2_SCAN", "0") == "1"
+    if remat is None:
+        remat = os.environ.get("APEX_TPU_GPT2_REMAT", "0") == "1"
+    parallel_state.destroy_model_parallel()
+    seq = 64 if tiny else 1024
+    cfg = TransformerConfig(
+        hidden_size=64 if tiny else 1024,
+        num_layers=2 if tiny else 24,
+        num_attention_heads=4 if tiny else 16,
+        vocab_size=256 if tiny else 50304,
+        max_position_embeddings=seq,
+        compute_dtype=jnp.bfloat16,
+        use_flash_attention=flash and not tiny,
+        scan_layers=scan,
+        activation_checkpointing=remat)
+    model = GPTModel(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    if loss == "xent":
+        from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            return jnp.mean(softmax_cross_entropy_loss(
+                logits.reshape(-1, cfg.vocab_size), labels.reshape(-1),
+                padding_idx=None, half_to_float=True))
+    else:
+        def loss_fn(p):
+            return gpt_loss_fn(model.apply({"params": p}, tokens), labels)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state):
+        loss_v, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss_v
+
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
+    flops = 3 * batch * seq * _transformer_fwd_flops_per_token(cfg, seq)
+    tflops = flops * steps / dt / 1e12
+    result = {
+        "tokens_per_sec": round(batch * seq * steps / dt, 1),
+        "ms_per_step": round(dt / steps * 1e3, 2),
+        "tflops_per_sec": round(tflops, 2),
+        "mfu": round(tflops / PEAK_TFLOPS, 4),
+    }
+    if emit:
+        _emit("gpt2_345m_tokens_per_sec_per_chip",
+              batch * seq * steps / dt, "tokens/sec", flops, steps, dt)
+    return result
 
 
 def bench_moe(batch, steps):
@@ -328,8 +409,54 @@ def bench_moe(batch, steps):
           batch * seq * steps / dt, "tokens/sec", flops, steps, dt)
 
 
+def _require_backend(attempts=3, probe_timeout=240, retry_wait=60):
+    """Bounded TPU-backend probe with retries (VERDICT r1 item 2: fail
+    with a clear JSON error instead of blocking for the whole watchdog
+    budget when the tunnel is wedged). Probes in a subprocess so a hung
+    backend init never blocks this process; killing an *init* probe is
+    safe (the round-1 wedge came from killing a compile, not an init)."""
+    import subprocess
+
+    if os.environ.get("APEX_TPU_SKIP_BACKEND_PROBE") == "1":
+        return  # sweep runners set this after their first healthy run
+    allow_cpu = os.environ.get("APEX_TPU_BENCH_ALLOW_CPU") == "1"
+    err = ""
+    for attempt in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print('PLATS', sorted({x.platform for x in d}))"],
+                capture_output=True, text=True, timeout=probe_timeout)
+            if out.returncode == 0 and "PLATS" in out.stdout:
+                import ast
+
+                plats = ast.literal_eval(
+                    out.stdout.split("PLATS", 1)[1].strip())
+                if allow_cpu or any(p != "cpu" for p in plats):
+                    return
+                # accelerator plugin fell back to CPU: a wedged tunnel
+                # must NOT silently produce CPU numbers labeled as chip
+                # MFU (set APEX_TPU_BENCH_ALLOW_CPU=1 to permit)
+                err = f"only CPU devices available ({plats})"
+            else:
+                err = (out.stderr or out.stdout).strip()[-300:]
+        except subprocess.TimeoutExpired:
+            err = f"backend init exceeded {probe_timeout}s"
+        if attempt + 1 < attempts:
+            time.sleep(retry_wait)
+    print(json.dumps({
+        "metric": "bench_error", "value": 0, "unit": "error",
+        "vs_baseline": 0.0,
+        "error": f"TPU backend unavailable after {attempts} probes "
+                 f"(tunnel wedged?): {err}",
+    }), flush=True)
+    sys.exit(2)
+
+
 def main():
     _arm_watchdog()
+    _require_backend()
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import FusedAdam
@@ -342,6 +469,10 @@ def main():
         seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
         return bench_gpt_long(seq, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "gpt2":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+        return bench_gpt2(batch, steps)
     if len(sys.argv) > 1 and sys.argv[1] == "moe":
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15

@@ -8,6 +8,7 @@ be identical given the same weights.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu.models.transformer_lm import (
     ParallelTransformer,
@@ -129,3 +130,39 @@ def test_scan_gpt_model_trains():
     assert np.isfinite(float(loss))
     assert float(jnp.abs(
         jax.tree_util.tree_leaves(g["transformer"])[0]).sum()) > 0
+
+
+def test_activation_checkpointing_off_matches_on(rng):
+    """cfg.activation_checkpointing only changes the memory/compute
+    schedule (VERDICT r1 item 6 MFU lever), never the math: loss and
+    grads must match with remat on vs off."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.models.gpt import gpt_loss_fn
+
+    cfg_on = TransformerConfig(
+        hidden_size=32, num_layers=2, num_attention_heads=2,
+        vocab_size=64, max_position_embeddings=16,
+        compute_dtype=jnp.float32, use_flash_attention=False,
+        activation_checkpointing=True)
+    cfg_off = dataclasses.replace(cfg_on, activation_checkpointing=False)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 16)))
+    labels = jnp.asarray(rng.randint(0, 64, (2, 16)))
+    params = GPTModel(cfg_on).init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def lg(cfg):
+        model = GPTModel(cfg)
+        return jax.value_and_grad(lambda p: gpt_loss_fn(
+            model.apply({"params": p}, tokens), labels))(params)
+
+    loss_on, g_on = lg(cfg_on)
+    loss_off, g_off = lg(cfg_off)
+    assert float(loss_on) == pytest.approx(float(loss_off), rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_on),
+                    jax.tree_util.tree_leaves(g_off)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
