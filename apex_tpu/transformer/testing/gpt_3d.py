@@ -18,7 +18,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.models.gpt_stage import GPTStage
 from apex_tpu.models.transformer_lm import is_sequence_parallel_param
 from apex_tpu.transformer.pipeline_parallel.schedules import (
-    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
 )
 from apex_tpu.transformer.tensor_parallel.layers import (
     allreduce_sequence_parallel_grads,
@@ -39,7 +39,7 @@ def boundary_tensor_shape(cfg, mesh, seq, microbatch):
 
 
 def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
-                         num_microbatches):
+                         num_microbatches, vpp=None):
     """Return ``(init_state, step)`` for a pipelined GPT training loop.
 
     ``init_state(key, tokens, labels)`` builds per-stage stacked params,
@@ -50,6 +50,12 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
 
     ``tokens``/``labels`` are [global_batch, seq] with
     global_batch = microbatch * num_microbatches * dp.
+
+    ``vpp``: virtual-pipeline chunks per rank (interleaved 1F1B). Rank r
+    holds chunks c with global stage c*pp + r; per-rank param leaves get
+    a leading [vpp] axis and the step runs
+    ``forward_backward_pipelining_with_interleaving`` (reference
+    build_model virtual-chunk support, common.py:30-151).
     """
     if cfg.num_moe_experts is not None:
         # Two unsolved compositions: (a) stage-local layer numbering means
@@ -61,7 +67,12 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
         raise ValueError(
             "MoE (num_moe_experts) is not supported under the pipelined "
             "harness; use transformer.testing.gpt_moe (dp x ep x tp)")
-    stage = GPTStage(cfg, cfg.num_layers // pp)
+    V = vpp or 1
+    if cfg.num_layers % (pp * V):
+        raise ValueError(
+            f"num_layers ({cfg.num_layers}) must be a multiple of "
+            f"pp*vpp ({pp * V})")
+    stage = GPTStage(cfg, cfg.num_layers // (pp * V))
     MB, M = microbatch, num_microbatches
     tensor_shape = boundary_tensor_shape(cfg, mesh, seq, microbatch)
 
@@ -77,10 +88,12 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
                "labels": labels.reshape(M, MB, seq)}
         # scale the loss up by the live scale; unscale_grads divides it
         # back out (and pmaxes found_inf over tp x pp)
-        losses, grads = forward_backward_pipelining_without_interleaving(
+        # V=1 falls through to the non-interleaved schedule inside
+        losses, grads = forward_backward_pipelining_with_interleaving(
             stage_fn, loss_fn, params, mbs, num_microbatches=M,
             tensor_shape=tensor_shape, dtype=jnp.bfloat16,
-            grad_scale=scaler_state.loss_scale, pp_size=pp)
+            grad_scale=scaler_state.loss_scale, pp_size=pp,
+            num_model_chunks=V)
         # DP gradient sync (DDP semantics: average over the dp axis).
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.pmean(g, "dp"), grads)
@@ -115,12 +128,21 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
                        check_vma=False)
     def init_params(key, tok, lab):
         rank = jax.lax.axis_index("pp")
-        key = jax.random.fold_in(key, rank)
         h0 = jnp.zeros(tensor_shape, jnp.bfloat16)
-        variables = stage.init(key, tok[:MB], h0, jnp.asarray(False),
-                               lab[:MB], method=GPTStage.full)
-        return jax.tree_util.tree_map(lambda a: a[None],
-                                      variables["params"])
+
+        def init_chunk(c):
+            # chunk c on rank r is global stage c*pp + r
+            k = jax.random.fold_in(key, c * pp + rank)
+            return stage.init(k, tok[:MB], h0, jnp.asarray(False),
+                              lab[:MB], method=GPTStage.full)["params"]
+
+        if V > 1:
+            chunks = [init_chunk(c) for c in range(V)]
+            params = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *chunks)
+        else:
+            params = init_chunk(0)
+        return jax.tree_util.tree_map(lambda a: a[None], params)
 
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("pp"),
                        out_specs=P("pp"), check_vma=False)

@@ -66,3 +66,41 @@ def test_gpt_3d_parallel_training_loss_decreases(gpt_setup):
     assert losses[-1] < 0.7 * losses[0], losses
     # and monotonic-ish: the minimum is at the end half
     assert min(losses[6:]) < min(losses[:6])
+
+
+def test_gpt_3d_interleaved_vpp_training_loss_decreases():
+    """Same 3D harness with virtual pipelining (vpp=2): 8 layers as 4
+    global stages (2 chunks x 2 ranks), interleaved 1F1B. The real-model
+    integration of forward_backward_pipelining_with_interleaving
+    (reference test_pipeline_parallel_fwd_bwd.py virtual-chunk cases)."""
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=TP, pipeline_model_parallel_size_=PP,
+        virtual_pipeline_model_parallel_size_=2,
+        devices=jax.devices()[:8])
+    V = 2
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2 * PP * V, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=32,
+        compute_dtype=jnp.bfloat16, sequence_parallel=True,
+        use_flash_attention=False)
+    global_b = MB * M * DP
+    rng = np.random.RandomState(0)
+    base = rng.randint(0, 32, size=(global_b, 1))
+    tokens = jnp.asarray((base + np.arange(SEQ)) % 32)
+    labels = jnp.asarray((base + np.arange(1, SEQ + 1)) % 32)
+
+    opt = FusedAdam(lr=5e-3, master_weights=True)
+    scaler = GradScaler(enabled=True)
+    init_state, step = build_gpt_3d_harness(
+        cfg, mesh, opt, scaler, pp=PP, seq=SEQ, microbatch=MB,
+        num_microbatches=M, vpp=V)
+
+    losses = []
+    state = init_state(jax.random.PRNGKey(0), tokens, labels)
+    for _ in range(12):
+        *state, loss = step(*state, tokens, labels)
+        losses.append(float(np.asarray(loss).sum()) / DP / M)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.7 * losses[0], losses
+    # teardown is the conftest autouse _reset_parallel_state fixture
