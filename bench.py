@@ -505,12 +505,15 @@ def main():
                                  verbosity=0)
     opt_state = opt.init(params)
 
-    # NOTE: no donation here — donating any of this step's buffers
-    # (params, batch_stats or opt_state, in any combination) trips an
-    # INVALID_ARGUMENT in the tunneled TPU backend and wedges the device
-    # session; the BERT bench's donation works fine. Revisit on a
-    # directly-attached runtime.
-    @jax.jit
+    # NOTE: no donation by default — donating any of this step's buffers
+    # (params, batch_stats or opt_state, in any combination) tripped an
+    # INVALID_ARGUMENT in the tunneled TPU backend and wedged the device
+    # session; the BERT bench's donation works fine (was +7% there).
+    # APEX_TPU_RESNET_DONATE=1 retries it on an updated runtime.
+    donate = (dict(donate_argnums=(0, 1, 2))
+              if os.environ.get("APEX_TPU_RESNET_DONATE") == "1" else {})
+
+    @functools.partial(jax.jit, **donate)
     def train_step(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
             logits, updates = model.apply(
