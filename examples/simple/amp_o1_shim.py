@@ -1,0 +1,62 @@
+"""Minimal amp O1 walkthrough with the universal op shim.
+
+The reference's O1 patches the torch namespaces at ``amp.initialize`` so
+*user* code gets automatic mixed-precision casts (apex/amp/amp.py:74-183).
+The TPU-native equivalent is an import swap: write your model against
+
+    from apex_tpu.amp import jnp, nn      # instead of jax.numpy / jax.nn
+
+and after ``amp.initialize(..., opt_level="O1")`` every white-listed op
+(matmul/einsum/convs) runs in bf16 on the MXU while black-listed ops
+(softmax, reductions, transcendentals) run in fp32 — no decorators, no
+model changes. Import the shim BEFORE jitting (casts are trace-time).
+
+Run:  PYTHONPATH=. python examples/simple/amp_o1_shim.py
+"""
+
+import jax
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.amp import jnp, nn
+from apex_tpu.optimizers import FusedSGD
+
+
+def model(params, x):
+    h = nn.gelu(jnp.matmul(x, params["w1"]))      # bf16 under O1
+    return jnp.matmul(h, params["w2"])            # bf16 under O1
+
+
+def main():
+    rng = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(rng.randn(16, 64) * 0.3, jnp.float32),
+              "w2": jnp.asarray(rng.randn(64, 8) * 0.3, jnp.float32)}
+    x = jnp.asarray(rng.randn(128, 16), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 8, 128))
+
+    # O1: params stay fp32, compute ops cast via the shim, dynamic loss
+    # scale (kept for API parity; near-no-op on bf16).
+    params, opt = amp.initialize(params, FusedSGD(lr=0.3), opt_level="O1")
+    opt_state = opt.init(params)
+
+    def loss_fn(p, s):
+        logits = model(p, x)
+        assert logits.dtype == jax.numpy.bfloat16  # white list applied
+        logp = nn.log_softmax(logits)              # fp32 (black list)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+        return opt.scale_loss(loss, s), loss       # scale-loss flow
+
+    @jax.jit
+    def step(p, s):
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, s)
+        new_p, new_s = opt.step(grads, s, p)       # unscale + skip-on-inf
+        return new_p, new_s, loss
+
+    for i in range(40):
+        params, opt_state, loss = step(params, opt_state)
+        if i % 10 == 0 or i == 39:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()
