@@ -163,9 +163,20 @@ def forward_backward_no_pipelining(forward_step_func, loss_func, params,
 
 def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
                        *, M, V, P, tensor_shape, dtype, axis_name,
-                       grad_scale):
+                       grad_scale, aux_loss=False):
     """Shared 3-phase tick machine for both pipelined schedules
-    (see pipeline_schedule_plan for the tick/unit mapping)."""
+    (see pipeline_schedule_plan for the tick/unit mapping).
+
+    ``aux_loss=True`` changes the stage contract to
+    ``forward_step_func(...) -> (output_tensor, aux_scalar)``: each
+    unit's backward injects its own stage's auxiliary loss (e.g. MoE
+    router load-balancing, scaled by grad_scale like the main loss)
+    alongside the downstream activation cotangent — total loss =
+    last-stage loss_func + sum of per-unit aux, with aux gradients
+    flowing to earlier stages through the regular backward wave. The
+    reported per-microbatch losses remain the last stage's (loss_func +
+    its own aux) only.
+    """
     plan = pipeline_schedule_plan(P, M, V)
     S = plan["stash"]
     PV, MV = P * V, M * V
@@ -210,17 +221,24 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
 
     zero_h = jnp.zeros(tensor_shape, dtype)
 
+    def run_stage(p, h, mb, is_first_u):
+        if aux_loss:
+            return forward_step_func(p, h, mb, is_first_u)
+        return (forward_step_func(p, h, mb, is_first_u),
+                jnp.zeros((), jnp.float32))
+
     def stage_and_maybe_loss(p, h, mb, is_first_u, is_last_u):
-        y = forward_step_func(p, h, mb, is_first_u)
+        y, aux = run_stage(p, h, mb, is_first_u)
         # Only the last global stage pays for loss_func (for GPT: the
         # vocab projection) — lax.cond skips it at runtime elsewhere, in
-        # both the primal and the transpose.
+        # both the primal and the transpose. Per-unit aux (module doc)
+        # rides the same loss output.
         loss = lax.cond(
             is_last_u,
             lambda op: loss_func(*op).astype(jnp.float32),
             lambda op: jnp.zeros((), jnp.float32),
             (p, y, mb))
-        return y, loss
+        return y, loss + aux.astype(jnp.float32)
 
     # state = (stash, y_prev, dx_prev, losses, grads)
     def fwd_half(t, state):
@@ -235,7 +253,7 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
             p_c = take_params(c)
             is_first_u = (rank == 0) & (c == 0)
             h_in = jnp.where(is_first_u, zero_h, recv).astype(dtype)
-            y = forward_step_func(p_c, h_in, mb, is_first_u)
+            y, _ = run_stage(p_c, h_in, mb, is_first_u)
             xs = lax.dynamic_update_index_in_dim(
                 xs, jnp.where(active, h_in, xs[slot]), slot, 0)
             y_prev = jnp.where(active, y, jnp.zeros_like(y))
@@ -263,7 +281,11 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
                                                   is_last_u), p_c, h_in)
             dy_cot = jnp.where(active & ~is_last_u, dy_recv,
                                jnp.zeros_like(dy_recv)).astype(dtype)
-            loss_cot = jnp.where(active & is_last_u,
+            # every active unit gets a loss cotangent: the main loss is
+            # cond-gated to the last stage (zero transpose elsewhere),
+            # while per-unit aux losses (if any) pick it up on their
+            # own stage
+            loss_cot = jnp.where(active,
                                  jnp.asarray(grad_scale, jnp.float32), 0.0)
             dp_c, dh = pullback((dy_cot, loss_cot))
             grads = add_grads(grads, dp_c, c, active)
@@ -295,6 +317,7 @@ def forward_backward_pipelining_without_interleaving(
         axis_name: str = PIPELINE_PARALLEL_AXIS,
         grad_scale: float = 1.0,
         pp_size: Optional[int] = None,
+        aux_loss: bool = False,
         **unused):
     """True 1F1B over the 'pp' axis in one jitted program (see module doc).
 
@@ -311,7 +334,8 @@ def forward_backward_pipelining_without_interleaving(
     return _pipelined_fwd_bwd(
         forward_step_func, loss_func, params, microbatches,
         M=num_microbatches, V=1, P=P, tensor_shape=tensor_shape,
-        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale)
+        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
+        aux_loss=aux_loss)
 
 
 def forward_backward_pipelining_with_interleaving(
@@ -319,7 +343,8 @@ def forward_backward_pipelining_with_interleaving(
         microbatches, *, num_microbatches: int, tensor_shape,
         dtype=jnp.float32, axis_name: str = PIPELINE_PARALLEL_AXIS,
         grad_scale: float = 1.0, pp_size: Optional[int] = None,
-        num_model_chunks: Optional[int] = None, **unused):
+        num_model_chunks: Optional[int] = None, aux_loss: bool = False,
+        **unused):
     """Interleaved (virtual-pipeline) 1F1B in one steady state.
 
     Parity target: fwd_bwd_pipelining_with_interleaving.py (516 LoC).
@@ -344,7 +369,7 @@ def forward_backward_pipelining_with_interleaving(
             forward_step_func, loss_func, params, microbatches,
             num_microbatches=num_microbatches, tensor_shape=tensor_shape,
             dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
-            pp_size=P)
+            pp_size=P, aux_loss=aux_loss)
     if num_microbatches % P != 0:
         # reference fwd_bwd_pipelining_with_interleaving.py asserts
         # num_microbatches % pipeline_parallel_size == 0
@@ -355,4 +380,5 @@ def forward_backward_pipelining_with_interleaving(
     return _pipelined_fwd_bwd(
         forward_step_func, loss_func, params, microbatches,
         M=num_microbatches, V=V, P=P, tensor_shape=tensor_shape,
-        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale)
+        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
+        aux_loss=aux_loss)

@@ -57,16 +57,24 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
     ``forward_backward_pipelining_with_interleaving`` (reference
     build_model virtual-chunk support, common.py:30-151).
     """
-    if cfg.num_moe_experts is not None:
-        # Two unsolved compositions: (a) stage-local layer numbering means
-        # MoE placement only matches pp=1 when layers_per_stage divides
-        # moe_layer_freq, and (b) this schedule computes grads from the
-        # last-stage loss alone, so earlier stages' router aux losses
-        # could not reach their own routers — training would silently run
-        # without load-balancing pressure. Refuse rather than misbehave.
+    moe = cfg.num_moe_experts is not None
+    if "ep" in mesh.shape and mesh.shape["ep"] > 1:
+        # This harness pmeans every grad over 'dp' alone; with an ep>1
+        # axis, dense params replicated across ep need the dense-over-
+        # (dp, ep) / expert-over-dp split sync (moe/layer.py:14-17,
+        # testing/gpt_moe.py) — replicas would silently diverge here.
         raise ValueError(
-            "MoE (num_moe_experts) is not supported under the pipelined "
-            "harness; use transformer.testing.gpt_moe (dp x ep x tp)")
+            "the pipelined harness does not support expert parallelism "
+            "(ep > 1); use transformer.testing.gpt_moe (dp x ep x tp)")
+    if moe and cfg.moe_layer_freq != 1:
+        # Stage-local layer numbering: each stage numbers its layers
+        # 0..layers_per_stage-1, so a global every-Nth-layer MoE pattern
+        # would silently shift per stage. A uniform stack (every layer
+        # MoE) is placement-invariant and composes; refuse the rest.
+        raise ValueError(
+            "MoE under the pipelined harness needs moe_layer_freq == 1 "
+            "(uniform stack); for sparse placement use "
+            "transformer.testing.gpt_moe (dp x ep x tp)")
     V = vpp or 1
     if cfg.num_layers % (pp * V):
         raise ValueError(
@@ -76,8 +84,20 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
     MB, M = microbatch, num_microbatches
     tensor_shape = boundary_tensor_shape(cfg, mesh, seq, microbatch)
 
-    def stage_fn(params, h, mb, is_first):
-        return stage.apply({"params": params}, mb["tokens"], h, is_first)
+    if moe:
+        from apex_tpu.transformer.moe import moe_loss_from_variables
+
+        def stage_fn(params, h, mb, is_first):
+            # router aux/z losses are per-stage; the schedule's aux_loss
+            # contract backprops them from each stage's own backward unit
+            y, mut = stage.apply({"params": params}, mb["tokens"], h,
+                                 is_first, mutable=["moe_losses"])
+            return y, moe_loss_from_variables(
+                mut, cfg.moe_aux_loss_coeff, cfg.moe_z_loss_coeff)
+    else:
+        def stage_fn(params, h, mb, is_first):
+            return stage.apply({"params": params}, mb["tokens"], h,
+                               is_first)
 
     def loss_fn(params, y, mb):
         return stage.apply({"params": params}, y, mb["labels"],
@@ -93,7 +113,7 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
             stage_fn, loss_fn, params, mbs, num_microbatches=M,
             tensor_shape=tensor_shape, dtype=jnp.bfloat16,
             grad_scale=scaler_state.loss_scale, pp_size=pp,
-            num_model_chunks=V)
+            num_model_chunks=V, aux_loss=moe)
         # DP gradient sync (DDP semantics: average over the dp axis).
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.pmean(g, "dp"), grads)
