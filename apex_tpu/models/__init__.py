@@ -16,7 +16,9 @@ from apex_tpu.models.gpt import GPTModel, gpt_loss_fn  # noqa: F401
 from apex_tpu.models.generation import (  # noqa: F401
     generate,
     init_cache,
+    init_params_tp,
     sample_logits,
+    tensor_parallel_generate,
 )
 from apex_tpu.models.bert import BertModel, bert_loss_fn  # noqa: F401
 from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401

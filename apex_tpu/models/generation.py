@@ -199,8 +199,9 @@ def beam_search(model, params, prompt_tokens, max_new_tokens: int,
 
     if get_tensor_model_parallel_world_size() > 1:
         raise NotImplementedError(
-            "beam_search() drives a tp=1 model; for tensor parallelism "
-            "run the decode step inside shard_map (see generate())")
+            "beam_search() drives a tp=1 model; for tensor-parallel "
+            "sampling/greedy decoding use tensor_parallel_generate() "
+            "(beam reordering under tp is not implemented)")
     cfg = model.config
     b, plen = prompt_tokens.shape
     if plen + max_new_tokens > cfg.max_position_embeddings:
@@ -212,6 +213,40 @@ def beam_search(model, params, prompt_tokens, max_new_tokens: int,
     cache = init_cache(model, b, prompt_tokens.dtype)
     best_seqs, best_scores = run(params, cache, prompt_tokens)
     return jnp.concatenate([prompt_tokens, best_seqs], axis=1), best_scores
+
+
+def _prep_decode(fn_name, model, prompt_tokens, max_new_tokens, rng,
+                 temperature, top_k, top_p, eos_token_id, pad_token_id):
+    """Shared validation + compile for generate()/tensor_parallel_generate:
+    returns (prefill, decode_all, rng)."""
+    if not getattr(model, "decode", False):
+        raise ValueError(f"{fn_name}() needs a model built with "
+                         f"decode=True")
+    cfg = model.config
+    plen = prompt_tokens.shape[1]
+    if plen + max_new_tokens > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings ({cfg.max_position_embeddings})")
+    if rng is None:
+        temperature = 0.0
+        rng = jax.random.PRNGKey(0)
+    prefill, decode_all = _compiled(
+        model, plen, max_new_tokens, float(temperature), top_k, top_p,
+        eos_token_id, pad_token_id)
+    return prefill, decode_all, rng
+
+
+def _prefill_and_decode(prefill, decode_all, model, params, prompt_tokens,
+                        rng):
+    """One prefill + scan-decode pass; returns the generated [b, new]."""
+    b, plen = prompt_tokens.shape
+    cache = init_cache(model, b, prompt_tokens.dtype)
+    cache, last_logits = prefill(params, cache, prompt_tokens)
+    init = (cache, last_logits, jnp.asarray(plen, jnp.int32), rng,
+            jnp.zeros((b,), bool))
+    _, out = decode_all(params, init)  # [max_new, b]
+    return out.T
 
 
 def generate(model, params, prompt_tokens, max_new_tokens: int, *,
@@ -226,36 +261,80 @@ def generate(model, params, prompt_tokens, max_new_tokens: int, *,
     ``rng`` is None or ``temperature == 0``. Prompts must be unpadded
     (decode mode rejects attention masks — left-trim or batch by
     length). This host-level loop drives a single-device (tp=1) model;
-    for tensor-parallel decoding build your own step inside shard_map
-    from ``model.apply`` + ``sample_logits`` (the compiled step already
-    gathers vocab-parallel logits over tp when the axis is bound).
+    for tensor-parallel decoding use :func:`tensor_parallel_generate`.
     """
-    if not getattr(model, "decode", False):
-        raise ValueError("generate() needs a model built with decode=True")
     from apex_tpu.transformer.parallel_state import (
         get_tensor_model_parallel_world_size,
     )
 
     if get_tensor_model_parallel_world_size() > 1:
         raise NotImplementedError(
-            "generate() drives a tp=1 model; for tensor parallelism run "
-            "the decode step inside shard_map (see docstring)")
-    cfg = model.config
-    b, plen = prompt_tokens.shape
-    if plen + max_new_tokens > cfg.max_position_embeddings:
-        raise ValueError(
-            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_position_embeddings ({cfg.max_position_embeddings})")
-    if rng is None:
-        temperature = 0.0
-        rng = jax.random.PRNGKey(0)
+            "generate() drives a tp=1 model; use "
+            "tensor_parallel_generate() (the same prefill + scan loop "
+            "inside shard_map over the 'tp' axis)")
+    prefill, decode_all, rng = _prep_decode(
+        "generate", model, prompt_tokens, max_new_tokens, rng, temperature,
+        top_k, top_p, eos_token_id, pad_token_id)
+    out = _prefill_and_decode(prefill, decode_all, model, params,
+                              prompt_tokens, rng)
+    return jnp.concatenate([prompt_tokens, out], axis=1)
 
-    prefill, decode_all = _compiled(
-        model, plen, max_new_tokens, float(temperature), top_k, top_p,
-        eos_token_id, pad_token_id)
-    cache = init_cache(model, b, prompt_tokens.dtype)
-    cache, last_logits = prefill(params, cache, prompt_tokens)
-    init = (cache, last_logits, jnp.asarray(plen, jnp.int32), rng,
-            jnp.zeros((b,), bool))
-    _, out = decode_all(params, init)  # [max_new, b]
-    return jnp.concatenate([prompt_tokens, out.T], axis=1)
+
+def init_params_tp(model, key, sample_tokens, mesh=None):
+    """Initialize a decode/serving model's params under the 'tp' axis.
+
+    Returns a *stacked* pytree (leading [tp] dim per leaf, leaf i = rank
+    i's local shard — the same convention as the pipelined harness) for
+    :func:`tensor_parallel_generate`. Init keys are rank-folded inside
+    the TP layers, so the sharded model is self-consistent.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state
+
+    mesh = mesh or parallel_state.get_mesh()
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+                       out_specs=P("tp"), check_vma=False)
+    def init_fn(k, tok):
+        variables = model.init(k, tok)
+        return jax.tree_util.tree_map(lambda a: a[None],
+                                      variables["params"])
+
+    return init_fn(key, sample_tokens)
+
+
+def tensor_parallel_generate(model, stacked_params, prompt_tokens,
+                             max_new_tokens: int, *, mesh=None, rng=None,
+                             temperature: float = 1.0,
+                             top_k: Optional[int] = None,
+                             top_p: Optional[float] = None,
+                             eos_token_id: Optional[int] = None,
+                             pad_token_id: int = 0):
+    """Tensor-parallel KV-cache decoding: the whole prefill + scan loop
+    runs inside ONE shard_map over the 'tp' mesh axis (vocab-parallel
+    logits are gathered per step by the compiled decode step, so
+    sampling sees the full vocabulary and — with the shared rng — every
+    rank picks identical tokens). ``stacked_params`` is the leading-[tp]
+    layout from :func:`init_params_tp`. Multi-chip serving path; the
+    reference has no serving story at all.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state
+
+    mesh = mesh or parallel_state.get_mesh()
+    prefill, decode_all, rng = _prep_decode(
+        "tensor_parallel_generate", model, prompt_tokens, max_new_tokens,
+        rng, temperature, top_k, top_p, eos_token_id, pad_token_id)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P("tp"), P(), P()), out_specs=P(),
+                       check_vma=False)
+    def run(sp, toks, key):
+        params = jax.tree_util.tree_map(lambda a: a[0], sp)
+        return _prefill_and_decode(prefill, decode_all, model, params,
+                                   toks, key)
+
+    out = run(stacked_params, prompt_tokens, rng)
+    return jnp.concatenate([prompt_tokens, out], axis=1)
