@@ -14,10 +14,12 @@ from apex_tpu.models.transformer_lm import (  # noqa: F401
 )
 from apex_tpu.models.gpt import GPTModel, gpt_loss_fn  # noqa: F401
 from apex_tpu.models.generation import (  # noqa: F401
+    beam_search,
     generate,
     init_cache,
     init_params_tp,
     sample_logits,
+    tensor_parallel_beam_search,
     tensor_parallel_generate,
 )
 from apex_tpu.models.bert import BertModel, bert_loss_fn  # noqa: F401

@@ -190,24 +190,16 @@ def beam_search(model, params, prompt_tokens, max_new_tokens: int,
     reselected each step; finished beams are frozen (extend with pad at
     zero cost). tp=1, like :func:`generate`.
     """
-    if not getattr(model, "decode", False):
-        raise ValueError("beam_search() needs a model built with "
-                         "decode=True")
     from apex_tpu.transformer.parallel_state import (
         get_tensor_model_parallel_world_size,
     )
 
     if get_tensor_model_parallel_world_size() > 1:
         raise NotImplementedError(
-            "beam_search() drives a tp=1 model; for tensor-parallel "
-            "sampling/greedy decoding use tensor_parallel_generate() "
-            "(beam reordering under tp is not implemented)")
-    cfg = model.config
+            "beam_search() drives a tp=1 model; use "
+            "tensor_parallel_beam_search()")
+    _validate_decode("beam_search", model, prompt_tokens, max_new_tokens)
     b, plen = prompt_tokens.shape
-    if plen + max_new_tokens > cfg.max_position_embeddings:
-        raise ValueError(
-            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_position_embeddings ({cfg.max_position_embeddings})")
     run = _compiled_beam(model, plen, max_new_tokens, num_beams,
                          float(length_penalty), eos_token_id, pad_token_id)
     cache = init_cache(model, b, prompt_tokens.dtype)
@@ -215,19 +207,59 @@ def beam_search(model, params, prompt_tokens, max_new_tokens: int,
     return jnp.concatenate([prompt_tokens, best_seqs], axis=1), best_scores
 
 
+def tensor_parallel_beam_search(model, stacked_params, prompt_tokens,
+                                max_new_tokens: int, num_beams: int = 4, *,
+                                mesh=None, length_penalty: float = 1.0,
+                                eos_token_id: Optional[int] = None,
+                                pad_token_id: int = 0):
+    """Beam search under tensor parallelism (same shard_map pattern as
+    :func:`tensor_parallel_generate`). The beam body is rank-local
+    except the vocab gather: log-probs are identical on every tp rank
+    after `_full_vocab`, so each rank performs the same beam reordering
+    on its own KV shard (cached K/V keep batch*beams at axis 1, which is
+    never tp-sharded)."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state
+
+    _validate_decode("tensor_parallel_beam_search", model, prompt_tokens,
+                     max_new_tokens)
+    mesh = mesh or parallel_state.get_mesh()
+    b, plen = prompt_tokens.shape
+    run = _compiled_beam(model, plen, max_new_tokens, num_beams,
+                         float(length_penalty), eos_token_id, pad_token_id)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P("tp"), P()), out_specs=(P(), P()),
+                       check_vma=False)
+    def go(sp, toks):
+        params = jax.tree_util.tree_map(lambda a: a[0], sp)
+        cache = init_cache(model, b, toks.dtype)
+        return run(params, cache, toks)
+
+    best_seqs, best_scores = go(stacked_params, prompt_tokens)
+    return jnp.concatenate([prompt_tokens, best_seqs], axis=1), best_scores
+
+
+def _validate_decode(fn_name, model, prompt_tokens, max_new_tokens):
+    """Shared decode-entry validation (all four public entry points)."""
+    if not getattr(model, "decode", False):
+        raise ValueError(f"{fn_name}() needs a model built with "
+                         f"decode=True")
+    plen = prompt_tokens.shape[1]
+    limit = model.config.max_position_embeddings
+    if plen + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings ({limit})")
+
+
 def _prep_decode(fn_name, model, prompt_tokens, max_new_tokens, rng,
                  temperature, top_k, top_p, eos_token_id, pad_token_id):
     """Shared validation + compile for generate()/tensor_parallel_generate:
     returns (prefill, decode_all, rng)."""
-    if not getattr(model, "decode", False):
-        raise ValueError(f"{fn_name}() needs a model built with "
-                         f"decode=True")
-    cfg = model.config
+    _validate_decode(fn_name, model, prompt_tokens, max_new_tokens)
     plen = prompt_tokens.shape[1]
-    if plen + max_new_tokens > cfg.max_position_embeddings:
-        raise ValueError(
-            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_position_embeddings ({cfg.max_position_embeddings})")
     if rng is None:
         temperature = 0.0
         rng = jax.random.PRNGKey(0)

@@ -276,3 +276,37 @@ class TestTensorParallelGenerate:
         with pytest.raises(NotImplementedError,
                            match="tensor_parallel_generate"):
             generate(dmodel, {}, jnp.zeros((1, 4), jnp.int32), 4)
+
+    def test_tp2_beam1_equals_greedy(self):
+        """num_beams=1 beam search == greedy decode, under tp=2."""
+        from apex_tpu.models import (init_params_tp,
+                                     tensor_parallel_beam_search,
+                                     tensor_parallel_generate)
+
+        mesh, cfg, dmodel, _ = self._setup(2)
+        rng = np.random.RandomState(1)
+        prompt = jnp.asarray(rng.randint(0, 64, (2, 6)))
+        params = init_params_tp(dmodel, jax.random.PRNGKey(4), prompt,
+                                mesh=mesh)
+        greedy = tensor_parallel_generate(dmodel, params, prompt, 5,
+                                          mesh=mesh)
+        beams, scores = tensor_parallel_beam_search(
+            dmodel, params, prompt, 5, num_beams=1, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(beams),
+                                      np.asarray(greedy))
+        assert np.isfinite(np.asarray(scores)).all()
+
+    def test_tp2_beam_search_runs(self):
+        from apex_tpu.models import (init_params_tp,
+                                     tensor_parallel_beam_search)
+
+        mesh, cfg, dmodel, _ = self._setup(2)
+        rng = np.random.RandomState(2)
+        prompt = jnp.asarray(rng.randint(0, 64, (2, 6)))
+        params = init_params_tp(dmodel, jax.random.PRNGKey(5), prompt,
+                                mesh=mesh)
+        seqs, scores = tensor_parallel_beam_search(
+            dmodel, params, prompt, 6, num_beams=3, mesh=mesh,
+            eos_token_id=63)
+        assert seqs.shape == (2, 12)
+        assert np.isfinite(np.asarray(scores)).all()
