@@ -22,6 +22,7 @@ from apex_tpu.models.generation import (  # noqa: F401
     tensor_parallel_beam_search,
     tensor_parallel_generate,
 )
+from apex_tpu.models.tp_split import split_params_for_tp  # noqa: F401
 from apex_tpu.models.bert import BertModel, bert_loss_fn  # noqa: F401
 from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
 from apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401

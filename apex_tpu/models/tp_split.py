@@ -1,0 +1,85 @@
+"""Split tp=1 GPT params into the per-rank tensor-parallel layout.
+
+Bridges single-device checkpoints (e.g. the HF converters in tools/) to
+the multi-chip serving/training entry points that take stacked
+[tp, ...] per-rank shards (``models.tensor_parallel_generate``,
+``init_params_tp`` layout). The reference has no analog — its TP
+checkpoints are saved per rank.
+
+Layout rules mirror the fused projections in
+``models/transformer_lm.py`` (ParallelAttention / ParallelMLP):
+
+- ``query_key_value``: MHA lays columns out per head as [q|k|v], so a
+  contiguous split is per-head correct; GQA lays out
+  [all q heads | per-group k|v], so rank r takes its q-head block AND
+  its kv-group block (two-region split).
+- ``dense_h_to_4h``: gelu is a plain column split; swiglu is fused
+  [gate | up], so each half splits separately (two-region).
+- ``dense`` / ``dense_4h_to_h`` (row-parallel): split the input dim
+  (second-to-last axis); row biases are replicated (added once after
+  the tp psum).
+- ``word_embeddings``: vocab rows; ``lm_head``: vocab columns.
+- everything else (layernorms, position embeddings) replicates.
+
+Negative axes keep the rules valid for ``scan_layers`` param stacks
+(leading [num_layers] dim).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_contiguous(x, tp, axis):
+    return jnp.stack(jnp.split(x, tp, axis=axis))
+
+
+def _split_two_region(x, tp, size_a, axis):
+    """Split [region_a | region_b] along ``axis``: rank r gets its 1/tp
+    slice of each region, concatenated."""
+    a, b_ = jnp.split(x, [size_a], axis=axis)
+    a_shards = jnp.split(a, tp, axis=axis)
+    b_shards = jnp.split(b_, tp, axis=axis)
+    return jnp.stack([jnp.concatenate([a_shards[r], b_shards[r]], axis=axis)
+                      for r in range(tp)])
+
+
+def _replicate(x, tp):
+    return jnp.broadcast_to(x[None], (tp,) + x.shape)
+
+
+def split_params_for_tp(cfg, params, tp: int):
+    """Return the stacked [tp, ...] pytree for a tp=1 GPTModel param
+    tree (see module doc). Validates divisibility of heads/groups/ffn/
+    vocab by ``tp``."""
+    if tp == 1:
+        return jax.tree_util.tree_map(lambda a: a[None], params)
+    heads, groups = cfg.num_attention_heads, cfg.query_groups
+    kv = cfg.kv_channels
+    for name, n in (("num_attention_heads", heads),
+                    ("query_groups", groups),
+                    ("ffn_size", cfg.ffn_size),
+                    ("vocab_size", cfg.vocab_size)):
+        if n % tp:
+            raise ValueError(f"{name} ({n}) is not divisible by tp ({tp})")
+
+    def rule(path, leaf):
+        keys = jax.tree_util.keystr(path)
+        if "query_key_value" in keys:
+            if groups == heads:
+                return _split_contiguous(leaf, tp, -1)
+            return _split_two_region(leaf, tp, heads * kv, -1)
+        if "dense_h_to_4h" in keys:
+            if cfg.activation == "swiglu":
+                return _split_two_region(leaf, tp, cfg.ffn_size, -1)
+            return _split_contiguous(leaf, tp, -1)
+        if "dense_4h_to_h" in keys or "self_attention']['dense" in keys:
+            if leaf.ndim >= 2 and "weight" in keys:
+                return _split_contiguous(leaf, tp, -2)
+            return _replicate(leaf, tp)  # row bias: added once post-psum
+        if "word_embeddings" in keys:
+            return _split_contiguous(leaf, tp, -2)
+        if "lm_head" in keys:
+            return _split_contiguous(leaf, tp, -1)
+        return _replicate(leaf, tp)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
