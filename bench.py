@@ -69,6 +69,14 @@ def _arm_watchdog():
 
 PEAK_TFLOPS = float(os.environ.get("APEX_TPU_PEAK_TFLOPS", "154"))
 
+# Per-layer activation recompute re-executes the forward during backward
+# (~25-30% of step FLOPs). The short-sequence train benches (bert seq
+# 128, llama/moe/gpt2 seq 1024 at small batch) fit HBM without it, so
+# they default it OFF; the long-context bench keeps it. Set
+# APEX_TPU_BENCH_REMAT=1 to force recompute back on everywhere (e.g. if
+# a capture OOMs).
+BENCH_REMAT = os.environ.get("APEX_TPU_BENCH_REMAT", "0") == "1"
+
 
 def _transformer_fwd_flops_per_token(cfg, seq):
     """Forward model-FLOPs per token: 2 FLOPs per matmul parameter
@@ -137,7 +145,8 @@ def bench_bert(batch, steps):
         hidden_size=1024, num_layers=24, num_attention_heads=16,
         vocab_size=30528, max_position_embeddings=512,
         compute_dtype=jnp.bfloat16, use_flash_attention=False,
-        attn_mask_type=AttnMaskType.padding)
+        attn_mask_type=AttnMaskType.padding,
+        activation_checkpointing=BENCH_REMAT)
     model = BertModel(cfg)
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
@@ -227,7 +236,7 @@ def bench_llama(batch, steps):
         normalization="rmsnorm", position_embedding_type="rope",
         activation="swiglu", num_query_groups=4,
         ffn_hidden_size=2816,  # ~8/3 * h, llama sizing
-        scan_layers=True)
+        scan_layers=True, activation_checkpointing=BENCH_REMAT)
     model = GPTModel(cfg)
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
@@ -309,7 +318,8 @@ def bench_gpt2(batch, steps, *, flash=None, scan=None, remat=None,
     if scan is None:
         scan = os.environ.get("APEX_TPU_GPT2_SCAN", "0") == "1"
     if remat is None:
-        remat = os.environ.get("APEX_TPU_GPT2_REMAT", "0") == "1"
+        remat = (os.environ.get("APEX_TPU_GPT2_REMAT", "0") == "1"
+                 or BENCH_REMAT)
     parallel_state.destroy_model_parallel()
     seq = 64 if tiny else 1024
     cfg = TransformerConfig(
@@ -379,7 +389,8 @@ def bench_moe(batch, steps):
         hidden_size=1024, num_layers=16, num_attention_heads=16,
         vocab_size=32000, max_position_embeddings=seq,
         compute_dtype=jnp.bfloat16, use_flash_attention=True,
-        num_moe_experts=8, moe_layer_freq=2, moe_capacity_factor=1.25)
+        num_moe_experts=8, moe_layer_freq=2, moe_capacity_factor=1.25,
+        activation_checkpointing=BENCH_REMAT)
     model = GPTModel(cfg)
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
