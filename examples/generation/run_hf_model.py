@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--beams", type=int, default=0,
                     help="0 = sample, N>1 = beam search")
     ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel ways: split the converted "
+                         "checkpoint and serve it over the 'tp' mesh axis")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
@@ -67,7 +70,27 @@ def main():
     prompt = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
 
-    if args.beams > 1:
+    if args.tp > 1:
+        from apex_tpu.models import (split_params_for_tp,
+                                     tensor_parallel_beam_search,
+                                     tensor_parallel_generate)
+        from apex_tpu.transformer import parallel_state
+
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=args.tp,
+            devices=jax.devices()[:args.tp])
+        shards = split_params_for_tp(cfg, params, args.tp)
+        if args.beams > 1:
+            out, scores = tensor_parallel_beam_search(
+                model, shards, prompt, max_new_tokens=args.max_new_tokens,
+                num_beams=args.beams, mesh=mesh)
+            print("beam scores:", np.asarray(scores))
+        else:
+            out = tensor_parallel_generate(
+                model, shards, prompt, max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature, rng=jax.random.PRNGKey(0),
+                mesh=mesh)
+    elif args.beams > 1:
         out, scores = beam_search(model, params, prompt,
                                   max_new_tokens=args.max_new_tokens,
                                   num_beams=args.beams)
