@@ -1,18 +1,44 @@
-"""Megatron pretraining batch samplers over data-parallel shards.
+"""Data-parallel pretraining batch samplers.
 
-Parity: reference apex/transformer/_data/_batchsampler.py —
-``MegatronPretrainingSampler`` (sequential, drop-last or padded last
-batch) and ``MegatronPretrainingRandomSampler`` (epoch-seeded shuffle of
-full granules). Framework-agnostic index iterators (usable with any data
-source, incl. torch DataLoader via batch_sampler=).
+Behavioral parity target: reference apex/transformer/_data/_batchsampler.py
+(sequential resume-able sampler, and an epoch-seeded shuffling sampler).
+Re-derived from the contract:
+
+  The global sample stream is consumed in *granules* of
+  ``micro_batch_size * data_parallel_size`` indices; each DP rank owns one
+  contiguous ``micro_batch_size`` slice of every granule.  Both samplers are
+  framework-agnostic index iterators (work as a torch ``batch_sampler=`` or
+  with any indexable source) and support mid-epoch resume via
+  ``consumed_samples``.
 """
 
 import numpy as np
 
 
+def _check_layout(total_samples, micro_batch_size, data_parallel_rank,
+                  data_parallel_size):
+    if total_samples <= 0:
+        raise AssertionError(f"empty dataset (total_samples={total_samples})")
+    if micro_batch_size <= 0 or data_parallel_size <= 0:
+        raise AssertionError("micro_batch_size and data_parallel_size must be "
+                             "positive")
+    if not 0 <= data_parallel_rank < data_parallel_size:
+        raise AssertionError(
+            f"rank {data_parallel_rank} outside data-parallel group of size "
+            f"{data_parallel_size}")
+
+
 class MegatronPretrainingSampler:
+    """Deterministic in-order sampler: rank r of each granule."""
+
     def __init__(self, total_samples, consumed_samples, micro_batch_size,
                  data_parallel_rank, data_parallel_size, drop_last=True):
+        _check_layout(total_samples, micro_batch_size, data_parallel_rank,
+                      data_parallel_size)
+        if consumed_samples >= total_samples:
+            raise AssertionError(
+                f"resume point {consumed_samples} is at/past the end of the "
+                f"dataset ({total_samples})")
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
         self.micro_batch_size = micro_batch_size
@@ -21,41 +47,37 @@ class MegatronPretrainingSampler:
             micro_batch_size * data_parallel_size)
         self.drop_last = drop_last
 
-        assert self.total_samples > 0, (
-            "no sample to consume: {}".format(self.total_samples))
-        assert self.consumed_samples < self.total_samples, (
-            "no samples left to consume: {}, {}".format(
-                self.consumed_samples, self.total_samples))
-        assert self.micro_batch_size > 0
-        assert data_parallel_size > 0
-        assert self.data_parallel_rank < data_parallel_size, (
-            "data_parallel_rank should be smaller than data size: {}, "
-            "{}".format(self.data_parallel_rank, data_parallel_size))
-
     def __len__(self):
         return self.total_samples
 
     def get_start_end_idx(self):
-        start_idx = self.data_parallel_rank * self.micro_batch_size
-        end_idx = start_idx + self.micro_batch_size
-        return start_idx, end_idx
+        lo = self.data_parallel_rank * self.micro_batch_size
+        return lo, lo + self.micro_batch_size
 
     def __iter__(self):
-        batch = []
-        for idx in range(self.consumed_samples, self.total_samples):
-            batch.append(idx)
-            if len(batch) == self.micro_batch_times_data_parallel_size:
-                start_idx, end_idx = self.get_start_end_idx()
-                yield batch[start_idx:end_idx]
-                batch = []
-        if len(batch) > 0 and not self.drop_last:
-            start_idx, end_idx = self.get_start_end_idx()
-            yield batch[start_idx:end_idx]
+        granule = self.micro_batch_times_data_parallel_size
+        lo, hi = self.get_start_end_idx()
+        cursor = self.consumed_samples
+        while cursor < self.total_samples:
+            chunk = list(range(cursor, min(cursor + granule,
+                                           self.total_samples)))
+            cursor += granule
+            if len(chunk) == granule:
+                yield chunk[lo:hi]
+            elif not self.drop_last:
+                # ragged tail: emit whatever of this rank's slice exists
+                yield chunk[lo:hi]
 
 
 class MegatronPretrainingRandomSampler:
+    """Epoch-shuffled sampler: each rank owns a fixed contiguous index bucket;
+    the bucket is permuted with a seed derived from (seed, epoch), and resume
+    skips the already-consumed prefix of the current epoch's permutation."""
+
     def __init__(self, total_samples, consumed_samples, micro_batch_size,
                  data_parallel_rank, data_parallel_size, seed=1234):
+        _check_layout(total_samples, micro_batch_size, data_parallel_rank,
+                      data_parallel_size)
         self.total_samples = total_samples
         self.consumed_samples = consumed_samples
         self.micro_batch_size = micro_batch_size
@@ -63,41 +85,40 @@ class MegatronPretrainingRandomSampler:
         self.data_parallel_size = data_parallel_size
         self.micro_batch_times_data_parallel_size = (
             micro_batch_size * data_parallel_size)
+        if total_samples < self.micro_batch_times_data_parallel_size:
+            raise AssertionError(
+                f"dataset of {total_samples} samples is smaller than one "
+                f"granule ({self.micro_batch_times_data_parallel_size}); "
+                "shrink micro_batch_size or data_parallel_size")
+        # The ragged tail (if any) is never sampled; an epoch is the
+        # whole-granule portion of the dataset.
         self.last_batch_size = (
-            self.total_samples % self.micro_batch_times_data_parallel_size)
+            total_samples % self.micro_batch_times_data_parallel_size)
         self.seed = seed
-
-        assert self.total_samples > 0
-        assert self.micro_batch_size > 0
-        assert data_parallel_size > 0
-        assert self.data_parallel_rank < data_parallel_size
 
     def __len__(self):
         return self.total_samples
 
     def __iter__(self):
-        active_total_samples = self.total_samples - self.last_batch_size
-        self.epoch = self.consumed_samples // active_total_samples
-        current_epoch_samples = self.consumed_samples % active_total_samples
-        assert (current_epoch_samples %
-                self.micro_batch_times_data_parallel_size == 0)
+        granule = self.micro_batch_times_data_parallel_size
+        epoch_samples = self.total_samples - self.last_batch_size
+        self.epoch = self.consumed_samples // epoch_samples
+        into_epoch = self.consumed_samples % epoch_samples
+        if into_epoch % granule:
+            raise AssertionError(
+                f"resume point {self.consumed_samples} is not granule-aligned "
+                f"(granule={granule})")
 
-        # data sharding and random sampling
-        bucket_size = ((self.total_samples //
-                        self.micro_batch_times_data_parallel_size)
-                       * self.micro_batch_size)
-        bucket_offset = current_epoch_samples // self.data_parallel_size
-        start_idx = self.data_parallel_rank * bucket_size
+        per_rank = (self.total_samples // granule) * self.micro_batch_size
+        bucket_start = self.data_parallel_rank * per_rank
+        skip = into_epoch // self.data_parallel_size
 
-        g = np.random.RandomState(self.seed + self.epoch)
-        random_idx = g.permutation(bucket_size).tolist()
-        idx_range = [start_idx + x for x in random_idx[bucket_offset:]]
-
-        batch = []
-        for idx in idx_range:
-            batch.append(idx)
-            if len(batch) == self.micro_batch_size:
-                self.consumed_samples += (
-                    self.micro_batch_times_data_parallel_size)
-                yield batch
-                batch = []
+        order = np.random.RandomState(self.seed + self.epoch).permutation(
+            per_rank)
+        pending = []
+        for off in order[skip:]:
+            pending.append(int(bucket_start + off))
+            if len(pending) == self.micro_batch_size:
+                self.consumed_samples += granule
+                yield pending
+                pending = []

@@ -1,42 +1,26 @@
-"""Microbatch calculators.
+"""Microbatch-count calculators for pipeline/data-parallel training.
 
-Parity: reference apex/transformer/microbatches.py:26-194 —
-``build_num_microbatches_calculator``, ``ConstantNumMicroBatches``,
-``RampupBatchsizeNumMicroBatches``.
+Behavioral parity target: reference apex/transformer/microbatches.py
+(constant count, and a linear global-batch-size ramp-up schedule keyed on
+consumed samples). Re-derived here from the schedule definition:
+
+  A *granule* is ``micro_batch_size * data_parallel_size`` samples — the
+  smallest global-batch quantum a (DP, microbatch) layout can consume.
+  The constant calculator fixes ``global_batch_size / granule`` microbatches
+  forever.  The ramp-up calculator grows the effective global batch from
+  ``start`` to ``final`` in increments of ``step``, spending an equal share
+  of ``ramp_samples`` at each intermediate size, then stays at ``final``.
 """
 
 from abc import ABC, abstractmethod
 
 
-def build_num_microbatches_calculator(rank, rampup_batch_size,
-                                      global_batch_size, micro_batch_size,
-                                      data_parallel_size):
-    if rampup_batch_size is None:
-        num_microbatches_calculator = ConstantNumMicroBatches(
-            global_batch_size, micro_batch_size, data_parallel_size)
-        if rank == 0:
-            print("setting number of micro-batches to constant {}".format(
-                num_microbatches_calculator.get()))
-    else:
-        assert len(rampup_batch_size) == 3
-        start_batch_size = int(rampup_batch_size[0])
-        batch_size_increment = int(rampup_batch_size[1])
-        ramup_samples = int(rampup_batch_size[2])
-        if rank == 0:
-            print("will use batch size rampup starting from global batch size "
-                  "{} to global batch size {} with batch size increments {} "
-                  "over {} samples.".format(start_batch_size, global_batch_size,
-                                            batch_size_increment, ramup_samples))
-        num_microbatches_calculator = RampupBatchsizeNumMicroBatches(
-            start_batch_size, batch_size_increment, ramup_samples,
-            global_batch_size, micro_batch_size, data_parallel_size)
-    return num_microbatches_calculator
-
-
 class NumMicroBatchesCalculator(ABC):
-    def __init__(self):
-        self.num_micro_batches = None
-        self.current_global_batch_size = None
+    """Interface: ``get()`` -> current microbatch count; ``update()`` advances
+    the schedule from the number of globally consumed samples."""
+
+    num_micro_batches = None
+    current_global_batch_size = None
 
     def get(self):
         return self.num_micro_batches
@@ -46,72 +30,116 @@ class NumMicroBatchesCalculator(ABC):
 
     @abstractmethod
     def update(self, consumed_samples, consistency_check):
-        pass
+        ...
+
+
+def _granule(micro_batch_size, data_parallel_size):
+    g = micro_batch_size * data_parallel_size
+    if g <= 0:
+        raise ValueError(
+            f"need positive micro_batch_size ({micro_batch_size}) and "
+            f"data_parallel_size ({data_parallel_size})")
+    return g
 
 
 class ConstantNumMicroBatches(NumMicroBatchesCalculator):
-    """Reference microbatches.py:93."""
+    """Fixed microbatch count: global batch must be a whole number of granules."""
 
     def __init__(self, global_batch_size, micro_batch_size, data_parallel_size):
-        micro_batch_times_data_parallel = micro_batch_size * data_parallel_size
-        assert global_batch_size % micro_batch_times_data_parallel == 0, (
-            "global batch size ({}) is not divisible by micro batch size ({})"
-            " times data parallel size ({})".format(
-                global_batch_size, micro_batch_size, data_parallel_size))
-        self.num_micro_batches = global_batch_size // micro_batch_times_data_parallel
+        granule = _granule(micro_batch_size, data_parallel_size)
+        if global_batch_size % granule != 0:
+            raise AssertionError(
+                f"global_batch_size={global_batch_size} must be a multiple of "
+                f"micro_batch_size*data_parallel_size={granule}")
+        self.num_micro_batches = global_batch_size // granule
         assert self.num_micro_batches >= 1
         self.current_global_batch_size = global_batch_size
         self.micro_batch_size = micro_batch_size
 
     def update(self, consumed_samples, consistency_check):
-        pass
+        # Nothing to advance — the count never changes.
+        return None
 
 
 class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
-    """Reference microbatches.py:112-194: linear global-batch ramp-up."""
+    """Linear global-batch-size warmup.
+
+    The global batch starts at ``start_batch_size`` and increases by
+    ``batch_size_increment`` every ``ramup_samples / num_increments``
+    consumed samples until it reaches ``global_batch_size``; past
+    ``ramup_samples`` it is pinned at the final size.
+    """
 
     def __init__(self, start_batch_size, batch_size_increment, ramup_samples,
                  global_batch_size, micro_batch_size, data_parallel_size):
         self.micro_batch_size = micro_batch_size
         self.data_parallel_size = data_parallel_size
-        self.micro_batch_times_data_parallel_size = (
-            micro_batch_size * data_parallel_size)
-        assert self.micro_batch_times_data_parallel_size > 0
+        self.micro_batch_times_data_parallel_size = _granule(
+            micro_batch_size, data_parallel_size)
 
-        assert start_batch_size > 0
+        if start_batch_size <= 0 or global_batch_size <= 0:
+            raise AssertionError("batch sizes must be positive")
+        if batch_size_increment <= 0:
+            raise AssertionError("batch_size_increment must be positive")
+        span = global_batch_size - start_batch_size
+        if span <= 0:
+            raise AssertionError(
+                f"start_batch_size={start_batch_size} must be strictly below "
+                f"the final global_batch_size={global_batch_size}; use "
+                "ConstantNumMicroBatches for a flat schedule")
+        if span % batch_size_increment != 0:
+            raise AssertionError(
+                f"ramp span {span} (= {global_batch_size} - {start_batch_size}) "
+                f"must be a multiple of the increment {batch_size_increment}")
+        if ramup_samples <= 0:
+            raise AssertionError(
+                "ramup_samples must be positive for a ramp-up schedule")
+
         self.start_batch_size = start_batch_size
-        assert global_batch_size > 0
-        self.global_batch_size = global_batch_size
-        diff_batch_size = self.global_batch_size - self.start_batch_size
-        assert diff_batch_size >= 0
-        assert batch_size_increment > 0
         self.batch_size_increment = batch_size_increment
-        assert diff_batch_size % batch_size_increment == 0, (
-            "expected global batch size interval ({}) to be divisible by "
-            "global batch size increment ({})".format(
-                diff_batch_size, batch_size_increment))
-
-        num_increments = diff_batch_size // self.batch_size_increment
+        self.global_batch_size = global_batch_size
         self.ramup_samples = ramup_samples
-        assert self.ramup_samples >= 0
-        self.rampup_samples_per_increment = self.ramup_samples / num_increments
+        # Samples spent at each intermediate batch size before stepping up.
+        self.rampup_samples_per_increment = (
+            ramup_samples / (span // batch_size_increment))
 
         self.update(0, False)
 
     def update(self, consumed_samples, consistency_check):
         if consumed_samples > self.ramup_samples:
-            self.current_global_batch_size = self.global_batch_size
+            gbs = self.global_batch_size
         else:
-            steps = int(consumed_samples / self.rampup_samples_per_increment)
-            self.current_global_batch_size = (
-                self.start_batch_size + steps * self.batch_size_increment)
-            assert self.current_global_batch_size <= self.global_batch_size
-        if consistency_check:
-            assert (self.current_global_batch_size %
-                    self.micro_batch_times_data_parallel_size == 0), (
-                "current global batch size ({}) is not divisible by "
-                "micro-batch-size ({}) times data parallel size ({})".format(
-                    self.current_global_batch_size, self.micro_batch_size,
-                    self.data_parallel_size))
-        self.num_micro_batches = (self.current_global_batch_size //
-                                  self.micro_batch_times_data_parallel_size)
+            steps_taken = int(consumed_samples / self.rampup_samples_per_increment)
+            gbs = self.start_batch_size + steps_taken * self.batch_size_increment
+            assert gbs <= self.global_batch_size
+        if consistency_check and gbs % self.micro_batch_times_data_parallel_size:
+            raise AssertionError(
+                f"ramped global batch {gbs} is not a whole number of "
+                f"micro_batch_size*data_parallel_size="
+                f"{self.micro_batch_times_data_parallel_size} granules")
+        self.current_global_batch_size = gbs
+        self.num_micro_batches = gbs // self.micro_batch_times_data_parallel_size
+
+
+def build_num_microbatches_calculator(rank, rampup_batch_size,
+                                      global_batch_size, micro_batch_size,
+                                      data_parallel_size):
+    """Factory: constant schedule when ``rampup_batch_size`` is None, else a
+    3-tuple ``(start, increment, ramp_samples)`` selects the ramp-up schedule."""
+    if rampup_batch_size is None:
+        calc = ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size)
+        if rank == 0:
+            print(f"[apex_tpu] constant microbatch count: {calc.get()}")
+        return calc
+
+    if len(rampup_batch_size) != 3:
+        raise AssertionError(
+            "rampup_batch_size takes exactly (start, increment, ramp_samples)")
+    start, increment, ramp_samples = (int(v) for v in rampup_batch_size)
+    if rank == 0:
+        print(f"[apex_tpu] ramping global batch {start} -> {global_batch_size} "
+              f"in steps of {increment}, over {ramp_samples} samples")
+    return RampupBatchsizeNumMicroBatches(
+        start, increment, ramp_samples,
+        global_batch_size, micro_batch_size, data_parallel_size)
