@@ -1,0 +1,26 @@
+"""Opt-in persistent XLA compilation cache (one switch for tests, the
+driver dryrun and local tooling).
+
+Compile time dominates the L0 suite and the multichip dryrun on slow
+hosts; a warm cache cuts serial wall-clock substantially. Off by default:
+XLA:CPU AOT reload can log machine-feature-mismatch errors when the cache
+dir migrates across heterogeneous hosts. Enable on a fixed host with e.g.
+
+    APEX_TPU_COMPILE_CACHE=/tmp/apex_tpu_jit_cache pytest tests/L0 -q
+"""
+
+import os
+
+
+def maybe_enable_compile_cache(min_compile_secs: float = 0.5) -> bool:
+    """Point jax at $APEX_TPU_COMPILE_CACHE if set. Returns True when
+    enabled. Call before the first compilation."""
+    cache_dir = os.environ.get("APEX_TPU_COMPILE_CACHE", "")
+    if not cache_dir:
+        return False
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    return True

@@ -159,7 +159,8 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
         # disabled (fp32 control) run
         from apex_tpu.amp import policy as _policy
 
-        _policy.set_global_policy(_policy.DtypePolicy(enabled=False))
+        _policy.set_global_policy(_policy.DtypePolicy(enabled=False),
+                                  verbosity=verbosity)
         return models, optimizers
 
     if opt_level not in opt_levels:
@@ -187,7 +188,7 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
     _policy.set_global_policy(_policy.DtypePolicy(
         enabled=bool(props.patch_torch_functions),
         compute_dtype=jnp.bfloat16,
-        cast_model_outputs=cast_model_outputs))
+        cast_model_outputs=cast_model_outputs), verbosity=verbosity)
 
     models_was_list = isinstance(models, list)
     models_list = models if models_was_list else [models]

@@ -8,12 +8,15 @@ dtype under the policy.
 import jax.lax as _lax
 
 from apex_tpu.amp import lists as _lists
-from apex_tpu.amp.policy import half_function
+from apex_tpu.amp.policy import float_function, half_function
 
 _WRAPPED = {}
 for _name in _lists.LAX_HALF:
     if hasattr(_lax, _name):
         _WRAPPED[_name] = half_function(getattr(_lax, _name))
+for _name in _lists.LAX_FLOAT:
+    if hasattr(_lax, _name):
+        _WRAPPED[_name] = float_function(getattr(_lax, _name))
 globals().update(_WRAPPED)
 
 

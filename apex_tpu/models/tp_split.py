@@ -47,10 +47,39 @@ def _replicate(x, tp):
     return jnp.broadcast_to(x[None], (tp,) + x.shape)
 
 
+# Module names whose >=2-D params legitimately replicate across tp ranks
+# (everything else with a matrix shape must match a split rule or the
+# split fails loudly — a silently replicated projection would produce
+# shards that are wrong or shape-mismatched only at apply time).
+_REPLICATED_MODULES = frozenset({
+    "position_embeddings", "input_layernorm", "post_attention_layernorm",
+    "final_layernorm",
+})
+
+
+def _path_names(path):
+    """The module/param name components of a pytree path (DictKey keys and
+    flax FrozenDict keys), robust against keystr formatting (ADVICE r2:
+    substring matching on the rendered keystr is brittle)."""
+    names = []
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if isinstance(key, str):
+            names.append(key)
+    return names
+
+
 def split_params_for_tp(cfg, params, tp: int):
     """Return the stacked [tp, ...] pytree for a tp=1 GPTModel param
     tree (see module doc). Validates divisibility of heads/groups/ffn/
-    vocab by ``tp``."""
+    vocab by ``tp``; raises on configs/leaves outside the GPT layout it
+    knows (MoE expert/router weights have their own ep layout and must
+    not be silently replicated)."""
+    if getattr(cfg, "num_moe_experts", None):
+        raise ValueError(
+            "split_params_for_tp handles dense GPT checkpoints only; MoE "
+            "expert/router weights need the ep-sharded layout "
+            "(transformer.moe), not a tp split")
     if tp == 1:
         return jax.tree_util.tree_map(lambda a: a[None], params)
     heads, groups = cfg.num_attention_heads, cfg.query_groups
@@ -63,23 +92,29 @@ def split_params_for_tp(cfg, params, tp: int):
             raise ValueError(f"{name} ({n}) is not divisible by tp ({tp})")
 
     def rule(path, leaf):
-        keys = jax.tree_util.keystr(path)
-        if "query_key_value" in keys:
+        names = set(_path_names(path))
+        if "query_key_value" in names:
             if groups == heads:
                 return _split_contiguous(leaf, tp, -1)
             return _split_two_region(leaf, tp, heads * kv, -1)
-        if "dense_h_to_4h" in keys:
+        if "dense_h_to_4h" in names:
             if cfg.activation == "swiglu":
                 return _split_two_region(leaf, tp, cfg.ffn_size, -1)
             return _split_contiguous(leaf, tp, -1)
-        if "dense_4h_to_h" in keys or "self_attention']['dense" in keys:
-            if leaf.ndim >= 2 and "weight" in keys:
+        if ("dense_4h_to_h" in names
+                or ("dense" in names and "self_attention" in names)):
+            if leaf.ndim >= 2 and "weight" in names:
                 return _split_contiguous(leaf, tp, -2)
             return _replicate(leaf, tp)  # row bias: added once post-psum
-        if "word_embeddings" in keys:
+        if "word_embeddings" in names:
             return _split_contiguous(leaf, tp, -2)
-        if "lm_head" in keys:
+        if "lm_head" in names:
             return _split_contiguous(leaf, tp, -1)
+        if leaf.ndim >= 2 and not (names & _REPLICATED_MODULES):
+            raise ValueError(
+                f"split_params_for_tp: unrecognized weight matrix at "
+                f"{jax.tree_util.keystr(path)} (shape {leaf.shape}) — "
+                f"refusing to silently replicate; add a split rule")
         return _replicate(leaf, tp)
 
     return jax.tree_util.tree_map_with_path(rule, params)

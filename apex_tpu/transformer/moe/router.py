@@ -31,9 +31,14 @@ class RoutingResult:
 
 def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
                     capacity_factor: float) -> int:
-    """Static per-expert slot count; multiple of 8 for TPU lane layout."""
+    """Static per-expert slot count; always a multiple of 8 for TPU lane
+    layout, capped near num_tokens (ADVICE r2: tiny configs otherwise get
+    more slots per expert than there are tokens, pure padding waste; the
+    cap itself rounds up to 8 so the lane invariant survives)."""
     raw = max(1, int(num_tokens * top_k * capacity_factor / num_experts))
-    return -(-raw // 8) * 8
+    rounded = -(-raw // 8) * 8
+    cap = -(-max(1, num_tokens) // 8) * 8
+    return min(rounded, cap)
 
 
 def compute_routing(logits, top_k: int, capacity: int,

@@ -3,6 +3,8 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_with_split,
+    make_encoder_decoder_step,
     pipeline_schedule_plan,
 )
 from apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401

@@ -16,10 +16,17 @@ All helpers must be called inside ``shard_map`` with the 'pp' axis bound.
 By default boundary ranks receive zeros (non-circular permutes), which
 schedules mask; ``circular=True`` wraps the ring (rank P-1 -> rank 0 and
 back) — the interleaved schedule rides chunk handoffs on the wrap edge.
+
+Payloads may be arbitrary pytrees of arrays (the reference's
+encoder-decoder dual-shape p2p — a (encoder, decoder) activation pair per
+boundary, get_tensor_shapes at ...without_interleaving.py:29-86 — is a
+two-leaf pytree here); each leaf rides its own collective-permute and XLA
+schedules them together.
 """
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -49,9 +56,11 @@ def send_forward_recv_forward(output_tensor, axis_name=PIPELINE_PARALLEL_AXIS,
     (reference recv_forward + send_forward pair)"""
     world = world or get_pipeline_model_parallel_world_size()
     if world == 1:
-        return output_tensor if circular else jnp.zeros_like(output_tensor)
-    return lax.ppermute(output_tensor, axis_name,
-                        _perm_fwd(world, circular))
+        return (output_tensor if circular
+                else jax.tree_util.tree_map(jnp.zeros_like, output_tensor))
+    perm = _perm_fwd(world, circular)
+    return jax.tree_util.tree_map(
+        lambda a: lax.ppermute(a, axis_name, perm), output_tensor)
 
 
 def send_backward_recv_backward(input_tensor_grad,
@@ -63,9 +72,10 @@ def send_backward_recv_backward(input_tensor_grad,
     world = world or get_pipeline_model_parallel_world_size()
     if world == 1:
         return (input_tensor_grad if circular
-                else jnp.zeros_like(input_tensor_grad))
-    return lax.ppermute(input_tensor_grad, axis_name,
-                        _perm_bwd(world, circular))
+                else jax.tree_util.tree_map(jnp.zeros_like, input_tensor_grad))
+    perm = _perm_bwd(world, circular)
+    return jax.tree_util.tree_map(
+        lambda a: lax.ppermute(a, axis_name, perm), input_tensor_grad)
 
 
 # Aliases matching the reference wrapper names

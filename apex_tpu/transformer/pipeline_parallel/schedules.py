@@ -57,10 +57,12 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import (
     PIPELINE_PARALLEL_AXIS,
+    get_pipeline_model_parallel_split_rank,
     get_pipeline_model_parallel_world_size,
     get_virtual_pipeline_model_parallel_world_size,
 )
@@ -161,11 +163,32 @@ def forward_backward_no_pipelining(forward_step_func, loss_func, params,
     return losses, grads
 
 
+def _payload_spec(tensor_shape, dtype):
+    """Normalize the boundary-payload description to a pytree of
+    ``jax.ShapeDtypeStruct``. A plain tuple/list of ints (the common
+    single-activation case) becomes one leaf of ``dtype``; anything else
+    is taken as an already-built spec pytree — the encoder-decoder
+    schedule passes a two-leaf dict (reference dual shapes,
+    ...without_interleaving.py:29-86)."""
+    if (isinstance(tensor_shape, (tuple, list))
+            and all(isinstance(d, (int, np.integer)) for d in tensor_shape)):
+        return jax.ShapeDtypeStruct(
+            tuple(int(d) for d in tensor_shape), dtype)
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(tuple(s.shape), s.dtype),
+        tensor_shape)
+
+
 def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
                        *, M, V, P, tensor_shape, dtype, axis_name,
                        grad_scale, aux_loss=False):
     """Shared 3-phase tick machine for both pipelined schedules
     (see pipeline_schedule_plan for the tick/unit mapping).
+
+    The stage-boundary payload is a pytree (single activation array for
+    GPT-style stacks; an {encoder, decoder} pair for split-rank models);
+    every payload op below — stash, ppermute shift, masking, dtype cast —
+    is tree-mapped over its leaves.
 
     ``aux_loss=True`` changes the stage contract to
     ``forward_step_func(...) -> (output_tensor, aux_scalar)``: each
@@ -183,6 +206,17 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
     T0 = V * P - 1  # first backward tick (mb 0 has crossed all V*P stages)
     rank = lax.axis_index(axis_name)
     interleaved = V > 1
+    tmap = jax.tree_util.tree_map
+    spec = _payload_spec(tensor_shape, dtype)
+
+    def _mask(pred, tree):
+        return tmap(lambda a: jnp.where(pred, a, jnp.zeros_like(a)), tree)
+
+    def _select(pred, tree_a, tree_b):
+        return tmap(lambda a, b: jnp.where(pred, a, b), tree_a, tree_b)
+
+    def _cast(tree):
+        return tmap(lambda a, s: a.astype(s.dtype), tree, spec)
 
     def take_mb(i):
         return jax.tree_util.tree_map(lambda a: a[i], microbatches)
@@ -219,7 +253,7 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
         kf = rnd * PV + c * P + j
         return c, rnd * P + j, kf % S
 
-    zero_h = jnp.zeros(tensor_shape, dtype)
+    zero_h = tmap(lambda s: jnp.zeros(s.shape, s.dtype), spec)
 
     def run_stage(p, h, mb, is_first_u):
         if aux_loss:
@@ -252,11 +286,13 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
             mb = take_mb(i)
             p_c = take_params(c)
             is_first_u = (rank == 0) & (c == 0)
-            h_in = jnp.where(is_first_u, zero_h, recv).astype(dtype)
+            h_in = _cast(_select(is_first_u, zero_h, recv))
             y, _ = run_stage(p_c, h_in, mb, is_first_u)
-            xs = lax.dynamic_update_index_in_dim(
-                xs, jnp.where(active, h_in, xs[slot]), slot, 0)
-            y_prev = jnp.where(active, y, jnp.zeros_like(y))
+            xs = tmap(
+                lambda buf, h: lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(active, h, buf[slot]), slot, 0),
+                xs, h_in)
+            y_prev = _mask(active, y)
             return xs, y_prev, dx_prev, losses, grads
 
     def bwd_half(t, state):
@@ -275,12 +311,11 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
             # and fwd_half runs first in a steady tick, so the slot read
             # here is the input stashed moments ago; other reads never
             # collide with this tick's write (ring size >= in-flight).
-            h_in = xs[slot]
+            h_in = tmap(lambda buf: buf[slot], xs)
             (_, loss), pullback = jax.vjp(
                 lambda p, h: stage_and_maybe_loss(p, h, mb, is_first_u,
                                                   is_last_u), p_c, h_in)
-            dy_cot = jnp.where(active & ~is_last_u, dy_recv,
-                               jnp.zeros_like(dy_recv)).astype(dtype)
+            dy_cot = _cast(_mask(active & ~is_last_u, dy_recv))
             # every active unit gets a loss cotangent: the main loss is
             # cond-gated to the last stage (zero transpose elsewhere),
             # while per-unit aux losses (if any) pick it up on their
@@ -291,13 +326,13 @@ def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
             grads = add_grads(grads, dp_c, c, active)
             losses = losses.at[i].add(
                 jnp.where(active & is_last_u, loss, 0.0))
-            dx_prev = jnp.where(active, dh,
-                                jnp.zeros_like(dh)).astype(dtype)
+            dx_prev = _cast(_mask(active, dh))
             return xs, y_prev, dx_prev, losses, grads
 
     zero_grads = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    state = (jnp.zeros((S,) + tuple(tensor_shape), dtype), zero_h, zero_h,
+    stash0 = tmap(lambda s: jnp.zeros((S,) + tuple(s.shape), s.dtype), spec)
+    state = (stash0, zero_h, zero_h,
              jnp.zeros((M,), jnp.float32), zero_grads)
     w, s = plan["warmup"], plan["steady"]
     state = lax.fori_loop(0, w, fwd_half, state)
@@ -382,3 +417,101 @@ def forward_backward_pipelining_with_interleaving(
         M=num_microbatches, V=V, P=P, tensor_shape=tensor_shape,
         dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
         aux_loss=aux_loss)
+
+
+def forward_backward_pipelining_with_split(
+        forward_step_func: Callable, loss_func: Callable, params,
+        microbatches, *, num_microbatches: int,
+        encoder_tensor_shape, decoder_tensor_shape,
+        dtype=jnp.float32, axis_name: str = PIPELINE_PARALLEL_AXIS,
+        grad_scale: float = 1.0, pp_size: Optional[int] = None,
+        split_rank: Optional[int] = None, aux_loss: bool = False,
+        **unused):
+    """Encoder-decoder (split-rank) 1F1B.
+
+    Parity target: the reference's ``ModelType.encoder_and_decoder`` path —
+    dual p2p tensor shapes computed from ``decoder_seq_length``
+    (fwd_bwd_pipelining_without_interleaving.py:29-86's get_tensor_shapes)
+    with the encoder on ranks ``< split_rank`` and the decoder at/after it
+    (parallel_state.py:243-331 places embedding groups around the same
+    split). The reference moves *two* tensors across decoder-side stage
+    boundaries (encoder memory + decoder stream); here the boundary
+    payload is the two-leaf pytree
+    ``{"encoder": (enc_seq, mb, h), "decoder": (dec_seq, mb, h)}`` riding
+    the same tick machine — encoder ranks advance the encoder leaf and
+    pass the decoder leaf through untouched; decoder ranks advance the
+    decoder leaf with the encoder leaf as cross-attention memory,
+    forwarding it unchanged so every decoder stage sees the final encoder
+    output. Interleaving is not supported with a split (matches the
+    reference's encoder_or_decoder-only interleaved schedule).
+
+    Stage contract (build with :func:`make_encoder_decoder_step`):
+
+        forward_step_func(params, payload_dict, mb, is_first_stage)
+            -> payload_dict
+        loss_func(params, payload_dict, mb) -> scalar   # reads "decoder"
+
+    Returns (per-microbatch losses [M] — nonzero on the last stage only,
+    grads pytree scaled by grad_scale / num_microbatches).
+    """
+    P = pp_size or get_pipeline_model_parallel_world_size()
+    split = (split_rank if split_rank is not None
+             else get_pipeline_model_parallel_split_rank())
+    if split is None or not 0 < split < P:
+        raise ValueError(
+            f"encoder-decoder pipelining needs 0 < split_rank < pp_size; "
+            f"got split_rank={split}, pp_size={P} (set it via "
+            f"initialize_model_parallel(..., "
+            f"pipeline_model_parallel_split_rank=...) or pass split_rank=)")
+    spec = {
+        "encoder": jax.ShapeDtypeStruct(tuple(encoder_tensor_shape), dtype),
+        "decoder": jax.ShapeDtypeStruct(tuple(decoder_tensor_shape), dtype),
+    }
+    return _pipelined_fwd_bwd(
+        forward_step_func, loss_func, params, microbatches,
+        M=num_microbatches, V=1, P=P, tensor_shape=spec, dtype=dtype,
+        axis_name=axis_name, grad_scale=grad_scale, aux_loss=aux_loss)
+
+
+def make_encoder_decoder_step(encoder_step: Callable, decoder_step: Callable,
+                              *, split_rank: Optional[int] = None,
+                              axis_name: str = PIPELINE_PARALLEL_AXIS):
+    """Build the stage fn for :func:`forward_backward_pipelining_with_split`
+    from per-side step functions:
+
+        encoder_step(params, enc_h, mb, is_first_stage) -> enc_h
+            (build enc_h from the microbatch when is_first_stage)
+        decoder_step(params, dec_h, enc_memory, mb, is_split_stage) -> dec_h
+            (build dec_h from the microbatch when is_split_stage — the
+            first decoder stage, where the upstream decoder leaf is zeros)
+
+    Rank-side selection is a runtime ``lax.cond`` on the pp mesh position
+    vs the split rank — one SPMD program, each rank executes only its own
+    side (consuming the split-rank bookkeeping the reference keeps in
+    parallel_state.py:469-486 / is_pipeline_stage_before_split).
+    ``params`` must carry both sides' weights in a uniform pytree on every
+    rank (each rank's unused side receives zero grads).
+    """
+    split = (split_rank if split_rank is not None
+             else get_pipeline_model_parallel_split_rank())
+    if split is None:
+        raise ValueError("make_encoder_decoder_step needs a split rank")
+
+    def step(params, payload, mb, is_first_stage):
+        rank = lax.axis_index(axis_name)
+
+        def enc_branch(op):
+            p, pl, mb_, first = op
+            return {"encoder": encoder_step(p, pl["encoder"], mb_, first),
+                    "decoder": pl["decoder"]}
+
+        def dec_branch(op):
+            p, pl, mb_, _ = op
+            return {"encoder": pl["encoder"],
+                    "decoder": decoder_step(p, pl["decoder"], pl["encoder"],
+                                            mb_, rank == split)}
+
+        return lax.cond(rank >= split, dec_branch, enc_branch,
+                        (params, payload, mb, is_first_stage))
+
+    return step

@@ -1,0 +1,149 @@
+"""Standalone T5-style encoder-decoder for pipeline split-rank tests.
+
+Parity: the reference exercises its ``ModelType.encoder_and_decoder``
+pipeline path with Megatron T5-style models (dual p2p shapes from
+``decoder_seq_length`` in
+apex/transformer/pipeline_parallel/schedules/fwd_bwd_pipelining_without_interleaving.py:29-86;
+split-rank group placement in apex/transformer/parallel_state.py:243-331).
+This is the TPU build's equivalent test vehicle: a small but genuine
+encoder-decoder — pre-RMSNorm blocks, multi-head self-attention, causal
+decoder self-attention, cross-attention over the encoder memory, GeGLU-free
+relu FFN — written as *pure jnp stage functions over explicit param dicts*
+so the same blocks run (a) per-stage inside the SPMD pipeline tick machine
+and (b) end-to-end on one device as the grad-parity oracle.
+
+Simplifications vs real T5 (documented, irrelevant to the pipeline
+mechanics under test): learned absolute position embeddings instead of
+relative position bias, no dropout, one block per pipeline stage.
+
+Layout: every pp rank holds a *uniform* params pytree
+``{"enc": {...}, "dec": {...}}`` (its own stage's weights; the slots a
+rank never touches — e.g. the encoder embedding off rank 0, the vocab
+head off the last rank — simply receive zero grads). Stage placement:
+ranks < split run ``encoder_block``; ranks >= split run
+``decoder_block`` with the forwarded encoder memory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t5_test_config(hidden=16, heads=2, ffn=32, vocab=32,
+                   enc_seq=6, dec_seq=5):
+    return dict(hidden=hidden, heads=heads, ffn=ffn, vocab=vocab,
+                enc_seq=enc_seq, dec_seq=dec_seq)
+
+
+def _rms_norm(x, g, eps=1e-6):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + eps) * g
+
+
+def _attention(q_w, k_w, v_w, o_w, x_q, x_kv, heads, causal=False):
+    """Multi-head attention between query stream x_q [s_q, b, h] and
+    key/value stream x_kv [s_kv, b, h]."""
+    sq, b, h = x_q.shape
+    skv = x_kv.shape[0]
+    d = h // heads
+    q = (x_q @ q_w).reshape(sq, b, heads, d)
+    k = (x_kv @ k_w).reshape(skv, b, heads, d)
+    v = (x_kv @ v_w).reshape(skv, b, heads, d)
+    scores = jnp.einsum("qbnd,kbnd->bnqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, skv), bool))
+        scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bnqk,kbnd->qbnd", probs, v).reshape(sq, b, h)
+    return ctx @ o_w
+
+
+def _ffn(w1, w2, x):
+    return jax.nn.relu(x @ w1) @ w2
+
+
+def init_stage_params(rng, cfg, scale=0.15):
+    """One pp rank's uniform param pytree (both sides present)."""
+    h, f, v = cfg["hidden"], cfg["ffn"], cfg["vocab"]
+
+    def mat(*shape):
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * scale)
+
+    attn = lambda: {"q": mat(h, h), "k": mat(h, h), "v": mat(h, h),
+                    "o": mat(h, h)}
+    return {
+        "enc": {
+            "embed": mat(v, h), "pos": mat(cfg["enc_seq"], h),
+            "ln1": jnp.ones((h,)), "attn": attn(),
+            "ln2": jnp.ones((h,)), "ffn": {"w1": mat(h, f), "w2": mat(f, h)},
+        },
+        "dec": {
+            "embed": mat(v, h), "pos": mat(cfg["dec_seq"], h),
+            "ln1": jnp.ones((h,)), "self_attn": attn(),
+            "ln2": jnp.ones((h,)), "cross_attn": attn(),
+            "ln3": jnp.ones((h,)), "ffn": {"w1": mat(h, f), "w2": mat(f, h)},
+            "ln_out": jnp.ones((h,)), "head": mat(h, v),
+        },
+    }
+
+
+def encoder_block(p, h, mb, is_first, *, cfg):
+    """Pipeline encoder stage: embed on the first stage, then one
+    pre-RMSNorm self-attention + FFN block. h: [enc_seq, b, hidden]."""
+    e = p["enc"]
+    # tokens mb["enc_tokens"]: [b, enc_seq] -> [enc_seq, b, hidden]
+    embedded = (e["embed"][mb["enc_tokens"]]
+                + e["pos"][None, :, :]).swapaxes(0, 1)
+    h = jnp.where(is_first, embedded, h)
+    a = _attention(e["attn"]["q"], e["attn"]["k"], e["attn"]["v"],
+                   e["attn"]["o"], _rms_norm(h, e["ln1"]),
+                   _rms_norm(h, e["ln1"]), cfg["heads"])
+    h = h + a
+    h = h + _ffn(e["ffn"]["w1"], e["ffn"]["w2"], _rms_norm(h, e["ln2"]))
+    return h
+
+
+def decoder_block(p, h, memory, mb, is_split, *, cfg):
+    """Pipeline decoder stage: embed decoder tokens on the split stage,
+    then causal self-attention + cross-attention over the encoder memory +
+    FFN. h: [dec_seq, b, hidden], memory: [enc_seq, b, hidden]."""
+    d = p["dec"]
+    embedded = (d["embed"][mb["dec_tokens"]]
+                + d["pos"][None, :, :]).swapaxes(0, 1)
+    h = jnp.where(is_split, embedded, h)
+    sa = d["self_attn"]
+    h = h + _attention(sa["q"], sa["k"], sa["v"], sa["o"],
+                       _rms_norm(h, d["ln1"]), _rms_norm(h, d["ln1"]),
+                       cfg["heads"], causal=True)
+    ca = d["cross_attn"]
+    h = h + _attention(ca["q"], ca["k"], ca["v"], ca["o"],
+                       _rms_norm(h, d["ln2"]), memory, cfg["heads"])
+    h = h + _ffn(d["ffn"]["w1"], d["ffn"]["w2"], _rms_norm(h, d["ln3"]))
+    return h
+
+
+def t5_loss(p, h, mb):
+    """Vocab head + mean token cross-entropy on the decoder stream."""
+    d = p["dec"]
+    logits = _rms_norm(h, d["ln_out"]) @ d["head"]  # [dec_seq, b, v]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt = mb["dec_targets"].swapaxes(0, 1)  # [dec_seq, b]
+    return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], -1))
+
+
+def t5_reference_loss(stage_params_list, mb, split, *, cfg):
+    """Single-device oracle: run every encoder stage then every decoder
+    stage sequentially with the same blocks the pipeline runs.
+    ``stage_params_list[r]`` is rank r's uniform pytree."""
+    P = len(stage_params_list)
+    b = mb["enc_tokens"].shape[0]
+    h = jnp.zeros((cfg["enc_seq"], b, cfg["hidden"]), jnp.float32)
+    for r in range(split):
+        h = encoder_block(stage_params_list[r], h, mb,
+                          jnp.asarray(r == 0), cfg=cfg)
+    memory = h
+    h = jnp.zeros((cfg["dec_seq"], b, cfg["hidden"]), jnp.float32)
+    for r in range(split, P):
+        h = decoder_block(stage_params_list[r], h, memory, mb,
+                          jnp.asarray(r == split), cfg=cfg)
+    return t5_loss(stage_params_list[P - 1], h, mb)

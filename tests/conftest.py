@@ -17,6 +17,12 @@ import jax  # noqa: E402
 # when a TPU PJRT plugin registers itself, so set the config directly);
 # run bench.py / examples for real-TPU execution.
 jax.config.update("jax_platforms", "cpu")
+
+# Opt-in persistent compilation cache (VERDICT r2 item 8) — see
+# apex_tpu/_compile_cache.py for the rationale and usage.
+from apex_tpu._compile_cache import maybe_enable_compile_cache  # noqa: E402
+
+maybe_enable_compile_cache()
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
