@@ -70,9 +70,13 @@ _trace_state = {"disabled_trace_seen": False, "warned": False}
 
 
 def _note_disabled_trace(args, kwargs):
-    if _trace_state["disabled_trace_seen"]:
+    # Best-effort and cheap: this runs on the disabled-policy passthrough
+    # path of every shim op, so no pytree flatten — a top-level isinstance
+    # scan catches the ordinary jnp-op call shapes, and once the hazard is
+    # latched (or the one-shot warning has fired) it costs two dict reads.
+    if _trace_state["disabled_trace_seen"] or _trace_state["warned"]:
         return
-    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+    for leaf in args if not kwargs else (*args, *kwargs.values()):
         if isinstance(leaf, jax.core.Tracer):
             _trace_state["disabled_trace_seen"] = True
             return

@@ -119,13 +119,24 @@ def pipeline_schedule_plan(pp_size: int, num_microbatches: int,
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
                               pipeline_model_parallel_size=None):
-    """Select a schedule (reference schedules/__init__.py:22-35)."""
+    """Select a schedule (reference schedules/__init__.py:22-35).
+
+    A pipeline split rank installed via ``initialize_model_parallel``
+    selects the encoder-decoder schedule (the reference routes
+    ``ModelType.encoder_and_decoder`` through the same selector; its
+    interleaved schedule is encoder_or_decoder-only, and so is ours)."""
     if pipeline_model_parallel_size is None:
         pipeline_model_parallel_size = get_pipeline_model_parallel_world_size()
     if virtual_pipeline_model_parallel_size is None:
         virtual_pipeline_model_parallel_size = (
             get_virtual_pipeline_model_parallel_world_size())
     if pipeline_model_parallel_size > 1:
+        if get_pipeline_model_parallel_split_rank() is not None:
+            if virtual_pipeline_model_parallel_size is not None:
+                raise ValueError(
+                    "interleaved (virtual-pipeline) scheduling does not "
+                    "compose with an encoder-decoder split rank")
+            return forward_backward_pipelining_with_split
         if virtual_pipeline_model_parallel_size is not None:
             return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving

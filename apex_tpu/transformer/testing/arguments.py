@@ -1,24 +1,21 @@
 """Megatron-style argument parser for the testing harness.
 
-Parity: reference apex/transformer/testing/arguments.py (977 LoC, ~180
-flags). This carries the subset the harness and tests actually consume —
-model geometry, parallelism degrees, batching, precision, checkpointing,
-logging — with the same flag names and defaulting/validation behavior
-(world-size divisibility, global-batch derivation) so Megatron-style
-launch commands work unchanged.
+Parity: reference apex/transformer/testing/arguments.py (977 LoC, ~170
+flags). External Megatron/NeMo-style launch commands should parse
+unchanged: every reference flag is accepted here under its original
+spelling, including the vision / retriever / BERT-pretraining tails that
+the TPU harness itself never reads (they exist so a ported launch script
+does not die on argparse). Structure is our own: flags live in grouped
+tables, then one derivation pass computes the dependent values
+(world-size splits, padded vocab, virtual-pipeline geometry) and
+validates cross-flag constraints.
 """
 
 import argparse
 import os
 
 
-def parse_args(extra_args_provider=None, defaults=None,
-               ignore_unknown_args=True, args=None):
-    """Parse harness arguments (reference arguments.py:parse_args)."""
-    parser = argparse.ArgumentParser(
-        description="apex_tpu testing harness arguments",
-        allow_abbrev=False)
-
+def _model_flags(parser):
     g = parser.add_argument_group("model")
     g.add_argument("--num-layers", type=int, default=2)
     g.add_argument("--hidden-size", type=int, default=64)
@@ -27,30 +24,71 @@ def parse_args(extra_args_provider=None, defaults=None,
     g.add_argument("--kv-channels", type=int, default=None)
     g.add_argument("--max-position-embeddings", type=int, default=128)
     g.add_argument("--seq-length", type=int, default=64)
+    g.add_argument("--encoder-seq-length", type=int, default=None)
+    g.add_argument("--decoder-seq-length", type=int, default=None)
+    g.add_argument("--retriever-seq-length", type=int, default=256)
     g.add_argument("--vocab-size", type=int, default=1024)
     g.add_argument("--padded-vocab-size", type=int, default=None)
     g.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    g.add_argument("--vocab-extra-ids", type=int, default=0)
     g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
     g.add_argument("--hidden-dropout", type=float, default=0.1)
     g.add_argument("--attention-dropout", type=float, default=0.1)
+    g.add_argument("--num-experts", type=int, default=None)
+    g.add_argument("--apply-residual-connection-post-layernorm",
+                   action="store_true")
+    g.add_argument("--openai-gelu", action="store_true")
+    g.add_argument("--onnx-safe", type=bool, default=None)
+    g.add_argument("--bert-no-binary-head", action="store_false",
+                   dest="bert_binary_head")
 
+
+def _parallelism_flags(parser):
     g = parser.add_argument_group("parallelism")
     g.add_argument("--tensor-model-parallel-size", type=int, default=1)
     g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--model-parallel-size", type=int, default=None,
+                   help="deprecated alias for "
+                        "--tensor-model-parallel-size")
     g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
                    default=None)
     g.add_argument("--pipeline-model-parallel-split-rank", type=int,
                    default=None)
     g.add_argument("--context-parallel-size", type=int, default=1)
     g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--standalone-embedding-stage", action="store_true")
     g.add_argument("--distributed-backend", default="xla",
                    choices=["xla", "nccl", "gloo", "ucc"])
+    g.add_argument("--lazy-mpu-init", type=bool, default=None)
+    g.add_argument("--use-cpu-initialization", type=bool, default=None)
+    g.add_argument("--empty-unused-memory-level", type=int, default=0,
+                   choices=[0, 1, 2])
+    g.add_argument("--no-async-tensor-model-parallel-allreduce",
+                   action="store_false",
+                   dest="async_tensor_model_parallel_allreduce")
+    g.add_argument("--no-scatter-gather-tensors-in-pipeline",
+                   action="store_false",
+                   dest="scatter_gather_tensors_in_pipeline")
+    g.add_argument("--no-contiguous-buffers-in-local-ddp",
+                   action="store_false",
+                   dest="contiguous_buffers_in_local_ddp")
+    g.add_argument("--inference-batch-times-seqlen-threshold", type=int,
+                   default=512)
+    g.add_argument("--cpu-offload", action="store_true")
 
+
+def _batching_flags(parser):
     g = parser.add_argument_group("batching")
     g.add_argument("--micro-batch-size", type=int, default=2)
+    g.add_argument("--batch-size", type=int, default=None,
+                   help="deprecated alias for --micro-batch-size")
     g.add_argument("--global-batch-size", type=int, default=None)
     g.add_argument("--rampup-batch-size", nargs="*", default=None)
 
+
+def _precision_flags(parser):
     g = parser.add_argument_group("precision")
     g.add_argument("--fp16", action="store_true")
     g.add_argument("--bf16", action="store_true")
@@ -62,31 +100,204 @@ def parse_args(extra_args_provider=None, defaults=None,
     g.add_argument("--accumulate-allreduce-grads-in-fp32",
                    action="store_true")
     g.add_argument("--params-dtype", default="float32")
+    g.add_argument("--fp32-residual-connection", action="store_true")
+    g.add_argument("--fp16-lm-cross-entropy", action="store_true")
+    g.add_argument("--attention-softmax-in-fp32", action="store_true")
+    g.add_argument("--no-query-key-layer-scaling", action="store_false",
+                   dest="apply_query_key_layer_scaling")
+    g.add_argument("--no-masked-softmax-fusion", action="store_false",
+                   dest="masked_softmax_fusion")
+    g.add_argument("--no-bias-gelu-fusion", action="store_false",
+                   dest="bias_gelu_fusion")
+    g.add_argument("--no-bias-dropout-fusion", action="store_false",
+                   dest="bias_dropout_fusion")
+    g.add_argument("--no-persist-layer-norm", action="store_false",
+                   dest="persist_layer_norm")
+    g.add_argument("--no-gradient-accumulation-fusion",
+                   action="store_false",
+                   dest="gradient_accumulation_fusion")
 
+
+def _training_flags(parser):
     g = parser.add_argument_group("training")
     g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--min-lr", type=float, default=0.0)
+    g.add_argument("--lr-decay-style", default="linear",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument("--lr-decay-iters", type=int, default=None)
+    g.add_argument("--lr-decay-samples", type=int, default=None)
+    g.add_argument("--lr-warmup-fraction", type=float, default=None)
+    g.add_argument("--lr-warmup-iters", type=int, default=0)
+    g.add_argument("--lr-warmup-samples", type=int, default=0)
+    g.add_argument("--warmup", type=float, default=None,
+                   help="removed; use --lr-warmup-fraction")
+    g.add_argument("--override-lr-scheduler", action="store_true")
+    g.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
     g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--start-weight-decay", type=float, default=None)
+    g.add_argument("--end-weight-decay", type=float, default=None)
+    g.add_argument("--weight-decay-incr-style", default="constant",
+                   choices=["constant", "linear", "cosine"])
     g.add_argument("--clip-grad", type=float, default=1.0)
-    g.add_argument("--train-iters", type=int, default=10)
-    g.add_argument("--seed", type=int, default=1234)
-    g.add_argument("--init-method-std", type=float, default=0.02)
+    g.add_argument("--adam-beta1", type=float, default=0.9)
+    g.add_argument("--adam-beta2", type=float, default=0.999)
+    g.add_argument("--adam-eps", type=float, default=1e-8)
+    g.add_argument("--sgd-momentum", type=float, default=0.9)
     g.add_argument("--optimizer", default="adam",
                    choices=["adam", "sgd", "lamb"])
+    g.add_argument("--train-iters", type=int, default=10)
+    g.add_argument("--train-samples", type=int, default=None)
+    g.add_argument("--exit-interval", type=int, default=None)
+    g.add_argument("--exit-duration-in-mins", type=int, default=None)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--init-method-std", type=float, default=0.02)
+    g.add_argument("--init-method-xavier-uniform", action="store_true")
+    g.add_argument("--finetune", action="store_true")
+    g.add_argument("--head-lr-mult", type=float, default=1.0)
 
+
+def _checkpoint_flags(parser):
     g = parser.add_argument_group("checkpointing")
     g.add_argument("--save", default=None)
     g.add_argument("--load", default=None)
     g.add_argument("--save-interval", type=int, default=None)
+    g.add_argument("--no-save-optim", action="store_true", default=None)
+    g.add_argument("--no-save-rng", action="store_true", default=None)
+    g.add_argument("--no-load-optim", action="store_true", default=None)
+    g.add_argument("--no-load-rng", action="store_true", default=None)
+    g.add_argument("--bert-load", default=None)
+    g.add_argument("--adlr-autoresume", action="store_true")
+    g.add_argument("--adlr-autoresume-interval", type=int, default=1000)
+    # recompute family: the reference carries both the legacy
+    # checkpoint-activations spelling and the newer recompute-* one
+    g.add_argument("--checkpoint-activations", action="store_true")
+    g.add_argument("--recompute-activations", action="store_true")
+    g.add_argument("--recompute-granularity", default=None,
+                   choices=[None, "full", "selective"])
+    g.add_argument("--recompute-method", default=None,
+                   choices=[None, "uniform", "block"])
+    g.add_argument("--recompute-num-layers", type=int, default=1)
     g.add_argument("--activations-checkpoint-method", default=None,
                    choices=[None, "uniform", "block"])
     g.add_argument("--activations-checkpoint-num-layers", type=int,
                    default=1)
     g.add_argument("--distribute-saved-activations", action="store_true")
 
+
+def _logging_flags(parser):
     g = parser.add_argument_group("logging")
     g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--log-params-norm", action="store_true")
+    g.add_argument("--log-num-zeros-in-grad", action="store_true")
+    g.add_argument("--timing-log-level", type=int, default=0,
+                   choices=[0, 1, 2])
     g.add_argument("--tensorboard-dir", default=None)
-    g.add_argument("--timing-log-level", type=int, default=0)
+    g.add_argument("--tensorboard-log-interval", type=int, default=1)
+    g.add_argument("--tensorboard-queue-size", type=int, default=1000)
+    g.add_argument("--log-timers-to-tensorboard", action="store_true")
+    g.add_argument("--log-batch-size-to-tensorboard", action="store_true")
+    g.add_argument("--no-log-learnig-rate-to-tensorboard",
+                   action="store_false",
+                   dest="log_learning_rate_to_tensorboard")
+    g.add_argument("--no-log-loss-scale-to-tensorboard",
+                   action="store_false",
+                   dest="log_loss_scale_to_tensorboard")
+    g.add_argument("--log-validation-ppl-to-tensorboard",
+                   action="store_true")
+    g.add_argument("--log-memory-to-tensorboard", action="store_true")
+    g.add_argument("--log-world-size-to-tensorboard", action="store_true")
+    g.add_argument("--eval-interval", type=int, default=1000)
+    g.add_argument("--eval-iters", type=int, default=100)
+
+
+def _data_flags(parser):
+    g = parser.add_argument_group("data")
+    g.add_argument("--data-path", nargs="*", default=None)
+    g.add_argument("--split", default="969, 30, 1")
+    g.add_argument("--vocab-file", default=None)
+    g.add_argument("--merge-file", default=None)
+    g.add_argument("--tokenizer-type", default=None)
+    g.add_argument("--data-impl", default="infer",
+                   choices=["lazy", "cached", "mmap", "infer"])
+    g.add_argument("--mmap-warmup", action="store_true")
+    g.add_argument("--num-workers", type=int, default=2)
+    g.add_argument("--dataloader-type", default=None,
+                   choices=[None, "single", "cyclic"])
+    g.add_argument("--no-data-sharding", action="store_false",
+                   dest="data_sharding")
+    g.add_argument("--mask-prob", type=float, default=0.15)
+    g.add_argument("--short-seq-prob", type=float, default=0.1)
+    g.add_argument("--sample-rate", type=float, default=1.0)
+    g.add_argument("--reset-position-ids", action="store_true")
+    g.add_argument("--reset-attention-mask", action="store_true")
+    g.add_argument("--eod-mask-loss", action="store_true")
+    g.add_argument("--use-one-sent-docs", type=bool, default=False)
+
+
+def _vision_flags(parser):
+    # vision/DINO tail — parsed for launch-command parity only
+    g = parser.add_argument_group("vision")
+    g.add_argument("--num-classes", type=int, default=1000)
+    g.add_argument("--img-h", type=int, default=224)
+    g.add_argument("--img-w", type=int, default=224)
+    g.add_argument("--num-channels", type=int, default=3)
+    g.add_argument("--patch-dim", type=int, default=16)
+    g.add_argument("--classes-fraction", type=float, default=1.0)
+    g.add_argument("--data-per-class-fraction", type=float, default=1.0)
+    g.add_argument("--vision-pretraining", action="store_true")
+    g.add_argument("--vision-pretraining-type", default="classify",
+                   choices=["classify", "inpaint", "dino"])
+    g.add_argument("--vision-backbone-type", default="vit",
+                   choices=["vit", "mit", "swin"])
+    g.add_argument("--swin-backbone-type", default="tiny",
+                   choices=["tiny", "base", "h3"])
+    g.add_argument("--mask-type", default="random",
+                   choices=["random", "row"])
+    g.add_argument("--mask-factor", type=float, default=1.0)
+    g.add_argument("--iter-per-epoch", type=int, default=1250)
+    g.add_argument("--dino-local-img-size", type=int, default=96)
+    g.add_argument("--dino-local-crops-number", type=int, default=10)
+    g.add_argument("--dino-head-hidden-size", type=int, default=2048)
+    g.add_argument("--dino-bottleneck-size", type=int, default=256)
+    g.add_argument("--dino-freeze-last-layer", type=float, default=1)
+    g.add_argument("--dino-norm-last-layer", action="store_true")
+    g.add_argument("--dino-warmup-teacher-temp", type=float, default=0.04)
+    g.add_argument("--dino-teacher-temp", type=float, default=0.07)
+    g.add_argument("--dino-warmup-teacher-temp-epochs", type=int,
+                   default=30)
+
+
+def _retriever_flags(parser):
+    # REALM/ICT/biencoder tail — parsed for launch-command parity only
+    g = parser.add_argument_group("retriever")
+    g.add_argument("--ict-head-size", type=int, default=None)
+    g.add_argument("--biencoder-projection-dim", type=int, default=0)
+    g.add_argument("--biencoder-shared-query-context-model",
+                   action="store_true")
+    g.add_argument("--ict-load", default=None)
+    g.add_argument("--titles-data-path", default=None)
+    g.add_argument("--query-in-block-prob", type=float, default=0.1)
+    g.add_argument("--block-data-path", default=None)
+    g.add_argument("--embedding-path", default=None)
+    g.add_argument("--evidence-data-path", default=None)
+    g.add_argument("--indexer-batch-size", type=int, default=128)
+    g.add_argument("--indexer-log-interval", type=int, default=1000)
+    g.add_argument("--retriever-report-topk-accuracies", nargs="+",
+                   type=int, default=[])
+    g.add_argument("--retriever-score-scaling", action="store_true")
+
+
+def parse_args(extra_args_provider=None, defaults=None,
+               ignore_unknown_args=True, args=None):
+    """Parse harness arguments (reference arguments.py:parse_args)."""
+    parser = argparse.ArgumentParser(
+        description="apex_tpu testing harness arguments",
+        allow_abbrev=False)
+    for add in (_model_flags, _parallelism_flags, _batching_flags,
+                _precision_flags, _training_flags, _checkpoint_flags,
+                _logging_flags, _data_flags, _vision_flags,
+                _retriever_flags):
+        add(parser)
 
     if extra_args_provider is not None:
         parser = extra_args_provider(parser)
@@ -101,7 +312,30 @@ def parse_args(extra_args_provider=None, defaults=None,
             if getattr(parsed, k, None) is None:
                 setattr(parsed, k, v)
 
-    # -- derivations/validation (reference arguments.py validate_args) ----
+    return _derive_and_validate(parsed)
+
+
+def _derive_and_validate(parsed):
+    """Dependent-value derivation + cross-flag validation (the
+    reference's validate_args)."""
+    # deprecated aliases fold into their modern spellings
+    if parsed.model_parallel_size is not None:
+        parsed.tensor_model_parallel_size = parsed.model_parallel_size
+    if parsed.batch_size is not None:
+        parsed.micro_batch_size = parsed.batch_size
+    if parsed.warmup is not None:
+        # the reference refuses this flag outright (arguments.py:109) —
+        # its historical int/fraction ambiguity makes silent folding
+        # dangerous
+        raise ValueError(
+            "--warmup was removed; use --lr-warmup-fraction instead")
+    if parsed.checkpoint_activations and not parsed.recompute_granularity:
+        parsed.recompute_granularity = "full"
+        parsed.recompute_method = (parsed.activations_checkpoint_method
+                                   or "uniform")
+    if parsed.recompute_activations and not parsed.recompute_granularity:
+        parsed.recompute_granularity = "selective"
+
     parsed.world_size = int(os.environ.get("WORLD_SIZE", "0")) or None
     if parsed.world_size is None:
         import jax
@@ -115,6 +349,7 @@ def parse_args(extra_args_provider=None, defaults=None,
             f"world size ({parsed.world_size}) is not divisible by "
             f"tp*pp*cp ({mp})")
     parsed.data_parallel_size = parsed.world_size // mp
+
     if parsed.global_batch_size is None:
         parsed.global_batch_size = (parsed.micro_batch_size
                                     * parsed.data_parallel_size)
@@ -123,13 +358,65 @@ def parse_args(extra_args_provider=None, defaults=None,
     if parsed.kv_channels is None:
         parsed.kv_channels = (parsed.hidden_size
                               // parsed.num_attention_heads)
+    if parsed.encoder_seq_length is None:
+        parsed.encoder_seq_length = parsed.seq_length
     if parsed.padded_vocab_size is None:
         mult = (parsed.make_vocab_size_divisible_by
                 * parsed.tensor_model_parallel_size)
         parsed.padded_vocab_size = (
-            (parsed.vocab_size + mult - 1) // mult * mult)
+            (parsed.vocab_size + parsed.vocab_extra_ids + mult - 1)
+            // mult * mult)
+
+    # virtual pipeline geometry: either give the chunk count directly or
+    # derive it from layers-per-virtual-stage
+    if (parsed.num_layers_per_virtual_pipeline_stage is not None
+            and parsed.virtual_pipeline_model_parallel_size is None):
+        if parsed.num_layers % parsed.pipeline_model_parallel_size:
+            raise ValueError(
+                f"--num-layers ({parsed.num_layers}) must be divisible "
+                f"by the pipeline size "
+                f"({parsed.pipeline_model_parallel_size}) to derive "
+                f"virtual-pipeline geometry")
+        per_stage = (parsed.num_layers
+                     // parsed.pipeline_model_parallel_size)
+        if per_stage % parsed.num_layers_per_virtual_pipeline_stage:
+            raise ValueError(
+                f"layers per pipeline stage ({per_stage}) must divide "
+                f"evenly into virtual stages of "
+                f"{parsed.num_layers_per_virtual_pipeline_stage}")
+        parsed.virtual_pipeline_model_parallel_size = (
+            per_stage // parsed.num_layers_per_virtual_pipeline_stage)
+
+    split = parsed.pipeline_model_parallel_split_rank
+    if split is not None and not (
+            0 <= split <= parsed.pipeline_model_parallel_size):
+        raise ValueError(
+            f"pipeline split rank {split} outside the "
+            f"{parsed.pipeline_model_parallel_size}-stage pipeline")
+
     if parsed.fp16 and parsed.bf16:
         raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    if parsed.train_samples is not None:
+        # sample-based bound wins over the iteration default: convert at
+        # the (possibly ramped-up) global batch size floor
+        parsed.train_iters = max(
+            1, parsed.train_samples // parsed.global_batch_size)
+    if parsed.lr_decay_iters is not None and parsed.lr_decay_samples \
+            is not None:
+        raise ValueError(
+            "--lr-decay-iters and --lr-decay-samples are mutually "
+            "exclusive")
+    if parsed.start_weight_decay is not None:
+        if parsed.start_weight_decay < 0:
+            raise ValueError("--start-weight-decay must be >= 0")
+        if parsed.end_weight_decay is None \
+                or parsed.end_weight_decay < parsed.start_weight_decay:
+            raise ValueError(
+                "--end-weight-decay must be set >= --start-weight-decay")
     if parsed.sequence_parallel and parsed.tensor_model_parallel_size == 1:
         parsed.sequence_parallel = False
+    if parsed.standalone_embedding_stage \
+            and parsed.pipeline_model_parallel_size == 1:
+        raise ValueError(
+            "--standalone-embedding-stage needs a multi-stage pipeline")
     return parsed

@@ -1,0 +1,132 @@
+"""Megatron-style launch-command parity for the harness argument parser.
+
+Parity: reference apex/transformer/testing/arguments.py — external
+Megatron/NeMo launch scripts must parse unchanged, dependent values must
+derive the same way (padded vocab, data-parallel split, virtual-pipeline
+geometry), and cross-flag violations must fail loudly.
+"""
+
+import pytest
+
+from apex_tpu.transformer.testing.arguments import parse_args
+
+
+@pytest.fixture(autouse=True)
+def _world(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "8")
+
+
+def test_megatron_launch_command_parses():
+    # a realistic Megatron-LM pretraining command line, verbatim flags
+    args = parse_args(args=[
+        "--num-layers", "24", "--hidden-size", "1024",
+        "--num-attention-heads", "16", "--seq-length", "1024",
+        "--max-position-embeddings", "1024",
+        "--micro-batch-size", "4", "--global-batch-size", "8",
+        "--lr", "0.00015", "--train-iters", "500000",
+        "--lr-decay-iters", "320000", "--lr-decay-style", "cosine",
+        "--vocab-file", "gpt2-vocab.json", "--merge-file", "gpt2-merges.txt",
+        "--data-path", "my-gpt2_text_document", "--split", "949,50,1",
+        "--weight-decay", "0.01", "--clip-grad", "1.0",
+        "--lr-warmup-fraction", ".01", "--activations-checkpoint-method",
+        "uniform", "--bf16", "--tensor-model-parallel-size", "2",
+        "--pipeline-model-parallel-size", "2", "--sequence-parallel",
+    ])
+    assert args.data_parallel_size == 2  # 8 / (tp=2 * pp=2)
+    assert args.ffn_hidden_size == 4096
+    assert args.kv_channels == 64
+    assert args.sequence_parallel
+    assert args.bf16 and not args.fp16
+    assert args.encoder_seq_length == 1024
+
+
+def test_padded_vocab_derivation():
+    args = parse_args(args=["--vocab-size", "50257",
+                            "--make-vocab-size-divisible-by", "128",
+                            "--tensor-model-parallel-size", "2"])
+    assert args.padded_vocab_size == 50432  # next multiple of 256
+    assert args.padded_vocab_size % 256 == 0
+
+
+def test_virtual_pipeline_from_layers_per_stage():
+    args = parse_args(args=[
+        "--num-layers", "16", "--pipeline-model-parallel-size", "4",
+        "--num-layers-per-virtual-pipeline-stage", "2"])
+    assert args.virtual_pipeline_model_parallel_size == 2
+
+    with pytest.raises(ValueError, match="divide"):
+        parse_args(args=[
+            "--num-layers", "16", "--pipeline-model-parallel-size", "4",
+            "--num-layers-per-virtual-pipeline-stage", "3"])
+
+
+def test_deprecated_aliases_fold_in():
+    args = parse_args(args=["--model-parallel-size", "4",
+                            "--batch-size", "16"])
+    assert args.tensor_model_parallel_size == 4
+    assert args.micro_batch_size == 16
+    assert args.data_parallel_size == 2
+
+
+def test_checkpoint_activations_maps_to_recompute():
+    args = parse_args(args=["--checkpoint-activations"])
+    assert args.recompute_granularity == "full"
+    assert args.recompute_method == "uniform"
+    sel = parse_args(args=["--recompute-activations"])
+    assert sel.recompute_granularity == "selective"
+
+
+def test_train_samples_bounds_iterations():
+    args = parse_args(args=["--train-samples", "1000",
+                            "--micro-batch-size", "1",
+                            "--global-batch-size", "10"])
+    assert args.train_iters == 100
+
+
+def test_negated_store_false_flags():
+    args = parse_args(args=["--no-bias-gelu-fusion",
+                            "--no-masked-softmax-fusion"])
+    assert not args.bias_gelu_fusion
+    assert not args.masked_softmax_fusion
+    assert args.bias_dropout_fusion  # untouched default stays on
+
+
+def test_invalid_combinations_raise():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_args(args=["--fp16", "--bf16"])
+    with pytest.raises(ValueError, match="not divisible"):
+        parse_args(args=["--tensor-model-parallel-size", "3"])
+    with pytest.raises(ValueError, match="split rank"):
+        parse_args(args=["--pipeline-model-parallel-size", "2",
+                         "--pipeline-model-parallel-split-rank", "5"])
+    with pytest.raises(ValueError, match="standalone-embedding"):
+        parse_args(args=["--standalone-embedding-stage"])
+
+
+def test_vision_and_retriever_tails_parse():
+    # flags the TPU harness never consumes must still parse (ported
+    # launch scripts carry them)
+    args = parse_args(args=[
+        "--vision-pretraining", "--vision-backbone-type", "swin",
+        "--dino-teacher-temp", "0.05", "--ict-head-size", "128",
+        "--retriever-report-topk-accuracies", "1", "5", "20",
+        "--indexer-batch-size", "64"])
+    assert args.swin_backbone_type == "tiny"
+    assert args.retriever_report_topk_accuracies == [1, 5, 20]
+
+
+def test_extra_args_provider_and_defaults():
+    def extra(parser):
+        parser.add_argument("--my-extra", type=int, default=None)
+        return parser
+
+    args = parse_args(extra_args_provider=extra,
+                      defaults={"my_extra": 7, "seq_length": 64},
+                      args=[])
+    assert args.my_extra == 7
+
+
+def test_unknown_args_ignored_by_default():
+    args = parse_args(args=["--definitely-not-a-flag", "x",
+                            "--hidden-size", "128"])
+    assert args.hidden_size == 128

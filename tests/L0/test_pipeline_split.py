@@ -138,3 +138,25 @@ def test_split_requires_valid_split_rank():
             lambda *a: None, lambda *a: None, {}, {},
             num_microbatches=2, encoder_tensor_shape=(2, 2, 4),
             decoder_tensor_shape=(2, 2, 4), pp_size=2)
+
+
+def test_selector_routes_split_rank_to_split_schedule():
+    """get_forward_backward_func must hand encoder-decoder setups the
+    split schedule (reference routes ModelType.encoder_and_decoder
+    through the same selector)."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        get_forward_backward_func,
+        forward_backward_pipelining_without_interleaving as plain,
+    )
+
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4,
+        pipeline_model_parallel_split_rank_=2,
+        devices=jax.devices()[:4])
+    assert get_forward_backward_func() is forward_backward_pipelining_with_split
+    with pytest.raises(ValueError, match="interleav"):
+        get_forward_backward_func(virtual_pipeline_model_parallel_size=2)
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4, devices=jax.devices()[:4])
+    assert get_forward_backward_func() is plain

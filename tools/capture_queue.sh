@@ -1,0 +1,17 @@
+#!/bin/bash
+# Sequential on-chip capture queue (VERDICT r2 item 1): one bench process
+# at a time, the TPU process owns the host CPU, no external kill-timeouts
+# (bench.py's own watchdog is the only abort path — an external SIGTERM
+# mid-compile is the documented tunnel-wedge trigger). Appends one
+# timestamped JSON line per capture to $CAPLOG.
+set -u
+CAPLOG=${CAPLOG:-/root/repo/.capture_log}
+cd /root/repo
+for spec in "$@"; do
+  echo "$(date -u +%H:%M:%S) START $spec" >> "$CAPLOG"
+  out=$(python bench.py $spec 2>/dev/null | tail -1)
+  echo "$(date -u +%H:%M:%S) $spec $out" >> "$CAPLOG"
+  case "$out" in *bench_error*) echo "$(date -u +%H:%M:%S) ABORT: backend unhealthy" >> "$CAPLOG"; exit 1;; esac
+  sleep 5
+done
+echo "$(date -u +%H:%M:%S) QUEUE DONE" >> "$CAPLOG"

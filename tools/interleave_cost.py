@@ -34,6 +34,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the tunneled-TPU plugin ignores the env var; the config route must
+    # win before any backend init (see tools/mfu_sweep.py)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Gentle TPU-tunnel health probe: one *init-only* subprocess per tick
+# (safe to kill per bench.py probe design), timestamped log for the
+# PERF.md capture timeline. Usage: probe_loop.sh [interval_s] [count]
+interval=${1:-600}; count=${2:-24}; log=${PROBE_LOG:-/root/repo/.probe_log}
+for i in $(seq 1 "$count"); do
+  t0=$(date -u +%H:%M:%S)
+  out=$(timeout 240 python -c "import jax; print(jax.devices()[0].platform)" 2>&1 | tail -1)
+  rc=$?
+  echo "$t0 rc=$rc $out" >> "$log"
+  if [ $rc -eq 0 ] && echo "$out" | grep -q axon; then
+    echo "$t0 HEALTHY" >> "$log"; exit 0
+  fi
+  sleep "$interval"
+done
+exit 1
