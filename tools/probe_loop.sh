@@ -1,15 +1,19 @@
 #!/bin/bash
-# Gentle TPU-tunnel health probe: one *init-only* subprocess per tick
-# (safe to kill per bench.py probe design), timestamped log for the
-# PERF.md capture timeline. Usage: probe_loop.sh [interval_s] [count]
+# Gentle TPU-tunnel health probe: an init-only subprocess, then a tiny
+# device-op canary (distinguishes init-healthy from op-healthy — the
+# 2026-07-31 wedge had init recovering minutes before ops did).
+# Timestamped log feeds the PERF.md capture timeline.
+# Usage: probe_loop.sh [interval_s] [count]; exits 0 when fully healthy.
 interval=${1:-600}; count=${2:-24}; log=${PROBE_LOG:-/root/repo/.probe_log}
 for i in $(seq 1 "$count"); do
   t0=$(date -u +%H:%M:%S)
-  out=$(timeout 240 python -c "import jax; print(jax.devices()[0].platform)" 2>&1 | tail -1)
-  rc=$?
-  echo "$t0 rc=$rc $out" >> "$log"
-  if [ $rc -eq 0 ] && echo "$out" | grep -q axon; then
-    echo "$t0 HEALTHY" >> "$log"; exit 0
+  plat=$(timeout 240 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  if [ "$plat" = "axon" ]; then
+    op=$(timeout 240 python -c "import jax.numpy as jnp; print(int(jnp.ones(())+1))" 2>/dev/null | tail -1)
+    if [ "$op" = "2" ]; then echo "$t0 HEALTHY (init+op)" >> "$log"; exit 0; fi
+    echo "$t0 init ok, op canary failed/hung" >> "$log"
+  else
+    echo "$t0 init failed ($plat)" >> "$log"
   fi
   sleep "$interval"
 done
