@@ -429,8 +429,13 @@ def _require_backend(attempts=3, probe_timeout=240, retry_wait=60):
     """Bounded TPU-backend probe with retries (VERDICT r1 item 2: fail
     with a clear JSON error instead of blocking for the whole watchdog
     budget when the tunnel is wedged). Probes in a subprocess so a hung
-    backend init never blocks this process; killing an *init* probe is
-    safe (the round-1 wedge came from killing a compile, not an init)."""
+    backend never blocks this process; killing a probe is safe (the
+    round-1 wedge came from killing a *large* compile mid-flight, not an
+    init or a trivial op). The probe runs a tiny device op, not just
+    backend init: the 2026-07-31 wedge had `jax.devices()` recovering
+    minutes before device ops did, and an init-only pass would have let
+    the bench proceed into model init and hang for the whole watchdog
+    budget."""
     import subprocess
 
     if os.environ.get("APEX_TPU_SKIP_BACKEND_PROBE") == "1":
@@ -441,8 +446,13 @@ def _require_backend(attempts=3, probe_timeout=240, retry_wait=60):
         try:
             out = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
-                 "print('PLATS', sorted({x.platform for x in d}))"],
+                 # the op result gates the output line itself (an assert
+                 # would vanish under PYTHONOPTIMIZE and silently revert
+                 # this probe to init-only)
+                 "import jax, jax.numpy as jnp; d = jax.devices(); "
+                 "ok = int(jnp.ones(()) + 1) == 2; "
+                 "print('PLATS' if ok else 'OPFAIL', "
+                 "sorted({x.platform for x in d}))"],
                 capture_output=True, text=True, timeout=probe_timeout)
             if out.returncode == 0 and "PLATS" in out.stdout:
                 import ast
@@ -458,7 +468,7 @@ def _require_backend(attempts=3, probe_timeout=240, retry_wait=60):
             else:
                 err = (out.stderr or out.stdout).strip()[-300:]
         except subprocess.TimeoutExpired:
-            err = f"backend init exceeded {probe_timeout}s"
+            err = f"backend init/op probe exceeded {probe_timeout}s"
         if attempt + 1 < attempts:
             time.sleep(retry_wait)
     print(json.dumps({

@@ -1,0 +1,279 @@
+"""On-chip L1 convergence traces: real ResNet-50 + BERT-large at every
+opt level, per-iteration loss/grad-norm dumped to committed JSON.
+
+Parity: reference tests/L1/common/main_amp.py (trace dump per opt level)
++ compare.py (closeness vs the O0 baseline); VERDICT r2 item 7 asks the
+comparison run on the real chip with the real models (BASELINE
+functional configs 1/2/4), not the CPU-mesh stand-ins in tests/L1.
+
+One config per invocation (fresh process per point — wedge/OOM
+containment, same policy as tools/mfu_sweep.py):
+
+    python tools/l1_onchip.py resnet_O0        # ... resnet_O1 _O2 _O3
+    python tools/l1_onchip.py bert_O0          # ... bert_O2
+    python tools/l1_onchip.py all              # print the run plan
+    python tools/l1_onchip.py compare          # verdicts vs O0, from JSON
+
+Traces land in tests/L1/traces_onchip/<config>.json. Budget ~2-6 min
+per config (first compile dominates); run with the host CPU dedicated.
+"""
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TRACE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "L1", "traces_onchip")
+
+# APEX_TPU_L1_TINY=1: CPU-smoke geometry for script-logic verification
+# (traces land in a separate dir so real captures are never overwritten)
+TINY = os.environ.get("APEX_TPU_L1_TINY") == "1"
+if TINY:
+    TRACE_DIR = os.path.join(os.path.dirname(TRACE_DIR), "traces_tiny")
+
+ITERS = 6 if TINY else 12
+
+# bf16-vs-fp32 per-iteration closeness (tests/L1/test_cross_product.py
+# rationale; real models at real scale get the same headroom)
+LOSS_RTOL = {"O1": 0.05, "O2": 0.08, "O3": 0.10}
+GNORM_RTOL = {"O1": 0.15, "O2": 0.20, "O3": 0.25}
+
+
+def _global_norm(grads, scale):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves)) / scale
+
+
+def run_resnet(opt_level, optimizer_name):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import amp
+    from apex_tpu.models import ResNet50
+    from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    dtype = jnp.float32 if opt_level == "O0" else jnp.bfloat16
+    if TINY:
+        from apex_tpu.models import ResNet18 as ResNetCls
+        batch, side, classes = 4, 64, 10
+    else:
+        ResNetCls, batch, side, classes = ResNet50, 64, 224, 1000
+    model = ResNetCls(num_classes=classes, dtype=dtype)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(
+        rng.randn(batch, side, side, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, classes, size=(batch,)))
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    base = (FusedSGD(lr=0.05, momentum=0.9) if optimizer_name == "sgd"
+            else FusedAdam(lr=1e-3))
+    params, opt = amp.initialize(params, base, opt_level=opt_level,
+                                 verbosity=0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state):
+        def loss_fn(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(
+                jnp.take_along_axis(logp, labels[:, None], axis=-1))
+            return loss, updates["batch_stats"]
+
+        scale = opt_state["scaler"].loss_scale
+        (loss, new_bs), grads = jax.value_and_grad(
+            lambda p: (lambda l, b: (l * scale, b))(*loss_fn(p)),
+            has_aux=True)(params)
+        gnorm = _global_norm(grads, scale)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_bs, new_opt_state, loss / scale, gnorm
+
+    losses, gnorms = [], []
+    state = (params, batch_stats, opt_state)
+    for _ in range(ITERS):
+        *state, loss, gnorm = train_step(*state)
+        losses.append(float(loss))
+        gnorms.append(float(gnorm))
+    return losses, gnorms
+
+
+def run_bert(opt_level):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import amp
+    from apex_tpu.models import BertModel, TransformerConfig, bert_loss_fn
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.enums import AttnMaskType
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    parallel_state.destroy_model_parallel()
+    batch, seq = (2, 32) if TINY else (16, 128)
+    cfg = TransformerConfig(
+        hidden_size=128 if TINY else 1024,
+        num_layers=2 if TINY else 24,
+        num_attention_heads=4 if TINY else 16,
+        vocab_size=512 if TINY else 30528,
+        max_position_embeddings=512,
+        compute_dtype=jnp.float32 if opt_level == "O0" else jnp.bfloat16,
+        use_flash_attention=False, attn_mask_type=AttnMaskType.padding,
+        activation_checkpointing=False)
+    model = BertModel(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    padding_mask = jnp.ones((batch, seq), jnp.int32)
+    tokentype = jnp.zeros((batch, seq), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    loss_mask = jnp.asarray(
+        (rng.rand(batch, seq) < 0.15).astype(np.float32))
+    nsp_labels = jnp.asarray(rng.randint(0, 2, (batch,)))
+
+    variables = model.init(jax.random.PRNGKey(0), tokens, padding_mask,
+                           tokentype)
+    params, opt = amp.initialize(
+        variables, FusedLAMB(lr=1e-3, weight_decay=0.01),
+        opt_level=opt_level, verbosity=0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state):
+        def loss_fn(p):
+            mlm, nsp = model.apply(p, tokens, padding_mask, tokentype)
+            return bert_loss_fn(mlm, nsp, labels, loss_mask, nsp_labels)
+
+        scale = opt_state["scaler"].loss_scale
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p) * scale)(params)
+        gnorm = _global_norm(grads, scale)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss / scale, gnorm
+
+    losses, gnorms = [], []
+    state = (params, opt_state)
+    for _ in range(ITERS):
+        *state, loss, gnorm = train_step(*state)
+        losses.append(float(loss))
+        gnorms.append(float(gnorm))
+    return losses, gnorms
+
+
+CONFIGS = {
+    "resnet_O0": functools.partial(run_resnet, "O0", "sgd"),
+    "resnet_O0_adam": functools.partial(run_resnet, "O0", "adam"),
+    "resnet_O1": functools.partial(run_resnet, "O1", "sgd"),
+    "resnet_O2": functools.partial(run_resnet, "O2", "adam"),
+    "resnet_O3": functools.partial(run_resnet, "O3", "adam"),
+    "bert_O0": functools.partial(run_bert, "O0"),
+    "bert_O2": functools.partial(run_bert, "O2"),
+}
+
+# which baseline each candidate compares against (optimizer must match)
+PAIRS = [
+    ("resnet_O1", "resnet_O0", "O1"),
+    ("resnet_O2", "resnet_O0_adam", "O2"),
+    ("resnet_O3", "resnet_O0_adam", "O3"),
+    ("bert_O2", "bert_O0", "O2"),
+]
+
+
+def capture(name):
+    import time
+
+    import jax
+
+    t0 = time.perf_counter()
+    losses, gnorms = CONFIGS[name]()
+    os.makedirs(TRACE_DIR, exist_ok=True)
+    rec = {
+        "config": name,
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "iters": ITERS,
+        "losses": losses,
+        "grad_norms": gnorms,
+        "total_incl_compile_s": round(time.perf_counter() - t0, 1),
+    }
+    path = os.path.join(TRACE_DIR, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps({"config": name, "wrote": path,
+                      "final_loss": losses[-1],
+                      "platform": rec["platform"],
+                      "s": rec["total_incl_compile_s"]}), flush=True)
+
+
+def compare():
+    import numpy as np
+
+    failures = []
+    for cand, base, level in PAIRS:
+        try:
+            with open(os.path.join(TRACE_DIR, f"{base}.json")) as f:
+                b = json.load(f)
+            with open(os.path.join(TRACE_DIR, f"{cand}.json")) as f:
+                c = json.load(f)
+        except FileNotFoundError as e:
+            print(json.dumps({"pair": f"{cand} vs {base}",
+                              "verdict": "MISSING", "detail": str(e)}))
+            failures.append(cand)
+            continue
+        bl, cl = np.asarray(b["losses"]), np.asarray(c["losses"])
+        bg, cg = np.asarray(b["grad_norms"]), np.asarray(c["grad_norms"])
+        rel = (np.abs(bl - cl) / np.maximum(np.abs(bl), 1e-6)).max()
+        # grad norms compare on the trailing half of the trace only: the
+        # first adam/LAMB updates are sign(g) (m-hat/sqrt(v-hat) = g/|g|
+        # at step 1), so precision rounding flips tiny-grad signs and the
+        # early gnorm trajectory diverges transiently by design — both
+        # runs must have re-converged by the back half
+        half = len(bg) // 2
+        relg = (np.abs(bg[half:] - cg[half:])
+                / np.maximum(np.abs(bg[half:]), 1e-6)).max()
+        ok = (rel < LOSS_RTOL[level] and relg < GNORM_RTOL[level]
+              and cl[-1] < cl[0])
+        print(json.dumps({
+            "pair": f"{cand} vs {base}",
+            "max_loss_rel": round(float(rel), 4),
+            "max_gnorm_rel": round(float(relg), 4),
+            "tol": [LOSS_RTOL[level], GNORM_RTOL[level]],
+            "trains": bool(cl[-1] < cl[0]),
+            "verdict": "PASS" if ok else "FAIL",
+        }), flush=True)
+        if not ok:
+            failures.append(cand)
+    sys.exit(1 if failures else 0)
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if name == "all":
+        for n in CONFIGS:
+            print(f"python tools/l1_onchip.py {n}")
+        print("python tools/l1_onchip.py compare")
+        return
+    if name == "compare":
+        return compare()
+    if name not in CONFIGS:
+        raise SystemExit(
+            f"unknown config {name!r}; one of {list(CONFIGS)} / compare")
+    capture(name)
+
+
+if __name__ == "__main__":
+    main()
