@@ -480,9 +480,28 @@ def _require_backend(attempts=3, probe_timeout=240, retry_wait=60):
     sys.exit(2)
 
 
+def _enable_bench_compile_cache():
+    """Persistent XLA compile cache, default ON for the bench (override
+    dir via APEX_TPU_COMPILE_CACHE; disable with
+    APEX_TPU_COMPILE_CACHE=off). The big single-chip compiles (ResNet
+    amp O2 ~25 min on this 1-core host) are the window where a tunnel
+    drop costs the whole run; with a warm cache a retry goes straight
+    to execution."""
+    val = os.environ.get("APEX_TPU_COMPILE_CACHE", "")
+    if val == "off":
+        return
+    if not val:
+        os.environ["APEX_TPU_COMPILE_CACHE"] = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jit_cache")
+    from apex_tpu._compile_cache import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
+
+
 def main():
     _arm_watchdog()
     _require_backend()
+    _enable_bench_compile_cache()
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import FusedAdam

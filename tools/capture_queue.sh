@@ -9,7 +9,9 @@ CAPLOG=${CAPLOG:-/root/repo/.capture_log}
 cd /root/repo
 for spec in "$@"; do
   echo "$(date -u +%H:%M:%S) START $spec" >> "$CAPLOG"
-  out=$(python bench.py $spec 2>/dev/null | tail -1)
+  err="/root/repo/.capture_err.${spec:-resnet}"
+  out=$(python bench.py $spec 2>"$err" | tail -1)
+  [ -z "$out" ] && echo "$(date -u +%H:%M:%S) EMPTY STDOUT for '$spec' — stderr tail:" >> "$CAPLOG" && tail -5 "$err" >> "$CAPLOG"
   echo "$(date -u +%H:%M:%S) $spec $out" >> "$CAPLOG"
   case "$out" in *bench_error*) echo "$(date -u +%H:%M:%S) ABORT: backend unhealthy" >> "$CAPLOG"; exit 1;; esac
   sleep 5
