@@ -198,6 +198,10 @@ def capture(name):
 
     import jax
 
+    if not TINY:
+        from bench import _enable_bench_compile_cache
+
+        _enable_bench_compile_cache()
     t0 = time.perf_counter()
     losses, gnorms = CONFIGS[name]()
     os.makedirs(TRACE_DIR, exist_ok=True)

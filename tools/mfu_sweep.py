@@ -46,7 +46,9 @@ def run(name):
         # the tunneled-TPU plugin ignores the env var; the config route
         # must win before any backend init (CPU smoke mode)
         jax.config.update("jax_platforms", "cpu")
-    from bench import bench_gpt2
+    from bench import _enable_bench_compile_cache, bench_gpt2
+
+    _enable_bench_compile_cache()
 
     v = dict(VARIANTS[name])
     tiny = os.environ.get("APEX_TPU_SWEEP_TINY") == "1"
