@@ -42,6 +42,17 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _emit_bench_error(error, kind):
+    """The one bench_error emission point — the driver and the capture
+    scripts parse this line, and the queue aborts only on kind='wedge'
+    (a backend-level failure poisons every later bench in this process
+    tree; a single bench's crash/OOM must not)."""
+    print(json.dumps({
+        "metric": "bench_error", "value": 0, "unit": "error",
+        "vs_baseline": 0.0, "kind": kind, "error": error,
+    }), flush=True)
+
+
 def _arm_watchdog():
     """Fail loudly instead of hanging forever when the tunneled TPU
     session is wedged (observed: killing a run mid-compile leaves every
@@ -53,13 +64,8 @@ def _arm_watchdog():
         return
 
     def fire():
-        print(json.dumps({
-            "metric": "bench_error",
-            "value": 0,
-            "unit": "error",
-            "vs_baseline": 0.0,
-            "error": f"bench exceeded {budget:.0f}s (TPU tunnel wedged?)",
-        }), flush=True)
+        _emit_bench_error(
+            f"bench exceeded {budget:.0f}s (TPU tunnel wedged?)", "wedge")
         os._exit(2)
 
     t = threading.Timer(budget, fire)
@@ -471,12 +477,9 @@ def _require_backend(attempts=3, probe_timeout=240, retry_wait=60):
             err = f"backend init/op probe exceeded {probe_timeout}s"
         if attempt + 1 < attempts:
             time.sleep(retry_wait)
-    print(json.dumps({
-        "metric": "bench_error", "value": 0, "unit": "error",
-        "vs_baseline": 0.0,
-        "error": f"TPU backend unavailable after {attempts} probes "
-                 f"(tunnel wedged?): {err}",
-    }), flush=True)
+    _emit_bench_error(
+        f"TPU backend unavailable after {attempts} probes "
+        f"(tunnel wedged?): {err}", "wedge")
     sys.exit(2)
 
 
@@ -600,4 +603,16 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the driver parses stdout;
+        # a tunnel drop mid-run (observed: fatal XLA error after 28 min of
+        # ResNet compile) must yield a parseable JSON line, not an empty
+        # stdout with the traceback lost to stderr
+        import traceback
+
+        traceback.print_exc()
+        _emit_bench_error(f"{type(e).__name__}: {str(e)[:300]}", "crash")
+        sys.exit(2)

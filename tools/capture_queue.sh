@@ -13,7 +13,9 @@ for spec in "$@"; do
   out=$(python bench.py $spec 2>"$err" | tail -1)
   [ -z "$out" ] && echo "$(date -u +%H:%M:%S) EMPTY STDOUT for '$spec' — stderr tail:" >> "$CAPLOG" && tail -5 "$err" >> "$CAPLOG"
   echo "$(date -u +%H:%M:%S) $spec $out" >> "$CAPLOG"
-  case "$out" in *bench_error*) echo "$(date -u +%H:%M:%S) ABORT: backend unhealthy" >> "$CAPLOG"; exit 1;; esac
+  # abort only on backend-level (wedge) errors — a single bench's crash
+  # must not cost the rest of the queue
+  case "$out" in *'"kind": "wedge"'*) echo "$(date -u +%H:%M:%S) ABORT: backend unhealthy" >> "$CAPLOG"; exit 1;; esac
   sleep 5
 done
 echo "$(date -u +%H:%M:%S) QUEUE DONE" >> "$CAPLOG"

@@ -1,0 +1,45 @@
+#!/bin/bash
+# The whole round-3 on-chip evidence plan as one sequential command.
+# Fire when tools/probe_loop.sh reports HEALTHY; every stage appends to
+# $CAPLOG and keeps stderr, no external kill-timeouts anywhere (PERF.md
+# pitfalls), persistent compile cache on throughout (.jit_cache/), so a
+# mid-plan wedge costs one stage, not the plan.
+#
+#   bash tools/run_all_onchip.sh            # full plan
+#   bash tools/run_all_onchip.sh benches    # just the bench queue
+set -u
+cd /root/repo
+CAPLOG=${CAPLOG:-/root/repo/.capture_log}
+stage=${1:-all}
+
+run() { # run <tag> <cmd...>: log one line per process, keep stderr
+  local tag=$1; shift
+  echo "$(date -u +%H:%M:%S) START $tag" >> "$CAPLOG"
+  # synchronous pipe (not a process substitution) so CAPLOG stays ordered
+  "$@" 2>"/root/repo/.capture_err.$tag" | tail -1 \
+      | sed "s/^/$(date -u +%H:%M:%S) $tag /" >> "$CAPLOG"
+  local rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && echo "$(date -u +%H:%M:%S) $tag rc=$rc stderr: $(tail -2 /root/repo/.capture_err.$tag | tr '\n' ' ')" >> "$CAPLOG"
+  return 0
+}
+
+if [ "$stage" = all ] || [ "$stage" = benches ]; then
+  # driver metric first (resnet default), then the rest
+  bash tools/capture_queue.sh "" gpt2 bert moe decode llama gpt || exit 1
+fi
+
+if [ "$stage" = all ] || [ "$stage" = sweep ]; then
+  for v in base noflash scan b16 b32 remat xent; do
+    run "sweep_$v" python tools/mfu_sweep.py "$v"
+  done
+fi
+
+if [ "$stage" = all ] || [ "$stage" = l1 ]; then
+  for c in resnet_O0 resnet_O0_adam resnet_O1 resnet_O2 resnet_O3 \
+           bert_O0 bert_O2; do
+    run "l1_$c" python tools/l1_onchip.py "$c"
+  done
+  run l1_compare python tools/l1_onchip.py compare
+fi
+
+echo "$(date -u +%H:%M:%S) ALL-ONCHIP DONE" >> "$CAPLOG"
