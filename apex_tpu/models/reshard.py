@@ -1,0 +1,120 @@
+"""Reshard a full single-program GPT checkpoint into the 3D-parallel
+per-stage layout.
+
+Closes the loop from single-device checkpoints (the tools/ HF converters,
+``apex_tpu.checkpoint`` saves) to pipelined training: the reference keeps
+per-rank checkpoint files and loads each rank's file into its own process
+(its ``parallel_state`` embedding groups assume the layout already
+matches), so it has no layout-conversion tool at all. Here a checkpoint
+is one pytree and the conversion is explicit:
+
+- ``split_gpt_params_for_pp``: full ``GPTModel`` tree -> one ``GPTStage``
+  tree per global stage (layer slices; embeddings/final-norm/head carried
+  on every stage — ``GPTStage`` owns all of them and uses the embed on
+  the first stage, the head on the last).
+- ``load_checkpoint_for_3d``: the whole journey to device: pp (+vpp)
+  stage split, per-stage TP shard split (``tp_split`` rules), then
+  placement into the exact per-rank stacked layout
+  ``testing.gpt_3d.build_gpt_3d_harness`` trains on (leading [pp] mesh
+  axis, per-rank [vpp] chunk axis, TP shards per (pp, tp) cell).
+
+Tied-embedding checkpoints (``cfg.tie_word_embeddings``) are untied on
+the way in: pipeline stages cannot share the embedding table across
+ranks (same constraint as the reference's parallel_lm_logits), so the
+head weight is materialized as ``embedding.T``.
+
+Memory note: placement temporarily replicates the stacked
+[stages, tp, ...] host tree to every device before each rank picks its
+cell — sized for single-host loading (the intended use: HF-converted or
+locally saved checkpoints). Oracle tests: tests/L0/test_reshard_3d.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.tp_split import split_params_for_tp
+
+
+def split_gpt_params_for_pp(cfg, params, pp, vpp=1):
+    """Full GPTModel param tree -> list of ``pp * vpp`` GPTStage trees,
+    ordered by global stage (chunk-major: stage s holds layers
+    ``s*lps .. (s+1)*lps-1``)."""
+    S = pp * (vpp or 1)
+    L = cfg.num_layers
+    if L % S:
+        raise ValueError(
+            f"num_layers ({L}) must be a multiple of pp*vpp ({S})")
+    lps = L // S
+
+    tree = dict(params)
+    trans = dict(tree.pop("transformer"))
+    shared = tree
+    if "lm_head" not in shared:
+        # tied checkpoint: stages need an untied head (module docstring)
+        shared = dict(shared)
+        shared["lm_head"] = jnp.transpose(
+            shared["word_embeddings"]["weight"])
+
+    scan = "layers" in trans  # scan_layers stack: leaves lead with [L]
+    stages = []
+    for s in range(S):
+        if scan:
+            sub = {"layers": jax.tree_util.tree_map(
+                lambda a, s=s: a[s * lps:(s + 1) * lps], trans["layers"])}
+        else:
+            missing = [f"layer_{s * lps + i}" for i in range(lps)
+                       if f"layer_{s * lps + i}" not in trans]
+            if missing:
+                raise ValueError(
+                    f"checkpoint transformer tree lacks {missing}; keys "
+                    f"present: {sorted(trans)}")
+            sub = {f"layer_{i}": trans[f"layer_{s * lps + i}"]
+                   for i in range(lps)}
+        stages.append({**shared, "transformer": sub})
+    return stages
+
+
+def _axis_index_or_zero(mesh, name):
+    return jax.lax.axis_index(name) if name in mesh.shape else 0
+
+
+def load_checkpoint_for_3d(cfg, params, mesh, *, pp, vpp=1):
+    """Full GPTModel params -> the stacked per-rank pytree that
+    ``build_gpt_3d_harness``'s step consumes (same device layout its own
+    ``init_params`` produces: P('pp')-stacked, TP shards resident per
+    (pp, tp) cell, [vpp] chunk axis per rank when vpp > 1)."""
+    from jax.sharding import PartitionSpec as P
+
+    V = vpp or 1
+    tp = mesh.shape.get("tp", 1)
+    stages = split_gpt_params_for_pp(cfg, params, pp, V)
+    # host-side: [stages, tp, ...] per leaf
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[split_params_for_tp(cfg, st, tp) for st in stages])
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(),),
+                       out_specs=P("pp"), check_vma=False)
+    def place(all_stages):
+        r = _axis_index_or_zero(mesh, "pp")
+        t = _axis_index_or_zero(mesh, "tp")
+
+        def pick(leaf, s):
+            x = jax.lax.dynamic_index_in_dim(leaf, s, 0, keepdims=False)
+            return jax.lax.dynamic_index_in_dim(x, t, 0, keepdims=False)
+
+        if V > 1:
+            # chunk c on rank r is global stage c*pp + r (gpt_3d layout)
+            chunks = [jax.tree_util.tree_map(
+                lambda a, c=c: pick(a, c * pp + r), all_stages)
+                for c in range(V)]
+            local = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *chunks)
+        else:
+            local = jax.tree_util.tree_map(lambda a: pick(a, r),
+                                           all_stages)
+        return jax.tree_util.tree_map(lambda a: a[None], local)
+
+    return jax.jit(place)(stacked)

@@ -111,7 +111,7 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
         # V=1 falls through to the non-interleaved schedule inside
         losses, grads = forward_backward_pipelining_with_interleaving(
             stage_fn, loss_fn, params, mbs, num_microbatches=M,
-            tensor_shape=tensor_shape, dtype=jnp.bfloat16,
+            tensor_shape=tensor_shape, dtype=cfg.compute_dtype,
             grad_scale=scaler_state.loss_scale, pp_size=pp,
             num_model_chunks=V, aux_loss=moe)
         # DP gradient sync (DDP semantics: average over the dp axis).
@@ -148,7 +148,7 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
                        check_vma=False)
     def init_params(key, tok, lab):
         rank = jax.lax.axis_index("pp")
-        h0 = jnp.zeros(tensor_shape, jnp.bfloat16)
+        h0 = jnp.zeros(tensor_shape, cfg.compute_dtype)
 
         def init_chunk(c):
             # chunk c on rank r is global stage c*pp + r
@@ -170,8 +170,12 @@ def build_gpt_3d_harness(cfg, mesh, opt, scaler, *, pp, seq, microbatch,
         params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
         return jax.tree_util.tree_map(lambda a: a[None], opt.init(params))
 
-    def init_state(key, tokens, labels):
-        stacked_params = init_params(key, tokens[:MB], labels[:MB])
+    def init_state(key, tokens, labels, stacked_params=None):
+        """``stacked_params``: pre-loaded per-rank params (e.g. from
+        ``models.reshard.load_checkpoint_for_3d``) instead of a fresh
+        init; optimizer/scaler state is built for them either way."""
+        if stacked_params is None:
+            stacked_params = init_params(key, tokens[:MB], labels[:MB])
         return stacked_params, init_opt(stacked_params), scaler.init_state()
 
     return init_state, jax.jit(sharded_step)
