@@ -62,6 +62,9 @@ class GPTModel(nn.Module):
                     cfg.params_dtype)
                 h = h + pos[position_ids]
             h = h.astype(cfg.compute_dtype)
+            if cfg.embedding_multiplier is not None:
+                h = h * jnp.asarray(cfg.embedding_multiplier,
+                                    cfg.compute_dtype)
             # [b, s, h] -> [s, b, h] (Megatron layout: seq-major for SP)
             h = h.transpose(1, 0, 2)
         else:

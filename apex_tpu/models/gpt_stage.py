@@ -63,7 +63,10 @@ class GPTStage(nn.Module):
         h = self.word_embeddings(tokens)
         if cfg.position_embedding_type == "learned":
             h = h + self.position_embeddings[:s][None, :, :]
-        h = h.astype(cfg.compute_dtype).transpose(1, 0, 2)  # [s, b, h]
+        h = h.astype(cfg.compute_dtype)
+        if cfg.embedding_multiplier is not None:
+            h = h * jnp.asarray(cfg.embedding_multiplier, cfg.compute_dtype)
+        h = h.transpose(1, 0, 2)  # [s, b, h]
         if cfg.sequence_parallel:
             h = scatter_to_sequence_parallel_region(h)
         return h

@@ -98,7 +98,7 @@ def split_params_for_tp(cfg, params, tp: int):
                 return _split_contiguous(leaf, tp, -1)
             return _split_two_region(leaf, tp, heads * kv, -1)
         if "dense_h_to_4h" in names:
-            if cfg.activation == "swiglu":
+            if cfg.activation in ("swiglu", "geglu"):
                 return _split_two_region(leaf, tp, cfg.ffn_size, -1)
             return _split_contiguous(leaf, tp, -1)
         if ("dense_4h_to_h" in names

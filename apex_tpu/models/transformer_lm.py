@@ -87,7 +87,10 @@ class TransformerConfig:
     num_query_groups: Optional[int] = None  # None -> MHA (groups == heads)
     position_embedding_type: str = "learned"  # or "rope"
     rotary_base: float = 10000.0
-    activation: str = "gelu"  # or "swiglu"
+    activation: str = "gelu"  # or "swiglu" / "geglu"
+    # Scale token embeddings by this factor on entry (Gemma family uses
+    # sqrt(hidden_size); the tied head contracts with the UNSCALED table).
+    embedding_multiplier: Optional[float] = None
     normalization: str = "layernorm"  # or "rmsnorm"
     # Tie the LM head to the word-embedding table (reference
     # parallel_lm_logits ties by default). Off here because the SPMD
@@ -102,7 +105,7 @@ class TransformerConfig:
                 f"unknown position_embedding_type "
                 f"{self.position_embedding_type!r}; expected 'learned' or "
                 f"'rope'")
-        if self.activation not in ("gelu", "swiglu"):
+        if self.activation not in ("gelu", "swiglu", "geglu"):
             raise ValueError(f"unknown activation {self.activation!r}")
         if self.normalization not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown normalization {self.normalization!r}")
@@ -426,11 +429,12 @@ class ParallelMLP(nn.Module):
     @nn.compact
     def __call__(self, hidden_states):
         cfg = self.config
-        if cfg.activation == "swiglu":
+        if cfg.activation in ("swiglu", "geglu"):
             # Fused [gate | up] projection: each tp rank's local columns
             # split into its own gate/up halves (per-rank pairing is
             # self-consistent because shards are initialized per rank,
-            # not sliced from a global matrix).
+            # not sliced from a global matrix). geglu (Gemma family)
+            # gates with tanh-approx gelu instead of silu.
             gate_up = ColumnParallelLinear(
                 input_size=cfg.hidden_size, output_size=2 * cfg.ffn_size,
                 gather_output=False, bias=False,
@@ -438,7 +442,8 @@ class ParallelMLP(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 name="dense_h_to_4h")(hidden_states.astype(cfg.compute_dtype))
             gate, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
-            x = (jax.nn.silu(gate) * up).astype(cfg.compute_dtype)
+            act = jax.nn.silu if cfg.activation == "swiglu" else jax.nn.gelu
+            x = (act(gate) * up).astype(cfg.compute_dtype)
         elif cfg.activation == "gelu":
             x = ColumnParallelLinear(
                 input_size=cfg.hidden_size, output_size=cfg.ffn_size,
@@ -450,7 +455,7 @@ class ParallelMLP(nn.Module):
             raise ValueError(f"unknown activation {cfg.activation!r}")
         x = RowParallelLinear(
             input_size=cfg.ffn_size, output_size=cfg.hidden_size,
-            input_is_parallel=True, bias=(cfg.activation != "swiglu"),
+            input_is_parallel=True, bias=(cfg.activation == "gelu"),
             params_dtype=cfg.params_dtype,
             sequence_parallel_enabled=cfg.sequence_parallel,
             name="dense_4h_to_h")(x)

@@ -176,3 +176,47 @@ def test_greedy_generation_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_gemma(seed=5, kv_heads=1):
+    cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=kv_heads, head_dim=12,
+        max_position_embeddings=32, attention_dropout=0.0)
+    torch.manual_seed(seed)
+    return transformers.GemmaForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("kv_heads", [1, 4])
+def test_logits_match_hf_gemma(kv_heads):
+    """Gemma oracle: GeGLU gate, sqrt(hidden) embedding scale, (1+w)
+    rmsnorm folding, always-tied head, MQA when kv_heads=1 — against
+    HF's independent implementation."""
+    from tools.convert_hf_gemma import convert_gemma
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_gemma(kv_heads=kv_heads)
+    cfg, params = convert_gemma(hf.state_dict(), hf_cfg)
+    assert cfg.activation == "geglu" and cfg.tie_word_embeddings
+    assert "lm_head" not in params
+
+    tokens = np.random.RandomState(5).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_gemma_refuses_mismatched_head_dim():
+    from tools.convert_hf_gemma import convert_gemma
+
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=1, head_dim=16, max_position_embeddings=32)
+    with pytest.raises(ValueError, match="head_dim"):
+        convert_gemma({}, hf_cfg)

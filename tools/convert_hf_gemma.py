@@ -1,0 +1,144 @@
+"""Convert a HuggingFace Gemma checkpoint into apex_tpu GPTModel params.
+
+Gemma specifics on top of the Llama-family mapping (convert_hf_llama):
+
+- GeGLU MLP (``hidden_act="gelu_pytorch_tanh"``) -> ``activation="geglu"``
+  (tanh-approx gelu gate, fused [gate | up] columns).
+- Embeddings scaled by sqrt(hidden_size) at entry ->
+  ``embedding_multiplier`` (the tied head contracts with the unscaled
+  table, so the scale must NOT be folded into the weights).
+- RMSNorm stores ``w`` and applies ``x * (1 + w)`` -> fold the +1 into
+  the weights here; the model's standard rmsnorm then matches.
+- Always-tied LM head -> ``tie_word_embeddings=True``, no lm_head param.
+- MQA on the 2b variant (num_key_value_heads=1) -> ``num_query_groups``.
+
+Variants whose ``head_dim != hidden_size / num_heads`` (e.g. gemma-7b:
+256 vs 192) do not map onto the fused-QKV layout and are refused loudly.
+
+    from transformers import GemmaForCausalLM
+    from tools.convert_hf_gemma import convert_gemma
+
+    hf = GemmaForCausalLM.from_pretrained(path)
+    cfg, params = convert_gemma(hf.state_dict(), hf.config)
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.convert_hf_llama import _fused_qkv, _t
+
+
+def convert_gemma(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a GemmaForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = hf_config.hidden_size // n
+    if getattr(hf_config, "head_dim", d) != d:
+        raise ValueError(
+            f"gemma variant with head_dim={hf_config.head_dim} != "
+            f"hidden_size/num_heads={d} does not map onto the fused-QKV "
+            f"layout (kv_channels is derived); use a variant where they "
+            f"match (e.g. gemma-2b)")
+    act = getattr(hf_config, "hidden_act", None) or getattr(
+        hf_config, "hidden_activation", "gelu_pytorch_tanh")
+    if not (act.startswith("gelu") or act.startswith("silu")):
+        raise ValueError(
+            f"unsupported hidden_act {act!r}: the converter maps gelu* "
+            f"-> geglu and silu -> swiglu; anything else would silently "
+            f"change numerics")
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        activation=("geglu" if act.startswith("gelu") else "swiglu"),
+        num_query_groups=(g if g != n else None),
+        tie_word_embeddings=True,
+        embedding_multiplier=math.sqrt(hf_config.hidden_size),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def rms(key):
+        # Gemma rmsnorm applies x * (1 + w): fold the +1 in
+        return jnp.asarray(_t(sd[key]) + 1.0)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {"weight": rms(f"{p}.input_layernorm.weight")},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": {
+                "weight": rms(f"{p}.post_attention_layernorm.weight")},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(np.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    return cfg, {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {"weight": rms("norm.weight")},
+    }
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import GemmaForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = GemmaForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_gemma(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
