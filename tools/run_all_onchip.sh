@@ -36,7 +36,7 @@ fi
 
 if [ "$stage" = all ] || [ "$stage" = l1 ]; then
   for c in resnet_O0 resnet_O0_adam resnet_O1 resnet_O2 resnet_O3 \
-           bert_O0 bert_O2; do
+           bert_O0 bert_O2 dcgan_O0 dcgan_O2; do
     run "l1_$c" python tools/l1_onchip.py "$c"
   done
   run l1_compare python tools/l1_onchip.py compare
