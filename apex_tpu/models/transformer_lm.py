@@ -91,6 +91,9 @@ class TransformerConfig:
     # Scale token embeddings by this factor on entry (Gemma family uses
     # sqrt(hidden_size); the tied head contracts with the UNSCALED table).
     embedding_multiplier: Optional[float] = None
+    # Per-head attention dim decoupled from hidden_size/num_heads (e.g.
+    # gemma-7b: 256 vs 3072/16=192). None -> hidden_size // num_heads.
+    head_dim: Optional[int] = None
     normalization: str = "layernorm"  # or "rmsnorm"
     # Tie the LM head to the word-embedding table (reference
     # parallel_lm_logits ties by default). Off here because the SPMD
@@ -126,7 +129,7 @@ class TransformerConfig:
 
     @property
     def kv_channels(self):
-        return self.hidden_size // self.num_attention_heads
+        return self.head_dim or self.hidden_size // self.num_attention_heads
 
     @property
     def query_groups(self):
@@ -203,7 +206,8 @@ class ParallelAttention(nn.Module):
 
         if cfg.query_groups == cfg.num_attention_heads:
             qkv = ColumnParallelLinear(
-                input_size=cfg.hidden_size, output_size=3 * cfg.hidden_size,
+                input_size=cfg.hidden_size,
+                output_size=3 * cfg.num_attention_heads * kv,
                 gather_output=False, bias=True, params_dtype=cfg.params_dtype,
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 name="query_key_value")(x)
@@ -308,7 +312,8 @@ class ParallelAttention(nn.Module):
         """Shared row-parallel output projection (both attention paths —
         keep them on ONE 'dense' module so numerics can't diverge)."""
         return RowParallelLinear(
-            input_size=cfg.hidden_size, output_size=cfg.hidden_size,
+            input_size=cfg.num_attention_heads * cfg.kv_channels,
+            output_size=cfg.hidden_size,
             input_is_parallel=True, bias=True, params_dtype=cfg.params_dtype,
             sequence_parallel_enabled=(cfg.sequence_parallel
                                        and not self.decode),

@@ -211,12 +211,29 @@ def test_logits_match_hf_gemma(kv_heads):
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
 
 
-def test_gemma_refuses_mismatched_head_dim():
+@pytest.mark.parametrize("kv_heads", [2, 4])
+def test_logits_match_hf_gemma_decoupled_head_dim(kv_heads):
+    """gemma-7b shape: head_dim (16) != hidden/heads (12) — oracles the
+    cfg.head_dim decoupling through q/k/v (both the GQA and the MHA
+    fused layouts), the output projection, and rope."""
     from tools.convert_hf_gemma import convert_gemma
 
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
     hf_cfg = transformers.GemmaConfig(
         vocab_size=96, hidden_size=48, intermediate_size=128,
-        num_hidden_layers=1, num_attention_heads=4,
-        num_key_value_heads=1, head_dim=16, max_position_embeddings=32)
-    with pytest.raises(ValueError, match="head_dim"):
-        convert_gemma({}, hf_cfg)
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=kv_heads, head_dim=16,
+        max_position_embeddings=32, attention_dropout=0.0)
+    torch.manual_seed(6)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+    cfg, params = convert_gemma(hf.state_dict(), hf_cfg)
+    assert cfg.head_dim == 16 and cfg.kv_channels == 16
+
+    tokens = np.random.RandomState(6).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)

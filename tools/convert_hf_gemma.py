@@ -11,9 +11,8 @@ Gemma specifics on top of the Llama-family mapping (convert_hf_llama):
   the weights here; the model's standard rmsnorm then matches.
 - Always-tied LM head -> ``tie_word_embeddings=True``, no lm_head param.
 - MQA on the 2b variant (num_key_value_heads=1) -> ``num_query_groups``.
-
-Variants whose ``head_dim != hidden_size / num_heads`` (e.g. gemma-7b:
-256 vs 192) do not map onto the fused-QKV layout and are refused loudly.
+- Decoupled ``head_dim`` (gemma-7b: 256 vs hidden/heads=192) ->
+  ``cfg.head_dim``.
 
     from transformers import GemmaForCausalLM
     from tools.convert_hf_gemma import convert_gemma
@@ -38,13 +37,7 @@ def convert_gemma(state_dict, hf_config):
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
     n = hf_config.num_attention_heads
     g = hf_config.num_key_value_heads
-    d = hf_config.hidden_size // n
-    if getattr(hf_config, "head_dim", d) != d:
-        raise ValueError(
-            f"gemma variant with head_dim={hf_config.head_dim} != "
-            f"hidden_size/num_heads={d} does not map onto the fused-QKV "
-            f"layout (kv_channels is derived); use a variant where they "
-            f"match (e.g. gemma-2b)")
+    d = getattr(hf_config, "head_dim", None) or hf_config.hidden_size // n
     act = getattr(hf_config, "hidden_act", None) or getattr(
         hf_config, "hidden_activation", "gelu_pytorch_tanh")
     if not (act.startswith("gelu") or act.startswith("silu")):
@@ -69,6 +62,7 @@ def convert_gemma(state_dict, hf_config):
         num_query_groups=(g if g != n else None),
         tie_word_embeddings=True,
         embedding_multiplier=math.sqrt(hf_config.hidden_size),
+        head_dim=(d if d * n != hf_config.hidden_size else None),
     )
 
     def lin_t(key):
