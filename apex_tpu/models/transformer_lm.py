@@ -103,6 +103,14 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
 
     def __post_init__(self):
+        if self.head_dim is not None:
+            if self.head_dim < 1:
+                raise ValueError(f"head_dim ({self.head_dim}) must be >= 1")
+            if self.head_dim * self.num_attention_heads == self.hidden_size:
+                # normalize the derived value to None so numerically
+                # identical configs compare/serialize identically and
+                # producers can pass head_dim through unconditionally
+                object.__setattr__(self, "head_dim", None)
         if self.position_embedding_type not in ("learned", "rope"):
             raise ValueError(
                 f"unknown position_embedding_type "

@@ -28,11 +28,9 @@ def gpt_model_provider(pre_process=True, post_process=True, *,
             vocab_size=args.padded_vocab_size or args.vocab_size,
             max_position_embeddings=args.max_position_embeddings,
             sequence_parallel=args.sequence_parallel,
-            # honor an explicit --kv-channels that differs from the
-            # derived hidden/heads (cfg.head_dim decoupling)
-            head_dim=(args.kv_channels if args.kv_channels is not None
-                      and args.kv_channels * args.num_attention_heads
-                      != args.hidden_size else None),
+            # honor an explicit --kv-channels (cfg normalizes the
+            # derived-value case back to None)
+            head_dim=args.kv_channels,
             params_dtype=jnp.float32,
             compute_dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         )

@@ -62,7 +62,7 @@ def convert_gemma(state_dict, hf_config):
         num_query_groups=(g if g != n else None),
         tie_word_embeddings=True,
         embedding_multiplier=math.sqrt(hf_config.hidden_size),
-        head_dim=(d if d * n != hf_config.hidden_size else None),
+        head_dim=d,
     )
 
     def lin_t(key):

@@ -50,8 +50,10 @@ def convert_llama(state_dict, hf_config):
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
     n = hf_config.num_attention_heads
     g = hf_config.num_key_value_heads
-    d = hf_config.hidden_size // n
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
     cfg = TransformerConfig(
+        head_dim=d,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,
         num_attention_heads=n,

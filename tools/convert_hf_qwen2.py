@@ -28,7 +28,8 @@ def convert_qwen2(state_dict, hf_config):
     cfg, params = convert_llama(state_dict, hf_config)
     n = hf_config.num_attention_heads
     g = hf_config.num_key_value_heads
-    d = hf_config.hidden_size // n
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
     for i in range(cfg.num_layers):
         p = f"layers.{i}"
