@@ -25,7 +25,9 @@ from apex_tpu.models.generation import (  # noqa: F401
 from apex_tpu.models.tp_split import split_params_for_tp  # noqa: F401
 from apex_tpu.models.reshard import (  # noqa: F401
     load_checkpoint_for_3d,
+    load_moe_checkpoint_for_ep,
     split_gpt_params_for_pp,
+    split_moe_params_for_ep,
 )
 from apex_tpu.models.bert import BertModel, bert_loss_fn  # noqa: F401
 from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401

@@ -34,7 +34,14 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.models.tp_split import split_params_for_tp
+from apex_tpu.models.tp_split import (
+    _dense_tp_rule,
+    _path_names,
+    _replicate,
+    _split_contiguous,
+    _split_two_region,
+    split_params_for_tp,
+)
 
 
 def split_gpt_params_for_pp(cfg, params, pp, vpp=1):
@@ -115,6 +122,87 @@ def load_checkpoint_for_3d(cfg, params, mesh, *, pp, vpp=1):
         else:
             local = jax.tree_util.tree_map(lambda a: pick(a, r),
                                            all_stages)
+        return jax.tree_util.tree_map(lambda a: a[None], local)
+
+    return jax.jit(place)(stacked)
+
+
+def split_moe_params_for_ep(cfg, params, ep, tp=1):
+    """Full single-program MoE GPT tree (e.g. tools/convert_hf_mixtral
+    output) -> leaves stacked [ep, tp, ...]:
+
+    - expert leaves (``mlp/experts/*``, leading global-expert axis [E]):
+      E sliced across ep ranks; the tp split follows the ExpertMLP
+      layout — w1 columns (two-region [gate | up] when the expert
+      activation is gated), w2 input rows, b1 columns; b2 replicates
+      (added once after the tp psum).
+    - router weights: replicated (dense math, every rank routes).
+    - everything else: the dense-GPT tp rules, replicated over ep.
+    """
+    E = cfg.num_moe_experts
+    if not E:
+        raise ValueError("cfg has no MoE experts; use split_params_for_tp")
+    if E % ep:
+        raise ValueError(f"num_moe_experts ({E}) not divisible by ep ({ep})")
+    gated = cfg.activation in ("swiglu", "geglu")
+    dense = _dense_tp_rule(cfg, tp) if tp > 1 else (
+        lambda path, leaf: leaf[None])
+
+    def expert_tp_split(name, x):
+        if tp == 1:
+            return x[None]
+        if name == "w1":
+            if gated:
+                return _split_two_region(x, tp, cfg.ffn_size, -1)
+            return _split_contiguous(x, tp, -1)
+        if name == "w2":
+            return _split_contiguous(x, tp, -2)
+        if name == "b1":
+            return _split_contiguous(x, tp, -1)
+        if name == "b2":
+            return _replicate(x, tp)
+        raise ValueError(f"unknown expert param {name!r}")
+
+    def rule(path, leaf):
+        names = _path_names(path)
+        if "experts" in names:
+            # scan_layers trees stack all layers under 'layers', so the
+            # global-expert axis sits behind the leading [num_layers]
+            e_axis = 1 if "layers" in names else 0
+            shards = jnp.split(leaf, ep, axis=e_axis)  # slice the E axis
+            return jnp.stack([expert_tp_split(names[-1], x)
+                              for x in shards])  # [ep, tp, ...]
+        if "router" in names:
+            return _replicate(_replicate(leaf, tp), ep)
+        out = dense(path, leaf)  # [tp, ...]
+        return _replicate(out, ep)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def load_moe_checkpoint_for_ep(cfg, params, mesh):
+    """Full single-program MoE GPT params -> the stacked per-rank pytree
+    ``testing.gpt_moe.build_gpt_moe_harness`` consumes (same device
+    layout its own ``init_params`` produces over the ('ep', 'tp') mesh
+    axes)."""
+    from jax.sharding import PartitionSpec as P
+
+    ep = mesh.shape.get("ep", 1)
+    tp = mesh.shape.get("tp", 1)
+    stacked = split_moe_params_for_ep(cfg, params, ep, tp)
+    model_axes = tuple(a for a in ("ep", "tp") if a in mesh.shape)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(),),
+                       out_specs=P(model_axes), check_vma=False)
+    def place(all_ranks):
+        e = _axis_index_or_zero(mesh, "ep")
+        t = _axis_index_or_zero(mesh, "tp")
+
+        def pick(leaf):
+            x = jax.lax.dynamic_index_in_dim(leaf, e, 0, keepdims=False)
+            return jax.lax.dynamic_index_in_dim(x, t, 0, keepdims=False)
+
+        local = jax.tree_util.tree_map(pick, all_ranks)
         return jax.tree_util.tree_map(lambda a: a[None], local)
 
     return jax.jit(place)(stacked)

@@ -69,19 +69,11 @@ def _path_names(path):
     return names
 
 
-def split_params_for_tp(cfg, params, tp: int):
-    """Return the stacked [tp, ...] pytree for a tp=1 GPTModel param
-    tree (see module doc). Validates divisibility of heads/groups/ffn/
-    vocab by ``tp``; raises on configs/leaves outside the GPT layout it
-    knows (MoE expert/router weights have their own ep layout and must
-    not be silently replicated)."""
-    if getattr(cfg, "num_moe_experts", None):
-        raise ValueError(
-            "split_params_for_tp handles dense GPT checkpoints only; MoE "
-            "expert/router weights need the ep-sharded layout "
-            "(transformer.moe), not a tp split")
-    if tp == 1:
-        return jax.tree_util.tree_map(lambda a: a[None], params)
+def _dense_tp_rule(cfg, tp):
+    """The per-leaf dense-GPT tp-split rule (module doc): returns a
+    ``rule(path, leaf) -> [tp, ...]`` closure after validating
+    divisibility. Shared by ``split_params_for_tp`` and the MoE loader
+    (``models.reshard``), which handles expert/router leaves itself."""
     heads, groups = cfg.num_attention_heads, cfg.query_groups
     kv = cfg.kv_channels
     for name, n in (("num_attention_heads", heads),
@@ -117,4 +109,21 @@ def split_params_for_tp(cfg, params, tp: int):
                 f"refusing to silently replicate; add a split rule")
         return _replicate(leaf, tp)
 
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return rule
+
+
+def split_params_for_tp(cfg, params, tp: int):
+    """Return the stacked [tp, ...] pytree for a tp=1 GPTModel param
+    tree (see module doc). Validates divisibility of heads/groups/ffn/
+    vocab by ``tp``; raises on configs/leaves outside the GPT layout it
+    knows (MoE expert/router weights have their own ep layout — use
+    ``models.reshard.load_moe_checkpoint_for_ep``)."""
+    if getattr(cfg, "num_moe_experts", None):
+        raise ValueError(
+            "split_params_for_tp handles dense GPT checkpoints only; MoE "
+            "expert/router weights need the ep-sharded layout "
+            "(models.reshard.load_moe_checkpoint_for_ep), not a tp split")
+    if tp == 1:
+        return jax.tree_util.tree_map(lambda a: a[None], params)
+    return jax.tree_util.tree_map_with_path(_dense_tp_rule(cfg, tp),
+                                            params)

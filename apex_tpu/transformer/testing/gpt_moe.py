@@ -80,8 +80,12 @@ def build_gpt_moe_harness(cfg, mesh, opt):
         params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
         return jax.tree_util.tree_map(lambda a: a[None], opt.init(params))
 
-    def init_state(key, tokens):
-        stacked_params = init_params(key, tokens)
+    def init_state(key, tokens, stacked_params=None):
+        """``stacked_params``: pre-loaded per-rank params (e.g. from
+        ``models.reshard.load_moe_checkpoint_for_ep``) instead of a
+        fresh init; optimizer state is built for them either way."""
+        if stacked_params is None:
+            stacked_params = init_params(key, tokens)
         return stacked_params, init_opt(stacked_params)
 
     return init_state, jax.jit(sharded_step)
