@@ -94,6 +94,11 @@ class TransformerConfig:
     # Per-head attention dim decoupled from hidden_size/num_heads (e.g.
     # gemma-7b: 256 vs 3072/16=192). None -> hidden_size // num_heads.
     head_dim: Optional[int] = None
+    # GPT-NeoX/Pythia-family knobs: sum attention and MLP branches into
+    # ONE residual (both read the pre-attn stream), and rotate only the
+    # leading fraction of each head's dims (rotary_pct).
+    parallel_residual: bool = False
+    rotary_percent: float = 1.0
     normalization: str = "layernorm"  # or "rmsnorm"
     # Tie the LM head to the word-embedding table (reference
     # parallel_lm_logits ties by default). Off here because the SPMD
@@ -103,6 +108,9 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
 
     def __post_init__(self):
+        if not 0.0 < self.rotary_percent <= 1.0:
+            raise ValueError(
+                f"rotary_percent ({self.rotary_percent}) must be in (0, 1]")
         if self.head_dim is not None:
             if self.head_dim < 1:
                 raise ValueError(f"head_dim ({self.head_dim}) must be >= 1")
@@ -148,19 +156,34 @@ def _attn_mask_fn(scores, mask):
     return jnp.where(mask.astype(bool), -10000.0, scores)
 
 
-def apply_rotary_emb(x, base: float = 10000.0, positions=None):
+def apply_rotary_emb(x, base: float = 10000.0, positions=None,
+                     percent: float = 1.0):
     """Rotary position embedding (rotate-half convention) on [s, b, n, d].
 
     ``positions`` is [s] (shared across the batch) or [s, b] (per-sequence
     indices, e.g. packed documents); defaults to global indices 0..s-1 —
     correct under sequence parallelism too, because the QKV projections
     gather the full sequence before heads are formed. fp32 trig, cast
-    back to x.dtype.
+    back to x.dtype. ``percent`` < 1 (GPT-NeoX rotary_pct) rotates only
+    the leading dims of each head: rotary_ndims = int(d * percent) sets
+    the frequency normalization, and 2*ceil(rotary_ndims/2) dims rotate
+    (the HF convention — an odd rotary_ndims still pairs up).
     """
+    d_full = x.shape[-1]
+    if percent < 1.0:
+        rot_n = int(d_full * percent)  # HF rotary_ndims (may be odd)
+        width = 2 * ((rot_n + 1) // 2)  # dims actually rotated
+        out = _rope_core(x[..., :width], base, positions, rot_n)
+        return jnp.concatenate([out, x[..., width:]], axis=-1)
+    return _rope_core(x, base, positions, d_full)
+
+
+def _rope_core(x, base, positions, freq_dim):
     s, _, _, d = x.shape
     if positions is None:
         positions = jnp.arange(s)
-    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    inv = 1.0 / (base ** (jnp.arange(0, freq_dim, 2, dtype=jnp.float32)
+                          / freq_dim))
     freqs = positions[..., None].astype(jnp.float32) * inv  # [s(,b), d/2]
     if freqs.ndim == 2:  # [s, d/2] -> broadcast over batch and heads
         freqs = freqs[:, None, :]
@@ -265,8 +288,10 @@ class ParallelAttention(nn.Module):
                                         np_local, kv, b)
 
         if cfg.position_embedding_type == "rope":
-            q = apply_rotary_emb(q, cfg.rotary_base, position_ids)
-            k = apply_rotary_emb(k, cfg.rotary_base, position_ids)
+            q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
+                                 cfg.rotary_percent)
+            k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
+                                 cfg.rotary_percent)
         if k.shape[2] != np_local:
             # broadcast each K/V group to its query heads
             rep = np_local // k.shape[2]
@@ -351,8 +376,10 @@ class ParallelAttention(nn.Module):
                 except Exception:
                     rank = 0
                 position_ids = rank * s + jnp.arange(s)
-            q = apply_rotary_emb(q, cfg.rotary_base, position_ids)
-            k = apply_rotary_emb(k, cfg.rotary_base, position_ids)
+            q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
+                                 cfg.rotary_percent)
+            k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
+                                 cfg.rotary_percent)
         if k.shape[2] != np_local:
             rep = np_local // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
@@ -390,8 +417,10 @@ class ParallelAttention(nn.Module):
         if cfg.position_embedding_type == "rope":
             pos = (position_ids if position_ids is not None
                    else idx + jnp.arange(s))
-            q = apply_rotary_emb(q, cfg.rotary_base, pos)
-            k = apply_rotary_emb(k, cfg.rotary_base, pos)
+            q = apply_rotary_emb(q, cfg.rotary_base, pos,
+                                 cfg.rotary_percent)
+            k = apply_rotary_emb(k, cfg.rotary_base, pos,
+                                 cfg.rotary_percent)
         if not initialized:
             # init pass: create the variables, plain causal attention over
             # the given tokens (shapes/params identical to the real path)
@@ -495,7 +524,10 @@ class ParallelTransformerLayer(nn.Module):
                                      name="self_attention")(
             ln1(hidden_states.astype(jnp.float32)).astype(cfg.compute_dtype),
             attention_mask, position_ids)
-        hidden_states = hidden_states + attn_out.astype(hidden_states.dtype)
+        residual = hidden_states  # pre-attn input (parallel-residual form)
+        if not cfg.parallel_residual:
+            hidden_states = hidden_states + attn_out.astype(
+                hidden_states.dtype)
         ln2 = _make_norm(cfg, "post_attention_layernorm")
         if self._is_moe_layer():
             from apex_tpu.transformer.moe import SwitchMLP
@@ -515,6 +547,11 @@ class ParallelTransformerLayer(nn.Module):
             mlp = ParallelMLP(cfg, name="mlp")
         mlp_out = mlp(
             ln2(hidden_states.astype(jnp.float32)).astype(cfg.compute_dtype))
+        if cfg.parallel_residual:
+            # GPT-NeoX form: both branches read the SAME input (ln2 is
+            # applied to the pre-attn stream) and sum into one residual
+            return (residual + attn_out.astype(residual.dtype)
+                    + mlp_out.astype(residual.dtype))
         return hidden_states + mlp_out.astype(hidden_states.dtype)
 
 

@@ -25,7 +25,8 @@ import numpy as np
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--family", choices=["gpt2", "llama"], default="gpt2")
+    ap.add_argument("--family", choices=["gpt2", "llama", "gemma"],
+                    default="gpt2")
     ap.add_argument("--model-path", default=None,
                     help="HF checkpoint dir; omit for a tiny random model")
     ap.add_argument("--max-new-tokens", type=int, default=24)
@@ -54,6 +55,17 @@ def main():
             hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
                 vocab_size=256, n_positions=128, n_embd=64, n_layer=4,
                 n_head=4))
+    elif args.family == "gemma":
+        from tools.convert_hf_gemma import convert_gemma as convert
+
+        if args.model_path:
+            hf = transformers.GemmaForCausalLM.from_pretrained(args.model_path)
+        else:
+            hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
+                vocab_size=256, hidden_size=64, intermediate_size=176,
+                num_hidden_layers=4, num_attention_heads=4,
+                num_key_value_heads=1, head_dim=16,
+                max_position_embeddings=128))
     else:
         from tools.convert_hf_llama import convert_llama as convert
 

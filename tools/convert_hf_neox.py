@@ -1,0 +1,128 @@
+"""Convert a HuggingFace GPT-NeoX/Pythia checkpoint into apex_tpu params.
+
+NeoX specifics:
+
+- Parallel residual (``use_parallel_residual=True``): attention and MLP
+  branches both read the pre-attention stream and sum into one residual
+  -> ``cfg.parallel_residual``.
+- Partial rotary (``rotary_pct``, Pythia uses 0.25): only the leading
+  fraction of each head's dims rotates -> ``cfg.rotary_percent``.
+- HF's fused ``query_key_value`` lays columns out per head as
+  [q_i | k_i | v_i], which IS apex_tpu's MHA fused layout — the weight
+  transposes straight across, no permutation.
+- gelu MLP with biases, LayerNorm with bias, untied ``embed_out`` head.
+
+    from transformers import GPTNeoXForCausalLM
+    from tools.convert_hf_neox import convert_neox
+
+    hf = GPTNeoXForCausalLM.from_pretrained("EleutherAI/pythia-160m")
+    cfg, params = convert_neox(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+from tools.convert_hf_llama import _t
+
+
+def convert_neox(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a GPTNeoXForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("gpt_neox."): v for k, v in state_dict.items()}
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.layer_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rotary_emb_base", 10000.0),
+        rotary_percent=getattr(hf_config, "rotary_pct", 1.0),
+        parallel_residual=getattr(hf_config, "use_parallel_residual", True),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def ln(prefix):
+        return {"weight": jnp.asarray(_t(sd[f"{prefix}.weight"])),
+                "bias": jnp.asarray(_t(sd[f"{prefix}.bias"]))}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.input_layernorm"),
+            "self_attention": {
+                # HF columns are already per-head [q|k|v] blocks
+                "query_key_value": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.attention.query_key_value.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.attention.query_key_value.bias"])),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.attention.dense.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.attention.dense.bias"])),
+                },
+            },
+            "post_attention_layernorm": ln(f"{p}.post_attention_layernorm"),
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.dense_h_to_4h.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.mlp.dense_h_to_4h.bias"])),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.dense_4h_to_h.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.mlp.dense_4h_to_h.bias"])),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_in.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln("final_layer_norm"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["embed_out.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import GPTNeoXForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = GPTNeoXForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_neox(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
