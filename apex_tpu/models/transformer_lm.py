@@ -99,6 +99,9 @@ class TransformerConfig:
     # leading fraction of each head's dims (rotary_pct).
     parallel_residual: bool = False
     rotary_percent: float = 1.0
+    # Mistral-style sliding-window attention: query i sees key j iff
+    # 0 <= i - j < sliding_window (on top of causal). None -> full causal.
+    sliding_window: Optional[int] = None
     normalization: str = "layernorm"  # or "rmsnorm"
     # Tie the LM head to the word-embedding table (reference
     # parallel_lm_logits ties by default). Off here because the SPMD
@@ -108,6 +111,17 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
 
     def __post_init__(self):
+        if self.sliding_window is not None:
+            if self.sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window ({self.sliding_window}) must be >= 1")
+            if self.attn_mask_type != AttnMaskType.causal:
+                raise ValueError("sliding_window requires causal attention")
+            if self.context_parallel:
+                raise ValueError(
+                    "sliding_window does not compose with context "
+                    "parallelism (the ring/ulysses kernels run full "
+                    "causal attention)")
         if not 0.0 < self.rotary_percent <= 1.0:
             raise ValueError(
                 f"rotary_percent ({self.rotary_percent}) must be in (0, 1]")
@@ -154,6 +168,26 @@ class TransformerConfig:
 
 def _attn_mask_fn(scores, mask):
     return jnp.where(mask.astype(bool), -10000.0, scores)
+
+
+_SWA_FLASH_WARNED = False
+
+
+def _warn_sliding_window_flash_once(window, seq):
+    """sliding_window takes the masked-softmax path (full [s, s] scores):
+    the flash kernel has no block-skip for bands yet, so long-seq SWA
+    does NOT get flash's memory savings. Trace-time, warn once."""
+    global _SWA_FLASH_WARNED
+    if _SWA_FLASH_WARNED:
+        return
+    _SWA_FLASH_WARNED = True
+    import warnings
+
+    warnings.warn(
+        f"sliding_window={window} < seq={seq} routes attention to the "
+        f"masked-softmax path; flash attention is bypassed (O(s^2) score "
+        f"materialization). For long sequences prefer seq <= window per "
+        f"segment or full causal + context parallelism.")
 
 
 def apply_rotary_emb(x, base: float = 10000.0, positions=None,
@@ -297,6 +331,19 @@ class ParallelAttention(nn.Module):
             rep = np_local // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
+
+        if (cfg.sliding_window is not None
+                and cfg.sliding_window < seq_full):
+            # fold the window band into the mask; a window covering the
+            # whole sequence is plain causal and keeps the flash path
+            if cfg.use_flash_attention:
+                _warn_sliding_window_flash_once(cfg.sliding_window,
+                                                seq_full)
+            i = jnp.arange(seq_full)[:, None]
+            j = jnp.arange(seq_full)[None, :]
+            band = (j > i) | (i - j >= cfg.sliding_window)
+            attention_mask = (band if attention_mask is None
+                              else band | attention_mask.astype(bool))
 
         # flash handles only the built-in causal/full patterns: an
         # explicit attention_mask (e.g. padding) must take the masked
@@ -442,7 +489,12 @@ class ParallelAttention(nn.Module):
         # j <= offset+i; unfilled cache tail is masked the same way
         jpos = jnp.arange(kv_len)[None, :]
         ipos = offset + jnp.arange(s)[:, None]
-        scores = jnp.where(jpos > ipos, -1e30, scores)
+        masked = jpos > ipos
+        if cfg.sliding_window is not None:
+            # stale cache entries beyond the window stay resident but
+            # invisible (Mistral semantics: 0 <= i - j < window)
+            masked = masked | (ipos - jpos >= cfg.sliding_window)
+        scores = jnp.where(masked, -1e30, scores)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bgrst,tbgd->sbgrd",
                          probs.astype(cfg.compute_dtype), vt,

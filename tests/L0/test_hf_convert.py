@@ -293,3 +293,54 @@ def test_neox_greedy_generation_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_mistral(seed=10, window=8):
+    cfg = transformers.MistralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=window, attention_dropout=0.0)
+    torch.manual_seed(seed)
+    return transformers.MistralForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_mistral_sliding_window():
+    """Sliding-window oracle: seq (24) well beyond the window (8), where
+    full causal attention would diverge from HF — pins the band mask."""
+    from tools.convert_hf_mistral import convert_mistral
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_mistral()
+    cfg, params = convert_mistral(hf.state_dict(), hf_cfg)
+    assert cfg.sliding_window == 8
+
+    tokens = np.random.RandomState(10).randint(0, 96, size=(2, 24))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_mistral_sliding_window_greedy_decode_matches_hf():
+    """KV-cache decode with stale-but-resident cache entries masked out
+    beyond the window: generate far past sliding_window, token-exact."""
+    from tools.convert_hf_mistral import convert_mistral
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_mistral(seed=11)
+    cfg, params = convert_mistral(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(11).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=16,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=16)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

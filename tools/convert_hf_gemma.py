@@ -26,6 +26,12 @@ import math
 import jax.numpy as jnp
 import numpy as np
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
 from tools.convert_hf_llama import _fused_qkv, _t
 
 
@@ -116,9 +122,6 @@ def convert_gemma(state_dict, hf_config):
 
 def main():
     import argparse
-    import sys
-
-    sys.path.insert(0, ".")
     ap = argparse.ArgumentParser()
     ap.add_argument("model_path")
     ap.add_argument("out_dir")

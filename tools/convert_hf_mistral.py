@@ -1,32 +1,23 @@
 """Convert a HuggingFace Mistral checkpoint into apex_tpu GPTModel params.
 
 Mistral's tensor layout and naming are identical to Llama's (RMSNorm,
-RoPE, SwiGLU, GQA, no biases) — the mapping is convert_llama verbatim.
-Note: Mistral's sliding-window attention applies only beyond
-``sliding_window`` tokens (4096 by default); apex_tpu computes full
-causal attention, so logits match only for sequences within the window —
-``convert_mistral`` warns and clamps max_position_embeddings to the
-window so longer sequences fail loudly instead of silently diverging.
+RoPE, SwiGLU, GQA, no biases) — the mapping is convert_llama verbatim —
+plus sliding-window attention: ``hf_config.sliding_window`` maps to
+``cfg.sliding_window`` (query i sees key j iff 0 <= i - j < window),
+so logits match HF beyond the window too.
 """
-
-import warnings
 
 from tools.convert_hf_llama import convert_llama
 
 
 def convert_mistral(state_dict, hf_config):
-    """convert_llama plus the sliding-window clamp (module docstring)."""
+    """convert_llama plus the sliding-window mapping (module docstring)."""
     import dataclasses
 
     cfg, params = convert_llama(state_dict, hf_config)
     window = getattr(hf_config, "sliding_window", None)
-    if window is not None and window < cfg.max_position_embeddings:
-        warnings.warn(
-            f"Mistral sliding_window={window} < max_position_embeddings="
-            f"{cfg.max_position_embeddings}: apex_tpu runs full causal "
-            f"attention, so logits diverge from HF beyond the window; "
-            f"clamping max_position_embeddings to {window}")
-        cfg = dataclasses.replace(cfg, max_position_embeddings=window)
+    if window is not None:
+        cfg = dataclasses.replace(cfg, sliding_window=window)
     return cfg, params
 
 

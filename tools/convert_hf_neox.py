@@ -21,6 +21,12 @@ NeoX specifics:
 
 import jax.numpy as jnp
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
 from tools.convert_hf_llama import _t
 
 
@@ -106,9 +112,6 @@ def convert_neox(state_dict, hf_config):
 
 def main():
     import argparse
-    import sys
-
-    sys.path.insert(0, ".")
     ap = argparse.ArgumentParser()
     ap.add_argument("model_path")
     ap.add_argument("out_dir")
