@@ -44,17 +44,55 @@ def _use_pallas():
         return False
 
 
-def _causal_mask(scores, qi, kj, block_q, block_k):
-    """Mask score entries above the diagonal for a (qi, kj) block pair."""
+def _causal_mask(scores, qi, kj, block_q, block_k, window=None):
+    """Mask score entries above the diagonal for a (qi, kj) block pair;
+    with ``window`` also below the sliding-window band (key j visible to
+    query i iff 0 <= i - j < window)."""
     q_ids = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_ids = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(q_ids >= k_ids, scores, NEG_INF)
+    visible = q_ids >= k_ids
+    if window is not None:
+        visible = visible & (q_ids - k_ids < window)
+    return jnp.where(visible, scores, NEG_INF)
+
+
+def _stream_kv_run(qi, kj, block_q, block_k, causal, window):
+    """Does kv block kj contribute to q block qi? (fwd / dq kernels)"""
+    if not causal:
+        return True
+    run = kj * block_k <= (qi + 1) * block_q - 1
+    if window is not None:
+        run = run & ((kj + 1) * block_k - 1 >= qi * block_q - window + 1)
+    return run
+
+
+def _stream_q_run(qi, kj, block_q, block_k, causal, window):
+    """Does q block qi contribute to kv block kj? (dkv kernel)"""
+    if not causal:
+        return True
+    run = (qi + 1) * block_q - 1 >= kj * block_k
+    if window is not None:
+        run = run & (qi * block_q <= _window_last_q_pos(kj, block_k,
+                                                        window))
+    return run
+
+
+def _window_first_kv_block(qi, block_q, block_k, window):
+    """First kv block inside the band for q block qi (index-map clamp;
+    must stay consistent with _stream_kv_run's lower bound)."""
+    return jnp.maximum(qi * block_q - window + 1, 0) // block_k
+
+
+def _window_last_q_pos(kj, block_k, window):
+    """Largest query index that can see any key in kv block kj."""
+    return (kj + 1) * block_k - 1 + window - 1
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                      l_ref, *, scale, causal, block_q, block_k, num_kv):
+                      l_ref, *, scale, causal, block_q, block_k, num_kv,
+                      window):
     """One (head, q-block, kv-block) grid cell of online-softmax attention.
 
     K/V arrive as [1, block_k, d] VMEM tiles streamed by the grid — VMEM
@@ -74,8 +112,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal: kv blocks entirely above the diagonal contribute nothing.
-    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+    # Causal: kv blocks entirely above the diagonal (or, windowed, fully
+    # below the band) contribute nothing.
+    run = _stream_kv_run(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
@@ -84,7 +123,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_cur = jnp.max(s, axis=-1)[:, None]
@@ -104,7 +143,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                      window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -118,15 +158,19 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
     grid = (b * n, s // block_q, num_kv)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_kv=num_kv)
+        block_k=block_k, num_kv=num_kv, window=window)
 
     if causal:
-        # Clamp masked kv blocks to the last contributing one: Pallas
+        # Clamp masked kv blocks into the contributing range: Pallas
         # skips the DMA when a block index repeats, so fully-above-diagonal
-        # K/V tiles are never fetched (the fori_loop design's early exit).
+        # (and, windowed, fully-below-band) K/V tiles are never fetched.
         def kv_index(h, i, j):
             last = ((i + 1) * block_q - 1) // block_k
-            return (h, jnp.minimum(j, last), 0)
+            j = jnp.minimum(j, last)
+            if window is not None:
+                j = jnp.maximum(j, _window_first_kv_block(
+                    i, block_q, block_k, window))
+            return (h, j, 0)
     else:
         def kv_index(h, i, j):
             return (h, j, 0)
@@ -166,7 +210,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                     num_kv):
+                     num_kv, window):
     """dq for one q block, streaming kv blocks (innermost grid dim):
     p = exp(q k^T scale - lse); ds = p * (do v^T - delta); dq += ds k scale.
     """
@@ -179,7 +223,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    run = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+    run = _stream_kv_run(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
@@ -191,7 +235,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -205,7 +249,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                      block_q, block_k, num_q):
+                      block_q, block_k, num_q, window):
     """dk/dv for one kv block, streaming q blocks (innermost grid dim):
     dv += p^T do;  dk += ds^T q scale."""
     from jax.experimental import pallas as pl
@@ -218,8 +262,9 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    # Causal: q blocks entirely above this kv block contribute nothing.
-    run = ((qi + 1) * block_q - 1 >= kj * block_k) if causal else True
+    # Causal: q blocks entirely above this kv block (or, windowed, beyond
+    # the band) contribute nothing.
+    run = _stream_q_run(qi, kj, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
@@ -231,7 +276,7 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)
         dv_acc[...] += jnp.dot(p.T, do,
                                preferred_element_type=jnp.float32)
@@ -247,7 +292,7 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
-                      block_k):
+                      block_k, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -264,18 +309,27 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
     if causal:
         def kv_index(h, i, j):
             last = ((i + 1) * block_q - 1) // block_k
-            return (h, jnp.minimum(j, last), 0)
+            j = jnp.minimum(j, last)
+            if window is not None:
+                j = jnp.maximum(j, _window_first_kv_block(
+                    i, block_q, block_k, window))
+            return (h, j, 0)
 
         def q_index_for_kv(h, j, i):
             first = (j * block_k) // block_q
-            return (h, jnp.maximum(i, first), 0)
+            i = jnp.maximum(i, first)
+            if window is not None:
+                i = jnp.minimum(
+                    i, _window_last_q_pos(j, block_k, window) // block_q)
+            return (h, i, 0)
     else:
         kv_index = lambda h, i, j: (h, j, 0)            # noqa: E731
         q_index_for_kv = lambda h, j, i: (h, i, 0)      # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_kv=num_kv),
+                          block_q=block_q, block_k=block_k, num_kv=num_kv,
+                          window=window),
         grid=(b * n, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
@@ -302,7 +356,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q=num_q),
+                          block_q=block_q, block_k=block_k, num_q=num_q,
+                          window=window),
         grid=(b * n, num_kv, num_q),
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0),
@@ -341,7 +396,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
     return rs(dq), rs(dk), rs(dv)
 
 
-def _attention_reference(q, k, v, scale, causal):
+def _attention_reference(q, k, v, scale, causal, window=None):
     """Reference einsum attention (fp32 softmax), used for the backward
     rematerialization and the non-TPU fallback."""
     s = jnp.einsum("bnqd,bnkd->bnqk", q.astype(jnp.float32),
@@ -349,6 +404,9 @@ def _attention_reference(q, k, v, scale, causal):
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            mask = mask & jnp.triu(jnp.ones((sq, sk), bool),
+                                   k=sk - sq - window + 1)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bnqk,bnkd->bnqd", p, v.astype(jnp.float32)).astype(q.dtype)
@@ -382,33 +440,54 @@ def _resolve(q, scale, block_q, block_k):
     return scale, _fit_block(block_q, s), _fit_block(block_k, s)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _check_window(window, causal):
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("flash_attention window requires causal=True")
+    if not isinstance(window, int) or window < 1:
+        raise ValueError(f"flash_attention window must be a positive "
+                         f"static int, got {window!r}")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=True, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Flash attention over [batch, heads, seq, head_dim] inputs."""
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    window=None):
+    """Flash attention over [batch, heads, seq, head_dim] inputs.
+
+    ``window``: sliding-window band (key j visible to query i iff
+    0 <= i - j < window); blocks fully outside the band are skipped, so
+    compute scales with seq * window instead of seq^2."""
+    _check_window(window, causal)
     scale, bq, bk = _resolve(q, scale, block_q, block_k)
     if _use_pallas() and bq is not None and bk is not None:
-        return _flash_fwd_pallas(q, k, v, scale, causal, bq, bk)[0]
-    return _attention_reference(q, k, v, scale, causal)
+        return _flash_fwd_pallas(q, k, v, scale, causal, bq, bk,
+                                 window)[0]
+    return _attention_reference(q, k, v, scale, causal, window)
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k,
+                    window=None):
+    _check_window(window, causal)
     scale_, bq, bk = _resolve(q, scale, block_q, block_k)
     if _use_pallas() and bq is not None and bk is not None:
-        out, lse = _flash_fwd_pallas(q, k, v, scale_, causal, bq, bk)
+        out, lse = _flash_fwd_pallas(q, k, v, scale_, causal, bq, bk,
+                                     window)
         return out, (q, k, v, out, lse)
-    return _attention_reference(q, k, v, scale_, causal), (q, k, v, None,
-                                                           None)
+    return (_attention_reference(q, k, v, scale_, causal, window),
+            (q, k, v, None, None))
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
+def _flash_bwd_rule(causal, scale, block_q, block_k, window, res, g):
     q, k, v, out, lse = res
     scale_, bq, bk = _resolve(q, scale, block_q, block_k)
     if lse is not None and _use_pallas():
         return _flash_bwd_pallas(q, k, v, out, lse, g, scale_, causal,
-                                 bq, bk)
+                                 bq, bk, window)
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale_, causal),
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale_,
+                                                causal, window),
         q, k, v)
     return vjp(g)
 

@@ -174,9 +174,11 @@ _SWA_FLASH_WARNED = False
 
 
 def _warn_sliding_window_flash_once(window, seq):
-    """sliding_window takes the masked-softmax path (full [s, s] scores):
-    the flash kernel has no block-skip for bands yet, so long-seq SWA
-    does NOT get flash's memory savings. Trace-time, warn once."""
+    """Flash supports the window band natively (fmha kernel block-skip),
+    but it was unavailable at this call site (non-TPU backend, an
+    explicit attention_mask, or seq not a block multiple) — the
+    masked-softmax path materializes full [s, s] scores. Trace-time,
+    warn once."""
     global _SWA_FLASH_WARNED
     if _SWA_FLASH_WARNED:
         return
@@ -184,10 +186,11 @@ def _warn_sliding_window_flash_once(window, seq):
     import warnings
 
     warnings.warn(
-        f"sliding_window={window} < seq={seq} routes attention to the "
-        f"masked-softmax path; flash attention is bypassed (O(s^2) score "
-        f"materialization). For long sequences prefer seq <= window per "
-        f"segment or full causal + context parallelism.")
+        f"sliding_window={window} < seq={seq}: flash attention was "
+        f"requested but unavailable here (non-TPU backend, explicit "
+        f"attention_mask, or seq/head_dim outside the kernel's blocks); "
+        f"falling back to masked softmax with O(s^2) score "
+        f"materialization.")
 
 
 def apply_rotary_emb(x, base: float = 10000.0, positions=None,
@@ -332,22 +335,15 @@ class ParallelAttention(nn.Module):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
 
-        if (cfg.sliding_window is not None
-                and cfg.sliding_window < seq_full):
-            # fold the window band into the mask; a window covering the
-            # whole sequence is plain causal and keeps the flash path
-            if cfg.use_flash_attention:
-                _warn_sliding_window_flash_once(cfg.sliding_window,
-                                                seq_full)
-            i = jnp.arange(seq_full)[:, None]
-            j = jnp.arange(seq_full)[None, :]
-            band = (j > i) | (i - j >= cfg.sliding_window)
-            attention_mask = (band if attention_mask is None
-                              else band | attention_mask.astype(bool))
+        # a window covering the whole sequence is plain causal
+        win = (cfg.sliding_window
+               if (cfg.sliding_window is not None
+                   and cfg.sliding_window < seq_full) else None)
 
-        # flash handles only the built-in causal/full patterns: an
-        # explicit attention_mask (e.g. padding) must take the masked
-        # softmax path below or it would be silently ignored.
+        # flash handles the built-in causal/full patterns and the
+        # sliding-window band (kernel block-skip); an explicit
+        # attention_mask (e.g. padding) must take the masked softmax
+        # path below or it would be silently ignored.
         if (cfg.use_flash_attention and attention_mask is None
                 and _flash_available(seq_full, kv)):
             from apex_tpu.contrib.fmha import flash_attention
@@ -358,9 +354,21 @@ class ParallelAttention(nn.Module):
             vt = v.transpose(1, 2, 0, 3)
             ctx = flash_attention(
                 qt, kt, vt,
-                causal=(cfg.attn_mask_type == AttnMaskType.causal))
+                causal=(cfg.attn_mask_type == AttnMaskType.causal),
+                window=win)
             ctx = ctx.transpose(2, 0, 1, 3)  # [s, b, n, d]
         else:
+            if win is not None:
+                # fold the window band into the mask (masked-softmax path
+                # materializes full [s, s] scores — warn when the caller
+                # asked for flash but it was unavailable here)
+                if cfg.use_flash_attention:
+                    _warn_sliding_window_flash_once(win, seq_full)
+                i = jnp.arange(seq_full)[:, None]
+                j = jnp.arange(seq_full)[None, :]
+                band = (j > i) | (i - j >= win)
+                attention_mask = (band if attention_mask is None
+                                  else band | attention_mask.astype(bool))
             # core attention (reference CoreAttention): [b, n, s, s] scores
             qt = q.transpose(1, 2, 0, 3).astype(cfg.compute_dtype)
             kt = k.transpose(1, 2, 0, 3).astype(cfg.compute_dtype)

@@ -56,6 +56,42 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("window", [1, 37, 64, 100, 256])
+    def test_sliding_window_matches_reference(self, rng, window):
+        """Windowed flash (block-skip band) vs the reference band mask:
+        windows below/at/above the block size and spanning several
+        blocks, forward and all three gradients."""
+        b, n, s, d = 1, 2, 256, 64
+        q = jnp.asarray(rng.randn(b, n, s, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, n, s, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, n, s, d).astype(np.float32))
+
+        out = flash_attention(q, k, v, True, None, 64, 64, window)
+        ref = _attention_reference(q, k, v, 1.0 / np.sqrt(d), True,
+                                   window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+        def f(q_, k_, v_):
+            return jnp.sum(
+                flash_attention(q_, k_, v_, True, None, 64, 64,
+                                window) ** 2)
+
+        def f_ref(q_, k_, v_):
+            return jnp.sum(_attention_reference(
+                q_, k_, v_, 1.0 / np.sqrt(d), True, window) ** 2)
+
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_window_requires_causal(self):
+        q = jnp.zeros((1, 1, 128, 64), jnp.float32)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, q, q, False, None, 64, 64, 37)
+
     @pytest.mark.parametrize("causal", [True, False])
     @pytest.mark.parametrize("bq,bk", [(64, 64), (64, 128), (128, 64)])
     def test_streamed_backward_multiblock(self, rng, causal, bq, bk):

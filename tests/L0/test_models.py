@@ -131,3 +131,31 @@ def test_gpt_flash_attention_path_jits(monkeypatch, rng):
     gmax = max(float(jnp.abs(x).max())
                for x in jax.tree_util.tree_leaves(grads))
     assert np.isfinite(gmax) and gmax > 0
+
+
+def test_gpt_sliding_window_flash_matches_masked_path(monkeypatch, rng):
+    """Model-level SWA through the flash kernel (window band block-skip)
+    must match the masked-softmax fold of the same config."""
+    import apex_tpu.contrib.fmha as fmha_mod
+    import apex_tpu.models.transformer_lm as tlm
+
+    from apex_tpu.models import GPTModel, TransformerConfig
+
+    tokens = jnp.asarray(rng.randint(0, 128, (1, 128)))
+
+    def logits(use_flash):
+        cfg = TransformerConfig(
+            hidden_size=64, num_layers=2, num_attention_heads=1,
+            vocab_size=128, max_position_embeddings=128,
+            compute_dtype=jnp.float32, use_flash_attention=use_flash,
+            sliding_window=40)
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        return np.asarray(model.apply(params, tokens))
+
+    masked = logits(use_flash=False)
+    monkeypatch.setattr(fmha_mod, "_INTERPRET", True)
+    monkeypatch.setattr(fmha_mod, "_use_pallas", lambda: True)
+    monkeypatch.setattr(tlm, "_flash_available", lambda s, d: True)
+    flash = logits(use_flash=True)
+    np.testing.assert_allclose(flash, masked, rtol=2e-4, atol=2e-4)
