@@ -105,6 +105,11 @@ class GPTModel(nn.Module):
             logits = jnp.einsum("sbh,hv->sbv", h,
                                 head.astype(cfg.compute_dtype),
                                 preferred_element_type=jnp.float32)
+            if cfg.lm_head_bias:
+                logits = logits + self.param(
+                    "lm_head_bias", nn.initializers.zeros,
+                    (vocab_per_rank,), cfg.params_dtype).astype(
+                        logits.dtype)
         return logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
 
 

@@ -56,6 +56,10 @@ class GPTStage(nn.Module):
             lambda key, shape, dtype: nn.initializers.normal(0.02)(
                 _fold_tp(key), shape, dtype),
             (cfg.hidden_size, divide(cfg.vocab_size, tp)), cfg.params_dtype)
+        self.lm_head_bias = (self.param(
+            "lm_head_bias", nn.initializers.zeros,
+            (divide(cfg.vocab_size, tp),), cfg.params_dtype)
+            if cfg.lm_head_bias else None)
 
     def embed(self, tokens):
         cfg = self.config
@@ -89,6 +93,8 @@ class GPTStage(nn.Module):
         logits = jnp.einsum("sbh,hv->sbv", h,
                             self.lm_head.astype(cfg.compute_dtype),
                             preferred_element_type=jnp.float32)
+        if self.lm_head_bias is not None:
+            logits = logits + self.lm_head_bias.astype(logits.dtype)
         logits = logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
         losses = vocab_parallel_cross_entropy(logits, labels)
         if loss_mask is not None:

@@ -100,7 +100,7 @@ def _dense_tp_rule(cfg, tp):
             return _replicate(leaf, tp)  # row bias: added once post-psum
         if "word_embeddings" in names:
             return _split_contiguous(leaf, tp, -2)
-        if "lm_head" in names:
+        if "lm_head" in names or "lm_head_bias" in names:
             return _split_contiguous(leaf, tp, -1)
         if leaf.ndim >= 2 and not (names & _REPLICATED_MODULES):
             raise ValueError(

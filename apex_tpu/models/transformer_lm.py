@@ -99,6 +99,12 @@ class TransformerConfig:
     # leading fraction of each head's dims (rotary_pct).
     parallel_residual: bool = False
     rotary_percent: float = 1.0
+    # Phi/Falcon-7b form of the parallel residual: ONE layernorm feeds
+    # both branches (no post_attention_layernorm params).
+    parallel_residual_shared_ln: bool = False
+    # Phi ties a bias to the LM head projection (vocab-parallel sliced
+    # with the head columns).
+    lm_head_bias: bool = False
     # Mistral-style sliding-window attention: query i sees key j iff
     # 0 <= i - j < sliding_window (on top of causal). None -> full causal.
     sliding_window: Optional[int] = None
@@ -122,6 +128,13 @@ class TransformerConfig:
                     "sliding_window does not compose with context "
                     "parallelism (the ring/ulysses kernels run full "
                     "causal attention)")
+        if self.parallel_residual_shared_ln and not self.parallel_residual:
+            raise ValueError(
+                "parallel_residual_shared_ln requires parallel_residual")
+        if self.lm_head_bias and self.tie_word_embeddings:
+            raise ValueError(
+                "lm_head_bias requires an untied head (the tied path "
+                "contracts with the embedding table and has no bias)")
         if not 0.0 < self.rotary_percent <= 1.0:
             raise ValueError(
                 f"rotary_percent ({self.rotary_percent}) must be in (0, 1]")
@@ -580,15 +593,18 @@ class ParallelTransformerLayer(nn.Module):
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
         ln1 = _make_norm(cfg, "input_layernorm")
+        ln1_out = ln1(hidden_states.astype(jnp.float32)).astype(
+            cfg.compute_dtype)
         attn_out = ParallelAttention(cfg, decode=self.decode,
                                      name="self_attention")(
-            ln1(hidden_states.astype(jnp.float32)).astype(cfg.compute_dtype),
-            attention_mask, position_ids)
+            ln1_out, attention_mask, position_ids)
         residual = hidden_states  # pre-attn input (parallel-residual form)
         if not cfg.parallel_residual:
             hidden_states = hidden_states + attn_out.astype(
                 hidden_states.dtype)
-        ln2 = _make_norm(cfg, "post_attention_layernorm")
+        # Phi/Falcon-7b: no second norm — both branches read ln1's output
+        ln2 = (None if cfg.parallel_residual_shared_ln
+               else _make_norm(cfg, "post_attention_layernorm"))
         if self._is_moe_layer():
             from apex_tpu.transformer.moe import SwitchMLP
 
@@ -605,8 +621,10 @@ class ParallelTransformerLayer(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel, name="mlp")
         else:
             mlp = ParallelMLP(cfg, name="mlp")
-        mlp_out = mlp(
-            ln2(hidden_states.astype(jnp.float32)).astype(cfg.compute_dtype))
+        mlp_in = (ln1_out if ln2 is None else
+                  ln2(hidden_states.astype(jnp.float32)).astype(
+                      cfg.compute_dtype))
+        mlp_out = mlp(mlp_in)
         if cfg.parallel_residual:
             # GPT-NeoX form: both branches read the SAME input (ln2 is
             # applied to the pre-attn stream) and sum into one residual

@@ -344,3 +344,59 @@ def test_mistral_sliding_window_greedy_decode_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=16)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_phi(seed=15):
+    cfg = transformers.PhiConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        partial_rotary_factor=0.5, attention_dropout=0.0,
+        resid_pdrop=0.0, embd_pdrop=0.0)
+    torch.manual_seed(seed)
+    return transformers.PhiForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_phi():
+    """Phi oracle: shared-LN parallel residual + partial rotary + biased
+    head against HF's independent implementation."""
+    from tools.convert_hf_phi import convert_phi
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_phi()
+    cfg, params = convert_phi(hf.state_dict(), hf_cfg)
+    assert cfg.parallel_residual_shared_ln and cfg.lm_head_bias
+    # HF zero-inits the head bias; randomize so the mapping is exercised
+    params["lm_head_bias"] = jnp.asarray(
+        np.random.RandomState(1).randn(96).astype(np.float32) * 0.3)
+    with torch.no_grad():
+        hf.lm_head.bias.copy_(torch.asarray(
+            np.asarray(params["lm_head_bias"])))
+
+    tokens = np.random.RandomState(15).randint(0, 96, size=(2, 24))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_phi_greedy_generation_matches_hf():
+    from tools.convert_hf_phi import convert_phi
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_phi(seed=16)
+    cfg, params = convert_phi(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(16).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

@@ -239,3 +239,42 @@ def test_moe_scan_layers_split_slices_expert_axis():
     # [ep, tp, L, E/ep, h, 2*ffn/tp]
     assert w1.shape == (2, 2, cfg.num_layers, 2, cfg.hidden_size,
                         2 * cfg.ffn_size // 2)
+
+
+def test_hf_phi_checkpoint_through_3d_pipeline():
+    """Biased-head migration story: HF Phi (shared-LN parallel residual,
+    partial rotary, lm_head bias) converted, resharded to pp x tp x dp —
+    covers the vocab-column split of the 1-D head bias and the GPTStage
+    bias add."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import sys
+
+    sys.path.insert(0, ".")
+    from tools.convert_hf_phi import convert_phi
+
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        partial_rotary_factor=0.5, attention_dropout=0.0,
+        resid_pdrop=0.0, embd_pdrop=0.0)
+    torch.manual_seed(21)
+    hf = transformers.PhiForCausalLM(hf_cfg).eval()
+    with torch.no_grad():  # nonzero bias so the vocab split is exercised
+        hf.lm_head.bias.copy_(torch.randn_like(hf.lm_head.bias) * 0.3)
+    cfg, params = convert_phi(hf.state_dict(), hf_cfg)
+    assert float(jnp.abs(params["lm_head_bias"]).sum()) > 0
+
+    rng = np.random.RandomState(21)
+    global_b = MB * M * DP
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (global_b, SEQ)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (global_b, SEQ)))
+
+    parallel_state.initialize_model_parallel(devices=jax.devices()[:1])
+    logits = GPTModel(cfg).apply({"params": params}, tokens)
+    ref_loss = float(gpt_loss_fn(logits, labels))
+    parallel_state.destroy_model_parallel()
+
+    pipe_loss = _pipelined_loss(cfg, params, tokens, labels)
+    np.testing.assert_allclose(pipe_loss, ref_loss, rtol=2e-4)

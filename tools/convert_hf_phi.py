@@ -1,0 +1,143 @@
+"""Convert a HuggingFace Phi (phi-1/1.5/2) checkpoint into apex_tpu params.
+
+Phi specifics:
+
+- Parallel residual with ONE shared layernorm: the layer's
+  `input_layernorm` output feeds both the attention and MLP branches
+  (`cfg.parallel_residual` + `cfg.parallel_residual_shared_ln`; there is
+  no post_attention_layernorm param).
+- Partial rotary (`partial_rotary_factor`, phi-2 uses 0.4) ->
+  ``cfg.rotary_percent``.
+- q/k/v/dense and fc1/fc2 all carry biases; the LM head does too ->
+  ``cfg.lm_head_bias``.
+- gelu_new MLP -> our tanh-approx "gelu" path; LayerNorm with bias.
+
+``qk_layernorm=True`` checkpoints (per-head q/k norms) are refused — no
+apex_tpu analog.
+
+    from transformers import PhiForCausalLM
+    from tools.convert_hf_phi import convert_phi
+
+    hf = PhiForCausalLM.from_pretrained("microsoft/phi-2")
+    cfg, params = convert_phi(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _t
+
+
+def convert_phi(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a PhiForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "qk_layernorm", False):
+        raise ValueError("qk_layernorm=True Phi checkpoints are not "
+                         "supported (no per-head q/k norm analog)")
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.layer_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rotary_percent=getattr(hf_config, "partial_rotary_factor", 0.5),
+        parallel_residual=True,
+        parallel_residual_shared_ln=True,
+        num_query_groups=(g if g != n else None),
+        lm_head_bias=True,
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def ln(prefix):
+        return {"weight": jnp.asarray(_t(sd[f"{prefix}.weight"])),
+                "bias": jnp.asarray(_t(sd[f"{prefix}.bias"]))}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused_w = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                             lin_t(f"{p}.self_attn.k_proj.weight"),
+                             lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        fused_b = _fused_qkv(_t(sd[f"{p}.self_attn.q_proj.bias"]),
+                             _t(sd[f"{p}.self_attn.k_proj.bias"]),
+                             _t(sd[f"{p}.self_attn.v_proj.bias"]), n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.input_layernorm"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused_w),
+                    "bias": jnp.asarray(fused_b),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.dense.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.self_attn.dense.bias"])),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.mlp.fc1.weight")),
+                    "bias": jnp.asarray(_t(sd[f"{p}.mlp.fc1.bias"])),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.mlp.fc2.weight")),
+                    "bias": jnp.asarray(_t(sd[f"{p}.mlp.fc2.bias"])),
+                },
+            },
+        }
+
+    return cfg, {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln("final_layernorm"),
+        "lm_head": jnp.asarray(_t(state_dict["lm_head.weight"]).T),
+        "lm_head_bias": jnp.asarray(_t(state_dict["lm_head.bias"])),
+    }
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import PhiForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = PhiForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_phi(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
