@@ -87,7 +87,10 @@ class TransformerConfig:
     num_query_groups: Optional[int] = None  # None -> MHA (groups == heads)
     position_embedding_type: str = "learned"  # or "rope"
     rotary_base: float = 10000.0
-    activation: str = "gelu"  # or "swiglu" / "geglu"
+    # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact"
+    # the erf form (HF "gelu" — Falcon/NeoX default); "swiglu"/"geglu"
+    # are the gated fused forms.
+    activation: str = "gelu"
     # Scale token embeddings by this factor on entry (Gemma family uses
     # sqrt(hidden_size); the tied head contracts with the UNSCALED table).
     embedding_multiplier: Optional[float] = None
@@ -151,7 +154,8 @@ class TransformerConfig:
                 f"unknown position_embedding_type "
                 f"{self.position_embedding_type!r}; expected 'learned' or "
                 f"'rope'")
-        if self.activation not in ("gelu", "swiglu", "geglu"):
+        if self.activation not in ("gelu", "gelu_exact", "swiglu",
+                                   "geglu"):
             raise ValueError(f"unknown activation {self.activation!r}")
         if self.normalization not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown normalization {self.normalization!r}")
@@ -559,18 +563,22 @@ class ParallelMLP(nn.Module):
             gate, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
             act = jax.nn.silu if cfg.activation == "swiglu" else jax.nn.gelu
             x = (act(gate) * up).astype(cfg.compute_dtype)
-        elif cfg.activation == "gelu":
+        elif cfg.activation in ("gelu", "gelu_exact"):
             x = ColumnParallelLinear(
                 input_size=cfg.hidden_size, output_size=cfg.ffn_size,
                 gather_output=False, bias=True, params_dtype=cfg.params_dtype,
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 name="dense_h_to_4h")(hidden_states.astype(cfg.compute_dtype))
-            x = jax.nn.gelu(x.astype(jnp.float32)).astype(cfg.compute_dtype)
+            x = jax.nn.gelu(
+                x.astype(jnp.float32),
+                approximate=(cfg.activation == "gelu")
+            ).astype(cfg.compute_dtype)
         else:
             raise ValueError(f"unknown activation {cfg.activation!r}")
         x = RowParallelLinear(
             input_size=cfg.ffn_size, output_size=cfg.hidden_size,
-            input_is_parallel=True, bias=(cfg.activation == "gelu"),
+            input_is_parallel=True,
+            bias=(cfg.activation in ("gelu", "gelu_exact")),
             params_dtype=cfg.params_dtype,
             sequence_parallel_enabled=cfg.sequence_parallel,
             name="dense_4h_to_h")(x)

@@ -400,3 +400,61 @@ def test_phi_greedy_generation_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+@pytest.mark.parametrize("variant", ["7b_mqa", "rw_mha", "rw_mha_bias",
+                                     "new_arch"])
+def test_logits_match_hf_falcon(variant):
+    """Falcon oracle across all three attention layouts and residual
+    forms: 7b (MQA + shared-LN parallel residual), rw (MHA, sequential
+    residual), 40b-style (grouped new_decoder_architecture, two LNs)."""
+    from tools.convert_hf_falcon import convert_falcon
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    kw = dict(vocab_size=96, hidden_size=48, num_hidden_layers=2,
+              num_attention_heads=4, max_position_embeddings=64,
+              alibi=False, attention_dropout=0.0, hidden_dropout=0.0,
+              bias=False)
+    if variant == "7b_mqa":
+        kw.update(multi_query=True, parallel_attn=True,
+                  new_decoder_architecture=False)
+    elif variant.startswith("rw_mha"):
+        kw.update(multi_query=False, parallel_attn=False,
+                  new_decoder_architecture=False,
+                  bias=variant.endswith("bias"))
+    else:
+        kw.update(new_decoder_architecture=True, num_kv_heads=2)
+    hf_cfg = transformers.FalconConfig(**kw)
+    torch.manual_seed(17)
+    hf = transformers.FalconForCausalLM(hf_cfg).eval()
+    if variant == "rw_mha_bias":
+        # HF zero-inits projection biases; randomize so the mapping
+        # (incl. the qkv bias regroup) is actually exercised
+        with torch.no_grad():
+            for name, prm in hf.named_parameters():
+                if name.endswith(".bias") and "layernorm" not in name                         and "ln_" not in name:
+                    prm.copy_(torch.randn_like(prm) * 0.3)
+    cfg, params = convert_falcon(hf.state_dict(), hf_cfg)
+    if variant == "rw_mha_bias":
+        b0 = params["transformer"]["layer_0"]["self_attention"][
+            "query_key_value"]["bias"]
+        assert float(jnp.abs(b0).sum()) > 0
+
+    tokens = np.random.RandomState(17).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_falcon_refuses_alibi():
+    from tools.convert_hf_falcon import convert_falcon
+
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=1,
+        num_attention_heads=4, alibi=True)
+    with pytest.raises(ValueError, match="alibi"):
+        convert_falcon({}, hf_cfg)

@@ -1,0 +1,193 @@
+"""Convert a HuggingFace Falcon checkpoint into apex_tpu GPTModel params.
+
+Covers all three Falcon attention layouts:
+
+- ``multi_query=True`` (falcon-7b): fused columns [q_0..q_{n-1} | k | v]
+  — already apex_tpu's GQA layout with one group; direct transpose.
+- ``multi_query=False`` (falcon-rw without alibi): per-head
+  [q_i | k_i | v_i] blocks — apex_tpu's MHA layout; direct transpose.
+- ``new_decoder_architecture=True`` (falcon-40b/180b): per-kv-group
+  [q..q | k | v] interleaved blocks — permuted here into
+  [all q | per-group k|v].
+
+Residual forms: ``parallel_attn=False`` -> standard pre-LN blocks;
+``parallel_attn=True`` with one LN (7b) ->
+``parallel_residual_shared_ln``; with two LNs (40b: ``ln_attn``/
+``ln_mlp``) -> plain ``parallel_residual``. Projection biases follow
+``hf_config.bias`` (mapped when present, zero-filled otherwise);
+``alibi=True`` checkpoints are refused (no alibi analog).
+
+    from transformers import FalconForCausalLM
+    from tools.convert_hf_falcon import convert_falcon
+
+    hf = FalconForCausalLM.from_pretrained("tiiuae/falcon-7b")
+    cfg, params = convert_falcon(hf.state_dict(), hf.config)
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _map_gelu, _t
+
+
+def _regroup_qkv(w, n, g, d, new_arch, multi_query):
+    """HF fused qkv [..., out] (weight [h, out] or 1-D bias [out]) ->
+    apex_tpu fused [q heads | per-group k|v] (or the per-head MHA
+    layout, which needs no change)."""
+    if new_arch:
+        lead = w.shape[:-1]
+        per = n // g
+        grouped = w.reshape(*lead, g, per + 2, d)
+        q = grouped[..., :per, :].reshape(*lead, n * d)
+        blocks = [q]
+        for grp in range(g):
+            blocks += [grouped[..., grp, per, :],
+                       grouped[..., grp, per + 1, :]]
+        return np.concatenate(blocks, axis=-1)
+    # multi_query: [all q | k | v] is our g=1 layout already;
+    # full MHA: per-head [q|k|v] blocks are our MHA layout already
+    del multi_query
+    return w
+
+
+def convert_falcon(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a FalconForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "alibi", False):
+        raise ValueError("alibi Falcon checkpoints are not supported "
+                         "(no alibi position-bias analog)")
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    new_arch = getattr(hf_config, "new_decoder_architecture", False)
+    multi_query = getattr(hf_config, "multi_query", True)
+    if new_arch:
+        g = getattr(hf_config, "num_kv_heads", None) or n
+    elif multi_query:
+        g = 1
+    else:
+        g = n
+    d = hf_config.hidden_size // n
+    parallel = new_arch or getattr(hf_config, "parallel_attn", True)
+    two_ln = new_arch and getattr(hf_config, "num_ln_in_parallel_attn",
+                                  2) != 1
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=getattr(hf_config, "ffn_hidden_size", None)
+        or 4 * hf_config.hidden_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=getattr(
+            hf_config, "max_position_embeddings", 2048),
+        layernorm_epsilon=hf_config.layer_norm_epsilon,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        activation=_map_gelu(getattr(hf_config, "activation", "gelu")),
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        parallel_residual=parallel,
+        parallel_residual_shared_ln=(parallel and not two_ln),
+        num_query_groups=(g if g != n else None),
+        tie_word_embeddings=False,
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def ln(prefix):
+        return {"weight": jnp.asarray(_t(sd[f"{prefix}.weight"])),
+                "bias": jnp.asarray(_t(sd[f"{prefix}.bias"]))}
+
+    use_bias = getattr(hf_config, "bias", False)
+
+    def bias_of(key, size, regroup=False):
+        if not use_bias:
+            return jnp.zeros((size,), jnp.float32)
+        bvec = _t(sd[key])
+        if regroup:
+            bvec = _regroup_qkv(bvec, n, g, d, new_arch, multi_query)
+        return jnp.asarray(bvec)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"h.{i}"
+        fused = _regroup_qkv(
+            lin_t(f"{p}.self_attention.query_key_value.weight"),
+            n, g, d, new_arch, multi_query)
+        entry = {
+            "input_layernorm": ln(
+                f"{p}.ln_attn" if two_ln else f"{p}.input_layernorm"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": bias_of(
+                        f"{p}.self_attention.query_key_value.bias",
+                        fused.shape[-1], regroup=True),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attention.dense.weight")),
+                    "bias": bias_of(f"{p}.self_attention.dense.bias",
+                                    cfg.hidden_size),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.dense_h_to_4h.weight")),
+                    "bias": bias_of(f"{p}.mlp.dense_h_to_4h.bias",
+                                    cfg.ffn_size),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.dense_4h_to_h.weight")),
+                    "bias": bias_of(f"{p}.mlp.dense_4h_to_h.bias",
+                                    cfg.hidden_size),
+                },
+            },
+        }
+        if two_ln:
+            entry["post_attention_layernorm"] = ln(f"{p}.ln_mlp")
+        elif not parallel:
+            entry["post_attention_layernorm"] = ln(
+                f"{p}.post_attention_layernorm")
+        layers[f"layer_{i}"] = entry
+
+    return cfg, {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["word_embeddings.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln("ln_f"),
+        "lm_head": jnp.asarray(_t(state_dict["lm_head.weight"]).T),
+    }
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import FalconForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = FalconForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_falcon(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,18 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _map_gelu(act):
+    """HF activation string -> apex_tpu activation for non-gated gelu
+    MLPs: tanh approximations map to "gelu", exact erf to "gelu_exact";
+    anything else is refused (silent mis-mapping changes numerics)."""
+    if act in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast",
+               "gelu_python_tanh"):
+        return "gelu"
+    if act in ("gelu", "gelu_python"):
+        return "gelu_exact"
+    raise ValueError(f"unsupported MLP activation {act!r}")
+
+
 def _t(x):
     return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
                       else x)

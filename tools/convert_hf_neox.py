@@ -27,7 +27,7 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
     _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
 
-from tools.convert_hf_llama import _t
+from tools.convert_hf_llama import _map_gelu, _t
 
 
 def convert_neox(state_dict, hf_config):
@@ -47,6 +47,7 @@ def convert_neox(state_dict, hf_config):
         compute_dtype=jnp.float32,
         use_flash_attention=False,
         normalization="layernorm",
+        activation=_map_gelu(getattr(hf_config, "hidden_act", "gelu")),
         position_embedding_type="rope",
         rotary_base=getattr(hf_config, "rotary_emb_base", 10000.0),
         rotary_percent=getattr(hf_config, "rotary_pct", 1.0),

@@ -30,7 +30,7 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
     _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
 
-from tools.convert_hf_llama import _fused_qkv, _t
+from tools.convert_hf_llama import _fused_qkv, _map_gelu, _t
 
 
 def convert_phi(state_dict, hf_config):
@@ -58,6 +58,8 @@ def convert_phi(state_dict, hf_config):
         compute_dtype=jnp.float32,
         use_flash_attention=False,
         normalization="layernorm",
+        activation=_map_gelu(getattr(hf_config, "hidden_act",
+                                     "gelu_new")),
         position_embedding_type="rope",
         rotary_base=getattr(hf_config, "rope_theta", 10000.0),
         rotary_percent=getattr(hf_config, "partial_rotary_factor", 0.5),
