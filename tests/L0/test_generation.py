@@ -317,7 +317,8 @@ class TestSplitParamsForTP:
     the SAME weights decoded at tp=1 and tp=2 must emit identical
     tokens (value parity, not just shape parity)."""
 
-    @pytest.mark.parametrize("arch", ["mha_gelu", "gqa_swiglu"])
+    @pytest.mark.parametrize("arch", ["mha_gelu", "gqa_swiglu",
+                                      "phi_style", "mistral_swa"])
     def test_tp2_matches_tp1_greedy(self, arch):
         from apex_tpu.models import (GPTModel, TransformerConfig, generate,
                                      split_params_for_tp,
@@ -327,6 +328,17 @@ class TestSplitParamsForTP:
         if arch == "gqa_swiglu":
             kw = dict(num_query_groups=2, activation="swiglu",
                       normalization="rmsnorm",
+                      position_embedding_type="rope")
+        elif arch == "phi_style":
+            # shared-LN parallel residual + biased head + partial rotary
+            # + decoupled head_dim (the phi/neox knob set under tp)
+            kw = dict(parallel_residual=True,
+                      parallel_residual_shared_ln=True, lm_head_bias=True,
+                      rotary_percent=0.5, head_dim=16,
+                      position_embedding_type="rope")
+        elif arch == "mistral_swa":
+            kw = dict(num_query_groups=2, activation="swiglu",
+                      normalization="rmsnorm", sliding_window=5,
                       position_embedding_type="rope")
         cfg = TransformerConfig(
             hidden_size=32, num_layers=2, num_attention_heads=4,
@@ -340,6 +352,10 @@ class TestSplitParamsForTP:
         parallel_state.initialize_model_parallel(devices=jax.devices()[:1])
         model1 = GPTModel(cfg, decode=True)
         params1 = model1.init(jax.random.PRNGKey(7), prompt)["params"]
+        if arch == "phi_style":
+            # zero-init head bias would make the vocab split vacuous
+            params1["lm_head_bias"] = jnp.asarray(
+                rng.randn(cfg.vocab_size).astype(np.float32) * 0.3)
         out1 = generate(model1, params1, prompt, 6)
 
         # tp=2: same weights, split
