@@ -88,8 +88,8 @@ class TransformerConfig:
     position_embedding_type: str = "learned"  # or "rope"
     rotary_base: float = 10000.0
     # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact"
-    # the erf form (HF "gelu" — Falcon/NeoX default); "swiglu"/"geglu"
-    # are the gated fused forms.
+    # the erf form (HF "gelu" — Falcon/NeoX default); "relu" (OPT);
+    # "swiglu"/"geglu" are the gated fused forms.
     activation: str = "gelu"
     # Scale token embeddings by this factor on entry (Gemma family uses
     # sqrt(hidden_size); the tied head contracts with the UNSCALED table).
@@ -102,6 +102,9 @@ class TransformerConfig:
     # leading fraction of each head's dims (rotary_pct).
     parallel_residual: bool = False
     rotary_percent: float = 1.0
+    # GPT-J rope convention: rotate interleaved even/odd pairs instead
+    # of the rotate-half block form.
+    rotary_interleaved: bool = False
     # Phi/Falcon-7b form of the parallel residual: ONE layernorm feeds
     # both branches (no post_attention_layernorm params).
     parallel_residual_shared_ln: bool = False
@@ -154,8 +157,8 @@ class TransformerConfig:
                 f"unknown position_embedding_type "
                 f"{self.position_embedding_type!r}; expected 'learned' or "
                 f"'rope'")
-        if self.activation not in ("gelu", "gelu_exact", "swiglu",
-                                   "geglu"):
+        if self.activation not in ("gelu", "gelu_exact", "relu",
+                                   "swiglu", "geglu"):
             raise ValueError(f"unknown activation {self.activation!r}")
         if self.normalization not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown normalization {self.normalization!r}")
@@ -211,7 +214,7 @@ def _warn_sliding_window_flash_once(window, seq):
 
 
 def apply_rotary_emb(x, base: float = 10000.0, positions=None,
-                     percent: float = 1.0):
+                     percent: float = 1.0, interleaved: bool = False):
     """Rotary position embedding (rotate-half convention) on [s, b, n, d].
 
     ``positions`` is [s] (shared across the batch) or [s, b] (per-sequence
@@ -225,14 +228,17 @@ def apply_rotary_emb(x, base: float = 10000.0, positions=None,
     """
     d_full = x.shape[-1]
     if percent < 1.0:
-        rot_n = int(d_full * percent)  # HF rotary_ndims (may be odd)
+        # +eps: keep HF's trunc semantics while absorbing fp error when
+        # percent was derived as rotary_dim / head_dim
+        rot_n = int(d_full * percent + 1e-6)  # HF rotary_ndims (may be odd)
         width = 2 * ((rot_n + 1) // 2)  # dims actually rotated
-        out = _rope_core(x[..., :width], base, positions, rot_n)
+        out = _rope_core(x[..., :width], base, positions, rot_n,
+                         interleaved)
         return jnp.concatenate([out, x[..., width:]], axis=-1)
-    return _rope_core(x, base, positions, d_full)
+    return _rope_core(x, base, positions, d_full, interleaved)
 
 
-def _rope_core(x, base, positions, freq_dim):
+def _rope_core(x, base, positions, freq_dim, interleaved=False):
     s, _, _, d = x.shape
     if positions is None:
         positions = jnp.arange(s)
@@ -243,8 +249,15 @@ def _rope_core(x, base, positions, freq_dim):
         freqs = freqs[:, None, :]
     cos = jnp.cos(freqs)[:, :, None, :]
     sin = jnp.sin(freqs)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    xf = x.astype(jnp.float32)
+    if interleaved:  # GPT-J: pairs are (even, odd) lanes
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                        axis=-1).reshape(x.shape)
+    else:  # rotate-half: pairs are (i, i + d/2)
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              -1)
     return out.astype(x.dtype)
 
 
@@ -343,9 +356,11 @@ class ParallelAttention(nn.Module):
 
         if cfg.position_embedding_type == "rope":
             q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
-                                 cfg.rotary_percent)
+                                 cfg.rotary_percent,
+                                 cfg.rotary_interleaved)
             k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
-                                 cfg.rotary_percent)
+                                 cfg.rotary_percent,
+                                 cfg.rotary_interleaved)
         if k.shape[2] != np_local:
             # broadcast each K/V group to its query heads
             rep = np_local // k.shape[2]
@@ -449,9 +464,11 @@ class ParallelAttention(nn.Module):
                     rank = 0
                 position_ids = rank * s + jnp.arange(s)
             q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
-                                 cfg.rotary_percent)
+                                 cfg.rotary_percent,
+                                 cfg.rotary_interleaved)
             k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
-                                 cfg.rotary_percent)
+                                 cfg.rotary_percent,
+                                 cfg.rotary_interleaved)
         if k.shape[2] != np_local:
             rep = np_local // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
@@ -490,9 +507,11 @@ class ParallelAttention(nn.Module):
             pos = (position_ids if position_ids is not None
                    else idx + jnp.arange(s))
             q = apply_rotary_emb(q, cfg.rotary_base, pos,
-                                 cfg.rotary_percent)
+                                 cfg.rotary_percent,
+                                 cfg.rotary_interleaved)
             k = apply_rotary_emb(k, cfg.rotary_base, pos,
-                                 cfg.rotary_percent)
+                                 cfg.rotary_percent,
+                                 cfg.rotary_interleaved)
         if not initialized:
             # init pass: create the variables, plain causal attention over
             # the given tokens (shapes/params identical to the real path)
@@ -563,22 +582,23 @@ class ParallelMLP(nn.Module):
             gate, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
             act = jax.nn.silu if cfg.activation == "swiglu" else jax.nn.gelu
             x = (act(gate) * up).astype(cfg.compute_dtype)
-        elif cfg.activation in ("gelu", "gelu_exact"):
+        elif cfg.activation in ("gelu", "gelu_exact", "relu"):
             x = ColumnParallelLinear(
                 input_size=cfg.hidden_size, output_size=cfg.ffn_size,
                 gather_output=False, bias=True, params_dtype=cfg.params_dtype,
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 name="dense_h_to_4h")(hidden_states.astype(cfg.compute_dtype))
-            x = jax.nn.gelu(
-                x.astype(jnp.float32),
-                approximate=(cfg.activation == "gelu")
-            ).astype(cfg.compute_dtype)
+            xf = x.astype(jnp.float32)
+            xf = (jax.nn.relu(xf) if cfg.activation == "relu"
+                  else jax.nn.gelu(xf,
+                                   approximate=(cfg.activation == "gelu")))
+            x = xf.astype(cfg.compute_dtype)
         else:
             raise ValueError(f"unknown activation {cfg.activation!r}")
         x = RowParallelLinear(
             input_size=cfg.ffn_size, output_size=cfg.hidden_size,
             input_is_parallel=True,
-            bias=(cfg.activation in ("gelu", "gelu_exact")),
+            bias=(cfg.activation in ("gelu", "gelu_exact", "relu")),
             params_dtype=cfg.params_dtype,
             sequence_parallel_enabled=cfg.sequence_parallel,
             name="dense_4h_to_h")(x)

@@ -458,3 +458,119 @@ def test_falcon_refuses_alibi():
         num_attention_heads=4, alibi=True)
     with pytest.raises(ValueError, match="alibi"):
         convert_falcon({}, hf_cfg)
+
+
+def _tiny_opt(seed=19):
+    cfg = transformers.OPTConfig(
+        vocab_size=96, hidden_size=48, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        word_embed_proj_dim=48, do_layer_norm_before=True,
+        activation_function="relu", dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(seed)
+    return transformers.OPTForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_opt():
+    """OPT oracle: relu MLP, learned positions with the +2 offset folded,
+    per-layer LN naming, tied head."""
+    from tools.convert_hf_opt import convert_opt
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_opt()
+    cfg, params = convert_opt(hf.state_dict(), hf_cfg)
+    assert cfg.activation == "relu" and cfg.tie_word_embeddings
+
+    tokens = np.random.RandomState(19).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_opt_greedy_generation_matches_hf():
+    """Learned-position decode: generate() must feed explicit positions
+    so the +2-offset fold stays consistent past the prefill."""
+    from tools.convert_hf_opt import convert_opt
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_opt(seed=20)
+    cfg, params = convert_opt(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(20).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_opt_refuses_post_ln():
+    from tools.convert_hf_opt import convert_opt
+
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=96, hidden_size=48, ffn_dim=128, num_hidden_layers=1,
+        num_attention_heads=4, do_layer_norm_before=False,
+        word_embed_proj_dim=48)
+    with pytest.raises(ValueError, match="do_layer_norm_before"):
+        convert_opt({}, hf_cfg)
+
+
+def _tiny_gptj(seed=23):
+    cfg = transformers.GPTJConfig(
+        vocab_size=96, n_embd=48, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(seed)
+    return transformers.GPTJForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_gptj():
+    """GPT-J oracle: interleaved partial rotary (rotate_every_two over
+    rotary_dim of head_dim), shared-LN parallel residual, biased MLP and
+    LM head."""
+    from tools.convert_hf_gptj import convert_gptj
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_gptj()
+    cfg, params = convert_gptj(hf.state_dict(), hf_cfg)
+    assert cfg.rotary_interleaved and cfg.rotary_percent < 1.0
+    # HF zero-inits the head bias; randomize so the mapping is exercised
+    params["lm_head_bias"] = jnp.asarray(
+        np.random.RandomState(2).randn(96).astype(np.float32) * 0.3)
+    with torch.no_grad():
+        hf.lm_head.bias.copy_(torch.asarray(
+            np.asarray(params["lm_head_bias"])))
+
+    tokens = np.random.RandomState(23).randint(0, 96, size=(2, 24))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_gptj_greedy_generation_matches_hf():
+    from tools.convert_hf_gptj import convert_gptj
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_gptj(seed=24)
+    cfg, params = convert_gptj(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(24).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

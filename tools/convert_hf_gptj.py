@@ -1,0 +1,129 @@
+"""Convert a HuggingFace GPT-J checkpoint into apex_tpu GPTModel params.
+
+GPT-J specifics:
+
+- Interleaved rotary pairs (rotate_every_two, not rotate-half) ->
+  ``cfg.rotary_interleaved``; partial rotation over ``rotary_dim`` dims
+  -> ``cfg.rotary_percent = rotary_dim / head_dim``.
+- Shared-LN parallel residual: ``ln_1`` feeds both branches
+  (``parallel_residual`` + ``parallel_residual_shared_ln``).
+- q/k/v/out projections are bias-free (zero-filled); the MLP
+  (fc_in/fc_out) and the untied LM head carry biases
+  (``cfg.lm_head_bias``); gelu_new MLP -> tanh-approx "gelu".
+
+    from transformers import GPTJForCausalLM
+    from tools.convert_hf_gptj import convert_gptj
+
+    hf = GPTJForCausalLM.from_pretrained("EleutherAI/gpt-j-6B")
+    cfg, params = convert_gptj(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import (_fused_qkv, _lin_t, _ln,
+                                    _map_gelu, _t)
+
+
+def convert_gptj(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a GPTJForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    d = hf_config.hidden_size // n
+    rot = getattr(hf_config, "rotary_dim", None) or d
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=getattr(hf_config, "n_inner", None)
+        or 4 * hf_config.hidden_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.layer_norm_epsilon,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        activation=_map_gelu(getattr(hf_config, "activation_function",
+                                     "gelu_new")),
+        position_embedding_type="rope",
+        rotary_percent=rot / d,
+        rotary_interleaved=True,
+        parallel_residual=True,
+        parallel_residual_shared_ln=True,
+        lm_head_bias=True,
+        tie_word_embeddings=False,
+    )
+
+    import functools
+
+    lin_t = functools.partial(_lin_t, sd)
+    ln = functools.partial(_ln, sd)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"h.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.attn.q_proj.weight"),
+                           lin_t(f"{p}.attn.k_proj.weight"),
+                           lin_t(f"{p}.attn.v_proj.weight"), n, n, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.ln_1"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.attn.out_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.mlp.fc_in.weight")),
+                    "bias": jnp.asarray(_t(sd[f"{p}.mlp.fc_in.bias"])),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.mlp.fc_out.weight")),
+                    "bias": jnp.asarray(_t(sd[f"{p}.mlp.fc_out.bias"])),
+                },
+            },
+        }
+
+    return cfg, {
+        "word_embeddings": {"weight": jnp.asarray(_t(sd["wte.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln("ln_f"),
+        "lm_head": jnp.asarray(_t(state_dict["lm_head.weight"]).T),
+        "lm_head_bias": jnp.asarray(_t(state_dict["lm_head.bias"])),
+    }
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import GPTJForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = GPTJForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_gptj(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()

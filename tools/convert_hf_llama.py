@@ -34,6 +34,19 @@ def _t(x):
                       else x)
 
 
+def _lin_t(sd, key):
+    """torch Linear weight [out, in] -> [in, out]."""
+    return _t(sd[key]).T
+
+
+def _ln(sd, prefix):
+    """LayerNorm weight+bias pair -> apex_tpu layernorm params."""
+    import jax.numpy as jnp
+
+    return {"weight": jnp.asarray(_t(sd[f"{prefix}.weight"])),
+            "bias": jnp.asarray(_t(sd[f"{prefix}.bias"]))}
+
+
 def _fused_qkv(wq, wk, wv, num_heads, num_groups, head_dim):
     """[h, n*d], [h, g*d], [h, g*d] -> fused columns in apex_tpu's layout.
 

@@ -1,0 +1,154 @@
+"""Convert a HuggingFace OPT checkpoint into apex_tpu GPTModel params.
+
+OPT specifics:
+
+- ReLU MLP (``activation_function="relu"``) -> ``activation="relu"``;
+  the rare gelu variants map through the shared gelu table.
+- Learned positions with a +2 padding offset baked into the table ->
+  fold by dropping the first two rows.
+- Per-layer LNs: ``self_attn_layer_norm`` -> input_layernorm,
+  layer-level ``final_layer_norm`` -> post_attention_layernorm; the
+  decoder's top-level final_layer_norm maps to ours.
+- Tied LM head (default) -> ``tie_word_embeddings=True``.
+
+Refused loudly: ``do_layer_norm_before=False`` (opt-350m's post-LN
+blocks) and ``word_embed_proj_dim != hidden_size`` (the 350m factorized
+embedding) — neither has an apex_tpu analog.
+
+    from transformers import OPTForCausalLM
+    from tools.convert_hf_opt import convert_opt
+
+    hf = OPTForCausalLM.from_pretrained("facebook/opt-125m")
+    cfg, params = convert_opt(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import (_fused_qkv, _lin_t, _ln,
+                                    _map_gelu, _t)
+
+
+def convert_opt(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from an OPTForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if not getattr(hf_config, "do_layer_norm_before", True):
+        raise ValueError(
+            "do_layer_norm_before=False (opt-350m post-LN blocks) has no "
+            "apex_tpu analog")
+    if getattr(hf_config, "word_embed_proj_dim",
+               hf_config.hidden_size) != hf_config.hidden_size:
+        raise ValueError(
+            "word_embed_proj_dim != hidden_size (factorized embedding) "
+            "is not supported")
+    if getattr(hf_config, "_remove_final_layer_norm", False):
+        raise ValueError("_remove_final_layer_norm=True checkpoints "
+                         "(no decoder final_layer_norm) are not supported")
+    if not getattr(hf_config, "enable_bias", True):
+        raise ValueError("enable_bias=False OPT variants are not supported")
+    if not getattr(hf_config, "layer_norm_elementwise_affine", True):
+        raise ValueError("layer_norm_elementwise_affine=False OPT "
+                         "variants are not supported")
+    act = getattr(hf_config, "activation_function", "relu")
+    sd = {k.removeprefix("model.decoder."): v
+          for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    d = hf_config.hidden_size // n
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.ffn_dim,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        activation=("relu" if act == "relu" else _map_gelu(act)),
+        position_embedding_type="learned",
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    True),
+    )
+
+    import functools
+
+    lin_t = functools.partial(_lin_t, sd)
+    ln = functools.partial(_ln, sd)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused_w = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                             lin_t(f"{p}.self_attn.k_proj.weight"),
+                             lin_t(f"{p}.self_attn.v_proj.weight"), n, n, d)
+        fused_b = _fused_qkv(_t(sd[f"{p}.self_attn.q_proj.bias"]),
+                             _t(sd[f"{p}.self_attn.k_proj.bias"]),
+                             _t(sd[f"{p}.self_attn.v_proj.bias"]), n, n, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.self_attn_layer_norm"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused_w),
+                    "bias": jnp.asarray(fused_b),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.out_proj.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.self_attn.out_proj.bias"])),
+                },
+            },
+            "post_attention_layernorm": ln(f"{p}.final_layer_norm"),
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.fc1.weight")),
+                    "bias": jnp.asarray(_t(sd[f"{p}.fc1.bias"])),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.fc2.weight")),
+                    "bias": jnp.asarray(_t(sd[f"{p}.fc2.bias"])),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        # +2 padding offset baked into the HF table: drop those rows
+        "position_embeddings": jnp.asarray(
+            _t(sd["embed_positions.weight"])[2:]),
+        "transformer": layers,
+        "final_layernorm": ln("final_layer_norm"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import OPTForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = OPTForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_opt(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
