@@ -65,6 +65,9 @@ class GPTModel(nn.Module):
             if cfg.embedding_multiplier is not None:
                 h = h * jnp.asarray(cfg.embedding_multiplier,
                                     cfg.compute_dtype)
+            if cfg.embedding_layernorm:  # BLOOM: LN right after embed
+                h = _make_norm(cfg, "embedding_layernorm")(
+                    h.astype(jnp.float32)).astype(cfg.compute_dtype)
             # [b, s, h] -> [s, b, h] (Megatron layout: seq-major for SP)
             h = h.transpose(1, 0, 2)
         else:

@@ -50,6 +50,9 @@ class GPTStage(nn.Module):
         self.transformer = ParallelTransformer(
             cfg, num_layers=self.layers_per_stage, name="transformer")
         self.final_layernorm = _make_norm(cfg, "final_layernorm")
+        self.embedding_layernorm = (
+            _make_norm(cfg, "embedding_layernorm")
+            if cfg.embedding_layernorm else None)
         tp = get_tensor_model_parallel_world_size()
         self.lm_head = self.param(
             "lm_head",
@@ -70,6 +73,9 @@ class GPTStage(nn.Module):
         h = h.astype(cfg.compute_dtype)
         if cfg.embedding_multiplier is not None:
             h = h * jnp.asarray(cfg.embedding_multiplier, cfg.compute_dtype)
+        if cfg.embedding_layernorm:  # BLOOM: LN right after embed
+            h = self.embedding_layernorm(
+                h.astype(jnp.float32)).astype(cfg.compute_dtype)
         h = h.transpose(1, 0, 2)  # [s, b, h]
         if cfg.sequence_parallel:
             h = scatter_to_sequence_parallel_region(h)

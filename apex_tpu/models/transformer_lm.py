@@ -115,6 +115,8 @@ class TransformerConfig:
     # 0 <= i - j < sliding_window (on top of causal). None -> full causal.
     sliding_window: Optional[int] = None
     normalization: str = "layernorm"  # or "rmsnorm"
+    # BLOOM applies a layernorm directly after the token embeddings.
+    embedding_layernorm: bool = False
     # Tie the LM head to the word-embedding table (reference
     # parallel_lm_logits ties by default). Off here because the SPMD
     # pipeline harness needs untied heads (first/last stages run the same
@@ -152,11 +154,16 @@ class TransformerConfig:
                 # identical configs compare/serialize identically and
                 # producers can pass head_dim through unconditionally
                 object.__setattr__(self, "head_dim", None)
-        if self.position_embedding_type not in ("learned", "rope"):
+        if self.position_embedding_type not in ("learned", "rope",
+                                                "alibi"):
             raise ValueError(
                 f"unknown position_embedding_type "
-                f"{self.position_embedding_type!r}; expected 'learned' or "
-                f"'rope'")
+                f"{self.position_embedding_type!r}; expected 'learned', "
+                f"'rope' or 'alibi'")
+        if self.position_embedding_type == "alibi" and self.context_parallel:
+            raise ValueError("alibi does not compose with context "
+                             "parallelism (ring/ulysses kernels carry no "
+                             "position bias)")
         if self.activation not in ("gelu", "gelu_exact", "relu",
                                    "swiglu", "geglu"):
             raise ValueError(f"unknown activation {self.activation!r}")
@@ -191,6 +198,22 @@ def _attn_mask_fn(scores, mask):
 
 
 _SWA_FLASH_WARNED = False
+_ALIBI_FLASH_WARNED = False
+
+
+def _warn_alibi_flash_once():
+    """ALiBi has no flash-kernel score-bias path yet: attention takes the
+    masked-softmax route (full [s, s] scores). Trace-time, warn once."""
+    global _ALIBI_FLASH_WARNED
+    if _ALIBI_FLASH_WARNED:
+        return
+    _ALIBI_FLASH_WARNED = True
+    import warnings
+
+    warnings.warn(
+        "position_embedding_type='alibi' bypasses flash attention (no "
+        "score-bias support in the kernel); the masked-softmax path "
+        "materializes O(s^2) scores.")
 
 
 def _warn_sliding_window_flash_once(window, seq):
@@ -259,6 +282,35 @@ def _rope_core(x, base, positions, freq_dim, interleaved=False):
         out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
                               -1)
     return out.astype(x.dtype)
+
+
+def alibi_slopes(num_heads):
+    """Per-head alibi slopes (ALiBi paper / HF build_alibi_tensor):
+    geometric in 2^(-8/n) for the nearest power-of-two head count,
+    interpolated for the remainder."""
+    import math
+
+    pow2 = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(pow2) - 3)))
+    slopes = [base ** (i + 1) for i in range(pow2)]
+    if pow2 < num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * pow2) - 3)))
+        slopes += [extra_base ** (2 * i + 1)
+                   for i in range(num_heads - pow2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def _local_alibi_slopes(cfg, np_local):
+    """This tp rank's slice of the global slope vector (heads are
+    contiguously sharded over tp; the canonical rank helper also honors
+    the eager set_tensor_model_parallel_rank override)."""
+    from apex_tpu.transformer.parallel_state import (
+        get_tensor_model_parallel_rank,
+    )
+
+    slopes = alibi_slopes(cfg.num_attention_heads)
+    rank = get_tensor_model_parallel_rank()
+    return jax.lax.dynamic_slice_in_dim(slopes, rank * np_local, np_local)
 
 
 def _make_norm(cfg, name):
@@ -377,6 +429,7 @@ class ParallelAttention(nn.Module):
         # attention_mask (e.g. padding) must take the masked softmax
         # path below or it would be silently ignored.
         if (cfg.use_flash_attention and attention_mask is None
+                and cfg.position_embedding_type != "alibi"
                 and _flash_available(seq_full, kv)):
             from apex_tpu.contrib.fmha import flash_attention
 
@@ -408,6 +461,16 @@ class ParallelAttention(nn.Module):
             scores = jnp.einsum("bnsd,bntd->bnst", qt, kt,
                                 preferred_element_type=jnp.float32)
             scores = scores / jnp.sqrt(kv).astype(jnp.float32)
+            if cfg.position_embedding_type == "alibi":
+                if cfg.use_flash_attention:
+                    _warn_alibi_flash_once()
+                # key-position-only form (HF build_alibi_tensor): each
+                # row differs from slope*(j - i) by a constant, which
+                # softmax cancels
+                slopes = _local_alibi_slopes(cfg, np_local)
+                scores = scores + (slopes[None, :, None, None]
+                                   * jnp.arange(seq_full, dtype=jnp.float32
+                                                )[None, None, None, :])
             from apex_tpu.transformer.functional.fused_softmax import (
                 scaled_masked_softmax,
                 scaled_upper_triang_masked_softmax,
@@ -531,6 +594,12 @@ class ParallelAttention(nn.Module):
         scores = scores / jnp.sqrt(kv).astype(jnp.float32)
         # causal over absolute positions: query i (at offset+i) sees keys
         # j <= offset+i; unfilled cache tail is masked the same way
+        if cfg.position_embedding_type == "alibi":
+            slopes = _local_alibi_slopes(cfg, n_kv * rep).reshape(
+                n_kv, rep)
+            scores = scores + (slopes[None, :, :, None, None]
+                               * jnp.arange(kv_len, dtype=jnp.float32
+                                            )[None, None, None, None, :])
         jpos = jnp.arange(kv_len)[None, :]
         ipos = offset + jnp.arange(s)[:, None]
         masked = jpos > ipos

@@ -318,7 +318,8 @@ class TestSplitParamsForTP:
     tokens (value parity, not just shape parity)."""
 
     @pytest.mark.parametrize("arch", ["mha_gelu", "gqa_swiglu",
-                                      "phi_style", "mistral_swa"])
+                                      "phi_style", "mistral_swa",
+                                      "bloom_alibi"])
     def test_tp2_matches_tp1_greedy(self, arch):
         from apex_tpu.models import (GPTModel, TransformerConfig, generate,
                                      split_params_for_tp,
@@ -340,6 +341,10 @@ class TestSplitParamsForTP:
             kw = dict(num_query_groups=2, activation="swiglu",
                       normalization="rmsnorm", sliding_window=5,
                       position_embedding_type="rope")
+        elif arch == "bloom_alibi":
+            # pins the per-rank slope slice (heads sharded over tp)
+            kw = dict(position_embedding_type="alibi",
+                      embedding_layernorm=True)
         cfg = TransformerConfig(
             hidden_size=32, num_layers=2, num_attention_heads=4,
             vocab_size=64, max_position_embeddings=32,

@@ -574,3 +574,55 @@ def test_gptj_greedy_generation_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_bloom(seed=27, n_head=4):
+    cfg = transformers.BloomConfig(
+        vocab_size=96, hidden_size=48, n_layer=2, n_head=n_head,
+        attention_dropout=0.0, hidden_dropout=0.0)
+    torch.manual_seed(seed)
+    return transformers.BloomForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("n_head", [4, 6])
+def test_logits_match_hf_bloom(n_head):
+    """BLOOM oracle: alibi position bias (incl. the non-power-of-two
+    slope interpolation at 6 heads), embedding layernorm, per-head fused
+    qkv, tied head."""
+    from tools.convert_hf_bloom import convert_bloom
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_bloom(n_head=n_head)
+    cfg, params = convert_bloom(hf.state_dict(), hf_cfg)
+    assert cfg.position_embedding_type == "alibi"
+    assert "embedding_layernorm" in params
+
+    tokens = np.random.RandomState(27).randint(0, 96, size=(2, 24))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_bloom_greedy_generation_matches_hf():
+    """KV-cache decode under alibi: the key-position bias must track
+    absolute cache positions past the prefill."""
+    from tools.convert_hf_bloom import convert_bloom
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_bloom(seed=28)
+    cfg, params = convert_bloom(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(28).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=10,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=10)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

@@ -1,0 +1,125 @@
+"""Convert a HuggingFace BLOOM checkpoint into apex_tpu GPTModel params.
+
+BLOOM specifics:
+
+- ALiBi position bias instead of embeddings ->
+  ``position_embedding_type="alibi"`` (key-position-only form; slopes
+  tp-sliced with the heads).
+- A layernorm directly after the token embeddings ->
+  ``cfg.embedding_layernorm``.
+- Fused per-head [q|k|v] qkv with biases (the apex_tpu MHA layout —
+  direct transpose, like GPT-NeoX); gelu (tanh) MLP with biases; tied
+  LM head.
+
+    from transformers import BloomForCausalLM
+    from tools.convert_hf_bloom import convert_bloom
+
+    hf = BloomForCausalLM.from_pretrained("bigscience/bloom-560m")
+    cfg, params = convert_bloom(hf.state_dict(), hf.config)
+"""
+
+import functools
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _lin_t, _ln, _t
+
+
+def convert_bloom(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a BloomForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.n_layer,
+        num_attention_heads=hf_config.n_head,
+        ffn_hidden_size=4 * hf_config.hidden_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=getattr(hf_config, "seq_length", 2048),
+        layernorm_epsilon=hf_config.layer_norm_epsilon,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        activation="gelu",  # bloom_gelu == tanh approximation
+        position_embedding_type="alibi",
+        embedding_layernorm=True,
+        tie_word_embeddings=True,
+    )
+
+    lin_t = functools.partial(_lin_t, sd)
+    ln = functools.partial(_ln, sd)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"h.{i}"
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.input_layernorm"),
+            "self_attention": {
+                # HF columns are already per-head [q|k|v] blocks
+                "query_key_value": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attention.query_key_value.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.self_attention.query_key_value.bias"])),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attention.dense.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.self_attention.dense.bias"])),
+                },
+            },
+            "post_attention_layernorm": ln(f"{p}.post_attention_layernorm"),
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.dense_h_to_4h.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.mlp.dense_h_to_4h.bias"])),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.dense_4h_to_h.weight")),
+                    "bias": jnp.asarray(
+                        _t(sd[f"{p}.mlp.dense_4h_to_h.bias"])),
+                },
+            },
+        }
+
+    return cfg, {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["word_embeddings.weight"]))},
+        "embedding_layernorm": _ln(sd, "word_embeddings_layernorm"),
+        "transformer": layers,
+        "final_layernorm": ln("ln_f"),
+    }
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import BloomForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = BloomForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_bloom(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
