@@ -23,10 +23,60 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_LLAMA_KW = dict(vocab_size=256, hidden_size=64, intermediate_size=176,
+                 num_hidden_layers=4, num_attention_heads=4,
+                 max_position_embeddings=128)
+
+# family -> (tools converter module, transformers class, tiny-config
+# factory for the offline demo)
+FAMILIES = {
+    "gpt2": ("convert_hf_gpt2", "GPT2LMHeadModel",
+             lambda t: t.GPT2Config(vocab_size=256, n_positions=128,
+                                    n_embd=64, n_layer=4, n_head=4)),
+    "llama": ("convert_hf_llama", "LlamaForCausalLM",
+              lambda t: t.LlamaConfig(num_key_value_heads=2, **_LLAMA_KW)),
+    "mistral": ("convert_hf_mistral", "MistralForCausalLM",
+                lambda t: t.MistralConfig(num_key_value_heads=2,
+                                          sliding_window=32, **_LLAMA_KW)),
+    "qwen2": ("convert_hf_qwen2", "Qwen2ForCausalLM",
+              lambda t: t.Qwen2Config(num_key_value_heads=2,
+                                      sliding_window=None, **_LLAMA_KW)),
+    "gemma": ("convert_hf_gemma", "GemmaForCausalLM",
+              lambda t: t.GemmaConfig(num_key_value_heads=1, head_dim=16,
+                                      **_LLAMA_KW)),
+    "neox": ("convert_hf_neox", "GPTNeoXForCausalLM",
+             lambda t: t.GPTNeoXConfig(rotary_pct=0.25, **_LLAMA_KW)),
+    "gptj": ("convert_hf_gptj", "GPTJForCausalLM",
+             lambda t: t.GPTJConfig(vocab_size=256, n_embd=64, n_layer=4,
+                                    n_head=4, n_positions=128,
+                                    rotary_dim=8)),
+    "phi": ("convert_hf_phi", "PhiForCausalLM",
+            lambda t: t.PhiConfig(num_key_value_heads=4, **_LLAMA_KW)),
+    "falcon": ("convert_hf_falcon", "FalconForCausalLM",
+               lambda t: t.FalconConfig(vocab_size=256, hidden_size=64,
+                                        num_hidden_layers=4,
+                                        num_attention_heads=4, alibi=False,
+                                        multi_query=True, bias=False)),
+    "opt": ("convert_hf_opt", "OPTForCausalLM",
+            lambda t: t.OPTConfig(vocab_size=256, hidden_size=64,
+                                  ffn_dim=176, num_hidden_layers=4,
+                                  num_attention_heads=4,
+                                  max_position_embeddings=128,
+                                  word_embed_proj_dim=64)),
+    "bloom": ("convert_hf_bloom", "BloomForCausalLM",
+              lambda t: t.BloomConfig(vocab_size=256, hidden_size=64,
+                                      n_layer=4, n_head=4)),
+    "mixtral": ("convert_hf_mixtral", "MixtralForCausalLM",
+                lambda t: t.MixtralConfig(num_key_value_heads=2,
+                                          num_local_experts=4,
+                                          num_experts_per_tok=2,
+                                          sliding_window=None, **_LLAMA_KW)),
+}
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--family", choices=["gpt2", "llama", "gemma"],
-                    default="gpt2")
+    ap.add_argument("--family", choices=sorted(FAMILIES), default="gpt2")
     ap.add_argument("--model-path", default=None,
                     help="HF checkpoint dir; omit for a tiny random model")
     ap.add_argument("--max-new-tokens", type=int, default=24)
@@ -46,36 +96,16 @@ def main():
     from apex_tpu.models import GPTModel
     from apex_tpu.models.generation import beam_search, generate
 
-    if args.family == "gpt2":
-        from tools.convert_hf_gpt2 import convert_gpt2 as convert
+    conv_mod, cls_name, tiny_cfg = FAMILIES[args.family]
+    import importlib
 
-        if args.model_path:
-            hf = transformers.GPT2LMHeadModel.from_pretrained(args.model_path)
-        else:
-            hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
-                vocab_size=256, n_positions=128, n_embd=64, n_layer=4,
-                n_head=4))
-    elif args.family == "gemma":
-        from tools.convert_hf_gemma import convert_gemma as convert
-
-        if args.model_path:
-            hf = transformers.GemmaForCausalLM.from_pretrained(args.model_path)
-        else:
-            hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
-                vocab_size=256, hidden_size=64, intermediate_size=176,
-                num_hidden_layers=4, num_attention_heads=4,
-                num_key_value_heads=1, head_dim=16,
-                max_position_embeddings=128))
+    convert = getattr(importlib.import_module(f"tools.{conv_mod}"),
+                      conv_mod.replace("convert_hf", "convert"))
+    cls = getattr(transformers, cls_name)
+    if args.model_path:
+        hf = cls.from_pretrained(args.model_path)
     else:
-        from tools.convert_hf_llama import convert_llama as convert
-
-        if args.model_path:
-            hf = transformers.LlamaForCausalLM.from_pretrained(args.model_path)
-        else:
-            hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
-                vocab_size=256, hidden_size=64, intermediate_size=176,
-                num_hidden_layers=4, num_attention_heads=4,
-                num_key_value_heads=2, max_position_embeddings=128))
+        hf = cls(tiny_cfg(transformers))
 
     cfg, params = convert(hf.eval().state_dict(), hf.config)
     model = GPTModel(cfg, decode=True)
