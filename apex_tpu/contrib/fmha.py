@@ -58,6 +58,15 @@ def _causal_mask(scores, qi, kj, block_q, block_k, window=None):
     return jnp.where(visible, scores, NEG_INF)
 
 
+def _alibi_bias(slopes_ref, kj, block_q, block_k):
+    """Key-position-only alibi bias for a (qi, kj) block pair: row
+    constants cancel in softmax, so slope * absolute-key-index is the
+    whole bias (HF build_alibi_tensor form)."""
+    k_ids = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.float32, (block_q, block_k), 1)
+    return slopes_ref[0, 0, 0] * k_ids
+
+
 def _stream_kv_run(qi, kj, block_q, block_k, causal, window):
     """Does kv block kj contribute to q block qi? (fwd / dq kernels)"""
     if not causal:
@@ -90,9 +99,9 @@ def _window_last_q_pos(kj, block_k, window):
     return (kj + 1) * block_k - 1 + window - 1
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                      l_ref, *, scale, causal, block_q, block_k, num_kv,
-                      window):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, scale, causal, block_q,
+                      block_k, num_kv, window, alibi):
     """One (head, q-block, kv-block) grid cell of online-softmax attention.
 
     K/V arrive as [1, block_k, d] VMEM tiles streamed by the grid — VMEM
@@ -122,6 +131,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if alibi:
+            s = s + _alibi_bias(slopes_ref, kj, block_q, block_k)
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, window)
         m_prev = m_ref[...]
@@ -143,8 +154,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
+def _slopes_input(alibi_slopes, b, n):
+    """[n] per-head slopes -> [b*n, 1, 1] grid input (zeros when alibi
+    is off — the kernel branch is static, the input just needs a shape)."""
+    if alibi_slopes is None:
+        return jnp.zeros((b * n, 1, 1), jnp.float32)
+    return jnp.broadcast_to(
+        alibi_slopes.astype(jnp.float32)[None, :], (b, n)
+    ).reshape(b * n, 1, 1)
+
+
 def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
-                      window=None):
+                      window=None, alibi_slopes=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -156,9 +177,11 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
     block_k = min(block_k, s)
     num_kv = s // block_k
     grid = (b * n, s // block_q, num_kv)
+    slopes3 = _slopes_input(alibi_slopes, b, n)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_kv=num_kv, window=window)
+        block_k=block_k, num_kv=num_kv, window=window,
+        alibi=alibi_slopes is not None)
 
     if causal:
         # Clamp masked kv blocks into the contributing range: Pallas
@@ -185,6 +208,8 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), kv_index,
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1), lambda h, i, j: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
@@ -204,13 +229,13 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
-    )(q3, k3, v3)
+    )(q3, k3, v3, slopes3)
     return out.reshape(b, n, s, d), lse.reshape(b, n, s)
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                     num_kv, window):
+                     slopes_ref, dq_ref, dq_acc, *, scale, causal,
+                     block_q, block_k, num_kv, window, alibi):
     """dq for one q block, streaming kv blocks (innermost grid dim):
     p = exp(q k^T scale - lse); ds = p * (do v^T - delta); dq += ds k scale.
     """
@@ -234,6 +259,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if alibi:
+            s = s + _alibi_bias(slopes_ref, kj, block_q, block_k)
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)
@@ -248,8 +275,9 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                      block_q, block_k, num_q, window):
+                      slopes_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                      scale, causal, block_q, block_k, num_q, window,
+                      alibi):
     """dk/dv for one kv block, streaming q blocks (innermost grid dim):
     dv += p^T do;  dk += ds^T q scale."""
     from jax.experimental import pallas as pl
@@ -275,6 +303,8 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if alibi:
+            s = s + _alibi_bias(slopes_ref, kj, block_q, block_k)
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, window)
         p = jnp.exp(s - lse)
@@ -292,7 +322,7 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
-                      block_k, window=None):
+                      block_k, window=None, alibi_slopes=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -305,6 +335,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
                     axis=-1, keepdims=True)
     num_q = s // block_q
     num_kv = s // block_k
+    slopes3 = _slopes_input(alibi_slopes, b, n)
+    alibi = alibi_slopes is not None
 
     if causal:
         def kv_index(h, i, j):
@@ -329,7 +361,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_kv=num_kv,
-                          window=window),
+                          window=window, alibi=alibi),
         grid=(b * n, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
@@ -344,6 +376,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, 1), lambda h, i, j: (h, i, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1), lambda h, i, j: (h, 0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0),
                                memory_space=pltpu.VMEM),
@@ -352,12 +386,12 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
-    )(q3, k3, v3, do3, lse3, delta)
+    )(q3, k3, v3, do3, lse3, delta, slopes3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q=num_q,
-                          window=window),
+                          window=window, alibi=alibi),
         grid=(b * n, num_kv, num_q),
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda h, j, i: (h, j, 0),
@@ -371,6 +405,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
             pl.BlockSpec((1, block_q, 1), q_index_for_kv,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, 1), q_index_for_kv,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1), lambda h, j, i: (h, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -390,17 +426,22 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
-    )(k3, v3, q3, do3, lse3, delta)
+    )(k3, v3, q3, do3, lse3, delta, slopes3)
 
     rs = lambda x: x.reshape(b, n, s, d)  # noqa: E731
     return rs(dq), rs(dk), rs(dv)
 
 
-def _attention_reference(q, k, v, scale, causal, window=None):
+def _attention_reference(q, k, v, scale, causal, window=None,
+                         alibi_slopes=None):
     """Reference einsum attention (fp32 softmax), used for the backward
     rematerialization and the non-TPU fallback."""
     s = jnp.einsum("bnqd,bnkd->bnqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if alibi_slopes is not None:
+        s = s + (alibi_slopes.astype(jnp.float32)[None, :, None, None]
+                 * jnp.arange(s.shape[-1], dtype=jnp.float32
+                              )[None, None, None, :])
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
@@ -453,43 +494,55 @@ def _check_window(window, causal):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=True, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    window=None):
+                    window=None, alibi_slopes=None):
     """Flash attention over [batch, heads, seq, head_dim] inputs.
 
     ``window``: sliding-window band (key j visible to query i iff
     0 <= i - j < window); blocks fully outside the band are skipped, so
-    compute scales with seq * window instead of seq^2."""
+    compute scales with seq * window instead of seq^2.
+    ``alibi_slopes``: per-head [heads] slopes adding the key-position
+    alibi bias inside the kernel. Treated as NON-DIFFERENTIABLE (the
+    returned cotangent is zero, matching the CUDA flash-attention
+    convention) — trained-ALiBi variants must not route slope gradients
+    through this op."""
     _check_window(window, causal)
     scale, bq, bk = _resolve(q, scale, block_q, block_k)
     if _use_pallas() and bq is not None and bk is not None:
         return _flash_fwd_pallas(q, k, v, scale, causal, bq, bk,
-                                 window)[0]
-    return _attention_reference(q, k, v, scale, causal, window)
+                                 window, alibi_slopes)[0]
+    return _attention_reference(q, k, v, scale, causal, window,
+                                alibi_slopes)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k,
-                    window=None):
+                    window=None, alibi_slopes=None):
     _check_window(window, causal)
     scale_, bq, bk = _resolve(q, scale, block_q, block_k)
     if _use_pallas() and bq is not None and bk is not None:
         out, lse = _flash_fwd_pallas(q, k, v, scale_, causal, bq, bk,
-                                     window)
-        return out, (q, k, v, out, lse)
-    return (_attention_reference(q, k, v, scale_, causal, window),
-            (q, k, v, None, None))
+                                     window, alibi_slopes)
+        return out, (q, k, v, out, lse, alibi_slopes)
+    return (_attention_reference(q, k, v, scale_, causal, window,
+                                 alibi_slopes),
+            (q, k, v, None, None, alibi_slopes))
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, window, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, alibi_slopes = res
     scale_, bq, bk = _resolve(q, scale, block_q, block_k)
+    none_slope_grad = (None if alibi_slopes is None
+                       else jnp.zeros_like(alibi_slopes))
     if lse is not None and _use_pallas():
-        return _flash_bwd_pallas(q, k, v, out, lse, g, scale_, causal,
-                                 bq, bk, window)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, scale_,
+                                       causal, bq, bk, window,
+                                       alibi_slopes)
+        return dq, dk, dv, none_slope_grad
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _attention_reference(q_, k_, v_, scale_,
-                                                causal, window),
+                                                causal, window,
+                                                alibi_slopes),
         q, k, v)
-    return vjp(g)
+    return (*vjp(g), none_slope_grad)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

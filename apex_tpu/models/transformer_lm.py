@@ -198,22 +198,6 @@ def _attn_mask_fn(scores, mask):
 
 
 _SWA_FLASH_WARNED = False
-_ALIBI_FLASH_WARNED = False
-
-
-def _warn_alibi_flash_once():
-    """ALiBi has no flash-kernel score-bias path yet: attention takes the
-    masked-softmax route (full [s, s] scores). Trace-time, warn once."""
-    global _ALIBI_FLASH_WARNED
-    if _ALIBI_FLASH_WARNED:
-        return
-    _ALIBI_FLASH_WARNED = True
-    import warnings
-
-    warnings.warn(
-        "position_embedding_type='alibi' bypasses flash attention (no "
-        "score-bias support in the kernel); the masked-softmax path "
-        "materializes O(s^2) scores.")
 
 
 def _warn_sliding_window_flash_once(window, seq):
@@ -429,10 +413,11 @@ class ParallelAttention(nn.Module):
         # attention_mask (e.g. padding) must take the masked softmax
         # path below or it would be silently ignored.
         if (cfg.use_flash_attention and attention_mask is None
-                and cfg.position_embedding_type != "alibi"
                 and _flash_available(seq_full, kv)):
             from apex_tpu.contrib.fmha import flash_attention
 
+            slopes = (_local_alibi_slopes(cfg, np_local)
+                      if cfg.position_embedding_type == "alibi" else None)
             # [s, b, n, d] -> [b, n, s, d]
             qt = q.transpose(1, 2, 0, 3)
             kt = k.transpose(1, 2, 0, 3)
@@ -440,7 +425,7 @@ class ParallelAttention(nn.Module):
             ctx = flash_attention(
                 qt, kt, vt,
                 causal=(cfg.attn_mask_type == AttnMaskType.causal),
-                window=win)
+                window=win, alibi_slopes=slopes)
             ctx = ctx.transpose(2, 0, 1, 3)  # [s, b, n, d]
         else:
             if win is not None:
@@ -462,8 +447,6 @@ class ParallelAttention(nn.Module):
                                 preferred_element_type=jnp.float32)
             scores = scores / jnp.sqrt(kv).astype(jnp.float32)
             if cfg.position_embedding_type == "alibi":
-                if cfg.use_flash_attention:
-                    _warn_alibi_flash_once()
                 # key-position-only form (HF build_alibi_tensor): each
                 # row differs from slope*(j - i) by a constant, which
                 # softmax cancels

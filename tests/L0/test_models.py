@@ -159,3 +159,31 @@ def test_gpt_sliding_window_flash_matches_masked_path(monkeypatch, rng):
     monkeypatch.setattr(tlm, "_flash_available", lambda s, d: True)
     flash = logits(use_flash=True)
     np.testing.assert_allclose(flash, masked, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_alibi_flash_matches_masked_path(monkeypatch, rng):
+    """Model-level ALiBi through the flash kernel (in-kernel key-position
+    bias) must match the masked-softmax score-bias path."""
+    import apex_tpu.contrib.fmha as fmha_mod
+    import apex_tpu.models.transformer_lm as tlm
+
+    from apex_tpu.models import GPTModel, TransformerConfig
+
+    tokens = jnp.asarray(rng.randint(0, 128, (1, 128)))
+
+    def logits(use_flash):
+        cfg = TransformerConfig(
+            hidden_size=64, num_layers=2, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=128,
+            compute_dtype=jnp.float32, use_flash_attention=use_flash,
+            position_embedding_type="alibi")
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        return np.asarray(model.apply(params, tokens))
+
+    masked = logits(use_flash=False)
+    monkeypatch.setattr(fmha_mod, "_INTERPRET", True)
+    monkeypatch.setattr(fmha_mod, "_use_pallas", lambda: True)
+    monkeypatch.setattr(tlm, "_flash_available", lambda s, d: True)
+    flash = logits(use_flash=True)
+    np.testing.assert_allclose(flash, masked, rtol=2e-4, atol=2e-4)
