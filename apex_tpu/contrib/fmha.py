@@ -20,6 +20,7 @@ the whole sequence as one block.
 """
 
 import functools
+import numbers
 
 import jax
 import jax.numpy as jnp
@@ -486,7 +487,10 @@ def _check_window(window, causal):
         return
     if not causal:
         raise ValueError("flash_attention window requires causal=True")
-    if not isinstance(window, int) or window < 1:
+    # numbers.Integral admits numpy scalars from parsed configs; bool is
+    # an int subclass and must not silently mean window=1.
+    if (isinstance(window, bool) or not isinstance(window, numbers.Integral)
+            or window < 1):
         raise ValueError(f"flash_attention window must be a positive "
                          f"static int, got {window!r}")
 

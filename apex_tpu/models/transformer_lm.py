@@ -197,7 +197,7 @@ def _attn_mask_fn(scores, mask):
     return jnp.where(mask.astype(bool), -10000.0, scores)
 
 
-_SWA_FLASH_WARNED = False
+_SWA_FLASH_WARNED = set()
 
 
 def _warn_sliding_window_flash_once(window, seq):
@@ -205,11 +205,12 @@ def _warn_sliding_window_flash_once(window, seq):
     but it was unavailable at this call site (non-TPU backend, an
     explicit attention_mask, or seq not a block multiple) — the
     masked-softmax path materializes full [s, s] scores. Trace-time,
-    warn once."""
-    global _SWA_FLASH_WARNED
-    if _SWA_FLASH_WARNED:
+    warn once per distinct (window, seq) so a later, different config
+    that also falls back still gets its own signal."""
+    key = (int(window), int(seq))
+    if key in _SWA_FLASH_WARNED:
         return
-    _SWA_FLASH_WARNED = True
+    _SWA_FLASH_WARNED.add(key)
     import warnings
 
     warnings.warn(

@@ -605,7 +605,9 @@ def main():
 if __name__ == "__main__":
     try:
         main()
-    except SystemExit:
+    except (SystemExit, KeyboardInterrupt):
+        # operator interrupts are not bench crashes — don't emit the
+        # parseable crash line for them
         raise
     except BaseException as e:  # noqa: BLE001 — the driver parses stdout;
         # a tunnel drop mid-run (observed: fatal XLA error after 28 min of
