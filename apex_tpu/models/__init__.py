@@ -22,7 +22,16 @@ from apex_tpu.models.generation import (  # noqa: F401
     tensor_parallel_beam_search,
     tensor_parallel_generate,
 )
-from apex_tpu.models.tp_split import split_params_for_tp  # noqa: F401
+from apex_tpu.models.tp_split import (  # noqa: F401
+    split_params_for_tp,
+    split_t5_params_for_tp,
+)
+from apex_tpu.models.t5 import (  # noqa: F401
+    T5Config,
+    T5Model,
+    t5_greedy_generate,
+    t5_loss_fn,
+)
 from apex_tpu.models.reshard import (  # noqa: F401
     load_checkpoint_for_3d,
     load_moe_checkpoint_for_ep,

@@ -1,0 +1,399 @@
+"""T5 encoder-decoder language model (TPU-native).
+
+Parity: the reference's encoder-decoder pipeline machinery is built for
+Megatron T5 (relative-position-embedding groups in
+apex/transformer/parallel_state.py:243-331; dual p2p shapes keyed off
+``decoder_seq_length`` in
+apex/transformer/pipeline_parallel/schedules/fwd_bwd_pipelining_without_interleaving.py:29-86).
+This module supplies the *model family* those mechanics exist for: a real
+T5 — relative position bias with log-spaced buckets (bidirectional for the
+encoder, causal for the decoder), scale-only RMS layernorm, bias-free
+linears, unscaled attention scores (T5 folds 1/sqrt(d) into init), relu or
+gated-gelu FFN, tied or untied LM head with the d_model**-0.5 tied-head
+rescale — on the same tensor-parallel primitives as the GPT/BERT families
+(column/row-parallel projections, vocab-parallel embedding).
+
+Encoder and decoder are exposed both fused (``__call__``) and as separate
+``encode`` / ``decode_step`` methods so pipeline split-rank stages and
+two-phase generation can drive each side independently.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.transformer.parallel_state import (
+    get_tensor_model_parallel_rank,
+    get_tensor_model_parallel_world_size,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    copy_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64          # per-head dim, decoupled from d_model/num_heads
+    d_ff: int = 2048
+    num_layers: int = 6             # encoder depth
+    num_decoder_layers: Optional[int] = None  # None -> num_layers
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    feed_forward_proj: str = "relu"  # or "gated-gelu" (t5 v1.1)
+    tie_word_embeddings: bool = True
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    activation_checkpointing: bool = False
+
+    def __post_init__(self):
+        if self.feed_forward_proj not in ("relu", "gated-gelu"):
+            raise ValueError(
+                f"unknown feed_forward_proj {self.feed_forward_proj!r}; "
+                f"expected 'relu' or 'gated-gelu'")
+        if self.num_heads < 1:
+            raise ValueError(f"num_heads ({self.num_heads}) must be >= 1")
+
+    @property
+    def decoder_layers(self):
+        return (self.num_decoder_layers if self.num_decoder_layers
+                is not None else self.num_layers)
+
+    @property
+    def inner_dim(self):
+        return self.num_heads * self.d_kv
+
+
+def relative_position_bucket(relative_position, bidirectional,
+                             num_buckets=32, max_distance=128):
+    """Map key-minus-query offsets to T5's bias buckets.
+
+    Half the buckets cover exact small offsets, the other half are
+    log-spaced out to ``max_distance`` (beyond which everything shares the
+    last bucket). Bidirectional (encoder) splits the budget between
+    negative and positive offsets; causal (decoder) buckets only the
+    lookback direction. Matches the T5 paper's assignment (and HF's
+    `_relative_position_bucket`) so converted checkpoints reproduce
+    logits exactly.
+    """
+    rel = relative_position
+    bucket_offset = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        bucket_offset = jnp.where(rel > 0, num_buckets, 0)
+        n = jnp.abs(rel)
+    else:
+        n = jnp.maximum(-rel, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    # log-spaced: max_exact..num_buckets-1 over max_exact..max_distance
+    nf = jnp.maximum(n, 1).astype(jnp.float32)
+    large = max_exact + (
+        jnp.log(nf / max_exact) / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return bucket_offset + jnp.where(is_small, n, large)
+
+
+class _RelativeBias(nn.Module):
+    """Per-head relative position bias table. The full
+    [num_buckets, num_heads] table is replicated; each tp rank reads the
+    bias rows for its contiguous head slice (same head layout as the
+    column-parallel q/k/v shards)."""
+
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_len, k_len, q_offset=0):
+        cfg = self.config
+        table = self.param(
+            "rel_attn_bias",
+            nn.initializers.normal(0.02),
+            (cfg.relative_attention_num_buckets, cfg.num_heads),
+            cfg.params_dtype)
+        ctx = q_offset + jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        buckets = relative_position_bucket(
+            mem - ctx, self.bidirectional,
+            cfg.relative_attention_num_buckets,
+            cfg.relative_attention_max_distance)
+        bias = table[buckets]  # [q, k, heads]
+        tp = get_tensor_model_parallel_world_size()
+        if tp > 1:
+            n_local = divide(cfg.num_heads, tp)
+            rank = get_tensor_model_parallel_rank()
+            bias = jax.lax.dynamic_slice_in_dim(
+                bias, rank * n_local, n_local, axis=2)
+        return bias.transpose(2, 0, 1).astype(jnp.float32)  # [n, q, k]
+
+
+class T5Attention(nn.Module):
+    """Self- or cross-attention with column-parallel q/k/v and
+    row-parallel output, T5 conventions: no bias terms, no 1/sqrt(d)
+    score scaling, additive per-head position bias on self-attention."""
+
+    config: T5Config
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x_q, x_kv=None, position_bias=None,
+                 attention_mask=None):
+        cfg = self.config
+        tp = get_tensor_model_parallel_world_size()
+        n_local = divide(cfg.num_heads, tp)
+        d = cfg.d_kv
+        sq, b, _ = x_q.shape
+        x_kv = x_q if x_kv is None else x_kv
+        skv = x_kv.shape[0]
+
+        def proj(name, src):
+            return ColumnParallelLinear(
+                input_size=cfg.d_model, output_size=cfg.inner_dim,
+                gather_output=False, bias=False,
+                params_dtype=cfg.params_dtype, name=name)(src)
+
+        q = proj("q", x_q).reshape(sq, b, n_local, d)
+        k = proj("k", x_kv).reshape(skv, b, n_local, d)
+        v = proj("v", x_kv).reshape(skv, b, n_local, d)
+
+        # T5 leaves scores unscaled (the 1/sqrt(d) lives in init)
+        scores = jnp.einsum("qbnd,kbnd->bnqk",
+                            q.astype(cfg.compute_dtype),
+                            k.astype(cfg.compute_dtype),
+                            preferred_element_type=jnp.float32)
+        if position_bias is not None:
+            scores = scores + position_bias[None]  # [n, q, k] broadcast
+        if self.causal:
+            i = jnp.arange(sq)[:, None]
+            j = jnp.arange(skv)[None, :]
+            scores = jnp.where(j > i, -1e9, scores)
+        if attention_mask is not None:
+            # [b, k] padding mask: True/1 = attend
+            scores = jnp.where(
+                attention_mask.astype(bool)[:, None, None, :],
+                scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bnqk,kbnd->qbnd",
+                         probs.astype(cfg.compute_dtype),
+                         v.astype(cfg.compute_dtype),
+                         preferred_element_type=jnp.float32)
+        ctx = ctx.reshape(sq, b, n_local * d).astype(cfg.compute_dtype)
+        return RowParallelLinear(
+            input_size=cfg.inner_dim, output_size=cfg.d_model,
+            input_is_parallel=True, bias=False,
+            params_dtype=cfg.params_dtype, name="o")(ctx)
+
+
+class T5FFN(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x.astype(cfg.compute_dtype)
+        if cfg.feed_forward_proj == "gated-gelu":
+            gate = ColumnParallelLinear(
+                input_size=cfg.d_model, output_size=cfg.d_ff,
+                gather_output=False, bias=False,
+                params_dtype=cfg.params_dtype, name="wi_0")(x)
+            up = ColumnParallelLinear(
+                input_size=cfg.d_model, output_size=cfg.d_ff,
+                gather_output=False, bias=False,
+                params_dtype=cfg.params_dtype, name="wi_1")(x)
+            # HF gated-gelu gates with the tanh approximation (gelu_new)
+            h = (jax.nn.gelu(gate.astype(jnp.float32), approximate=True)
+                 * up.astype(jnp.float32)).astype(cfg.compute_dtype)
+        else:
+            h = ColumnParallelLinear(
+                input_size=cfg.d_model, output_size=cfg.d_ff,
+                gather_output=False, bias=False,
+                params_dtype=cfg.params_dtype, name="wi")(x)
+            h = jax.nn.relu(h.astype(jnp.float32)).astype(cfg.compute_dtype)
+        return RowParallelLinear(
+            input_size=cfg.d_ff, output_size=cfg.d_model,
+            input_is_parallel=True, bias=False,
+            params_dtype=cfg.params_dtype, name="wo")(h)
+
+
+def _norm(cfg, name):
+    return FusedRMSNorm(normalized_shape=cfg.d_model,
+                        eps=cfg.layer_norm_epsilon,
+                        param_dtype=jnp.float32, name=name)
+
+
+class T5Block(nn.Module):
+    """Pre-RMSNorm residual block: self-attn [+ cross-attn] + FFN."""
+
+    config: T5Config
+    has_cross: bool = False
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, h, memory=None, position_bias=None,
+                 self_mask=None, cross_mask=None):
+        cfg = self.config
+        x = _norm(cfg, "self_attn_norm")(h.astype(jnp.float32)).astype(
+            cfg.compute_dtype)
+        h = h + T5Attention(cfg, causal=self.causal, name="self_attn")(
+            x, None, position_bias, self_mask).astype(h.dtype)
+        if self.has_cross:
+            x = _norm(cfg, "cross_attn_norm")(h.astype(jnp.float32)).astype(
+                cfg.compute_dtype)
+            # cross-attention carries no relative bias (T5 convention)
+            h = h + T5Attention(cfg, causal=False, name="cross_attn")(
+                x, memory, None, cross_mask).astype(h.dtype)
+        x = _norm(cfg, "ffn_norm")(h.astype(jnp.float32)).astype(
+            cfg.compute_dtype)
+        return h + T5FFN(cfg, name="ffn")(x).astype(h.dtype)
+
+
+class T5Encoder(nn.Module):
+    """Embedded tokens -> encoder memory [s, b, d_model] (fp32 normed)."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None):
+        cfg = self.config
+        bias = _RelativeBias(cfg, bidirectional=True,
+                             name="relative_bias")(h.shape[0], h.shape[0])
+        block = T5Block
+        if cfg.activation_checkpointing:
+            block = nn.checkpoint(T5Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            h = block(cfg, has_cross=False, causal=False,
+                      name=f"block_{i}")(h, None, bias, attention_mask,
+                                         None)
+        return _norm(cfg, "final_norm")(h.astype(jnp.float32))
+
+
+class T5Decoder(nn.Module):
+    """Embedded decoder tokens + encoder memory -> pre-head hidden
+    [s, b, d_model] (fp32 normed)."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, h, memory, self_mask=None, cross_mask=None):
+        cfg = self.config
+        bias = _RelativeBias(cfg, bidirectional=False,
+                             name="relative_bias")(h.shape[0], h.shape[0])
+        block = T5Block
+        if cfg.activation_checkpointing:
+            block = nn.checkpoint(T5Block, static_argnums=())
+        for i in range(cfg.decoder_layers):
+            h = block(cfg, has_cross=True, causal=True,
+                      name=f"block_{i}")(h, memory, bias, self_mask,
+                                         cross_mask)
+        return _norm(cfg, "final_norm")(h.astype(jnp.float32))
+
+
+class T5Model(nn.Module):
+    """Conditional-generation T5. ``__call__(enc_tokens, dec_tokens)``
+    with [b, s] int ids returns [b, s_dec, vocab/tp] logits. ``encode``
+    and ``decode_from_memory`` expose the two halves for pipeline
+    split-rank stages and two-phase generation."""
+
+    config: T5Config
+
+    def setup(self):
+        cfg = self.config
+        self.shared = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.d_model,
+            params_dtype=cfg.params_dtype, name="shared")
+        self.encoder = T5Encoder(cfg, name="encoder")
+        self.decoder = T5Decoder(cfg, name="decoder")
+        if not cfg.tie_word_embeddings:
+            tp = get_tensor_model_parallel_world_size()
+            self.lm_head = self.param(
+                "lm_head", nn.initializers.normal(0.02),
+                (cfg.d_model, divide(cfg.vocab_size, tp)),
+                cfg.params_dtype)
+
+    def _embed(self, tokens):
+        # [b, s] -> [s, b, d_model] (seq-major, Megatron layout)
+        return self.shared(tokens).astype(
+            self.config.compute_dtype).transpose(1, 0, 2)
+
+    def encode(self, enc_tokens, enc_mask=None):
+        return self.encoder(self._embed(enc_tokens), enc_mask)
+
+    def decode_hidden(self, dec_tokens, memory, enc_mask=None):
+        """Decoder stack only (pre-head [s, b, d_model]) — the pipeline
+        split-rank stage payload; the head lives in ``head`` so the
+        schedule's loss_func can apply it on the last rank."""
+        return self.decoder(self._embed(dec_tokens),
+                            memory.astype(self.config.compute_dtype),
+                            cross_mask=enc_mask)
+
+    def head(self, h):
+        cfg = self.config
+        h = copy_to_tensor_model_parallel_region(
+            h.astype(cfg.compute_dtype))
+        if cfg.tie_word_embeddings:
+            # tied head contracts with the shared table after the T5
+            # rescale (HF: sequence_output * d_model**-0.5)
+            h = h * jnp.asarray(cfg.d_model ** -0.5, h.dtype)
+            logits = self.shared.attend(h)
+        else:
+            logits = jnp.einsum(
+                "sbh,hv->sbv", h, self.lm_head.astype(cfg.compute_dtype),
+                preferred_element_type=jnp.float32)
+        return logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
+
+    def decode_from_memory(self, dec_tokens, memory, enc_mask=None):
+        return self.head(self.decode_hidden(dec_tokens, memory, enc_mask))
+
+    def __call__(self, enc_tokens, dec_tokens, enc_mask=None):
+        memory = self.encode(enc_tokens, enc_mask)
+        return self.decode_from_memory(dec_tokens, memory, enc_mask)
+
+
+def t5_greedy_generate(model, params, enc_tokens, max_new_tokens,
+                       decoder_start_token_id=0, enc_mask=None):
+    """Greedy decode: encode once, then argmax one token at a time with a
+    full decoder re-run per step (bounded unrolled loop — token-exact
+    oracle path; the KV-cache fast path is the decoder-only family's
+    ``generate``)."""
+    from apex_tpu.transformer.tensor_parallel import (
+        gather_from_tensor_model_parallel_region,
+    )
+
+    b = enc_tokens.shape[0]
+    memory = model.apply({"params": params}, enc_tokens, enc_mask,
+                         method=T5Model.encode)
+    dec = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    for _ in range(max_new_tokens):
+        logits = model.apply({"params": params}, dec, memory, enc_mask,
+                             method=T5Model.decode_from_memory)
+        # vocab-parallel shards -> full vocabulary before argmax (no-op
+        # at tp=1 / unbound axis)
+        full = gather_from_tensor_model_parallel_region(logits[:, -1, :])
+        nxt = jnp.argmax(full, axis=-1).astype(jnp.int32)
+        dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
+    return dec
+
+
+def t5_loss_fn(vocab_parallel_logits, labels, loss_mask=None):
+    """Mean per-token vocab-parallel CE over decoder positions."""
+    from apex_tpu.transformer.tensor_parallel import (
+        vocab_parallel_cross_entropy,
+    )
+
+    losses = vocab_parallel_cross_entropy(vocab_parallel_logits, labels)
+    if loss_mask is not None:
+        return jnp.sum(losses * loss_mask) / jnp.maximum(
+            jnp.sum(loss_mask), 1.0)
+    return jnp.mean(losses)

@@ -112,6 +112,51 @@ def _dense_tp_rule(cfg, tp):
     return rule
 
 
+# -- T5 family ---------------------------------------------------------------
+
+# column-parallel (split output columns, axis -1) / row-parallel (split
+# input rows, axis -2) module names in models/t5.py
+_T5_COLUMN = frozenset({"q", "k", "v", "wi", "wi_0", "wi_1"})
+_T5_ROW = frozenset({"o", "wo"})
+_T5_REPLICATED = frozenset({
+    "self_attn_norm", "cross_attn_norm", "ffn_norm", "final_norm",
+    "relative_bias",  # full [buckets, heads] table; module slices per rank
+})
+
+
+def split_t5_params_for_tp(cfg, params, tp: int):
+    """Stacked [tp, ...] layout for a tp=1 T5Model param tree: per-head
+    column splits for q/k/v and the (gated) FFN up-projections, row
+    splits for o/wo, vocab rows for the shared embedding, vocab columns
+    for an untied head; the relative-bias table replicates (the module
+    reads its head slice by rank). Fails loudly on unknown matrices."""
+    for name, n in (("num_heads", cfg.num_heads), ("d_ff", cfg.d_ff),
+                    ("vocab_size", cfg.vocab_size)):
+        if n % tp:
+            raise ValueError(f"{name} ({n}) is not divisible by tp ({tp})")
+    if tp == 1:
+        return jax.tree_util.tree_map(lambda a: a[None], params)
+
+    def rule(path, leaf):
+        names = set(_path_names(path))
+        if names & _T5_COLUMN:
+            return _split_contiguous(leaf, tp, -1)
+        if names & _T5_ROW:
+            return _split_contiguous(leaf, tp, -2)
+        if "shared" in names:
+            return _split_contiguous(leaf, tp, -2)
+        if "lm_head" in names:
+            return _split_contiguous(leaf, tp, -1)
+        if leaf.ndim >= 2 and not (names & _T5_REPLICATED):
+            raise ValueError(
+                f"split_t5_params_for_tp: unrecognized weight matrix at "
+                f"{jax.tree_util.keystr(path)} (shape {leaf.shape}) — "
+                f"refusing to silently replicate; add a split rule")
+        return _replicate(leaf, tp)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
 def split_params_for_tp(cfg, params, tp: int):
     """Return the stacked [tp, ...] pytree for a tp=1 GPTModel param
     tree (see module doc). Validates divisibility of heads/groups/ffn/

@@ -205,9 +205,11 @@ def _warn_sliding_window_flash_once(window, seq):
     but it was unavailable at this call site (non-TPU backend, an
     explicit attention_mask, or seq not a block multiple) — the
     masked-softmax path materializes full [s, s] scores. Trace-time,
-    warn once per distinct (window, seq) so a later, different config
-    that also falls back still gets its own signal."""
-    key = (int(window), int(seq))
+    warn once per distinct window so a later, different model that also
+    falls back still gets a signal, while variable-length workloads
+    (length-bucketed batches retracing many seq values) don't spam one
+    warning per length."""
+    key = int(window)
     if key in _SWA_FLASH_WARNED:
         return
     _SWA_FLASH_WARNED.add(key)

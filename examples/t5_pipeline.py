@@ -51,6 +51,11 @@ def main():
                    help="microbatch size")
     p.add_argument("--hidden", type=int, default=32)
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--model", choices=["standalone", "real"],
+                   default="standalone",
+                   help="'real' runs the full T5Model family (relative-"
+                        "position buckets, RMS norms, tied head) as the "
+                        "pipeline stages; needs --pp 2 --split 1")
     args = p.parse_args()
 
     from apex_tpu.optimizers import FusedAdam
@@ -94,18 +99,57 @@ def main():
         pipeline_model_parallel_split_rank_=args.split,
         devices=jax.devices()[:args.pp])
 
-    step = make_encoder_decoder_step(
-        functools.partial(encoder_block, cfg=cfg),
-        functools.partial(decoder_block, cfg=cfg))
+    if args.model == "real":
+        # the full T5 family (models/t5.py) as the pipeline stages: the
+        # encoder rank runs T5Model.encode, the decoder rank runs
+        # decode_hidden with the forwarded memory, the loss applies the
+        # tied head. One whole side per rank -> pp=2/split=1.
+        if (args.pp, args.split) != (2, 1):
+            raise SystemExit("--model real needs --pp 2 --split 1 "
+                             "(one full encoder rank + one decoder rank)")
+        from apex_tpu.models.t5 import T5Config, T5Model, t5_loss_fn
 
-    def loss_func(params, payload, mb):
-        return t5_loss(params, payload["decoder"], mb)
+        tcfg = T5Config(
+            vocab_size=cfg["vocab"], d_model=args.hidden, d_kv=16,
+            d_ff=2 * args.hidden, num_layers=2, num_decoder_layers=2,
+            num_heads=cfg["heads"], compute_dtype=jnp.float32)
+        model = T5Model(tcfg)
+
+        def enc_fn(p, h, mb, is_first):
+            del h, is_first
+            return model.apply({"params": p}, mb["enc_tokens"],
+                               method=T5Model.encode)
+
+        def dec_fn(p, h, memory, mb, is_split):
+            del h, is_split
+            return model.apply({"params": p}, mb["dec_tokens"], memory,
+                               method=T5Model.decode_hidden)
+
+        step = make_encoder_decoder_step(enc_fn, dec_fn)
+
+        def loss_func(params, payload, mb):
+            logits = model.apply({"params": params}, payload["decoder"],
+                                 method=T5Model.head)
+            return t5_loss_fn(logits, mb["dec_targets"])
+
+        init_rank = lambda r: model.init(
+            jax.random.PRNGKey(r), mbs["enc_tokens"][0],
+            mbs["dec_tokens"][0])["params"]
+    else:
+        step = make_encoder_decoder_step(
+            functools.partial(encoder_block, cfg=cfg),
+            functools.partial(decoder_block, cfg=cfg))
+
+        def loss_func(params, payload, mb):
+            return t5_loss(params, payload["decoder"], mb)
+
+        init_rank = lambda r: init_stage_params(rng, cfg)
 
     opt = FusedAdam(lr=args.lr)
     # one stage's params per pp rank, stacked for shard_map entry
     stage_params = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs),
-        *[init_stage_params(rng, cfg) for _ in range(args.pp)])
+        *[init_rank(r) for r in range(args.pp)])
     opt_state = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs),
         *[opt.init(jax.tree_util.tree_map(lambda a: a[r], stage_params))

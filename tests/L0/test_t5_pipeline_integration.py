@@ -1,0 +1,126 @@
+"""T5Model riding the split-rank pipeline schedule (pp=2, split=1).
+
+The round-3 split-rank schedule was verified with a standalone test
+vehicle; this closes the loop with the REAL model family: the full
+T5Model (relative-position bias buckets, RMS norms, cross-attention,
+tied head) as the pipeline's encoder/decoder stages, with loss and
+gradient parity against the unpipelined two-program composition
+(encode with rank 0's params, decode with rank 1's).
+
+Reference: ModelType.encoder_and_decoder pipelines in
+apex/transformer/pipeline_parallel/schedules/fwd_bwd_pipelining_without_interleaving.py:29-86
+driven by T5-shaped models (tests/L0/run_transformer/).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from apex_tpu.testing import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.models.t5 import T5Config, T5Model, t5_loss_fn
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_with_split,
+    make_encoder_decoder_step,
+)
+
+M = 2   # microbatches
+B = 2   # microbatch size
+ENC_S, DEC_S = 6, 5
+
+
+@pytest.fixture
+def cfg():
+    return T5Config(
+        vocab_size=32, d_model=32, d_kv=16, d_ff=48, num_layers=1,
+        num_decoder_layers=1, num_heads=2,
+        relative_attention_num_buckets=8,
+        relative_attention_max_distance=10,
+        compute_dtype=jnp.float32)
+
+
+def test_t5_model_split_pipeline_matches_two_program_composition(cfg):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    parallel_state.destroy_model_parallel()
+    rng = np.random.RandomState(0)
+    mbs = {
+        "enc_tokens": jnp.asarray(rng.randint(0, 32, (M, B, ENC_S))),
+        "dec_tokens": jnp.asarray(rng.randint(0, 32, (M, B, DEC_S))),
+        "dec_targets": jnp.asarray(rng.randint(0, 32, (M, B, DEC_S))),
+    }
+    model = T5Model(cfg)
+    params = [
+        model.init(jax.random.PRNGKey(r),
+                   mbs["enc_tokens"][0], mbs["dec_tokens"][0])["params"]
+        for r in range(2)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params)
+
+    # -- unpipelined oracle: encode with rank0 params, decode with rank1
+    def ref_total(stacked_):
+        p0 = jax.tree_util.tree_map(lambda a: a[0], stacked_)
+        p1 = jax.tree_util.tree_map(lambda a: a[1], stacked_)
+        losses = []
+        for m in range(M):
+            memory = model.apply({"params": p0}, mbs["enc_tokens"][m],
+                                 method=T5Model.encode)
+            logits = model.apply({"params": p1}, mbs["dec_tokens"][m],
+                                 memory, method=T5Model.decode_from_memory)
+            losses.append(t5_loss_fn(logits, mbs["dec_targets"][m]))
+        return sum(losses) / M, jnp.stack(losses)
+
+    (_, ref_losses), ref_grads = jax.value_and_grad(
+        ref_total, has_aux=True)(stacked)
+
+    # -- pipelined run
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=2,
+        pipeline_model_parallel_split_rank_=1,
+        devices=jax.devices()[:2])
+
+    def enc_fn(p, h, mb, is_first):
+        del h, is_first  # single encoder stage: always embeds
+        return model.apply({"params": p}, mb["enc_tokens"],
+                           method=T5Model.encode)
+
+    def dec_fn(p, h, memory, mb, is_split):
+        del h, is_split  # single decoder stage: always embeds
+        return model.apply({"params": p}, mb["dec_tokens"], memory,
+                           method=T5Model.decode_hidden)
+
+    step = make_encoder_decoder_step(enc_fn, dec_fn)
+
+    def loss_func(p, payload, mb):
+        logits = model.apply({"params": p}, payload["decoder"],
+                             method=T5Model.head)
+        return t5_loss_fn(logits, mb["dec_targets"])
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P("pp"), P()), out_specs=(P("pp"), P("pp")))
+    def run(p_stage, mbs_):
+        p = jax.tree_util.tree_map(lambda a: a[0], p_stage)
+        losses, grads = forward_backward_pipelining_with_split(
+            step, loss_func, p, mbs_, num_microbatches=M,
+            encoder_tensor_shape=(ENC_S, B, cfg.d_model),
+            decoder_tensor_shape=(DEC_S, B, cfg.d_model),
+            dtype=jnp.float32, pp_size=2)
+        grads = jax.tree_util.tree_map(lambda a: a[None], grads)
+        return losses[None], grads
+
+    losses, grads = jax.jit(run)(stacked, mbs)
+    parallel_state.destroy_model_parallel()
+
+    np.testing.assert_allclose(np.asarray(losses)[1], np.asarray(ref_losses),
+                               rtol=1e-4, atol=1e-5)
+    flat_ref, _ = jax.tree_util.tree_flatten_with_path(ref_grads)
+    flat_got = dict(jax.tree_util.tree_flatten_with_path(grads)[0])
+    for path, ref_leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(flat_got[path]), np.asarray(ref_leaf),
+            rtol=2e-3, atol=1e-4, err_msg=jax.tree_util.keystr(path))

@@ -1,0 +1,126 @@
+"""Convert a HuggingFace T5 checkpoint into apex_tpu T5Model params.
+
+Migration tooling + external numerics oracle (tests/L0/test_hf_convert_t5.py):
+identical weights must reproduce HF's logits through an independent
+implementation — validating the relative-position bucket assignment,
+unscaled attention scores, RMS layernorm placement, (gated-)FFN, and the
+tied-head d_model**-0.5 rescale end to end.
+
+Usage (offline, state-dict based):
+
+    from transformers import T5ForConditionalGeneration
+    from tools.convert_hf_t5 import convert_t5
+
+    hf = T5ForConditionalGeneration.from_pretrained(path)
+    cfg, params = convert_t5(hf.state_dict(), hf.config)
+    logits = T5Model(cfg).apply({"params": params}, enc_tokens, dec_tokens)
+
+Layout notes:
+- HF ``nn.Linear`` weights are [out, in]; apex_tpu's parallel linears are
+  [in, out] — every projection transposes.
+- HF keeps the relative bias table inside block 0's SelfAttention; here it
+  lives at stack level (``encoder/relative_bias``) since every layer reads
+  the same table.
+- Original T5 ties the LM head to ``shared`` (with the d_model**-0.5
+  rescale); v1.1 ('gated-gelu') unties it.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def _attn(sd, prefix):
+    return {n: {"weight": _t(sd[f"{prefix}.{n}.weight"]).T}
+            for n in ("q", "k", "v", "o")}
+
+
+def _ffn(sd, prefix, gated):
+    if gated:
+        return {"wi_0": {"weight": _t(sd[f"{prefix}.wi_0.weight"]).T},
+                "wi_1": {"weight": _t(sd[f"{prefix}.wi_1.weight"]).T},
+                "wo": {"weight": _t(sd[f"{prefix}.wo.weight"]).T}}
+    return {"wi": {"weight": _t(sd[f"{prefix}.wi.weight"]).T},
+            "wo": {"weight": _t(sd[f"{prefix}.wo.weight"]).T}}
+
+
+def convert_t5(state_dict, hf_config):
+    """(T5Config, params pytree) from a T5ForConditionalGeneration
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models.t5 import T5Config
+
+    sd = state_dict
+    proj = hf_config.feed_forward_proj
+    if proj not in ("relu", "gated-gelu"):
+        # e.g. "gelu" or "gated-silu": weights would load fine but run
+        # the wrong activation — refuse rather than silently mis-convert
+        raise ValueError(
+            f"convert_t5 supports feed_forward_proj 'relu' (t5) and "
+            f"'gated-gelu' (t5 v1.1); got {proj!r}")
+    gated = proj == "gated-gelu"
+    cfg = T5Config(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.d_model,
+        d_kv=hf_config.d_kv,
+        d_ff=hf_config.d_ff,
+        num_layers=hf_config.num_layers,
+        num_decoder_layers=hf_config.num_decoder_layers,
+        num_heads=hf_config.num_heads,
+        relative_attention_num_buckets=(
+            hf_config.relative_attention_num_buckets),
+        relative_attention_max_distance=getattr(
+            hf_config, "relative_attention_max_distance", 128),
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        feed_forward_proj="gated-gelu" if gated else "relu",
+        tie_word_embeddings=hf_config.tie_word_embeddings,
+        compute_dtype=jnp.float32,
+    )
+
+    enc = {"relative_bias": {"rel_attn_bias": _t(
+        sd["encoder.block.0.layer.0.SelfAttention"
+           ".relative_attention_bias.weight"])},
+        "final_norm": {"weight": _t(sd["encoder.final_layer_norm.weight"])}}
+    for i in range(cfg.num_layers):
+        p = f"encoder.block.{i}"
+        enc[f"block_{i}"] = {
+            "self_attn_norm": {"weight": _t(
+                sd[f"{p}.layer.0.layer_norm.weight"])},
+            "self_attn": _attn(sd, f"{p}.layer.0.SelfAttention"),
+            "ffn_norm": {"weight": _t(
+                sd[f"{p}.layer.1.layer_norm.weight"])},
+            "ffn": _ffn(sd, f"{p}.layer.1.DenseReluDense", gated),
+        }
+
+    dec = {"relative_bias": {"rel_attn_bias": _t(
+        sd["decoder.block.0.layer.0.SelfAttention"
+           ".relative_attention_bias.weight"])},
+        "final_norm": {"weight": _t(sd["decoder.final_layer_norm.weight"])}}
+    for i in range(cfg.decoder_layers):
+        p = f"decoder.block.{i}"
+        dec[f"block_{i}"] = {
+            "self_attn_norm": {"weight": _t(
+                sd[f"{p}.layer.0.layer_norm.weight"])},
+            "self_attn": _attn(sd, f"{p}.layer.0.SelfAttention"),
+            "cross_attn_norm": {"weight": _t(
+                sd[f"{p}.layer.1.layer_norm.weight"])},
+            "cross_attn": _attn(sd, f"{p}.layer.1.EncDecAttention"),
+            "ffn_norm": {"weight": _t(
+                sd[f"{p}.layer.2.layer_norm.weight"])},
+            "ffn": _ffn(sd, f"{p}.layer.2.DenseReluDense", gated),
+        }
+
+    params = {
+        "shared": {"weight": _t(sd["shared.weight"])},
+        "encoder": enc,
+        "decoder": dec,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _t(sd["lm_head.weight"]).T
+    import jax
+
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return cfg, params
