@@ -12,3 +12,11 @@ from apex_tpu.contrib.sparsity.permutation_lib import (  # noqa: F401
     search_for_good_permutation,
     sum_after_2_to_4,
 )
+from apex_tpu.contrib.sparsity.propagation import (  # noqa: F401
+    PermSpec,
+    PermutationGroup,
+    gpt_permutation_groups,
+    propagate_permutations,
+    resnet_permutation_groups,
+    t5_permutation_groups,
+)

@@ -1,0 +1,275 @@
+"""Cross-layer channel-permutation propagation for 2:4 sparsity.
+
+Parity: reference apex/contrib/sparsity/permutation_lib.py — the torch.fx
+graph walk that finds, for every prunable layer, the set of tensors that
+must be co-permuted so the network function is preserved (producer output
+channels, elementwise/norm params on the channel, consumer input
+channels), then applies one jointly-searched permutation per group.
+
+TPU design: JAX models are functional pytrees, not traced module graphs,
+so the "graph" is expressed directly as :class:`PermutationGroup` specs —
+pytree paths + axes (+ optional regions for packed projections like the
+fused [gate | up] swiglu weight). Builders for the in-repo model zoo
+(:func:`gpt_permutation_groups`, :func:`t5_permutation_groups`,
+:func:`resnet_permutation_groups`) produce the same producer/consumer
+pairs the reference's fx walk would discover, without the user plumbing
+anything by hand.
+
+Orientation note: ``sparse_masklib.create_mask`` groups 4-wide along the
+LAST axis of each 2-D weight (the flax [in, out] layout's output dim), so
+the searched/permuted channels are the producer's *output* channels; each
+consumer compensates along its *input* axis with the SAME index vector
+(a' = a[perm]  ⇒  w_consumer' = w_consumer[perm, :]), and 1-D channel
+params (biases, BN scale/bias/mean/var) permute elementwise. Residual-
+stream channels are never permuted (same restriction the reference's
+group-segmentation enforces at ops it cannot pass through).
+"""
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.contrib.sparsity.permutation_lib import (
+    search_for_good_permutation,
+    sum_after_2_to_4,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PermSpec:
+    """One tensor's participation in a permutation group.
+
+    ``path``: key tuple into the variables pytree (collections included,
+    e.g. ``("params", "transformer", "layer_0", ...)``).
+    ``axis``: the axis holding the permuted channels.
+    ``search``: whether this tensor's retained 2:4 magnitude is part of
+    the search objective (True for the masked producer weights; False
+    for compensating consumers/passthroughs, whose masks are invariant
+    under this permutation).
+    ``region``: optional (start, size) slice along ``axis`` for packed
+    projections; the permutation acts within the region.
+    """
+
+    path: Tuple[Any, ...]
+    axis: int
+    search: bool = False
+    region: Optional[Tuple[int, int]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PermutationGroup:
+    """Tensors sharing one channel permutation."""
+
+    name: str
+    specs: Tuple[PermSpec, ...]
+
+
+def _get(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _set(tree, path, value):
+    if not path:
+        return value
+    head, rest = path[0], path[1:]
+    out = dict(tree)
+    out[head] = _set(tree[head], rest, value)
+    return out
+
+
+def _channels_last_2d(leaf, axis, region):
+    """Slice the region, move ``axis`` last, flatten to [K, C]."""
+    if region is not None:
+        leaf = jax.lax.slice_in_dim(leaf, region[0], region[0] + region[1],
+                                    axis=axis)
+    moved = jnp.moveaxis(leaf, axis, -1)
+    return moved.reshape(-1, moved.shape[-1])
+
+
+def _apply_perm(leaf, axis, region, perm):
+    perm = jnp.asarray(perm)
+    if region is None:
+        return jnp.take(leaf, perm, axis=axis)
+    leaf = jnp.asarray(leaf)  # .at[] needs a jax array (numpy trees ok)
+    start, size = region
+    sl = jax.lax.slice_in_dim(leaf, start, start + size, axis=axis)
+    sl = jnp.take(sl, perm, axis=axis)
+    idx = [slice(None)] * leaf.ndim
+    idx[axis] = slice(start, start + size)
+    return leaf.at[tuple(idx)].set(sl)
+
+
+def propagate_permutations(variables, groups: Sequence[PermutationGroup],
+                           num_iters: int = 10, chunk: int = 64,
+                           verbose: bool = False):
+    """Search one permutation per group on the masked producer weights
+    and apply it to every member tensor.
+
+    Returns ``(permuted_variables, report)`` where report maps group name
+    to ``{"kept_before", "kept_after", "perm"}``. Groups whose search
+    finds no improvement are left untouched (identity perm recorded).
+    The network function is preserved exactly (up to dtype rounding):
+    producers permute outputs, consumers permute the matching inputs.
+    """
+    report = {}
+    for grp in groups:
+        search_specs = [s for s in grp.specs if s.search]
+        if not search_specs:
+            raise ValueError(f"group {grp.name!r} has no search tensors")
+        mats = [np.asarray(_channels_last_2d(_get(variables, s.path),
+                                             s.axis, s.region),
+                           np.float32) for s in search_specs]
+        c = mats[0].shape[-1]
+        for s, m in zip(search_specs, mats):
+            if m.shape[-1] != c:
+                raise ValueError(
+                    f"group {grp.name!r}: search tensor {s.path} has "
+                    f"{m.shape[-1]} channels, expected {c}")
+        if c % 4:
+            raise ValueError(
+                f"group {grp.name!r}: channel count {c} not divisible "
+                f"by 4")
+        joint = np.concatenate(mats, axis=0)  # [sum K, C]
+        before = float(sum_after_2_to_4(jnp.asarray(joint)))
+        perm, _ = search_for_good_permutation(joint, num_iters=num_iters,
+                                              chunk=chunk)
+        after = float(sum_after_2_to_4(jnp.asarray(joint[:, perm])))
+        if after > before:
+            for s in grp.specs:
+                leaf = _get(variables, s.path)
+                variables = _set(variables, s.path,
+                                 _apply_perm(leaf, s.axis, s.region, perm))
+        else:
+            perm = np.arange(c)
+        report[grp.name] = {"kept_before": before, "kept_after": after,
+                            "perm": np.asarray(perm)}
+        if verbose:
+            print(f"[ASP perm] {grp.name}: kept {before:.2f} -> "
+                  f"{after:.2f} ({(after / max(before, 1e-9) - 1) * 100:+.2f}%)")
+    return variables, report
+
+
+# -- model-zoo group builders -------------------------------------------------
+
+def gpt_permutation_groups(cfg, variables):
+    """Producer/consumer groups for GPTModel / the parallel transformer
+    stack (models/transformer_lm.py): per layer, the MLP interior
+    channels — dense_h_to_4h output columns (the masked search target),
+    its bias, and dense_4h_to_h input rows. With swiglu/geglu the packed
+    [gate | up] projection contributes two same-permutation regions whose
+    channels align with the gated product feeding dense_4h_to_h.
+
+    Attention interiors and every residual-stream dim are left alone
+    (the permutation would cross softmax/head boundaries — the same
+    place the reference's fx walk segments its groups).
+
+    ``variables``: the full ``{"params": ...}`` dict.
+    """
+    if getattr(cfg, "scan_layers", False):
+        raise ValueError(
+            "gpt_permutation_groups needs per-layer leaves; scan_layers "
+            "stacks all layers into one param (a single shared "
+            "permutation would be wrong per layer)")
+    gated = cfg.activation in ("swiglu", "geglu")
+    ffn = cfg.ffn_size
+    groups = []
+    params = variables["params"]
+    root = params["transformer"] if "transformer" in params else params
+    prefix = ("params", "transformer") if "transformer" in params else (
+        "params",)
+    for name in sorted(k for k in root if k.startswith("layer_")):
+        mlp = root[name].get("mlp")
+        if mlp is None or "dense_h_to_4h" not in mlp:
+            continue  # MoE layer: expert interiors have their own layout
+        base = prefix + (name, "mlp")
+        specs = []
+        if gated:
+            specs.append(PermSpec(base + ("dense_h_to_4h", "weight"),
+                                  axis=-1, search=True, region=(0, ffn)))
+            specs.append(PermSpec(base + ("dense_h_to_4h", "weight"),
+                                  axis=-1, search=True, region=(ffn, ffn)))
+        else:
+            specs.append(PermSpec(base + ("dense_h_to_4h", "weight"),
+                                  axis=-1, search=True))
+            if "bias" in mlp["dense_h_to_4h"]:
+                specs.append(PermSpec(base + ("dense_h_to_4h", "bias"),
+                                      axis=-1))
+        specs.append(PermSpec(base + ("dense_4h_to_h", "weight"), axis=0))
+        groups.append(PermutationGroup(f"{name}/mlp", tuple(specs)))
+    return groups
+
+
+def t5_permutation_groups(cfg, variables):
+    """Groups for T5Model (models/t5.py): encoder and decoder FFN
+    interiors — wi (or the wi_0/wi_1 pair, jointly searched with one
+    shared permutation) output columns + wo input rows.
+
+    ``variables``: the full ``{"params": ...}`` dict."""
+    groups = []
+    for side, depth in (("encoder", cfg.num_layers),
+                        ("decoder", cfg.decoder_layers)):
+        for i in range(depth):
+            base = ("params", side, f"block_{i}", "ffn")
+            ffn = _get(variables, base)
+            specs = []
+            if "wi" in ffn:
+                specs.append(PermSpec(base + ("wi", "weight"), axis=-1,
+                                      search=True))
+            else:
+                specs.append(PermSpec(base + ("wi_0", "weight"), axis=-1,
+                                      search=True))
+                specs.append(PermSpec(base + ("wi_1", "weight"), axis=-1,
+                                      search=True))
+            specs.append(PermSpec(base + ("wo", "weight"), axis=0))
+            groups.append(PermutationGroup(f"{side}/block_{i}/ffn",
+                                           tuple(specs)))
+    return groups
+
+
+def _bn_specs(variables, bn_path_params, bn_path_stats):
+    specs = [PermSpec(bn_path_params + ("scale",), axis=0),
+             PermSpec(bn_path_params + ("bias",), axis=0)]
+    # batch_stats exists once the model has run at least one train step
+    try:
+        _get(variables, bn_path_stats)
+        specs += [PermSpec(bn_path_stats + ("mean",), axis=0),
+                  PermSpec(bn_path_stats + ("var",), axis=0)]
+    except (KeyError, TypeError):
+        pass
+    return specs
+
+
+def resnet_permutation_groups(variables):
+    """Groups for the ResNet family (models/resnet.py): inside every
+    Basic/Bottleneck block, each conv -> BN -> relu -> conv chain that
+    does not touch the residual stream. Conv kernels are NHWC
+    [kh, kw, cin, cout]: producers permute axis -1, consumers axis 2,
+    and the BatchNorm between permutes scale/bias (+ running mean/var in
+    ``batch_stats`` when present)."""
+    params = variables["params"]
+    groups = []
+    for block in sorted(k for k in params
+                        if k.startswith(("BottleneckBlock_",
+                                         "BasicBlock_"))):
+        convs = sorted(k for k in params[block] if k.startswith("Conv_"))
+        # chain pairs: Conv_0 -> BN_0 -> Conv_1 (-> BN_1 -> Conv_2);
+        # the LAST conv's output feeds the residual sum — locked.
+        for a, b in zip(convs[:-1], convs[1:]):
+            bn = "BatchNorm_" + a.split("_")[1]
+            norm_name = bn if bn in params[block] else (
+                "SyncBatchNorm_" + a.split("_")[1])
+            specs = [
+                PermSpec(("params", block, a, "kernel"), axis=-1,
+                         search=True),
+                *_bn_specs(variables, ("params", block, norm_name),
+                           ("batch_stats", block, norm_name)),
+                PermSpec(("params", block, b, "kernel"), axis=2),
+            ]
+            groups.append(PermutationGroup(f"{block}/{a}->{b}",
+                                           tuple(specs)))
+    return groups

@@ -14,8 +14,9 @@ rescale — on the same tensor-parallel primitives as the GPT/BERT families
 (column/row-parallel projections, vocab-parallel embedding).
 
 Encoder and decoder are exposed both fused (``__call__``) and as separate
-``encode`` / ``decode_step`` methods so pipeline split-rank stages and
-two-phase generation can drive each side independently.
+``encode`` / ``decode_hidden`` / ``head`` / ``decode_from_memory``
+methods so pipeline split-rank stages and two-phase generation can drive
+each side independently.
 """
 
 import dataclasses
