@@ -176,7 +176,6 @@ def gpt_permutation_groups(cfg, variables):
             "stacks all layers into one param (a single shared "
             "permutation would be wrong per layer)")
     gated = cfg.activation in ("swiglu", "geglu")
-    ffn = cfg.ffn_size
     groups = []
     params = variables["params"]
     root = params["transformer"] if "transformer" in params else params
@@ -189,10 +188,15 @@ def gpt_permutation_groups(cfg, variables):
         base = prefix + (name, "mlp")
         specs = []
         if gated:
+            # regions from the LOCAL leaf (a tp shard holds 2*ffn/tp
+            # columns — [local gate | local up]); cfg.ffn_size would
+            # straddle the shard's gate/up boundary under tp>1
+            half = mlp["dense_h_to_4h"]["weight"].shape[-1] // 2
             specs.append(PermSpec(base + ("dense_h_to_4h", "weight"),
-                                  axis=-1, search=True, region=(0, ffn)))
+                                  axis=-1, search=True, region=(0, half)))
             specs.append(PermSpec(base + ("dense_h_to_4h", "weight"),
-                                  axis=-1, search=True, region=(ffn, ffn)))
+                                  axis=-1, search=True,
+                                  region=(half, half)))
         else:
             specs.append(PermSpec(base + ("dense_h_to_4h", "weight"),
                                   axis=-1, search=True))

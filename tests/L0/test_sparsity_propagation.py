@@ -157,6 +157,26 @@ def test_mask_retention_improves_end_to_end():
     assert kept(permuted) > base
 
 
+def test_gated_regions_follow_local_shard_width():
+    """Packed [gate | up] regions come from the LEAF's width, not the
+    global cfg.ffn_size — a tp shard holds 2*ffn/tp columns and a global
+    region would straddle its gate/up boundary."""
+    from apex_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(
+        hidden_size=32, num_layers=1, num_attention_heads=4,
+        vocab_size=64, max_position_embeddings=32, ffn_hidden_size=64,
+        activation="swiglu", normalization="rmsnorm")
+    # simulate one tp=2 rank: packed width 2*ffn/tp = 64
+    variables = {"params": {"transformer": {"layer_0": {"mlp": {
+        "dense_h_to_4h": {"weight": jnp.zeros((32, 64))},
+        "dense_4h_to_h": {"weight": jnp.zeros((32, 32))},
+    }}}}}
+    (group,) = gpt_permutation_groups(cfg, variables)
+    regions = [s.region for s in group.specs if s.search]
+    assert regions == [(0, 32), (32, 32)]
+
+
 def test_unknown_group_validation():
     with pytest.raises(ValueError, match="no search tensors"):
         propagate_permutations(
