@@ -44,8 +44,13 @@ class AmpOptimizer(object):
         # Amp-owned masters live under a distinct key so ownership is
         # derivable from a (possibly checkpoint-restored) state alone.
         if self.master_weights and "master" not in inner_state:
-            inner_state["amp_master"] = jax.tree_util.tree_map(
-                lambda p: p.astype(jnp.float32), params)
+            # alias-free copy: astype is a no-op on already-fp32 leaves
+            # (all norm params under O2) and would alias masters to the
+            # live params — donating both then trips XLA's
+            # donate-same-buffer-twice check (tools/donation_repro.py)
+            from apex_tpu.optimizers._base import master_copy_tree
+
+            inner_state["amp_master"] = master_copy_tree(params)
         return {"inner": inner_state, "scaler": self.scaler.init_state()}
 
     def step(self, grads, state, params, *, lr=None):

@@ -116,9 +116,18 @@ def _write_state(path: str, host_state, use_orbax: bool) -> None:
             os.makedirs(tmp, exist_ok=True)
             with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                 pickle.dump(host_state, f)
+        old = None
         if os.path.exists(path):
-            shutil.rmtree(path)  # force-overwrite semantics
+            # force-overwrite: park the old dir under a non-matching name
+            # first so a crash between the two renames leaves the data
+            # recoverable and never a half-deleted step dir
+            old = f"{path}.old-{os.getpid()}"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(path, old)
         os.rename(tmp, path)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
     finally:
         if os.path.exists(tmp):
             shutil.rmtree(tmp, ignore_errors=True)

@@ -32,9 +32,13 @@ class FP16_Optimizer(object):
         return self.loss_scaler.loss_scale
 
     def init(self, params):
+        from apex_tpu.optimizers._base import master_copy_tree
+
         inner_state = self.inner.init(params)
-        inner_state["fp32_master"] = jax.tree_util.tree_map(
-            lambda p: p.astype(jnp.float32), params)
+        # alias-free copies: astype is a no-op on fp32 leaves and would
+        # alias masters to live params (donation double-donate; see
+        # master_copy_tree / tools/donation_repro.py)
+        inner_state["fp32_master"] = master_copy_tree(params)
         return inner_state
 
     def backward(self, loss):

@@ -54,7 +54,8 @@ def prep_param_lists(params, flat_master=False):
     if flat_master:
         flat = jnp.concatenate([p.reshape(-1).astype(jnp.float32) for p in model_leaves])
         return model_leaves, [flat]
-    return model_leaves, [p.astype(jnp.float32) for p in model_leaves]
+    return model_leaves, [jnp.array(p, dtype=jnp.float32, copy=True)
+                          for p in model_leaves]  # alias-free masters
 
 
 def model_grads_to_master_grads(model_grads, master_params, flat_master=False):

@@ -27,6 +27,23 @@ def cast_tree(params, dtype):
     return jax.tree_util.tree_map(lambda p: p.astype(dtype), params)
 
 
+def master_copy_tree(params, dtype=None):
+    """Alias-free cast for fp32 master-weight creation.
+
+    ``astype`` is a no-op on leaves already at ``dtype`` and returns the
+    SAME buffer — a master tree built that way aliases the model params
+    wherever they are already fp32 (all norm params under amp O2), and a
+    train step donating both params and opt_state then presents one
+    buffer twice to XLA: "Attempt to donate the same buffer twice in
+    Execute()" (the round-3 'ResNet donation INVALID_ARGUMENT';
+    tools/donation_repro.py rung 4). ``jnp.array(..., copy=True)``
+    forces a distinct buffer for every leaf.
+    """
+    dtype = jnp.float32 if dtype is None else dtype
+    return jax.tree_util.tree_map(
+        lambda p: jnp.array(p, dtype=dtype, copy=True), params)
+
+
 class FusedOptimizerBase:
     """Base class giving the stateful-eager and optax views of a stepper."""
 

@@ -17,6 +17,7 @@ from apex_tpu.ops import multi_tensor_adam, multi_tensor_adam_capturable_master
 from apex_tpu.optimizers._base import (
     FusedOptimizerBase,
     cast_tree,
+    master_copy_tree,
     resolve_found_inf,
     zeros_like_tree,
 )
@@ -52,7 +53,7 @@ class FusedAdam(FusedOptimizerBase):
             "exp_avg_sq": zeros_like_tree(params),
         }
         if self.master_weights:
-            state["master"] = cast_tree(params, jnp.float32)
+            state["master"] = master_copy_tree(params)
         return state
 
     def step(self, grads, state, params, *, lr: Optional[float] = None,

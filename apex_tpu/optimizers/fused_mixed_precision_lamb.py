@@ -16,6 +16,7 @@ from apex_tpu.ops import multi_tensor_l2norm_scale, multi_tensor_lamb_mp
 from apex_tpu.optimizers._base import (
     FusedOptimizerBase,
     cast_tree,
+    master_copy_tree,
     resolve_found_inf,
     zeros_like_tree,
 )
@@ -45,7 +46,7 @@ class FusedMixedPrecisionLamb(FusedOptimizerBase):
             "step": jnp.asarray(self.initial_step, jnp.int32),
             "exp_avg": zeros_like_tree(params),
             "exp_avg_sq": zeros_like_tree(params),
-            "master": cast_tree(params, jnp.float32),
+            "master": master_copy_tree(params),
         }
 
     def step(self, grads, state, params, *, lr: Optional[float] = None,

@@ -553,13 +553,14 @@ def main():
                                  verbosity=0)
     opt_state = opt.init(params)
 
-    # NOTE: no donation by default — donating any of this step's buffers
-    # (params, batch_stats or opt_state, in any combination) tripped an
-    # INVALID_ARGUMENT in the tunneled TPU backend and wedged the device
-    # session; the BERT bench's donation works fine (was +7% there).
-    # APEX_TPU_RESNET_DONATE=1 retries it on an updated runtime.
-    donate = (dict(donate_argnums=(0, 1, 2))
-              if os.environ.get("APEX_TPU_RESNET_DONATE") == "1" else {})
+    # Donation ON (round 4): the round-2/3 INVALID_ARGUMENT was root-
+    # caused as OUR bug, not the backend's — amp O2's fp32 masters were
+    # no-op-cast ALIASES of the already-fp32 norm params, so donating
+    # params and opt_state presented the same buffer twice to Execute()
+    # (tools/donation_repro.py, reproduced on CPU; fixed by
+    # master_copy_tree). APEX_TPU_RESNET_DONATE=0 opts out.
+    donate = ({} if os.environ.get("APEX_TPU_RESNET_DONATE") == "0"
+              else dict(donate_argnums=(0, 1, 2)))
 
     @functools.partial(jax.jit, **donate)
     def train_step(params, batch_stats, opt_state, images, labels):

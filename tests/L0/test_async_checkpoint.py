@@ -34,7 +34,10 @@ def test_save_returns_before_write_and_steps_overlap(tmp_path):
     """The background write is gated open by the test; steps run to
     completion while the checkpoint is still in flight."""
     gate = threading.Event()
-    ck = AsyncCheckpointer(use_orbax=False, _pre_write_hook=gate.wait)
+    # timeout: an assertion failure before gate.set() must fail the
+    # test, not hang the non-daemon worker forever
+    ck = AsyncCheckpointer(use_orbax=False,
+                           _pre_write_hook=lambda: gate.wait(60))
     state = _train_state()
     x = jnp.ones((8, 64))
 
@@ -65,7 +68,8 @@ def test_snapshot_isolated_from_donation(tmp_path):
                                                       s),
                      donate_argnums=0)
     gate = threading.Event()
-    ck = AsyncCheckpointer(use_orbax=False, _pre_write_hook=gate.wait)
+    ck = AsyncCheckpointer(use_orbax=False,
+                           _pre_write_hook=lambda: gate.wait(60))
     state = {"w": jnp.arange(16.0)}
     ck.save(str(tmp_path), 3, state)
     state = donate(state)  # invalidates the old device buffers

@@ -1,0 +1,210 @@
+"""Bisection harness for the ResNet donation INVALID_ARGUMENT (VERDICT
+r3 item 5 / bench.py note).
+
+Observed (round 2-3, tunneled axon backend): donating any of
+{params, batch_stats, opt_state} into the ResNet-50 O2 train step trips
+INVALID_ARGUMENT and wedges the device session, while the BERT bench's
+donation works. This ladder isolates the trigger with the SMALLEST
+possible device footprint per rung, each in its own subprocess so a
+wedge costs one rung, not the session:
+
+  1  plain donated matmul step
+  2  donated conv
+  3  donated conv + BatchNorm (mutable batch_stats pytree, fp32 stats)
+  4  donated one-BottleneckBlock train step (amp O2 + FusedAdam)
+  5  donated full ResNet-50 train step (the bench config, small batch)
+
+Run:  python tools/donation_repro.py [rung]     (no arg = all, in order)
+Each rung prints one line: RUNG <n> OK | RUNG <n> FAIL <ExcType>: msg.
+CPU note: donation is a no-op on the CPU backend (buffers are not
+aliased), so all rungs pass there — the ladder is meaningful on-chip.
+"""
+
+import functools
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def rung_1():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(w, x):
+        return w - 0.01 * (x.T @ (x @ w))
+
+    w = jnp.ones((512, 512), jnp.bfloat16)
+    x = jnp.ones((64, 512), jnp.bfloat16)
+    for _ in range(3):
+        w = step(w, x)
+    float(jnp.sum(w.astype(jnp.float32)))
+
+
+def rung_2():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(k, x):
+        y = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO",
+                                                     "NHWC"))
+        return k - 1e-4 * jnp.mean(y) * jnp.ones_like(k)
+
+    k = jnp.ones((3, 3, 32, 32), jnp.bfloat16)
+    x = jnp.ones((8, 56, 56, 32), jnp.bfloat16)
+    for _ in range(3):
+        k = step(k, x)
+    float(jnp.sum(k.astype(jnp.float32)))
+
+
+def rung_3():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    class ConvBN(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Conv(32, (3, 3), use_bias=False, dtype=jnp.bfloat16,
+                        param_dtype=jnp.float32)(x)
+            return nn.BatchNorm(use_running_average=False, momentum=0.9,
+                                dtype=jnp.bfloat16,
+                                param_dtype=jnp.float32)(x)
+
+    model = ConvBN()
+    x = jnp.ones((8, 56, 56, 32), jnp.bfloat16)
+    v = model.init(jax.random.PRNGKey(0), x)
+    params, bs = v["params"], v["batch_stats"]
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, bs, x):
+        def loss(p):
+            y, upd = model.apply({"params": p, "batch_stats": bs}, x,
+                                 mutable=["batch_stats"])
+            return jnp.mean(y.astype(jnp.float32)), upd["batch_stats"]
+
+        (l, new_bs), g = jax.value_and_grad(loss, has_aux=True)(params)
+        new_p = jax.tree_util.tree_map(lambda a, b: a - 1e-3 * b, params, g)
+        return new_p, new_bs, l
+
+    for _ in range(3):
+        params, bs, l = step(params, bs, x)
+    float(l)
+
+
+def _block_step(model, batch, img):
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+
+    x = jnp.ones((batch,) + img, jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+    params, bs = v["params"], v["batch_stats"]
+    params, opt = amp.initialize(params, FusedAdam(lr=1e-3),
+                                 opt_level="O2", verbosity=0)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, bs, opt_state, x, labels):
+        def loss_fn(p):
+            logits, upd = model.apply({"params": p, "batch_stats": bs}, x,
+                                      train=True, mutable=["batch_stats"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            l = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], -1))
+            return l, upd["batch_stats"]
+
+        scale = opt_state["scaler"].loss_scale
+        (l, new_bs), g = jax.value_and_grad(
+            lambda p: (lambda a, b: (a * scale, b))(*loss_fn(p)),
+            has_aux=True)(params)
+        new_p, new_o = opt.step(g, opt_state, params)
+        return new_p, new_bs, new_o, l / scale
+
+    out = step(params, bs, opt_state, x, labels)
+    for _ in range(2):
+        out = step(*out[:3], x, labels)
+    float(out[3])
+
+
+def rung_4():
+    import flax.linen as nn
+    import jax.numpy as jnp
+    from functools import partial
+
+    from apex_tpu.models.resnet import BottleneckBlock
+
+    class OneBlock(nn.Module):
+        train: bool = True
+
+        @nn.compact
+        def __call__(self, x, train=True):
+            conv = partial(nn.Conv, use_bias=False, dtype=jnp.bfloat16,
+                           param_dtype=jnp.float32)
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, dtype=jnp.bfloat16,
+                           param_dtype=jnp.float32)
+            x = x.astype(jnp.bfloat16)
+            x = BottleneckBlock(16, 1, conv=conv, norm=norm)(x)
+            x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+            return nn.Dense(10, dtype=jnp.float32)(x)
+
+    _block_step(OneBlock(), batch=8, img=(32, 32, 3))
+
+
+def rung_5():
+    import jax.numpy as jnp
+
+    from apex_tpu.models import ResNet50
+
+    _block_step(ResNet50(num_classes=1000, dtype=jnp.bfloat16),
+                batch=16, img=(224, 224, 3))
+
+
+RUNGS = {1: rung_1, 2: rung_2, 3: rung_3, 4: rung_4, 5: rung_5}
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the tunneled-TPU plugin ignores the env var; the config route
+        # must win before any backend init (same guard as the examples)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if len(sys.argv) > 1:
+        n = int(sys.argv[1])
+        try:
+            RUNGS[n]()
+            print(f"RUNG {n} OK", flush=True)
+        except Exception as e:  # noqa: BLE001 — the whole point is triage
+            print(f"RUNG {n} FAIL {type(e).__name__}: "
+                  f"{str(e)[:300]}", flush=True)
+            sys.exit(1)
+        return
+    # drive each rung in its own subprocess (a wedge costs one rung)
+    for n in sorted(RUNGS):
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                                str(n)], timeout=1800)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            # a wedged device session — the very failure mode the ladder
+            # triages; report it as the stopping rung, don't traceback
+            print(f"RUNG {n} WEDGE (no result in 1800s; child killed)",
+                  flush=True)
+            rc = 1
+        if rc != 0:
+            print(f"ladder stopped at rung {n} (first failing config)",
+                  flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()
