@@ -34,6 +34,14 @@ if [ "$stage" = all ] || [ "$stage" = sweep ]; then
   done
 fi
 
+if [ "$stage" = all ] || [ "$stage" = extras ]; then
+  # round-4 addition: donation ladder (expects all 5 rungs OK post-fix).
+  # NOTE interleave_cost (VERDICT r3 item 8) needs a P-device pp mesh —
+  # impossible on this 1-chip environment; regime boundary documented in
+  # docs/parallelism.md instead.
+  run donation_ladder python tools/donation_repro.py
+fi
+
 if [ "$stage" = all ] || [ "$stage" = l1 ]; then
   for c in resnet_O0 resnet_O0_adam resnet_O1 resnet_O2 resnet_O3 \
            bert_O0 bert_O2 dcgan_O0 dcgan_O2; do
