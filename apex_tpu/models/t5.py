@@ -20,6 +20,7 @@ each side independently.
 """
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -54,6 +55,9 @@ class T5Config:
     layer_norm_epsilon: float = 1e-6
     feed_forward_proj: str = "relu"  # or "gated-gelu" (t5 v1.1)
     tie_word_embeddings: bool = True
+    # KV-cache window for incremental decoding (relative positions put
+    # no hard limit on T5 lengths; this bounds only the decode cache)
+    max_decode_length: int = 512
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     activation_checkpointing: bool = False
@@ -147,17 +151,23 @@ class T5Attention(nn.Module):
 
     config: T5Config
     causal: bool = False
+    cross: bool = False  # encoder-decoder attention (memory K/V)
 
     @nn.compact
     def __call__(self, x_q, x_kv=None, position_bias=None,
-                 attention_mask=None):
+                 attention_mask=None, mode="train", pos=None):
+        """``mode`` (static): 'train' — full attention; 'prefill' —
+        decode with cache writes (self-attn K/V appended at ``pos``,
+        cross-attn K/V of the memory computed once and stored);
+        'step' — decode reading the caches (cross projections are never
+        re-applied: this trace doesn't touch their params at all)."""
         cfg = self.config
         tp = get_tensor_model_parallel_world_size()
         n_local = divide(cfg.num_heads, tp)
         d = cfg.d_kv
         sq, b, _ = x_q.shape
-        x_kv = x_q if x_kv is None else x_kv
-        skv = x_kv.shape[0]
+        cross = self.cross
+        decode = mode in ("prefill", "step")
 
         def proj(name, src):
             return ColumnParallelLinear(
@@ -166,8 +176,55 @@ class T5Attention(nn.Module):
                 params_dtype=cfg.params_dtype, name=name)(src)
 
         q = proj("q", x_q).reshape(sq, b, n_local, d)
-        k = proj("k", x_kv).reshape(skv, b, n_local, d)
-        v = proj("v", x_kv).reshape(skv, b, n_local, d)
+
+        kv_mask = attention_mask
+        if not decode:
+            src = x_q if not cross else x_kv
+            skv = src.shape[0]
+            k = proj("k", src).reshape(skv, b, n_local, d)
+            v = proj("v", src).reshape(skv, b, n_local, d)
+            causal_from = jnp.arange(sq)[:, None] if self.causal else None
+        elif cross:
+            if mode == "prefill":
+                skv = x_kv.shape[0]
+                k = proj("k", x_kv).reshape(skv, b, n_local, d)
+                v = proj("v", x_kv).reshape(skv, b, n_local, d)
+                ck = self.variable("cache", "cross_key",
+                                   lambda: k.astype(cfg.compute_dtype))
+                cv = self.variable("cache", "cross_value",
+                                   lambda: v.astype(cfg.compute_dtype))
+                ck.value = k.astype(cfg.compute_dtype)
+                cv.value = v.astype(cfg.compute_dtype)
+            else:
+                if not self.has_variable("cache", "cross_key"):
+                    # reachable now that cross-ness is declared on the
+                    # module (an empty cache dict means no prefill ran)
+                    raise ValueError(
+                        "T5 decode_step before decode_prefill: the "
+                        "cross-attention cache is empty")
+                k = self.variable("cache", "cross_key", None).value
+                v = self.variable("cache", "cross_value", None).value
+            causal_from = None  # encoder memory is fully visible
+        else:
+            # causal self-attention over the cache prefix
+            if pos is None:
+                raise ValueError("decode self-attention needs pos")
+            max_len = cfg.max_decode_length
+            k_new = proj("k", x_q).reshape(sq, b, n_local, d)
+            v_new = proj("v", x_q).reshape(sq, b, n_local, d)
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (max_len, b, n_local, d), cfg.compute_dtype)
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (max_len, b, n_local, d), cfg.compute_dtype)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k_new.astype(cfg.compute_dtype), (pos, 0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v_new.astype(cfg.compute_dtype), (pos, 0, 0, 0))
+            k, v = ck.value, cv.value
+            causal_from = pos + jnp.arange(sq)[:, None]
+            kv_mask = None  # decoder tokens are unpadded by contract
 
         # T5 leaves scores unscaled (the 1/sqrt(d) lives in init)
         scores = jnp.einsum("qbnd,kbnd->bnqk",
@@ -176,15 +233,13 @@ class T5Attention(nn.Module):
                             preferred_element_type=jnp.float32)
         if position_bias is not None:
             scores = scores + position_bias[None]  # [n, q, k] broadcast
-        if self.causal:
-            i = jnp.arange(sq)[:, None]
-            j = jnp.arange(skv)[None, :]
-            scores = jnp.where(j > i, -1e9, scores)
-        if attention_mask is not None:
+        if causal_from is not None:
+            j = jnp.arange(k.shape[0])[None, :]
+            scores = jnp.where(j > causal_from, -1e9, scores)
+        if kv_mask is not None:
             # [b, k] padding mask: True/1 = attend
             scores = jnp.where(
-                attention_mask.astype(bool)[:, None, None, :],
-                scores, -1e9)
+                kv_mask.astype(bool)[:, None, None, :], scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bnqk,kbnd->qbnd",
                          probs.astype(cfg.compute_dtype),
@@ -243,18 +298,20 @@ class T5Block(nn.Module):
 
     @nn.compact
     def __call__(self, h, memory=None, position_bias=None,
-                 self_mask=None, cross_mask=None):
+                 self_mask=None, cross_mask=None, mode="train", pos=None):
         cfg = self.config
         x = _norm(cfg, "self_attn_norm")(h.astype(jnp.float32)).astype(
             cfg.compute_dtype)
         h = h + T5Attention(cfg, causal=self.causal, name="self_attn")(
-            x, None, position_bias, self_mask).astype(h.dtype)
+            x, None, position_bias, self_mask, mode=mode,
+            pos=pos).astype(h.dtype)
         if self.has_cross:
             x = _norm(cfg, "cross_attn_norm")(h.astype(jnp.float32)).astype(
                 cfg.compute_dtype)
             # cross-attention carries no relative bias (T5 convention)
-            h = h + T5Attention(cfg, causal=False, name="cross_attn")(
-                x, memory, None, cross_mask).astype(h.dtype)
+            h = h + T5Attention(cfg, causal=False, cross=True,
+                                name="cross_attn")(
+                x, memory, None, cross_mask, mode=mode).astype(h.dtype)
         x = _norm(cfg, "ffn_norm")(h.astype(jnp.float32)).astype(
             cfg.compute_dtype)
         return h + T5FFN(cfg, name="ffn")(x).astype(h.dtype)
@@ -282,22 +339,47 @@ class T5Encoder(nn.Module):
 
 class T5Decoder(nn.Module):
     """Embedded decoder tokens + encoder memory -> pre-head hidden
-    [s, b, d_model] (fp32 normed)."""
+    [s, b, d_model] (fp32 normed).
+
+    ``mode='prefill'/'step'`` runs the KV-cache incremental path: a
+    stack-level ``pos`` counter offsets the relative-position bias
+    (computed against the full cache window), self-attention appends to
+    per-block caches, and cross-attention K/V are computed from the
+    memory once at prefill, then read back — a step trace never touches
+    the cross k/v projection weights."""
 
     config: T5Config
 
     @nn.compact
-    def __call__(self, h, memory, self_mask=None, cross_mask=None):
+    def __call__(self, h, memory=None, self_mask=None, cross_mask=None,
+                 mode="train"):
         cfg = self.config
-        bias = _RelativeBias(cfg, bidirectional=False,
-                             name="relative_bias")(h.shape[0], h.shape[0])
+        rel = _RelativeBias(cfg, bidirectional=False, name="relative_bias")
+        pos = None
+        if mode in ("prefill", "step"):
+            ctr = self.variable("cache", "pos",
+                                lambda: jnp.zeros((), jnp.int32))
+            pos = jnp.zeros((), jnp.int32) if mode == "prefill" \
+                else ctr.value
+            bias = rel(h.shape[0], cfg.max_decode_length, q_offset=pos)
+            ctr.value = pos + h.shape[0]
+        else:
+            bias = rel(h.shape[0], h.shape[0])
         block = T5Block
-        if cfg.activation_checkpointing:
+        if cfg.activation_checkpointing and mode == "train":
             block = nn.checkpoint(T5Block, static_argnums=())
         for i in range(cfg.decoder_layers):
-            h = block(cfg, has_cross=True, causal=True,
-                      name=f"block_{i}")(h, memory, bias, self_mask,
-                                         cross_mask)
+            if mode == "train":
+                # keyword-free call: nn.checkpoint traces every arg and
+                # a static mode string must not reach it
+                h = block(cfg, has_cross=True, causal=True,
+                          name=f"block_{i}")(h, memory, bias, self_mask,
+                                             cross_mask)
+            else:
+                h = T5Block(cfg, has_cross=True, causal=True,
+                            name=f"block_{i}")(h, memory, bias, self_mask,
+                                               cross_mask, mode=mode,
+                                               pos=pos)
         return _norm(cfg, "final_norm")(h.astype(jnp.float32))
 
 
@@ -357,6 +439,25 @@ class T5Model(nn.Module):
     def decode_from_memory(self, dec_tokens, memory, enc_mask=None):
         return self.head(self.decode_hidden(dec_tokens, memory, enc_mask))
 
+    def decode_prefill(self, dec_tokens, memory, enc_mask=None):
+        """KV-cache decode, phase 1: run the given decoder prefix,
+        filling the self-attention caches and computing the
+        cross-attention K/V from ``memory`` once. Apply with
+        ``mutable=["cache"]``. Returns [b, s, vocab/tp] logits."""
+        h = self.decoder(self._embed(dec_tokens),
+                         memory.astype(self.config.compute_dtype),
+                         cross_mask=enc_mask, mode="prefill")
+        return self.head(h)
+
+    def decode_step(self, dec_tokens, enc_mask=None):
+        """KV-cache decode, phase 2: extend by ``dec_tokens`` (usually
+        one token) against the caches; the encoder memory is NOT needed
+        (cross K/V are read back, their projections never re-applied).
+        Apply with ``mutable=["cache"]``."""
+        h = self.decoder(self._embed(dec_tokens), None,
+                         cross_mask=enc_mask, mode="step")
+        return self.head(h)
+
     def __call__(self, enc_tokens, dec_tokens, enc_mask=None):
         memory = self.encode(enc_tokens, enc_mask)
         return self.decode_from_memory(dec_tokens, memory, enc_mask)
@@ -385,6 +486,90 @@ def t5_greedy_generate(model, params, enc_tokens, max_new_tokens,
         nxt = jnp.argmax(full, axis=-1).astype(jnp.int32)
         dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
     return dec
+
+
+def init_t5_cache(model, batch_size: int, enc_seq: int,
+                  prefill_len: int = 1):
+    """Zeroed decode cache for ``model`` (shape-only trace): per-block
+    self-attn K/V windows of ``max_decode_length``, cross-attn K/V for an
+    ``enc_seq``-long memory, and the stack position counter."""
+    dummy_dec = jnp.zeros((batch_size, prefill_len), jnp.int32)
+    dummy_mem = jnp.zeros((enc_seq, batch_size, model.config.d_model),
+                          jnp.float32)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), dummy_dec, dummy_mem,
+                           None, method=T5Model.decode_prefill))["cache"]
+    return jax.tree_util.tree_map(
+        lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
+
+
+@functools.lru_cache(maxsize=16)
+def _t5_compiled_decode(model, max_new_tokens, has_mask):
+    """jitted prefill + scan-decode for :func:`t5_cached_generate`,
+    cached per (model, length, maskedness) so a serving loop compiles
+    once (same pattern as generation.py's ``_compiled``). ``enc_mask``
+    is threaded as an argument — closures would defeat the cache."""
+    from apex_tpu.transformer.tensor_parallel import (
+        gather_from_tensor_model_parallel_region,
+    )
+
+    @jax.jit
+    def prefill(params, cache, start, memory, enc_mask):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, start, memory,
+            enc_mask if has_mask else None,
+            mutable=["cache"], method=T5Model.decode_prefill)
+        full = gather_from_tensor_model_parallel_region(logits[:, -1, :])
+        return mut["cache"], jnp.argmax(full, -1).astype(jnp.int32)
+
+    @jax.jit
+    def decode_all(params, cache, first, enc_mask):
+        def step(carry, _):
+            cache, tok = carry
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                enc_mask if has_mask else None,
+                mutable=["cache"], method=T5Model.decode_step)
+            full = gather_from_tensor_model_parallel_region(
+                logits[:, -1, :])
+            nxt = jnp.argmax(full, -1).astype(jnp.int32)
+            return (mut["cache"], nxt), nxt
+        (_, _), toks = jax.lax.scan(step, (cache, first), None,
+                                    length=max_new_tokens - 1)
+        return toks  # [T-1, b]
+
+    return prefill, decode_all
+
+
+def t5_cached_generate(model, params, enc_tokens, max_new_tokens,
+                       decoder_start_token_id=0, enc_mask=None):
+    """Greedy decode on the KV-cache path: encode once, prefill with the
+    start token, then one jitted single-token step per new token under
+    ``lax.scan`` — per-step work is O(1) in the generated length (vs the
+    full decoder re-run of :func:`t5_greedy_generate`, its oracle)."""
+    cfg = model.config
+    # slots written: 1 (prefill, the start token) + max_new_tokens - 1
+    # steps (the last generated token is never fed back)
+    if max_new_tokens > cfg.max_decode_length:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_decode_length ({cfg.max_decode_length})")
+    b, s_enc = enc_tokens.shape
+    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    if max_new_tokens == 0:
+        return start
+    memory = model.apply({"params": params}, enc_tokens, enc_mask,
+                         method=T5Model.encode)
+    cache = init_t5_cache(model, b, s_enc)
+    prefill, decode_all = _t5_compiled_decode(model, max_new_tokens,
+                                              enc_mask is not None)
+    mask_arg = (enc_mask if enc_mask is not None
+                else jnp.ones((b, s_enc), jnp.int32))
+    cache, first = prefill(params, cache, start, memory, mask_arg)
+    if max_new_tokens == 1:
+        return jnp.concatenate([start, first[:, None]], axis=1)
+    toks = decode_all(params, cache, first, mask_arg)
+    return jnp.concatenate([start, first[:, None], toks.T], axis=1)
 
 
 def t5_loss_fn(vocab_parallel_logits, labels, loss_mask=None):
