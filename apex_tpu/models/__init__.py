@@ -29,7 +29,6 @@ from apex_tpu.models.tp_split import (  # noqa: F401
 from apex_tpu.models.t5 import (  # noqa: F401
     T5Config,
     T5Model,
-    init_t5_cache,
     t5_cached_generate,
     t5_greedy_generate,
     t5_loss_fn,

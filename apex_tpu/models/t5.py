@@ -488,21 +488,6 @@ def t5_greedy_generate(model, params, enc_tokens, max_new_tokens,
     return dec
 
 
-def init_t5_cache(model, batch_size: int, enc_seq: int,
-                  prefill_len: int = 1):
-    """Zeroed decode cache for ``model`` (shape-only trace): per-block
-    self-attn K/V windows of ``max_decode_length``, cross-attn K/V for an
-    ``enc_seq``-long memory, and the stack position counter."""
-    dummy_dec = jnp.zeros((batch_size, prefill_len), jnp.int32)
-    dummy_mem = jnp.zeros((enc_seq, batch_size, model.config.d_model),
-                          jnp.float32)
-    shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), dummy_dec, dummy_mem,
-                           None, method=T5Model.decode_prefill))["cache"]
-    return jax.tree_util.tree_map(
-        lambda sd: jnp.zeros(sd.shape, sd.dtype), shapes)
-
-
 @functools.lru_cache(maxsize=16)
 def _t5_compiled_decode(model, max_new_tokens, has_mask):
     """jitted prefill + scan-decode for :func:`t5_cached_generate`,
@@ -514,9 +499,13 @@ def _t5_compiled_decode(model, max_new_tokens, has_mask):
     )
 
     @jax.jit
-    def prefill(params, cache, start, memory, enc_mask):
+    def prefill(params, start, memory, enc_mask):
+        # no pre-built cache: flax CREATES the 'cache' collection under
+        # mutable — so a decode_step without a prefilled cache has no
+        # cross_key variable and hits the loud guard instead of silently
+        # attending over zeros
         logits, mut = model.apply(
-            {"params": params, "cache": cache}, start, memory,
+            {"params": params}, start, memory,
             enc_mask if has_mask else None,
             mutable=["cache"], method=T5Model.decode_prefill)
         full = gather_from_tensor_model_parallel_region(logits[:, -1, :])
@@ -554,21 +543,20 @@ def t5_cached_generate(model, params, enc_tokens, max_new_tokens,
         raise ValueError(
             f"max_new_tokens ({max_new_tokens}) exceeds "
             f"max_decode_length ({cfg.max_decode_length})")
-    b, s_enc = enc_tokens.shape
+    b = enc_tokens.shape[0]
     start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
     if max_new_tokens == 0:
         return start
     memory = model.apply({"params": params}, enc_tokens, enc_mask,
                          method=T5Model.encode)
-    cache = init_t5_cache(model, b, s_enc)
     prefill, decode_all = _t5_compiled_decode(model, max_new_tokens,
                                               enc_mask is not None)
-    mask_arg = (enc_mask if enc_mask is not None
-                else jnp.ones((b, s_enc), jnp.int32))
-    cache, first = prefill(params, cache, start, memory, mask_arg)
+    # enc_mask may be None: jit treats it as an empty pytree node, and
+    # has_mask already specializes the trace
+    cache, first = prefill(params, start, memory, enc_mask)
     if max_new_tokens == 1:
         return jnp.concatenate([start, first[:, None]], axis=1)
-    toks = decode_all(params, cache, first, mask_arg)
+    toks = decode_all(params, cache, first, enc_mask)
     return jnp.concatenate([start, first[:, None], toks.T], axis=1)
 
 

@@ -385,6 +385,58 @@ def bench_gpt2(batch, steps, *, flash=None, scan=None, remat=None,
     return result
 
 
+def bench_t5(batch, steps):
+    """T5-base encoder-decoder (12+12 x 768, relative-position buckets)
+    single-chip training throughput — the encoder_and_decoder model
+    family the reference's split-rank pipeline machinery exists for."""
+    from apex_tpu.models import T5Config, T5Model, t5_loss_fn
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    enc_s = dec_s = 512
+    cfg = T5Config(
+        vocab_size=32128, d_model=768, d_kv=64, d_ff=3072,
+        num_layers=12, num_decoder_layers=12, num_heads=12,
+        compute_dtype=jnp.bfloat16,
+        activation_checkpointing=BENCH_REMAT)
+    model = T5Model(cfg)
+    rng = np.random.RandomState(0)
+    enc = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, enc_s)))
+    dec = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, dec_s)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, dec_s)))
+    params = model.init(jax.random.PRNGKey(0), enc, dec)["params"]
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state):
+        def loss_fn(p):
+            return t5_loss_fn(
+                model.apply({"params": p}, enc, dec), labels)
+
+        loss_v, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss_v
+
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
+    # fwd model FLOPs (2 / matmul param touched + attention matmuls):
+    h, inner, ffn = cfg.d_model, cfg.inner_dim, cfg.d_ff
+    enc_layer = 4 * h * inner + 2 * h * ffn          # qkvo + ffn params
+    dec_layer = 8 * h * inner + 2 * h * ffn          # self + cross + ffn
+    fwd = (batch * enc_s * (cfg.num_layers * (2 * enc_layer
+                                              + 4 * enc_s * inner))
+           + batch * dec_s * (cfg.decoder_layers * (2 * dec_layer
+                                                    + 4 * dec_s * inner
+                                                    + 4 * enc_s * inner)
+                              + 2 * h * cfg.vocab_size))
+    flops = 3 * fwd  # train = fwd + bwd (2x)
+    total_tokens = batch * (enc_s + dec_s)
+    _emit("t5_base_tokens_per_sec_per_chip",
+          total_tokens * steps / dt, "tokens/sec", flops, steps, dt)
+
+
 def bench_moe(batch, steps):
     """MoE GPT (16 layers x 1024, 8 experts top-1, seq 1024) single-chip
     training throughput — the expert-parallel capability beyond the
@@ -521,6 +573,10 @@ def main():
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
         return bench_gpt2(batch, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "t5":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+        return bench_t5(batch, steps)
     if len(sys.argv) > 1 and sys.argv[1] == "moe":
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15

@@ -220,3 +220,19 @@ def test_t5_cached_generate_gated_and_masked():
                                 max_new_tokens=6,
                                 enc_mask=jnp.asarray(mask))
     np.testing.assert_array_equal(np.asarray(cached), np.asarray(oracle))
+
+
+def test_t5_decode_step_without_prefill_raises():
+    import jax
+
+    from apex_tpu.models.t5 import T5Config, T5Model
+
+    _fresh()
+    cfg = T5Config(vocab_size=32, d_model=32, d_kv=8, d_ff=32,
+                   num_layers=1, num_heads=2, compute_dtype=jnp.float32)
+    model = T5Model(cfg)
+    enc = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), enc, enc)["params"]
+    with pytest.raises(ValueError, match="decode_step before"):
+        model.apply({"params": params}, jnp.zeros((1, 1), jnp.int32),
+                    None, mutable=["cache"], method=T5Model.decode_step)
