@@ -42,3 +42,8 @@ from apex_tpu.models.reshard import (  # noqa: F401
 from apex_tpu.models.bert import BertModel, bert_loss_fn  # noqa: F401
 from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
 from apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401
+from apex_tpu.models.vit import (  # noqa: F401
+    ViTModel,
+    vit_config,
+    vit_loss_fn,
+)

@@ -54,6 +54,9 @@ def _replicate(x, tp):
 _REPLICATED_MODULES = frozenset({
     "position_embeddings", "input_layernorm", "post_attention_layernorm",
     "final_layernorm",
+    # ViT (models/vit.py): embed/classifier touch only the replicated
+    # residual dim; the transformer body splits by the rules above
+    "patch_embed", "cls_token", "classifier",
 })
 
 
@@ -78,13 +81,18 @@ def _dense_tp_rule(cfg, tp):
     kv = cfg.kv_channels
     for name, n in (("num_attention_heads", heads),
                     ("query_groups", groups),
-                    ("ffn_size", cfg.ffn_size),
-                    ("vocab_size", cfg.vocab_size)):
+                    ("ffn_size", cfg.ffn_size)):
         if n % tp:
             raise ValueError(f"{name} ({n}) is not divisible by tp ({tp})")
 
     def rule(path, leaf):
         names = set(_path_names(path))
+        if (names & {"word_embeddings", "lm_head", "lm_head_bias"}
+                and cfg.vocab_size % tp):
+            # checked lazily: vocab-less models (ViT) carry a dummy
+            # vocab_size and no vocab-sharded leaves
+            raise ValueError(f"vocab_size ({cfg.vocab_size}) is not "
+                             f"divisible by tp ({tp})")
         if "query_key_value" in names:
             if groups == heads:
                 return _split_contiguous(leaf, tp, -1)

@@ -1,0 +1,94 @@
+"""Vision Transformer on the parallel transformer stack.
+
+Parity: the reference carries Megatron's vision model surface in its
+launch-flag layer (apex/transformer/testing — vision/DINO argument tails
+handled by `testing/arguments.py` here), and its ImageNet example is the
+CV half of its model zoo. This supplies the actual model family: a
+standard ViT (patch-conv embed, [CLS] token, learned positions, pre-LN
+bidirectional blocks with exact-erf gelu, classifier on the CLS state)
+riding the SAME tensor/sequence-parallel transformer stack as
+GPT/BERT/T5 — so every TP/SP/pipeline/amp facility applies to vision
+models unchanged. NHWC images feed the MXU's native conv path.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.transformer_lm import (
+    ParallelTransformer,
+    TransformerConfig,
+)
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.enums import AttnMaskType
+
+
+def vit_config(hidden_size=768, num_layers=12, num_heads=12,
+               ffn_hidden_size=None, layernorm_epsilon=1e-12,
+               compute_dtype=jnp.bfloat16, **kw) -> TransformerConfig:
+    """TransformerConfig preset for ViT: bidirectional (padding mask
+    type), exact-erf gelu (HF ViT convention), no flash (short patch
+    sequences; full softmax fuses fine)."""
+    return TransformerConfig(
+        hidden_size=hidden_size, num_layers=num_layers,
+        num_attention_heads=num_heads, ffn_hidden_size=ffn_hidden_size,
+        vocab_size=1,  # unused: no token embedding in ViT
+        max_position_embeddings=1,
+        attn_mask_type=AttnMaskType.padding,
+        activation="gelu_exact", use_flash_attention=False,
+        layernorm_epsilon=layernorm_epsilon,
+        compute_dtype=compute_dtype, **kw)
+
+
+class ViTModel(nn.Module):
+    """[b, H, W, C] NHWC images -> [b, num_classes] logits (or the
+    [s, b, h] encoded sequence when ``num_classes`` is None)."""
+
+    config: TransformerConfig
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    num_classes: Optional[int] = 1000
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.config
+        assert cfg.attn_mask_type == AttnMaskType.padding, (
+            "ViT is bidirectional: build the config with vit_config() "
+            "(causal would silently mask future patches)")
+        p = self.patch_size
+        b = images.shape[0]
+        x = nn.Conv(cfg.hidden_size, (p, p), strides=(p, p),
+                    dtype=cfg.compute_dtype, param_dtype=cfg.params_dtype,
+                    name="patch_embed")(images.astype(cfg.compute_dtype))
+        x = x.reshape(b, -1, cfg.hidden_size)  # [b, np, h]
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.hidden_size), cfg.params_dtype)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(x.dtype),
+                              (b, 1, cfg.hidden_size)), x], axis=1)
+        pos = self.param("position_embeddings",
+                         nn.initializers.normal(0.02),
+                         ((self.image_size // p) ** 2 + 1,
+                          cfg.hidden_size), cfg.params_dtype)
+        x = x + pos[None, :x.shape[1]].astype(x.dtype)
+        h = x.transpose(1, 0, 2)  # [s, b, h] Megatron layout
+        h = ParallelTransformer(cfg, name="transformer")(h, None)
+        h = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                           eps=cfg.layernorm_epsilon,
+                           param_dtype=jnp.float32,
+                           name="final_layernorm")(h.astype(jnp.float32))
+        if self.num_classes is None:
+            return h
+        return nn.Dense(self.num_classes, param_dtype=cfg.params_dtype,
+                        dtype=jnp.float32,
+                        name="classifier")(
+            h[0].astype(jnp.float32))  # CLS state
+
+
+def vit_loss_fn(logits, labels):
+    """Mean softmax cross-entropy over classes."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

@@ -1,0 +1,113 @@
+"""Convert a HuggingFace ViT checkpoint into apex_tpu ViTModel params.
+
+Migration tooling + external numerics oracle
+(tests/L0/test_hf_convert_vit.py): identical weights must reproduce HF's
+logits — validating the patch-conv embed layout conversion, CLS/position
+handling, the fused-QKV per-head column permutation, pre-LN blocks with
+exact-erf gelu, and the CLS classifier end to end.
+
+Layout notes:
+- HF Conv2d patch projection is [h, C, p, p] (OIHW); flax NHWC conv
+  kernels are [p, p, C, h] — transpose (2, 3, 1, 0).
+- HF keeps separate q/k/v Linears; the fused column-parallel QKV packs
+  per head as [q_n | k_n | v_n] — same permutation as the GPT-2
+  converter.
+- HF nn.Linear weights are [out, in]; ours are [in, out].
+"""
+
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def _fuse_qkv(q, k, v, num_heads):
+    """Stack [in, h] q/k/v into the per-head-packed [in, 3h] layout."""
+    h = q.shape[-1]
+    kv = h // num_heads
+    parts = [p.reshape(*p.shape[:-1], num_heads, kv) for p in (q, k, v)]
+    out = np.stack(parts, axis=-2)  # [.., np, 3, kv]
+    return out.reshape(*q.shape[:-1], 3 * h)
+
+
+def convert_vit(state_dict, hf_config):
+    """(TransformerConfig, model kwargs, params pytree) from a
+    ViTForImageClassification state_dict. Single-device layout (tp=1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.vit import vit_config
+
+    sd = {k.removeprefix("vit."): v for k, v in state_dict.items()}
+    if hf_config.hidden_act not in ("gelu",):
+        raise ValueError(f"convert_vit supports hidden_act 'gelu' "
+                         f"(exact erf); got {hf_config.hidden_act!r}")
+    cfg = vit_config(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        ffn_hidden_size=hf_config.intermediate_size,
+        layernorm_epsilon=hf_config.layer_norm_eps,
+        compute_dtype=jnp.float32)
+    kwargs = dict(image_size=hf_config.image_size,
+                  patch_size=hf_config.patch_size,
+                  num_channels=hf_config.num_channels,
+                  num_classes=len(getattr(hf_config, "id2label", {})) or
+                  None)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"encoder.layer.{i}"
+        att = f"{p}.attention.attention"
+        qw = _t(sd[f"{att}.query.weight"]).T
+        kw = _t(sd[f"{att}.key.weight"]).T
+        vw = _t(sd[f"{att}.value.weight"]).T
+        qb = _t(sd[f"{att}.query.bias"])
+        kb = _t(sd[f"{att}.key.bias"])
+        vb = _t(sd[f"{att}.value.bias"])
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {
+                "weight": _t(sd[f"{p}.layernorm_before.weight"]),
+                "bias": _t(sd[f"{p}.layernorm_before.bias"])},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": _fuse_qkv(qw, kw, vw,
+                                        cfg.num_attention_heads),
+                    "bias": _fuse_qkv(qb, kb, vb,
+                                      cfg.num_attention_heads)},
+                "dense": {
+                    "weight": _t(
+                        sd[f"{p}.attention.output.dense.weight"]).T,
+                    "bias": _t(sd[f"{p}.attention.output.dense.bias"])},
+            },
+            "post_attention_layernorm": {
+                "weight": _t(sd[f"{p}.layernorm_after.weight"]),
+                "bias": _t(sd[f"{p}.layernorm_after.bias"])},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": _t(sd[f"{p}.intermediate.dense.weight"]).T,
+                    "bias": _t(sd[f"{p}.intermediate.dense.bias"])},
+                "dense_4h_to_h": {
+                    "weight": _t(sd[f"{p}.output.dense.weight"]).T,
+                    "bias": _t(sd[f"{p}.output.dense.bias"])},
+            },
+        }
+
+    params = {
+        "patch_embed": {
+            "kernel": _t(sd["embeddings.patch_embeddings.projection"
+                            ".weight"]).transpose(2, 3, 1, 0),
+            "bias": _t(sd["embeddings.patch_embeddings.projection.bias"]),
+        },
+        "cls_token": _t(sd["embeddings.cls_token"]),
+        "position_embeddings": _t(sd["embeddings.position_embeddings"])[0],
+        "transformer": layers,
+        "final_layernorm": {"weight": _t(sd["layernorm.weight"]),
+                            "bias": _t(sd["layernorm.bias"])},
+        "classifier": {"kernel": _t(state_dict["classifier.weight"]).T,
+                       "bias": _t(state_dict["classifier.bias"])},
+    }
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return cfg, kwargs, params

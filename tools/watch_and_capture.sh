@@ -2,9 +2,9 @@
 # Round-4 watcher: probe the tunnel; the moment it is healthy, mark
 # .capture_active (tells the builder to pause pytest on this 1-core
 # host — see PERF.md round-3 wedge post-mortems) and run the full
-# on-chip evidence plan. Waits for any in-flight pytest run
-# (.tests_running marker) to finish BEFORE firing — the documented
-# round-3 wedge trigger was host-CPU contention mid-XLA-compile.
+# on-chip evidence plan. Waits for any in-flight pytest run to finish
+# BEFORE firing — the documented round-3 wedge trigger was host-CPU
+# contention mid-XLA-compile.
 # Leaves .capture_done when finished.
 cd /root/repo
 rm -f .capture_active .capture_done
@@ -12,7 +12,7 @@ bash tools/probe_loop.sh "${1:-240}" "${2:-170}" || { echo "probe loop exhausted
 touch .capture_active
 for i in $(seq 1 240); do  # up to 60 min for a test run to drain
   # liveness-based (a stale marker file can't stall the capture):
-  pgrep -f "python -m pytest" > /dev/null || break
+  pgrep -f pytest > /dev/null || break
   sleep 15
 done
 echo "$(date -u +%H:%M:%S) HEALTHY -> firing run_all_onchip" >> .capture_log_watch
