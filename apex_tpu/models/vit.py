@@ -73,7 +73,9 @@ class ViTModel(nn.Module):
                          nn.initializers.normal(0.02),
                          ((self.image_size // p) ** 2 + 1,
                           cfg.hidden_size), cfg.params_dtype)
-        x = x + pos[None, :x.shape[1]].astype(x.dtype)
+        # no silent truncation: a grid/image-size mismatch must raise
+        # (HF ViT does the same), not read spatially wrong positions
+        x = x + pos[None].astype(x.dtype)
         h = x.transpose(1, 0, 2)  # [s, b, h] Megatron layout
         h = ParallelTransformer(cfg, name="transformer")(h, None)
         h = FusedLayerNorm(normalized_shape=cfg.hidden_size,

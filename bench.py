@@ -437,6 +437,46 @@ def bench_t5(batch, steps):
           total_tokens * steps / dt, "tokens/sec", flops, steps, dt)
 
 
+def bench_vit(batch, steps):
+    """ViT-base/16 @ 224 single-chip training throughput (the vision
+    family on the parallel transformer stack; patches feed the MXU as
+    one [b,196+1,768] bidirectional stack)."""
+    from apex_tpu.models import ViTModel, vit_config, vit_loss_fn
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    cfg = vit_config(hidden_size=768, num_layers=12, num_heads=12,
+                     ffn_hidden_size=3072,
+                     activation_checkpointing=BENCH_REMAT)
+    model = ViTModel(cfg, image_size=224, patch_size=16, num_classes=1000)
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.randn(batch, 224, 224, 3).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
+    params = model.init(jax.random.PRNGKey(0), imgs[:2])["params"]
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state):
+        loss_v, grads = jax.value_and_grad(
+            lambda p: vit_loss_fn(model.apply({"params": p}, imgs),
+                                  labels))(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss_v
+
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
+    # fwd FLOPs: patch conv + 12 blocks on seq 197 + classifier
+    s, h, ffn = 197, cfg.hidden_size, cfg.ffn_size
+    per_tok = cfg.num_layers * (2 * (4 * h * h + 2 * h * ffn)
+                                + 4 * s * h)
+    patch = 2 * (16 * 16 * 3) * h  # per patch position
+    fwd = batch * (s * per_tok + (s - 1) * patch + 2 * h * 1000)
+    _emit("vit_base_imgs_per_sec_per_chip", batch * steps / dt,
+          "imgs/sec", 3 * fwd, steps, dt)
+
+
 def bench_moe(batch, steps):
     """MoE GPT (16 layers x 1024, 8 experts top-1, seq 1024) single-chip
     training throughput — the expert-parallel capability beyond the
@@ -577,6 +617,10 @@ def main():
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
         return bench_t5(batch, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "vit":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+        return bench_vit(batch, steps)
     if len(sys.argv) > 1 and sys.argv[1] == "moe":
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15

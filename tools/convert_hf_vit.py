@@ -51,11 +51,11 @@ def convert_vit(state_dict, hf_config):
         ffn_hidden_size=hf_config.intermediate_size,
         layernorm_epsilon=hf_config.layer_norm_eps,
         compute_dtype=jnp.float32)
+    num_labels = getattr(hf_config, "num_labels", 0)
     kwargs = dict(image_size=hf_config.image_size,
                   patch_size=hf_config.patch_size,
                   num_channels=hf_config.num_channels,
-                  num_classes=len(getattr(hf_config, "id2label", {})) or
-                  None)
+                  num_classes=num_labels or None)
 
     layers = {}
     for i in range(cfg.num_layers):
@@ -106,8 +106,10 @@ def convert_vit(state_dict, hf_config):
         "transformer": layers,
         "final_layernorm": {"weight": _t(sd["layernorm.weight"]),
                             "bias": _t(sd["layernorm.bias"])},
-        "classifier": {"kernel": _t(state_dict["classifier.weight"]).T,
-                       "bias": _t(state_dict["classifier.bias"])},
     }
+    if num_labels:  # num_labels=0 -> HF nn.Identity head, no weights
+        params["classifier"] = {
+            "kernel": _t(state_dict["classifier.weight"]).T,
+            "bias": _t(state_dict["classifier.bias"])}
     params = jax.tree_util.tree_map(jnp.asarray, params)
     return cfg, kwargs, params

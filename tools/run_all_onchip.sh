@@ -25,7 +25,7 @@ run() { # run <tag> <cmd...>: log one line per process, keep stderr
 
 if [ "$stage" = all ] || [ "$stage" = benches ]; then
   # driver metric first (resnet default), then the rest
-  bash tools/capture_queue.sh "" gpt2 bert moe t5 decode llama gpt || exit 1
+  bash tools/capture_queue.sh "" gpt2 bert moe t5 vit decode llama gpt || exit 1
 fi
 
 if [ "$stage" = all ] || [ "$stage" = sweep ]; then
@@ -40,6 +40,9 @@ if [ "$stage" = all ] || [ "$stage" = extras ]; then
   # impossible on this 1-chip environment; regime boundary documented in
   # docs/parallelism.md instead.
   run donation_ladder python tools/donation_repro.py
+  # VERDICT r3 item 4: windowed-flash seq*window scaling + alibi-flash
+  run flash_window python tools/flash_window_sweep.py a
+  run flash_alibi python tools/flash_window_sweep.py b
 fi
 
 if [ "$stage" = all ] || [ "$stage" = l1 ]; then

@@ -12,7 +12,7 @@ bash tools/probe_loop.sh "${1:-240}" "${2:-170}" || { echo "probe loop exhausted
 touch .capture_active
 for i in $(seq 1 240); do  # up to 60 min for a test run to drain
   # liveness-based (a stale marker file can't stall the capture):
-  pgrep -f pytest > /dev/null || break
+  pgrep -f "python[0-9.]* -m pytest|(^|[ /])pytest( |$)" > /dev/null || break
   sleep 15
 done
 echo "$(date -u +%H:%M:%S) HEALTHY -> firing run_all_onchip" >> .capture_log_watch
