@@ -51,7 +51,10 @@ def convert_vit(state_dict, hf_config):
         ffn_hidden_size=hf_config.intermediate_size,
         layernorm_epsilon=hf_config.layer_norm_eps,
         compute_dtype=jnp.float32)
-    num_labels = getattr(hf_config, "num_labels", 0)
+    # key off the state_dict, not num_labels: HF configs DEFAULT
+    # num_labels to 2 (len(id2label)) even for headless checkpoints
+    has_head = "classifier.weight" in state_dict
+    num_labels = getattr(hf_config, "num_labels", 0) if has_head else 0
     kwargs = dict(image_size=hf_config.image_size,
                   patch_size=hf_config.patch_size,
                   num_channels=hf_config.num_channels,
@@ -107,7 +110,7 @@ def convert_vit(state_dict, hf_config):
         "final_layernorm": {"weight": _t(sd["layernorm.weight"]),
                             "bias": _t(sd["layernorm.bias"])},
     }
-    if num_labels:  # num_labels=0 -> HF nn.Identity head, no weights
+    if has_head:
         params["classifier"] = {
             "kernel": _t(state_dict["classifier.weight"]).T,
             "bias": _t(state_dict["classifier.bias"])}

@@ -1,0 +1,160 @@
+"""Input-pipeline rate demonstration (VERDICT r3 weak item 5).
+
+The question: can `apex_tpu.data.PrefetchLoader` (+ the native
+`apex_tpu_C.pack_batch`) feed a REAL, disk-backed dataset at the chip's
+measured training rate (ResNet-50 O2: ~2550 imgs/s)? Three host-side
+measurements, one JSON line each — none needs the TPU (the consumer is
+a no-op; the chip only makes the bar LOWER because the loader runs
+concurrently with a device-bound step):
+
+1. mmap-npy shards (the decoded-dataset layout: images stored uint8
+   [224,224,3], memory-mapped per shard, normalized on the fly) through
+   the full assemble+prefetch path.
+2. Same with jax.device_put in the worker (the real deployment shape).
+3. Single-worker JPEG decode (PIL) rate for reference — the decode
+   stage the reference outsources to DALI (GPU decode); on TPU hosts
+   this scales with host cores / a decode service, not with this
+   library, so it is reported, not claimed.
+
+Run:  python tools/loader_rate.py [n_images_per_shard] [n_shards]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+BATCH = 256
+CHIP_RATE = 2550.0  # imgs/s, BENCH r3 ResNet-50 capture
+
+
+def _make_shards(root, per_shard, n_shards):
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(n_shards):
+        imgs = rng.randint(0, 255, (per_shard, 224, 224, 3), np.uint8)
+        labels = rng.randint(0, 1000, (per_shard,), np.int32)
+        pi = os.path.join(root, f"shard_{i:03d}_images.npy")
+        pl = os.path.join(root, f"shard_{i:03d}_labels.npy")
+        np.save(pi, imgs)
+        np.save(pl, labels)
+        paths.append((pi, pl))
+    return paths
+
+
+def _samples(paths, normalize=True):
+    """Stream (image, label) pairs from mmap'd shards — disk-backed, one
+    shard resident at a time (the decoded-ImageNet layout)."""
+    mean = np.array([0.485, 0.456, 0.406], np.float32) * 255
+    std = np.array([0.229, 0.224, 0.225], np.float32) * 255
+    for pi, pl in paths:
+        imgs = np.load(pi, mmap_mode="r")  # true mmap: .npy, not .npz
+        labels = np.load(pl)
+        for i in range(imgs.shape[0]):
+            x = imgs[i]
+            if normalize:
+                x = (x.astype(np.float32) - mean) / std
+            yield x, labels[i]
+
+
+def _rate(loader, n_batches):
+    it = iter(loader)
+    next(it)  # warm the worker/queue
+    t0 = time.perf_counter()
+    got = 0
+    for b in it:
+        got += 1
+        if got >= n_batches:
+            break
+    dt = time.perf_counter() - t0
+    return got * BATCH / dt
+
+
+def main():
+    from apex_tpu.data import PrefetchLoader
+
+    per_shard = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    n_shards = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+    root = tempfile.mkdtemp(prefix="loader_rate_")
+    try:
+        paths = _make_shards(root, per_shard, n_shards)
+        n_batches = per_shard * n_shards // BATCH - 2
+
+        # TPU-native deployment shape: feed uint8, normalize INSIDE the
+        # jitted step (4x less host->device traffic, no per-sample fp32
+        # host math). The host does mmap slice + pack only.
+        loader = PrefetchLoader(_samples(paths, normalize=False), BATCH,
+                                prefetch=2)
+        u8_rate = _rate(loader, n_batches)
+        print(json.dumps({
+            "stage": "uint8 mmap_npy+pack_batch+prefetch "
+                     "(normalize-on-device deployment)",
+            "imgs_per_sec": round(u8_rate, 1),
+            "vs_chip_rate": round(u8_rate / CHIP_RATE, 2)}), flush=True)
+
+        loader = PrefetchLoader(_samples(paths), BATCH, prefetch=2)
+        host_rate = _rate(loader, n_batches)
+        print(json.dumps({
+            "stage": "mmap_npy+host-normalize+pack_batch+prefetch",
+            "imgs_per_sec": round(host_rate, 1),
+            "vs_chip_rate": round(host_rate / CHIP_RATE, 2)}), flush=True)
+
+        try:
+            import jax
+
+            if os.environ.get("JAX_PLATFORMS") == "cpu":
+                jax.config.update("jax_platforms", "cpu")
+            loader = PrefetchLoader(_samples(paths), BATCH, prefetch=2,
+                                    device_put=jax.device_put)
+            dev_rate = _rate(loader, n_batches)
+            print(json.dumps({
+                "stage": "..+device_put",
+                "imgs_per_sec": round(dev_rate, 1),
+                "platform": jax.devices()[0].platform,
+                "vs_chip_rate": round(dev_rate / CHIP_RATE, 2)}),
+                flush=True)
+        except Exception as e:  # device unavailable: host numbers stand
+            print(json.dumps({"stage": "..+device_put",
+                              "skipped": str(e)[:120]}), flush=True)
+
+        try:
+            import io
+
+            from PIL import Image
+
+            rng = np.random.RandomState(1)
+            bufs = []
+            for _ in range(64):
+                im = Image.fromarray(
+                    rng.randint(0, 255, (256, 256, 3), np.uint8))
+                b = io.BytesIO()
+                im.save(b, "JPEG", quality=90)
+                bufs.append(b.getvalue())
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < 3.0:
+                im = Image.open(io.BytesIO(bufs[n % 64]))
+                np.asarray(im.resize((224, 224)))
+                n += 1
+            rate = n / (time.perf_counter() - t0)
+            print(json.dumps({
+                "stage": "jpeg_decode_single_worker(reference: DALI's "
+                         "job, scales with host cores)",
+                "imgs_per_sec": round(rate, 1),
+                "workers_needed_for_chip_rate": round(CHIP_RATE / rate, 1),
+            }), flush=True)
+        except ImportError:
+            pass
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
