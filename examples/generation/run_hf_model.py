@@ -66,6 +66,13 @@ FAMILIES = {
     "bloom": ("convert_hf_bloom", "BloomForCausalLM",
               lambda t: t.BloomConfig(vocab_size=256, hidden_size=64,
                                       n_layer=4, n_head=4)),
+    # encoder-decoder: decodes via t5_cached_generate (cross K/V cached
+    # at prefill); single-program greedy in this example
+    "t5": ("convert_hf_t5", "T5ForConditionalGeneration",
+           lambda t: t.T5Config(vocab_size=96, d_model=48, d_kv=16,
+                                d_ff=96, num_layers=2, num_heads=4,
+                                dropout_rate=0.0,
+                                decoder_start_token_id=0)),
     "mixtral": ("convert_hf_mixtral", "MixtralForCausalLM",
                 lambda t: t.MixtralConfig(num_key_value_heads=2,
                                           num_local_experts=4,
@@ -108,6 +115,21 @@ def main():
         hf = cls(tiny_cfg(transformers))
 
     cfg, params = convert(hf.eval().state_dict(), hf.config)
+
+    if args.family == "t5":
+        from apex_tpu.models import T5Model, t5_cached_generate
+
+        if args.tp > 1 or args.beams > 1:
+            raise SystemExit("the t5 path in this example is greedy "
+                             "single-program; see tests for the tp2 "
+                             "logits oracle")
+        enc = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
+        out = t5_cached_generate(T5Model(cfg), params, enc,
+                                 max_new_tokens=args.max_new_tokens)
+        print("token ids:\n", np.asarray(out))
+        return
+
     model = GPTModel(cfg, decode=True)
     prompt = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
