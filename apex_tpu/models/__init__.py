@@ -32,6 +32,7 @@ from apex_tpu.models.t5 import (  # noqa: F401
     t5_cached_generate,
     t5_greedy_generate,
     t5_loss_fn,
+    tensor_parallel_t5_generate,
 )
 from apex_tpu.models.reshard import (  # noqa: F401
     load_checkpoint_for_3d,

@@ -536,28 +536,78 @@ def t5_cached_generate(model, params, enc_tokens, max_new_tokens,
     start token, then one jitted single-token step per new token under
     ``lax.scan`` — per-step work is O(1) in the generated length (vs the
     full decoder re-run of :func:`t5_greedy_generate`, its oracle)."""
+    start = _t5_decode_precheck(model, enc_tokens, max_new_tokens,
+                                decoder_start_token_id)
+    if max_new_tokens == 0:
+        return start
+    return _t5_run_decode(model, params, enc_tokens, enc_mask, start,
+                          max_new_tokens, has_mask=enc_mask is not None)
+
+
+def _t5_decode_precheck(model, enc_tokens, max_new_tokens,
+                        decoder_start_token_id):
+    """Shared capacity check + start column for the tp=1 and tp>1 paths.
+    Slots written: 1 (prefill, the start token) + max_new_tokens - 1
+    steps (the last generated token is never fed back)."""
     cfg = model.config
-    # slots written: 1 (prefill, the start token) + max_new_tokens - 1
-    # steps (the last generated token is never fed back)
     if max_new_tokens > cfg.max_decode_length:
         raise ValueError(
             f"max_new_tokens ({max_new_tokens}) exceeds "
             f"max_decode_length ({cfg.max_decode_length})")
-    b = enc_tokens.shape[0]
-    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
-    if max_new_tokens == 0:
-        return start
-    memory = model.apply({"params": params}, enc_tokens, enc_mask,
-                         method=T5Model.encode)
+    return jnp.full((enc_tokens.shape[0], 1), decoder_start_token_id,
+                    jnp.int32)
+
+
+def _t5_run_decode(model, params, enc_tokens, mask, start,
+                   max_new_tokens, has_mask):
+    """encode -> prefill -> scan-decode -> [start | tokens]; the single
+    orchestration body both the tp=1 entry and the shard_map'd tp body
+    run (mask may be None at tp=1 — jit treats it as an empty pytree;
+    has_mask already specializes the trace)."""
     prefill, decode_all = _t5_compiled_decode(model, max_new_tokens,
-                                              enc_mask is not None)
-    # enc_mask may be None: jit treats it as an empty pytree node, and
-    # has_mask already specializes the trace
-    cache, first = prefill(params, start, memory, enc_mask)
+                                              has_mask)
+    memory = model.apply({"params": params}, enc_tokens,
+                         mask if has_mask else None,
+                         method=T5Model.encode)
+    cache, first = prefill(params, start, memory, mask)
     if max_new_tokens == 1:
         return jnp.concatenate([start, first[:, None]], axis=1)
-    toks = decode_all(params, cache, first, enc_mask)
+    toks = decode_all(params, cache, first, mask)
     return jnp.concatenate([start, first[:, None], toks.T], axis=1)
+
+
+def tensor_parallel_t5_generate(model, stacked_params, enc_tokens,
+                                max_new_tokens, *, mesh=None,
+                                decoder_start_token_id=0, enc_mask=None):
+    """Greedy KV-cache T5 decoding under tensor parallelism: the whole
+    encode + prefill + scan-decode runs inside ONE shard_map over the
+    'tp' mesh axis (same pattern as the decoder-only family's
+    ``tensor_parallel_generate``). Vocab-parallel logits are gathered per
+    step, so every rank argmaxes the full vocabulary and emits identical
+    tokens. ``stacked_params`` is the leading-[tp] layout from
+    :func:`apex_tpu.models.tp_split.split_t5_params_for_tp`."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state
+
+    mesh = mesh or parallel_state.get_mesh()
+    start = _t5_decode_precheck(model, enc_tokens, max_new_tokens,
+                                decoder_start_token_id)
+    if max_new_tokens == 0:
+        return start
+    has_mask = enc_mask is not None
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P("tp"), P(), P()), out_specs=P(),
+                       check_vma=False)
+    def go(sp, enc, mask):
+        p = jax.tree_util.tree_map(lambda a: a[0], sp)
+        return _t5_run_decode(model, p, enc, mask, start,
+                              max_new_tokens, has_mask)
+
+    mask_arg = (enc_mask if has_mask
+                else jnp.zeros((0,), jnp.int32))  # spec placeholder
+    return go(stacked_params, enc_tokens, mask_arg)
 
 
 def t5_loss_fn(vocab_parallel_logits, labels, loss_mask=None):

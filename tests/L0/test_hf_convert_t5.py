@@ -236,3 +236,34 @@ def test_t5_decode_step_without_prefill_raises():
     with pytest.raises(ValueError, match="decode_step before"):
         model.apply({"params": params}, jnp.zeros((1, 1), jnp.int32),
                     None, mutable=["cache"], method=T5Model.decode_step)
+
+
+def test_t5_tp2_cached_generate_matches_tp1():
+    """Tensor-parallel T5 serving: tp=2 cached decode emits tokens
+    identical to the tp=1 path (and hence to HF, by the oracle above)."""
+    import jax
+
+    from tools.convert_hf_t5 import convert_t5
+
+    from apex_tpu.models.t5 import (T5Model, t5_cached_generate,
+                                    tensor_parallel_t5_generate)
+    from apex_tpu.models.tp_split import split_t5_params_for_tp
+    from apex_tpu.transformer import parallel_state
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    _fresh()
+    hf, hf_cfg = _tiny_t5(seed=8, gated=True, tie=False)
+    cfg, params = convert_t5(hf.state_dict(), hf_cfg)
+    enc = jnp.asarray(np.random.RandomState(8).randint(0, 95, (2, 9)))
+
+    model = T5Model(cfg)
+    ref = t5_cached_generate(model, params, enc, max_new_tokens=6)
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, devices=jax.devices()[:2])
+    stacked = split_t5_params_for_tp(cfg, params, 2)
+    out = tensor_parallel_t5_generate(model, stacked, enc,
+                                      max_new_tokens=6, mesh=mesh)
+    parallel_state.destroy_model_parallel()
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
