@@ -489,7 +489,8 @@ def t5_greedy_generate(model, params, enc_tokens, max_new_tokens,
 
 
 @functools.lru_cache(maxsize=16)
-def _t5_compiled_decode(model, max_new_tokens, has_mask):
+def _t5_compiled_decode(model, max_new_tokens, has_mask,
+                        eos_token_id=None, pad_token_id=0):
     """jitted prefill + scan-decode for :func:`t5_cached_generate`,
     cached per (model, length, maskedness) so a serving loop compiles
     once (same pattern as generation.py's ``_compiled``). ``enc_mask``
@@ -513,8 +514,11 @@ def _t5_compiled_decode(model, max_new_tokens, has_mask):
 
     @jax.jit
     def decode_all(params, cache, first, enc_mask):
+        done0 = (jnp.zeros(first.shape, bool) if eos_token_id is None
+                 else first == eos_token_id)
+
         def step(carry, _):
-            cache, tok = carry
+            cache, tok, done = carry
             logits, mut = model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 enc_mask if has_mask else None,
@@ -522,16 +526,21 @@ def _t5_compiled_decode(model, max_new_tokens, has_mask):
             full = gather_from_tensor_model_parallel_region(
                 logits[:, -1, :])
             nxt = jnp.argmax(full, -1).astype(jnp.int32)
-            return (mut["cache"], nxt), nxt
-        (_, _), toks = jax.lax.scan(step, (cache, first), None,
-                                    length=max_new_tokens - 1)
+            if eos_token_id is not None:
+                # finished rows extend with pad (HF generate semantics)
+                nxt = jnp.where(done, pad_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            return (mut["cache"], nxt, done), nxt
+        (_, _, _), toks = jax.lax.scan(step, (cache, first, done0), None,
+                                       length=max_new_tokens - 1)
         return toks  # [T-1, b]
 
     return prefill, decode_all
 
 
 def t5_cached_generate(model, params, enc_tokens, max_new_tokens,
-                       decoder_start_token_id=0, enc_mask=None):
+                       decoder_start_token_id=0, enc_mask=None,
+                       eos_token_id=None, pad_token_id=0):
     """Greedy decode on the KV-cache path: encode once, prefill with the
     start token, then one jitted single-token step per new token under
     ``lax.scan`` — per-step work is O(1) in the generated length (vs the
@@ -541,7 +550,9 @@ def t5_cached_generate(model, params, enc_tokens, max_new_tokens,
     if max_new_tokens == 0:
         return start
     return _t5_run_decode(model, params, enc_tokens, enc_mask, start,
-                          max_new_tokens, has_mask=enc_mask is not None)
+                          max_new_tokens, has_mask=enc_mask is not None,
+                          eos_token_id=eos_token_id,
+                          pad_token_id=pad_token_id)
 
 
 def _t5_decode_precheck(model, enc_tokens, max_new_tokens,
@@ -559,13 +570,15 @@ def _t5_decode_precheck(model, enc_tokens, max_new_tokens,
 
 
 def _t5_run_decode(model, params, enc_tokens, mask, start,
-                   max_new_tokens, has_mask):
+                   max_new_tokens, has_mask, eos_token_id=None,
+                   pad_token_id=0):
     """encode -> prefill -> scan-decode -> [start | tokens]; the single
     orchestration body both the tp=1 entry and the shard_map'd tp body
     run (mask may be None at tp=1 — jit treats it as an empty pytree;
     has_mask already specializes the trace)."""
     prefill, decode_all = _t5_compiled_decode(model, max_new_tokens,
-                                              has_mask)
+                                              has_mask, eos_token_id,
+                                              pad_token_id)
     memory = model.apply({"params": params}, enc_tokens,
                          mask if has_mask else None,
                          method=T5Model.encode)
@@ -578,7 +591,8 @@ def _t5_run_decode(model, params, enc_tokens, mask, start,
 
 def tensor_parallel_t5_generate(model, stacked_params, enc_tokens,
                                 max_new_tokens, *, mesh=None,
-                                decoder_start_token_id=0, enc_mask=None):
+                                decoder_start_token_id=0, enc_mask=None,
+                                eos_token_id=None, pad_token_id=0):
     """Greedy KV-cache T5 decoding under tensor parallelism: the whole
     encode + prefill + scan-decode runs inside ONE shard_map over the
     'tp' mesh axis (same pattern as the decoder-only family's
@@ -603,7 +617,9 @@ def tensor_parallel_t5_generate(model, stacked_params, enc_tokens,
     def go(sp, enc, mask):
         p = jax.tree_util.tree_map(lambda a: a[0], sp)
         return _t5_run_decode(model, p, enc, mask, start,
-                              max_new_tokens, has_mask)
+                              max_new_tokens, has_mask,
+                              eos_token_id=eos_token_id,
+                              pad_token_id=pad_token_id)
 
     mask_arg = (enc_mask if has_mask
                 else jnp.zeros((0,), jnp.int32))  # spec placeholder

@@ -267,3 +267,27 @@ def test_t5_tp2_cached_generate_matches_tp1():
                                       max_new_tokens=6, mesh=mesh)
     parallel_state.destroy_model_parallel()
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_t5_cached_generate_eos_matches_hf():
+    """EOS semantics: finished rows extend with pad, exactly as HF
+    generate emits them (compared over HF's actual output length)."""
+    from tools.convert_hf_t5 import convert_t5
+
+    from apex_tpu.models.t5 import T5Model, t5_cached_generate
+
+    _fresh()
+    hf, hf_cfg = _tiny_t5(seed=9)
+    cfg, params = convert_t5(hf.state_dict(), hf_cfg)
+    enc = np.random.RandomState(9).randint(0, 95, size=(3, 7))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(enc), max_new_tokens=12,
+                          do_sample=False).numpy()  # stops at eos
+    ours = np.asarray(t5_cached_generate(
+        T5Model(cfg), params, jnp.asarray(enc), max_new_tokens=12,
+        eos_token_id=95, pad_token_id=0))
+    hf_len = ref.shape[1]
+    np.testing.assert_array_equal(ours[:, :hf_len], ref)
+    # beyond HF's stop point every row is pad (all rows were done)
+    if hf_len < ours.shape[1]:
+        assert (ours[:, hf_len:] == 0).all()
