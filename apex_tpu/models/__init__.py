@@ -48,3 +48,8 @@ from apex_tpu.models.vit import (  # noqa: F401
     vit_config,
     vit_loss_fn,
 )
+from apex_tpu.models.whisper import (  # noqa: F401
+    WhisperConfig,
+    WhisperModel,
+    whisper_greedy_generate,
+)

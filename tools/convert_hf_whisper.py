@@ -1,0 +1,129 @@
+"""Convert a HuggingFace Whisper checkpoint into apex_tpu WhisperModel
+params.
+
+Migration tooling + external numerics oracle
+(tests/L0/test_hf_convert_whisper.py): identical weights must reproduce
+HF's logits — validating the conv frontend layout (torch [out, in, k] ->
+flax [k, in, out]), sinusoidal/learned positions, biased scaled
+attention (K bias zero-filled: the original has none), cross-attention,
+and the tied head end to end.
+"""
+
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def _attn(sd, prefix, d_model):
+    out = {}
+    for ours, theirs in (("q", "q_proj"), ("k", "k_proj"),
+                         ("v", "v_proj"), ("out", "out_proj")):
+        entry = {"weight": _t(sd[f"{prefix}.{theirs}.weight"]).T}
+        bkey = f"{prefix}.{theirs}.bias"
+        # K carries no bias in the original; our projection has one —
+        # zero-fill for exact numerics
+        entry["bias"] = (_t(sd[bkey]) if bkey in sd
+                         else np.zeros((d_model,), np.float32))
+        out[ours] = entry
+    return out
+
+
+def _block(sd, prefix, d_model, cross):
+    out = {
+        "self_attn_norm": {
+            "weight": _t(sd[f"{prefix}.self_attn_layer_norm.weight"]),
+            "bias": _t(sd[f"{prefix}.self_attn_layer_norm.bias"])},
+        "self_attn": _attn(sd, f"{prefix}.self_attn", d_model),
+        "ffn_norm": {
+            "weight": _t(sd[f"{prefix}.final_layer_norm.weight"]),
+            "bias": _t(sd[f"{prefix}.final_layer_norm.bias"])},
+        "ffn": {
+            "fc1": {"weight": _t(sd[f"{prefix}.fc1.weight"]).T,
+                    "bias": _t(sd[f"{prefix}.fc1.bias"])},
+            "fc2": {"weight": _t(sd[f"{prefix}.fc2.weight"]).T,
+                    "bias": _t(sd[f"{prefix}.fc2.bias"])},
+        },
+    }
+    if cross:
+        out["cross_attn_norm"] = {
+            "weight": _t(sd[f"{prefix}.encoder_attn_layer_norm.weight"]),
+            "bias": _t(sd[f"{prefix}.encoder_attn_layer_norm.bias"])}
+        out["cross_attn"] = _attn(sd, f"{prefix}.encoder_attn", d_model)
+    return out
+
+
+def convert_whisper(state_dict, hf_config):
+    """(WhisperConfig, params pytree) from a
+    WhisperForConditionalGeneration state_dict. tp=1 layout."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.whisper import WhisperConfig
+
+    if hf_config.activation_function != "gelu":
+        raise ValueError(
+            f"convert_whisper supports activation_function 'gelu'; got "
+            f"{hf_config.activation_function!r}")
+    if getattr(hf_config, "scale_embedding", False):
+        raise ValueError("convert_whisper expects scale_embedding=False "
+                         "(the released Whisper checkpoints)")
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        # proj_out would hold distinct head weights the tied-head model
+        # cannot represent — refuse rather than silently mis-convert
+        raise ValueError("convert_whisper supports tied heads only "
+                         "(tie_word_embeddings=True, all released "
+                         "Whisper checkpoints)")
+    if (hf_config.encoder_attention_heads
+            != hf_config.decoder_attention_heads):
+        raise ValueError("encoder/decoder head counts differ; "
+                         "WhisperConfig carries one num_heads")
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    d = hf_config.d_model
+    cfg = WhisperConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=d,
+        encoder_layers=hf_config.encoder_layers,
+        decoder_layers=hf_config.decoder_layers,
+        num_heads=hf_config.encoder_attention_heads,
+        encoder_ffn_dim=hf_config.encoder_ffn_dim,
+        decoder_ffn_dim=hf_config.decoder_ffn_dim,
+        num_mel_bins=hf_config.num_mel_bins,
+        max_source_positions=hf_config.max_source_positions,
+        max_target_positions=hf_config.max_target_positions,
+        compute_dtype=jnp.float32)
+
+    enc = {
+        # torch conv1d [out, in, k] -> flax [k, in, out]
+        "conv1": {"kernel": _t(sd["encoder.conv1.weight"]
+                               ).transpose(2, 1, 0),
+                  "bias": _t(sd["encoder.conv1.bias"])},
+        "conv2": {"kernel": _t(sd["encoder.conv2.weight"]
+                               ).transpose(2, 1, 0),
+                  "bias": _t(sd["encoder.conv2.bias"])},
+        "positions": _t(sd["encoder.embed_positions.weight"]),
+        "final_norm": {"weight": _t(sd["encoder.layer_norm.weight"]),
+                       "bias": _t(sd["encoder.layer_norm.bias"])},
+    }
+    for i in range(cfg.encoder_layers):
+        enc[f"block_{i}"] = _block(sd, f"encoder.layers.{i}", d,
+                                   cross=False)
+
+    dec = {
+        "positions": _t(sd["decoder.embed_positions.weight"]),
+        "final_norm": {"weight": _t(sd["decoder.layer_norm.weight"]),
+                       "bias": _t(sd["decoder.layer_norm.bias"])},
+    }
+    for i in range(cfg.decoder_layers):
+        dec[f"block_{i}"] = _block(sd, f"decoder.layers.{i}", d,
+                                   cross=True)
+
+    params = {
+        "embed_tokens": {"weight": _t(sd["decoder.embed_tokens.weight"])},
+        "encoder": enc,
+        "decoder": dec,
+    }
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return cfg, params
