@@ -15,6 +15,7 @@ from apex_tpu.contrib.sparsity.permutation_lib import (  # noqa: F401
 from apex_tpu.contrib.sparsity.propagation import (  # noqa: F401
     PermSpec,
     PermutationGroup,
+    gpt_attention_permutation_groups,
     gpt_permutation_groups,
     propagate_permutations,
     resnet_permutation_groups,

@@ -156,6 +156,20 @@ def propagate_permutations(variables, groups: Sequence[PermutationGroup],
 
 # -- model-zoo group builders -------------------------------------------------
 
+def _gpt_layer_root(cfg, variables):
+    """Shared root/prefix resolution + scan_layers guard for the GPT
+    group builders (one source of truth for the param-tree layout)."""
+    if getattr(cfg, "scan_layers", False):
+        raise ValueError(
+            "permutation groups need per-layer leaves; scan_layers "
+            "stacks all layers into one param (a single shared "
+            "permutation would be wrong per layer)")
+    params = variables["params"]
+    if "transformer" in params:
+        return params["transformer"], ("params", "transformer")
+    return params, ("params",)
+
+
 def gpt_permutation_groups(cfg, variables):
     """Producer/consumer groups for GPTModel / the parallel transformer
     stack (models/transformer_lm.py): per layer, the MLP interior
@@ -164,23 +178,15 @@ def gpt_permutation_groups(cfg, variables):
     [gate | up] projection contributes two same-permutation regions whose
     channels align with the gated product feeding dense_4h_to_h.
 
-    Attention interiors and every residual-stream dim are left alone
-    (the permutation would cross softmax/head boundaries — the same
-    place the reference's fx walk segments its groups).
+    Residual-stream dims are left alone (the same restriction the
+    reference's fx walk enforces); attention interiors have their own
+    per-head groups in :func:`gpt_attention_permutation_groups`.
 
     ``variables``: the full ``{"params": ...}`` dict.
     """
-    if getattr(cfg, "scan_layers", False):
-        raise ValueError(
-            "gpt_permutation_groups needs per-layer leaves; scan_layers "
-            "stacks all layers into one param (a single shared "
-            "permutation would be wrong per layer)")
     gated = cfg.activation in ("swiglu", "geglu")
     groups = []
-    params = variables["params"]
-    root = params["transformer"] if "transformer" in params else params
-    prefix = ("params", "transformer") if "transformer" in params else (
-        "params",)
+    root, prefix = _gpt_layer_root(cfg, variables)
     for name in sorted(k for k in root if k.startswith("layer_")):
         mlp = root[name].get("mlp")
         if mlp is None or "dense_h_to_4h" not in mlp:
@@ -205,6 +211,67 @@ def gpt_permutation_groups(cfg, variables):
                                       axis=-1))
         specs.append(PermSpec(base + ("dense_4h_to_h", "weight"), axis=0))
         groups.append(PermutationGroup(f"{name}/mlp", tuple(specs)))
+    return groups
+
+
+def gpt_attention_permutation_groups(cfg, variables):
+    """Attention-interior groups for GPTModel (beyond the reference's fx
+    walk, which segments at attention): per head, (a) the V-channel
+    block of the fused QKV — context channels pass through softmax
+    opaquely, so the output projection's matching rows compensate — and
+    (b) a JOINT Q+K permutation (scores contract q·k per head, so one
+    shared in-head permutation of both leaves them invariant; no
+    consumer needed). Q/K groups are skipped under rotary embeddings
+    (RoPE pairs specific channel indices) — V groups remain valid there.
+    MHA only: the GQA packing interleaves q-blocks and kv-groups.
+
+    ``variables``: the full ``{"params": ...}`` dict.
+    """
+    if cfg.query_groups != cfg.num_attention_heads:
+        raise ValueError(
+            "attention permutation groups support MHA only (the GQA "
+            "fused layout packs [q heads | kv groups])")
+    kv = cfg.kv_channels
+    rope = cfg.position_embedding_type == "rope"
+    root, prefix = _gpt_layer_root(cfg, variables)
+    groups = []
+    for name in sorted(k for k in root if k.startswith("layer_")):
+        attn = root[name].get("self_attention")
+        if attn is None:
+            continue
+        w = attn["query_key_value"]["weight"]
+        n_local = w.shape[-1] // (3 * kv)  # per-rank heads (tp shards)
+        base = prefix + (name, "self_attention")
+        has_bias = "bias" in attn["query_key_value"]
+        for n in range(n_local):
+            off = n * 3 * kv
+            # (a) V block + output-projection rows
+            specs = [PermSpec(base + ("query_key_value", "weight"),
+                              axis=-1, search=True,
+                              region=(off + 2 * kv, kv))]
+            if has_bias:
+                specs.append(PermSpec(base + ("query_key_value", "bias"),
+                                      axis=-1,
+                                      region=(off + 2 * kv, kv)))
+            specs.append(PermSpec(base + ("dense", "weight"), axis=0,
+                                  region=(n * kv, kv)))
+            groups.append(PermutationGroup(f"{name}/attn_v/head_{n}",
+                                           tuple(specs)))
+            if rope:
+                continue  # RoPE pins q/k channel identities
+            # (b) joint Q+K in-head permutation (scores invariant)
+            specs = [PermSpec(base + ("query_key_value", "weight"),
+                              axis=-1, search=True, region=(off, kv)),
+                     PermSpec(base + ("query_key_value", "weight"),
+                              axis=-1, search=True,
+                              region=(off + kv, kv))]
+            if has_bias:
+                specs += [PermSpec(base + ("query_key_value", "bias"),
+                                   axis=-1, region=(off, kv)),
+                          PermSpec(base + ("query_key_value", "bias"),
+                                   axis=-1, region=(off + kv, kv))]
+            groups.append(PermutationGroup(f"{name}/attn_qk/head_{n}",
+                                           tuple(specs)))
     return groups
 
 

@@ -182,3 +182,54 @@ def test_unknown_group_validation():
         propagate_permutations(
             {"params": {}},
             [PermutationGroup("bad", (PermSpec(("params",), 0),))])
+
+
+@pytest.mark.parametrize("pos", ["learned", "rope"])
+def test_gpt_attention_propagation_preserves_function(pos):
+    """Per-head V-channel groups (plus joint Q/K where RoPE doesn't pin
+    channels): outputs unchanged, retention improves, and the group set
+    composes with the MLP groups."""
+    from apex_tpu.contrib.sparsity.propagation import (
+        gpt_attention_permutation_groups,
+    )
+    from apex_tpu.models import GPTModel, TransformerConfig
+
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=1, num_attention_heads=4,
+        vocab_size=64, max_position_embeddings=32, ffn_hidden_size=64,
+        position_embedding_type=pos,
+        normalization="rmsnorm" if pos == "rope" else "layernorm",
+        compute_dtype=jnp.float32, use_flash_attention=False)
+    model = GPTModel(cfg)
+    tokens = jnp.asarray(np.random.RandomState(5).randint(0, 64, (2, 8)))
+    variables = model.init(jax.random.PRNGKey(5), tokens)
+    ref = model.apply(variables, tokens)
+
+    groups = gpt_attention_permutation_groups(cfg, variables)
+    v_groups = [g for g in groups if "attn_v" in g.name]
+    qk_groups = [g for g in groups if "attn_qk" in g.name]
+    assert len(v_groups) == 4  # one per head
+    assert len(qk_groups) == (0 if pos == "rope" else 4)
+
+    groups = groups + gpt_permutation_groups(cfg, variables)
+    permuted, report = propagate_permutations(variables, groups)
+    _assert_improved(report)
+
+    out = model.apply(permuted, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gpt_attention_groups_refuse_gqa():
+    from apex_tpu.contrib.sparsity.propagation import (
+        gpt_attention_permutation_groups,
+    )
+    from apex_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=1, num_attention_heads=4,
+        num_query_groups=2, vocab_size=64, max_position_embeddings=32,
+        position_embedding_type="rope", normalization="rmsnorm",
+        activation="swiglu")
+    with pytest.raises(ValueError, match="MHA only"):
+        gpt_attention_permutation_groups(cfg, {"params": {}})
