@@ -51,5 +51,6 @@ from apex_tpu.models.vit import (  # noqa: F401
 from apex_tpu.models.whisper import (  # noqa: F401
     WhisperConfig,
     WhisperModel,
+    whisper_cached_generate,
     whisper_greedy_generate,
 )

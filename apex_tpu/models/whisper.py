@@ -16,6 +16,7 @@ member.
 """
 
 import dataclasses
+import functools
 from typing import Any
 
 import flax.linen as nn
@@ -75,16 +76,23 @@ class WhisperAttention(nn.Module):
 
     config: WhisperConfig
     causal: bool = False
+    cross: bool = False  # encoder-decoder attention (memory K/V)
 
     @nn.compact
-    def __call__(self, x_q, x_kv=None, attention_mask=None):
+    def __call__(self, x_q, x_kv=None, attention_mask=None, mode="train",
+                 pos=None):
+        """``mode`` (static, mirroring models/t5.py): 'train' — full
+        attention; 'prefill' — decode with cache writes (self K/V
+        appended at ``pos``; cross K/V of the memory computed once and
+        stored); 'step' — decode reading the caches (cross projections
+        never re-applied)."""
         cfg = self.config
         tp = get_tensor_model_parallel_world_size()
         n_local = divide(cfg.num_heads, tp)
         d = cfg.head_dim
         sq, b, _ = x_q.shape
-        x_kv = x_q if x_kv is None else x_kv
-        skv = x_kv.shape[0]
+        cross = self.cross
+        decode = mode in ("prefill", "step")
 
         def proj(name, src):
             return ColumnParallelLinear(
@@ -95,18 +103,59 @@ class WhisperAttention(nn.Module):
         # q scaled by d**-0.5 BEFORE the matmul (the original's layout;
         # numerically identical to scaling scores)
         q = proj("q", x_q).reshape(sq, b, n_local, d)
-        k = proj("k", x_kv).reshape(skv, b, n_local, d)
-        v = proj("v", x_kv).reshape(skv, b, n_local, d)
+
+        causal_from = None
+        if not decode:
+            src = x_q if not cross else x_kv
+            skv = src.shape[0]
+            k = proj("k", src).reshape(skv, b, n_local, d)
+            v = proj("v", src).reshape(skv, b, n_local, d)
+            if self.causal:
+                causal_from = jnp.arange(sq)[:, None]
+        elif cross:
+            if mode == "prefill":
+                skv = x_kv.shape[0]
+                k = proj("k", x_kv).reshape(skv, b, n_local, d)
+                v = proj("v", x_kv).reshape(skv, b, n_local, d)
+                ck = self.variable("cache", "cross_key",
+                                   lambda: k.astype(cfg.compute_dtype))
+                cv = self.variable("cache", "cross_value",
+                                   lambda: v.astype(cfg.compute_dtype))
+                ck.value = k.astype(cfg.compute_dtype)
+                cv.value = v.astype(cfg.compute_dtype)
+            else:
+                if not self.has_variable("cache", "cross_key"):
+                    raise ValueError(
+                        "whisper decode_step before decode_prefill: the "
+                        "cross-attention cache is empty")
+                k = self.variable("cache", "cross_key", None).value
+                v = self.variable("cache", "cross_value", None).value
+        else:
+            if pos is None:
+                raise ValueError("decode self-attention needs pos")
+            max_len = cfg.max_target_positions
+            k_new = proj("k", x_q).reshape(sq, b, n_local, d)
+            v_new = proj("v", x_q).reshape(sq, b, n_local, d)
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (max_len, b, n_local, d), cfg.compute_dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (max_len, b, n_local, d), cfg.compute_dtype)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k_new.astype(cfg.compute_dtype), (pos, 0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v_new.astype(cfg.compute_dtype), (pos, 0, 0, 0))
+            k, v = ck.value, cv.value
+            causal_from = pos + jnp.arange(sq)[:, None]
+
         scores = jnp.einsum(
             "qbnd,kbnd->bnqk",
             (q * jnp.asarray(d ** -0.5, q.dtype)).astype(cfg.compute_dtype),
             k.astype(cfg.compute_dtype),
             preferred_element_type=jnp.float32)
-        if self.causal:
-            i = jnp.arange(sq)[:, None]
-            j = jnp.arange(skv)[None, :]
-            scores = jnp.where(j > i, -1e9, scores)
-        if attention_mask is not None:
+        if causal_from is not None:
+            j = jnp.arange(k.shape[0])[None, :]
+            scores = jnp.where(j > causal_from, -1e9, scores)
+        if attention_mask is not None and not decode:
             scores = jnp.where(
                 attention_mask.astype(bool)[:, None, None, :],
                 scores, -1e9)
@@ -149,18 +198,19 @@ class WhisperBlock(nn.Module):
     causal: bool = False
 
     @nn.compact
-    def __call__(self, h, memory=None, self_mask=None):
+    def __call__(self, h, memory=None, self_mask=None, mode="train",
+                 pos=None):
         cfg = self.config
         x = _ln(cfg, "self_attn_norm")(h.astype(jnp.float32)).astype(
             cfg.compute_dtype)
         h = h + WhisperAttention(cfg, causal=self.causal,
                                  name="self_attn")(
-            x, None, self_mask).astype(h.dtype)
+            x, None, self_mask, mode=mode, pos=pos).astype(h.dtype)
         if self.has_cross:
             x = _ln(cfg, "cross_attn_norm")(h.astype(jnp.float32)).astype(
                 cfg.compute_dtype)
-            h = h + WhisperAttention(cfg, name="cross_attn")(
-                x, memory).astype(h.dtype)
+            h = h + WhisperAttention(cfg, cross=True, name="cross_attn")(
+                x, memory, mode=mode).astype(h.dtype)
         x = _ln(cfg, "ffn_norm")(h.astype(jnp.float32)).astype(
             cfg.compute_dtype)
         return h + _FFN(cfg, self.ffn_dim, name="ffn")(x).astype(h.dtype)
@@ -220,17 +270,29 @@ class WhisperDecoder(nn.Module):
     config: WhisperConfig
 
     @nn.compact
-    def __call__(self, h, memory):
+    def __call__(self, h, memory=None, mode="train"):
         cfg = self.config
         s = h.shape[0]
         pos = self.param("positions", nn.initializers.normal(0.02),
                          (cfg.max_target_positions, cfg.d_model),
                          cfg.params_dtype)
-        h = h + pos[:s, None].astype(h.dtype)
-        memory = memory.astype(cfg.compute_dtype)
+        offset = None
+        if mode in ("prefill", "step"):
+            ctr = self.variable("cache", "pos",
+                                lambda: jnp.zeros((), jnp.int32))
+            offset = (jnp.zeros((), jnp.int32) if mode == "prefill"
+                      else ctr.value)
+            ctr.value = offset + s
+            h = h + jax.lax.dynamic_slice_in_dim(
+                pos, offset, s, axis=0)[:, None].astype(h.dtype)
+        else:
+            h = h + pos[:s, None].astype(h.dtype)
+        if memory is not None:
+            memory = memory.astype(cfg.compute_dtype)
         for i in range(cfg.decoder_layers):
             h = WhisperBlock(cfg, cfg.decoder_ffn_dim, has_cross=True,
-                             causal=True, name=f"block_{i}")(h, memory)
+                             causal=True, name=f"block_{i}")(
+                h, memory, mode=mode, pos=offset)
         return _ln(cfg, "final_norm")(h.astype(jnp.float32))
 
 
@@ -254,19 +316,95 @@ class WhisperModel(nn.Module):
     def encode(self, input_features):
         return self.encoder(input_features)
 
-    def decode_from_memory(self, dec_tokens, memory):
-        cfg = self.config
-        h = self.embed_tokens(dec_tokens).astype(
-            cfg.compute_dtype).transpose(1, 0, 2)
-        h = self.decoder(h, memory)
+    def _embed(self, dec_tokens):
+        return self.embed_tokens(dec_tokens).astype(
+            self.config.compute_dtype).transpose(1, 0, 2)
+
+    def _head(self, h):
         h = copy_to_tensor_model_parallel_region(
-            h.astype(cfg.compute_dtype))
+            h.astype(self.config.compute_dtype))
         logits = self.embed_tokens.attend(h)  # tied head
         return logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
+
+    def decode_from_memory(self, dec_tokens, memory):
+        return self._head(self.decoder(self._embed(dec_tokens), memory))
+
+    def decode_prefill(self, dec_tokens, memory):
+        """KV-cache decode, phase 1 (apply with ``mutable=["cache"]``):
+        runs the decoder prefix, filling self caches and computing the
+        cross K/V from ``memory`` once."""
+        return self._head(self.decoder(self._embed(dec_tokens), memory,
+                                       mode="prefill"))
+
+    def decode_step(self, dec_tokens):
+        """KV-cache decode, phase 2: extend against the caches; the
+        audio memory is NOT needed (cross K/V read back)."""
+        return self._head(self.decoder(self._embed(dec_tokens), None,
+                                       mode="step"))
 
     def __call__(self, input_features, dec_tokens):
         return self.decode_from_memory(dec_tokens,
                                        self.encode(input_features))
+
+
+def whisper_cached_generate(model, params, input_features, max_new_tokens,
+                            decoder_start_token_id):
+    """Greedy transcription on the KV-cache path: encode once, prefill
+    with the start token, one jitted single-token step per new token
+    (cross K/V never re-projected). Token-exact vs
+    :func:`whisper_greedy_generate`, its oracle."""
+    cfg = model.config
+    # slots written: 1 (prefill) + max_new_tokens - 1 steps (the last
+    # generated token is never fed back) = max_new_tokens
+    if max_new_tokens > cfg.max_target_positions:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_target_positions ({cfg.max_target_positions})")
+    b = input_features.shape[0]
+    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    if max_new_tokens == 0:
+        return start
+    memory = model.apply({"params": params}, input_features,
+                         method=WhisperModel.encode)
+    prefill, decode_all = _whisper_compiled_decode(model, max_new_tokens)
+    cache, first = prefill(params, start, memory)
+    if max_new_tokens == 1:
+        return jnp.concatenate([start, first[:, None]], axis=1)
+    toks = decode_all(params, cache, first)
+    return jnp.concatenate([start, first[:, None], toks.T], axis=1)
+
+
+
+@functools.lru_cache(maxsize=16)
+def _whisper_compiled_decode(model, max_new_tokens):
+    from apex_tpu.transformer.tensor_parallel import (
+        gather_from_tensor_model_parallel_region,
+    )
+
+    @jax.jit
+    def prefill(params, start, memory):
+        logits, mut = model.apply(
+            {"params": params}, start, memory, mutable=["cache"],
+            method=WhisperModel.decode_prefill)
+        full = gather_from_tensor_model_parallel_region(logits[:, -1, :])
+        return mut["cache"], jnp.argmax(full, -1).astype(jnp.int32)
+
+    @jax.jit
+    def decode_all(params, cache, first):
+        def step(carry, _):
+            cache, tok = carry
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"], method=WhisperModel.decode_step)
+            full = gather_from_tensor_model_parallel_region(
+                logits[:, -1, :])
+            nxt = jnp.argmax(full, -1).astype(jnp.int32)
+            return (mut["cache"], nxt), nxt
+        (_, _), toks = jax.lax.scan(step, (cache, first), None,
+                                    length=max_new_tokens - 1)
+        return toks
+
+    return prefill, decode_all
 
 
 def whisper_greedy_generate(model, params, input_features, max_new_tokens,

@@ -107,3 +107,46 @@ def test_whisper_frontend_refuses_wrong_frame_count():
         WhisperModel(cfg).init(jax.random.PRNGKey(0),
                                jnp.zeros((1, 8, 20)),
                                jnp.zeros((1, 4), jnp.int32))
+
+
+def test_whisper_cached_generate_matches_oracle():
+    """KV-cache decode is token-exact vs the full-rerun oracle (which is
+    itself token-exact vs HF, above)."""
+    from tools.convert_hf_whisper import convert_whisper
+
+    from apex_tpu.models.whisper import (WhisperModel,
+                                         whisper_cached_generate,
+                                         whisper_greedy_generate)
+
+    _fresh()
+    hf, hf_cfg = _tiny_whisper(seed=4)
+    cfg, params = convert_whisper(hf.state_dict(), hf_cfg)
+    feats = np.random.RandomState(4).randn(2, 8, 32).astype(np.float32)
+    model = WhisperModel(cfg)
+    oracle = whisper_greedy_generate(model, params, jnp.asarray(feats),
+                                     max_new_tokens=7,
+                                     decoder_start_token_id=1)
+    cached = whisper_cached_generate(model, params, jnp.asarray(feats),
+                                     max_new_tokens=7,
+                                     decoder_start_token_id=1)
+    np.testing.assert_array_equal(np.asarray(cached), np.asarray(oracle))
+
+
+def test_whisper_decode_step_without_prefill_raises():
+    import jax
+
+    from apex_tpu.models.whisper import WhisperConfig, WhisperModel
+
+    _fresh()
+    cfg = WhisperConfig(vocab_size=32, d_model=32, encoder_layers=1,
+                        decoder_layers=1, num_heads=4,
+                        encoder_ffn_dim=64, decoder_ffn_dim=64,
+                        num_mel_bins=8, max_source_positions=16,
+                        max_target_positions=8, compute_dtype=jnp.float32)
+    model = WhisperModel(cfg)
+    feats = jnp.zeros((1, 8, 32))
+    dec = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), feats, dec)["params"]
+    with pytest.raises(ValueError, match="decode_step before"):
+        model.apply({"params": params}, jnp.zeros((1, 1), jnp.int32),
+                    mutable=["cache"], method=WhisperModel.decode_step)
