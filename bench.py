@@ -437,6 +437,60 @@ def bench_t5(batch, steps):
           total_tokens * steps / dt, "tokens/sec", flops, steps, dt)
 
 
+def bench_whisper(batch, steps):
+    """Whisper-base-shaped (6+6 x 512, mel 80, 30 s audio = 3000 frames)
+    single-chip training throughput — the audio family; the conv
+    frontend and both stacks ride the MXU."""
+    from apex_tpu.models import WhisperConfig, WhisperModel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    dec_s = 256
+    cfg = WhisperConfig(compute_dtype=jnp.bfloat16, d_model=512,
+                        encoder_layers=6, decoder_layers=6, num_heads=8,
+                        encoder_ffn_dim=2048, decoder_ffn_dim=2048)
+    model = WhisperModel(cfg)
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.randn(
+        batch, cfg.num_mel_bins,
+        2 * cfg.max_source_positions).astype(np.float32))
+    dec = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, dec_s)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, dec_s)))
+    params = model.init(jax.random.PRNGKey(0), feats[:1], dec[:1])["params"]
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, feats, dec)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(
+                jnp.take_along_axis(logp, labels[..., None], -1))
+
+        loss_v, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt_state = opt.step(grads, opt_state, params)
+        return new_params, new_opt_state, loss_v
+
+    dt, _ = _time_steps(train_step, (params, opt_state), steps,
+                        loss_index=2)
+    h = cfg.d_model
+    enc_s = cfg.max_source_positions
+    enc_layer = 4 * h * h + 2 * h * cfg.encoder_ffn_dim
+    dec_layer = 8 * h * h + 2 * h * cfg.decoder_ffn_dim
+    fwd = (batch * enc_s * (cfg.encoder_layers * (2 * enc_layer
+                                                  + 4 * enc_s * h))
+           + batch * dec_s * (cfg.decoder_layers * (2 * dec_layer
+                                                    + 4 * dec_s * h
+                                                    + 4 * enc_s * h)
+                              + 2 * h * cfg.vocab_size)
+           + batch * 2 * enc_s * 2 * (3 * cfg.num_mel_bins * h
+                                      + 3 * h * h) // 2)
+    _emit("whisper_base_audio_seconds_per_sec_per_chip",
+          batch * 30.0 * steps / dt, "audio_s/sec", 3 * fwd, steps, dt)
+
+
 def bench_vit(batch, steps):
     """ViT-base/16 @ 224 single-chip training throughput (the vision
     family on the parallel transformer stack; patches feed the MXU as
@@ -621,6 +675,10 @@ def main():
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
         return bench_vit(batch, steps)
+    if len(sys.argv) > 1 and sys.argv[1] == "whisper":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
+        return bench_whisper(batch, steps)
     if len(sys.argv) > 1 and sys.argv[1] == "moe":
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15

@@ -66,6 +66,17 @@ FAMILIES = {
     "bloom": ("convert_hf_bloom", "BloomForCausalLM",
               lambda t: t.BloomConfig(vocab_size=256, hidden_size=64,
                                       n_layer=4, n_head=4)),
+    # audio encoder-decoder: random mel features in, KV-cache greedy out
+    "whisper": ("convert_hf_whisper", "WhisperForConditionalGeneration",
+                lambda t: t.WhisperConfig(
+                    vocab_size=96, d_model=48, encoder_layers=2,
+                    decoder_layers=2, encoder_attention_heads=4,
+                    decoder_attention_heads=4, encoder_ffn_dim=96,
+                    decoder_ffn_dim=96, num_mel_bins=8,
+                    max_source_positions=16, max_target_positions=48,
+                    pad_token_id=0, bos_token_id=1, eos_token_id=2,
+                    decoder_start_token_id=1, suppress_tokens=None,
+                    begin_suppress_tokens=None)),
     # encoder-decoder: decodes via t5_cached_generate (cross K/V cached
     # at prefill); single-program greedy in this example
     "t5": ("convert_hf_t5", "T5ForConditionalGeneration",
@@ -115,6 +126,23 @@ def main():
         hf = cls(tiny_cfg(transformers))
 
     cfg, params = convert(hf.eval().state_dict(), hf.config)
+
+    if args.family == "whisper":
+        from apex_tpu.models import WhisperModel, whisper_cached_generate
+
+        if args.tp > 1 or args.beams > 1:
+            raise SystemExit("the whisper path in this example is greedy "
+                             "single-program")
+        feats = jnp.asarray(np.random.RandomState(0).randn(
+            2, cfg.num_mel_bins, 2 * cfg.max_source_positions),
+            jnp.float32)
+        out = whisper_cached_generate(
+            WhisperModel(cfg), params, feats,
+            max_new_tokens=min(args.max_new_tokens,
+                               cfg.max_target_positions),
+            decoder_start_token_id=1)
+        print("token ids:\n", np.asarray(out))
+        return
 
     if args.family == "t5":
         from apex_tpu.models import T5Model, t5_cached_generate
