@@ -66,6 +66,17 @@ FAMILIES = {
     "bloom": ("convert_hf_bloom", "BloomForCausalLM",
               lambda t: t.BloomConfig(vocab_size=256, hidden_size=64,
                                       n_layer=4, n_head=4)),
+    "gptbigcode": ("convert_hf_gptbigcode", "GPTBigCodeForCausalLM",
+                   lambda t: t.GPTBigCodeConfig(
+                       vocab_size=96, n_embd=48, n_layer=2, n_head=4,
+                       n_positions=64, multi_query=True, resid_pdrop=0.0,
+                       embd_pdrop=0.0, attn_pdrop=0.0)),
+    "stablelm": ("convert_hf_stablelm", "StableLmForCausalLM",
+                 lambda t: t.StableLmConfig(
+                     vocab_size=96, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     intermediate_size=128, partial_rotary_factor=0.25,
+                     max_position_embeddings=64)),
     # audio encoder-decoder: random mel features in, KV-cache greedy out
     "whisper": ("convert_hf_whisper", "WhisperForConditionalGeneration",
                 lambda t: t.WhisperConfig(

@@ -626,3 +626,87 @@ def test_bloom_greedy_generation_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=10)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_bigcode(seed=0):
+    cfg = transformers.GPTBigCodeConfig(
+        vocab_size=96, n_embd=48, n_layer=2, n_head=4, n_positions=32,
+        multi_query=True, resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0)
+    torch.manual_seed(seed)
+    return transformers.GPTBigCodeForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_gptbigcode():
+    """StarCoder family: multi-query attention = num_query_groups=1;
+    HF c_attn's [q_all | k | v] rows transpose straight into our fused
+    GQA column layout."""
+    from tools.convert_hf_gptbigcode import convert_gptbigcode
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_bigcode()
+    cfg, params = convert_gptbigcode(hf.state_dict(), hf_cfg)
+    assert cfg.query_groups == 1
+
+    tokens = np.random.RandomState(0).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_gptbigcode_greedy_matches_hf():
+    from tools.convert_hf_gptbigcode import convert_gptbigcode
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_bigcode(seed=3)
+    cfg, params = convert_gptbigcode(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(3).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_stablelm(seed=0, qkv_bias=False, kv_heads=4):
+    cfg = transformers.StableLmConfig(
+        vocab_size=96, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=kv_heads,
+        intermediate_size=128, partial_rotary_factor=0.25,
+        max_position_embeddings=32, use_qkv_bias=qkv_bias,
+        attention_dropout=0.0, hidden_dropout=0.0)
+    torch.manual_seed(seed)
+    return transformers.StableLmForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("qkv_bias,kv_heads", [(False, 4), (True, 2)])
+def test_logits_match_hf_stablelm(qkv_bias, kv_heads):
+    """StableLM: LayerNorm blocks + SwiGLU + partial rotary (0.25) —
+    the knob combination no other family pairs."""
+    from tools.convert_hf_stablelm import convert_stablelm
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_stablelm(qkv_bias=qkv_bias, kv_heads=kv_heads)
+    cfg, params = convert_stablelm(hf.state_dict(), hf_cfg)
+    assert cfg.normalization == "layernorm" and cfg.activation == "swiglu"
+    assert cfg.rotary_percent == 0.25
+
+    tokens = np.random.RandomState(1).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4,
+                               atol=2e-4)

@@ -1,0 +1,109 @@
+"""Convert a HuggingFace GPTBigCode (StarCoder) checkpoint into
+apex_tpu GPTModel params.
+
+Migration tooling + numerics oracle (tests/L0/test_hf_convert.py):
+StarCoder is the multi-query-attention family — ONE K/V head shared by
+all query heads, which is exactly ``num_query_groups=1`` here. The HF
+``c_attn`` packs rows as [q_all | k | v] ([out, in] layout), which after
+transposition IS our fused GQA column layout ([all q heads | kv
+groups]) — no permutation needed, unlike GPT-2's per-head interleave.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def convert_gptbigcode(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a GPTBigCodeForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if not hf_config.multi_query:
+        raise ValueError("convert_gptbigcode expects multi_query=True "
+                         "(the StarCoder family); MHA checkpoints are "
+                         "plain GPT-2 — use convert_gpt2's layout")
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    cfg = TransformerConfig(
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_attention_heads=hf_config.n_head,
+        num_query_groups=1,  # MQA
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.n_positions,
+        ffn_hidden_size=(getattr(hf_config, 'n_inner', None)
+                         or 4 * hf_config.n_embd),
+        layernorm_epsilon=hf_config.layer_norm_epsilon,
+        activation="gelu",  # gelu_pytorch_tanh = the tanh approximation
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        tie_word_embeddings=True,
+    )
+    if hf_config.activation_function not in ("gelu_pytorch_tanh",
+                                             "gelu_new"):
+        raise ValueError(f"unexpected activation "
+                         f"{hf_config.activation_function!r}")
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"h.{i}"
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {"weight": _t(sd[f"{p}.ln_1.weight"]),
+                                "bias": _t(sd[f"{p}.ln_1.bias"])},
+            "self_attention": {
+                # [q_all | k | v] rows -> transpose -> our GQA columns
+                "query_key_value": {
+                    "weight": _t(sd[f"{p}.attn.c_attn.weight"]).T,
+                    "bias": _t(sd[f"{p}.attn.c_attn.bias"])},
+                "dense": {"weight": _t(sd[f"{p}.attn.c_proj.weight"]).T,
+                          "bias": _t(sd[f"{p}.attn.c_proj.bias"])},
+            },
+            "post_attention_layernorm": {
+                "weight": _t(sd[f"{p}.ln_2.weight"]),
+                "bias": _t(sd[f"{p}.ln_2.bias"])},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": _t(sd[f"{p}.mlp.c_fc.weight"]).T,
+                    "bias": _t(sd[f"{p}.mlp.c_fc.bias"])},
+                "dense_4h_to_h": {
+                    "weight": _t(sd[f"{p}.mlp.c_proj.weight"]).T,
+                    "bias": _t(sd[f"{p}.mlp.c_proj.bias"])},
+            },
+        }
+
+    import jax
+
+    params = {
+        "word_embeddings": {"weight": _t(sd["wte.weight"])},
+        "position_embeddings": _t(sd["wpe.weight"]),
+        "transformer": layers,
+        "final_layernorm": {"weight": _t(sd["ln_f.weight"]),
+                            "bias": _t(sd["ln_f.bias"])},
+    }
+    return cfg, jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import GPTBigCodeForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = GPTBigCodeForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_gptbigcode(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Convert a HuggingFace StableLM checkpoint into apex_tpu GPTModel
+params.
+
+Migration tooling + numerics oracle (tests/L0/test_hf_convert.py):
+StableLM combines knobs no other family pairs — LayerNorm (with bias)
+blocks around a SwiGLU MLP, plus PARTIAL rotary (partial_rotary_factor,
+e.g. 0.25) with optional QKV biases — validating that the architecture
+knobs compose freely rather than living in fixed bundles.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.convert_hf_llama import _fused_qkv, _lin_t, _ln, _t
+
+
+def convert_stablelm(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a StableLmForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if hf_config.hidden_act != "silu":
+        raise ValueError(f"expected silu MLP, got "
+                         f"{hf_config.hidden_act!r}")
+    if getattr(hf_config, "use_parallel_residual", False):
+        raise ValueError("parallel-residual StableLM variants need the "
+                         "neox-style converter path")
+    if getattr(hf_config, "qk_layernorm", False):
+        raise ValueError("qk_layernorm=True checkpoints (stablelm-2-12b "
+                         "lineage) carry per-head q/k layernorms this "
+                         "model does not represent — refusing to "
+                         "silently drop them")
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    heads = hf_config.num_attention_heads
+    groups = hf_config.num_key_value_heads
+    kv = hf_config.hidden_size // heads
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=heads,
+        num_query_groups=groups if groups != heads else None,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        ffn_hidden_size=hf_config.intermediate_size,
+        layernorm_epsilon=hf_config.layer_norm_eps,
+        activation="swiglu",
+        normalization="layernorm",
+        position_embedding_type="rope",
+        rotary_base=hf_config.rope_theta,
+        rotary_percent=float(hf_config.partial_rotary_factor),
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        tie_word_embeddings=bool(
+            getattr(hf_config, "tie_word_embeddings", False)),
+    )
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        qkv_w = _fused_qkv(_lin_t(sd, f"{p}.self_attn.q_proj.weight"),
+                           _lin_t(sd, f"{p}.self_attn.k_proj.weight"),
+                           _lin_t(sd, f"{p}.self_attn.v_proj.weight"),
+                           heads, groups, kv)
+        attn = {"query_key_value": {"weight": qkv_w},
+                "dense": {"weight": _lin_t(
+                    sd, f"{p}.self_attn.o_proj.weight")}}
+        if f"{p}.self_attn.q_proj.bias" in sd:  # use_qkv_bias=True
+            attn["query_key_value"]["bias"] = _fused_qkv(
+                _t(sd[f"{p}.self_attn.q_proj.bias"]),
+                _t(sd[f"{p}.self_attn.k_proj.bias"]),
+                _t(sd[f"{p}.self_attn.v_proj.bias"]), heads, groups, kv)
+        else:
+            attn["query_key_value"]["bias"] = np.zeros(
+                ((heads + 2 * groups) * kv,), np.float32)
+        attn["dense"]["bias"] = np.zeros((cfg.hidden_size,), np.float32)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": _ln(sd, f"{p}.input_layernorm"),
+            "self_attention": attn,
+            "post_attention_layernorm": _ln(
+                sd, f"{p}.post_attention_layernorm"),
+            "mlp": {
+                "dense_h_to_4h": {"weight": np.concatenate(
+                    [_lin_t(sd, f"{p}.mlp.gate_proj.weight"),
+                     _lin_t(sd, f"{p}.mlp.up_proj.weight")], axis=-1)},
+                "dense_4h_to_h": {"weight": _lin_t(
+                    sd, f"{p}.mlp.down_proj.weight")},
+            },
+        }
+
+    import jax
+
+    params = {
+        "word_embeddings": {"weight": _t(sd["embed_tokens.weight"])},
+        "transformer": layers,
+        "final_layernorm": _ln(sd, "norm"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _t(state_dict["lm_head.weight"]).T
+    return cfg, jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import StableLmForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = StableLmForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_stablelm(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
