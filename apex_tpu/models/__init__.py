@@ -23,6 +23,7 @@ from apex_tpu.models.generation import (  # noqa: F401
     tensor_parallel_generate,
 )
 from apex_tpu.models.tp_split import (  # noqa: F401
+    split_mla_params_for_tp,
     split_params_for_tp,
     split_t5_params_for_tp,
 )
@@ -53,4 +54,9 @@ from apex_tpu.models.whisper import (  # noqa: F401
     WhisperModel,
     whisper_cached_generate,
     whisper_greedy_generate,
+)
+from apex_tpu.models.mla import (  # noqa: F401
+    DeepseekModel,
+    MLAConfig,
+    mla_greedy_generate,
 )

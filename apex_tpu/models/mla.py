@@ -1,0 +1,237 @@
+"""Multi-head Latent Attention (DeepSeek-V2) language model.
+
+The KV-cache-compression attention innovation for the zoo: K/V are
+projected through a small shared LATENT (``kv_lora_rank`` wide, plus a
+decoupled rope sub-vector shared across heads) and re-expanded per head,
+shrinking the cache by an order of magnitude — directly relevant on TPU
+where HBM capacity bounds batch at decode. Queries optionally compress
+through their own latent (``q_lora_rank``; deepseek-v2-lite skips it).
+
+Layout (DeepSeek-V2 conventions, validated against HF by the converter
+oracle): per head, queries/keys carry ``qk_nope_head_dim`` positionless
+channels plus ``qk_rope_head_dim`` rotary channels (the key's rope
+sub-vector comes from the latent projection and is SHARED by all heads);
+values carry ``v_head_dim``. Scores scale by (nope+rope)**-0.5. The
+rotary uses the interleaved-pair convention (HF's internal de-interleave
+permute cancels in the q·k contraction). RMSNorm everywhere, SwiGLU MLP,
+untied head.
+
+TP design: the latent projections (q_a, kv_a) are small and REPLICATED;
+the per-head expansions (q_b, kv_b) are column-parallel over heads and
+the output projection is row-parallel — so the latent rides every rank
+while heads shard, the same geometry the cache savings want.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.transformer_lm import _rope_core
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.transformer.parallel_state import (
+    get_tensor_model_parallel_world_size,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    copy_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class MLAConfig:
+    vocab_size: int = 102400
+    hidden_size: int = 2048
+    num_layers: int = 12
+    num_heads: int = 16
+    q_lora_rank: Optional[int] = None   # None -> direct q projection
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    ffn_hidden_size: int = 8192
+    rms_eps: float = 1e-6
+    rotary_base: float = 10000.0
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def qk_head_dim(self):
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+
+def _norm(cfg, name, width=None):
+    return FusedRMSNorm(normalized_shape=width or cfg.hidden_size,
+                        eps=cfg.rms_eps, param_dtype=jnp.float32,
+                        name=name)
+
+
+class MLAAttention(nn.Module):
+    """Latent-compressed attention (module doc)."""
+
+    config: MLAConfig
+
+    @nn.compact
+    def __call__(self, x, position_ids=None):
+        cfg = self.config
+        tp = get_tensor_model_parallel_world_size()
+        n_local = divide(cfg.num_heads, tp)
+        nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+        vd = cfg.v_head_dim
+        s, b, _ = x.shape
+        x = x.astype(cfg.compute_dtype)
+
+        # -- queries: optional latent compression, then per-head expand
+        if cfg.q_lora_rank:
+            qa = nn.Dense(cfg.q_lora_rank, use_bias=False,
+                          dtype=cfg.compute_dtype,
+                          param_dtype=cfg.params_dtype, name="q_a")(x)
+            qa = _norm(cfg, "q_a_norm", cfg.q_lora_rank)(
+                qa.astype(jnp.float32)).astype(cfg.compute_dtype)
+            qa = copy_to_tensor_model_parallel_region(qa)
+            q = ColumnParallelLinear(
+                input_size=cfg.q_lora_rank,
+                output_size=cfg.num_heads * cfg.qk_head_dim,
+                gather_output=False, bias=False,
+                params_dtype=cfg.params_dtype, name="q_b")(qa)
+        else:
+            q = ColumnParallelLinear(
+                input_size=cfg.hidden_size,
+                output_size=cfg.num_heads * cfg.qk_head_dim,
+                gather_output=False, bias=False,
+                params_dtype=cfg.params_dtype, name="q_b")(x)
+        q = q.reshape(s, b, n_local, cfg.qk_head_dim)
+        q_nope, q_pe = q[..., :nope], q[..., nope:]
+
+        # -- keys/values: shared latent + shared rope sub-vector
+        ckv = nn.Dense(cfg.kv_lora_rank + rope, use_bias=False,
+                       dtype=cfg.compute_dtype,
+                       param_dtype=cfg.params_dtype, name="kv_a")(x)
+        compressed, k_pe = ckv[..., :cfg.kv_lora_rank], \
+            ckv[..., cfg.kv_lora_rank:]
+        compressed = _norm(cfg, "kv_a_norm", cfg.kv_lora_rank)(
+            compressed.astype(jnp.float32)).astype(cfg.compute_dtype)
+        compressed = copy_to_tensor_model_parallel_region(compressed)
+        kv = ColumnParallelLinear(
+            input_size=cfg.kv_lora_rank,
+            output_size=cfg.num_heads * (nope + vd),
+            gather_output=False, bias=False,
+            params_dtype=cfg.params_dtype, name="kv_b")(compressed)
+        kv = kv.reshape(s, b, n_local, nope + vd)
+        k_nope, value = kv[..., :nope], kv[..., nope:]
+
+        # rope on the decoupled sub-vectors (interleaved convention; the
+        # key rope part is one shared "head" broadcast after rotation)
+        q_pe = _rope_core(q_pe, cfg.rotary_base, position_ids, rope,
+                          interleaved=True)
+        k_pe = _rope_core(k_pe[:, :, None, :], cfg.rotary_base,
+                          position_ids, rope, interleaved=True)
+        k_pe = jnp.broadcast_to(k_pe, (s, b, n_local, rope))
+
+        scale = jnp.asarray(cfg.qk_head_dim ** -0.5, jnp.float32)
+        scores = (jnp.einsum("qbnd,kbnd->bnqk",
+                             jnp.concatenate([q_nope, q_pe], -1).astype(
+                                 cfg.compute_dtype),
+                             jnp.concatenate([k_nope, k_pe], -1).astype(
+                                 cfg.compute_dtype),
+                             preferred_element_type=jnp.float32) * scale)
+        i = jnp.arange(s)[:, None]
+        j = jnp.arange(s)[None, :]
+        scores = jnp.where(j > i, -1e9, scores)  # causal
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bnqk,kbnd->qbnd",
+                         probs.astype(cfg.compute_dtype),
+                         value.astype(cfg.compute_dtype),
+                         preferred_element_type=jnp.float32)
+        ctx = ctx.reshape(s, b, n_local * vd).astype(cfg.compute_dtype)
+        return RowParallelLinear(
+            input_size=cfg.num_heads * vd, output_size=cfg.hidden_size,
+            input_is_parallel=True, bias=False,
+            params_dtype=cfg.params_dtype, name="o")(ctx)
+
+
+class _SwiGLU(nn.Module):
+    config: MLAConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x.astype(cfg.compute_dtype)
+        gate_up = ColumnParallelLinear(
+            input_size=cfg.hidden_size, output_size=2 * cfg.ffn_hidden_size,
+            gather_output=False, bias=False,
+            params_dtype=cfg.params_dtype, name="gate_up")(x)
+        gate, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
+        h = (jax.nn.silu(gate) * up).astype(cfg.compute_dtype)
+        return RowParallelLinear(
+            input_size=cfg.ffn_hidden_size, output_size=cfg.hidden_size,
+            input_is_parallel=True, bias=False,
+            params_dtype=cfg.params_dtype, name="down")(h)
+
+
+class DeepseekBlock(nn.Module):
+    config: MLAConfig
+
+    @nn.compact
+    def __call__(self, h, position_ids=None):
+        cfg = self.config
+        x = _norm(cfg, "input_norm")(h.astype(jnp.float32)).astype(
+            cfg.compute_dtype)
+        h = h + MLAAttention(cfg, name="self_attn")(
+            x, position_ids).astype(h.dtype)
+        x = _norm(cfg, "post_attn_norm")(h.astype(jnp.float32)).astype(
+            cfg.compute_dtype)
+        return h + _SwiGLU(cfg, name="mlp")(x).astype(h.dtype)
+
+
+class DeepseekModel(nn.Module):
+    """Dense DeepSeek-V2-style causal LM on MLA. Token ids [b, s] ->
+    [b, s, vocab/tp] logits. (The MoE layers of the large DeepSeek
+    checkpoints route through ``transformer/moe``'s SwitchMLP — this
+    family pins the attention innovation with the dense configuration.)
+    """
+
+    config: MLAConfig
+
+    @nn.compact
+    def __call__(self, tokens, position_ids=None):
+        cfg = self.config
+        h = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+            params_dtype=cfg.params_dtype, name="embed_tokens")(tokens)
+        h = h.astype(cfg.compute_dtype).transpose(1, 0, 2)  # [s, b, h]
+        pos = (position_ids.transpose(1, 0)
+               if position_ids is not None else None)
+        for i in range(cfg.num_layers):
+            h = DeepseekBlock(cfg, name=f"layer_{i}")(h, pos)
+        h = _norm(cfg, "final_norm")(h.astype(jnp.float32))
+        h = copy_to_tensor_model_parallel_region(
+            h.astype(cfg.compute_dtype))
+        tp = get_tensor_model_parallel_world_size()
+        head = self.param("lm_head", nn.initializers.normal(0.02),
+                          (cfg.hidden_size, divide(cfg.vocab_size, tp)),
+                          cfg.params_dtype)
+        logits = jnp.einsum("sbh,hv->sbv", h,
+                            head.astype(cfg.compute_dtype),
+                            preferred_element_type=jnp.float32)
+        return logits.transpose(1, 0, 2)
+
+
+def mla_greedy_generate(model, params, prompt_tokens, max_new_tokens):
+    """Greedy decode (full re-run per token — oracle path)."""
+    from apex_tpu.transformer.tensor_parallel import (
+        gather_from_tensor_model_parallel_region,
+    )
+
+    toks = jnp.asarray(prompt_tokens, jnp.int32)
+    for _ in range(max_new_tokens):
+        logits = model.apply({"params": params}, toks)
+        full = gather_from_tensor_model_parallel_region(logits[:, -1, :])
+        nxt = jnp.argmax(full, -1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    return toks

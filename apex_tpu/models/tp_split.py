@@ -165,6 +165,52 @@ def split_t5_params_for_tp(cfg, params, tp: int):
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
+# -- MLA / DeepSeek family ---------------------------------------------------
+
+_MLA_COLUMN = frozenset({"q_b", "kv_b"})  # per-head expansions
+_MLA_ROW = frozenset({"o", "down"})
+_MLA_REPLICATED = frozenset({
+    "q_a", "kv_a",            # shared latent projections ride every rank
+    "q_a_norm", "kv_a_norm", "input_norm", "post_attn_norm", "final_norm",
+})
+
+
+def split_mla_params_for_tp(cfg, params, tp: int):
+    """Stacked [tp, ...] layout for a tp=1 DeepseekModel tree: per-head
+    column splits for the latent expansions (q_b/kv_b) and the fused
+    gate_up, row splits for o/down, vocab rows for the embedding, vocab
+    columns for the head; the LATENT projections and their norms
+    replicate (models/mla.py TP design). gate_up is [gate | up] packed —
+    two-region split like the dense GPT swiglu."""
+    for name, n in (("num_heads", cfg.num_heads),
+                    ("ffn_hidden_size", cfg.ffn_hidden_size),
+                    ("vocab_size", cfg.vocab_size)):
+        if n % tp:
+            raise ValueError(f"{name} ({n}) is not divisible by tp ({tp})")
+    if tp == 1:
+        return jax.tree_util.tree_map(lambda a: a[None], params)
+
+    def rule(path, leaf):
+        names = set(_path_names(path))
+        if "gate_up" in names:
+            return _split_two_region(leaf, tp, cfg.ffn_hidden_size, -1)
+        if names & _MLA_COLUMN:
+            return _split_contiguous(leaf, tp, -1)
+        if names & _MLA_ROW:
+            return _split_contiguous(leaf, tp, -2)
+        if "embed_tokens" in names:
+            return _split_contiguous(leaf, tp, -2)
+        if "lm_head" in names:
+            return _split_contiguous(leaf, tp, -1)
+        if leaf.ndim >= 2 and not (names & _MLA_REPLICATED):
+            raise ValueError(
+                f"split_mla_params_for_tp: unrecognized weight matrix at "
+                f"{jax.tree_util.keystr(path)} (shape {leaf.shape})")
+        return _replicate(leaf, tp)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
 def split_params_for_tp(cfg, params, tp: int):
     """Return the stacked [tp, ...] pytree for a tp=1 GPTModel param
     tree (see module doc). Validates divisibility of heads/groups/ffn/

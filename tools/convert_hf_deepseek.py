@@ -1,0 +1,102 @@
+"""Convert a HuggingFace DeepSeek-V2 (dense) checkpoint into apex_tpu
+DeepseekModel params.
+
+Migration tooling + numerics oracle (tests/L0/test_hf_convert_mla.py):
+validates the multi-head-latent-attention pipeline — query/key-value
+latent compression, per-head expansion, the decoupled shared-rope
+sub-vector, (nope+rope)**-0.5 scaling, and the interleaved rope
+convention — against HF end to end. Dense configurations only: MoE
+layers (n_routed_experts set with first_k_dense_replace < num_layers)
+are refused; route those through transformer/moe.
+"""
+
+import numpy as np
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def convert_deepseek(state_dict, hf_config):
+    """(MLAConfig, params pytree) from a DeepseekV2ForCausalLM
+    state_dict. tp=1 layout."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.mla import MLAConfig
+
+    n_layers = hf_config.num_hidden_layers
+    if (getattr(hf_config, "n_routed_experts", None)
+            and getattr(hf_config, "first_k_dense_replace", 0) < n_layers):
+        raise ValueError(
+            "convert_deepseek handles DENSE DeepSeek configs only; MoE "
+            "layers route through apex_tpu.transformer.moe")
+    if hf_config.hidden_act != "silu":
+        raise ValueError(f"expected silu, got {hf_config.hidden_act!r}")
+    if getattr(hf_config, "rope_scaling", None):
+        raise ValueError("rope_scaling (yarn mscale) not supported; "
+                         "plain rope checkpoints only")
+    if getattr(hf_config, "attention_bias", False) or getattr(
+            hf_config, "mlp_bias", False):
+        raise ValueError("attention_bias/mlp_bias checkpoints carry "
+                         "projection biases this model does not "
+                         "represent — refusing to silently drop them")
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    cfg = MLAConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=n_layers,
+        num_heads=hf_config.num_attention_heads,
+        q_lora_rank=hf_config.q_lora_rank,
+        kv_lora_rank=hf_config.kv_lora_rank,
+        qk_nope_head_dim=hf_config.qk_nope_head_dim,
+        qk_rope_head_dim=hf_config.qk_rope_head_dim,
+        v_head_dim=hf_config.v_head_dim,
+        ffn_hidden_size=hf_config.intermediate_size,
+        rms_eps=hf_config.rms_norm_eps,
+        rotary_base=hf_config.rope_theta,
+        compute_dtype=jnp.float32)
+
+    layers = {}
+    for i in range(n_layers):
+        p = f"layers.{i}"
+        attn = {
+            "kv_a": {"kernel": _t(
+                sd[f"{p}.self_attn.kv_a_proj_with_mqa.weight"]).T},
+            "kv_a_norm": {"weight": _t(
+                sd[f"{p}.self_attn.kv_a_layernorm.weight"])},
+            "kv_b": {"weight": _t(sd[f"{p}.self_attn.kv_b_proj.weight"]).T},
+            "o": {"weight": _t(sd[f"{p}.self_attn.o_proj.weight"]).T},
+        }
+        if cfg.q_lora_rank:
+            attn["q_a"] = {"kernel": _t(
+                sd[f"{p}.self_attn.q_a_proj.weight"]).T}
+            attn["q_a_norm"] = {"weight": _t(
+                sd[f"{p}.self_attn.q_a_layernorm.weight"])}
+            attn["q_b"] = {"weight": _t(
+                sd[f"{p}.self_attn.q_b_proj.weight"]).T}
+        else:
+            attn["q_b"] = {"weight": _t(
+                sd[f"{p}.self_attn.q_proj.weight"]).T}
+        layers[f"layer_{i}"] = {
+            "input_norm": {"weight": _t(
+                sd[f"{p}.input_layernorm.weight"])},
+            "self_attn": attn,
+            "post_attn_norm": {"weight": _t(
+                sd[f"{p}.post_attention_layernorm.weight"])},
+            "mlp": {
+                "gate_up": {"weight": np.concatenate(
+                    [_t(sd[f"{p}.mlp.gate_proj.weight"]).T,
+                     _t(sd[f"{p}.mlp.up_proj.weight"]).T], axis=-1)},
+                "down": {"weight": _t(sd[f"{p}.mlp.down_proj.weight"]).T},
+            },
+        }
+
+    params = {
+        "embed_tokens": {"weight": _t(sd["embed_tokens.weight"])},
+        "final_norm": {"weight": _t(sd["norm.weight"])},
+        "lm_head": _t(state_dict["lm_head.weight"]).T,
+        **layers,
+    }
+    return cfg, jax.tree_util.tree_map(jnp.asarray, params)

@@ -12,6 +12,8 @@ groups]) — no permutation needed, unlike GPT-2's per-head interleave.
 import jax.numpy as jnp
 import numpy as np
 
+from tools.convert_hf_llama import _map_gelu
+
 
 def _t(x):
     return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
@@ -27,6 +29,12 @@ def convert_gptbigcode(state_dict, hf_config):
         raise ValueError("convert_gptbigcode expects multi_query=True "
                          "(the StarCoder family); MHA checkpoints are "
                          "plain GPT-2 — use convert_gpt2's layout")
+    if not getattr(hf_config, "tie_word_embeddings", True):
+        raise ValueError("untied-head GPTBigCode checkpoints are not "
+                         "represented — refusing to drop lm_head")
+    if not getattr(hf_config, "scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False changes the score "
+                         "scaling this model applies — refusing")
     sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
     cfg = TransformerConfig(
         hidden_size=hf_config.n_embd,
@@ -38,15 +46,11 @@ def convert_gptbigcode(state_dict, hf_config):
         ffn_hidden_size=(getattr(hf_config, 'n_inner', None)
                          or 4 * hf_config.n_embd),
         layernorm_epsilon=hf_config.layer_norm_epsilon,
-        activation="gelu",  # gelu_pytorch_tanh = the tanh approximation
+        activation=_map_gelu(hf_config.activation_function),
         compute_dtype=jnp.float32,
         use_flash_attention=False,
         tie_word_embeddings=True,
     )
-    if hf_config.activation_function not in ("gelu_pytorch_tanh",
-                                             "gelu_new"):
-        raise ValueError(f"unexpected activation "
-                         f"{hf_config.activation_function!r}")
 
     layers = {}
     for i in range(cfg.num_layers):
