@@ -66,6 +66,15 @@ FAMILIES = {
     "bloom": ("convert_hf_bloom", "BloomForCausalLM",
               lambda t: t.BloomConfig(vocab_size=256, hidden_size=64,
                                       n_layer=4, n_head=4)),
+    "deepseek": ("convert_hf_deepseek", "DeepseekV2ForCausalLM",
+                 lambda t: t.DeepseekV2Config(
+                     vocab_size=96, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=4, q_lora_rank=16,
+                     kv_lora_rank=8, qk_rope_head_dim=4,
+                     qk_nope_head_dim=8, v_head_dim=8,
+                     n_routed_experts=None, first_k_dense_replace=2,
+                     max_position_embeddings=64, attention_dropout=0.0)),
     "gptbigcode": ("convert_hf_gptbigcode", "GPTBigCodeForCausalLM",
                    lambda t: t.GPTBigCodeConfig(
                        vocab_size=96, n_embd=48, n_layer=2, n_head=4,
@@ -137,6 +146,20 @@ def main():
         hf = cls(tiny_cfg(transformers))
 
     cfg, params = convert(hf.eval().state_dict(), hf.config)
+
+    if args.family == "deepseek":
+        from apex_tpu.models import DeepseekModel, mla_greedy_generate
+
+        if args.tp > 1 or args.beams > 1:
+            raise SystemExit("the deepseek path in this example is "
+                             "greedy single-program (tp oracle lives in "
+                             "tests)")
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
+        out = mla_greedy_generate(DeepseekModel(cfg), params, prompt,
+                                  max_new_tokens=args.max_new_tokens)
+        print("token ids:\n", np.asarray(out))
+        return
 
     if args.family == "whisper":
         from apex_tpu.models import WhisperModel, whisper_cached_generate
