@@ -58,5 +58,6 @@ from apex_tpu.models.whisper import (  # noqa: F401
 from apex_tpu.models.mla import (  # noqa: F401
     DeepseekModel,
     MLAConfig,
+    mla_cached_generate,
     mla_greedy_generate,
 )

@@ -23,6 +23,7 @@ while heads shard, the same geometry the cache savings want.
 """
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -57,6 +58,7 @@ class MLAConfig:
     ffn_hidden_size: int = 8192
     rms_eps: float = 1e-6
     rotary_base: float = 10000.0
+    max_decode_length: int = 512   # latent-cache window for decoding
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -72,17 +74,29 @@ def _norm(cfg, name, width=None):
 
 
 class MLAAttention(nn.Module):
-    """Latent-compressed attention (module doc)."""
+    """Latent-compressed attention (module doc). ``mode`` (static):
+    'train' — full attention; 'prefill'/'step' — the ABSORBED-projection
+    latent-cache decode: the cache holds ONLY the per-token latent row
+    [kv_lora_rank + qk_rope_head_dim] (normed latent | rotated shared
+    k_pe), shared across heads, and ``kv_b``'s halves fold into the
+    attention contractions
+
+      scores_nope[i,j] = q_nope_i . (W_nope c_j) = (W_nope^T q_nope_i) . c_j
+      ctx_i            = sum_j p_ij (W_v c_j)   = W_v (sum_j p_ij c_j)
+
+    so per-layer cache bytes shrink from 2*heads*(nope+rope) to
+    (kv_rank+rope) floats/token (8-28x on the published configs) and
+    per-step FLOPs over the prefix stop scaling with heads."""
 
     config: MLAConfig
 
     @nn.compact
-    def __call__(self, x, position_ids=None):
+    def __call__(self, x, position_ids=None, mode="train"):
         cfg = self.config
         tp = get_tensor_model_parallel_world_size()
         n_local = divide(cfg.num_heads, tp)
         nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
-        vd = cfg.v_head_dim
+        vd, lat = cfg.v_head_dim, cfg.kv_lora_rank
         s, b, _ = x.shape
         x = x.astype(cfg.compute_dtype)
 
@@ -108,17 +122,21 @@ class MLAAttention(nn.Module):
         q = q.reshape(s, b, n_local, cfg.qk_head_dim)
         q_nope, q_pe = q[..., :nope], q[..., nope:]
 
-        # -- keys/values: shared latent + shared rope sub-vector
-        ckv = nn.Dense(cfg.kv_lora_rank + rope, use_bias=False,
+        # -- the shared latent projection (keys/values live inside it)
+        ckv = nn.Dense(lat + rope, use_bias=False,
                        dtype=cfg.compute_dtype,
                        param_dtype=cfg.params_dtype, name="kv_a")(x)
-        compressed, k_pe = ckv[..., :cfg.kv_lora_rank], \
-            ckv[..., cfg.kv_lora_rank:]
-        compressed = _norm(cfg, "kv_a_norm", cfg.kv_lora_rank)(
+
+        if mode != "train":
+            return self._decode_tail(cfg, x, ckv, q_nope, q_pe, n_local,
+                                     nope, rope, vd, lat, s, b, mode)
+
+        compressed, k_pe = ckv[..., :lat], ckv[..., lat:]
+        compressed = _norm(cfg, "kv_a_norm", lat)(
             compressed.astype(jnp.float32)).astype(cfg.compute_dtype)
         compressed = copy_to_tensor_model_parallel_region(compressed)
         kv = ColumnParallelLinear(
-            input_size=cfg.kv_lora_rank,
+            input_size=lat,
             output_size=cfg.num_heads * (nope + vd),
             gather_output=False, bias=False,
             params_dtype=cfg.params_dtype, name="kv_b")(compressed)
@@ -154,6 +172,69 @@ class MLAAttention(nn.Module):
             input_is_parallel=True, bias=False,
             params_dtype=cfg.params_dtype, name="o")(ctx)
 
+    def _decode_tail(self, cfg, x, ckv, q_nope, q_pe, n_local, nope,
+                     rope, vd, lat, s, b, mode):
+            compressed = _norm(cfg, "kv_a_norm", lat)(
+                ckv[..., :lat].astype(jnp.float32)).astype(cfg.compute_dtype)
+
+            # the kv_b weight READ AS A TENSOR (same param path/shape the
+            # train-mode ColumnParallelLinear creates), split into its
+            # absorbed halves: [lat, n*(nope+vd)] -> W_nope, W_v
+            w_full = _RawWeight((lat, n_local * (nope + vd)),
+                                cfg.params_dtype, name="kv_b")()
+            w_full = w_full.astype(cfg.compute_dtype).reshape(
+                lat, n_local, nope + vd)
+            w_nope, w_v = w_full[..., :nope], w_full[..., nope:]
+
+            pos_ctr = self.variable("cache", "pos",
+                                    lambda: jnp.zeros((), jnp.int32))
+            pos = jnp.zeros((), jnp.int32) if mode == "prefill" \
+                else pos_ctr.value
+            pos_ctr.value = pos + s
+            positions = pos + jnp.arange(s)
+
+            q_pe = _rope_core(q_pe, cfg.rotary_base, positions, rope,
+                              interleaved=True)
+            k_pe = _rope_core(ckv[..., None, lat:], cfg.rotary_base,
+                              positions, rope, interleaved=True)[:, :, 0]
+
+            # latent cache rows: [max_len, b, lat + rope]
+            max_len = cfg.max_decode_length
+            row = jnp.concatenate([compressed, k_pe], axis=-1)
+            cache = self.variable("cache", "latent", jnp.zeros,
+                                  (max_len, b, lat + rope), cfg.compute_dtype)
+            cache.value = jax.lax.dynamic_update_slice(
+                cache.value, row.astype(cfg.compute_dtype), (pos, 0, 0))
+            c_lat = cache.value[..., :lat]      # [t, b, lat]
+            c_pe = cache.value[..., lat:]       # [t, b, rope]
+
+            # absorb: queries into latent space (per step, per head)
+            q_lat = jnp.einsum("sbnd,lnd->sbnl", q_nope.astype(
+                cfg.compute_dtype), w_nope,
+                preferred_element_type=jnp.float32).astype(cfg.compute_dtype)
+            scale = jnp.asarray(cfg.qk_head_dim ** -0.5, jnp.float32)
+            scores = (jnp.einsum("sbnl,tbl->bnst", q_lat, c_lat,
+                                 preferred_element_type=jnp.float32)
+                      + jnp.einsum("sbnd,tbd->bnst",
+                                   q_pe.astype(cfg.compute_dtype), c_pe,
+                                   preferred_element_type=jnp.float32)) * scale
+            jpos = jnp.arange(max_len)[None, :]
+            ipos = pos + jnp.arange(s)[:, None]
+            scores = jnp.where(jpos > ipos, -1e9, scores)
+            probs = jax.nn.softmax(scores, axis=-1)
+            # weighted latent out, THEN expand through W_v (absorbed)
+            ctx_lat = jnp.einsum("bnst,tbl->sbnl",
+                                 probs.astype(cfg.compute_dtype), c_lat,
+                                 preferred_element_type=jnp.float32).astype(
+                cfg.compute_dtype)
+            ctx = jnp.einsum("sbnl,lnd->sbnd", ctx_lat, w_v,
+                             preferred_element_type=jnp.float32)
+            ctx = ctx.reshape(s, b, n_local * vd).astype(cfg.compute_dtype)
+            return RowParallelLinear(
+                input_size=cfg.num_heads * vd, output_size=cfg.hidden_size,
+                input_is_parallel=True, bias=False,
+                params_dtype=cfg.params_dtype, name="o")(ctx)
+
 
 class _SwiGLU(nn.Module):
     config: MLAConfig
@@ -178,12 +259,12 @@ class DeepseekBlock(nn.Module):
     config: MLAConfig
 
     @nn.compact
-    def __call__(self, h, position_ids=None):
+    def __call__(self, h, position_ids=None, mode="train"):
         cfg = self.config
         x = _norm(cfg, "input_norm")(h.astype(jnp.float32)).astype(
             cfg.compute_dtype)
         h = h + MLAAttention(cfg, name="self_attn")(
-            x, position_ids).astype(h.dtype)
+            x, position_ids, mode=mode).astype(h.dtype)
         x = _norm(cfg, "post_attn_norm")(h.astype(jnp.float32)).astype(
             cfg.compute_dtype)
         return h + _SwiGLU(cfg, name="mlp")(x).astype(h.dtype)
@@ -199,7 +280,7 @@ class DeepseekModel(nn.Module):
     config: MLAConfig
 
     @nn.compact
-    def __call__(self, tokens, position_ids=None):
+    def __call__(self, tokens, position_ids=None, mode="train"):
         cfg = self.config
         h = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
@@ -208,7 +289,7 @@ class DeepseekModel(nn.Module):
         pos = (position_ids.transpose(1, 0)
                if position_ids is not None else None)
         for i in range(cfg.num_layers):
-            h = DeepseekBlock(cfg, name=f"layer_{i}")(h, pos)
+            h = DeepseekBlock(cfg, name=f"layer_{i}")(h, pos, mode=mode)
         h = _norm(cfg, "final_norm")(h.astype(jnp.float32))
         h = copy_to_tensor_model_parallel_region(
             h.astype(cfg.compute_dtype))
@@ -220,6 +301,28 @@ class DeepseekModel(nn.Module):
                             head.astype(cfg.compute_dtype),
                             preferred_element_type=jnp.float32)
         return logits.transpose(1, 0, 2)
+
+    def decode_prefill(self, tokens):
+        """Latent-cache decode, phase 1 (apply with mutable=["cache"])."""
+        return self(tokens, mode="prefill")
+
+    def decode_step(self, tokens):
+        """Latent-cache decode, phase 2 (single-token extension)."""
+        return self(tokens, mode="step")
+
+
+class _RawWeight(nn.Module):
+    """Parameter-only scope: creates/looks up ``<name>/weight`` with the
+    same shape the train-mode parallel linear uses, so decode and train
+    modes share one param tree."""
+
+    shape: tuple
+    dtype: Any
+
+    @nn.compact
+    def __call__(self):
+        return self.param("weight", nn.initializers.normal(0.02),
+                          self.shape, self.dtype)
 
 
 def mla_greedy_generate(model, params, prompt_tokens, max_new_tokens):
@@ -235,3 +338,58 @@ def mla_greedy_generate(model, params, prompt_tokens, max_new_tokens):
         nxt = jnp.argmax(full, -1).astype(jnp.int32)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     return toks
+
+
+@functools.lru_cache(maxsize=16)
+def _mla_compiled_decode(model, max_new_tokens):
+    from apex_tpu.transformer.tensor_parallel import (
+        gather_from_tensor_model_parallel_region,
+    )
+
+    @jax.jit
+    def prefill(params, prompt):
+        logits, mut = model.apply(
+            {"params": params}, prompt, mutable=["cache"],
+            method=DeepseekModel.decode_prefill)
+        full = gather_from_tensor_model_parallel_region(logits[:, -1, :])
+        return mut["cache"], jnp.argmax(full, -1).astype(jnp.int32)
+
+    @jax.jit
+    def decode_all(params, cache, first):
+        def step(carry, _):
+            cache, tok = carry
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"], method=DeepseekModel.decode_step)
+            full = gather_from_tensor_model_parallel_region(
+                logits[:, -1, :])
+            nxt = jnp.argmax(full, -1).astype(jnp.int32)
+            return (mut["cache"], nxt), nxt
+        (_, _), toks = jax.lax.scan(step, (cache, first), None,
+                                    length=max_new_tokens - 1)
+        return toks
+
+    return prefill, decode_all
+
+
+def mla_cached_generate(model, params, prompt_tokens, max_new_tokens):
+    """Greedy decode on the LATENT cache (absorbed projections): the
+    cache stores kv_lora_rank + qk_rope_head_dim floats per token per
+    layer — shared across heads — instead of the 2*heads*(nope+rope)
+    a conventional KV cache would. Token-exact vs
+    :func:`mla_greedy_generate`, its oracle."""
+    cfg = model.config
+    plen = prompt_tokens.shape[1]
+    if plen + max_new_tokens > cfg.max_decode_length:
+        raise ValueError(
+            f"prompt + max_new_tokens ({plen + max_new_tokens}) exceeds "
+            f"max_decode_length ({cfg.max_decode_length})")
+    toks = jnp.asarray(prompt_tokens, jnp.int32)
+    if max_new_tokens == 0:
+        return toks
+    prefill, decode_all = _mla_compiled_decode(model, max_new_tokens)
+    cache, first = prefill(params, toks)
+    if max_new_tokens == 1:
+        return jnp.concatenate([toks, first[:, None]], axis=1)
+    rest = decode_all(params, cache, first)
+    return jnp.concatenate([toks, first[:, None], rest.T], axis=1)

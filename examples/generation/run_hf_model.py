@@ -148,7 +148,7 @@ def main():
     cfg, params = convert(hf.eval().state_dict(), hf.config)
 
     if args.family == "deepseek":
-        from apex_tpu.models import DeepseekModel, mla_greedy_generate
+        from apex_tpu.models import DeepseekModel, mla_cached_generate
 
         if args.tp > 1 or args.beams > 1:
             raise SystemExit("the deepseek path in this example is "
@@ -156,7 +156,7 @@ def main():
                              "tests)")
         prompt = jnp.asarray(
             np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
-        out = mla_greedy_generate(DeepseekModel(cfg), params, prompt,
+        out = mla_cached_generate(DeepseekModel(cfg), params, prompt,
                                   max_new_tokens=args.max_new_tokens)
         print("token ids:\n", np.asarray(out))
         return

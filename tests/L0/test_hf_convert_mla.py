@@ -125,3 +125,44 @@ def test_deepseek_tp2_logits_match_tp1():
     parallel_state.destroy_model_parallel()
     np.testing.assert_allclose(np.asarray(full), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("q_lora_rank", [16, None])
+def test_mla_cached_generate_matches_oracle(q_lora_rank):
+    """The absorbed-projection latent-cache decode (kv_b folded into the
+    attention contractions; cache = kv_rank+rope floats/token shared
+    across heads) is token-exact vs the full-rerun oracle — which is
+    itself token-exact vs HF above. Both query layouts (compressed and
+    the v2-lite direct q)."""
+    from tools.convert_hf_deepseek import convert_deepseek
+
+    from apex_tpu.models.mla import (DeepseekModel, mla_cached_generate,
+                                     mla_greedy_generate)
+
+    _fresh()
+    hf, hf_cfg = _tiny_deepseek(seed=5, q_lora_rank=q_lora_rank)
+    cfg, params = convert_deepseek(hf.state_dict(), hf_cfg)
+    prompt = jnp.asarray(np.random.RandomState(5).randint(0, 96, (2, 6)))
+    model = DeepseekModel(cfg)
+    oracle = mla_greedy_generate(model, params, prompt, max_new_tokens=7)
+    cached = mla_cached_generate(model, params, prompt, max_new_tokens=7)
+    np.testing.assert_array_equal(np.asarray(cached), np.asarray(oracle))
+
+
+def test_mla_cached_generate_window_guard():
+    from apex_tpu.models.mla import (DeepseekModel, MLAConfig,
+                                     mla_cached_generate)
+
+    _fresh()
+    cfg = MLAConfig(vocab_size=32, hidden_size=32, num_layers=1,
+                    num_heads=2, kv_lora_rank=8, qk_nope_head_dim=8,
+                    qk_rope_head_dim=4, v_head_dim=8, ffn_hidden_size=32,
+                    max_decode_length=8, compute_dtype=jnp.float32)
+    import jax
+
+    model = DeepseekModel(cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    assert mla_cached_generate(model, params, prompt, 4).shape == (1, 8)
+    with pytest.raises(ValueError, match="exceeds"):
+        mla_cached_generate(model, params, prompt, 5)
