@@ -66,6 +66,9 @@ FAMILIES = {
     "bloom": ("convert_hf_bloom", "BloomForCausalLM",
               lambda t: t.BloomConfig(vocab_size=256, hidden_size=64,
                                       n_layer=4, n_head=4)),
+    "mpt": ("convert_hf_mpt", "MptForCausalLM",
+            lambda t: t.MptConfig(vocab_size=96, d_model=48, n_heads=4,
+                                  n_layers=2, max_seq_len=64)),
     "deepseek": ("convert_hf_deepseek", "DeepseekV2ForCausalLM",
                  lambda t: t.DeepseekV2Config(
                      vocab_size=96, hidden_size=32, intermediate_size=64,

@@ -1,0 +1,113 @@
+"""Convert a HuggingFace MPT checkpoint into apex_tpu GPTModel params.
+
+Migration tooling + numerics oracle (tests/L0/test_hf_convert.py): MPT
+is the bias-free ALiBi family — NO position embeddings, NO biases on any
+linear or layernorm (zero-filled here: the model's params carry them),
+exact-erf gelu, tied head. Wqkv packs rows as [q_all | k_all | v_all];
+after transposition the columns get the GPT-2 per-head permutation into
+the fused [q_n | k_n | v_n] layout.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.convert_hf_gpt2 import _qkv_permute, _t
+
+
+def convert_mpt(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from an MptForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    attn_cfg = hf_config.attn_config
+    if not getattr(attn_cfg, "alibi", True):
+        raise ValueError("convert_mpt expects alibi=True (rope/learned "
+                         "MPT variants are other families' layouts)")
+    if getattr(attn_cfg, "qk_ln", False):
+        raise ValueError("qk_ln checkpoints carry q/k layernorms this "
+                         "model does not represent")
+    if getattr(attn_cfg, "softmax_scale", None):
+        raise ValueError("custom softmax_scale not supported (default "
+                         "1/sqrt(head_dim) only)")
+    if getattr(attn_cfg, "attn_type", "multihead_attention") \
+            != "multihead_attention":
+        raise ValueError("multiquery MPT variants need the grouped "
+                         "layout; only multihead_attention is mapped")
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    h = hf_config.d_model
+    heads = hf_config.n_heads
+    cfg = TransformerConfig(
+        hidden_size=h,
+        num_layers=hf_config.n_layers,
+        num_attention_heads=heads,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_seq_len,
+        ffn_hidden_size=int(hf_config.expansion_ratio * h),
+        layernorm_epsilon=getattr(hf_config, "layer_norm_epsilon", 1e-5),
+        activation="gelu_exact",  # MptMLP: nn.GELU(approximate="none")
+        position_embedding_type="alibi",
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        tie_word_embeddings=True,
+    )
+
+    def z(n):
+        return np.zeros((n,), np.float32)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"blocks.{i}"
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {"weight": _t(sd[f"{p}.norm_1.weight"]),
+                                "bias": z(h)},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": _qkv_permute(
+                        _t(sd[f"{p}.attn.Wqkv.weight"]).T, heads),
+                    "bias": z(3 * h)},
+                "dense": {"weight": _t(sd[f"{p}.attn.out_proj.weight"]).T,
+                          "bias": z(h)},
+            },
+            "post_attention_layernorm": {
+                "weight": _t(sd[f"{p}.norm_2.weight"]), "bias": z(h)},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": _t(sd[f"{p}.ffn.up_proj.weight"]).T,
+                    "bias": z(cfg.ffn_size)},
+                "dense_4h_to_h": {
+                    "weight": _t(sd[f"{p}.ffn.down_proj.weight"]).T,
+                    "bias": z(h)},
+            },
+        }
+
+    import jax
+
+    params = {
+        "word_embeddings": {"weight": _t(sd["wte.weight"])},
+        "transformer": layers,
+        "final_layernorm": {"weight": _t(sd["norm_f.weight"]),
+                            "bias": z(h)},
+    }
+    return cfg, jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import MptForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = MptForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_mpt(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
