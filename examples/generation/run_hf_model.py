@@ -3,6 +3,9 @@
     python examples/generation/run_hf_model.py            # tiny random GPT-2
     python examples/generation/run_hf_model.py --model-path /path/to/gpt2
     python examples/generation/run_hf_model.py --family llama --beams 4
+    python examples/generation/run_hf_model.py --family t5        # enc-dec
+    python examples/generation/run_hf_model.py --family whisper   # audio
+    python examples/generation/run_hf_model.py --family deepseek  # MLA
 
 Loads (or randomly initializes, offline) a HuggingFace causal LM,
 converts the weights with tools/convert_hf_*, and decodes with the
