@@ -59,6 +59,18 @@ class MLAConfig:
     rms_eps: float = 1e-6
     rotary_base: float = 10000.0
     max_decode_length: int = 512   # latent-cache window for decoding
+    # DeepSeek MoE layers (None -> dense everywhere). Layers >=
+    # first_k_dense_replace route top-k over n_routed_experts small
+    # experts (greedy gate, raw softmax mass unless norm_topk_prob,
+    # output scaled by routed_scaling_factor) PLUS an always-on shared
+    # expert of n_shared_experts * moe_intermediate_size width.
+    n_routed_experts: Optional[int] = None
+    moe_intermediate_size: Optional[int] = None
+    n_shared_experts: Optional[int] = None
+    moe_top_k: int = 2
+    routed_scaling_factor: float = 1.0
+    norm_topk_prob: bool = False
+    first_k_dense_replace: int = 0
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -238,25 +250,33 @@ class MLAAttention(nn.Module):
 
 class _SwiGLU(nn.Module):
     config: MLAConfig
+    ffn: Optional[int] = None  # None -> config.ffn_hidden_size
 
     @nn.compact
     def __call__(self, x):
         cfg = self.config
+        ffn = self.ffn or cfg.ffn_hidden_size
         x = x.astype(cfg.compute_dtype)
         gate_up = ColumnParallelLinear(
-            input_size=cfg.hidden_size, output_size=2 * cfg.ffn_hidden_size,
+            input_size=cfg.hidden_size, output_size=2 * ffn,
             gather_output=False, bias=False,
             params_dtype=cfg.params_dtype, name="gate_up")(x)
         gate, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
         h = (jax.nn.silu(gate) * up).astype(cfg.compute_dtype)
         return RowParallelLinear(
-            input_size=cfg.ffn_hidden_size, output_size=cfg.hidden_size,
+            input_size=ffn, output_size=cfg.hidden_size,
             input_is_parallel=True, bias=False,
             params_dtype=cfg.params_dtype, name="down")(h)
 
 
 class DeepseekBlock(nn.Module):
     config: MLAConfig
+    layer_idx: int = 0
+
+    def _is_moe(self):
+        cfg = self.config
+        return (cfg.n_routed_experts is not None
+                and self.layer_idx >= cfg.first_k_dense_replace)
 
     @nn.compact
     def __call__(self, h, position_ids=None, mode="train"):
@@ -267,15 +287,38 @@ class DeepseekBlock(nn.Module):
             x, position_ids, mode=mode).astype(h.dtype)
         x = _norm(cfg, "post_attn_norm")(h.astype(jnp.float32)).astype(
             cfg.compute_dtype)
-        return h + _SwiGLU(cfg, name="mlp")(x).astype(h.dtype)
+        if not self._is_moe():
+            return h + _SwiGLU(cfg, name="mlp")(x).astype(h.dtype)
+        from apex_tpu.transformer.moe import SwitchMLP
+
+        E, k = cfg.n_routed_experts, cfg.moe_top_k
+        routed = SwitchMLP(
+            hidden_size=cfg.hidden_size,
+            ffn_hidden_size=cfg.moe_intermediate_size,
+            num_experts=E, top_k=k,
+            capacity_factor=float(E) / k,  # dropless (Mixtral-converter note)
+            router_type="top_k", activation="swiglu",
+            normalize_topk=cfg.norm_topk_prob,
+            params_dtype=cfg.params_dtype,
+            compute_dtype=cfg.compute_dtype,
+            warn_on_dropped_losses=False, name="mlp")(x)
+        # scaling the combined routed output == scaling every gate
+        out = routed * jnp.asarray(cfg.routed_scaling_factor, routed.dtype)
+        if cfg.n_shared_experts:
+            out = out + _SwiGLU(
+                cfg, ffn=cfg.n_shared_experts * cfg.moe_intermediate_size,
+                name="shared_mlp")(x)
+        return h + out.astype(h.dtype)
 
 
 class DeepseekModel(nn.Module):
-    """Dense DeepSeek-V2-style causal LM on MLA. Token ids [b, s] ->
-    [b, s, vocab/tp] logits. (The MoE layers of the large DeepSeek
-    checkpoints route through ``transformer/moe``'s SwitchMLP — this
-    family pins the attention innovation with the dense configuration.)
-    """
+    """DeepSeek-V2-style causal LM on MLA. Token ids [b, s] ->
+    [b, s, vocab/tp] logits. Configs with ``n_routed_experts`` run
+    greedy-gate MoE layers (fine-grained experts on SwitchMLP + shared
+    expert) from ``first_k_dense_replace`` onward; the dropless
+    capacity (E/k) used for HF parity makes dispatch O(T^2 E) — for
+    non-toy MoE training pass a capped capacity through a custom block
+    (round-5 queue: scatter dispatch)."""
 
     config: MLAConfig
 
@@ -289,7 +332,8 @@ class DeepseekModel(nn.Module):
         pos = (position_ids.transpose(1, 0)
                if position_ids is not None else None)
         for i in range(cfg.num_layers):
-            h = DeepseekBlock(cfg, name=f"layer_{i}")(h, pos, mode=mode)
+            h = DeepseekBlock(cfg, layer_idx=i, name=f"layer_{i}")(
+                h, pos, mode=mode)
         h = _norm(cfg, "final_norm")(h.astype(jnp.float32))
         h = copy_to_tensor_model_parallel_region(
             h.astype(cfg.compute_dtype))

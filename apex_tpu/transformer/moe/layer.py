@@ -173,6 +173,9 @@ class SwitchMLP(nn.Module):
     capacity_factor: float = 1.25
     jitter_eps: float = 0.0
     router_type: str = "top_k"  # or "expert_choice" (balanced, no aux)
+    # renormalize the selected top-k gates to sum to 1 (Mixtral); False
+    # keeps raw softmax mass (DeepSeek greedy gate, norm_topk_prob=False)
+    normalize_topk: bool = True
     activation: str = "gelu"  # or "swiglu" (Llama/Mixtral-style experts)
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
@@ -204,6 +207,7 @@ class SwitchMLP(nn.Module):
             num_experts=self.num_experts, top_k=self.top_k,
             capacity_factor=self.capacity_factor, jitter_eps=self.jitter_eps,
             router_type=self.router_type,
+            normalize_topk=self.normalize_topk,
             params_dtype=self.params_dtype, name="router")(tokens)
         sown = self.sow("moe_losses", "aux_loss", routing.aux_loss)
         self.sow("moe_losses", "z_loss", routing.z_loss)

@@ -75,16 +75,27 @@ def test_deepseek_greedy_matches_hf():
     np.testing.assert_array_equal(np.asarray(ours), ref)
 
 
-def test_deepseek_converter_refuses_moe_and_yarn():
+def test_deepseek_converter_refusals():
+    """group_limited_greedy routing and yarn rope scaling are not
+    represented — refused loudly instead of silently mis-mapped."""
     from tools.convert_hf_deepseek import convert_deepseek
 
     cfg = transformers.DeepseekV2Config(
         vocab_size=32, hidden_size=32, num_hidden_layers=2,
         num_attention_heads=4, q_lora_rank=8, kv_lora_rank=8,
         qk_rope_head_dim=4, qk_nope_head_dim=8, v_head_dim=8,
-        n_routed_experts=4, first_k_dense_replace=1)
-    with pytest.raises(ValueError, match="DENSE"):
+        n_routed_experts=4, first_k_dense_replace=1,
+        topk_method="group_limited_greedy", n_group=2, topk_group=1)
+    with pytest.raises(ValueError, match="greedy"):
         convert_deepseek({}, cfg)
+    cfg2 = transformers.DeepseekV2Config(
+        vocab_size=32, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, q_lora_rank=8, kv_lora_rank=8,
+        qk_rope_head_dim=4, qk_nope_head_dim=8, v_head_dim=8,
+        n_routed_experts=None,
+        rope_scaling={"type": "yarn", "factor": 2.0})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        convert_deepseek({}, cfg2)
 
 
 def test_deepseek_tp2_logits_match_tp1():
@@ -166,3 +177,38 @@ def test_mla_cached_generate_window_guard():
     assert mla_cached_generate(model, params, prompt, 4).shape == (1, 8)
     with pytest.raises(ValueError, match="exceeds"):
         mla_cached_generate(model, params, prompt, 5)
+
+
+def test_logits_match_hf_deepseek_moe():
+    """The full DeepSeek-V2-lite shape: MLA + MoE layers (greedy top-2
+    over fine-grained experts, RAW softmax mass — norm_topk_prob=False —
+    scaled by routed_scaling_factor, plus the always-on shared expert;
+    layer 0 stays dense per first_k_dense_replace)."""
+    from tools.convert_hf_deepseek import convert_deepseek
+
+    from apex_tpu.models.mla import DeepseekModel
+
+    _fresh()
+    cfg_hf = transformers.DeepseekV2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=16, kv_lora_rank=8,
+        qk_rope_head_dim=4, qk_nope_head_dim=8, v_head_dim=8,
+        n_routed_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=24, n_shared_experts=2,
+        first_k_dense_replace=1, moe_layer_freq=1,
+        routed_scaling_factor=1.0, norm_topk_prob=False,
+        topk_method="greedy", max_position_embeddings=32,
+        attention_dropout=0.0)
+    torch.manual_seed(6)
+    hf = transformers.DeepseekV2ForCausalLM(cfg_hf).eval()
+    cfg, params = convert_deepseek(hf.state_dict(), cfg_hf)
+    assert cfg.n_routed_experts == 4 and cfg.first_k_dense_replace == 1
+
+    tokens = np.random.RandomState(6).randint(0, 96, size=(2, 12))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = DeepseekModel(cfg).apply({"params": params},
+                                    jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4,
+                               atol=3e-4)

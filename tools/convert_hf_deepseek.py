@@ -27,11 +27,13 @@ def convert_deepseek(state_dict, hf_config):
     from apex_tpu.models.mla import MLAConfig
 
     n_layers = hf_config.num_hidden_layers
-    if (getattr(hf_config, "n_routed_experts", None)
-            and getattr(hf_config, "first_k_dense_replace", 0) < n_layers):
+    n_routed = getattr(hf_config, "n_routed_experts", None)
+    moe_from = getattr(hf_config, "first_k_dense_replace", 0)
+    has_moe = bool(n_routed) and moe_from < n_layers
+    if has_moe and getattr(hf_config, "topk_method", "greedy") != "greedy":
         raise ValueError(
-            "convert_deepseek handles DENSE DeepSeek configs only; MoE "
-            "layers route through apex_tpu.transformer.moe")
+            "only the greedy gate (deepseek-v2-lite lineage) is mapped; "
+            "group_limited_greedy routing is not represented")
     if hf_config.hidden_act != "silu":
         raise ValueError(f"expected silu, got {hf_config.hidden_act!r}")
     if getattr(hf_config, "rope_scaling", None):
@@ -56,6 +58,20 @@ def convert_deepseek(state_dict, hf_config):
         ffn_hidden_size=hf_config.intermediate_size,
         rms_eps=hf_config.rms_norm_eps,
         rotary_base=hf_config.rope_theta,
+        n_routed_experts=n_routed if has_moe else None,
+        moe_intermediate_size=(hf_config.moe_intermediate_size
+                               if has_moe else None),
+        n_shared_experts=(getattr(hf_config, "n_shared_experts", None)
+                          if has_moe else None),
+        moe_top_k=(hf_config.num_experts_per_tok if has_moe else 2),
+        routed_scaling_factor=float(
+            getattr(hf_config, "routed_scaling_factor", 1.0)),
+        # ALWAYS False: the HF reference implementation stores
+        # norm_topk_prob but never applies it (verified against
+        # transformers 4.57.6 DeepseekV2MoEGate), so raw softmax mass is
+        # what reproduces HF logits regardless of the config flag
+        norm_topk_prob=False,
+        first_k_dense_replace=moe_from if has_moe else 0,
         compute_dtype=jnp.float32)
 
     layers = {}
@@ -79,18 +95,39 @@ def convert_deepseek(state_dict, hf_config):
         else:
             attn["q_b"] = {"weight": _t(
                 sd[f"{p}.self_attn.q_proj.weight"]).T}
+        if has_moe and i >= moe_from:
+            E = cfg.n_routed_experts
+            w1 = np.stack([np.concatenate(
+                [_t(sd[f"{p}.mlp.experts.{e}.gate_proj.weight"]).T,
+                 _t(sd[f"{p}.mlp.experts.{e}.up_proj.weight"]).T],
+                axis=-1) for e in range(E)])
+            w2 = np.stack([_t(sd[f"{p}.mlp.experts.{e}.down_proj.weight"]).T
+                           for e in range(E)])
+            mlp = {"router": {"gate_weight": _t(
+                sd[f"{p}.mlp.gate.weight"]).T},
+                "experts": {"w1": w1, "w2": w2}}
+            entry = {"mlp": mlp}
+            if cfg.n_shared_experts:
+                sh = f"{p}.mlp.shared_experts"
+                entry["shared_mlp"] = {
+                    "gate_up": {"weight": np.concatenate(
+                        [_t(sd[f"{sh}.gate_proj.weight"]).T,
+                         _t(sd[f"{sh}.up_proj.weight"]).T], axis=-1)},
+                    "down": {"weight": _t(sd[f"{sh}.down_proj.weight"]).T}}
+        else:
+            entry = {"mlp": {
+                "gate_up": {"weight": np.concatenate(
+                    [_t(sd[f"{p}.mlp.gate_proj.weight"]).T,
+                     _t(sd[f"{p}.mlp.up_proj.weight"]).T], axis=-1)},
+                "down": {"weight": _t(sd[f"{p}.mlp.down_proj.weight"]).T},
+            }}
         layers[f"layer_{i}"] = {
             "input_norm": {"weight": _t(
                 sd[f"{p}.input_layernorm.weight"])},
             "self_attn": attn,
             "post_attn_norm": {"weight": _t(
                 sd[f"{p}.post_attention_layernorm.weight"])},
-            "mlp": {
-                "gate_up": {"weight": np.concatenate(
-                    [_t(sd[f"{p}.mlp.gate_proj.weight"]).T,
-                     _t(sd[f"{p}.mlp.up_proj.weight"]).T], axis=-1)},
-                "down": {"weight": _t(sd[f"{p}.mlp.down_proj.weight"]).T},
-            },
+            **entry,
         }
 
     params = {
