@@ -50,6 +50,30 @@ def latest_step(directory: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def repair_orphaned_steps(directory: str) -> list:
+    """Recover steps stranded by a crash inside ``_write_state``'s
+    force-overwrite window: a death between ``rename(path, old)`` and
+    ``rename(tmp, path)`` leaves the step only as ``step_N.old-<pid>``,
+    which ``latest_step`` rightly skips. Renames each such dir back when
+    (and only when) the canonical ``step_N`` is absent — if both exist
+    the landed checkpoint is newer and the parked copy stays parked.
+    Called from ``save`` (single-writer discipline: don't run it while
+    another process is mid-save in the same directory). Returns the
+    recovered step numbers."""
+    if not os.path.isdir(directory):
+        return []
+    recovered = []
+    for name in os.listdir(directory):
+        m = re.fullmatch(r"(step_\d+)\.old-\d+", name)
+        if not m:
+            continue
+        canonical = os.path.join(directory, m.group(1))
+        if not os.path.exists(canonical):
+            os.rename(os.path.join(directory, name), canonical)
+            recovered.append(int(m.group(1)[len("step_"):]))
+    return recovered
+
+
 def save(directory: str, step: int, state: Optional[Dict[str, Any]] = None,
          *, use_orbax: Optional[bool] = None, **extra: Any) -> str:
     """Snapshot ``state`` (a dict of pytrees, merged with ``extra``
@@ -63,6 +87,7 @@ def save(directory: str, step: int, state: Optional[Dict[str, Any]] = None,
         use_orbax = _HAVE_ORBAX
     path = _step_dir(directory, step)
     os.makedirs(directory, exist_ok=True)
+    repair_orphaned_steps(directory)
     host_state = jax.device_get(state)
     _write_state(path, host_state, use_orbax)
     return path
@@ -80,6 +105,13 @@ def restore(directory: str, step: Optional[int] = None, *,
     otherwise. Raises FileNotFoundError when no checkpoints exist.
     """
     if step is None:
+        # The resume flow is where a step stranded mid-overwrite (crash
+        # between _write_state's two renames) would otherwise silently
+        # resolve to an OLDER step — recover parked dirs first. (Under
+        # the single-writer discipline repair_orphaned_steps documents,
+        # a concurrent writer mid-rename-window would fail its landing
+        # rename loudly rather than lose data silently.)
+        repair_orphaned_steps(directory)
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
@@ -175,6 +207,7 @@ class AsyncCheckpointer:
             merged = {**(state or {}), **extra}
             path = _step_dir(directory, step)
             os.makedirs(directory, exist_ok=True)
+            repair_orphaned_steps(directory)
             # synchronous D2H: after this the device buffers are free to
             # be donated/overwritten by the next step
             host_state = jax.device_get(merged)

@@ -71,6 +71,13 @@ class MLAConfig:
     routed_scaling_factor: float = 1.0
     norm_topk_prob: bool = False
     first_k_dense_replace: int = 0
+    # None -> dropless (E/k, the HF-parity semantics: every token reaches
+    # its routed experts). Training users can cap it (e.g. 1.25) without
+    # forking the block; dropped tokens then ride the residual.
+    moe_capacity_factor: Optional[float] = None
+    # auto -> ragged grouped-matmul when dropless on one ep rank,
+    # scatter otherwise (see transformer/moe/layer.py SwitchMLP).
+    moe_dispatch_mode: str = "auto"
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -296,7 +303,11 @@ class DeepseekBlock(nn.Module):
             hidden_size=cfg.hidden_size,
             ffn_hidden_size=cfg.moe_intermediate_size,
             num_experts=E, top_k=k,
-            capacity_factor=float(E) / k,  # dropless (Mixtral-converter note)
+            # default: dropless (E/k), the HF-parity semantics
+            capacity_factor=(cfg.moe_capacity_factor
+                             if cfg.moe_capacity_factor is not None
+                             else float(E) / k),
+            dispatch_mode=cfg.moe_dispatch_mode,
             router_type="top_k", activation="swiglu",
             normalize_topk=cfg.norm_topk_prob,
             params_dtype=cfg.params_dtype,
@@ -315,10 +326,10 @@ class DeepseekModel(nn.Module):
     """DeepSeek-V2-style causal LM on MLA. Token ids [b, s] ->
     [b, s, vocab/tp] logits. Configs with ``n_routed_experts`` run
     greedy-gate MoE layers (fine-grained experts on SwitchMLP + shared
-    expert) from ``first_k_dense_replace`` onward; the dropless
-    capacity (E/k) used for HF parity makes dispatch O(T^2 E) — for
-    non-toy MoE training pass a capped capacity through a custom block
-    (round-5 queue: scatter dispatch)."""
+    expert) from ``first_k_dense_replace`` onward. Dropless serving
+    (the default) uses the ragged grouped-matmul dispatch — linear in
+    tokens, zero capacity padding; ``moe_capacity_factor`` caps it for
+    training (scatter dispatch, dropped tokens ride the residual)."""
 
     config: MLAConfig
 

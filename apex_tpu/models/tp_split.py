@@ -180,20 +180,50 @@ def split_mla_params_for_tp(cfg, params, tp: int):
     column splits for the latent expansions (q_b/kv_b) and the fused
     gate_up, row splits for o/down, vocab rows for the embedding, vocab
     columns for the head; the LATENT projections and their norms
-    replicate (models/mla.py TP design). gate_up is [gate | up] packed —
-    two-region split like the dense GPT swiglu."""
+    replicate (models/mla.py TP design). Packed [gate | up] projections
+    (dense mlp AND the shared expert, whose half-width is
+    n_shared_experts * moe_intermediate_size) split two-region at the
+    leaf's own midpoint. MoE layers: the router gate replicates (routing
+    must agree on every tp rank — SwitchMLP's copy/reduce pairing
+    assumes it), expert w1 is per-expert packed [gate | up] (two-region
+    on the last axis), expert w2 row-splits — matching ExpertMLP's
+    ffn/tp local layout."""
     for name, n in (("num_heads", cfg.num_heads),
                     ("ffn_hidden_size", cfg.ffn_hidden_size),
                     ("vocab_size", cfg.vocab_size)):
         if n % tp:
             raise ValueError(f"{name} ({n}) is not divisible by tp ({tp})")
+    if getattr(cfg, "n_routed_experts", None) and \
+            cfg.moe_intermediate_size % tp:
+        raise ValueError(f"moe_intermediate_size "
+                         f"({cfg.moe_intermediate_size}) is not divisible "
+                         f"by tp ({tp})")
     if tp == 1:
         return jax.tree_util.tree_map(lambda a: a[None], params)
+
+    def split_packed_gate_up(path, leaf):
+        half = leaf.shape[-1] // 2
+        if leaf.shape[-1] % 2 or half % tp:
+            raise ValueError(
+                f"split_mla_params_for_tp: packed [gate | up] leaf at "
+                f"{jax.tree_util.keystr(path)} (shape {leaf.shape}) has "
+                f"half-width {half}, not divisible by tp ({tp})")
+        return _split_two_region(leaf, tp, half, -1)
 
     def rule(path, leaf):
         names = set(_path_names(path))
         if "gate_up" in names:
-            return _split_two_region(leaf, tp, cfg.ffn_hidden_size, -1)
+            return split_packed_gate_up(path, leaf)
+        if "experts" in names:
+            if "w1" in names:
+                return split_packed_gate_up(path, leaf)
+            if "w2" in names:
+                return _split_contiguous(leaf, tp, -2)
+            raise ValueError(
+                f"split_mla_params_for_tp: unrecognized expert param at "
+                f"{jax.tree_util.keystr(path)} (shape {leaf.shape})")
+        if "gate_weight" in names:  # MoE router: replicated
+            return _replicate(leaf, tp)
         if names & _MLA_COLUMN:
             return _split_contiguous(leaf, tp, -1)
         if names & _MLA_ROW:

@@ -81,6 +81,10 @@ class TransformerConfig:
     moe_router_type: str = "top_k"  # or "expert_choice"
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
+    # auto -> ragged grouped matmuls when dropless on one ep rank (the
+    # converted-Mixtral serving shape), scatter otherwise; "einsum" keeps
+    # the dense [T,E,C] one-hot formulation (see moe/layer.py SwitchMLP).
+    moe_dispatch_mode: str = "auto"
     # Modern-LLM (Llama-family) knobs — beyond the reference, which is
     # GPT-2/BERT-era: grouped-query attention (fewer K/V head groups),
     # rotary position embeddings, SwiGLU MLPs, RMSNorm blocks.
@@ -698,6 +702,7 @@ class ParallelTransformerLayer(nn.Module):
                 capacity_factor=cfg.moe_capacity_factor,
                 jitter_eps=cfg.moe_jitter_eps,
                 router_type=cfg.moe_router_type,
+                dispatch_mode=cfg.moe_dispatch_mode,
                 activation=cfg.activation,
                 params_dtype=cfg.params_dtype,
                 compute_dtype=cfg.compute_dtype,

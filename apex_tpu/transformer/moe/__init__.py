@@ -2,11 +2,12 @@
 
 No reference equivalent: juncongmoo/apex has no MoE / expert parallelism
 (SURVEY.md §2.3 note). This subsystem is a new capability, designed
-TPU-first: capacity-based GShard/Switch routing expressed as one-hot
-einsums (static shapes, MXU-friendly), grouped expert FFNs batched over a
-leading expert dim, and expert-parallel dispatch via ``lax.all_to_all``
-over the 'ep' mesh axis (ICI all-to-all), with the expert hidden dim
-tensor-parallel over 'tp'.
+TPU-first: capacity-based GShard/Switch routing (one-hot einsums or the
+O(T log T) sorted formulation), grouped expert FFNs — batched over a
+leading expert dim, or ragged via ``lax.ragged_dot`` grouped matmuls
+with zero capacity padding (the dropless serving path) — and
+expert-parallel dispatch via ``lax.all_to_all`` over the 'ep' mesh axis
+(ICI all-to-all), with the expert hidden dim tensor-parallel over 'tp'.
 """
 
 from apex_tpu.transformer.moe.layer import (
@@ -16,17 +17,21 @@ from apex_tpu.transformer.moe.layer import (
     moe_loss_from_variables,
 )
 from apex_tpu.transformer.moe.router import (
+    SortedRouting,
     TopKRouter,
     compute_expert_choice_routing,
     compute_routing,
+    compute_routing_sorted,
 )
 
 __all__ = [
     "ExpertMLP",
+    "SortedRouting",
     "SwitchMLP",
     "TopKRouter",
     "compute_expert_choice_routing",
     "compute_routing",
+    "compute_routing_sorted",
     "is_expert_param",
     "moe_loss_from_variables",
 ]

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.transformer.moe.router import TopKRouter
+from apex_tpu.transformer.moe.router import TopKRouter, expert_capacity
 from apex_tpu.transformer.parallel_state import (
     EXPERT_PARALLEL_AXIS,
     TENSOR_PARALLEL_AXIS,
@@ -100,12 +100,21 @@ def _expert_rank_key(key):
 
 
 class ExpertMLP(nn.Module):
-    """Grouped FFN over a leading local-expert dim: h -> ffn/tp -> h per
-    expert, activation in fp32, tp-reduced output. Input [E_local, S, h].
+    """Grouped FFN over experts: h -> ffn/tp -> h per expert, activation
+    in fp32, tp-reduced output. Two input layouts, identical params:
+
+    - slotted [E_local, S, h] (default): per-expert einsum over the
+      leading dim — the all_to_all-compatible layout.
+    - ragged [N, h] with ``group_sizes`` [E_local] (rows grouped by
+      expert, consecutively): ``lax.ragged_dot`` grouped matmul — zero
+      capacity padding, the dropless serving layout. XLA lowers this to
+      the TPU grouped-matmul kernel (the MegaBlocks dMoE idea without
+      hand-written block-sparsity: the "blocks" are the ragged groups).
 
     ``activation="swiglu"`` makes w1 a fused per-rank [gate | up]
     projection (2 * ffn/tp local columns, bias-free — the Llama/Mixtral
-    expert shape); "gelu" is the Switch-Transformer shape with biases.
+    expert shape); "gelu" is the Switch-Transformer shape with biases
+    (ragged layout gathers per-row biases via ``expert_idx``).
     """
 
     hidden_size: int
@@ -116,13 +125,17 @@ class ExpertMLP(nn.Module):
     compute_dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, group_sizes=None, expert_idx=None):
         tp = get_tensor_model_parallel_world_size()
         ffn_local = divide(self.ffn_hidden_size, tp)
         init = nn.initializers.lecun_normal(batch_axis=(0,))
         swiglu = self.activation == "swiglu"
         if not swiglu and self.activation != "gelu":
             raise ValueError(f"unknown activation {self.activation!r}")
+        ragged = group_sizes is not None
+        if not swiglu and ragged and expert_idx is None:
+            raise ValueError("ragged gelu experts need expert_idx for "
+                             "per-row bias gathers")
 
         def shard_init(key, shape, dtype):
             return init(_expert_rank_key(key), shape, dtype)
@@ -145,26 +158,59 @@ class ExpertMLP(nn.Module):
         # Column-parallel in, row-parallel out (identity/psum vjp pairing).
         x = copy_to_tensor_model_parallel_region(x)
         x = x.astype(self.compute_dtype)
-        h1 = jnp.einsum("ech,ehf->ecf", x, w1.astype(self.compute_dtype),
-                        preferred_element_type=jnp.float32)
+        if ragged:
+            h1 = lax.ragged_dot(x, w1.astype(self.compute_dtype),
+                                group_sizes,
+                                preferred_element_type=jnp.float32)
+        else:
+            h1 = jnp.einsum("ech,ehf->ecf", x, w1.astype(self.compute_dtype),
+                            preferred_element_type=jnp.float32)
         if swiglu:
             gate, up = jnp.split(h1, 2, axis=-1)
             a = (jax.nn.silu(gate) * up).astype(self.compute_dtype)
         else:
-            h1 = h1 + b1[:, None, :].astype(jnp.float32)
+            bias1 = (b1[expert_idx] if ragged else b1[:, None, :])
+            h1 = h1 + bias1.astype(jnp.float32)
             a = jax.nn.gelu(h1).astype(self.compute_dtype)
-        y = jnp.einsum("ecf,efh->ech", a, w2.astype(self.compute_dtype),
-                       preferred_element_type=jnp.float32)
+        if ragged:
+            y = lax.ragged_dot(a, w2.astype(self.compute_dtype),
+                               group_sizes,
+                               preferred_element_type=jnp.float32)
+        else:
+            y = jnp.einsum("ecf,efh->ech", a, w2.astype(self.compute_dtype),
+                           preferred_element_type=jnp.float32)
         y = reduce_from_tensor_model_parallel_region(y)
         if swiglu:
             return y
-        return y + b2[:, None, :].astype(jnp.float32)
+        bias2 = (b2[expert_idx] if ragged else b2[:, None, :])
+        return y + bias2.astype(jnp.float32)
 
 
 class SwitchMLP(nn.Module):
     """Drop-in MoE replacement for ParallelMLP (Megatron names this
     SwitchMLP). Sows 'aux_loss'/'z_loss' into the 'moe_losses' collection;
-    apply with ``mutable=["moe_losses"]`` to collect them."""
+    apply with ``mutable=["moe_losses"]`` to collect them.
+
+    ``dispatch_mode`` picks the dispatch/combine algorithm:
+
+    - "einsum": dense [T, E, C] one-hot einsums. O(T*E*C) — quadratic in
+      T once C ~ T (the dropless capacity serving converted checkpoints
+      uses). Kept as the reference formulation and ep-compatible.
+    - "scatter": sort assignments by expert, invert the slot map with an
+      int scatter, dispatch/combine as gathers + one scatter-add.
+      O(T log T + T*E) routing + O(T*h) data movement; same [E, C, h]
+      slot layout, so expert parallelism (all_to_all) and capacity-drop
+      semantics are unchanged — drop decisions are bit-identical to
+      "einsum" (see compute_routing_sorted).
+    - "ragged": no capacity slots at all — tokens sorted by expert feed
+      ``lax.ragged_dot`` grouped matmuls ([k*T, h] rows, zero padding).
+      Truly dropless and the fastest serving path; ep must be 1 (the
+      all_to_all needs static per-rank splits).
+    - "auto" (default): "scatter" when ep > 1 or when the capacity can
+      actually drop tokens (capacity < T — preserving drop semantics),
+      else "ragged". expert_choice routing always uses its dense path
+      (C is small by design there).
+    """
 
     hidden_size: int
     ffn_hidden_size: int
@@ -180,10 +226,31 @@ class SwitchMLP(nn.Module):
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     sequence_parallel_enabled: bool = False
+    dispatch_mode: str = "auto"  # auto | einsum | scatter | ragged
     # Warn (once per process) when aux losses are silently dropped because
     # the caller didn't pass mutable=["moe_losses"]; set False for
     # inference/eval modules where dropping them is intended.
     warn_on_dropped_losses: bool = True
+
+    def _resolve_dispatch(self, ep: int, capacity: int, num_tokens: int):
+        mode = self.dispatch_mode
+        if mode not in ("auto", "einsum", "scatter", "ragged"):
+            raise ValueError(f"unknown dispatch_mode {mode!r}")
+        if self.router_type != "top_k":
+            if mode in ("scatter", "ragged"):
+                raise ValueError(
+                    f"dispatch_mode {mode!r} requires the top_k router; "
+                    "expert_choice routing has only its dense path")
+            return "einsum"
+        if mode == "auto":
+            if ep > 1 or capacity < num_tokens:
+                return "scatter"
+            return "ragged"
+        if mode == "ragged" and ep > 1:
+            raise ValueError(
+                "ragged dispatch has no static per-rank slot layout for "
+                "the expert-parallel all_to_all; use 'scatter' with ep > 1")
+        return mode
 
     @nn.compact
     def __call__(self, hidden_states):
@@ -203,11 +270,17 @@ class SwitchMLP(nn.Module):
         orig_shape = hidden_states.shape  # [s, b, h]
         tokens = hidden_states.reshape(-1, orig_shape[-1])
 
+        num_tokens = tokens.shape[0]
+        capacity = expert_capacity(num_tokens, self.num_experts, self.top_k,
+                                   self.capacity_factor)
+        mode = self._resolve_dispatch(ep, capacity, num_tokens)
         routing = TopKRouter(
             num_experts=self.num_experts, top_k=self.top_k,
             capacity_factor=self.capacity_factor, jitter_eps=self.jitter_eps,
             router_type=self.router_type,
             normalize_topk=self.normalize_topk,
+            routing_format={"einsum": "dense", "scatter": "sorted",
+                            "ragged": "sorted_dropless"}[mode],
             params_dtype=self.params_dtype, name="router")(tokens)
         sown = self.sow("moe_losses", "aux_loss", routing.aux_loss)
         self.sow("moe_losses", "z_loss", routing.z_loss)
@@ -221,42 +294,85 @@ class SwitchMLP(nn.Module):
             # with zero load-balancing pressure and collapse the router.
             _warn_dropped_losses_once()
 
-        # Dispatch: [T, h] x [T, E, C] -> [E, C, h]
-        expert_in = jnp.einsum(
-            "th,tec->ech", tokens.astype(self.compute_dtype),
-            routing.dispatch_mask.astype(self.compute_dtype))
-        if ep > 1:
-            # [E, C, h] -> [E/ep, ep*C, h]: local expert shards gain every
-            # ep rank's capacity slots (rank r's block at offset r*C).
-            # Tiled form: the non-tiled reshape/all_to_all/reshape chain
-            # trips a JAX transpose bug when two all_to_alls are chained
-            # through reshapes (wrong cotangent shape at lowering).
-            expert_in = lax.all_to_all(expert_in, EXPERT_PARALLEL_AXIS,
-                                       split_axis=0, concat_axis=1,
-                                       tiled=True)
-
-        expert_out = ExpertMLP(
+        experts = ExpertMLP(
             hidden_size=self.hidden_size,
             ffn_hidden_size=self.ffn_hidden_size,
             num_local_experts=n_local, activation=self.activation,
             params_dtype=self.params_dtype,
-            compute_dtype=self.compute_dtype, name="experts")(expert_in)
-        # compute_dtype over the wire: the return all_to_all otherwise
-        # ships fp32 (2x the dispatch path's ICI bytes).
-        expert_out = expert_out.astype(self.compute_dtype)
+            compute_dtype=self.compute_dtype, name="experts")
+        x = tokens.astype(self.compute_dtype)
+        hidden = orig_shape[-1]
 
-        if ep > 1:
-            # [E/ep, ep*C, h] -> [E, C, h]: return each rank's slots.
-            expert_out = lax.all_to_all(expert_out, EXPERT_PARALLEL_AXIS,
-                                        split_axis=1, concat_axis=0,
-                                        tiled=True)
+        if mode == "ragged":
+            # Zero-padding dropless path: gather rows into expert-sorted
+            # order (grad = scatter-add, the gather's XLA transpose), run
+            # the grouped matmuls, weight by gate, scatter-add back.
+            sorted_x = x[routing.token_idx]  # [N, h]
+            y = experts(sorted_x, group_sizes=routing.counts,
+                        expert_idx=routing.expert_idx)
+            contrib = y.astype(jnp.float32) * routing.gate[:, None]
+            out = jnp.zeros((num_tokens, hidden), jnp.float32)
+            out = out.at[routing.token_idx].add(contrib)
+        elif mode == "scatter":
+            EC = self.num_experts * capacity
+            # Invert slot -> source token with an int scatter (N int32
+            # elements, not N*h floats), then dispatch is one gather.
+            # Dropped assignments hit the sentinel row EC (discarded);
+            # empty slots read the zero row appended at token index T.
+            inv = jnp.full((EC + 1,), num_tokens, jnp.int32)
+            inv = inv.at[routing.slot].set(routing.token_idx)
+            x_pad = jnp.concatenate(
+                [x, jnp.zeros((1, hidden), x.dtype)], axis=0)
+            expert_in = x_pad[inv[:EC]].reshape(
+                self.num_experts, capacity, hidden)
+            if ep > 1:
+                # [E, C, h] -> [E/ep, ep*C, h] (tiled: see einsum branch).
+                expert_in = lax.all_to_all(expert_in, EXPERT_PARALLEL_AXIS,
+                                           split_axis=0, concat_axis=1,
+                                           tiled=True)
+            expert_out = experts(expert_in).astype(self.compute_dtype)
+            if ep > 1:
+                expert_out = lax.all_to_all(expert_out, EXPERT_PARALLEL_AXIS,
+                                            split_axis=1, concat_axis=0,
+                                            tiled=True)
+            flat = expert_out.reshape(EC, hidden)
+            # Dropped rows gather garbage through the clamped index but
+            # carry gate 0, so they contribute (and backprop) nothing.
+            safe = jnp.minimum(routing.slot, EC - 1)
+            contrib = flat[safe].astype(jnp.float32) * routing.gate[:, None]
+            out = jnp.zeros((num_tokens, hidden), jnp.float32)
+            out = out.at[routing.token_idx].add(contrib)
+        else:  # einsum
+            # Dispatch: [T, h] x [T, E, C] -> [E, C, h]
+            expert_in = jnp.einsum(
+                "th,tec->ech", x,
+                routing.dispatch_mask.astype(self.compute_dtype))
+            if ep > 1:
+                # [E, C, h] -> [E/ep, ep*C, h]: local expert shards gain
+                # every ep rank's capacity slots (rank r's block at offset
+                # r*C). Tiled form: the non-tiled reshape/all_to_all/
+                # reshape chain trips a JAX transpose bug when two
+                # all_to_alls are chained through reshapes (wrong
+                # cotangent shape at lowering).
+                expert_in = lax.all_to_all(expert_in, EXPERT_PARALLEL_AXIS,
+                                           split_axis=0, concat_axis=1,
+                                           tiled=True)
+            # compute_dtype over the wire: the return all_to_all otherwise
+            # ships fp32 (2x the dispatch path's ICI bytes).
+            expert_out = experts(expert_in).astype(self.compute_dtype)
+            if ep > 1:
+                # [E/ep, ep*C, h] -> [E, C, h]: return each rank's slots.
+                expert_out = lax.all_to_all(expert_out, EXPERT_PARALLEL_AXIS,
+                                            split_axis=1, concat_axis=0,
+                                            tiled=True)
+            # Combine: [E, C, h] x [T, E, C] -> [T, h]; bf16 operands on
+            # the MXU (gates are probabilities — bf16 rounding is on par
+            # with the activations), fp32 accumulation.
+            out = jnp.einsum("ech,tec->th", expert_out,
+                             routing.combine_weights.astype(
+                                 self.compute_dtype),
+                             preferred_element_type=jnp.float32)
 
-        # Combine: [E, C, h] x [T, E, C] -> [T, h]; bf16 operands on the
-        # MXU (gates are probabilities — bf16 rounding is on par with the
-        # activations), fp32 accumulation.
-        out = jnp.einsum("ech,tec->th", expert_out,
-                         routing.combine_weights.astype(self.compute_dtype),
-                         preferred_element_type=jnp.float32)
         out = out.reshape(orig_shape).astype(self.compute_dtype)
         if self.sequence_parallel_enabled:
             out = scatter_to_sequence_parallel_region(out)

@@ -41,6 +41,17 @@ def expert_capacity(num_tokens: int, num_experts: int, top_k: int,
     return min(rounded, cap)
 
 
+def _router_losses(logits, probs, expert_fractions):
+    """Shared Switch aux loss + ST-MoE z-loss. ``expert_fractions`` [E]
+    is the PRE-DROP fraction of routed assignments per expert — both the
+    dense and sorted formulations must feed the same quantity, or the
+    dispatch-mode parity contract (test_moe_dispatch.py) breaks."""
+    E = logits.shape[-1]
+    aux_loss = E * jnp.sum(expert_fractions * probs.mean(axis=0))
+    z = jax.scipy.special.logsumexp(logits, axis=-1)
+    return aux_loss, jnp.mean(z * z)
+
+
 def compute_routing(logits, top_k: int, capacity: int,
                     normalize_topk: bool = True) -> RoutingResult:
     """Route tokens from fp32 router ``logits`` [T, E].
@@ -83,12 +94,92 @@ def compute_routing(logits, top_k: int, capacity: int,
     # Load-balancing aux loss: E * sum_e f_e * P_e with f_e the fraction of
     # routed (pre-drop) assignments and P_e the mean router probability.
     f = sum(choice_masks).sum(axis=0) / (top_k * T)  # [E]
-    p = probs.mean(axis=0)
-    aux_loss = E * jnp.sum(f * p)
-    z = jax.scipy.special.logsumexp(logits, axis=-1)
-    z_loss = jnp.mean(z * z)
+    aux_loss, z_loss = _router_losses(logits, probs, f)
     dropped = 1.0 - jnp.sum(dispatch) / (top_k * T)
     return RoutingResult(dispatch, combine, aux_loss, z_loss, probs,
+                         lax.stop_gradient(dropped))
+
+
+@dataclasses.dataclass
+class SortedRouting:
+    """Sorted token->expert assignments for T tokens, E experts, k choices.
+
+    N = k*T assignment rows, ordered by expert id (stable within an
+    expert: choice rank major, then token order — exactly the slot-fill
+    order of ``compute_routing``'s cumsum, so capacity drops are
+    bit-identical between the dense and sorted formulations). This is
+    the O(T log T + T E) routing representation: no [T, E, C] one-hot
+    tensors anywhere, so dispatch/combine cost scales linearly in T
+    instead of quadratically (the dropless C ~ T regime that serves
+    converted Mixtral/DeepSeek checkpoints at real sequence lengths).
+    """
+
+    token_idx: jnp.ndarray   # [N] int32 — source token of assignment i
+    expert_idx: jnp.ndarray  # [N] int32 — expert of assignment i (ascending)
+    gate: jnp.ndarray        # [N] fp32 — combine weight (0 for dropped rows)
+    counts: jnp.ndarray      # [E] int32 — pre-drop assignments per expert
+    slot: jnp.ndarray        # [N] int32 in [0, E*C]; E*C = dropped sentinel
+                             # (None when capacity is None: dropless)
+    aux_loss: jnp.ndarray    # scalar load-balancing loss (same formula as
+                             # compute_routing — counts are pre-drop)
+    z_loss: jnp.ndarray      # scalar router z-loss
+    probs: jnp.ndarray       # [T, E] softmax router probabilities
+    dropped_fraction: jnp.ndarray = None
+
+
+def compute_routing_sorted(logits, top_k: int, capacity: Optional[int],
+                           normalize_topk: bool = True) -> SortedRouting:
+    """Sort-based routing from fp32 ``logits`` [T, E].
+
+    ``capacity=None`` is truly dropless (every assignment kept, no slot
+    layout — feed ``ExpertMLP`` via ragged grouping). With a capacity,
+    assignments beyond C per expert get zero gate and the E*C slot
+    sentinel; the kept set matches ``compute_routing`` exactly because
+    the pre-sort order (choice rank major, token minor) reproduces its
+    "earlier choices claim slots first" cumsum discipline.
+    """
+    logits = logits.astype(jnp.float32)
+    T, E = logits.shape
+    N = top_k * T
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # lax.top_k returns descending values, ties broken toward the lower
+    # index — the same choice sequence as compute_routing's iterative
+    # argmax-and-mask.
+    topv, topi = lax.top_k(probs, top_k)  # [T, k], [T, k]
+    gates = topv
+    if normalize_topk and top_k > 1:
+        gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+    # Choice-rank-major flatten, then a stable sort by expert: within an
+    # expert, rows appear in (rank, token) order — compute_routing's fill
+    # order — so "first C rows win" is the identical drop rule.
+    flat_e = topi.T.reshape(N)
+    flat_t = jnp.tile(jnp.arange(T, dtype=jnp.int32), top_k)
+    flat_g = gates.T.reshape(N)
+    order = jnp.argsort(flat_e, stable=True)
+    expert_sorted = flat_e[order].astype(jnp.int32)
+    token_sorted = flat_t[order]
+    gate_sorted = flat_g[order]
+
+    counts = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)  # pre-drop
+    group_start = jnp.cumsum(counts) - counts  # exclusive prefix
+    pos_in_expert = jnp.arange(N, dtype=jnp.int32) - group_start[expert_sorted]
+
+    if capacity is None:
+        slot = None
+        dropped = jnp.zeros((), jnp.float32)
+    else:
+        kept = pos_in_expert < capacity
+        slot = jnp.where(kept, expert_sorted * capacity + pos_in_expert,
+                         E * capacity).astype(jnp.int32)
+        gate_sorted = jnp.where(kept, gate_sorted, 0.0)
+        dropped = 1.0 - jnp.sum(kept) / N
+
+    f = counts.astype(jnp.float32) / N  # pre-drop fraction, as compute_routing
+    aux_loss, z_loss = _router_losses(logits, probs, f)
+    return SortedRouting(token_sorted, expert_sorted, gate_sorted, counts,
+                         slot, aux_loss, z_loss, probs,
                          lax.stop_gradient(dropped))
 
 
@@ -157,6 +248,10 @@ class TopKRouter(nn.Module):
     router_type: str = "top_k"
     params_dtype: Any = jnp.float32
     capacity: Optional[int] = None  # override for tests
+    # "dense" -> RoutingResult ([T,E,C] one-hots for the einsum path);
+    # "sorted" -> SortedRouting with capacity slots (scatter dispatch);
+    # "sorted_dropless" -> SortedRouting, capacity=None (ragged dispatch).
+    routing_format: str = "dense"
 
     @nn.compact
     def __call__(self, tokens) -> RoutingResult:
@@ -188,4 +283,13 @@ class TopKRouter(nn.Module):
         if self.router_type != "top_k":
             raise ValueError(f"unknown router_type {self.router_type!r}; "
                              "expected 'top_k' or 'expert_choice'")
+        if self.routing_format == "sorted":
+            return compute_routing_sorted(logits, self.top_k, cap,
+                                          self.normalize_topk)
+        if self.routing_format == "sorted_dropless":
+            return compute_routing_sorted(logits, self.top_k, None,
+                                          self.normalize_topk)
+        if self.routing_format != "dense":
+            raise ValueError(
+                f"unknown routing_format {self.routing_format!r}")
         return compute_routing(logits, self.top_k, cap, self.normalize_topk)

@@ -53,6 +53,39 @@ def test_latest_step_and_explicit_step(tmp_path):
         np.zeros(2))
 
 
+def test_orphaned_old_dir_recovered_on_save(tmp_path):
+    """A crash between _write_state's two renames leaves the step only
+    as step_N.old-<pid>; the next save must rename it back so restore
+    doesn't silently resume from an older step (ADVICE r4)."""
+    import os
+
+    d = str(tmp_path / "ckpt")
+    checkpoint.save(d, 3, use_orbax=False, x=jnp.ones(2) * 3)
+    checkpoint.save(d, 7, use_orbax=False, x=jnp.ones(2) * 7)
+    # simulate the crash window: step 7 parked, canonical dir gone
+    step7 = os.path.join(d, "step_0000000007")
+    os.rename(step7, step7 + ".old-12345")
+    assert checkpoint.latest_step(d) == 3  # the failure mode
+    # the resume flow itself repairs: restore(step=None) must come back
+    # with step 7, not silently fall back to 3
+    np.testing.assert_array_equal(
+        np.asarray(checkpoint.restore(d, use_orbax=False)["x"]),
+        np.full(2, 7.0))
+    assert checkpoint.latest_step(d) == 7
+    # explicit repair helper is idempotent
+    assert checkpoint.repair_orphaned_steps(d) == []
+    # save() runs the repair itself: park step 7 again, save step 9
+    os.rename(step7, step7 + ".old-12345")
+    checkpoint.save(d, 9, use_orbax=False, x=jnp.ones(2) * 9)
+    assert checkpoint.latest_step(d) == 9
+    assert os.path.isdir(step7)  # recovered by save's repair pass
+    # a parked copy whose canonical dir EXISTS stays parked (the landed
+    # checkpoint is newer)
+    os.makedirs(step7 + ".old-999")
+    checkpoint.save(d, 11, use_orbax=False, x=jnp.ones(2))
+    assert os.path.isdir(step7 + ".old-999") and os.path.isdir(step7)
+
+
 @pytest.mark.parametrize("use_orbax", [False, True])
 def test_training_state_resume_continues_identically(tmp_path, rng,
                                                      use_orbax):

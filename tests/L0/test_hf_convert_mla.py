@@ -212,3 +212,75 @@ def test_logits_match_hf_deepseek_moe():
                                     jnp.asarray(tokens))
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4,
                                atol=3e-4)
+
+
+def test_deepseek_norm_topk_prob_refused():
+    """A checkpoint trained with gate normalization (the original
+    remote-code semantics) must not silently convert to raw softmax
+    mass (ADVICE r4)."""
+    from tools.convert_hf_deepseek import convert_deepseek
+
+    cfg = transformers.DeepseekV2Config(
+        vocab_size=32, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, q_lora_rank=8, kv_lora_rank=8,
+        qk_rope_head_dim=4, qk_nope_head_dim=8, v_head_dim=8,
+        n_routed_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=16, first_k_dense_replace=1,
+        topk_method="greedy", norm_topk_prob=True)
+    with pytest.raises(ValueError, match="norm_topk_prob"):
+        convert_deepseek({}, cfg)
+
+
+def test_deepseek_moe_tp2_logits_match_tp1():
+    """MoE DeepSeek under tensor parallelism: router replicated, expert
+    w1 split as packed [gate | up] halves, expert w2 row-split, shared
+    expert's gate_up split at its own (n_shared * moe_intermediate)
+    midpoint — logits match the tp=1 run (ADVICE r4: these leaves
+    previously failed the tp split)."""
+    import functools
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tools.convert_hf_deepseek import convert_deepseek
+
+    from apex_tpu.models.mla import DeepseekModel
+    from apex_tpu.models.tp_split import split_mla_params_for_tp
+    from apex_tpu.transformer import parallel_state
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    _fresh()
+    cfg_hf = transformers.DeepseekV2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, q_lora_rank=16, kv_lora_rank=8,
+        qk_rope_head_dim=4, qk_nope_head_dim=8, v_head_dim=8,
+        n_routed_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=24, n_shared_experts=2,
+        first_k_dense_replace=1, moe_layer_freq=1,
+        routed_scaling_factor=1.0, norm_topk_prob=False,
+        topk_method="greedy", max_position_embeddings=32,
+        attention_dropout=0.0)
+    torch.manual_seed(7)
+    hf = transformers.DeepseekV2ForCausalLM(cfg_hf).eval()
+    cfg, params = convert_deepseek(hf.state_dict(), cfg_hf)
+    tokens = jnp.asarray(np.random.RandomState(7).randint(0, 96, (2, 8)))
+    ref = DeepseekModel(cfg).apply({"params": params}, tokens)
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, devices=jax.devices()[:2])
+    stacked = split_mla_params_for_tp(cfg, params, 2)
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P("tp"), P()), out_specs=P("tp"),
+                       check_vma=False)
+    def run(sp, toks):
+        p = jax.tree_util.tree_map(lambda a: a[0], sp)
+        return DeepseekModel(cfg).apply({"params": p}, toks)[None]
+
+    out = run(stacked, tokens)  # [tp, b, s, vocab/tp]
+    full = jnp.concatenate([out[0], out[1]], axis=-1)
+    parallel_state.destroy_model_parallel()
+    np.testing.assert_allclose(np.asarray(full), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

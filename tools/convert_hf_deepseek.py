@@ -34,6 +34,16 @@ def convert_deepseek(state_dict, hf_config):
         raise ValueError(
             "only the greedy gate (deepseek-v2-lite lineage) is mapped; "
             "group_limited_greedy routing is not represented")
+    if has_moe and getattr(hf_config, "norm_topk_prob", False):
+        # transformers' DeepseekV2MoEGate ignores this flag, but the
+        # original remote-code gate normalizes the selected gates —
+        # converting such a checkpoint with raw softmax mass would
+        # silently diverge from the weights' training-time semantics.
+        raise ValueError(
+            "norm_topk_prob=true checkpoints are refused: the HF oracle "
+            "this converter reproduces never normalizes top-k gates, so "
+            "parity would mask a real semantic mismatch (set the flag "
+            "false only if the checkpoint was trained that way)")
     if hf_config.hidden_act != "silu":
         raise ValueError(f"expected silu, got {hf_config.hidden_act!r}")
     if getattr(hf_config, "rope_scaling", None):
@@ -66,10 +76,11 @@ def convert_deepseek(state_dict, hf_config):
         moe_top_k=(hf_config.num_experts_per_tok if has_moe else 2),
         routed_scaling_factor=float(
             getattr(hf_config, "routed_scaling_factor", 1.0)),
-        # ALWAYS False: the HF reference implementation stores
-        # norm_topk_prob but never applies it (verified against
-        # transformers 4.57.6 DeepseekV2MoEGate), so raw softmax mass is
-        # what reproduces HF logits regardless of the config flag
+        # False reproduces the transformers implementation this converter
+        # is oracled against (4.57.6 DeepseekV2MoEGate stores
+        # norm_topk_prob but never applies it). The original DeepSeek
+        # remote-code gate DOES apply it — a checkpoint that sets it is
+        # refused below rather than silently misconverted.
         norm_topk_prob=False,
         first_k_dense_replace=moe_from if has_moe else 0,
         compute_dtype=jnp.float32)
