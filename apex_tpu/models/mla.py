@@ -231,21 +231,38 @@ class MLAAttention(nn.Module):
             q_lat = jnp.einsum("sbnd,lnd->sbnl", q_nope.astype(
                 cfg.compute_dtype), w_nope,
                 preferred_element_type=jnp.float32).astype(cfg.compute_dtype)
-            scale = jnp.asarray(cfg.qk_head_dim ** -0.5, jnp.float32)
-            scores = (jnp.einsum("sbnl,tbl->bnst", q_lat, c_lat,
-                                 preferred_element_type=jnp.float32)
-                      + jnp.einsum("sbnd,tbd->bnst",
-                                   q_pe.astype(cfg.compute_dtype), c_pe,
-                                   preferred_element_type=jnp.float32)) * scale
-            jpos = jnp.arange(max_len)[None, :]
-            ipos = pos + jnp.arange(s)[:, None]
-            scores = jnp.where(jpos > ipos, -1e9, scores)
-            probs = jax.nn.softmax(scores, axis=-1)
-            # weighted latent out, THEN expand through W_v (absorbed)
-            ctx_lat = jnp.einsum("bnst,tbl->sbnl",
-                                 probs.astype(cfg.compute_dtype), c_lat,
-                                 preferred_element_type=jnp.float32).astype(
-                cfg.compute_dtype)
+            scale = float(cfg.qk_head_dim ** -0.5)
+            from apex_tpu.contrib import mla_decode as _mla_decode
+
+            if (mode == "step" and s == 1
+                    and _mla_decode.use_flash(max_len)):
+                # Single-token hot loop: the streaming Pallas kernel —
+                # cache read once for all heads, no [b, n, 1, T] score
+                # round-trip through HBM, dead prefix tiles never
+                # fetched (contrib/mla_decode.py). Gated on use_flash so
+                # every non-kernel configuration runs the einsum path
+                # below, not the kernel module's fp32 fallback.
+                q_full = jnp.concatenate(
+                    [q_lat[0], q_pe[0].astype(cfg.compute_dtype)], -1)
+                ctx_lat = _mla_decode.mla_flash_decode(
+                    q_full, cache.value, pos + 1, lat, scale)[None].astype(
+                    cfg.compute_dtype)
+            else:
+                scores = (jnp.einsum("sbnl,tbl->bnst", q_lat, c_lat,
+                                     preferred_element_type=jnp.float32)
+                          + jnp.einsum("sbnd,tbd->bnst",
+                                       q_pe.astype(cfg.compute_dtype), c_pe,
+                                       preferred_element_type=jnp.float32)
+                          ) * scale
+                jpos = jnp.arange(max_len)[None, :]
+                ipos = pos + jnp.arange(s)[:, None]
+                scores = jnp.where(jpos > ipos, -1e9, scores)
+                probs = jax.nn.softmax(scores, axis=-1)
+                # weighted latent out, THEN expand through W_v (absorbed)
+                ctx_lat = jnp.einsum("bnst,tbl->sbnl",
+                                     probs.astype(cfg.compute_dtype), c_lat,
+                                     preferred_element_type=jnp.float32
+                                     ).astype(cfg.compute_dtype)
             ctx = jnp.einsum("sbnl,lnd->sbnd", ctx_lat, w_v,
                              preferred_element_type=jnp.float32)
             ctx = ctx.reshape(s, b, n_local * vd).astype(cfg.compute_dtype)

@@ -1,0 +1,125 @@
+"""MLA latent-cache flash decode kernel vs the einsum formulation.
+
+The kernel (contrib/mla_decode.py) streams the latent cache through VMEM
+once with an online softmax; these tests run it in interpreter mode on
+the CPU mesh (real kernel dataflow, no TPU needed) and pin it to the
+einsum oracle:
+
+- value parity across prefix lengths spanning tile boundaries, multiple
+  batches, bf16 cache rows;
+- end to end: DeepseekModel cached decode with the kernel forced ON is
+  token-exact vs the einsum decode path AND the full-rerun forward;
+- the fallback ladder (off-TPU -> einsum; indivisible cache -> einsum).
+
+VERDICT r4 item 4; reference analog: apex/contrib/fmha exists purely to
+make attention fast (fmha_api.cpp:363).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib import mla_decode as md
+
+
+@pytest.fixture
+def interpret_kernel():
+    md.force_interpret(True)
+    yield
+    md.force_interpret(False)
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("length", [1, 7, 16, 33, 48])
+    def test_matches_reference_across_tile_boundaries(self, length,
+                                                      interpret_kernel):
+        rng = np.random.RandomState(0)
+        b, n, lat, rope, T = 2, 8, 32, 8, 48
+        L = lat + rope
+        q = jnp.asarray(rng.randn(b, n, L), jnp.float32).astype(jnp.bfloat16)
+        cache = jnp.asarray(rng.randn(T, b, L),
+                            jnp.float32).astype(jnp.bfloat16)
+        ref = md.mla_decode_reference(q, cache, jnp.int32(length), lat, 0.3)
+        out = md.mla_flash_decode(q, cache, jnp.int32(length), lat, 0.3,
+                                  block_t=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6)
+
+    def test_dead_tiles_do_not_change_result(self, interpret_kernel):
+        """Rows beyond ``length`` must be invisible even when filled with
+        huge values (the mask, not luck, protects the softmax)."""
+        rng = np.random.RandomState(1)
+        b, n, lat, T = 1, 4, 16, 32
+        L = lat + 4
+        q = jnp.asarray(rng.randn(b, n, L), jnp.float32)
+        live = rng.randn(T, b, L).astype(np.float32)
+        poisoned = live.copy()
+        poisoned[10:] = 1e4  # length = 10 -> all poisoned rows are dead
+        o1 = md.mla_flash_decode(q, jnp.asarray(live), jnp.int32(10), lat,
+                                 0.5, block_t=8)
+        o2 = md.mla_flash_decode(q, jnp.asarray(poisoned), jnp.int32(10),
+                                 lat, 0.5, block_t=8)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   atol=1e-6)
+
+    def test_fallbacks(self):
+        """Off-TPU (no interpret) and indivisible cache lengths take the
+        einsum path — same public entry, same result."""
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(1, 2, 12), jnp.float32)
+        cache = jnp.asarray(rng.randn(10, 1, 12), jnp.float32)  # T=10
+        ref = md.mla_decode_reference(q, cache, jnp.int32(6), 8, 0.4)
+        out = md.mla_flash_decode(q, cache, jnp.int32(6), 8, 0.4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+class TestEndToEnd:
+    def _model(self):
+        from apex_tpu.models.mla import DeepseekModel, MLAConfig
+        from apex_tpu.transformer import parallel_state
+
+        parallel_state.destroy_model_parallel()
+        cfg = MLAConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            q_lora_rank=None, kv_lora_rank=8, qk_nope_head_dim=8,
+            qk_rope_head_dim=4, v_head_dim=8, ffn_hidden_size=64,
+            max_decode_length=32, compute_dtype=jnp.float32)
+        m = DeepseekModel(cfg)
+        tokens = jnp.asarray(
+            np.random.RandomState(3).randint(0, 128, (2, 6)))
+        params = m.init(jax.random.PRNGKey(0), tokens)["params"]
+        return m, params, tokens
+
+    def _greedy_cached(self, m, params, prompt, new_tokens):
+        """prefill + single-token steps through the latent cache."""
+        logits, var = m.apply({"params": params}, prompt, mode="prefill",
+                              mutable=["cache"])
+        seq = prompt
+        for _ in range(new_tokens):
+            nxt = jnp.argmax(logits[:, -1:], -1)
+            seq = jnp.concatenate([seq, nxt], axis=1)
+            logits, var = m.apply(
+                {"params": params, "cache": var["cache"]}, nxt,
+                mode="step", mutable=["cache"])
+        return seq
+
+    def test_cached_decode_token_exact_vs_einsum_path(self,
+                                                      interpret_kernel):
+        m, params, prompt = self._model()
+        with_kernel = self._greedy_cached(m, params, prompt, 6)
+        md.force_interpret(False)  # now the same steps ride the einsum path
+        without = self._greedy_cached(m, params, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(with_kernel),
+                                      np.asarray(without))
+
+    def test_cached_decode_matches_full_rerun(self, interpret_kernel):
+        m, params, prompt = self._model()
+        seq = self._greedy_cached(m, params, prompt, 5)
+        # full-rerun oracle: greedy from scratch each step, no cache
+        full = prompt
+        for _ in range(5):
+            logits = m.apply({"params": params}, full)
+            full = jnp.concatenate(
+                [full, jnp.argmax(logits[:, -1:], -1)], axis=1)
+        np.testing.assert_array_equal(np.asarray(seq), np.asarray(full))

@@ -25,7 +25,7 @@ run() { # run <tag> <cmd...>: log one line per process, keep stderr
 
 if [ "$stage" = all ] || [ "$stage" = benches ]; then
   # driver metric first (resnet default), then the rest
-  bash tools/capture_queue.sh "" gpt2 bert moe moe_serve t5 vit whisper decode llama gpt || exit 1
+  bash tools/capture_queue.sh "" gpt2 bert moe moe_serve mla_decode t5 vit whisper decode llama gpt || exit 1
 fi
 
 if [ "$stage" = all ] || [ "$stage" = sweep ]; then
