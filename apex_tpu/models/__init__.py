@@ -30,6 +30,7 @@ from apex_tpu.models.tp_split import (  # noqa: F401
 from apex_tpu.models.t5 import (  # noqa: F401
     T5Config,
     T5Model,
+    t5_beam_generate,
     t5_cached_generate,
     t5_greedy_generate,
     t5_loss_fn,
@@ -52,6 +53,7 @@ from apex_tpu.models.vit import (  # noqa: F401
 from apex_tpu.models.whisper import (  # noqa: F401
     WhisperConfig,
     WhisperModel,
+    whisper_beam_generate,
     whisper_cached_generate,
     whisper_greedy_generate,
 )

@@ -1,0 +1,159 @@
+"""Beam search over encoder-decoder KV-cache decode (T5, Whisper).
+
+One generic static-shaped beam engine driven by model-specific prefill/
+step closures. The algorithm reproduces HuggingFace generate semantics
+(BeamSearchScorer, early_stopping=False):
+
+- 2k candidates per step from the k running beams, so the running set
+  refills to k even when candidates hit EOS;
+- a candidate ending in EOS leaves the running set and enters a size-k
+  finished-hypothesis pool, scored sum_logprobs / generated_len **
+  length_penalty with generated_len counting the EOS (HF cur_len + 1
+  convention, decoder prompt excluded);
+- a batch row is done once its worst finished score can no longer be
+  beaten by the best running sum at the current length; its state then
+  freezes (HF stops collecting hypotheses at exactly this point);
+- at the end, still-running beams of not-done rows are finalized at
+  generated_len = max_new_tokens and compete with the pool.
+
+Everything is lax-friendly: the loop is a scan over max_new_tokens, the
+pools are fixed [b, k] tensors, and per-beam caches are reordered by a
+batched gather on the cache's batch axis (axis 1 — the [s, b, n, d]
+layout both families' attention caches use; scalar position counters
+pass through untouched). No reference counterpart (apex is
+training-only); the oracle is HF generate(num_beams=k) token output.
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1.0e9
+
+
+def tile_cache_for_beams(cache, num_beams):
+    """[.., b, ..] -> [.., b*k, ..] along the cache batch axis (axis 1);
+    scalars (position counters) pass through."""
+    return jax.tree_util.tree_map(
+        lambda x: x if x.ndim == 0 else jnp.repeat(x, num_beams, axis=1),
+        cache)
+
+
+def _reorder_cache(cache, gather):
+    return jax.tree_util.tree_map(
+        lambda x: x if x.ndim == 0 else jnp.take(x, gather, axis=1),
+        cache)
+
+
+def beam_search_cached(step_fn, cache, first_logits, *, num_beams,
+                       max_new_tokens, eos_token_id, pad_token_id=0,
+                       length_penalty=1.0):
+    """Generic cached-decode beam search.
+
+    step_fn(cache, tok [b*k] int32) -> (full-vocab logits [b*k, v],
+    new cache): one single-token decoder step. ``cache`` must already be
+    tiled to b*k rows (``tile_cache_for_beams``) and prefetched with the
+    decoder start token; ``first_logits`` [b, v] are the start token's
+    full-vocab logits from that prefill.
+
+    Returns (tokens [b, max_new_tokens] — EOS then pad on finished rows,
+    HF layout — and [b] final scores).
+    """
+    k = num_beams
+    b, vocab = first_logits.shape
+    N = max_new_tokens
+    no_eos = eos_token_id is None
+    eos = 0 if no_eos else eos_token_id
+
+    def update(i, logits_bkv, state):
+        (cache, run_scores, run_seqs, fin_scores, fin_seqs, done) = state
+        lp = jax.nn.log_softmax(logits_bkv.astype(jnp.float32))
+        total = lp + run_scores[:, :, None]
+        cand_scores, cand_flat = jax.lax.top_k(
+            total.reshape(b, k * vocab), 2 * k)
+        cand_beam = cand_flat // vocab                      # [b, 2k]
+        cand_tok = cand_flat % vocab
+        cand_seqs = jnp.take_along_axis(run_seqs, cand_beam[:, :, None],
+                                        axis=1)             # [b, 2k, N]
+        cand_seqs = cand_seqs.at[:, :, i].set(cand_tok)
+        finished_now = (jnp.zeros_like(cand_tok, bool) if no_eos
+                        else cand_tok == eos)
+
+        # running set: EOS candidates drop out, best k survivors refill
+        live = jnp.where(finished_now, NEG_INF, cand_scores)
+        new_run_scores, sel = jax.lax.top_k(live, k)
+        new_tok = jnp.take_along_axis(cand_tok, sel, axis=1)
+        new_run_seqs = jnp.take_along_axis(cand_seqs, sel[:, :, None],
+                                           axis=1)
+        src_beam = jnp.take_along_axis(cand_beam, sel, axis=1)
+
+        # finished pool: HF normalizes by the generated length INCLUDING
+        # the EOS (cur_len + 1 - decoder_prompt_len = i + 1); i may be a
+        # scan tracer, so the power stays in jnp
+        gen_len = (jnp.asarray(i, jnp.float32) + 1.0) ** length_penalty
+        norm = cand_scores / gen_len
+        norm = jnp.where(finished_now, norm, NEG_INF)
+        pool_scores = jnp.concatenate([fin_scores, norm], axis=1)
+        pool_seqs = jnp.concatenate([fin_seqs, cand_seqs], axis=1)
+        new_fin_scores, fsel = jax.lax.top_k(pool_scores, k)
+        new_fin_seqs = jnp.take_along_axis(pool_seqs, fsel[:, :, None],
+                                           axis=1)
+
+        # HF is_done (early_stopping=False): k hypotheses exist AND the
+        # best running sum can no longer beat the worst of them at the
+        # current generated length
+        worst_fin = new_fin_scores[:, -1]   # NEG_INF while pool not full
+        best_possible = new_run_scores[:, 0] / gen_len
+        now_done = done | (worst_fin >= best_possible)
+
+        # freeze rows that were already done BEFORE this step (HF stops
+        # adding hypotheses the moment is_done fires)
+        frz = done[:, None]
+        new_run_scores = jnp.where(frz, run_scores, new_run_scores)
+        new_run_seqs = jnp.where(frz[:, :, None], run_seqs, new_run_seqs)
+        new_fin_scores = jnp.where(frz, fin_scores, new_fin_scores)
+        new_fin_seqs = jnp.where(frz[:, :, None], fin_seqs, new_fin_seqs)
+        now_done = jnp.where(done, done, now_done)
+        new_tok = jnp.where(frz, pad_token_id, new_tok)
+        src_beam = jnp.where(frz, jnp.arange(k)[None, :], src_beam)
+
+        gather = (jnp.arange(b)[:, None] * k + src_beam).reshape(b * k)
+        cache = _reorder_cache(cache, gather)
+        state = (cache, new_run_scores, new_run_seqs, new_fin_scores,
+                 new_fin_seqs, now_done)
+        return state, new_tok.reshape(b * k)
+
+    # step 0: every tiled beam is identical, so score only beam 0 and
+    # let the generic update spread the top-k picks across beams
+    run_scores0 = jnp.full((b, k), NEG_INF).at[:, 0].set(0.0)
+    state = (cache, run_scores0,
+             jnp.full((b, k, N), pad_token_id, jnp.int32),
+             jnp.full((b, k), NEG_INF),
+             jnp.full((b, k, N), pad_token_id, jnp.int32),
+             jnp.zeros((b,), bool))
+    logits0 = jnp.broadcast_to(first_logits[:, None, :], (b, k, vocab))
+    state, tok = update(0, logits0, state)
+
+    def scan_step(carry, i):
+        state, tok = carry
+        logits, new_cache = step_fn(state[0], tok)
+        state = (new_cache,) + state[1:]
+        state, tok = update(i, logits.reshape(b, k, vocab), state)
+        return (state, tok), None
+
+    if N > 1:
+        (state, _), _ = jax.lax.scan(scan_step, (state, tok),
+                                     jnp.arange(1, N))
+    (_, run_scores, run_seqs, fin_scores, fin_seqs, done) = state
+
+    # finalize: not-done rows contribute their running beams at
+    # generated_len = N (HF finalize semantics)
+    final_norm = run_scores / float(N ** length_penalty)
+    final_norm = jnp.where(done[:, None], NEG_INF, final_norm)
+    pool_scores = jnp.concatenate([fin_scores, final_norm], axis=1)
+    pool_seqs = jnp.concatenate([fin_seqs, run_seqs], axis=1)
+    best = jnp.argmax(pool_scores, axis=1)                   # [b]
+    best_seqs = jnp.take_along_axis(
+        pool_seqs, best[:, None, None], axis=1)[:, 0]        # [b, N]
+    best_scores = jnp.take_along_axis(pool_scores, best[:, None],
+                                      axis=1)[:, 0]
+    return best_seqs, best_scores

@@ -589,6 +589,69 @@ def _t5_run_decode(model, params, enc_tokens, mask, start,
     return jnp.concatenate([start, first[:, None], toks.T], axis=1)
 
 
+@functools.lru_cache(maxsize=16)
+def _t5_compiled_beam(model, max_new_tokens, num_beams, has_mask,
+                      eos_token_id, pad_token_id, length_penalty):
+    """jitted encode-side beam search for :func:`t5_beam_generate`
+    (same caching discipline as ``_t5_compiled_decode``)."""
+    from apex_tpu.models.encdec_beam import (
+        beam_search_cached,
+        tile_cache_for_beams,
+    )
+    from apex_tpu.transformer.tensor_parallel import (
+        gather_from_tensor_model_parallel_region,
+    )
+
+    @jax.jit
+    def run(params, start, memory, enc_mask):
+        logits, mut = model.apply(
+            {"params": params}, start, memory,
+            enc_mask if has_mask else None,
+            mutable=["cache"], method=T5Model.decode_prefill)
+        first = gather_from_tensor_model_parallel_region(logits[:, -1, :])
+        cache = tile_cache_for_beams(mut["cache"], num_beams)
+        mask_k = (jnp.repeat(enc_mask, num_beams, axis=0) if has_mask
+                  else None)
+
+        def step_fn(cache, tok):
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mask_k, mutable=["cache"], method=T5Model.decode_step)
+            return gather_from_tensor_model_parallel_region(
+                logits[:, -1, :]), mut["cache"]
+
+        return beam_search_cached(
+            step_fn, cache, first, num_beams=num_beams,
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, length_penalty=length_penalty)
+
+    return run
+
+
+def t5_beam_generate(model, params, enc_tokens, max_new_tokens,
+                     num_beams=4, decoder_start_token_id=0, enc_mask=None,
+                     eos_token_id=None, pad_token_id=0,
+                     length_penalty=1.0):
+    """Beam search on the T5 KV-cache decode path (HF generate
+    semantics — see models/encdec_beam.py). Encode once, prefill the
+    start token, tile the caches per beam, then one jitted step per new
+    token with per-beam cache reordering. Returns ([b, 1 + max_new]
+    sequences incl the start column, [b] final scores)."""
+    start = _t5_decode_precheck(model, enc_tokens, max_new_tokens,
+                                decoder_start_token_id)
+    if max_new_tokens == 0:
+        return start, jnp.zeros((enc_tokens.shape[0],), jnp.float32)
+    has_mask = enc_mask is not None
+    run = _t5_compiled_beam(model, max_new_tokens, num_beams, has_mask,
+                            eos_token_id, pad_token_id,
+                            float(length_penalty))
+    memory = model.apply({"params": params}, enc_tokens,
+                         enc_mask if has_mask else None,
+                         method=T5Model.encode)
+    seqs, scores = run(params, start, memory, enc_mask)
+    return jnp.concatenate([start, seqs], axis=1), scores
+
+
 def tensor_parallel_t5_generate(model, stacked_params, enc_tokens,
                                 max_new_tokens, *, mesh=None,
                                 decoder_start_token_id=0, enc_mask=None,

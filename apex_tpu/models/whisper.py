@@ -407,6 +407,68 @@ def _whisper_compiled_decode(model, max_new_tokens):
     return prefill, decode_all
 
 
+@functools.lru_cache(maxsize=16)
+def _whisper_compiled_beam(model, max_new_tokens, num_beams, eos_token_id,
+                           pad_token_id, length_penalty):
+    from apex_tpu.models.encdec_beam import (
+        beam_search_cached,
+        tile_cache_for_beams,
+    )
+    from apex_tpu.transformer.tensor_parallel import (
+        gather_from_tensor_model_parallel_region,
+    )
+
+    @jax.jit
+    def run(params, start, memory):
+        logits, mut = model.apply(
+            {"params": params}, start, memory, mutable=["cache"],
+            method=WhisperModel.decode_prefill)
+        first = gather_from_tensor_model_parallel_region(logits[:, -1, :])
+        cache = tile_cache_for_beams(mut["cache"], num_beams)
+
+        def step_fn(cache, tok):
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"], method=WhisperModel.decode_step)
+            return gather_from_tensor_model_parallel_region(
+                logits[:, -1, :]), mut["cache"]
+
+        return beam_search_cached(
+            step_fn, cache, first, num_beams=num_beams,
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, length_penalty=length_penalty)
+
+    return run
+
+
+def whisper_beam_generate(model, params, input_features, max_new_tokens,
+                          decoder_start_token_id, num_beams=4,
+                          eos_token_id=None, pad_token_id=0,
+                          length_penalty=1.0):
+    """Beam-search transcription on the KV-cache path (HF generate
+    semantics — models/encdec_beam.py): encode once, prefill the start
+    token, tile the caches per beam, one jitted step per new token with
+    per-beam cache reordering (cross K/V tiled once, never
+    re-projected). Returns ([b, 1 + max_new] sequences incl the start
+    column, [b] final scores)."""
+    cfg = model.config
+    if max_new_tokens > cfg.max_target_positions:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_target_positions ({cfg.max_target_positions})")
+    b = input_features.shape[0]
+    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    if max_new_tokens == 0:
+        return start, jnp.zeros((b,), jnp.float32)
+    memory = model.apply({"params": params}, input_features,
+                         method=WhisperModel.encode)
+    run = _whisper_compiled_beam(model, max_new_tokens, num_beams,
+                                 eos_token_id, pad_token_id,
+                                 float(length_penalty))
+    seqs, scores = run(params, start, memory)
+    return jnp.concatenate([start, seqs], axis=1), scores
+
+
 def whisper_greedy_generate(model, params, input_features, max_new_tokens,
                             decoder_start_token_id):
     """Greedy transcription: encode once, full decoder re-run per token

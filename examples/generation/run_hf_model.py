@@ -168,33 +168,52 @@ def main():
         return
 
     if args.family == "whisper":
-        from apex_tpu.models import WhisperModel, whisper_cached_generate
+        from apex_tpu.models import (WhisperModel, whisper_beam_generate,
+                                     whisper_cached_generate)
 
-        if args.tp > 1 or args.beams > 1:
-            raise SystemExit("the whisper path in this example is greedy "
+        if args.tp > 1:
+            raise SystemExit("the whisper path in this example is "
                              "single-program")
         feats = jnp.asarray(np.random.RandomState(0).randn(
             2, cfg.num_mel_bins, 2 * cfg.max_source_positions),
             jnp.float32)
-        out = whisper_cached_generate(
-            WhisperModel(cfg), params, feats,
-            max_new_tokens=min(args.max_new_tokens,
-                               cfg.max_target_positions),
-            decoder_start_token_id=1)
+        new = min(args.max_new_tokens, cfg.max_target_positions)
+        wmodel = WhisperModel(cfg)
+        # token ids come from the HF config — a real checkpoint's eos /
+        # decoder_start differ from the tiny offline config's
+        start_id = hf.config.decoder_start_token_id
+        if args.beams > 1:
+            out, scores = whisper_beam_generate(
+                wmodel, params, feats, new, decoder_start_token_id=start_id,
+                num_beams=args.beams, eos_token_id=hf.config.eos_token_id,
+                pad_token_id=hf.config.pad_token_id or 0)
+            print("beam scores:", np.asarray(scores))
+        else:
+            out = whisper_cached_generate(wmodel, params, feats, new,
+                                          decoder_start_token_id=start_id)
         print("token ids:\n", np.asarray(out))
         return
 
     if args.family == "t5":
-        from apex_tpu.models import T5Model, t5_cached_generate
+        from apex_tpu.models import (T5Model, t5_beam_generate,
+                                     t5_cached_generate)
 
-        if args.tp > 1 or args.beams > 1:
-            raise SystemExit("the t5 path in this example is greedy "
+        if args.tp > 1:
+            raise SystemExit("the t5 path in this example is "
                              "single-program; see tests for the tp2 "
                              "logits oracle")
         enc = jnp.asarray(
             np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)))
-        out = t5_cached_generate(T5Model(cfg), params, enc,
-                                 max_new_tokens=args.max_new_tokens)
+        tmodel = T5Model(cfg)
+        if args.beams > 1:
+            out, scores = t5_beam_generate(
+                tmodel, params, enc, args.max_new_tokens,
+                num_beams=args.beams, eos_token_id=hf.config.eos_token_id,
+                pad_token_id=hf.config.pad_token_id or 0)
+            print("beam scores:", np.asarray(scores))
+        else:
+            out = t5_cached_generate(tmodel, params, enc,
+                                     max_new_tokens=args.max_new_tokens)
         print("token ids:\n", np.asarray(out))
         return
 
