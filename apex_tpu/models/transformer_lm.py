@@ -85,6 +85,13 @@ class TransformerConfig:
     # converted-Mixtral serving shape), scatter otherwise; "einsum" keeps
     # the dense [T,E,C] one-hot formulation (see moe/layer.py SwitchMLP).
     moe_dispatch_mode: str = "auto"
+    # renormalize the selected top-k gates to sum to 1 (Mixtral); False
+    # keeps raw softmax mass (Qwen2-MoE norm_topk_prob=false)
+    moe_normalize_topk: bool = True
+    # Always-on shared expert beside the routed set (Qwen2-MoE block:
+    # out = routed + sigmoid(gate(x)) * shared(x)); None -> none.
+    moe_shared_expert_size: Optional[int] = None
+    moe_shared_expert_gated: bool = True
     # Modern-LLM (Llama-family) knobs — beyond the reference, which is
     # GPT-2/BERT-era: grouped-query attention (fewer K/V head groups),
     # rotary position embeddings, SwiGLU MLPs, RMSNorm blocks.
@@ -692,7 +699,25 @@ class ParallelTransformerLayer(nn.Module):
         # Phi/Falcon-7b: no second norm — both branches read ln1's output
         ln2 = (None if cfg.parallel_residual_shared_ln
                else _make_norm(cfg, "post_attention_layernorm"))
-        if self._is_moe_layer():
+        if self._is_moe_layer() and cfg.moe_shared_expert_size:
+            from apex_tpu.transformer.moe.layer import SharedExpertMoE
+
+            mlp = SharedExpertMoE(
+                hidden_size=cfg.hidden_size,
+                ffn_hidden_size=cfg.ffn_size,
+                shared_expert_size=cfg.moe_shared_expert_size,
+                num_experts=cfg.num_moe_experts, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                jitter_eps=cfg.moe_jitter_eps,
+                router_type=cfg.moe_router_type,
+                normalize_topk=cfg.moe_normalize_topk,
+                dispatch_mode=cfg.moe_dispatch_mode,
+                activation=cfg.activation,
+                shared_expert_gated=cfg.moe_shared_expert_gated,
+                params_dtype=cfg.params_dtype,
+                compute_dtype=cfg.compute_dtype,
+                sequence_parallel_enabled=cfg.sequence_parallel, name="mlp")
+        elif self._is_moe_layer():
             from apex_tpu.transformer.moe import SwitchMLP
 
             mlp = SwitchMLP(
@@ -703,6 +728,7 @@ class ParallelTransformerLayer(nn.Module):
                 jitter_eps=cfg.moe_jitter_eps,
                 router_type=cfg.moe_router_type,
                 dispatch_mode=cfg.moe_dispatch_mode,
+                normalize_topk=cfg.moe_normalize_topk,
                 activation=cfg.activation,
                 params_dtype=cfg.params_dtype,
                 compute_dtype=cfg.compute_dtype,

@@ -12,6 +12,7 @@ expert-parallel dispatch via ``lax.all_to_all`` over the 'ep' mesh axis
 
 from apex_tpu.transformer.moe.layer import (
     ExpertMLP,
+    SharedExpertMoE,
     SwitchMLP,
     is_expert_param,
     moe_loss_from_variables,
@@ -26,6 +27,7 @@ from apex_tpu.transformer.moe.router import (
 
 __all__ = [
     "ExpertMLP",
+    "SharedExpertMoE",
     "SortedRouting",
     "SwitchMLP",
     "TopKRouter",

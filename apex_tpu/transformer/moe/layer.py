@@ -186,6 +186,90 @@ class ExpertMLP(nn.Module):
         return y + bias2.astype(jnp.float32)
 
 
+class SharedExpertMoE(nn.Module):
+    """Routed SwitchMLP plus an always-on shared expert (the Qwen2-MoE
+    block shape): out = routed(x) + sigmoid(gate(x)) * shared(x), the
+    scalar sigmoid gate optional. The shared expert is a dense SwiGLU
+    MLP (column-parallel fused [gate | up], row-parallel down) of its
+    own width — distinct from DeepSeek's ungated shared expert, which
+    lives in models/mla.py. Aux losses sow through the nested SwitchMLP
+    as usual."""
+
+    hidden_size: int
+    ffn_hidden_size: int            # routed expert width
+    shared_expert_size: int         # shared expert width
+    num_experts: int
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    jitter_eps: float = 0.0
+    normalize_topk: bool = True
+    dispatch_mode: str = "auto"
+    # the block shape is tied to top-k routing over SwiGLU experts; other
+    # router/activation combinations raise rather than silently ignore
+    # the request (a config-driven caller would otherwise train a
+    # different model than it asked for)
+    router_type: str = "top_k"
+    activation: str = "swiglu"
+    shared_expert_gated: bool = True
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    sequence_parallel_enabled: bool = False
+    warn_on_dropped_losses: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states):
+        from apex_tpu.transformer.tensor_parallel.layers import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        if self.router_type != "top_k":
+            raise ValueError(
+                f"SharedExpertMoE supports top_k routing only, got "
+                f"{self.router_type!r}")
+        if self.activation != "swiglu":
+            raise ValueError(
+                f"SharedExpertMoE experts are SwiGLU (the Qwen2-MoE "
+                f"shape), got activation {self.activation!r}")
+        routed = SwitchMLP(
+            hidden_size=self.hidden_size,
+            ffn_hidden_size=self.ffn_hidden_size,
+            num_experts=self.num_experts, top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+            jitter_eps=self.jitter_eps,
+            normalize_topk=self.normalize_topk,
+            dispatch_mode=self.dispatch_mode, activation="swiglu",
+            params_dtype=self.params_dtype,
+            compute_dtype=self.compute_dtype,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            warn_on_dropped_losses=self.warn_on_dropped_losses,
+            name="routed")(hidden_states)
+
+        x = hidden_states.astype(self.compute_dtype)
+        gate_up = ColumnParallelLinear(
+            input_size=self.hidden_size,
+            output_size=2 * self.shared_expert_size,
+            gather_output=False, bias=False,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            params_dtype=self.params_dtype, name="shared_gate_up")(x)
+        g, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
+        h = (jax.nn.silu(g) * up).astype(self.compute_dtype)
+        shared = RowParallelLinear(
+            input_size=self.shared_expert_size,
+            output_size=self.hidden_size, input_is_parallel=True,
+            bias=False,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            params_dtype=self.params_dtype, name="shared_down")(h)
+        if self.shared_expert_gated:
+            gate_w = self.param("shared_expert_gate",
+                                nn.initializers.zeros,
+                                (self.hidden_size, 1), self.params_dtype)
+            scale = jax.nn.sigmoid(
+                (x.astype(jnp.float32) @ gate_w.astype(jnp.float32)))
+            shared = shared * scale.astype(shared.dtype)
+        return routed + shared.astype(routed.dtype)
+
+
 class SwitchMLP(nn.Module):
     """Drop-in MoE replacement for ParallelMLP (Megatron names this
     SwitchMLP). Sows 'aux_loss'/'z_loss' into the 'moe_losses' collection;

@@ -115,6 +115,16 @@ FAMILIES = {
                                           num_local_experts=4,
                                           num_experts_per_tok=2,
                                           sliding_window=None, **_LLAMA_KW)),
+    "qwen2moe": ("convert_hf_qwen2moe", "Qwen2MoeForCausalLM",
+                 lambda t: t.Qwen2MoeConfig(
+                     vocab_size=96, hidden_size=48, intermediate_size=64,
+                     moe_intermediate_size=24,
+                     shared_expert_intermediate_size=40,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, num_experts=8,
+                     num_experts_per_tok=2, norm_topk_prob=False,
+                     max_position_embeddings=32, attention_dropout=0.0,
+                     use_sliding_window=False)),
 }
 
 

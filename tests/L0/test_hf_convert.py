@@ -158,6 +158,84 @@ def test_logits_match_hf_mixtral():
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
 
 
+def _tiny_qwen2moe(norm_topk=False, seed=5):
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=64,
+        moe_intermediate_size=24, shared_expert_intermediate_size=40,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=8, num_experts_per_tok=2, norm_topk_prob=norm_topk,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=32, attention_dropout=0.0,
+        use_sliding_window=False)
+    torch.manual_seed(seed)
+    return transformers.Qwen2MoeForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("norm_topk", [False, True])
+def test_logits_match_hf_qwen2moe(norm_topk):
+    """Oracle for the shared-expert MoE block (Qwen1.5-MoE lineage):
+    fine-grained routed experts + always-on shared expert scaled by a
+    sigmoid scalar gate + QKV-biased GQA attention. norm_topk_prob
+    toggles Mixtral-style gate renormalization vs raw softmax mass —
+    both appear in published configs."""
+    from tools.convert_hf_qwen2moe import convert_qwen2moe
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_qwen2moe(norm_topk)
+    cfg, params = convert_qwen2moe(hf.state_dict(), hf_cfg)
+    assert cfg.moe_normalize_topk == norm_topk
+    assert cfg.moe_shared_expert_size == 40
+
+    tokens = np.random.RandomState(5).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours, _ = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens),
+                                  mutable=["moe_losses"])
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4, atol=3e-4)
+
+
+def test_qwen2moe_greedy_matches_hf():
+    """Token-exact greedy through the cached decode path — end to end
+    over the ragged dropless dispatch (capacity == all tokens)."""
+    from tools.convert_hf_qwen2moe import convert_qwen2moe
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_qwen2moe(seed=6)
+    cfg, params = convert_qwen2moe(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(6).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_qwen2moe_converter_refusals():
+    """Per-layer dense/MoE interleavings this mapping cannot express are
+    refused loudly."""
+    from tools.convert_hf_qwen2moe import convert_qwen2moe
+
+    base = dict(vocab_size=32, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2,
+                num_experts=4, num_experts_per_tok=2,
+                moe_intermediate_size=16,
+                shared_expert_intermediate_size=16)
+    with pytest.raises(ValueError, match="decoder_sparse_step"):
+        convert_qwen2moe({}, transformers.Qwen2MoeConfig(
+            **base, decoder_sparse_step=2))
+    with pytest.raises(ValueError, match="mlp_only_layers"):
+        convert_qwen2moe({}, transformers.Qwen2MoeConfig(
+            **base, mlp_only_layers=[0]))
+
+
 def test_greedy_generation_matches_hf():
     from tools.convert_hf_gpt2 import convert_gpt2
 

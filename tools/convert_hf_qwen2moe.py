@@ -1,0 +1,178 @@
+"""Convert a HuggingFace Qwen2-MoE checkpoint into apex_tpu MoE-GPT
+params.
+
+Qwen2-MoE (Qwen1.5-MoE-A2.7B lineage) is Qwen2-shaped attention (GQA,
+RoPE, QKV biases) with a per-layer MoE block that differs from Mixtral
+in three ways this converter maps onto the SharedExpertMoE layer
+(transformer/moe/layer.py):
+
+- fine-grained routed experts of ``moe_intermediate_size`` width with
+  RAW softmax gate mass (``norm_topk_prob=false`` -> normalize_topk
+  False; when true, gates renormalize like Mixtral),
+- an always-on shared expert of ``shared_expert_intermediate_size``
+  width,
+- a learned scalar sigmoid gate on the shared expert's output.
+
+The dropless capacity (num_experts / top_k) reproduces HF's
+drop-nothing dispatch and routes through the ragged grouped-matmul path
+at serving time.
+
+    from transformers import Qwen2MoeForCausalLM
+    from tools.convert_hf_qwen2moe import convert_qwen2moe
+
+    hf = Qwen2MoeForCausalLM.from_pretrained(path)
+    cfg, params = convert_qwen2moe(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tools.convert_hf_llama import _fused_qkv
+
+
+def _t(x):
+    return np.asarray(x.detach().cpu().numpy() if hasattr(x, "detach")
+                      else x)
+
+
+def convert_qwen2moe(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Qwen2MoeForCausalLM
+    state_dict. Single-device layout (tp=1, ep=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "decoder_sparse_step", 1) != 1:
+        raise ValueError(
+            "decoder_sparse_step != 1 interleaves dense layers on a "
+            "different phase than moe_layer_freq expresses — refusing "
+            "to misconvert")
+    if getattr(hf_config, "mlp_only_layers", None):
+        raise ValueError("mlp_only_layers checkpoints mix per-layer "
+                         "dense MLPs this mapping does not represent")
+    if getattr(hf_config, "use_sliding_window", False):
+        raise ValueError("sliding-window attention checkpoints are not "
+                         "mapped")
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    E = hf_config.num_experts
+    k = hf_config.num_experts_per_tok
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.moe_intermediate_size,  # routed width
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        num_moe_experts=E,
+        moe_top_k=k,
+        moe_capacity_factor=float(E) / k,  # dropless
+        moe_normalize_topk=bool(getattr(hf_config, "norm_topk_prob",
+                                        False)),
+        moe_shared_expert_size=hf_config.shared_expert_intermediate_size,
+        moe_shared_expert_gated=True,
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        fused_bias = _fused_qkv(_t(sd[f"{p}.self_attn.q_proj.bias"]),
+                                _t(sd[f"{p}.self_attn.k_proj.bias"]),
+                                _t(sd[f"{p}.self_attn.v_proj.bias"]),
+                                n, g, d)
+        moe = f"{p}.mlp"
+        # per routed expert: gate_proj [f, h], up_proj [f, h], down_proj
+        # [h, f]; ours: w1 [E, h, 2f] = [gate.T | up.T], w2 [E, f, h]
+        w1 = np.stack([np.concatenate(
+            [lin_t(f"{moe}.experts.{e}.gate_proj.weight"),
+             lin_t(f"{moe}.experts.{e}.up_proj.weight")], axis=-1)
+            for e in range(E)])
+        w2 = np.stack([lin_t(f"{moe}.experts.{e}.down_proj.weight")
+                       for e in range(E)])
+        shared_gate_up = np.concatenate(
+            [lin_t(f"{moe}.shared_expert.gate_proj.weight"),
+             lin_t(f"{moe}.shared_expert.up_proj.weight")], axis=-1)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {
+                "weight": jnp.asarray(_t(sd[f"{p}.input_layernorm.weight"]))},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.asarray(fused_bias),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.post_attention_layernorm.weight"]))},
+            "mlp": {
+                "routed": {
+                    "router": {"gate_weight": jnp.asarray(
+                        lin_t(f"{moe}.gate.weight"))},
+                    "experts": {"w1": jnp.asarray(w1),
+                                "w2": jnp.asarray(w2)},
+                },
+                "shared_gate_up": {"weight": jnp.asarray(shared_gate_up)},
+                "shared_down": {"weight": jnp.asarray(
+                    lin_t(f"{moe}.shared_expert.down_proj.weight"))},
+                "shared_expert_gate": jnp.asarray(
+                    lin_t(f"{moe}.shared_expert_gate.weight")),
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {"weight": jnp.asarray(_t(sd["norm.weight"]))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    import sys
+
+    sys.path.insert(0, ".")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Qwen2MoeForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Qwen2MoeForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_qwen2moe(hf.eval().state_dict(), hf.config)
+    checkpoint.save(args.out_dir, 0, params=params)
+    print(f"saved step_0 under {args.out_dir} "
+          f"({cfg.num_layers} layers, {cfg.num_moe_experts} experts)")
+
+
+if __name__ == "__main__":
+    main()
