@@ -792,63 +792,13 @@ def _enable_bench_compile_cache():
     maybe_enable_compile_cache()
 
 
-def main():
-    _arm_watchdog()
-    _require_backend()
-    _enable_bench_compile_cache()
+def bench_resnet(batch, steps):
+    """ResNet-50 amp O2 + FusedAdam — the driver's default metric
+    (BASELINE.json metric 1). Extracted from main() so the one-process
+    capture driver (tools/oneproc_capture.py) can run it in-process."""
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import FusedAdam
-
-    if len(sys.argv) > 1 and sys.argv[1] == "bert":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 30
-        return bench_bert(batch, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "gpt":
-        seq = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
-        return bench_gpt_long(seq, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "gpt2":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
-        return bench_gpt2(batch, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "t5":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
-        return bench_t5(batch, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "vit":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
-        return bench_vit(batch, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "whisper":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
-        return bench_whisper(batch, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "moe":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
-        return bench_moe(batch, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "moe_serve":
-        seq = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
-        return bench_moe_serve(seq, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "mla_decode":
-        prefix = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 64
-        return bench_mla_decode(prefix, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "llama":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 4
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 15
-        return bench_llama(batch, steps)
-    if len(sys.argv) > 1 and sys.argv[1] == "decode":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 128
-        return bench_decode(batch, steps)
-
-    # batch 256 measured ~1.7x faster per chip than 128 on the v5e/v6e
-    # class chip (better MXU utilization); 50 steps amortize dispatch
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 50
 
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = np.random.RandomState(0)
@@ -912,6 +862,49 @@ def main():
     # ResNet-50 fwd ~4.09 GFLOPs/image at 224x224; train = 3x fwd
     _emit("resnet50_amp_o2_fused_adam_imgs_per_sec_per_chip",
           imgs_per_sec, "imgs/sec", 3 * 4.09e9 * batch, steps, dt)
+
+
+# The canonical (size, steps) per bench — the ONLY place these defaults
+# live; both the CLI dispatch below and the one-process capture plan
+# (tools/oneproc_capture.py) read them, so a tuning change (like resnet
+# batch 128 -> 256, measured ~1.7x on this chip class) propagates to
+# every capture path. Functions resolve lazily so `python bench.py` via
+# this table still defers heavy imports to the chosen bench.
+BENCH_SPECS = {
+    "bert": ((64, 30), bench_bert),
+    "gpt": ((8192, 15), bench_gpt_long),
+    "gpt2": ((8, 20), bench_gpt2),
+    "t5": ((16, 20), bench_t5),
+    "vit": ((128, 20), bench_vit),
+    "whisper": ((8, 15), bench_whisper),
+    "moe": ((4, 15), bench_moe),
+    "moe_serve": ((2048, 20), bench_moe_serve),
+    "mla_decode": ((4096, 64), bench_mla_decode),
+    "llama": ((4, 15), bench_llama),
+    "decode": ((8, 128), bench_decode),
+    "resnet": ((256, 50), bench_resnet),
+}
+
+
+def main():
+    _arm_watchdog()
+    _require_backend()
+    _enable_bench_compile_cache()
+
+    name = sys.argv[1] if len(sys.argv) > 1 and sys.argv[1] in BENCH_SPECS \
+        else None
+    if name is not None:
+        (size, steps), fn = BENCH_SPECS[name]
+        size = int(sys.argv[2]) if len(sys.argv) > 2 else size
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else steps
+        return fn(size, steps)
+
+    # default (the driver's metric): resnet, with bare-number argv
+    # compatibility (`python bench.py 128 20`)
+    (size, steps), fn = BENCH_SPECS["resnet"]
+    size = int(sys.argv[1]) if len(sys.argv) > 1 else size
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else steps
+    return fn(size, steps)
 
 
 if __name__ == "__main__":

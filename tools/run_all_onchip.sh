@@ -6,7 +6,8 @@
 # mid-plan wedge costs one stage, not the plan.
 #
 #   bash tools/run_all_onchip.sh            # full plan
-#   bash tools/run_all_onchip.sh benches    # just the bench queue
+#   bash tools/run_all_onchip.sh benches    # all benches+sweep (one process)
+#   bash tools/run_all_onchip.sh sweep      # just the gpt2 MFU sweep
 set -u
 cd /root/repo
 CAPLOG=${CAPLOG:-/root/repo/.capture_log}
@@ -23,15 +24,26 @@ run() { # run <tag> <cmd...>: log one line per process, keep stderr
   return 0
 }
 
-if [ "$stage" = all ] || [ "$stage" = benches ]; then
-  # driver metric first (resnet default), then the rest
-  bash tools/capture_queue.sh "" gpt2 bert moe moe_serve mla_decode t5 vit whisper decode llama gpt || exit 1
-fi
-
-if [ "$stage" = all ] || [ "$stage" = sweep ]; then
-  for v in base noflash scan b16 b32 remat xent; do
-    run "sweep_$v" python tools/mfu_sweep.py "$v"
+if [ "$stage" = all ] || [ "$stage" = benches ] || [ "$stage" = sweep ]; then
+  # Round-5 rework: ALL benches + the MFU sweep run in ONE long-lived
+  # process (tools/oneproc_capture.py) — the 08-01 green window died at
+  # a process boundary, so connection churn is minimized. Stage tags in
+  # $CAPLOG are scoped by ONEPROC_RUN: the relaunch loop below shares
+  # one id (so a relaunch resumes after a wedged stage, re-gated on
+  # bench._require_backend), while a fresh plan invocation gets a new
+  # id and re-runs everything. `sweep` limits to the gpt2* stages.
+  ONEPROC_RUN=${ONEPROC_RUN:-$(date -u +%m%dT%H%M%S)}
+  export ONEPROC_RUN
+  only=""
+  [ "$stage" = sweep ] && only=gpt2
+  for i in 1 2 3; do
+    python tools/oneproc_capture.py $only >> "$CAPLOG.oneproc_out" 2>"/root/repo/.capture_err.oneproc$i"
+    rc=$?
+    [ "$rc" -eq 0 ] && break
+    echo "$(date -u +%H:%M:%S) oneproc attempt $i rc=$rc stderr: $(tail -2 /root/repo/.capture_err.oneproc$i | tr '\n' ' ')" >> "$CAPLOG"
+    sleep 60
   done
+  grep -q "oneproc\[$ONEPROC_RUN\] COMPLETE" "$CAPLOG" || exit 1
 fi
 
 if [ "$stage" = all ] || [ "$stage" = extras ]; then
