@@ -113,6 +113,13 @@ class GPTModel(nn.Module):
                     "lm_head_bias", nn.initializers.zeros,
                     (vocab_per_rank,), cfg.params_dtype).astype(
                         logits.dtype)
+        if cfg.final_logit_softcapping is not None:
+            # Gemma-2: logits -> cap * tanh(logits / cap), fp32 (HF
+            # modeling_gemma2 Gemma2ForCausalLM.forward). Elementwise, so
+            # valid on each vocab-parallel shard independently.
+            cap = jnp.float32(cfg.final_logit_softcapping)
+            logits = (cap * jnp.tanh(logits.astype(jnp.float32) / cap)
+                      ).astype(logits.dtype)
         return logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
 
 

@@ -32,6 +32,44 @@ from apex_tpu.transformer.tensor_parallel import (
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Frequency-rescaled RoPE (HF modeling_rope_utils semantics).
+
+    ``rope_type="linear"`` divides every inverse frequency by ``factor``
+    (position interpolation). ``rope_type="llama3"`` (Llama-3.1) keeps
+    wavelengths shorter than ``original_max/high_freq_factor``, divides
+    those longer than ``original_max/low_freq_factor`` by ``factor``,
+    and smoothly interpolates in between
+    (_compute_llama3_parameters). All-scalar and frozen, so
+    TransformerConfig remains hashable for static jit arguments."""
+
+    rope_type: str = "llama3"
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+def _scale_rope_freqs(inv, scaling: RopeScaling):
+    import math
+
+    if scaling.rope_type == "linear":
+        return inv / scaling.factor
+    if scaling.rope_type != "llama3":
+        raise ValueError(f"unknown rope_type {scaling.rope_type!r}")
+    old_len = scaling.original_max_position_embeddings
+    low_wavelen = old_len / scaling.low_freq_factor
+    high_wavelen = old_len / scaling.high_freq_factor
+    wavelen = 2 * math.pi / inv
+    scaled = jnp.where(wavelen > low_wavelen, inv / scaling.factor, inv)
+    smooth = ((old_len / wavelen - scaling.low_freq_factor)
+              / (scaling.high_freq_factor - scaling.low_freq_factor))
+    smoothed = ((1 - smooth) * scaled / scaling.factor + smooth * scaled)
+    medium = (wavelen >= high_wavelen) & (wavelen <= low_wavelen)
+    return jnp.where(medium, smoothed, scaled)
+
+
+@dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     hidden_size: int = 1024
     num_layers: int = 24
@@ -98,6 +136,13 @@ class TransformerConfig:
     num_query_groups: Optional[int] = None  # None -> MHA (groups == heads)
     position_embedding_type: str = "learned"  # or "rope"
     rotary_base: float = 10000.0
+    # Long-context RoPE frequency rescaling (Llama-3.1 "llama3" or
+    # position-interpolation "linear"); None -> unscaled frequencies.
+    rope_scaling: Optional[RopeScaling] = None
+    # Query/key RMSNorm before rope: "projection" (OLMoE — one norm over
+    # the full flattened q / k projection output) or "head" (Qwen3 —
+    # per-head over head_dim, tensor-parallel-safe). None -> off.
+    qk_norm: Optional[str] = None
     # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact"
     # the erf form (HF "gelu" — Falcon/NeoX default); "relu" (OPT);
     # "swiglu"/"geglu" are the gated fused forms.
@@ -125,6 +170,27 @@ class TransformerConfig:
     # Mistral-style sliding-window attention: query i sees key j iff
     # 0 <= i - j < sliding_window (on top of causal). None -> full causal.
     sliding_window: Optional[int] = None
+    # Alternating local/global attention (Gemma-2/3): the window applies
+    # to layer i iff (i + 1) % pattern != 0 — every pattern-th layer runs
+    # full causal attention (Gemma-2: pattern 2 -> even layers local;
+    # Gemma-3: pattern 6). 1 -> every layer windowed (Mistral).
+    sliding_window_pattern: int = 1
+    # Gemma-2 tanh soft-capping: scores -> cap * tanh(scores / cap)
+    # after the softmax scale, before masking (HF modeling_gemma2
+    # eager_attention_forward). Takes the masked-softmax path — the
+    # flash kernel has no softcap epilogue.
+    attn_logit_softcapping: Optional[float] = None
+    # Gemma-2: LM-head logits -> cap * tanh(logits / cap) (fp32),
+    # applied per vocab-parallel shard (elementwise).
+    final_logit_softcapping: Optional[float] = None
+    # Decoupled softmax scale (Gemma-2 query_pre_attn_scalar): scores
+    # are scaled by this value**-0.5 instead of kv_channels**-0.5
+    # (gemma-2-27b: 144 vs head_dim 128). None -> kv_channels.
+    query_pre_attn_scalar: Optional[float] = None
+    # Gemma-2 "sandwich" residual form: each branch output is normed
+    # BEFORE its residual add (x + post_norm(branch(pre_norm(x)))) —
+    # adds post_self_attn_norm / post_mlp_norm params per layer.
+    sandwich_norm: bool = False
     normalization: str = "layernorm"  # or "rmsnorm"
     # BLOOM applies a layernorm directly after the token embeddings.
     embedding_layernorm: bool = False
@@ -147,6 +213,60 @@ class TransformerConfig:
                     "sliding_window does not compose with context "
                     "parallelism (the ring/ulysses kernels run full "
                     "causal attention)")
+        if self.sliding_window_pattern < 1:
+            raise ValueError(
+                f"sliding_window_pattern ({self.sliding_window_pattern}) "
+                f"must be >= 1")
+        if self.sliding_window_pattern > 1:
+            if self.sliding_window is None:
+                raise ValueError(
+                    "sliding_window_pattern > 1 needs sliding_window set")
+            if self.scan_layers:
+                raise ValueError(
+                    "scan_layers needs a uniform stack: alternating "
+                    "local/global attention (sliding_window_pattern > 1) "
+                    "cannot be scanned")
+        if self.query_pre_attn_scalar is not None and self.context_parallel:
+            raise ValueError(
+                "query_pre_attn_scalar does not compose with context "
+                "parallelism (the ring/ulysses kernels use the default "
+                "1/sqrt(head_dim) softmax scale)")
+        if self.attn_logit_softcapping is not None:
+            if self.attn_logit_softcapping <= 0:
+                raise ValueError(
+                    f"attn_logit_softcapping "
+                    f"({self.attn_logit_softcapping}) must be > 0")
+            if self.context_parallel:
+                raise ValueError(
+                    "attn_logit_softcapping does not compose with context "
+                    "parallelism (the ring/ulysses kernels carry no "
+                    "softcap epilogue)")
+        if self.qk_norm not in (None, "projection", "head"):
+            raise ValueError(
+                f"unknown qk_norm {self.qk_norm!r}; expected "
+                f"'projection' (OLMoE) or 'head' (Qwen3)")
+        if self.rope_scaling is not None:
+            if self.position_embedding_type != "rope":
+                raise ValueError("rope_scaling requires "
+                                 "position_embedding_type='rope'")
+            if self.rope_scaling.rope_type not in ("linear", "llama3"):
+                raise ValueError(
+                    f"unknown rope_type "
+                    f"{self.rope_scaling.rope_type!r}; expected 'linear' "
+                    f"or 'llama3'")
+            if self.rope_scaling.factor < 1.0:
+                raise ValueError(
+                    f"rope_scaling.factor ({self.rope_scaling.factor}) "
+                    f"must be >= 1")
+        if (self.final_logit_softcapping is not None
+                and self.final_logit_softcapping <= 0):
+            raise ValueError(
+                f"final_logit_softcapping "
+                f"({self.final_logit_softcapping}) must be > 0")
+        if self.sandwich_norm and self.parallel_residual:
+            raise ValueError(
+                "sandwich_norm and parallel_residual are mutually "
+                "exclusive residual forms")
         if self.parallel_residual_shared_ln and not self.parallel_residual:
             raise ValueError(
                 "parallel_residual_shared_ln requires parallel_residual")
@@ -235,7 +355,8 @@ def _warn_sliding_window_flash_once(window, seq):
 
 
 def apply_rotary_emb(x, base: float = 10000.0, positions=None,
-                     percent: float = 1.0, interleaved: bool = False):
+                     percent: float = 1.0, interleaved: bool = False,
+                     scaling: Optional[RopeScaling] = None):
     """Rotary position embedding (rotate-half convention) on [s, b, n, d].
 
     ``positions`` is [s] (shared across the batch) or [s, b] (per-sequence
@@ -254,17 +375,20 @@ def apply_rotary_emb(x, base: float = 10000.0, positions=None,
         rot_n = int(d_full * percent + 1e-6)  # HF rotary_ndims (may be odd)
         width = 2 * ((rot_n + 1) // 2)  # dims actually rotated
         out = _rope_core(x[..., :width], base, positions, rot_n,
-                         interleaved)
+                         interleaved, scaling)
         return jnp.concatenate([out, x[..., width:]], axis=-1)
-    return _rope_core(x, base, positions, d_full, interleaved)
+    return _rope_core(x, base, positions, d_full, interleaved, scaling)
 
 
-def _rope_core(x, base, positions, freq_dim, interleaved=False):
+def _rope_core(x, base, positions, freq_dim, interleaved=False,
+               scaling=None):
     s, _, _, d = x.shape
     if positions is None:
         positions = jnp.arange(s)
     inv = 1.0 / (base ** (jnp.arange(0, freq_dim, 2, dtype=jnp.float32)
                           / freq_dim))
+    if scaling is not None:
+        inv = _scale_rope_freqs(inv, scaling)
     freqs = positions[..., None].astype(jnp.float32) * inv  # [s(,b), d/2]
     if freqs.ndim == 2:  # [s, d/2] -> broadcast over batch and heads
         freqs = freqs[:, None, :]
@@ -339,6 +463,21 @@ class ParallelAttention(nn.Module):
 
     config: TransformerConfig
     decode: bool = False
+    # which layer this is — selects local vs global attention under
+    # sliding_window_pattern (Gemma-2/3 alternation)
+    layer_number: int = 0
+
+    def _layer_window(self):
+        """This layer's sliding window, or None when it runs full causal
+        attention (every sliding_window_pattern-th layer)."""
+        cfg = self.config
+        if cfg.sliding_window is None:
+            return None
+        if (cfg.sliding_window_pattern > 1
+                and (self.layer_number + 1) % cfg.sliding_window_pattern
+                == 0):
+            return None
+        return cfg.sliding_window
 
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
@@ -385,6 +524,9 @@ class ParallelAttention(nn.Module):
                                                     2 * kv)
             k, v = jnp.split(kvp, 2, axis=-1)
 
+        if cfg.qk_norm is not None:
+            q, k = self._apply_qk_norm(cfg, q, k, tp)
+
         if self.decode:
             if attention_mask is not None:
                 raise ValueError(
@@ -407,10 +549,12 @@ class ParallelAttention(nn.Module):
         if cfg.position_embedding_type == "rope":
             q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
                                  cfg.rotary_percent,
-                                 cfg.rotary_interleaved)
+                                 cfg.rotary_interleaved,
+                                 cfg.rope_scaling)
             k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
                                  cfg.rotary_percent,
-                                 cfg.rotary_interleaved)
+                                 cfg.rotary_interleaved,
+                                 cfg.rope_scaling)
         if k.shape[2] != np_local:
             # broadcast each K/V group to its query heads
             rep = np_local // k.shape[2]
@@ -418,15 +562,19 @@ class ParallelAttention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         # a window covering the whole sequence is plain causal
-        win = (cfg.sliding_window
-               if (cfg.sliding_window is not None
-                   and cfg.sliding_window < seq_full) else None)
+        layer_win = self._layer_window()
+        win = (layer_win
+               if (layer_win is not None and layer_win < seq_full)
+               else None)
 
         # flash handles the built-in causal/full patterns and the
         # sliding-window band (kernel block-skip); an explicit
-        # attention_mask (e.g. padding) must take the masked softmax
-        # path below or it would be silently ignored.
+        # attention_mask (e.g. padding), a softcap, or a non-default
+        # softmax scale must take the masked softmax path below or they
+        # would be silently ignored.
         if (cfg.use_flash_attention and attention_mask is None
+                and cfg.attn_logit_softcapping is None
+                and cfg.query_pre_attn_scalar in (None, kv)
                 and _flash_available(seq_full, kv)):
             from apex_tpu.contrib.fmha import flash_attention
 
@@ -459,7 +607,12 @@ class ParallelAttention(nn.Module):
             vt = v.transpose(1, 2, 0, 3).astype(cfg.compute_dtype)
             scores = jnp.einsum("bnsd,bntd->bnst", qt, kt,
                                 preferred_element_type=jnp.float32)
-            scores = scores / jnp.sqrt(kv).astype(jnp.float32)
+            scores = scores / jnp.sqrt(
+                cfg.query_pre_attn_scalar or kv).astype(jnp.float32)
+            if cfg.attn_logit_softcapping is not None:
+                # Gemma-2: scale, then cap * tanh(s / cap), then mask
+                cap = jnp.float32(cfg.attn_logit_softcapping)
+                scores = cap * jnp.tanh(scores / cap)
             if cfg.position_embedding_type == "alibi":
                 # key-position-only form (HF build_alibi_tensor): each
                 # row differs from slope*(j - i) by a constant, which
@@ -487,6 +640,35 @@ class ParallelAttention(nn.Module):
 
         ctx = ctx.reshape(ctx.shape[0], b, np_local * kv)
         return self._output_proj(cfg, ctx)
+
+    def _apply_qk_norm(self, cfg, q, k, tp):
+        """Query/key RMSNorm before rope (fp32, cast back).
+
+        "projection" (HF modeling_olmoe OlmoeAttention: q_norm/k_norm
+        over the FULL projected vector before the head reshape) —
+        normalizes across all heads jointly, so a tp-sharded projection
+        would need a cross-rank psum of squares; refused for tp > 1.
+        "head" (Qwen3 convention): per-head over head_dim — tp-safe."""
+        from apex_tpu.normalization import FusedRMSNorm
+
+        def norm(x, shape, name):
+            return FusedRMSNorm(
+                normalized_shape=shape, eps=cfg.layernorm_epsilon,
+                param_dtype=jnp.float32, name=name)(
+                x.astype(jnp.float32)).astype(cfg.compute_dtype)
+
+        if cfg.qk_norm == "head":
+            return (norm(q, q.shape[-1], "q_norm"),
+                    norm(k, k.shape[-1], "k_norm"))
+        if tp > 1:
+            raise ValueError(
+                "qk_norm='projection' normalizes the full projection "
+                "width and is not tensor-parallel (would need a psum of "
+                "squares across ranks); use tp=1 or qk_norm='head'")
+        s, b = q.shape[:2]
+        qn = norm(q.reshape(s, b, -1), q.shape[-2] * q.shape[-1], "q_norm")
+        kn = norm(k.reshape(s, b, -1), k.shape[-2] * k.shape[-1], "k_norm")
+        return qn.reshape(q.shape), kn.reshape(k.shape)
 
     def _output_proj(self, cfg, ctx):
         """Shared row-parallel output projection (both attention paths —
@@ -525,10 +707,12 @@ class ParallelAttention(nn.Module):
                 position_ids = rank * s + jnp.arange(s)
             q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
                                  cfg.rotary_percent,
-                                 cfg.rotary_interleaved)
+                                 cfg.rotary_interleaved,
+                                 cfg.rope_scaling)
             k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
                                  cfg.rotary_percent,
-                                 cfg.rotary_interleaved)
+                                 cfg.rotary_interleaved,
+                                 cfg.rope_scaling)
         if k.shape[2] != np_local:
             rep = np_local // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
@@ -568,10 +752,12 @@ class ParallelAttention(nn.Module):
                    else idx + jnp.arange(s))
             q = apply_rotary_emb(q, cfg.rotary_base, pos,
                                  cfg.rotary_percent,
-                                 cfg.rotary_interleaved)
+                                 cfg.rotary_interleaved,
+                                 cfg.rope_scaling)
             k = apply_rotary_emb(k, cfg.rotary_base, pos,
                                  cfg.rotary_percent,
-                                 cfg.rotary_interleaved)
+                                 cfg.rotary_interleaved,
+                                 cfg.rope_scaling)
         if not initialized:
             # init pass: create the variables, plain causal attention over
             # the given tokens (shapes/params identical to the real path)
@@ -588,7 +774,11 @@ class ParallelAttention(nn.Module):
         vt = v_full.astype(cfg.compute_dtype)
         scores = jnp.einsum("sbgrd,tbgd->bgrst", qg, kt,
                             preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(kv).astype(jnp.float32)
+        scores = scores / jnp.sqrt(
+            cfg.query_pre_attn_scalar or kv).astype(jnp.float32)
+        if cfg.attn_logit_softcapping is not None:
+            cap = jnp.float32(cfg.attn_logit_softcapping)
+            scores = cap * jnp.tanh(scores / cap)
         # causal over absolute positions: query i (at offset+i) sees keys
         # j <= offset+i; unfilled cache tail is masked the same way
         if cfg.position_embedding_type == "alibi":
@@ -600,10 +790,11 @@ class ParallelAttention(nn.Module):
         jpos = jnp.arange(kv_len)[None, :]
         ipos = offset + jnp.arange(s)[:, None]
         masked = jpos > ipos
-        if cfg.sliding_window is not None:
+        decode_win = self._layer_window()
+        if decode_win is not None:
             # stale cache entries beyond the window stay resident but
             # invisible (Mistral semantics: 0 <= i - j < window)
-            masked = masked | (ipos - jpos >= cfg.sliding_window)
+            masked = masked | (ipos - jpos >= decode_win)
         scores = jnp.where(masked, -1e30, scores)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bgrst,tbgd->sbgrd",
@@ -690,8 +881,13 @@ class ParallelTransformerLayer(nn.Module):
         ln1_out = ln1(hidden_states.astype(jnp.float32)).astype(
             cfg.compute_dtype)
         attn_out = ParallelAttention(cfg, decode=self.decode,
+                                     layer_number=self.layer_number,
                                      name="self_attention")(
             ln1_out, attention_mask, position_ids)
+        if cfg.sandwich_norm:
+            # Gemma-2: norm each branch's OUTPUT before its residual add
+            attn_out = _make_norm(cfg, "post_self_attn_norm")(
+                attn_out.astype(jnp.float32)).astype(cfg.compute_dtype)
         residual = hidden_states  # pre-attn input (parallel-residual form)
         if not cfg.parallel_residual:
             hidden_states = hidden_states + attn_out.astype(
@@ -739,6 +935,9 @@ class ParallelTransformerLayer(nn.Module):
                   ln2(hidden_states.astype(jnp.float32)).astype(
                       cfg.compute_dtype))
         mlp_out = mlp(mlp_in)
+        if cfg.sandwich_norm:
+            mlp_out = _make_norm(cfg, "post_mlp_norm")(
+                mlp_out.astype(jnp.float32)).astype(cfg.compute_dtype)
         if cfg.parallel_residual:
             # GPT-NeoX form: both branches read the SAME input (ln2 is
             # applied to the pre-attn stream) and sum into one residual

@@ -1,0 +1,173 @@
+"""Convert a HuggingFace Gemma-2 checkpoint into apex_tpu GPTModel params.
+
+Gemma-2 specifics on top of the Gemma mapping (convert_hf_gemma):
+
+- Tanh soft-capping of attention scores (50.0) and final logits (30.0)
+  -> ``attn_logit_softcapping`` / ``final_logit_softcapping`` (HF
+  modeling_gemma2 eager_attention_forward / Gemma2ForCausalLM.forward —
+  eager IS the reference implementation for this family).
+- Alternating local/global attention: HF ``layer_types`` puts
+  sliding_attention on even layers, full_attention on odd ->
+  ``sliding_window_pattern=2`` (+ ``sliding_window``). The converter
+  REFUSES a checkpoint whose layer_types deviates from that alternation
+  rather than silently attending wrongly.
+- "Sandwich" norms: four RMSNorms per layer. HF input_layernorm stays
+  pre-attention; HF post_attention_layernorm norms the attention OUTPUT
+  -> ours ``post_self_attn_norm``; HF pre_feedforward_layernorm is the
+  pre-MLP norm -> ours ``post_attention_layernorm`` (the standard
+  pre-LN slot); HF post_feedforward_layernorm -> ours ``post_mlp_norm``.
+- Decoupled softmax scale ``query_pre_attn_scalar`` (gemma-2-27b: 144
+  vs head_dim 128).
+- Everything else as Gemma-1: GeGLU, sqrt(h) embedding scale, (1+w)
+  RMSNorm folding, tied head, GQA, decoupled head_dim.
+
+    from transformers import Gemma2ForCausalLM
+    from tools.convert_hf_gemma2 import convert_gemma2
+
+    hf = Gemma2ForCausalLM.from_pretrained(path)
+    cfg, params = convert_gemma2(hf.state_dict(), hf.config)
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _t
+
+
+def convert_gemma2(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Gemma2ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = getattr(hf_config, "head_dim", None) or hf_config.hidden_size // n
+    act = getattr(hf_config, "hidden_activation", None) or getattr(
+        hf_config, "hidden_act", "gelu_pytorch_tanh")
+    if not act.startswith("gelu"):
+        raise ValueError(
+            f"unsupported hidden_activation {act!r}: Gemma-2 uses "
+            f"gelu_pytorch_tanh (geglu); anything else would silently "
+            f"change numerics")
+
+    # the model expresses alternation as a pattern, not a per-layer
+    # list — refuse any layer_types the pattern can't represent
+    layer_types = getattr(hf_config, "layer_types", None)
+    expected = ["sliding_attention" if (i + 1) % 2 else "full_attention"
+                for i in range(hf_config.num_hidden_layers)]
+    if layer_types is not None and list(layer_types) != expected:
+        raise ValueError(
+            f"layer_types {layer_types!r} is not the Gemma-2 "
+            f"even-local/odd-global alternation; refusing rather than "
+            f"misconverting the attention pattern")
+
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        activation="geglu",
+        num_query_groups=(g if g != n else None),
+        tie_word_embeddings=True,
+        embedding_multiplier=math.sqrt(hf_config.hidden_size),
+        head_dim=d,
+        sliding_window=hf_config.sliding_window,
+        sliding_window_pattern=2,
+        attn_logit_softcapping=hf_config.attn_logit_softcapping,
+        final_logit_softcapping=hf_config.final_logit_softcapping,
+        query_pre_attn_scalar=hf_config.query_pre_attn_scalar,
+        sandwich_norm=True,
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def rms(key):
+        # Gemma rmsnorm applies x * (1 + w): fold the +1 in
+        return jnp.asarray(_t(sd[key]) + 1.0)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {"weight": rms(f"{p}.input_layernorm.weight")},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            # HF post_attention_layernorm norms the attn OUTPUT
+            "post_self_attn_norm": {
+                "weight": rms(f"{p}.post_attention_layernorm.weight")},
+            # HF pre_feedforward_layernorm is the pre-MLP norm — our
+            # standard post_attention_layernorm slot
+            "post_attention_layernorm": {
+                "weight": rms(f"{p}.pre_feedforward_layernorm.weight")},
+            "post_mlp_norm": {
+                "weight": rms(f"{p}.post_feedforward_layernorm.weight")},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(np.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    return cfg, {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {"weight": rms("norm.weight")},
+    }
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Gemma2ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Gemma2ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_gemma2(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,41 @@ def _ln(sd, prefix):
             "bias": jnp.asarray(_t(sd[f"{prefix}.bias"]))}
 
 
+def _map_rope_scaling(hf_rope_scaling):
+    """HF ``rope_scaling`` dict -> apex_tpu RopeScaling (or None).
+
+    Llama-3.1+ checkpoints carry {"rope_type": "llama3", "factor": 8.0,
+    "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 8192}; older checkpoints use the
+    legacy key "type" and the "linear" interpolation form. Unsupported
+    types (yarn, dynamic, longrope — seq-length-dependent or
+    attention-scaled) are REFUSED: ignoring them would silently attend
+    at wrong frequencies."""
+    if hf_rope_scaling is None:
+        return None
+    from apex_tpu.models.transformer_lm import RopeScaling
+
+    kind = (hf_rope_scaling.get("rope_type")
+            or hf_rope_scaling.get("type") or "default")
+    if kind == "default":
+        return None
+    if kind == "linear":
+        return RopeScaling(rope_type="linear",
+                           factor=float(hf_rope_scaling["factor"]))
+    if kind == "llama3":
+        return RopeScaling(
+            rope_type="llama3",
+            factor=float(hf_rope_scaling["factor"]),
+            low_freq_factor=float(hf_rope_scaling["low_freq_factor"]),
+            high_freq_factor=float(hf_rope_scaling["high_freq_factor"]),
+            original_max_position_embeddings=int(
+                hf_rope_scaling["original_max_position_embeddings"]))
+    raise ValueError(
+        f"unsupported rope_scaling type {kind!r}: only 'linear' and "
+        f"'llama3' are implemented; converting anyway would silently "
+        f"change attention frequencies")
+
+
 def _fused_qkv(wq, wk, wv, num_heads, num_groups, head_dim):
     """[h, n*d], [h, g*d], [h, g*d] -> fused columns in apex_tpu's layout.
 
@@ -78,6 +113,8 @@ def convert_llama(state_dict, hf_config):
     d = (getattr(hf_config, "head_dim", None)
          or hf_config.hidden_size // n)
     cfg = TransformerConfig(
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
         head_dim=d,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,

@@ -1,0 +1,180 @@
+"""Convert a HuggingFace OLMoE checkpoint into apex_tpu MoE-GPT params.
+
+OLMoE (allenai OLMoE-1B-7B) specifics on top of the Mixtral mapping
+(convert_hf_mixtral):
+
+- Query/key RMSNorm over the FULL projected q / k vectors before rope
+  (HF modeling_olmoe OlmoeAttention.q_norm/k_norm) ->
+  ``qk_norm="projection"`` with the norm weights carried through the
+  same fused-QKV column permutation as the projections they normalize.
+- 64 experts, top-8, ``norm_topk_prob=False`` by default -> raw softmax
+  mass (``moe_normalize_topk=False``); True converts to the
+  renormalized form.
+- ``clip_qkv`` is REFUSED when set (elementwise clamp between the
+  projection and the norm — not implemented; ignoring it would change
+  numerics).
+- Experts named mlp.experts.{e}.{gate,up,down}_proj; router at
+  mlp.gate. Dropless parity via ``moe_capacity_factor = E / k``
+  (ragged dispatch at serve time).
+
+    from transformers import OlmoeForCausalLM
+    from tools.convert_hf_olmoe import convert_olmoe
+
+    hf = OlmoeForCausalLM.from_pretrained(path)
+    cfg, params = convert_olmoe(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _t
+
+
+def _permute_qk_norm_weights(wq_norm, wk_norm, num_heads, num_groups,
+                             head_dim):
+    """The fused QKV layout permutes head columns; the projection-wide
+    q/k norm weights must follow the SAME permutation so weight i still
+    scales the feature it was trained on.
+
+    MHA fused layout is per-head [q_i | k_i | v_i] blocks — q features
+    land at block offsets, so the q-norm weight (length n*d) is split
+    per head and re-read in head order (identity permutation for q and
+    for k separately: heads stay in order within their kind). GQA keeps
+    all q heads first, then per-group [k_g | v_g] — also head-order for
+    each kind. Net: NO reordering is needed for either layout (each
+    kind's heads keep their relative order); returned unchanged, with
+    the reasoning recorded here so a future layout change revisits
+    this."""
+    del num_heads, num_groups, head_dim
+    return wq_norm, wk_norm
+
+
+def convert_olmoe(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from an OlmoeForCausalLM
+    state_dict. Single-device layout (tp=1, ep=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "clip_qkv", None) is not None:
+        raise ValueError(
+            f"clip_qkv={hf_config.clip_qkv} is not implemented (an "
+            f"elementwise clamp between projection and qk-norm); "
+            f"refusing rather than silently dropping it")
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    E = hf_config.num_experts
+    k = hf_config.num_experts_per_tok
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        qk_norm="projection",
+        num_moe_experts=E,
+        moe_top_k=k,
+        moe_capacity_factor=float(E) / k,  # dropless
+        moe_normalize_topk=bool(getattr(hf_config, "norm_topk_prob",
+                                        False)),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        wqn, wkn = _permute_qk_norm_weights(
+            _t(sd[f"{p}.self_attn.q_norm.weight"]),
+            _t(sd[f"{p}.self_attn.k_norm.weight"]), n, g, d)
+        moe = f"{p}.mlp"
+        # per expert: gate [ffn, h], up [ffn, h], down [h, ffn];
+        # ours: w1 [E, h, 2*ffn] = [gate.T | up.T], w2 [E, ffn, h]
+        w1 = np.stack([np.concatenate(
+            [lin_t(f"{moe}.experts.{e}.gate_proj.weight"),
+             lin_t(f"{moe}.experts.{e}.up_proj.weight")], axis=-1)
+            for e in range(E)])
+        w2 = np.stack([lin_t(f"{moe}.experts.{e}.down_proj.weight")
+                       for e in range(E)])
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.input_layernorm.weight"]))},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "q_norm": {"weight": jnp.asarray(wqn)},
+                "k_norm": {"weight": jnp.asarray(wkn)},
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.post_attention_layernorm.weight"]))},
+            "mlp": {
+                "router": {"gate_weight": jnp.asarray(
+                    lin_t(f"{moe}.gate.weight"))},
+                "experts": {"w1": jnp.asarray(w1), "w2": jnp.asarray(w2)},
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {
+            "weight": jnp.asarray(_t(sd["norm.weight"]))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import OlmoeForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = OlmoeForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_olmoe(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
