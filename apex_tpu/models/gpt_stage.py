@@ -108,6 +108,12 @@ class GPTStage(nn.Module):
                             preferred_element_type=jnp.float32)
         if self.lm_head_bias is not None:
             logits = logits + self.lm_head_bias.astype(logits.dtype)
+        if cfg.final_logit_softcapping is not None:
+            # same cap as GPTModel's head — a pipelined softcap model
+            # must not silently train on uncapped logits
+            cap = jnp.float32(cfg.final_logit_softcapping)
+            logits = (cap * jnp.tanh(logits.astype(jnp.float32) / cap)
+                      ).astype(logits.dtype)
         logits = logits.transpose(1, 0, 2)  # [b, s, vocab/tp]
         losses = vocab_parallel_cross_entropy(logits, labels)
         if loss_mask is not None:

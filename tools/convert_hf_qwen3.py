@@ -1,0 +1,150 @@
+"""Convert a HuggingFace Qwen3 checkpoint into apex_tpu GPTModel params.
+
+Qwen3 specifics on top of the Llama mapping (convert_hf_llama):
+
+- Per-head q/k RMSNorm over head_dim before rope (HF modeling_qwen3
+  OlmoeAttention contrast: "unlike olmo, only on the head dim") ->
+  ``qk_norm="head"`` — ONE [head_dim] weight shared by all heads, so
+  the fused-QKV column permutation needs no weight reordering.
+- No attention biases (unlike Qwen2) and a decoupled ``head_dim``.
+- Tied embeddings on the small variants (hf_config.tie_word_embeddings).
+- ``use_sliding_window=True`` (non-uniform layer_types) is REFUSED —
+  the released dense Qwen3 checkpoints are full-attention; converting a
+  windowed variant as full attention would silently change semantics.
+
+    from transformers import Qwen3ForCausalLM
+    from tools.convert_hf_qwen3 import convert_qwen3
+
+    hf = Qwen3ForCausalLM.from_pretrained(path)
+    cfg, params = convert_qwen3(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import (
+    _fused_qkv,
+    _map_rope_scaling,
+    _t,
+)
+
+
+def convert_qwen3(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Qwen3ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "use_sliding_window", False):
+        raise ValueError(
+            "use_sliding_window=True (non-uniform layer_types) is not "
+            "supported by this converter; refusing rather than silently "
+            "attending globally")
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        qk_norm="head",
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.input_layernorm.weight"]))},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "q_norm": {"weight": jnp.asarray(
+                    _t(sd[f"{p}.self_attn.q_norm.weight"]))},
+                "k_norm": {"weight": jnp.asarray(
+                    _t(sd[f"{p}.self_attn.k_norm.weight"]))},
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.post_attention_layernorm.weight"]))},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(jnp.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {
+            "weight": jnp.asarray(_t(sd["norm.weight"]))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Qwen3ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Qwen3ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_qwen3(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
