@@ -1244,3 +1244,101 @@ def test_gpt_stage_applies_final_logit_softcapping():
             jnp.ones(()), labels, method=GPTStage.full))
 
     assert abs(stage_loss(capped) - stage_loss(base)) > 1e-3
+
+
+def _tiny_qwen3moe(seed=51, norm_topk=True):
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        moe_intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=32, attention_dropout=0.0,
+        num_experts=8, num_experts_per_tok=2, norm_topk_prob=norm_topk,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        use_sliding_window=False)
+    torch.manual_seed(seed)
+    hf = transformers.Qwen3MoeForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if name.endswith(("q_norm.weight", "k_norm.weight")):
+                p.copy_(1.0 + torch.randn_like(p) * 0.3)
+    return hf, cfg
+
+
+@pytest.mark.parametrize("norm_topk", [True, False])
+def test_logits_match_hf_qwen3moe(norm_topk):
+    """Qwen3-MoE oracle (24th family): the Qwen3 attention stack
+    (per-head qk-norm) + routed-only top-k experts, renormalized
+    (30B-A3B ships norm_topk_prob=true) and raw gate mass."""
+    from tools.convert_hf_qwen3moe import convert_qwen3moe
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_qwen3moe(norm_topk=norm_topk)
+    cfg, params = convert_qwen3moe(hf.state_dict(), hf_cfg)
+    assert cfg.qk_norm == "head"
+    assert cfg.moe_normalize_topk == norm_topk
+    assert cfg.moe_shared_expert_size is None
+
+    tokens = np.random.RandomState(51).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)
+
+
+def test_qwen3moe_greedy_generation_matches_hf():
+    from tools.convert_hf_qwen3moe import convert_qwen3moe
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_qwen3moe(seed=52)
+    cfg, params = convert_qwen3moe(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(52).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_qwen3moe_nonuniform_sparsity_refused():
+    from tools.convert_hf_qwen3moe import convert_qwen3moe
+
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2,
+        num_experts=8, decoder_sparse_step=2)
+    with pytest.raises(ValueError, match="sparsity"):
+        convert_qwen3moe({}, hf_cfg)
+    hf_cfg2 = transformers.Qwen3MoeConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2,
+        num_experts=8, mlp_only_layers=[1])
+    with pytest.raises(ValueError, match="sparsity"):
+        convert_qwen3moe({}, hf_cfg2)
+
+
+def test_qwen3_attention_bias_refused():
+    """attention_bias=True checkpoints carry projection biases the
+    converters do not map — both must refuse, not zero-fill (review
+    finding)."""
+    from tools.convert_hf_qwen3 import convert_qwen3
+    from tools.convert_hf_qwen3moe import convert_qwen3moe
+
+    with pytest.raises(ValueError, match="attention_bias"):
+        convert_qwen3({}, transformers.Qwen3Config(
+            vocab_size=96, hidden_size=48, num_hidden_layers=1,
+            num_attention_heads=4, num_key_value_heads=2,
+            use_sliding_window=False, attention_bias=True))
+    with pytest.raises(ValueError, match="attention_bias"):
+        convert_qwen3moe({}, transformers.Qwen3MoeConfig(
+            vocab_size=96, hidden_size=48, num_hidden_layers=1,
+            num_attention_heads=4, num_key_value_heads=2, num_experts=4,
+            use_sliding_window=False, attention_bias=True))

@@ -1,25 +1,23 @@
-"""Convert a HuggingFace Qwen3 checkpoint into apex_tpu GPTModel params.
+"""Convert a HuggingFace Qwen3-MoE checkpoint into apex_tpu params.
 
-Qwen3 specifics on top of the Llama mapping (convert_hf_llama):
+Qwen3-MoE (Qwen3-30B-A3B class) = the Qwen3 attention stack (per-head
+q/k RMSNorm before rope, decoupled head_dim, no attention biases —
+convert_hf_qwen3) + a routed-only MoE MLP (128 experts top-8, no shared
+expert — contrast Qwen2-MoE's sigmoid-gated shared expert,
+convert_hf_qwen2moe). ``norm_topk_prob`` maps to ``moe_normalize_topk``
+(the released 30B-A3B sets it True). Non-uniform sparsity
+(``decoder_sparse_step != 1`` or non-empty ``mlp_only_layers``) is
+REFUSED — converting it would silently dense-ify some layers.
 
-- Per-head q/k RMSNorm over head_dim before rope (HF modeling_qwen3
-  OlmoeAttention contrast: "unlike olmo, only on the head dim") ->
-  ``qk_norm="head"`` — ONE [head_dim] weight shared by all heads, so
-  the fused-QKV column permutation needs no weight reordering.
-- No attention biases (unlike Qwen2) and a decoupled ``head_dim``.
-- Tied embeddings on the small variants (hf_config.tie_word_embeddings).
-- ``use_sliding_window=True`` (non-uniform layer_types) is REFUSED —
-  the released dense Qwen3 checkpoints are full-attention; converting a
-  windowed variant as full attention would silently change semantics.
+    from transformers import Qwen3MoeForCausalLM
+    from tools.convert_hf_qwen3moe import convert_qwen3moe
 
-    from transformers import Qwen3ForCausalLM
-    from tools.convert_hf_qwen3 import convert_qwen3
-
-    hf = Qwen3ForCausalLM.from_pretrained(path)
-    cfg, params = convert_qwen3(hf.state_dict(), hf.config)
+    hf = Qwen3MoeForCausalLM.from_pretrained(path)
+    cfg, params = convert_qwen3moe(hf.state_dict(), hf.config)
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 import os as _os
 import sys as _sys
@@ -27,40 +25,46 @@ import sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
     _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
 
-from tools.convert_hf_llama import (
-    _fused_qkv,
-    _map_rope_scaling,
-    _t,
-)
+from tools.convert_hf_llama import _fused_qkv, _map_rope_scaling, _t
 
 
-def convert_qwen3(state_dict, hf_config):
-    """(TransformerConfig, params pytree) from a Qwen3ForCausalLM
-    state_dict. Single-device layout (tp=1)."""
+def convert_qwen3moe(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Qwen3MoeForCausalLM
+    state_dict. Single-device layout (tp=1, ep=1)."""
     from apex_tpu.models import TransformerConfig
 
     if getattr(hf_config, "use_sliding_window", False):
-        raise ValueError(
-            "use_sliding_window=True (non-uniform layer_types) is not "
-            "supported by this converter; refusing rather than silently "
-            "attending globally")
+        raise ValueError("use_sliding_window=True is not supported; "
+                         "refusing rather than silently attending "
+                         "globally")
     if getattr(hf_config, "attention_bias", False):
         raise ValueError(
             "attention_bias=True checkpoints carry q/k/v/o biases this "
             "converter does not map; refusing rather than silently "
             "zero-filling them")
+    if (getattr(hf_config, "decoder_sparse_step", 1) != 1
+            or getattr(hf_config, "mlp_only_layers", None)):
+        raise ValueError(
+            f"non-uniform sparsity (decoder_sparse_step="
+            f"{getattr(hf_config, 'decoder_sparse_step', 1)}, "
+            f"mlp_only_layers="
+            f"{getattr(hf_config, 'mlp_only_layers', None)}) is not "
+            f"supported; refusing rather than silently dense-ifying "
+            f"those layers")
 
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
     n = hf_config.num_attention_heads
     g = hf_config.num_key_value_heads
     d = (getattr(hf_config, "head_dim", None)
          or hf_config.hidden_size // n)
+    E = hf_config.num_experts
+    k = hf_config.num_experts_per_tok
     cfg = TransformerConfig(
         head_dim=d,
         hidden_size=hf_config.hidden_size,
         num_layers=hf_config.num_hidden_layers,
         num_attention_heads=n,
-        ffn_hidden_size=hf_config.intermediate_size,
+        ffn_hidden_size=hf_config.moe_intermediate_size,
         vocab_size=hf_config.vocab_size,
         max_position_embeddings=hf_config.max_position_embeddings,
         layernorm_epsilon=hf_config.rms_norm_eps,
@@ -74,6 +78,11 @@ def convert_qwen3(state_dict, hf_config):
         activation="swiglu",
         num_query_groups=(g if g != n else None),
         qk_norm="head",
+        num_moe_experts=E,
+        moe_top_k=k,
+        moe_capacity_factor=float(E) / k,  # dropless
+        moe_normalize_topk=bool(getattr(hf_config, "norm_topk_prob",
+                                        False)),
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
                                     False),
     )
@@ -87,6 +96,13 @@ def convert_qwen3(state_dict, hf_config):
         fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
                            lin_t(f"{p}.self_attn.k_proj.weight"),
                            lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        moe = f"{p}.mlp"
+        w1 = np.stack([np.concatenate(
+            [lin_t(f"{moe}.experts.{e}.gate_proj.weight"),
+             lin_t(f"{moe}.experts.{e}.up_proj.weight")], axis=-1)
+            for e in range(E)])
+        w2 = np.stack([lin_t(f"{moe}.experts.{e}.down_proj.weight")
+                       for e in range(E)])
         layers[f"layer_{i}"] = {
             "input_layernorm": {
                 "weight": jnp.asarray(
@@ -110,15 +126,9 @@ def convert_qwen3(state_dict, hf_config):
                 "weight": jnp.asarray(
                     _t(sd[f"{p}.post_attention_layernorm.weight"]))},
             "mlp": {
-                "dense_h_to_4h": {
-                    "weight": jnp.asarray(jnp.concatenate(
-                        [lin_t(f"{p}.mlp.gate_proj.weight"),
-                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
-                },
-                "dense_4h_to_h": {
-                    "weight": jnp.asarray(
-                        lin_t(f"{p}.mlp.down_proj.weight")),
-                },
+                "router": {"gate_weight": jnp.asarray(
+                    lin_t(f"{moe}.gate.weight"))},
+                "experts": {"w1": jnp.asarray(w1), "w2": jnp.asarray(w2)},
             },
         }
 
@@ -140,12 +150,12 @@ def main():
     ap.add_argument("model_path")
     ap.add_argument("out_dir")
     args = ap.parse_args()
-    from transformers import Qwen3ForCausalLM
+    from transformers import Qwen3MoeForCausalLM
 
     from apex_tpu import checkpoint
 
-    hf = Qwen3ForCausalLM.from_pretrained(args.model_path)
-    cfg, params = convert_qwen3(hf.state_dict(), hf.config)
+    hf = Qwen3MoeForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_qwen3moe(hf.state_dict(), hf.config)
     path = checkpoint.save(args.out_dir, 0, {"params": params,
                                              "config": vars(cfg)})
     print("saved:", path)
