@@ -125,6 +125,24 @@ FAMILIES = {
                      num_experts_per_tok=2, norm_topk_prob=False,
                      max_position_embeddings=32, attention_dropout=0.0,
                      use_sliding_window=False)),
+    "gemma2": ("convert_hf_gemma2", "Gemma2ForCausalLM",
+               lambda t: t.Gemma2Config(
+                   num_key_value_heads=2, head_dim=16, sliding_window=32,
+                   attn_implementation="eager", **_LLAMA_KW)),
+    "olmoe": ("convert_hf_olmoe", "OlmoeForCausalLM",
+              lambda t: t.OlmoeConfig(
+                  num_key_value_heads=2, num_experts=8,
+                  num_experts_per_tok=2, clip_qkv=None, **_LLAMA_KW)),
+    "qwen3": ("convert_hf_qwen3", "Qwen3ForCausalLM",
+              lambda t: t.Qwen3Config(num_key_value_heads=2, head_dim=16,
+                                      use_sliding_window=False,
+                                      **_LLAMA_KW)),
+    "qwen3moe": ("convert_hf_qwen3moe", "Qwen3MoeForCausalLM",
+                 lambda t: t.Qwen3MoeConfig(
+                     num_key_value_heads=2, head_dim=16,
+                     moe_intermediate_size=24, num_experts=8,
+                     num_experts_per_tok=2, norm_topk_prob=True,
+                     use_sliding_window=False, **_LLAMA_KW)),
 }
 
 

@@ -319,7 +319,8 @@ class TestSplitParamsForTP:
 
     @pytest.mark.parametrize("arch", ["mha_gelu", "gqa_swiglu",
                                       "phi_style", "mistral_swa",
-                                      "bloom_alibi"])
+                                      "bloom_alibi", "qwen3_qknorm",
+                                      "gemma2_sandwich"])
     def test_tp2_matches_tp1_greedy(self, arch):
         from apex_tpu.models import (GPTModel, TransformerConfig, generate,
                                      split_params_for_tp,
@@ -345,6 +346,26 @@ class TestSplitParamsForTP:
             # pins the per-rank slope slice (heads sharded over tp)
             kw = dict(position_embedding_type="alibi",
                       embedding_layernorm=True)
+        elif arch == "qwen3_qknorm":
+            # per-head qk-norm: the [head_dim] weight replicates across
+            # tp while the projections it norms are head-sharded
+            kw = dict(num_query_groups=2, activation="swiglu",
+                      normalization="rmsnorm", qk_norm="head",
+                      head_dim=16, position_embedding_type="rope")
+        elif arch == "gemma2_sandwich":
+            # the full Gemma-2 knob set under tp: sandwich norms
+            # (replicated), softcaps (elementwise, shard-safe),
+            # alternating local/global windows, decoupled softmax
+            # scale, geglu, scaled tied embeddings
+            kw = dict(num_query_groups=2, activation="geglu",
+                      normalization="rmsnorm", sliding_window=5,
+                      sliding_window_pattern=2, sandwich_norm=True,
+                      attn_logit_softcapping=30.0,
+                      final_logit_softcapping=10.0,
+                      query_pre_attn_scalar=20.0,
+                      embedding_multiplier=5.657,
+                      tie_word_embeddings=True,
+                      position_embedding_type="rope")
         cfg = TransformerConfig(
             hidden_size=32, num_layers=2, num_attention_heads=4,
             vocab_size=64, max_position_embeddings=32,
