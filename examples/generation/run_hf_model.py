@@ -137,6 +137,11 @@ FAMILIES = {
               lambda t: t.Qwen3Config(num_key_value_heads=2, head_dim=16,
                                       use_sliding_window=False,
                                       **_LLAMA_KW)),
+    "phi3": ("convert_hf_phi3", "Phi3ForCausalLM",
+             lambda t: t.Phi3Config(num_key_value_heads=2,
+                                    rope_scaling=None, pad_token_id=0,
+                                    bos_token_id=1, eos_token_id=2,
+                                    **_LLAMA_KW)),
     "qwen3moe": ("convert_hf_qwen3moe", "Qwen3MoeForCausalLM",
                  lambda t: t.Qwen3MoeConfig(
                      num_key_value_heads=2, head_dim=16,

@@ -1342,3 +1342,106 @@ def test_qwen3_attention_bias_refused():
             vocab_size=96, hidden_size=48, num_hidden_layers=1,
             num_attention_heads=4, num_key_value_heads=2, num_experts=4,
             use_sliding_window=False, attention_bias=True))
+
+
+def _tiny_phi3(seed=61, window=None):
+    cfg = transformers.Phi3Config(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        attention_dropout=0.0, sliding_window=window, rope_scaling=None,
+        # HF defaults (pad 32000, eos 32000) exceed the tiny vocab
+        pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(seed)
+    return transformers.Phi3ForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("window", [None, 8])
+def test_logits_match_hf_phi3(window):
+    """Phi-3 oracle (25th family): the fused [q_all|k_all|v_all]
+    qkv_proj re-sliced into our per-group layout, the [gate|up]
+    gate_up_proj mapped verbatim onto fused swiglu, uniform sliding
+    window (mini-128k shape, window < seq so it bites)."""
+    from tools.convert_hf_phi3 import convert_phi3
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_phi3(window=window)
+    cfg, params = convert_phi3(hf.state_dict(), hf_cfg)
+    assert cfg.activation == "swiglu"
+    assert cfg.sliding_window == window
+
+    tokens = np.random.RandomState(61).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_phi3_greedy_generation_matches_hf():
+    from tools.convert_hf_phi3 import convert_phi3
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_phi3(seed=62)
+    cfg, params = convert_phi3(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(62).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_phi3_longrope_refused():
+    """longrope (su) short/long factor tables are seq-dependent — must
+    be refused by _map_rope_scaling, not ignored."""
+    from tools.convert_hf_phi3 import convert_phi3
+
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=96, hidden_size=48, num_hidden_layers=1,
+        num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+        original_max_position_embeddings=32,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0] * 6,
+                      "long_factor": [2.0] * 6})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        convert_phi3({}, hf_cfg)
+
+
+def test_logits_match_hf_phi3_partial_rotary():
+    """partial_rotary_factor=0.5 parity: HF rotates the leading
+    rotary_dim dims (rotate-half) — must land on our rotary_percent
+    convention, not silently stay full-rotary (review finding)."""
+    from tools.convert_hf_phi3 import convert_phi3
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        attention_dropout=0.0, rope_scaling=None,
+        partial_rotary_factor=0.5,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(63)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    cfg, params = convert_phi3(hf.state_dict(), hf_cfg)
+    assert cfg.rotary_percent == 0.5
+
+    tokens = np.random.RandomState(63).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4,
+                               atol=3e-4)

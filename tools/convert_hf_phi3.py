@@ -1,0 +1,98 @@
+"""Convert a HuggingFace Phi-3 checkpoint into apex_tpu GPTModel params.
+
+Phi-3 (mini/medium 4k) is the Llama mapping (convert_llama) with two
+fused projections and two extra knobs, so this converter just un-fuses
+and delegates (the convert_hf_mistral pattern — the llama mapping stays
+the single source of truth):
+
+- ONE fused ``qkv_proj`` laid out [q_all | k_all | v_all] (HF
+  modeling_phi3 Phi3Attention.forward slices by query_pos) -> sliced
+  back into per-kind q/k/v_proj weights.
+- ONE fused ``gate_up_proj`` laid out [gate | up] -> split into
+  gate/up_proj halves.
+- Uniform sliding window (mini-128k) -> ``cfg.sliding_window``;
+  ``partial_rotary_factor`` (phi-3-small lineage; HF rotates the
+  leading rotary_dim dims, rotate-half — our rotary_percent
+  convention) -> ``cfg.rotary_percent``.
+- ``rope_scaling`` type "longrope" (su short/long factor tables —
+  seq-length-dependent frequency switching) is REFUSED inside
+  convert_llama's ``_map_rope_scaling``; the 4k checkpoints carry
+  ``rope_scaling=None`` and convert exactly.
+
+    from transformers import Phi3ForCausalLM
+    from tools.convert_hf_phi3 import convert_phi3
+
+    hf = Phi3ForCausalLM.from_pretrained(path)
+    cfg, params = convert_phi3(hf.state_dict(), hf.config)
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _t, convert_llama
+
+
+def convert_phi3(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Phi3ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    import dataclasses
+
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+
+    # un-fuse into the per-kind keys convert_llama expects (torch Linear
+    # weights are [out, in]: row slices select output features)
+    synth = {}
+    for key, v in state_dict.items():
+        if key.endswith("self_attn.qkv_proj.weight"):
+            base = key[:-len("qkv_proj.weight")]
+            arr = _t(v)  # [(n + 2g) * d, h]
+            synth[base + "q_proj.weight"] = arr[:n * d]
+            synth[base + "k_proj.weight"] = arr[n * d:(n + g) * d]
+            synth[base + "v_proj.weight"] = arr[(n + g) * d:]
+        elif key.endswith("mlp.gate_up_proj.weight"):
+            base = key[:-len("gate_up_proj.weight")]
+            arr = _t(v)  # [2 * ffn, h]
+            ffn = arr.shape[0] // 2
+            synth[base + "gate_proj.weight"] = arr[:ffn]
+            synth[base + "up_proj.weight"] = arr[ffn:]
+        else:
+            synth[key] = v
+
+    cfg, params = convert_llama(synth, hf_config)
+    rep = {}
+    window = getattr(hf_config, "sliding_window", None)
+    if window is not None:
+        rep["sliding_window"] = window
+    pct = float(getattr(hf_config, "partial_rotary_factor", 1.0))
+    if pct != 1.0:
+        rep["rotary_percent"] = pct
+    if rep:
+        cfg = dataclasses.replace(cfg, **rep)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Phi3ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Phi3ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_phi3(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
