@@ -19,6 +19,7 @@ from apex_tpu.models.generation import (  # noqa: F401
     init_cache,
     init_params_tp,
     sample_logits,
+    speculative_generate,
     tensor_parallel_beam_search,
     tensor_parallel_generate,
 )

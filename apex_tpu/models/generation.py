@@ -242,7 +242,9 @@ def tensor_parallel_beam_search(model, stacked_params, prompt_tokens,
 
 
 def _validate_decode(fn_name, model, prompt_tokens, max_new_tokens):
-    """Shared decode-entry validation (all four public entry points)."""
+    """Shared decode-entry validation (all five public entry points;
+    speculative_generate validates both of its models through here with
+    the draft-window headroom added to max_new_tokens)."""
     if not getattr(model, "decode", False):
         raise ValueError(f"{fn_name}() needs a model built with "
                          f"decode=True")
@@ -309,6 +311,155 @@ def generate(model, params, prompt_tokens, max_new_tokens: int, *,
         top_k, top_p, eos_token_id, pad_token_id)
     out = _prefill_and_decode(prefill, decode_all, model, params,
                               prompt_tokens, rng)
+    return jnp.concatenate([prompt_tokens, out], axis=1)
+
+
+def _set_cache_index(cache, value):
+    """Roll every layer's scalar ``cache_index`` to ``value`` (leaves
+    beyond the index stay resident but masked — the decode attention
+    masks by absolute position, so a rollback is just the index)."""
+    def fix(path, leaf):
+        names = [getattr(e, "key", None) for e in path]
+        if names and names[-1] == "cache_index":
+            return jnp.full_like(leaf, value)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_speculative(target, draft, plen, max_new, k, eos_token_id,
+                          pad_token_id):
+    """Jitted speculative-decode engine (greedy): per round the draft
+    proposes ``k`` tokens via its own KV cache, the target verifies all
+    of them in ONE (k+1)-token chunk forward, and the longest matching
+    prefix plus one target token (correction on mismatch, bonus on full
+    accept) is emitted. Output is token-exact vs target-alone greedy:
+    every emitted token is an argmax of target logits over the same
+    prefix. Batch rows accept the round-wise MINIMUM across the batch —
+    still exact per row (a shorter accepted prefix is still a verified
+    prefix), just less speedup on skewed batches."""
+
+    @jax.jit
+    def run(tparams, dparams, tcache, dcache, prompt):
+        b = prompt.shape[0]
+        pos = jnp.arange(plen)[None, :]
+        tlg, tmut = target.apply({"params": tparams, "cache": tcache},
+                                 prompt, pos, mutable=["cache"])
+        _, dmut = draft.apply({"params": dparams, "cache": dcache},
+                              prompt, pos, mutable=["cache"])
+        tcache, dcache = tmut["cache"], dmut["cache"]
+        last = jnp.argmax(_full_vocab(tlg[:, -1]), -1).astype(jnp.int32)
+
+        buf_w = max_new + k + 1
+        out = jnp.full((b, buf_w), pad_token_id, jnp.int32)
+        out = out.at[:, 0].set(last)
+        n0 = jnp.asarray(1, jnp.int32)
+
+        def cond(c):
+            return c[0] < max_new
+
+        def body(c):
+            n, last, out, tcache, dcache = c
+
+            # draft: k proposals + one cache-completion feed of d_k, so
+            # the draft cache never has a hole after a full accept
+            def dstep(carry, _):
+                dc, tok = carry
+                lg, mut = draft.apply({"params": dparams, "cache": dc},
+                                      tok[:, None], None,
+                                      mutable=["cache"])
+                nxt = jnp.argmax(_full_vocab(lg[:, -1]), -1).astype(
+                    jnp.int32)
+                return (mut["cache"], nxt), nxt
+
+            (dcache, _), ds = jax.lax.scan(dstep, (dcache, last), None,
+                                           length=k + 1)
+            d = ds[:k].T  # [b, k]; ds[k] is the completion feed's output
+
+            # target verifies the whole window in one chunk: logits[i]
+            # predicts the position after chunk[:, i]
+            chunk = jnp.concatenate([last[:, None], d], axis=1)
+            tlg, tmut = target.apply({"params": tparams, "cache": tcache},
+                                     chunk, None, mutable=["cache"])
+            tcache = tmut["cache"]
+            v = jnp.argmax(_full_vocab(tlg), -1).astype(jnp.int32)
+
+            match = (d == v[:, :k]).astype(jnp.int32)
+            a = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))
+            corr = jax.lax.dynamic_index_in_dim(v, a, axis=1,
+                                                keepdims=False)
+            base = jnp.concatenate([d, d[:, -1:]], axis=1)
+            emit = jnp.where(jnp.arange(k + 1)[None, :] == a,
+                             corr[:, None], base)
+            out = jax.lax.dynamic_update_slice(out, emit, (0, n))
+            n = n + a + 1
+            # both caches must hold exactly the positions before the new
+            # `last` (at plen + n - 1); stale tail entries are masked
+            t_new = plen + n - 1
+            return (n, corr, out, _set_cache_index(tcache, t_new),
+                    _set_cache_index(dcache, t_new))
+
+        n, _, out, _, _ = jax.lax.while_loop(
+            cond, body, (n0, last, out, tcache, dcache))
+        out = out[:, :max_new]
+        if eos_token_id is not None:
+            is_eos = (out == eos_token_id).astype(jnp.int32)
+            after = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+            out = jnp.where(after, pad_token_id, out)
+        return out
+
+    return run
+
+
+def speculative_generate(target_model, target_params, draft_model,
+                         draft_params, prompt_tokens,
+                         max_new_tokens: int, *, num_draft_tokens: int = 4,
+                         eos_token_id: Optional[int] = None,
+                         pad_token_id: int = 0):
+    """Greedy speculative decoding: a small draft model proposes
+    ``num_draft_tokens`` per round, the target verifies them in one
+    chunked forward over its KV cache, and the accepted prefix plus one
+    target token is emitted. Token-exact vs ``generate(target, ...)``
+    greedy — every output token is a target-argmax over the same prefix
+    (the draft only affects HOW MANY target forwards are needed, never
+    the result). Sampling is not supported (rejection-sampling
+    speculative decoding is a different contract); both models must be
+    built with ``decode=True`` and share a tokenizer/vocab.
+
+    The cache-rollback trick: decode attention masks by absolute
+    position against each layer's scalar ``cache_index``, so rejecting
+    draft tokens costs one index reset — stale K/V rows stay resident
+    but invisible until overwritten."""
+    from apex_tpu.transformer.parallel_state import (
+        get_tensor_model_parallel_world_size,
+    )
+
+    if get_tensor_model_parallel_world_size() > 1:
+        raise NotImplementedError(
+            "speculative_generate() drives tp=1 models")
+    if num_draft_tokens < 1:
+        raise ValueError(f"num_draft_tokens ({num_draft_tokens}) must "
+                         f"be >= 1")
+    if (target_model.config.vocab_size
+            != draft_model.config.vocab_size):
+        raise ValueError(
+            f"target vocab ({target_model.config.vocab_size}) != draft "
+            f"vocab ({draft_model.config.vocab_size}): draft proposals "
+            f"would be clamped/garbled in the target embedding — the "
+            f"models must share a tokenizer")
+    for m in (target_model, draft_model):
+        # the draft window overshoots by up to num_draft_tokens beyond
+        # the emitted tokens, so validate with that headroom included
+        _validate_decode("speculative_generate", m, prompt_tokens,
+                         max_new_tokens + num_draft_tokens)
+    b, plen = prompt_tokens.shape
+    run = _compiled_speculative(
+        target_model, draft_model, plen, max_new_tokens,
+        int(num_draft_tokens), eos_token_id, pad_token_id)
+    tcache = init_cache(target_model, b, prompt_tokens.dtype)
+    dcache = init_cache(draft_model, b, prompt_tokens.dtype)
+    out = run(target_params, draft_params, tcache, dcache, prompt_tokens)
     return jnp.concatenate([prompt_tokens, out], axis=1)
 
 

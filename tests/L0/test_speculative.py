@@ -1,0 +1,131 @@
+"""Speculative decoding: token-exactness vs plain greedy is the whole
+contract — the draft model must never change WHAT is generated, only
+how many target forwards it takes. Oracled against generate() with
+drafts ranging from perfect (the target itself: every round fully
+accepts and takes the bonus-token path) to adversarial (an unrelated
+random model: every round rejects at position 0 and degenerates to one
+token per round)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models import (
+    GPTModel,
+    TransformerConfig,
+    generate,
+    speculative_generate,
+)
+from apex_tpu.transformer import parallel_state
+
+
+def _cfg(layers=3, hidden=48, **kw):
+    return TransformerConfig(
+        hidden_size=hidden, num_layers=layers, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=64,
+        compute_dtype=jnp.float32, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", **kw)
+
+
+def _model_and_params(cfg, seed, prompt):
+    model = GPTModel(cfg, decode=True)
+    params = model.init(jax.random.PRNGKey(seed), prompt)["params"]
+    return model, params
+
+
+@pytest.fixture(autouse=True)
+def _single_device():
+    parallel_state.destroy_model_parallel()
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_speculative_matches_greedy_independent_draft(k):
+    """A smaller independently-initialized draft (partial agreement —
+    the realistic regime): output must equal target-alone greedy."""
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, size=(2, 8)))
+    target, tparams = _model_and_params(_cfg(layers=3), 1, prompt)
+    draft, dparams = _model_and_params(_cfg(layers=1, hidden=32), 2,
+                                       prompt)
+    ref = generate(target, tparams, prompt, 12)
+    out = speculative_generate(target, tparams, draft, dparams, prompt,
+                               12, num_draft_tokens=k)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_perfect_draft_full_accept_path():
+    """Draft == target: every round fully accepts and emits the bonus
+    token — exercises the a == k branch and the draft-cache completion
+    feed."""
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, 128, size=(2, 6)))
+    target, tparams = _model_and_params(_cfg(), 4, prompt)
+    ref = generate(target, tparams, prompt, 10)
+    out = speculative_generate(target, tparams, target, tparams, prompt,
+                               10, num_draft_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_adversarial_draft_still_exact():
+    """An unrelated random draft (near-zero acceptance): the engine
+    degenerates to ~one target token per round but stays exact."""
+    prompt = jnp.asarray(
+        np.random.RandomState(5).randint(0, 128, size=(1, 5)))
+    target, tparams = _model_and_params(_cfg(), 6, prompt)
+    draft, dparams = _model_and_params(_cfg(layers=1, hidden=32), 7,
+                                       prompt)
+    ref = generate(target, tparams, prompt, 9)
+    out = speculative_generate(target, tparams, draft, dparams, prompt,
+                               9, num_draft_tokens=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_eos_padding_matches_generate():
+    """Positions after the first eos pad exactly as generate() pads
+    them (the buffer may transiently hold recomputed tokens past eos —
+    they must never surface)."""
+    prompt = jnp.asarray(
+        np.random.RandomState(9).randint(0, 128, size=(2, 6)))
+    target, tparams = _model_and_params(_cfg(), 10, prompt)
+    draft, dparams = _model_and_params(_cfg(layers=1, hidden=32), 11,
+                                       prompt)
+    ref = generate(target, tparams, prompt, 12)
+    # pick the token the target actually emits early so eos fires
+    eos = int(np.asarray(ref)[0, 8])
+    ref_eos = generate(target, tparams, prompt, 12, eos_token_id=eos,
+                       pad_token_id=0)
+    out = speculative_generate(target, tparams, draft, dparams, prompt,
+                               12, num_draft_tokens=3, eos_token_id=eos,
+                               pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_eos))
+
+
+def test_speculative_validation():
+    prompt = jnp.asarray(np.zeros((1, 4), np.int32))
+    target, tparams = _model_and_params(_cfg(), 12, prompt)
+    nodecode = GPTModel(_cfg())
+    with pytest.raises(ValueError, match="decode=True"):
+        speculative_generate(nodecode, tparams, target, tparams, prompt,
+                             4)
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        speculative_generate(target, tparams, target, tparams, prompt,
+                             4, num_draft_tokens=0)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        speculative_generate(target, tparams, target, tparams, prompt,
+                             60, num_draft_tokens=4)
+
+
+def test_speculative_vocab_mismatch_refused():
+    """Mismatched vocabs would silently clamp draft ids in the target
+    embedding (zero acceptance, no error) — refuse loudly instead."""
+    prompt = jnp.asarray(np.zeros((1, 4), np.int32))
+    target, tparams = _model_and_params(_cfg(), 13, prompt)
+    small_vocab = dataclasses.replace(_cfg(layers=1, hidden=32),
+                                      vocab_size=64)
+    draft, dparams = _model_and_params(small_vocab, 14, prompt)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(target, tparams, draft, dparams, prompt, 4)
