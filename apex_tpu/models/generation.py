@@ -241,19 +241,23 @@ def tensor_parallel_beam_search(model, stacked_params, prompt_tokens,
     return jnp.concatenate([prompt_tokens, best_seqs], axis=1), best_scores
 
 
-def _validate_decode(fn_name, model, prompt_tokens, max_new_tokens):
+def _validate_decode(fn_name, model, prompt_tokens, max_new_tokens,
+                     draft_window=0):
     """Shared decode-entry validation (all five public entry points;
     speculative_generate validates both of its models through here with
-    the draft-window headroom added to max_new_tokens)."""
+    the draft-window headroom passed separately so errors report the
+    caller's own numbers)."""
     if not getattr(model, "decode", False):
         raise ValueError(f"{fn_name}() needs a model built with "
                          f"decode=True")
     plen = prompt_tokens.shape[1]
     limit = model.config.max_position_embeddings
-    if plen + max_new_tokens > limit:
+    if plen + max_new_tokens + draft_window > limit:
+        extra = (f" + draft window ({draft_window})" if draft_window
+                 else "")
         raise ValueError(
-            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_position_embeddings ({limit})")
+            f"prompt ({plen}) + max_new_tokens ({max_new_tokens})"
+            f"{extra} exceeds max_position_embeddings ({limit})")
 
 
 def _prep_decode(fn_name, model, prompt_tokens, max_new_tokens, rng,
@@ -361,27 +365,35 @@ def _compiled_speculative(target, draft, plen, max_new, k, eos_token_id,
 
         def body(c):
             n, last, out, tcache, dcache = c
+            # absolute position of `last` — passed EXPLICITLY on every
+            # decode forward: learned-position models embed by
+            # position_ids (the arange default only suits prefill), and
+            # rope models accept the same explicit positions
+            t0 = plen + n - 1
 
             # draft: k proposals + one cache-completion feed of d_k, so
             # the draft cache never has a hole after a full accept
-            def dstep(carry, _):
+            def dstep(carry, i):
                 dc, tok = carry
+                pos = jnp.broadcast_to((t0 + i)[None, None], (b, 1))
                 lg, mut = draft.apply({"params": dparams, "cache": dc},
-                                      tok[:, None], None,
+                                      tok[:, None], pos,
                                       mutable=["cache"])
                 nxt = jnp.argmax(_full_vocab(lg[:, -1]), -1).astype(
                     jnp.int32)
                 return (mut["cache"], nxt), nxt
 
-            (dcache, _), ds = jax.lax.scan(dstep, (dcache, last), None,
-                                           length=k + 1)
+            (dcache, _), ds = jax.lax.scan(dstep, (dcache, last),
+                                           jnp.arange(k + 1))
             d = ds[:k].T  # [b, k]; ds[k] is the completion feed's output
 
             # target verifies the whole window in one chunk: logits[i]
             # predicts the position after chunk[:, i]
             chunk = jnp.concatenate([last[:, None], d], axis=1)
+            cpos = jnp.broadcast_to((t0 + jnp.arange(k + 1))[None, :],
+                                    (b, k + 1))
             tlg, tmut = target.apply({"params": tparams, "cache": tcache},
-                                     chunk, None, mutable=["cache"])
+                                     chunk, cpos, mutable=["cache"])
             tcache = tmut["cache"]
             v = jnp.argmax(_full_vocab(tlg), -1).astype(jnp.int32)
 
@@ -452,7 +464,7 @@ def speculative_generate(target_model, target_params, draft_model,
         # the draft window overshoots by up to num_draft_tokens beyond
         # the emitted tokens, so validate with that headroom included
         _validate_decode("speculative_generate", m, prompt_tokens,
-                         max_new_tokens + num_draft_tokens)
+                         max_new_tokens, draft_window=num_draft_tokens)
     b, plen = prompt_tokens.shape
     run = _compiled_speculative(
         target_model, draft_model, plen, max_new_tokens,

@@ -191,6 +191,10 @@ class TransformerConfig:
     # BEFORE its residual add (x + post_norm(branch(pre_norm(x)))) —
     # adds post_self_attn_norm / post_mlp_norm params per layer.
     sandwich_norm: bool = False
+    # False -> no input/pre-MLP norms: branches read the RAW residual
+    # stream (OLMo-2 post-norm blocks: x + post_norm(branch(x))).
+    # Requires sandwich_norm (a block with no norms at all is refused).
+    pre_norm: bool = True
     normalization: str = "layernorm"  # or "rmsnorm"
     # BLOOM applies a layernorm directly after the token embeddings.
     embedding_layernorm: bool = False
@@ -267,6 +271,13 @@ class TransformerConfig:
             raise ValueError(
                 "sandwich_norm and parallel_residual are mutually "
                 "exclusive residual forms")
+        if not self.pre_norm and not self.sandwich_norm:
+            # (parallel_residual is already excluded transitively: it is
+            # mutually exclusive with the sandwich_norm required here)
+            raise ValueError(
+                "pre_norm=False (OLMo-2 post-norm blocks) requires "
+                "sandwich_norm=True — a block with no norms at all "
+                "is almost certainly a config mistake")
         if self.parallel_residual_shared_ln and not self.parallel_residual:
             raise ValueError(
                 "parallel_residual_shared_ln requires parallel_residual")
@@ -877,9 +888,12 @@ class ParallelTransformerLayer(nn.Module):
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
-        ln1 = _make_norm(cfg, "input_layernorm")
-        ln1_out = ln1(hidden_states.astype(jnp.float32)).astype(
-            cfg.compute_dtype)
+        if cfg.pre_norm:
+            ln1 = _make_norm(cfg, "input_layernorm")
+            ln1_out = ln1(hidden_states.astype(jnp.float32)).astype(
+                cfg.compute_dtype)
+        else:  # OLMo-2: the attention branch reads the raw stream
+            ln1_out = hidden_states.astype(cfg.compute_dtype)
         attn_out = ParallelAttention(cfg, decode=self.decode,
                                      layer_number=self.layer_number,
                                      name="self_attention")(
@@ -892,8 +906,11 @@ class ParallelTransformerLayer(nn.Module):
         if not cfg.parallel_residual:
             hidden_states = hidden_states + attn_out.astype(
                 hidden_states.dtype)
-        # Phi/Falcon-7b: no second norm — both branches read ln1's output
-        ln2 = (None if cfg.parallel_residual_shared_ln
+        # Phi/Falcon-7b: no second norm — both branches read ln1's
+        # output. OLMo-2 (pre_norm=False): no pre-MLP norm either — the
+        # MLP reads the post-attention residual stream raw.
+        ln2 = (None if (cfg.parallel_residual_shared_ln
+                        or not cfg.pre_norm)
                else _make_norm(cfg, "post_attention_layernorm"))
         if self._is_moe_layer() and cfg.moe_shared_expert_size:
             from apex_tpu.transformer.moe.layer import SharedExpertMoE
@@ -931,9 +948,14 @@ class ParallelTransformerLayer(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel, name="mlp")
         else:
             mlp = ParallelMLP(cfg, name="mlp")
-        mlp_in = (ln1_out if ln2 is None else
-                  ln2(hidden_states.astype(jnp.float32)).astype(
-                      cfg.compute_dtype))
+        if ln2 is not None:
+            mlp_in = ln2(hidden_states.astype(jnp.float32)).astype(
+                cfg.compute_dtype)
+        elif not cfg.pre_norm:
+            # OLMo-2: the MLP reads the post-attention residual raw
+            mlp_in = hidden_states.astype(cfg.compute_dtype)
+        else:  # Phi/Falcon-7b shared-LN: both branches read ln1's output
+            mlp_in = ln1_out
         mlp_out = mlp(mlp_in)
         if cfg.sandwich_norm:
             mlp_out = _make_norm(cfg, "post_mlp_norm")(

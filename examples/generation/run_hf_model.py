@@ -129,6 +129,9 @@ FAMILIES = {
                lambda t: t.Gemma2Config(
                    num_key_value_heads=2, head_dim=16, sliding_window=32,
                    attn_implementation="eager", **_LLAMA_KW)),
+    "olmo2": ("convert_hf_olmo2", "Olmo2ForCausalLM",
+              lambda t: t.Olmo2Config(num_key_value_heads=2,
+                                      **_LLAMA_KW)),
     "olmoe": ("convert_hf_olmoe", "OlmoeForCausalLM",
               lambda t: t.OlmoeConfig(
                   num_key_value_heads=2, num_experts=8,

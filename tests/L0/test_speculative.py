@@ -129,3 +129,22 @@ def test_speculative_vocab_mismatch_refused():
     draft, dparams = _model_and_params(small_vocab, 14, prompt)
     with pytest.raises(ValueError, match="vocab"):
         speculative_generate(target, tparams, draft, dparams, prompt, 4)
+
+
+def test_speculative_learned_positions_exact():
+    """GPT-2-style learned position embeddings: decode steps MUST get
+    explicit absolute position_ids (the arange default embeds every
+    step at position 0) — this oracles the engine's position plumbing
+    (review finding)."""
+    cfg = dataclasses.replace(_cfg(), position_embedding_type="learned",
+                              normalization="layernorm",
+                              activation="gelu")
+    prompt = jnp.asarray(
+        np.random.RandomState(15).randint(0, 128, size=(2, 7)))
+    target, tparams = _model_and_params(cfg, 16, prompt)
+    dcfg = dataclasses.replace(cfg, num_layers=1)
+    draft, dparams = _model_and_params(dcfg, 17, prompt)
+    ref = generate(target, tparams, prompt, 10)
+    out = speculative_generate(target, tparams, draft, dparams, prompt,
+                               10, num_draft_tokens=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -1,0 +1,146 @@
+"""Convert a HuggingFace OLMo-2 checkpoint into apex_tpu GPTModel params.
+
+OLMo-2 (allenai OLMo-2-1124) specifics:
+
+- POST-norm blocks (HF modeling_olmo2 Olmo2DecoderLayer: no input
+  norms — ``x + post_attention_layernorm(attn(x))`` then
+  ``x + post_feedforward_layernorm(mlp(x))``) ->
+  ``pre_norm=False, sandwich_norm=True``; HF's two norms land on the
+  output-side ``post_self_attn_norm`` / ``post_mlp_norm`` slots.
+- Projection-wide q/k RMSNorm before rope (same placement as OLMoE,
+  over the full [heads*d] / [groups*d] vectors) ->
+  ``qk_norm="projection"``.
+- Otherwise the Llama shape: RMSNorm final norm, RoPE, SwiGLU, no
+  attention biases, untied head.
+
+    from transformers import Olmo2ForCausalLM
+    from tools.convert_hf_olmo2 import convert_olmo2
+
+    hf = Olmo2ForCausalLM.from_pretrained(path)
+    cfg, params = convert_olmo2(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _map_rope_scaling, _t
+
+
+def convert_olmo2(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from an Olmo2ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        qk_norm="projection",
+        pre_norm=False,
+        sandwich_norm=True,
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                # full-projection q/k norms (head order matches the
+                # fused layout — see convert_hf_olmoe)
+                "q_norm": {"weight": jnp.asarray(
+                    _t(sd[f"{p}.self_attn.q_norm.weight"]))},
+                "k_norm": {"weight": jnp.asarray(
+                    _t(sd[f"{p}.self_attn.k_norm.weight"]))},
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            # HF's post-norms are output-side: our sandwich slots
+            "post_self_attn_norm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.post_attention_layernorm.weight"]))},
+            "post_mlp_norm": {
+                "weight": jnp.asarray(
+                    _t(sd[f"{p}.post_feedforward_layernorm.weight"]))},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(jnp.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {
+            "weight": jnp.asarray(_t(sd["norm.weight"]))},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Olmo2ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Olmo2ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_olmo2(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
