@@ -113,6 +113,11 @@ class GPTModel(nn.Module):
                     "lm_head_bias", nn.initializers.zeros,
                     (vocab_per_rank,), cfg.params_dtype).astype(
                         logits.dtype)
+        if cfg.logits_scaling != 1.0:
+            # Granite: logits are DIVIDED by the scaling (elementwise,
+            # shard-safe)
+            logits = logits / jnp.asarray(cfg.logits_scaling,
+                                          logits.dtype)
         if cfg.final_logit_softcapping is not None:
             # Gemma-2: logits -> cap * tanh(logits / cap), fp32 (HF
             # modeling_gemma2 Gemma2ForCausalLM.forward). Elementwise, so

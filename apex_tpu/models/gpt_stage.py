@@ -108,6 +108,9 @@ class GPTStage(nn.Module):
                             preferred_element_type=jnp.float32)
         if self.lm_head_bias is not None:
             logits = logits + self.lm_head_bias.astype(logits.dtype)
+        if cfg.logits_scaling != 1.0:  # Granite divisor — as in GPTModel
+            logits = logits / jnp.asarray(cfg.logits_scaling,
+                                          logits.dtype)
         if cfg.final_logit_softcapping is not None:
             # same cap as GPTModel's head — a pipelined softcap model
             # must not silently train on uncapped logits

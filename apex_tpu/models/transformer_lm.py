@@ -139,6 +139,10 @@ class TransformerConfig:
     # Long-context RoPE frequency rescaling (Llama-3.1 "llama3" or
     # position-interpolation "linear"); None -> unscaled frequencies.
     rope_scaling: Optional[RopeScaling] = None
+    # Gemma-3: layers whose sliding window applies use THIS rope base
+    # and skip rope_scaling (local 10k vs global 1M + linear scaling);
+    # None -> every layer uses rotary_base/rope_scaling.
+    rotary_base_local: Optional[float] = None
     # Query/key RMSNorm before rope: "projection" (OLMoE — one norm over
     # the full flattened q / k projection output) or "head" (Qwen3 —
     # per-head over head_dim, tensor-parallel-safe). None -> off.
@@ -195,6 +199,11 @@ class TransformerConfig:
     # stream (OLMo-2 post-norm blocks: x + post_norm(branch(x))).
     # Requires sandwich_norm (a block with no norms at all is refused).
     pre_norm: bool = True
+    # Granite muP-style scalars: each branch output is scaled before
+    # its residual add (x + m * branch(...)), and LM logits are DIVIDED
+    # by logits_scaling (HF modeling_granite "main diff with Llama").
+    residual_multiplier: float = 1.0
+    logits_scaling: float = 1.0
     normalization: str = "layernorm"  # or "rmsnorm"
     # BLOOM applies a layernorm directly after the token embeddings.
     embedding_layernorm: bool = False
@@ -249,6 +258,10 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown qk_norm {self.qk_norm!r}; expected "
                 f"'projection' (OLMoE) or 'head' (Qwen3)")
+        if self.rotary_base_local is not None and self.sliding_window is None:
+            raise ValueError(
+                "rotary_base_local needs sliding_window set (it applies "
+                "to the windowed layers only)")
         if self.rope_scaling is not None:
             if self.position_embedding_type != "rope":
                 raise ValueError("rope_scaling requires "
@@ -271,6 +284,10 @@ class TransformerConfig:
             raise ValueError(
                 "sandwich_norm and parallel_residual are mutually "
                 "exclusive residual forms")
+        if self.logits_scaling <= 0:
+            raise ValueError(
+                f"logits_scaling ({self.logits_scaling}) must be > 0 "
+                f"(it divides the LM logits)")
         if not self.pre_norm and not self.sandwich_norm:
             # (parallel_residual is already excluded transitively: it is
             # mutually exclusive with the sandwich_norm required here)
@@ -490,6 +507,16 @@ class ParallelAttention(nn.Module):
             return None
         return cfg.sliding_window
 
+    def _layer_rope(self):
+        """(rotary_base, rope_scaling) for THIS layer: Gemma-3 gives the
+        windowed (local) layers their own base with no frequency
+        rescaling, while global layers keep rotary_base/rope_scaling."""
+        cfg = self.config
+        if (cfg.rotary_base_local is not None
+                and self._layer_window() is not None):
+            return cfg.rotary_base_local, None
+        return cfg.rotary_base, cfg.rope_scaling
+
     @nn.compact
     def __call__(self, hidden_states, attention_mask=None, position_ids=None):
         cfg = self.config
@@ -558,14 +585,15 @@ class ParallelAttention(nn.Module):
                                         np_local, kv, b)
 
         if cfg.position_embedding_type == "rope":
-            q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
+            rope_base, rope_scale = self._layer_rope()
+            q = apply_rotary_emb(q, rope_base, position_ids,
                                  cfg.rotary_percent,
                                  cfg.rotary_interleaved,
-                                 cfg.rope_scaling)
-            k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
+                                 rope_scale)
+            k = apply_rotary_emb(k, rope_base, position_ids,
                                  cfg.rotary_percent,
                                  cfg.rotary_interleaved,
-                                 cfg.rope_scaling)
+                                 rope_scale)
         if k.shape[2] != np_local:
             # broadcast each K/V group to its query heads
             rep = np_local // k.shape[2]
@@ -716,14 +744,15 @@ class ParallelAttention(nn.Module):
                 except Exception:
                     rank = 0
                 position_ids = rank * s + jnp.arange(s)
-            q = apply_rotary_emb(q, cfg.rotary_base, position_ids,
+            rope_base, rope_scale = self._layer_rope()
+            q = apply_rotary_emb(q, rope_base, position_ids,
                                  cfg.rotary_percent,
                                  cfg.rotary_interleaved,
-                                 cfg.rope_scaling)
-            k = apply_rotary_emb(k, cfg.rotary_base, position_ids,
+                                 rope_scale)
+            k = apply_rotary_emb(k, rope_base, position_ids,
                                  cfg.rotary_percent,
                                  cfg.rotary_interleaved,
-                                 cfg.rope_scaling)
+                                 rope_scale)
         if k.shape[2] != np_local:
             rep = np_local // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
@@ -761,14 +790,15 @@ class ParallelAttention(nn.Module):
         if cfg.position_embedding_type == "rope":
             pos = (position_ids if position_ids is not None
                    else idx + jnp.arange(s))
-            q = apply_rotary_emb(q, cfg.rotary_base, pos,
+            rope_base, rope_scale = self._layer_rope()
+            q = apply_rotary_emb(q, rope_base, pos,
                                  cfg.rotary_percent,
                                  cfg.rotary_interleaved,
-                                 cfg.rope_scaling)
-            k = apply_rotary_emb(k, cfg.rotary_base, pos,
+                                 rope_scale)
+            k = apply_rotary_emb(k, rope_base, pos,
                                  cfg.rotary_percent,
                                  cfg.rotary_interleaved,
-                                 cfg.rope_scaling)
+                                 rope_scale)
         if not initialized:
             # init pass: create the variables, plain causal attention over
             # the given tokens (shapes/params identical to the real path)
@@ -902,6 +932,9 @@ class ParallelTransformerLayer(nn.Module):
             # Gemma-2: norm each branch's OUTPUT before its residual add
             attn_out = _make_norm(cfg, "post_self_attn_norm")(
                 attn_out.astype(jnp.float32)).astype(cfg.compute_dtype)
+        rm = cfg.residual_multiplier
+        if rm != 1.0:  # Granite: x + m * branch(...)
+            attn_out = attn_out * jnp.asarray(rm, attn_out.dtype)
         residual = hidden_states  # pre-attn input (parallel-residual form)
         if not cfg.parallel_residual:
             hidden_states = hidden_states + attn_out.astype(
@@ -960,6 +993,8 @@ class ParallelTransformerLayer(nn.Module):
         if cfg.sandwich_norm:
             mlp_out = _make_norm(cfg, "post_mlp_norm")(
                 mlp_out.astype(jnp.float32)).astype(cfg.compute_dtype)
+        if rm != 1.0:
+            mlp_out = mlp_out * jnp.asarray(rm, mlp_out.dtype)
         if cfg.parallel_residual:
             # GPT-NeoX form: both branches read the SAME input (ln2 is
             # applied to the pre-attn stream) and sum into one residual

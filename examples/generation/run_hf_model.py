@@ -125,6 +125,16 @@ FAMILIES = {
                      num_experts_per_tok=2, norm_topk_prob=False,
                      max_position_embeddings=32, attention_dropout=0.0,
                      use_sliding_window=False)),
+    "gemma3": ("convert_hf_gemma3", "Gemma3ForCausalLM",
+               lambda t: t.Gemma3TextConfig(
+                   num_key_value_heads=2, head_dim=16, sliding_window=32,
+                   sliding_window_pattern=2,
+                   attn_implementation="eager", **_LLAMA_KW)),
+    "granite": ("convert_hf_granite", "GraniteForCausalLM",
+                lambda t: t.GraniteConfig(
+                    num_key_value_heads=2, embedding_multiplier=12.0,
+                    attention_multiplier=0.2, residual_multiplier=0.22,
+                    logits_scaling=8.0, **_LLAMA_KW)),
     "gemma2": ("convert_hf_gemma2", "Gemma2ForCausalLM",
                lambda t: t.Gemma2Config(
                    num_key_value_heads=2, head_dim=16, sliding_window=32,

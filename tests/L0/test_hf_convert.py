@@ -1514,3 +1514,136 @@ def test_post_norm_without_sandwich_refused():
 
     with pytest.raises(ValueError, match="pre_norm"):
         TransformerConfig(pre_norm=False)
+
+
+def _tiny_granite(seed=81):
+    cfg = transformers.GraniteConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        attention_dropout=0.0,
+        # all four muP scalars != 1 so each mapping is load-bearing
+        embedding_multiplier=12.0, attention_multiplier=0.2,
+        residual_multiplier=0.22, logits_scaling=8.0,
+        tie_word_embeddings=True)
+    torch.manual_seed(seed)
+    return transformers.GraniteForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_granite():
+    """Granite oracle (27th family): the four muP scalars — embedding
+    multiplier, attention multiplier (mapped exactly onto
+    query_pre_attn_scalar = 1/m^2), residual multiplier, logits
+    divisor — all set to non-default values so any dropped scalar
+    breaks parity."""
+    from tools.convert_hf_granite import convert_granite
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_granite()
+    cfg, params = convert_granite(hf.state_dict(), hf_cfg)
+    assert cfg.residual_multiplier == 0.22
+    assert cfg.logits_scaling == 8.0
+    assert abs(cfg.query_pre_attn_scalar - 25.0) < 1e-9  # 1/0.2^2
+
+    tokens = np.random.RandomState(81).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)
+
+
+def test_granite_greedy_generation_matches_hf():
+    from tools.convert_hf_granite import convert_granite
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_granite(seed=82)
+    cfg, params = convert_granite(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(82).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_gemma3(seed=91, with_scaling=True):
+    kw = dict(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=12,
+        max_position_embeddings=64, attention_dropout=0.0,
+        sliding_window=8, sliding_window_pattern=3,
+        rope_theta=1_000_000.0, rope_local_base_freq=10000.0,
+        query_pre_attn_scalar=20.0, attn_implementation="eager")
+    if with_scaling:
+        # global layers get linear rope scaling; local layers must NOT
+        kw["rope_scaling"] = {"rope_type": "linear", "factor": 8.0}
+    cfg = transformers.Gemma3TextConfig(**kw)
+    torch.manual_seed(seed)
+    return transformers.Gemma3ForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("with_scaling", [True, False])
+def test_logits_match_hf_gemma3(with_scaling):
+    """Gemma-3 oracle (28th family): per-layer-type rope — local
+    (windowed) layers use rope_local_base_freq with NO frequency
+    rescaling while global layers use rope_theta (+ linear scaling when
+    set) — plus per-head qk-norm with (1+w) folding, sandwich norms,
+    pattern-3 alternation at window < seq."""
+    from tools.convert_hf_gemma3 import convert_gemma3
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_gemma3(with_scaling=with_scaling)
+    cfg, params = convert_gemma3(hf.state_dict(), hf_cfg)
+    assert cfg.rotary_base_local == 10000.0
+    assert cfg.sliding_window_pattern == 3 and cfg.qk_norm == "head"
+    assert (cfg.rope_scaling is not None) == with_scaling
+
+    tokens = np.random.RandomState(91).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)
+
+
+def test_gemma3_greedy_generation_matches_hf():
+    from tools.convert_hf_gemma3 import convert_gemma3
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_gemma3(seed=92)
+    cfg, params = convert_gemma3(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(92).randint(0, 96, size=(2, 10))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_gemma3_bidirectional_refused():
+    from tools.convert_hf_gemma3 import convert_gemma3
+
+    hf_cfg = transformers.Gemma3TextConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        use_bidirectional_attention=True)
+    with pytest.raises(ValueError, match="bidirectional"):
+        convert_gemma3({}, hf_cfg)

@@ -1,0 +1,176 @@
+"""Convert a HuggingFace Gemma-3 (text) checkpoint into apex_tpu
+GPTModel params.
+
+Gemma-3 specifics on top of the Gemma-2 mapping (convert_hf_gemma2):
+
+- Per-head q/k RMSNorm (``qk_norm="head"``) REPLACES Gemma-2's
+  attention softcap (both are still mapped if a checkpoint carries
+  them).
+- 5:1 local/global alternation (``sliding_window_pattern``, default 6)
+  with a SEPARATE rope base for local layers
+  (``rope_local_base_freq`` -> ``rotary_base_local``; global layers
+  keep ``rope_theta`` + optional linear ``rope_scaling`` — HF
+  modeling_gemma3 builds two rotary embeddings and picks by
+  ``is_sliding``).
+- Zero-centered (1+w) RMSNorms, sandwich norms, GeGLU, sqrt(h)
+  embedding scale, tied head — as Gemma-2.
+- ``use_bidirectional_attention=True`` (embedding-variant configs) is
+  REFUSED: this converter targets the causal LM.
+
+    from transformers import Gemma3ForCausalLM
+    from tools.convert_hf_gemma3 import convert_gemma3
+
+    hf = Gemma3ForCausalLM.from_pretrained(path)
+    cfg, params = convert_gemma3(hf.state_dict(), hf.config)
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _map_rope_scaling, _t
+
+
+def convert_gemma3(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Gemma3ForCausalLM
+    state_dict (text config). Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "use_bidirectional_attention", False):
+        raise ValueError(
+            "use_bidirectional_attention=True (the embedding-model "
+            "variant) is not a causal LM; refusing")
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = getattr(hf_config, "head_dim", None) or hf_config.hidden_size // n
+
+    pattern = int(getattr(hf_config, "_sliding_window_pattern", None)
+                  or getattr(hf_config, "sliding_window_pattern", 6))
+    layer_types = getattr(hf_config, "layer_types", None)
+    expected = ["sliding_attention" if (i + 1) % pattern
+                else "full_attention"
+                for i in range(hf_config.num_hidden_layers)]
+    if layer_types is not None and list(layer_types) != expected:
+        raise ValueError(
+            f"layer_types {layer_types!r} does not match the "
+            f"{pattern - 1}:1 local/global alternation this model "
+            f"expresses; refusing rather than misconverting the "
+            f"attention pattern")
+
+    cfg = TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 1_000_000.0),
+        rotary_base_local=float(getattr(hf_config, "rope_local_base_freq",
+                                        10000.0)),
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        activation="geglu",
+        num_query_groups=(g if g != n else None),
+        tie_word_embeddings=True,
+        embedding_multiplier=math.sqrt(hf_config.hidden_size),
+        head_dim=d,
+        sliding_window=hf_config.sliding_window,
+        sliding_window_pattern=pattern,
+        qk_norm="head",
+        attn_logit_softcapping=getattr(hf_config,
+                                       "attn_logit_softcapping", None),
+        final_logit_softcapping=getattr(hf_config,
+                                        "final_logit_softcapping", None),
+        query_pre_attn_scalar=getattr(hf_config, "query_pre_attn_scalar",
+                                      None),
+        sandwich_norm=True,
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def rms(key):
+        # Gemma rmsnorm applies x * (1 + w): fold the +1 in
+        return jnp.asarray(_t(sd[key]) + 1.0)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": {"weight": rms(f"{p}.input_layernorm.weight")},
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "q_norm": {"weight": rms(f"{p}.self_attn.q_norm.weight")},
+                "k_norm": {"weight": rms(f"{p}.self_attn.k_norm.weight")},
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_self_attn_norm": {
+                "weight": rms(f"{p}.post_attention_layernorm.weight")},
+            "post_attention_layernorm": {
+                "weight": rms(f"{p}.pre_feedforward_layernorm.weight")},
+            "post_mlp_norm": {
+                "weight": rms(f"{p}.post_feedforward_layernorm.weight")},
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(np.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    return cfg, {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": {"weight": rms("norm.weight")},
+    }
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Gemma3ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Gemma3ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_gemma3(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Convert a HuggingFace Granite checkpoint into apex_tpu GPTModel
+params.
+
+Granite (IBM granite-3.x dense) is the Llama shape plus four muP-style
+scalars (HF modeling_granite, each marked "main diff with Llama"):
+
+- ``embedding_multiplier`` — embeddings scaled on entry (existing
+  knob; the tied head contracts with the unscaled table).
+- ``attention_multiplier`` — REPLACES the 1/sqrt(head_dim) softmax
+  scale; mapped exactly onto ``query_pre_attn_scalar = 1/m**2``
+  (scores / sqrt(1/m**2) == scores * m).
+- ``residual_multiplier`` — every branch output scaled before its
+  residual add.
+- ``logits_scaling`` — LM logits divided on exit.
+
+Everything else delegates to convert_llama (RMSNorm, RoPE, SwiGLU,
+GQA, tied head).
+
+    from transformers import GraniteForCausalLM
+    from tools.convert_hf_granite import convert_granite
+
+    hf = GraniteForCausalLM.from_pretrained(path)
+    cfg, params = convert_granite(hf.state_dict(), hf.config)
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import convert_llama
+
+
+def convert_granite(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a GraniteForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    import dataclasses
+
+    cfg, params = convert_llama(state_dict, hf_config)
+    m = float(getattr(hf_config, "attention_multiplier", 1.0))
+    rep = {}
+    if m != 1.0:
+        # scores * m == scores / sqrt(1/m^2)
+        rep["query_pre_attn_scalar"] = 1.0 / (m * m)
+    e = float(getattr(hf_config, "embedding_multiplier", 1.0))
+    if e != 1.0:
+        rep["embedding_multiplier"] = e
+    r = float(getattr(hf_config, "residual_multiplier", 1.0))
+    if r != 1.0:
+        rep["residual_multiplier"] = r
+    s = float(getattr(hf_config, "logits_scaling", 1.0))
+    if s != 1.0:
+        rep["logits_scaling"] = s
+    if rep:
+        cfg = dataclasses.replace(cfg, **rep)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import GraniteForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = GraniteForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_granite(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
