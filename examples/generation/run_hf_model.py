@@ -72,6 +72,11 @@ FAMILIES = {
     "mpt": ("convert_hf_mpt", "MptForCausalLM",
             lambda t: t.MptConfig(vocab_size=96, d_model=48, n_heads=4,
                                   n_layers=2, max_seq_len=64)),
+    "cohere": ("convert_hf_cohere", "CohereForCausalLM",
+               lambda t: t.CohereConfig(
+                   num_key_value_heads=2, logit_scale=0.0625,
+                   use_qk_norm=False, pad_token_id=0, bos_token_id=1,
+                   eos_token_id=2, **_LLAMA_KW)),
     "deepseek": ("convert_hf_deepseek", "DeepseekV2ForCausalLM",
                  lambda t: t.DeepseekV2Config(
                      vocab_size=96, hidden_size=32, intermediate_size=64,

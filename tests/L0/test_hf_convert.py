@@ -1647,3 +1647,103 @@ def test_gemma3_bidirectional_refused():
         use_bidirectional_attention=True)
     with pytest.raises(ValueError, match="bidirectional"):
         convert_gemma3({}, hf_cfg)
+
+
+def _tiny_cohere(seed=101):
+    cfg = transformers.CohereConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        attention_dropout=0.0, logit_scale=0.0625, use_qk_norm=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(seed)
+    return transformers.CohereForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_cohere():
+    """Cohere/Command-R oracle (29th family): shared-LN parallel
+    residual + bias-free LayerNorm + INTERLEAVED rope + multiplicative
+    logit_scale (mapped onto the logits_scaling divisor) + tied head —
+    all existing knobs composed a new way."""
+    from tools.convert_hf_cohere import convert_cohere
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_cohere()
+    cfg, params = convert_cohere(hf.state_dict(), hf_cfg)
+    assert cfg.parallel_residual and cfg.parallel_residual_shared_ln
+    assert cfg.rotary_interleaved
+    assert cfg.logits_scaling == 16.0  # 1 / 0.0625
+
+    tokens = np.random.RandomState(101).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)
+
+
+def test_cohere_greedy_generation_matches_hf():
+    from tools.convert_hf_cohere import convert_cohere
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_cohere(seed=102)
+    cfg, params = convert_cohere(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(102).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_cohere_qk_norm_refused():
+    from tools.convert_hf_cohere import convert_cohere
+
+    hf_cfg = transformers.CohereConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=1,
+        num_attention_heads=4, num_key_value_heads=2, use_qk_norm=True)
+    with pytest.raises(ValueError, match="qk_norm"):
+        convert_cohere({}, hf_cfg)
+
+
+def test_cohere_untied_and_bias_paths():
+    """attention_bias refusal (COVERAGE claim must be tested) and the
+    untied-head mapping (an untied config without lm_head in params
+    would crash at apply time, not conversion time)."""
+    from tools.convert_hf_cohere import convert_cohere
+
+    hf_cfg = transformers.CohereConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=1,
+        num_attention_heads=4, num_key_value_heads=2,
+        use_qk_norm=False, attention_bias=True)
+    with pytest.raises(ValueError, match="attention_bias"):
+        convert_cohere({}, hf_cfg)
+
+    untied = transformers.CohereConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        use_qk_norm=False, tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(103)
+    hf = transformers.CohereForCausalLM(untied).eval()
+    cfg, params = convert_cohere(hf.state_dict(), untied)
+    assert not cfg.tie_word_embeddings and "lm_head" in params
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    tokens = np.random.RandomState(103).randint(0, 96, size=(1, 8))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)

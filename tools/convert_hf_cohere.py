@@ -1,0 +1,160 @@
+"""Convert a HuggingFace Cohere (Command-R) checkpoint into apex_tpu
+GPTModel params.
+
+Cohere specifics (HF modeling_cohere, each marked "main diff from
+Llama"):
+
+- Parallel residual with ONE shared input LayerNorm feeding both
+  branches (``x + attn(ln(x)) + mlp(ln(x))``) — the existing
+  ``parallel_residual + parallel_residual_shared_ln`` (Phi/Falcon-7b)
+  form.
+- Bias-free mean-centered LayerNorm -> ``normalization="layernorm"``
+  with zero-filled bias params (exact).
+- Interleaved rope (even/odd lanes, the GPT-J convention) ->
+  ``rotary_interleaved=True``.
+- Logits MULTIPLIED by ``logit_scale`` (0.0625 on Command-R) -> the
+  Granite ``logits_scaling`` divisor with ``1/logit_scale``.
+- Always-tied head; ``use_qk_norm=True`` (Command-R+ per-head
+  LayerNorm with PER-HEAD weights — a different norm than the shared
+  per-head RMSNorm this model implements) is REFUSED rather than
+  misconverted, as is ``attention_bias=True``.
+
+    from transformers import CohereForCausalLM
+    from tools.convert_hf_cohere import convert_cohere
+
+    hf = CohereForCausalLM.from_pretrained(path)
+    cfg, params = convert_cohere(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _map_rope_scaling, _t
+
+
+def convert_cohere(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a CohereForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "use_qk_norm", False):
+        raise ValueError(
+            "use_qk_norm=True (Command-R+ per-head LayerNorm with "
+            "per-head weights) is not the shared-weight RMS qk-norm "
+            "this model implements; refusing rather than misconverting")
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError(
+            "attention_bias=True checkpoints carry q/k/v/o biases this "
+            "converter does not map; refusing rather than silently "
+            "zero-filling them")
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    scale = float(getattr(hf_config, "logit_scale", 1.0))
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.layer_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rotary_interleaved=True,
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        parallel_residual=True,
+        parallel_residual_shared_ln=True,
+        logits_scaling=(1.0 / scale if scale != 1.0 else 1.0),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    True),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def ln(key, width):
+        # CohereLayerNorm is bias-free: zero bias is exact
+        return {"weight": jnp.asarray(_t(sd[key])),
+                "bias": jnp.zeros((width,), jnp.float32)}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.input_layernorm.weight",
+                                  cfg.hidden_size),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(jnp.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln("norm.weight", cfg.hidden_size),
+    }
+    if not cfg.tie_word_embeddings:
+        # released Command-R ties, but honor an untied config rather
+        # than shipping a params tree the model can't apply
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import CohereForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = CohereForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_cohere(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
