@@ -31,30 +31,18 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.contrib._pallas_gate import PallasGate, choose_block
+
 NEG_INF = -1e30
 DEFAULT_BLOCK_T = 512
 
-_INTERPRET = False  # tests flip via force_interpret to run the kernel on CPU
-
-
-def _use_pallas():
-    import os
-
-    if os.environ.get("APEX_TPU_MLA_FLASH", "1") == "0":
-        return False
-    if _INTERPRET:
-        return True
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+_GATE = PallasGate("APEX_TPU_MLA_FLASH")
 
 
 def force_interpret(on: bool):
     """Run the kernel in interpreter mode regardless of backend (tests:
     exercises the real kernel dataflow on the CPU mesh)."""
-    global _INTERPRET
-    _INTERPRET = bool(on)
+    _GATE.force_interpret(on)
 
 
 def mla_decode_reference(q_full, cache, length, lat, scale):
@@ -147,16 +135,16 @@ def _decode_pallas(q_full, cache, length, lat, scale, block_t):
         out_shape=jax.ShapeDtypeStruct((b, n, lat), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
-        interpret=_INTERPRET,
+        interpret=_GATE.interpret,
     )(jnp.asarray(length, jnp.int32).reshape(1), q_full, cache)
 
 
 def use_flash(cache_len: int, block_t: int = DEFAULT_BLOCK_T) -> bool:
-    """True when the kernel would actually run (TPU/interpret AND a
-    block divides the cache). Callers gate on this so the non-kernel
-    path is their own production einsum formulation, not this module's
-    fp32 reference fallback."""
-    return _use_pallas() and cache_len % min(block_t, cache_len) == 0
+    """True when the kernel would actually run (TPU/interpret AND the
+    block ladder finds a tile dividing the cache). Callers gate on this
+    so the non-kernel path is their own production einsum formulation,
+    not this module's fp32 reference fallback."""
+    return _GATE.enabled() and choose_block(cache_len, block_t) is not None
 
 
 def mla_flash_decode(q_full, cache, length, lat, scale,
@@ -175,4 +163,4 @@ def mla_flash_decode(q_full, cache, length, lat, scale,
     if not use_flash(T, block_t):
         return mla_decode_reference(q_full, cache, length, lat, scale)
     return _decode_pallas(q_full, cache, length, lat, scale,
-                          min(block_t, T))
+                          choose_block(T, block_t))

@@ -813,6 +813,23 @@ class ParallelAttention(nn.Module):
         qg = q.reshape(s, b, n_kv, rep, kv).astype(cfg.compute_dtype)
         kt = k_full.astype(cfg.compute_dtype)
         vt = v_full.astype(cfg.compute_dtype)
+        if (s == 1 and initialized
+                and cfg.position_embedding_type != "alibi"):
+            # serving hot loop: stream the cache through VMEM once per
+            # (batch, group) with tile skipping beyond the prefix and,
+            # for windowed layers, before the window (contrib/gqa_decode)
+            from apex_tpu.contrib import gqa_decode
+
+            if gqa_decode.use_flash(kv_len):
+                import math
+
+                sm = 1.0 / math.sqrt(cfg.query_pre_attn_scalar or kv)
+                ctx = gqa_decode.gqa_flash_decode(
+                    qg[0], kt, vt, idx + s, sm,
+                    window=self._layer_window(),
+                    softcap=cfg.attn_logit_softcapping)
+                ctx = ctx.reshape(1, b, np_local * kv)
+                return self._output_proj(cfg, ctx)
         scores = jnp.einsum("sbgrd,tbgd->bgrst", qg, kt,
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(
