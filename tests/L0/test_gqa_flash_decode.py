@@ -87,10 +87,11 @@ def test_generate_token_exact_kernel_vs_einsum(case, monkeypatch):
                                   np.asarray(out_einsum))
 
 
-def test_alibi_stays_on_einsum():
-    """ALiBi decode must NOT take the kernel (no position bias in the
-    kernel): gate check — just assert generation still works and the
-    use_flash gate is irrelevant to it."""
+def test_alibi_stays_on_einsum(monkeypatch):
+    """ALiBi decode must NOT take the kernel (it carries no position
+    bias): with the kernel gate ON (interpret), tokens must equal the
+    flag-off einsum run — if a future edit dropped the alibi exclusion
+    from the gate, the slope bias would vanish and tokens diverge."""
     cfg = TransformerConfig(
         hidden_size=48, num_layers=2, num_attention_heads=4,
         vocab_size=96, max_position_embeddings=32,
@@ -100,8 +101,16 @@ def test_alibi_stays_on_einsum():
     prompt = jnp.asarray(
         np.random.RandomState(3).randint(0, 96, size=(1, 6)))
     params = model.init(jax.random.PRNGKey(4), prompt)["params"]
-    out = generate(model, params, prompt, 6)
-    assert np.asarray(out).shape == (1, 12)
+    out_gated = generate(model, params, prompt, 6)
+
+    from apex_tpu.models import generation as gen_mod
+
+    monkeypatch.setenv("APEX_TPU_DECODE_FLASH", "0")
+    gqa_decode.force_interpret(False)
+    gen_mod._compiled.cache_clear()
+    out_einsum = generate(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out_gated),
+                                  np.asarray(out_einsum))
 
 
 def test_block_ladder_nondivisible_buffers():

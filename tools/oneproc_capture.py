@@ -91,6 +91,32 @@ def _caplog_text():
         return ""
 
 
+def _with_env(var, value, thunk):
+    """Run a stage under a temporary env var (read at trace time by the
+    model's kernel gates); always restored so later stages see the
+    default. The generation engine's compiled-callable cache is cleared
+    around the stage — it is keyed on the (structurally equal) model, so
+    without the clear a flag flip would silently re-measure the
+    previous stage's traces."""
+    def run():
+        import os
+
+        from apex_tpu.models import generation as gen_mod
+
+        prev = os.environ.get(var)
+        os.environ[var] = value
+        gen_mod._compiled.cache_clear()
+        try:
+            return thunk()
+        finally:
+            gen_mod._compiled.cache_clear()
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+    return run
+
+
 def _stages(smoke):
     import bench
 
@@ -145,8 +171,12 @@ def _stages(smoke):
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),
-        # the rest of the zoo benches
+        # the rest of the zoo benches; decode runs twice — kernel
+        # (default on TPU) vs einsum — so the gqa_decode win is a
+        # measured pair in one capture
         ("decode", None, spec("decode")),
+        ("decode_einsum", None, _with_env(
+            "APEX_TPU_DECODE_FLASH", "0", spec("decode"))),
         ("moe", None, spec("moe")),
         ("llama", None, spec("llama")),
         ("t5", None, spec("t5")),
