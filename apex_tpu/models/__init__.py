@@ -18,6 +18,7 @@ from apex_tpu.models.generation import (  # noqa: F401
     generate,
     init_cache,
     init_params_tp,
+    prefill_prefix,
     sample_logits,
     speculative_generate,
     tensor_parallel_beam_search,

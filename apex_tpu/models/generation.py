@@ -61,15 +61,17 @@ def _full_vocab(logits):
 
 @functools.lru_cache(maxsize=32)
 def _compiled(model, plen, max_new_tokens, temperature, top_k, top_p,
-              eos_token_id, pad_token_id):
+              eos_token_id, pad_token_id, prefix_len=0):
     """jitted prefill + scan-decode, cached per model/config (shape
-    specialization is jit's own cache)."""
+    specialization is jit's own cache). ``prefix_len`` > 0 means the
+    cache already holds a shared prefilled prefix: the prompt chunk and
+    the decode steps run at offset absolute positions."""
 
     @jax.jit
     def prefill(params, cache, tokens):
         logits, mut = model.apply(
             {"params": params, "cache": cache}, tokens,
-            jnp.arange(plen)[None, :], mutable=["cache"])
+            (prefix_len + jnp.arange(plen))[None, :], mutable=["cache"])
         return mut["cache"], _full_vocab(logits[:, -1])
 
     def step(params, carry, _):
@@ -242,55 +244,122 @@ def tensor_parallel_beam_search(model, stacked_params, prompt_tokens,
 
 
 def _validate_decode(fn_name, model, prompt_tokens, max_new_tokens,
-                     draft_window=0):
-    """Shared decode-entry validation (all five public entry points;
-    speculative_generate validates both of its models through here with
-    the draft-window headroom passed separately so errors report the
-    caller's own numbers)."""
+                     extra=0, extra_label="draft window"):
+    """Shared decode-entry validation (all public entry points;
+    speculative_generate passes its draft-window headroom and
+    prefix-cached generate() its prefix length via ``extra`` so errors
+    report the caller's own numbers)."""
     if not getattr(model, "decode", False):
         raise ValueError(f"{fn_name}() needs a model built with "
                          f"decode=True")
     plen = prompt_tokens.shape[1]
     limit = model.config.max_position_embeddings
-    if plen + max_new_tokens + draft_window > limit:
-        extra = (f" + draft window ({draft_window})" if draft_window
-                 else "")
+    if plen + max_new_tokens + extra > limit:
+        extra_s = f" + {extra_label} ({extra})" if extra else ""
         raise ValueError(
             f"prompt ({plen}) + max_new_tokens ({max_new_tokens})"
-            f"{extra} exceeds max_position_embeddings ({limit})")
+            f"{extra_s} exceeds max_position_embeddings ({limit})")
 
 
 def _prep_decode(fn_name, model, prompt_tokens, max_new_tokens, rng,
-                 temperature, top_k, top_p, eos_token_id, pad_token_id):
+                 temperature, top_k, top_p, eos_token_id, pad_token_id,
+                 prefix_len=0):
     """Shared validation + compile for generate()/tensor_parallel_generate:
     returns (prefill, decode_all, rng)."""
-    _validate_decode(fn_name, model, prompt_tokens, max_new_tokens)
+    _validate_decode(fn_name, model, prompt_tokens, max_new_tokens,
+                     extra=prefix_len, extra_label="prefix")
     plen = prompt_tokens.shape[1]
     if rng is None:
         temperature = 0.0
         rng = jax.random.PRNGKey(0)
     prefill, decode_all = _compiled(
         model, plen, max_new_tokens, float(temperature), top_k, top_p,
-        eos_token_id, pad_token_id)
+        eos_token_id, pad_token_id, prefix_len)
     return prefill, decode_all, rng
 
 
 def _prefill_and_decode(prefill, decode_all, model, params, prompt_tokens,
-                        rng):
+                        rng, prefix_cache=None, prefix_len=0):
     """One prefill + scan-decode pass; returns the generated [b, new]."""
     b, plen = prompt_tokens.shape
-    cache = init_cache(model, b, prompt_tokens.dtype)
+    cache = (init_cache(model, b, prompt_tokens.dtype)
+             if prefix_cache is None else prefix_cache)
     cache, last_logits = prefill(params, cache, prompt_tokens)
-    init = (cache, last_logits, jnp.asarray(plen, jnp.int32), rng,
+    init = (cache, last_logits,
+            jnp.asarray(prefix_len + plen, jnp.int32), rng,
             jnp.zeros((b,), bool))
     _, out = decode_all(params, init)  # [max_new, b]
     return out.T
 
 
+@functools.lru_cache(maxsize=16)
+def _compiled_prefix(model, plen):
+    """Jitted prefix forward, cached per (model, prefix length) like
+    every other compiled entry point here — a serving loop prefilling
+    many same-shape system prompts pays the compile once."""
+
+    @jax.jit
+    def run(params, cache, tokens):
+        _, mut = model.apply({"params": params, "cache": cache}, tokens,
+                             jnp.arange(plen)[None, :],
+                             mutable=["cache"])
+        return mut["cache"]
+
+    return run
+
+
+def prefill_prefix(model, params, prefix_tokens):
+    """Prefill a SHARED prompt prefix once and return an opaque
+    ``(cache, prefix_len)`` state for ``generate(prefix_state=...)`` —
+    the serving prompt-cache pattern: one system prompt, many
+    continuations. The prefix forward runs exactly once; every
+    continuation then prefills only its suffix at offset positions.
+
+    The returned cache may be reused across any number of generate()
+    calls (nothing donates it), and a batch-1 prefix broadcasts to any
+    continuation batch size."""
+    if not getattr(model, "decode", False):
+        raise ValueError("prefill_prefix() needs a model built with "
+                         "decode=True")
+    b, plen = prefix_tokens.shape
+    limit = model.config.max_position_embeddings
+    if plen >= limit:
+        raise ValueError(f"prefix ({plen}) leaves no room under "
+                         f"max_position_embeddings ({limit})")
+    cache = init_cache(model, b, prefix_tokens.dtype)
+    run = _compiled_prefix(model, plen)
+    return run(params, cache, prefix_tokens), plen
+
+
+def _broadcast_prefix_cache(cache, b):
+    """A batch-1 prefix cache serves a batch-b continuation: K/V
+    buffers broadcast along their batch axis — axis ndim-3, which
+    handles both the plain [T, b, g, d] layout and scan_layers'
+    layer-stacked [L, T, b, g, d]. Scalar leaves (cache_index) pass
+    through."""
+    def fix(path, leaf):
+        names = [getattr(e, "key", None) for e in path]
+        if (names and str(names[-1]).startswith("cached_")
+                and leaf.ndim >= 3):
+            ax = leaf.ndim - 3
+            if leaf.shape[ax] == b:
+                return leaf
+            if leaf.shape[ax] != 1:
+                raise ValueError(
+                    f"prefix cache batch ({leaf.shape[ax]}) != prompt "
+                    f"batch ({b}); only batch-1 prefixes broadcast")
+            return jnp.broadcast_to(
+                leaf, leaf.shape[:ax] + (b,) + leaf.shape[ax + 1:])
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
 def generate(model, params, prompt_tokens, max_new_tokens: int, *,
              rng=None, temperature: float = 1.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
-             eos_token_id: Optional[int] = None, pad_token_id: int = 0):
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+             prefix_state=None):
     """Prefill + scan-decode. Returns [batch, prompt + max_new_tokens]
     (generated positions after an eos are ``pad_token_id``).
 
@@ -300,6 +369,13 @@ def generate(model, params, prompt_tokens, max_new_tokens: int, *,
     (decode mode rejects attention masks — left-trim or batch by
     length). This host-level loop drives a single-device (tp=1) model;
     for tensor-parallel decoding use :func:`tensor_parallel_generate`.
+
+    ``prefix_state`` (from :func:`prefill_prefix`): a shared prefilled
+    prompt prefix — ``prompt_tokens`` is then the per-request SUFFIX,
+    prefilled at offset positions into (a batch-broadcast copy of) the
+    prefix cache; output is [batch, suffix + max_new_tokens] (the
+    prefix tokens belong to the caller). Token-exact vs prefilling the
+    concatenated prompt from scratch.
     """
     from apex_tpu.transformer.parallel_state import (
         get_tensor_model_parallel_world_size,
@@ -310,11 +386,17 @@ def generate(model, params, prompt_tokens, max_new_tokens: int, *,
             "generate() drives a tp=1 model; use "
             "tensor_parallel_generate() (the same prefill + scan loop "
             "inside shard_map over the 'tp' axis)")
+    prefix_cache, prefix_len = (None, 0)
+    if prefix_state is not None:
+        prefix_cache, prefix_len = prefix_state
+        prefix_cache = _broadcast_prefix_cache(prefix_cache,
+                                               prompt_tokens.shape[0])
     prefill, decode_all, rng = _prep_decode(
         "generate", model, prompt_tokens, max_new_tokens, rng, temperature,
-        top_k, top_p, eos_token_id, pad_token_id)
+        top_k, top_p, eos_token_id, pad_token_id, prefix_len)
     out = _prefill_and_decode(prefill, decode_all, model, params,
-                              prompt_tokens, rng)
+                              prompt_tokens, rng, prefix_cache,
+                              prefix_len)
     return jnp.concatenate([prompt_tokens, out], axis=1)
 
 
@@ -464,7 +546,7 @@ def speculative_generate(target_model, target_params, draft_model,
         # the draft window overshoots by up to num_draft_tokens beyond
         # the emitted tokens, so validate with that headroom included
         _validate_decode("speculative_generate", m, prompt_tokens,
-                         max_new_tokens, draft_window=num_draft_tokens)
+                         max_new_tokens, extra=num_draft_tokens)
     b, plen = prompt_tokens.shape
     run = _compiled_speculative(
         target_model, draft_model, plen, max_new_tokens,

@@ -1,0 +1,138 @@
+"""Prefix caching (prefill_prefix + generate(prefix_state=...)): the
+shared-system-prompt serving pattern must be TOKEN-EXACT against
+prefilling the concatenated prompt from scratch — the prefix forward
+runs once, continuations prefill only their suffix at offset
+positions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models import (
+    GPTModel,
+    TransformerConfig,
+    generate,
+    prefill_prefix,
+)
+from apex_tpu.transformer import parallel_state
+
+
+def _cfg(**kw):
+    base = dict(
+        hidden_size=48, num_layers=2, num_attention_heads=4,
+        vocab_size=96, max_position_embeddings=64,
+        compute_dtype=jnp.float32, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=2)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _single_device():
+    parallel_state.destroy_model_parallel()
+
+
+@pytest.mark.parametrize("kw", [
+    {},                                            # rope GQA
+    {"position_embedding_type": "learned",         # GPT-2-style
+     "normalization": "layernorm", "activation": "gelu"},
+    {"sliding_window": 7},                         # windowed decode
+])
+def test_prefix_matches_full_prompt(kw):
+    cfg = _cfg(**kw)
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(0)
+    prefix = jnp.asarray(rng.randint(0, 96, size=(2, 11)))
+    suffix = jnp.asarray(rng.randint(0, 96, size=(2, 5)))
+    full = jnp.concatenate([prefix, suffix], axis=1)
+    params = model.init(jax.random.PRNGKey(1), full)["params"]
+
+    ref = generate(model, params, full, 8)
+    state = prefill_prefix(model, params, prefix)
+    out = generate(model, params, suffix, 8, prefix_state=state)
+    # out is [b, suffix + new]; compare against the full run's tail
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(ref)[:, prefix.shape[1]:])
+
+
+def test_prefix_broadcasts_to_batch():
+    """One batch-1 system prompt, many continuations: each row must
+    equal its own full-prompt run."""
+    cfg = _cfg()
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(2)
+    prefix = jnp.asarray(rng.randint(0, 96, size=(1, 9)))
+    suffix = jnp.asarray(rng.randint(0, 96, size=(3, 4)))
+    full = jnp.concatenate([jnp.broadcast_to(prefix, (3, 9)), suffix],
+                           axis=1)
+    params = model.init(jax.random.PRNGKey(3), full)["params"]
+
+    ref = generate(model, params, full, 6)
+    state = prefill_prefix(model, params, prefix)
+    out = generate(model, params, suffix, 6, prefix_state=state)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(ref)[:, 9:])
+
+
+def test_prefix_cache_reusable_across_calls():
+    """The state must survive multiple generate() calls (nothing
+    donates it): two different suffixes from ONE prefilled prefix."""
+    cfg = _cfg()
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(4)
+    prefix = jnp.asarray(rng.randint(0, 96, size=(1, 8)))
+    s1 = jnp.asarray(rng.randint(0, 96, size=(1, 3)))
+    s2 = jnp.asarray(rng.randint(0, 96, size=(1, 6)))
+    params = model.init(
+        jax.random.PRNGKey(5),
+        jnp.concatenate([prefix, s2], axis=1))["params"]
+
+    state = prefill_prefix(model, params, prefix)
+    out1 = generate(model, params, s1, 5, prefix_state=state)
+    out2 = generate(model, params, s2, 5, prefix_state=state)
+    ref1 = generate(model, params, jnp.concatenate([prefix, s1], 1), 5)
+    ref2 = generate(model, params, jnp.concatenate([prefix, s2], 1), 5)
+    np.testing.assert_array_equal(np.asarray(out1),
+                                  np.asarray(ref1)[:, 8:])
+    np.testing.assert_array_equal(np.asarray(out2),
+                                  np.asarray(ref2)[:, 8:])
+
+
+def test_prefix_validation():
+    cfg = _cfg()
+    model = GPTModel(cfg, decode=True)
+    prefix = jnp.asarray(np.zeros((2, 8), np.int32))
+    suffix = jnp.asarray(np.zeros((3, 4), np.int32))
+    params = model.init(jax.random.PRNGKey(6), prefix)["params"]
+    state = prefill_prefix(model, params, prefix)
+    # batch-2 prefix cannot serve batch-3 suffixes
+    with pytest.raises(ValueError, match="batch"):
+        generate(model, params, suffix, 4, prefix_state=state)
+    # prefix + suffix + new must fit the position budget
+    with pytest.raises(ValueError, match="prefix"):
+        generate(model, params, jnp.zeros((2, 4), jnp.int32), 60,
+                 prefix_state=state)
+    with pytest.raises(ValueError, match="decode=True"):
+        prefill_prefix(GPTModel(cfg), params, prefix)
+
+
+def test_prefix_broadcast_scan_layers():
+    """scan_layers stacks cache leaves with a leading layer axis
+    ([L, T, b, g, d]) — the broadcast must find the batch axis there
+    too (review finding)."""
+    cfg = _cfg(scan_layers=True)
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(7)
+    prefix = jnp.asarray(rng.randint(0, 96, size=(1, 8)))
+    suffix = jnp.asarray(rng.randint(0, 96, size=(2, 4)))
+    full = jnp.concatenate([jnp.broadcast_to(prefix, (2, 8)), suffix],
+                           axis=1)
+    params = model.init(jax.random.PRNGKey(8), full)["params"]
+
+    ref = generate(model, params, full, 5)
+    state = prefill_prefix(model, params, prefix)
+    out = generate(model, params, suffix, 5, prefix_state=state)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(ref)[:, 8:])

@@ -174,7 +174,8 @@ def _stages(smoke):
         # the rest of the zoo benches; decode runs twice — kernel
         # (default on TPU) vs einsum — so the gqa_decode win is a
         # measured pair in one capture
-        ("decode", None, spec("decode")),
+        ("decode", None, _with_env(
+            "APEX_TPU_DECODE_FLASH", "1", spec("decode"))),
         ("decode_einsum", None, _with_env(
             "APEX_TPU_DECODE_FLASH", "0", spec("decode"))),
         ("moe", None, spec("moe")),
