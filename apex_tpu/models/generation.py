@@ -318,6 +318,14 @@ def prefill_prefix(model, params, prefix_tokens):
     The returned cache may be reused across any number of generate()
     calls (nothing donates it), and a batch-1 prefix broadcasts to any
     continuation batch size."""
+    from apex_tpu.transformer.parallel_state import (
+        get_tensor_model_parallel_world_size,
+    )
+
+    if get_tensor_model_parallel_world_size() > 1:
+        raise NotImplementedError(
+            "prefill_prefix() drives a tp=1 model (the tensor-parallel "
+            "serving loop has no prefix-cache path yet)")
     if not getattr(model, "decode", False):
         raise ValueError("prefill_prefix() needs a model built with "
                          "decode=True")

@@ -149,7 +149,8 @@ class TransformerConfig:
     qk_norm: Optional[str] = None
     # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact"
     # the erf form (HF "gelu" — Falcon/NeoX default); "relu" (OPT);
-    # "swiglu"/"geglu" are the gated fused forms.
+    # "relu2" squared ReLU (Nemotron); "swiglu"/"geglu" are the gated
+    # fused forms.
     activation: str = "gelu"
     # Scale token embeddings by this factor on entry (Gemma family uses
     # sqrt(hidden_size); the tied head contracts with the UNSCALED table).
@@ -324,7 +325,7 @@ class TransformerConfig:
                              "parallelism (ring/ulysses kernels carry no "
                              "position bias)")
         if self.activation not in ("gelu", "gelu_exact", "relu",
-                                   "swiglu", "geglu"):
+                                   "relu2", "swiglu", "geglu"):
             raise ValueError(f"unknown activation {self.activation!r}")
         if self.normalization not in ("layernorm", "rmsnorm"):
             raise ValueError(f"unknown normalization {self.normalization!r}")
@@ -897,23 +898,27 @@ class ParallelMLP(nn.Module):
             gate, up = jnp.split(gate_up.astype(jnp.float32), 2, axis=-1)
             act = jax.nn.silu if cfg.activation == "swiglu" else jax.nn.gelu
             x = (act(gate) * up).astype(cfg.compute_dtype)
-        elif cfg.activation in ("gelu", "gelu_exact", "relu"):
+        elif cfg.activation in ("gelu", "gelu_exact", "relu", "relu2"):
             x = ColumnParallelLinear(
                 input_size=cfg.hidden_size, output_size=cfg.ffn_size,
                 gather_output=False, bias=True, params_dtype=cfg.params_dtype,
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 name="dense_h_to_4h")(hidden_states.astype(cfg.compute_dtype))
             xf = x.astype(jnp.float32)
-            xf = (jax.nn.relu(xf) if cfg.activation == "relu"
-                  else jax.nn.gelu(xf,
-                                   approximate=(cfg.activation == "gelu")))
+            if cfg.activation in ("relu", "relu2"):
+                xf = jax.nn.relu(xf)
+                if cfg.activation == "relu2":  # Nemotron squared ReLU
+                    xf = xf * xf
+            else:
+                xf = jax.nn.gelu(xf, approximate=(cfg.activation == "gelu"))
             x = xf.astype(cfg.compute_dtype)
         else:
             raise ValueError(f"unknown activation {cfg.activation!r}")
         x = RowParallelLinear(
             input_size=cfg.ffn_size, output_size=cfg.hidden_size,
             input_is_parallel=True,
-            bias=(cfg.activation in ("gelu", "gelu_exact", "relu")),
+            bias=(cfg.activation in ("gelu", "gelu_exact", "relu",
+                                     "relu2")),
             params_dtype=cfg.params_dtype,
             sequence_parallel_enabled=cfg.sequence_parallel,
             name="dense_4h_to_h")(x)

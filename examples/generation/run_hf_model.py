@@ -47,6 +47,10 @@ FAMILIES = {
     "gemma": ("convert_hf_gemma", "GemmaForCausalLM",
               lambda t: t.GemmaConfig(num_key_value_heads=1, head_dim=16,
                                       **_LLAMA_KW)),
+    "nemotron": ("convert_hf_nemotron", "NemotronForCausalLM",
+                 lambda t: t.NemotronConfig(
+                     num_key_value_heads=2, hidden_act="relu2",
+                     partial_rotary_factor=0.5, **_LLAMA_KW)),
     "neox": ("convert_hf_neox", "GPTNeoXForCausalLM",
              lambda t: t.GPTNeoXConfig(rotary_pct=0.25, **_LLAMA_KW)),
     "gptj": ("convert_hf_gptj", "GPTJForCausalLM",

@@ -1747,3 +1747,71 @@ def test_cohere_untied_and_bias_paths():
     ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
                                atol=4e-4)
+
+
+def _tiny_nemotron(seed=111):
+    cfg = transformers.NemotronConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        attention_dropout=0.0, hidden_act="relu2",
+        partial_rotary_factor=0.5)
+    torch.manual_seed(seed)
+    return transformers.NemotronForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_nemotron():
+    """Nemotron oracle (30th family): LayerNorm1p (weight+1 folded at
+    conversion), squared-ReLU ungated MLP (relu2), partial rotary 0.5 —
+    against HF's independent implementation."""
+    from tools.convert_hf_nemotron import convert_nemotron
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_nemotron()
+    cfg, params = convert_nemotron(hf.state_dict(), hf_cfg)
+    assert cfg.activation == "relu2" and cfg.rotary_percent == 0.5
+
+    tokens = np.random.RandomState(111).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_nemotron_greedy_generation_matches_hf():
+    from tools.convert_hf_nemotron import convert_nemotron
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_nemotron(seed=112)
+    cfg, params = convert_nemotron(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(112).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_nemotron_bias_variants_refused():
+    from tools.convert_hf_nemotron import convert_nemotron
+
+    hf_cfg = transformers.NemotronConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=1,
+        num_attention_heads=4, num_key_value_heads=2,
+        attention_bias=True)
+    with pytest.raises(ValueError, match="attention_bias"):
+        convert_nemotron({}, hf_cfg)
+    hf_cfg2 = transformers.NemotronConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=1,
+        num_attention_heads=4, num_key_value_heads=2, mlp_bias=True)
+    with pytest.raises(ValueError, match="mlp_bias"):
+        convert_nemotron({}, hf_cfg2)

@@ -1,0 +1,156 @@
+"""Convert a HuggingFace Nemotron checkpoint into apex_tpu GPTModel
+params.
+
+Nemotron (nvidia Nemotron-4/Minitron lineage) specifics:
+
+- LayerNorm1p (HF modeling_nemotron NemotronLayerNorm1P: layer_norm
+  with ``weight + 1``) -> fold the +1 into the weight at conversion;
+  the model's standard LayerNorm then matches exactly (the Gemma
+  (1+w)-rmsnorm move, for LayerNorm).
+- Squared-ReLU MLP (``hidden_act="relu2"``: up_proj -> relu(x)^2 ->
+  down_proj, NO gate) -> ``activation="relu2"``.
+- Partial rotary (default 0.5) -> ``rotary_percent``; untied head;
+  optional attention/MLP biases are REFUSED when enabled (the released
+  checkpoints carry none).
+
+    from transformers import NemotronForCausalLM
+    from tools.convert_hf_nemotron import convert_nemotron
+
+    hf = NemotronForCausalLM.from_pretrained(path)
+    cfg, params = convert_nemotron(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import (
+    _fused_qkv,
+    _lin_t,
+    _map_rope_scaling,
+    _t,
+)
+
+
+def convert_nemotron(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a NemotronForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "hidden_act", "relu2") != "relu2":
+        raise ValueError(
+            f"unsupported hidden_act {hf_config.hidden_act!r}: Nemotron "
+            f"ships relu2 (squared ReLU); anything else would silently "
+            f"change numerics")
+    for knob in ("attention_bias", "mlp_bias"):
+        if getattr(hf_config, knob, False):
+            raise ValueError(
+                f"{knob}=True checkpoints carry biases this converter "
+                f"does not map; refusing rather than zero-filling them")
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        rotary_percent=float(getattr(hf_config, "partial_rotary_factor",
+                                     0.5)),
+        activation="relu2",
+        num_query_groups=(g if g != n else None),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _lin_t(sd, key)
+
+    def ln1p(prefix):
+        # LayerNorm1p applies weight + 1: fold the +1 in
+        return {"weight": jnp.asarray(_t(sd[f"{prefix}.weight"]) + 1.0),
+                "bias": jnp.asarray(_t(sd[f"{prefix}.bias"]))}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln1p(f"{p}.input_layernorm"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": ln1p(
+                f"{p}.post_attention_layernorm"),
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.up_proj.weight")),
+                    "bias": jnp.zeros((cfg.ffn_size,), jnp.float32),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln1p("norm"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import NemotronForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = NemotronForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_nemotron(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
