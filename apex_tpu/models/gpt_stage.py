@@ -39,13 +39,13 @@ class GPTStage(nn.Module):
 
     def setup(self):
         cfg = self.config
-        if cfg.sliding_window_pattern > 1:
+        if cfg.sliding_window_pattern > 1 or cfg.no_rope_layer_interval:
             raise ValueError(
-                "sliding_window_pattern > 1 (alternating local/global "
-                "layers) is not supported under SPMD pipelining: every "
-                "stage runs the same program with per-stage layer "
-                "numbering, so the alternation would silently restart at "
-                "each stage boundary")
+                "per-layer alternation (sliding_window_pattern > 1 or "
+                "no_rope_layer_interval) is not supported under SPMD "
+                "pipelining: every stage runs the same program with "
+                "per-stage layer numbering, so the alternation would "
+                "silently restart at each stage boundary")
         self.word_embeddings = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
             params_dtype=cfg.params_dtype, name="word_embeddings")

@@ -143,6 +143,9 @@ class TransformerConfig:
     # and skip rope_scaling (local 10k vs global 1M + linear scaling);
     # None -> every layer uses rotary_base/rope_scaling.
     rotary_base_local: Optional[float] = None
+    # SmolLM3 NoPE alternation: every interval-th layer ((i+1) % N == 0)
+    # applies NO rotary embedding at all. 0 -> rope on every layer.
+    no_rope_layer_interval: int = 0
     # Query/key RMSNorm before rope: "projection" (OLMoE — one norm over
     # the full flattened q / k projection output) or "head" (Qwen3 —
     # per-head over head_dim, tensor-parallel-safe). None -> off.
@@ -259,6 +262,20 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown qk_norm {self.qk_norm!r}; expected "
                 f"'projection' (OLMoE) or 'head' (Qwen3)")
+        if self.no_rope_layer_interval:
+            if self.no_rope_layer_interval < 2:
+                raise ValueError(
+                    f"no_rope_layer_interval "
+                    f"({self.no_rope_layer_interval}) must be >= 2 (1 "
+                    f"would disable rope everywhere — use "
+                    f"position_embedding_type='learned'/'alibi' instead)")
+            if self.position_embedding_type != "rope":
+                raise ValueError("no_rope_layer_interval requires "
+                                 "position_embedding_type='rope'")
+            if self.scan_layers:
+                raise ValueError(
+                    "scan_layers needs a uniform stack: NoPE alternation "
+                    "(no_rope_layer_interval) cannot be scanned")
         if self.rotary_base_local is not None and self.sliding_window is None:
             raise ValueError(
                 "rotary_base_local needs sliding_window set (it applies "
@@ -508,6 +525,13 @@ class ParallelAttention(nn.Module):
             return None
         return cfg.sliding_window
 
+    def _layer_uses_rope(self):
+        """False on SmolLM3-style NoPE layers (every interval-th)."""
+        cfg = self.config
+        if not cfg.no_rope_layer_interval:
+            return True
+        return (self.layer_number + 1) % cfg.no_rope_layer_interval != 0
+
     def _layer_rope(self):
         """(rotary_base, rope_scaling) for THIS layer: Gemma-3 gives the
         windowed (local) layers their own base with no frequency
@@ -585,7 +609,8 @@ class ParallelAttention(nn.Module):
             return self._ring_attention(cfg, q, k, v, position_ids,
                                         np_local, kv, b)
 
-        if cfg.position_embedding_type == "rope":
+        if (cfg.position_embedding_type == "rope"
+                and self._layer_uses_rope()):
             rope_base, rope_scale = self._layer_rope()
             q = apply_rotary_emb(q, rope_base, position_ids,
                                  cfg.rotary_percent,
@@ -738,7 +763,8 @@ class ParallelAttention(nn.Module):
         from apex_tpu.transformer.parallel_state import CONTEXT_PARALLEL_AXIS
 
         s = q.shape[0]
-        if cfg.position_embedding_type == "rope":
+        if (cfg.position_embedding_type == "rope"
+                and self._layer_uses_rope()):
             if position_ids is None:
                 try:
                     rank = lax.axis_index(CONTEXT_PARALLEL_AXIS)
@@ -788,7 +814,8 @@ class ParallelAttention(nn.Module):
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
         idx = ci.value
-        if cfg.position_embedding_type == "rope":
+        if (cfg.position_embedding_type == "rope"
+                and self._layer_uses_rope()):
             pos = (position_ids if position_ids is not None
                    else idx + jnp.arange(s))
             rope_base, rope_scale = self._layer_rope()

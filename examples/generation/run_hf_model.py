@@ -95,6 +95,11 @@ FAMILIES = {
                        vocab_size=96, n_embd=48, n_layer=2, n_head=4,
                        n_positions=64, multi_query=True, resid_pdrop=0.0,
                        embd_pdrop=0.0, attn_pdrop=0.0)),
+    "smollm3": ("convert_hf_smollm3", "SmolLM3ForCausalLM",
+                lambda t: t.SmolLM3Config(
+                    num_key_value_heads=2, no_rope_layer_interval=2,
+                    use_sliding_window=False, pad_token_id=0,
+                    bos_token_id=1, eos_token_id=2, **_LLAMA_KW)),
     "stablelm": ("convert_hf_stablelm", "StableLmForCausalLM",
                  lambda t: t.StableLmConfig(
                      vocab_size=96, hidden_size=64, num_hidden_layers=2,

@@ -1815,3 +1815,75 @@ def test_nemotron_bias_variants_refused():
         num_attention_heads=4, num_key_value_heads=2, mlp_bias=True)
     with pytest.raises(ValueError, match="mlp_bias"):
         convert_nemotron({}, hf_cfg2)
+
+
+def _tiny_smollm3(seed=121):
+    cfg = transformers.SmolLM3Config(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=32,
+        attention_dropout=0.0, no_rope_layer_interval=2,
+        use_sliding_window=False, tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(seed)
+    return transformers.SmolLM3ForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_smollm3():
+    """SmolLM3 oracle (31st family): NoPE alternation — every 2nd layer
+    applies NO rotary embedding (4 layers: rope, none, rope, none) —
+    plus a materiality check that disabling the alternation diverges."""
+    import dataclasses
+
+    from tools.convert_hf_smollm3 import convert_smollm3
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_smollm3()
+    cfg, params = convert_smollm3(hf.state_dict(), hf_cfg)
+    assert cfg.no_rope_layer_interval == 2
+
+    tokens = np.random.RandomState(121).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4,
+                               atol=3e-4)
+    all_rope = GPTModel(dataclasses.replace(
+        cfg, no_rope_layer_interval=0)).apply({"params": params},
+                                              jnp.asarray(tokens))
+    assert not np.allclose(np.asarray(ours), np.asarray(all_rope),
+                           atol=1e-5)
+
+
+def test_smollm3_greedy_generation_matches_hf():
+    from tools.convert_hf_smollm3 import convert_smollm3
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_smollm3(seed=122)
+    cfg, params = convert_smollm3(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(122).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_smollm3_custom_no_rope_layers_refused():
+    from tools.convert_hf_smollm3 import convert_smollm3
+
+    hf_cfg = transformers.SmolLM3Config(
+        vocab_size=96, hidden_size=48, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2,
+        no_rope_layers=[1, 1, 0, 1], no_rope_layer_interval=2,
+        use_sliding_window=False)
+    with pytest.raises(ValueError, match="no_rope_layers"):
+        convert_smollm3({}, hf_cfg)

@@ -1,0 +1,80 @@
+"""Convert a HuggingFace SmolLM3 checkpoint into apex_tpu GPTModel
+params.
+
+SmolLM3 is the Llama mapping plus NoPE alternation: every
+``no_rope_layer_interval``-th layer ((i+1) % N == 0 — HF
+configuration_smollm3 builds ``no_rope_layers`` exactly so) applies no
+rotary embedding at all -> ``cfg.no_rope_layer_interval``. A custom
+``no_rope_layers`` list that does not match the interval pattern is
+REFUSED (the model expresses the alternation as an interval, not a
+per-layer list), as are windowed variants (``use_sliding_window``) and
+bias variants.
+
+    from transformers import SmolLM3ForCausalLM
+    from tools.convert_hf_smollm3 import convert_smollm3
+
+    hf = SmolLM3ForCausalLM.from_pretrained(path)
+    cfg, params = convert_smollm3(hf.state_dict(), hf.config)
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import convert_llama
+
+
+def convert_smollm3(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a SmolLM3ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    import dataclasses
+
+    if getattr(hf_config, "use_sliding_window", False):
+        raise ValueError("use_sliding_window=True is not supported; "
+                         "refusing rather than silently attending "
+                         "globally")
+    if getattr(hf_config, "attention_bias", False) or getattr(
+            hf_config, "mlp_bias", False):
+        raise ValueError(
+            "attention_bias/mlp_bias checkpoints carry biases this "
+            "converter does not map; refusing rather than zero-filling")
+
+    interval = int(getattr(hf_config, "no_rope_layer_interval", 0) or 0)
+    no_rope = getattr(hf_config, "no_rope_layers", None)
+    if no_rope is not None:
+        expected = [int((i + 1) % interval != 0) if interval else 1
+                    for i in range(hf_config.num_hidden_layers)]
+        if list(no_rope) != expected:
+            raise ValueError(
+                f"no_rope_layers {no_rope!r} does not match the "
+                f"every-{interval}th NoPE alternation this model "
+                f"expresses; refusing rather than misconverting the "
+                f"position scheme")
+
+    cfg, params = convert_llama(state_dict, hf_config)
+    if interval:
+        cfg = dataclasses.replace(cfg, no_rope_layer_interval=interval)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import SmolLM3ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = SmolLM3ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_smollm3(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
