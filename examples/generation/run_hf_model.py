@@ -36,6 +36,11 @@ FAMILIES = {
     "gpt2": ("convert_hf_gpt2", "GPT2LMHeadModel",
              lambda t: t.GPT2Config(vocab_size=256, n_positions=128,
                                     n_embd=64, n_layer=4, n_head=4)),
+    "helium": ("convert_hf_helium", "HeliumForCausalLM",
+               lambda t: t.HeliumConfig(
+                   num_key_value_heads=2, head_dim=16,
+                   attention_bias=False, mlp_bias=False, pad_token_id=0,
+                   bos_token_id=1, eos_token_id=2, **_LLAMA_KW)),
     "llama": ("convert_hf_llama", "LlamaForCausalLM",
               lambda t: t.LlamaConfig(num_key_value_heads=2, **_LLAMA_KW)),
     "mistral": ("convert_hf_mistral", "MistralForCausalLM",

@@ -1887,3 +1887,59 @@ def test_smollm3_custom_no_rope_layers_refused():
         use_sliding_window=False)
     with pytest.raises(ValueError, match="no_rope_layers"):
         convert_smollm3({}, hf_cfg)
+
+
+def _tiny_helium(seed=131):
+    cfg = transformers.HeliumConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=12,
+        max_position_embeddings=32, attention_dropout=0.0,
+        attention_bias=False, mlp_bias=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(seed)
+    return transformers.HeliumForCausalLM(cfg).eval(), cfg
+
+
+def test_logits_match_hf_helium():
+    """Helium oracle (32nd family): the llama shape (RMSNorm, SwiGLU,
+    GQA) under the INTERLEAVED rope convention — a combination no other
+    family pins (GPT-J is interleaved but partial-rotary +
+    parallel-residual; Cohere is interleaved but LayerNorm + parallel
+    residual). HF's o_proj is [hidden, hidden], so head_dim must equal
+    hidden/heads here."""
+    from tools.convert_hf_helium import convert_helium
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_helium()
+    cfg, params = convert_helium(hf.state_dict(), hf_cfg)
+    assert cfg.rotary_interleaved
+
+    tokens = np.random.RandomState(131).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_helium_greedy_generation_matches_hf():
+    from tools.convert_hf_helium import convert_helium
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_helium(seed=132)
+    cfg, params = convert_helium(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(132).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

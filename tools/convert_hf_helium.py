@@ -1,0 +1,61 @@
+"""Convert a HuggingFace Helium checkpoint into apex_tpu GPTModel
+params.
+
+Helium (kyutai helium-1) is the Llama mapping with the INTERLEAVED
+rope convention (HF modeling_helium rotate_half pairs even/odd lanes
+and repeat_interleaves the half-width cos/sin — the GPT-J/Cohere form)
+-> ``rotary_interleaved=True`` on top of convert_llama (HF's o_proj is
+[hidden, hidden], so head_dim always equals hidden/heads despite the
+config field). Bias variants (``attention_bias``/``mlp_bias``) are
+REFUSED — the released checkpoints carry none and the llama mapping
+would zero-fill them.
+
+    from transformers import HeliumForCausalLM
+    from tools.convert_hf_helium import convert_helium
+
+    hf = HeliumForCausalLM.from_pretrained(path)
+    cfg, params = convert_helium(hf.state_dict(), hf.config)
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import convert_llama
+
+
+def convert_helium(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a HeliumForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    import dataclasses
+
+    if getattr(hf_config, "attention_bias", False) or getattr(
+            hf_config, "mlp_bias", False):
+        raise ValueError(
+            "attention_bias/mlp_bias checkpoints carry biases this "
+            "converter does not map; refusing rather than zero-filling")
+    cfg, params = convert_llama(state_dict, hf_config)
+    return dataclasses.replace(cfg, rotary_interleaved=True), params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import HeliumForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = HeliumForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_helium(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
