@@ -149,6 +149,11 @@ FAMILIES = {
                    num_key_value_heads=2, head_dim=16, sliding_window=32,
                    sliding_window_pattern=2,
                    attn_implementation="eager", **_LLAMA_KW)),
+    "glm4": ("convert_hf_glm4", "Glm4ForCausalLM",
+             lambda t: t.Glm4Config(
+                 num_key_value_heads=2, head_dim=16,
+                 partial_rotary_factor=0.5, attention_bias=True,
+                 pad_token_id=0, eos_token_id=2, **_LLAMA_KW)),
     "granite": ("convert_hf_granite", "GraniteForCausalLM",
                 lambda t: t.GraniteConfig(
                     num_key_value_heads=2, embedding_multiplier=12.0,

@@ -1943,3 +1943,65 @@ def test_helium_greedy_generation_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_glm4(seed=141, biased=True):
+    cfg = transformers.Glm4Config(
+        vocab_size=96, hidden_size=48, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=12,
+        max_position_embeddings=32, attention_dropout=0.0,
+        partial_rotary_factor=0.5, attention_bias=biased,
+        pad_token_id=0, eos_token_id=2)
+    torch.manual_seed(seed)
+    hf = transformers.Glm4ForCausalLM(cfg).eval()
+    if biased:  # HF zero-inits biases; randomize to oracle the mapping
+        with torch.no_grad():
+            for name, p in hf.named_parameters():
+                if "self_attn" in name and name.endswith("bias"):
+                    p.copy_(torch.randn_like(p) * 0.5)
+    return hf, cfg
+
+
+@pytest.mark.parametrize("biased", [True, False])
+def test_logits_match_hf_glm4(biased):
+    """GLM-4 oracle (33rd family): sandwich norms in the Gemma-2 slot
+    semantics + partial INTERLEAVED rope (0.5, even/odd lanes) + QKV
+    biases through the fused per-group layout + verbatim [gate|up]
+    mapping — a knob combination no other family pins."""
+    from tools.convert_hf_glm4 import convert_glm4
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_glm4(biased=biased)
+    cfg, params = convert_glm4(hf.state_dict(), hf_cfg)
+    assert cfg.sandwich_norm and cfg.rotary_interleaved
+    assert cfg.rotary_percent == 0.5
+
+    tokens = np.random.RandomState(141).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)
+
+
+def test_glm4_greedy_generation_matches_hf():
+    from tools.convert_hf_glm4 import convert_glm4
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_glm4(seed=142)
+    cfg, params = convert_glm4(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(142).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)

@@ -1,0 +1,155 @@
+"""Convert a HuggingFace GLM-4 checkpoint into apex_tpu GPTModel
+params.
+
+GLM-4 (zai-org glm-4-9b lineage) composes knobs this model already
+carries, in a combination no other family pins:
+
+- Sandwich norms with the SAME slot semantics as Gemma-2: HF
+  input_layernorm stays pre-attention, post_self_attn_layernorm norms
+  the attention OUTPUT -> ``post_self_attn_norm``,
+  post_attention_layernorm is the pre-MLP norm (our standard slot),
+  post_mlp_layernorm -> ``post_mlp_norm``; ``sandwich_norm=True``.
+- Partial INTERLEAVED rope (``partial_rotary_factor`` 0.5, even/odd
+  lanes — HF repeat_interleaves half-width cos/sin over the LEADING
+  rotary_dim) -> ``rotary_percent`` + ``rotary_interleaved``.
+- QKV biases (``attention_bias=True``, o_proj bias-free) through the
+  fused per-group layout (the Qwen2 move); decoupled head_dim.
+- ONE fused [gate | up] ``gate_up_proj`` -> maps verbatim onto our
+  fused swiglu columns (the Phi-3 layout, no un-fusing needed).
+
+    from transformers import Glm4ForCausalLM
+    from tools.convert_hf_glm4 import convert_glm4
+
+    hf = Glm4ForCausalLM.from_pretrained(path)
+    cfg, params = convert_glm4(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _map_rope_scaling, _t
+
+
+def convert_glm4(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Glm4ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    biased = bool(getattr(hf_config, "attention_bias", True))
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        rotary_percent=float(getattr(hf_config, "partial_rotary_factor",
+                                     0.5)),
+        rotary_interleaved=True,
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        sandwich_norm=True,
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def rms(key):
+        return {"weight": jnp.asarray(_t(sd[key]))}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        if biased:
+            fused_bias = _fused_qkv(
+                _t(sd[f"{p}.self_attn.q_proj.bias"]),
+                _t(sd[f"{p}.self_attn.k_proj.bias"]),
+                _t(sd[f"{p}.self_attn.v_proj.bias"]), n, g, d)
+            qkv_bias = jnp.asarray(fused_bias)
+        else:
+            qkv_bias = jnp.zeros((fused.shape[-1],), jnp.float32)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": rms(f"{p}.input_layernorm.weight"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": qkv_bias,
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            "post_self_attn_norm": rms(
+                f"{p}.post_self_attn_layernorm.weight"),
+            "post_attention_layernorm": rms(
+                f"{p}.post_attention_layernorm.weight"),
+            "post_mlp_norm": rms(f"{p}.post_mlp_layernorm.weight"),
+            "mlp": {
+                # HF gate_up_proj is already [gate | up] — verbatim
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.gate_up_proj.weight")),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": rms("norm.weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Glm4ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Glm4ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_glm4(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
