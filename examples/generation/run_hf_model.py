@@ -64,6 +64,11 @@ FAMILIES = {
                                     rotary_dim=8)),
     "phi": ("convert_hf_phi", "PhiForCausalLM",
             lambda t: t.PhiConfig(num_key_value_heads=4, **_LLAMA_KW)),
+    "exaone4": ("convert_hf_exaone4", "Exaone4ForCausalLM",
+                lambda t: t.Exaone4Config(
+                    num_key_value_heads=2, head_dim=16, sliding_window=32,
+                    sliding_window_pattern=2, pad_token_id=0,
+                    bos_token_id=1, eos_token_id=2, **_LLAMA_KW)),
     "falcon": ("convert_hf_falcon", "FalconForCausalLM",
                lambda t: t.FalconConfig(vocab_size=256, hidden_size=64,
                                         num_hidden_layers=4,

@@ -2005,3 +2005,90 @@ def test_glm4_greedy_generation_matches_hf():
     ours = generate(GPTModel(cfg, decode=True), params,
                     jnp.asarray(prompt), max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def _tiny_exaone4(seed=151, window=8):
+    kw = dict(sliding_window=window, sliding_window_pattern=2)
+    if window is None:
+        # HF's config builds layer_types with % pattern and zeroes the
+        # pattern for windowless configs -> ZeroDivisionError unless the
+        # list is explicit
+        kw = dict(sliding_window=None,
+                  layer_types=["full_attention"] * 4)
+    cfg = transformers.Exaone4Config(
+        vocab_size=96, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=12,
+        max_position_embeddings=32, attention_dropout=0.0,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2, **kw)
+    torch.manual_seed(seed)
+    hf = transformers.Exaone4ForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if name.endswith(("q_norm.weight", "k_norm.weight")):
+                p.copy_(1.0 + torch.randn_like(p) * 0.3)
+    return hf, cfg
+
+
+@pytest.mark.parametrize("window", [8, None])
+def test_logits_match_hf_exaone4(window):
+    """EXAONE-4 oracle (34th family): FOUR knobs composed — hybrid
+    sliding (window < seq so it bites), rope ONLY on the sliding layers
+    (the full-attention layers are NoPE: sliding_window_pattern and
+    no_rope_layer_interval share the model's (i+1)%N convention),
+    OLMo-2-style post-norm blocks, per-head qk-norm (randomized
+    weights). window=None: full attention + rope everywhere."""
+    from tools.convert_hf_exaone4 import convert_exaone4
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_exaone4(window=window)
+    cfg, params = convert_exaone4(hf.state_dict(), hf_cfg)
+    assert not cfg.pre_norm and cfg.qk_norm == "head"
+    if window is not None:
+        assert cfg.sliding_window_pattern == 2
+        assert cfg.no_rope_layer_interval == 2
+    else:
+        assert cfg.no_rope_layer_interval == 0
+
+    tokens = np.random.RandomState(151).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)
+
+
+def test_exaone4_greedy_generation_matches_hf():
+    from tools.convert_hf_exaone4 import convert_exaone4
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_exaone4(seed=152)
+    cfg, params = convert_exaone4(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(152).randint(0, 96, size=(2, 10))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_exaone4_ambiguous_window_refused():
+    """sliding_window without a pattern would silently window every
+    layer with rope (HF runs full+NoPE) — refuse (review finding)."""
+    from tools.convert_hf_exaone4 import convert_exaone4
+
+    hf_cfg = transformers.Exaone4Config(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=8, layer_types=["full_attention"] * 2)
+    hf_cfg.sliding_window_pattern = None
+    with pytest.raises(ValueError, match="ambiguous"):
+        convert_exaone4({}, hf_cfg)

@@ -1,0 +1,184 @@
+"""Convert a HuggingFace EXAONE-4 checkpoint into apex_tpu GPTModel
+params.
+
+EXAONE-4 (LGAI) composes FOUR existing knobs, all sharing this model's
+(i+1) % N index convention:
+
+- Hybrid attention: sliding on layers (i+1) % pattern != 0, full on
+  every pattern-th (HF configuration_exaone4 layer_types) ->
+  ``sliding_window_pattern``.
+- Rope ONLY on the sliding layers (HF modeling_exaone4: ``if
+  self.sliding_window is None or self.is_sliding`` — the full-attention
+  layers are NoPE) -> ``no_rope_layer_interval = pattern``. A windowless
+  config ropes everywhere (both knobs off).
+- POST-norm blocks (no input norms; HF post_attention_layernorm norms
+  the attention OUTPUT, post_feedforward_layernorm the MLP output — the
+  OLMo-2 structure) -> ``pre_norm=False`` + the sandwich output slots.
+- Per-head q/k RMSNorm over head_dim (the Qwen3 form) ->
+  ``qk_norm="head"``.
+
+Custom ``layer_types`` lists that don't match the pattern are REFUSED,
+as are bias variants.
+
+    from transformers import Exaone4ForCausalLM
+    from tools.convert_hf_exaone4 import convert_exaone4
+
+    hf = Exaone4ForCausalLM.from_pretrained(path)
+    cfg, params = convert_exaone4(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _map_rope_scaling, _t
+
+
+def convert_exaone4(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from an Exaone4ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError(
+            "attention_bias=True checkpoints carry biases this "
+            "converter does not map; refusing rather than zero-filling")
+
+    window = getattr(hf_config, "sliding_window", None)
+    pattern = getattr(hf_config, "sliding_window_pattern", None)
+    if isinstance(pattern, str):  # "LLLG" string form -> its length
+        pattern = len(pattern)
+    pattern = int(pattern or 0)
+    if window is not None and not pattern:
+        raise ValueError(
+            "sliding_window is set but sliding_window_pattern is "
+            "falsy: the hybrid local/global split is ambiguous — "
+            "refusing rather than guessing which layers slide")
+    layer_types = getattr(hf_config, "layer_types", None)
+    if window is not None and pattern:
+        expected = ["sliding_attention" if (i + 1) % pattern
+                    else "full_attention"
+                    for i in range(hf_config.num_hidden_layers)]
+    else:
+        expected = ["full_attention"] * hf_config.num_hidden_layers
+    if layer_types is not None and list(layer_types) != expected:
+        raise ValueError(
+            f"layer_types {layer_types!r} does not match the "
+            f"every-{pattern}th-global alternation this model "
+            f"expresses; refusing rather than misconverting")
+
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="rmsnorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        qk_norm="head",
+        pre_norm=False,
+        sandwich_norm=True,
+        sliding_window=window,
+        sliding_window_pattern=(pattern if window is not None and pattern
+                                else 1),
+        no_rope_layer_interval=(pattern if window is not None and pattern
+                                else 0),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def rms(key):
+        return {"weight": jnp.asarray(_t(sd[key]))}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        layers[f"layer_{i}"] = {
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "q_norm": {"weight": jnp.asarray(
+                    _t(sd[f"{p}.self_attn.q_norm.weight"]))},
+                "k_norm": {"weight": jnp.asarray(
+                    _t(sd[f"{p}.self_attn.k_norm.weight"]))},
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+                },
+            },
+            # OLMo-2 structure: HF's two norms are output-side
+            "post_self_attn_norm": rms(
+                f"{p}.post_attention_layernorm.weight"),
+            "post_mlp_norm": rms(
+                f"{p}.post_feedforward_layernorm.weight"),
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(jnp.concatenate(
+                        [lin_t(f"{p}.mlp.gate_proj.weight"),
+                         lin_t(f"{p}.mlp.up_proj.weight")], axis=-1)),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.down_proj.weight")),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": rms("norm.weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Exaone4ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Exaone4ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_exaone4(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
