@@ -150,6 +150,10 @@ class TransformerConfig:
     # the full flattened q / k projection output) or "head" (Qwen3 —
     # per-head over head_dim, tensor-parallel-safe). None -> off.
     qk_norm: Optional[str] = None
+    # DBRX: clamp the QKV projection outputs to [-clip, clip]
+    # (elementwise, applied after the fused projection — identical to
+    # HF's clamp of the fused Wqkv output). None -> no clamp.
+    qkv_clip: Optional[float] = None
     # "gelu" is the tanh approximation (GPT-2 gelu_new); "gelu_exact"
     # the erf form (HF "gelu" — Falcon/NeoX default); "relu" (OPT);
     # "relu2" squared ReLU (Nemotron); "swiglu"/"geglu" are the gated
@@ -258,6 +262,8 @@ class TransformerConfig:
                     "attn_logit_softcapping does not compose with context "
                     "parallelism (the ring/ulysses kernels carry no "
                     "softcap epilogue)")
+        if self.qkv_clip is not None and self.qkv_clip <= 0:
+            raise ValueError(f"qkv_clip ({self.qkv_clip}) must be > 0")
         if self.qk_norm not in (None, "projection", "head"):
             raise ValueError(
                 f"unknown qk_norm {self.qk_norm!r}; expected "
@@ -586,6 +592,12 @@ class ParallelAttention(nn.Module):
             kvp = proj[..., np_local * kv:].reshape(seq_full, b, g_local,
                                                     2 * kv)
             k, v = jnp.split(kvp, 2, axis=-1)
+
+        if cfg.qkv_clip is not None:  # DBRX: clamp projection outputs
+            clip = jnp.asarray(cfg.qkv_clip, q.dtype)
+            q = jnp.clip(q, -clip, clip)
+            k = jnp.clip(k, -clip, clip)
+            v = jnp.clip(v, -clip, clip)
 
         if cfg.qk_norm is not None:
             q, k = self._apply_qk_norm(cfg, q, k, tp)

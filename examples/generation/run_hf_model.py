@@ -91,6 +91,15 @@ FAMILIES = {
                    num_key_value_heads=2, logit_scale=0.0625,
                    use_qk_norm=False, pad_token_id=0, bos_token_id=1,
                    eos_token_id=2, **_LLAMA_KW)),
+    "dbrx": ("convert_hf_dbrx", "DbrxForCausalLM",
+             lambda t: t.DbrxConfig(
+                 d_model=64, n_heads=4, n_layers=2, max_seq_len=128,
+                 vocab_size=256,
+                 attn_config=dict(kv_n_heads=2, clip_qkv=8.0),
+                 ffn_config=dict(ffn_hidden_size=96, moe_num_experts=4,
+                                 moe_top_k=2,
+                                 moe_normalize_expert_weights=1.0),
+                 pad_token_id=0, eos_token_id=2)),
     "deepseek": ("convert_hf_deepseek", "DeepseekV2ForCausalLM",
                  lambda t: t.DeepseekV2Config(
                      vocab_size=96, hidden_size=32, intermediate_size=64,

@@ -2092,3 +2092,87 @@ def test_exaone4_ambiguous_window_refused():
     hf_cfg.sliding_window_pattern = None
     with pytest.raises(ValueError, match="ambiguous"):
         convert_exaone4({}, hf_cfg)
+
+
+def _tiny_dbrx(seed=161, clip=4.0):
+    cfg = transformers.DbrxConfig(
+        d_model=48, n_heads=4, n_layers=2, max_seq_len=32,
+        vocab_size=96, attn_config=__import__('transformers.models.dbrx.configuration_dbrx', fromlist=['DbrxAttentionConfig']).DbrxAttentionConfig(
+            kv_n_heads=2, clip_qkv=clip, rope_theta=10000.0,
+            attn_pdrop=0.0),
+        ffn_config=__import__('transformers.models.dbrx.configuration_dbrx', fromlist=['DbrxFFNConfig']).DbrxFFNConfig(
+            ffn_hidden_size=64, moe_num_experts=8, moe_top_k=2,
+            moe_normalize_expert_weights=1.0),
+        resid_pdrop=0.0, emb_pdrop=0.0,
+        pad_token_id=0, eos_token_id=2)
+    torch.manual_seed(seed)
+    return transformers.DbrxForCausalLM(cfg).eval(), cfg
+
+
+@pytest.mark.parametrize("clip", [0.05, None])
+def test_logits_match_hf_dbrx(clip):
+    """DBRX oracle (35th family): fused Wqkv with QKV clamping
+    (qkv_clip — clip=0.05 is far inside the random-init projection
+    range, so the clamp provably bites), giant
+    stacked expert tensors (w1/v1/w2 with w2 already [in, out]),
+    bias-free LayerNorm, L1-renormalized top-4 routing."""
+    from tools.convert_hf_dbrx import convert_dbrx
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_dbrx(clip=clip)
+    cfg, params = convert_dbrx(hf.state_dict(), hf_cfg)
+    assert cfg.qkv_clip == clip
+    if clip is not None:
+        # the clamp must actually fire at these scales, else this
+        # parity would be vacuous for the clip mapping
+        import dataclasses as _dc
+        unclipped = GPTModel(_dc.replace(cfg, qkv_clip=None)).apply(
+            {"params": params},
+            jnp.asarray(np.random.RandomState(161).randint(
+                0, 96, size=(2, 16))))
+    assert cfg.moe_normalize_topk
+
+    tokens = np.random.RandomState(161).randint(0, 96, size=(2, 16))
+    with torch.no_grad():
+        ref = hf(torch.asarray(tokens)).logits.numpy()
+    ours = GPTModel(cfg).apply({"params": params}, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=4e-4,
+                               atol=4e-4)
+    if clip is not None:
+        assert not np.allclose(np.asarray(ours), np.asarray(unclipped),
+                               atol=1e-5)
+
+
+def test_dbrx_greedy_generation_matches_hf():
+    from tools.convert_hf_dbrx import convert_dbrx
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generation import generate
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    hf, hf_cfg = _tiny_dbrx(seed=162, clip=2.0)
+    cfg, params = convert_dbrx(hf.state_dict(), hf_cfg)
+    prompt = np.random.RandomState(162).randint(0, 96, size=(2, 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.asarray(prompt), max_new_tokens=8,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = generate(GPTModel(cfg, decode=True), params,
+                    jnp.asarray(prompt), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_dbrx_unsupported_norm_p_refused():
+    from tools.convert_hf_dbrx import convert_dbrx
+
+    hf_cfg = transformers.DbrxConfig(
+        d_model=48, n_heads=4, n_layers=1, vocab_size=96,
+        attn_config=__import__('transformers.models.dbrx.configuration_dbrx', fromlist=['DbrxAttentionConfig']).DbrxAttentionConfig(kv_n_heads=2),
+        ffn_config=__import__('transformers.models.dbrx.configuration_dbrx', fromlist=['DbrxFFNConfig']).DbrxFFNConfig(
+            ffn_hidden_size=64, moe_num_experts=4, moe_top_k=2,
+            moe_normalize_expert_weights=2.0))
+    with pytest.raises(ValueError, match="normalize_expert"):
+        convert_dbrx({}, hf_cfg)

@@ -1,0 +1,162 @@
+"""Convert a HuggingFace DBRX checkpoint into apex_tpu MoE-GPT params.
+
+DBRX (databricks dbrx-base/instruct) specifics:
+
+- ONE fused ``Wqkv`` laid out [q_all | k_all | v_all] (the Phi-3
+  layout) -> sliced back into per-kind matrices and re-fused.
+- ``clip_qkv``: the fused projection output is clamped to
+  [-clip, clip] -> ``cfg.qkv_clip`` (elementwise, so clamping after
+  the split is identical).
+- Bias-free LayerNorm pre-norm blocks (norm_1/norm_2) -> standard
+  pre-LN with zero-filled biases (exact).
+- 16-expert top-4 MoE with giant stacked expert tensors: HF
+  ``experts.mlp.w1/v1`` are [E*ffn, h] (gate/up, [out, in] per expert)
+  and ``w2`` is [E*ffn, h] already in [in, out] per-expert form ->
+  ours w1 [E, h, 2*ffn] = [gate.T | up.T], w2 [E, ffn, h] (NO
+  transpose). ``moe_normalize_expert_weights=1`` (L1) is the
+  renormalized top-k form; None -> raw mass; other p-norms REFUSED.
+- Router at ``ffn.router.layer``; untied LM head.
+
+    from transformers import DbrxForCausalLM
+    from tools.convert_hf_dbrx import convert_dbrx
+
+    hf = DbrxForCausalLM.from_pretrained(path)
+    cfg, params = convert_dbrx(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import _fused_qkv, _t
+
+
+def convert_dbrx(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a DbrxForCausalLM
+    state_dict. Single-device layout (tp=1, ep=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    attn_cfg = hf_config.attn_config
+    ffn_cfg = hf_config.ffn_config
+    act = getattr(ffn_cfg, "ffn_act_fn", None) or {"name": "silu"}
+    if act.get("name", "silu") != "silu":
+        raise ValueError(f"unsupported ffn_act_fn {act!r}: DBRX ships "
+                         f"silu (glu); refusing")
+    p_norm = getattr(ffn_cfg, "moe_normalize_expert_weights", None)
+    if p_norm is not None and float(p_norm) != 1.0:
+        raise ValueError(
+            f"moe_normalize_expert_weights={p_norm}: only the L1 "
+            f"renormalization (1.0) or None (raw mass) is implemented; "
+            f"refusing rather than misconverting the gate mass")
+
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    h = hf_config.d_model
+    n = hf_config.n_heads
+    g = attn_cfg.kv_n_heads
+    d = h // n
+    E = ffn_cfg.moe_num_experts
+    k = ffn_cfg.moe_top_k
+    ffn = ffn_cfg.ffn_hidden_size
+    cfg = TransformerConfig(
+        hidden_size=h,
+        num_layers=hf_config.n_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=ffn,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_seq_len,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        position_embedding_type="rope",
+        rotary_base=float(getattr(attn_cfg, "rope_theta", 500000.0)),
+        activation="swiglu",
+        num_query_groups=(g if g != n else None),
+        qkv_clip=(float(attn_cfg.clip_qkv)
+                  if getattr(attn_cfg, "clip_qkv", None) is not None
+                  else None),
+        num_moe_experts=E,
+        moe_top_k=k,
+        moe_capacity_factor=float(E) / k,  # dropless
+        moe_normalize_topk=(p_norm is not None),
+        tie_word_embeddings=False,
+    )
+
+    def lin_t(key):
+        return _t(sd[key]).T  # torch Linear [out, in] -> [in, out]
+
+    def ln(key):
+        # DBRX LayerNorm is bias-free: zero bias is exact
+        return {"weight": jnp.asarray(_t(sd[key])),
+                "bias": jnp.zeros((h,), jnp.float32)}
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"blocks.{i}"
+        wqkv = lin_t(f"{p}.norm_attn_norm.attn.Wqkv.weight")  # [h, (n+2g)d]
+        wq = wqkv[:, :n * d]
+        wk = wqkv[:, n * d:(n + g) * d]
+        wv = wqkv[:, (n + g) * d:]
+        fused = _fused_qkv(wq, wk, wv, n, g, d)
+        # experts: w1/v1 [E*ffn, h] ([out, in] per expert) -> [E, h, 2ffn];
+        # w2 [E*ffn, h] already [in, out] per expert -> [E, ffn, h]
+        w1_all = _t(sd[f"{p}.ffn.experts.mlp.w1"]).reshape(E, ffn, h)
+        v1_all = _t(sd[f"{p}.ffn.experts.mlp.v1"]).reshape(E, ffn, h)
+        w2_all = _t(sd[f"{p}.ffn.experts.mlp.w2"]).reshape(E, ffn, h)
+        w1 = np.concatenate([np.swapaxes(w1_all, 1, 2),
+                             np.swapaxes(v1_all, 1, 2)], axis=-1)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.norm_attn_norm.norm_1.weight"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": jnp.zeros((fused.shape[-1],), jnp.float32),
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.norm_attn_norm.attn.out_proj.weight")),
+                    "bias": jnp.zeros((h,), jnp.float32),
+                },
+            },
+            "post_attention_layernorm": ln(
+                f"{p}.norm_attn_norm.norm_2.weight"),
+            "mlp": {
+                "router": {"gate_weight": jnp.asarray(
+                    lin_t(f"{p}.ffn.router.layer.weight"))},
+                "experts": {"w1": jnp.asarray(w1),
+                            "w2": jnp.asarray(w2_all)},
+            },
+        }
+
+    return cfg, {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["wte.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln("norm_f.weight"),
+        "lm_head": jnp.asarray(_t(state_dict["lm_head.weight"]).T),
+    }
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import DbrxForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = DbrxForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_dbrx(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
