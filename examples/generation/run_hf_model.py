@@ -119,6 +119,12 @@ FAMILIES = {
                     num_key_value_heads=2, no_rope_layer_interval=2,
                     use_sliding_window=False, pad_token_id=0,
                     bos_token_id=1, eos_token_id=2, **_LLAMA_KW)),
+    "starcoder2": ("convert_hf_starcoder2", "Starcoder2ForCausalLM",
+                   lambda t: t.Starcoder2Config(
+                       num_key_value_heads=2, use_bias=True,
+                       sliding_window=None,
+                       pad_token_id=0, bos_token_id=1, eos_token_id=2,
+                       **_LLAMA_KW)),
     "stablelm": ("convert_hf_stablelm", "StableLmForCausalLM",
                  lambda t: t.StableLmConfig(
                      vocab_size=96, hidden_size=64, num_hidden_layers=2,

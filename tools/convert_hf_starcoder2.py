@@ -1,0 +1,158 @@
+"""Convert a HuggingFace Starcoder2 checkpoint into apex_tpu GPTModel
+params.
+
+Starcoder2 (bigcode starcoder2-3b/7b/15b) pairs the modern attention
+stack (rope + GQA + optional uniform sliding window) with the GPT-2-era
+MLP form: LayerNorm (biased) blocks, non-gated tanh-gelu MLP
+(c_fc/c_proj), and ``use_bias=True`` on EVERY projection — q/k/v/o
+biases travel through the fused per-group column layout (the Qwen2
+move, here for all four).
+
+    from transformers import Starcoder2ForCausalLM
+    from tools.convert_hf_starcoder2 import convert_starcoder2
+
+    hf = Starcoder2ForCausalLM.from_pretrained(path)
+    cfg, params = convert_starcoder2(hf.state_dict(), hf.config)
+"""
+
+import jax.numpy as jnp
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_llama import (
+    _fused_qkv,
+    _lin_t,
+    _ln,
+    _map_gelu,
+    _map_rope_scaling,
+    _t,
+)
+
+
+def convert_starcoder2(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from a Starcoder2ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    from apex_tpu.models import TransformerConfig
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    n = hf_config.num_attention_heads
+    g = hf_config.num_key_value_heads
+    d = (getattr(hf_config, "head_dim", None)
+         or hf_config.hidden_size // n)
+    biased = bool(getattr(hf_config, "use_bias", True))
+    # HF applies the window purely from sliding_window is not None
+    # (modeling_starcoder2 mask selection) — there is NO
+    # use_sliding_window knob on this config; real checkpoints ship
+    # sliding_window=4096
+    window = getattr(hf_config, "sliding_window", None)
+    cfg = TransformerConfig(
+        head_dim=d,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_attention_heads=n,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.norm_epsilon,
+        compute_dtype=jnp.float32,
+        use_flash_attention=False,
+        normalization="layernorm",
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        rope_scaling=_map_rope_scaling(
+            getattr(hf_config, "rope_scaling", None)),
+        activation=_map_gelu(getattr(hf_config, "hidden_act",
+                                     "gelu_pytorch_tanh")),
+        num_query_groups=(g if g != n else None),
+        sliding_window=window,
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    True),
+    )
+
+    import functools
+
+    lin_t = functools.partial(_lin_t, sd)
+    ln = functools.partial(_ln, sd)
+
+    def bias(key, width):
+        if biased:
+            return jnp.asarray(_t(sd[key]))
+        return jnp.zeros((width,), jnp.float32)
+
+    layers = {}
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        fused = _fused_qkv(lin_t(f"{p}.self_attn.q_proj.weight"),
+                           lin_t(f"{p}.self_attn.k_proj.weight"),
+                           lin_t(f"{p}.self_attn.v_proj.weight"), n, g, d)
+        if biased:
+            qkv_bias = jnp.asarray(_fused_qkv(
+                _t(sd[f"{p}.self_attn.q_proj.bias"]),
+                _t(sd[f"{p}.self_attn.k_proj.bias"]),
+                _t(sd[f"{p}.self_attn.v_proj.bias"]), n, g, d))
+        else:
+            qkv_bias = jnp.zeros((fused.shape[-1],), jnp.float32)
+        layers[f"layer_{i}"] = {
+            "input_layernorm": ln(f"{p}.input_layernorm"),
+            "self_attention": {
+                "query_key_value": {
+                    "weight": jnp.asarray(fused),
+                    "bias": qkv_bias,
+                },
+                "dense": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.self_attn.o_proj.weight")),
+                    "bias": bias(f"{p}.self_attn.o_proj.bias",
+                                 cfg.hidden_size),
+                },
+            },
+            "post_attention_layernorm": ln(
+                f"{p}.post_attention_layernorm"),
+            "mlp": {
+                "dense_h_to_4h": {
+                    "weight": jnp.asarray(lin_t(f"{p}.mlp.c_fc.weight")),
+                    "bias": bias(f"{p}.mlp.c_fc.bias", cfg.ffn_size),
+                },
+                "dense_4h_to_h": {
+                    "weight": jnp.asarray(
+                        lin_t(f"{p}.mlp.c_proj.weight")),
+                    "bias": bias(f"{p}.mlp.c_proj.bias",
+                                 cfg.hidden_size),
+                },
+            },
+        }
+
+    params = {
+        "word_embeddings": {
+            "weight": jnp.asarray(_t(sd["embed_tokens.weight"]))},
+        "transformer": layers,
+        "final_layernorm": ln("norm"),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(_t(state_dict["lm_head.weight"]).T)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Starcoder2ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Starcoder2ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_starcoder2(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
