@@ -186,6 +186,9 @@ FAMILIES = {
     "olmo2": ("convert_hf_olmo2", "Olmo2ForCausalLM",
               lambda t: t.Olmo2Config(num_key_value_heads=2,
                                       **_LLAMA_KW)),
+    "olmo3": ("convert_hf_olmo3", "Olmo3ForCausalLM",
+              lambda t: t.Olmo3Config(num_key_value_heads=2,
+                                      sliding_window=32, **_LLAMA_KW)),
     "olmoe": ("convert_hf_olmoe", "OlmoeForCausalLM",
               lambda t: t.OlmoeConfig(
                   num_key_value_heads=2, num_experts=8,

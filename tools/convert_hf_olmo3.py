@@ -1,0 +1,83 @@
+"""Convert a HuggingFace OLMo-3 checkpoint into apex_tpu GPTModel
+params.
+
+OLMo-3 is the OLMo-2 mapping (convert_hf_olmo2: POST-norm blocks +
+projection-wide qk-norm) plus hybrid attention:
+
+- 3:1 sliding/full alternation ((i+1) % 4 — the model's own
+  convention) -> ``sliding_window`` + ``sliding_window_pattern=4``.
+- Dual rotary (HF modeling_olmo3 builds TWO rotary embeddings): the
+  SLIDING layers always use the plain default rope while only the
+  full-attention layers apply ``rope_scaling`` -> expressed here as
+  ``rotary_base_local = rope_theta`` (same base, scaling skipped on
+  windowed layers) whenever a scaling is present.
+- Custom ``layer_types`` lists that break the alternation are REFUSED.
+
+    from transformers import Olmo3ForCausalLM
+    from tools.convert_hf_olmo3 import convert_olmo3
+
+    hf = Olmo3ForCausalLM.from_pretrained(path)
+    cfg, params = convert_olmo3(hf.state_dict(), hf.config)
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # script-mode: make 'tools' importable
+
+from tools.convert_hf_olmo2 import convert_olmo2
+
+
+def convert_olmo3(state_dict, hf_config):
+    """(TransformerConfig, params pytree) from an Olmo3ForCausalLM
+    state_dict. Single-device layout (tp=1)."""
+    import dataclasses
+
+    pattern = 4
+    window = getattr(hf_config, "sliding_window", None)
+    layer_types = getattr(hf_config, "layer_types", None)
+    if window is not None:
+        expected = ["sliding_attention" if (i + 1) % pattern
+                    else "full_attention"
+                    for i in range(hf_config.num_hidden_layers)]
+    else:
+        expected = ["full_attention"] * hf_config.num_hidden_layers
+    if layer_types is not None and list(layer_types) != expected:
+        raise ValueError(
+            f"layer_types {layer_types!r} does not match the 3:1 "
+            f"sliding/full alternation this model expresses; refusing "
+            f"rather than misconverting the attention pattern")
+
+    cfg, params = convert_olmo2(state_dict, hf_config)
+    rep = {}
+    if window is not None:
+        rep.update(sliding_window=window, sliding_window_pattern=pattern)
+        if cfg.rope_scaling is not None:
+            # sliding layers keep the plain default rope; only the
+            # full-attention layers apply the scaling
+            rep["rotary_base_local"] = cfg.rotary_base
+    if rep:
+        cfg = dataclasses.replace(cfg, **rep)
+    return cfg, params
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model_path")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    from transformers import Olmo3ForCausalLM
+
+    from apex_tpu import checkpoint
+
+    hf = Olmo3ForCausalLM.from_pretrained(args.model_path)
+    cfg, params = convert_olmo3(hf.state_dict(), hf.config)
+    path = checkpoint.save(args.out_dir, 0, {"params": params,
+                                             "config": vars(cfg)})
+    print("saved:", path)
+
+
+if __name__ == "__main__":
+    main()
