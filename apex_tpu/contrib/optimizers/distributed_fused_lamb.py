@@ -22,6 +22,7 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     _flatten_f32,
     _unflatten_like,
 )
+from apex_tpu.parallel import compression
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
 
 
@@ -29,7 +30,10 @@ class DistributedFusedLAMB:
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, max_grad_norm=1.0,
                  adam_w_mode=True, grad_averaging=True, use_nvlamb=False,
-                 clip_after_ar=True, axis_name: str = "dp"):
+                 clip_after_ar=True, axis_name: str = "dp",
+                 compress: bool = False,
+                 grad_compress=None, param_compress=None,
+                 compress_block_size: int = compression.BLOCK_SIZE):
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -41,13 +45,29 @@ class DistributedFusedLAMB:
         self.use_nvlamb = use_nvlamb
         self.clip_after_ar = clip_after_ar
         self.axis_name = axis_name
+        # Compressed collectives, same policy as DistributedFusedAdam:
+        # compress=True -> int8 grads (error feedback in state) + bf16
+        # param gather; override per-path via grad_/param_compress.
+        # LAMB's grad-norm clip runs on the DEQUANTIZED shard, i.e.
+        # after quantization error enters — clip_after_ar semantics.
+        if compress and grad_compress is None:
+            grad_compress = "int8"
+        if compress and param_compress is None:
+            param_compress = "bf16"
+        self.grad_compress = grad_compress
+        self.param_compress = param_compress
+        self.compress_block_size = compress_block_size
 
     def _layout(self, params):
         leaves = jax.tree_util.tree_leaves(params)
         sizes = [int(np.prod(l.shape)) for l in leaves]
         n = sum(sizes)
         world = _axis_size(self.axis_name)
-        padded = ((n + world - 1) // world) * world
+        align = world
+        if "int8" in (self.grad_compress, self.param_compress):
+            # shard boundaries must land on quantization-block boundaries
+            align *= self.compress_block_size
+        padded = ((n + align - 1) // align) * align
         # static segment ids over the padded flat vector (pad -> segment T)
         seg = np.repeat(np.arange(len(sizes)), sizes)
         seg = np.concatenate([seg, np.full(padded - n, len(sizes))])
@@ -65,12 +85,15 @@ class DistributedFusedLAMB:
                                              padded // world)
         else:
             shard = flat
-        return {
+        state = {
             "step": jnp.zeros((), jnp.int32),
             "master_shard": shard,
             "exp_avg_shard": jnp.zeros_like(shard),
             "exp_avg_sq_shard": jnp.zeros_like(shard),
         }
+        if self.grad_compress == "int8":
+            state["grad_residual"] = jnp.zeros((padded,), jnp.float32)
+        return state
 
     def _per_tensor_sq(self, x_shard, seg_shards, world, T):
         """Per-tensor sum-of-squares from a local flat shard + psum."""
@@ -95,8 +118,17 @@ class DistributedFusedLAMB:
 
         flat_g = _flatten_f32(grads) / scale
         flat_g = jnp.pad(flat_g, (0, padded - n))
+        grad_residual = state.get("grad_residual")
         if world > 1:
-            g_shard = lax.psum_scatter(flat_g, self.axis_name, tiled=True)
+            if self.grad_compress is None:
+                g_shard = lax.psum_scatter(flat_g, self.axis_name,
+                                           tiled=True)
+            else:
+                g_shard, grad_residual = \
+                    compression.psum_scatter_compressed(
+                        flat_g, self.axis_name, mode=self.grad_compress,
+                        residual=grad_residual,
+                        block_size=self.compress_block_size)
             if self.grad_averaging:
                 g_shard = g_shard / world
         else:
@@ -154,16 +186,26 @@ class DistributedFusedLAMB:
         v = jnp.where(keep, state["exp_avg_sq_shard"], v)
 
         if world > 1:
-            flat_p = lax.all_gather(p_new, self.axis_name, tiled=True)
+            if self.param_compress is None:
+                flat_p = lax.all_gather(p_new, self.axis_name, tiled=True)
+            else:
+                flat_p = compression.all_gather_compressed(
+                    p_new, self.axis_name, mode=self.param_compress,
+                    block_size=self.compress_block_size)
         else:
             flat_p = p_new
         new_params = _unflatten_like(flat_p[:n], params)
-        return new_params, {
+        new_state = {
             "step": step,
             "master_shard": p_new,
             "exp_avg_shard": m,
             "exp_avg_sq_shard": v,
         }
+        if self.grad_compress == "int8":
+            # overflow-skipped steps drop the bogus quantization error
+            new_state["grad_residual"] = jnp.where(
+                keep, state["grad_residual"], grad_residual)
+        return new_params, new_state
 
     # reference-API hooks kept for drop-in use
     def set_global_scale(self, global_scale):

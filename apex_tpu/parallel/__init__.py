@@ -12,6 +12,8 @@ with the backward pass — the same overlap the reference hand-builds with
 CUDA streams.
 """
 
+from apex_tpu.parallel import compression  # noqa: F401
+from apex_tpu.parallel.compression import init_residual  # noqa: F401
 from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
     Reducer,

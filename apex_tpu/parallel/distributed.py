@@ -23,17 +23,36 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu import _C
+from apex_tpu.parallel import compression
+from apex_tpu.parallel.compression import init_residual  # noqa: F401
 
 
 def flatten(tensors):
-    """Coalesce a list of arrays into one flat fp32-width buffer
-    (parity: apex_C.flatten, csrc/flatten_unflatten.cpp)."""
+    """Coalesce a list of SAME-dtype arrays into one flat buffer
+    (parity: apex_C.flatten, csrc/flatten_unflatten.cpp).
+
+    Contract: all leaves share one dtype, so ``unflatten(flatten(ts),
+    ts)`` is bitwise round-trip-exact. ``jnp.concatenate`` would
+    otherwise silently promote a mixed-dtype list to the widest dtype
+    and ``unflatten``'s cast-back would lose the excursion — the
+    reference kernel only ever coalesces homogeneous buffers, and the
+    bucketed allreduce path guarantees it via ``plan_buckets``'s
+    dtype segregation."""
+    dtypes = {jnp.dtype(t.dtype) for t in tensors}
+    if len(dtypes) > 1:
+        raise ValueError(
+            f"flatten: mixed dtypes {sorted(d.name for d in dtypes)}; "
+            f"flatten/unflatten round-trip exactly only over a single "
+            f"dtype — group leaves with plan_buckets first")
     return jnp.concatenate([t.reshape(-1) for t in tensors])
 
 
 def unflatten(flat, tensors):
     """Split a flat buffer back into views shaped like ``tensors``
-    (parity: apex_C.unflatten)."""
+    (parity: apex_C.unflatten). Under :func:`flatten`'s single-dtype
+    contract the ``astype`` is an exact no-op; it remains to cast a
+    buffer that came back from a widened comm dtype (e.g. an fp32
+    allreduce of bf16 grads)."""
     outs, off = [], 0
     for t in tensors:
         n = t.size
@@ -54,7 +73,9 @@ def _axis_size_total(axis_name):
 
 
 def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
-                      gradient_predivide_factor):
+                      gradient_predivide_factor, compress=None,
+                      compress_block_size=compression.BLOCK_SIZE,
+                      residual=None):
     """The DDP reduction policy (reference distributed.py:429-479
     ``allreduce_bucket``): optional fp32 comm dtype, predivide before /
     postdivide after the psum, cast back to the original dtype.
@@ -63,19 +84,39 @@ def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
     parallelism borrows devices from the replica axis); an empty tuple
     skips the reduction (used as ``expert_axis_name=()`` to leave expert
     shards untouched in a pre-sync pass, e.g. before a ZeRO optimizer
-    that reduce-scatters over dp itself)."""
+    that reduce-scatters over dp itself).
+
+    ``compress`` selects the comm payload: None (full width, honoring
+    ``allreduce_always_fp32``), "bf16" (cast payload), or "int8"
+    (block-quantized with error feedback — see parallel/compression.py).
+    A compress mode owns the comm dtype, so it overrides
+    ``allreduce_always_fp32``. With ``compress="int8"`` the return is
+    ``(g, new_residual)`` and ``residual`` (fp32, same shape as ``g``,
+    zeros on step 0) is added into the payload before quantization; the
+    residual lives in the pre-psum, predivided gradient domain, so keep
+    ``gradient_predivide_factor`` fixed across steps."""
+    is_int8 = compress == "int8"
     if isinstance(axis_name, (tuple, list)) and len(axis_name) == 0:
-        return g
+        return (g, residual) if is_int8 else g
     orig_dtype = g.dtype
-    if allreduce_always_fp32:
+    if compress is None and allreduce_always_fp32:
         g = g.astype(jnp.float32)
     if gradient_predivide_factor != 1.0:
         g = g / gradient_predivide_factor
-    g = lax.psum(g, axis_name)
+    if compress is not None:
+        shape = g.shape
+        flat_r = None if residual is None else residual.reshape(-1)
+        g, new_residual = compression.psum_compressed(
+            g.reshape(-1), axis_name, mode=compress, residual=flat_r,
+            block_size=compress_block_size)
+        g = g.reshape(shape)
+    else:
+        g = lax.psum(g, axis_name)
     if gradient_average:
         n = _axis_size_total(axis_name)
         g = g / (n / gradient_predivide_factor)
-    return g.astype(orig_dtype)
+    g = g.astype(orig_dtype)
+    return (g, new_residual.reshape(g.shape)) if is_int8 else g
 
 
 def _leaf_path_str(path) -> str:
@@ -85,7 +126,10 @@ def _leaf_path_str(path) -> str:
 
 def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
                          gradient_average=True, gradient_predivide_factor=1.0,
-                         expert_param_predicate=None, expert_axis_name="dp"):
+                         expert_param_predicate=None, expert_axis_name="dp",
+                         compress=None,
+                         compress_block_size=compression.BLOCK_SIZE,
+                         residual=None):
     """Allreduce a grad pytree over a mesh axis (the DDP hot path).
 
     With expert parallelism (mesh has an 'ep' axis), dense params replicate
@@ -95,18 +139,48 @@ def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
     against the '/'-joined leaf path) so each group reduces over the right
     replica set. Reducing an MoE model over 'dp' alone silently diverges
     the dense params across ep.
+
+    ``compress=None|"bf16"|"int8"`` selects the comm payload (see
+    parallel/compression.py). With ``"int8"`` the return becomes
+    ``(grads, residual)`` — carry the residual pytree to the next call
+    (``residual=None`` starts from zeros).
     """
+    if compress == "int8":
+        if residual is None:
+            residual = init_residual(grads)
+        paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        res_leaves = jax.tree_util.tree_leaves(residual)
+        new_g, new_r = [], []
+        for (path, g), r in zip(paths_leaves, res_leaves):
+            ax = axis_name
+            if expert_param_predicate is not None and \
+                    expert_param_predicate(_leaf_path_str(path)):
+                ax = expert_axis_name
+            g2, r2 = _psum_with_policy(
+                g, ax, allreduce_always_fp32, gradient_average,
+                gradient_predivide_factor, compress="int8",
+                compress_block_size=compress_block_size, residual=r)
+            new_g.append(g2)
+            new_r.append(r2)
+        return (jax.tree_util.tree_unflatten(treedef, new_g),
+                jax.tree_util.tree_unflatten(treedef, new_r))
+
     if expert_param_predicate is None:
         return jax.tree_util.tree_map(
             lambda g: _psum_with_policy(g, axis_name, allreduce_always_fp32,
                                         gradient_average,
-                                        gradient_predivide_factor), grads)
+                                        gradient_predivide_factor,
+                                        compress=compress,
+                                        compress_block_size=compress_block_size),
+            grads)
 
     def fix(path, g):
         ax = (expert_axis_name if expert_param_predicate(_leaf_path_str(path))
               else axis_name)
         return _psum_with_policy(g, ax, allreduce_always_fp32,
-                                 gradient_average, gradient_predivide_factor)
+                                 gradient_average, gradient_predivide_factor,
+                                 compress=compress,
+                                 compress_block_size=compress_block_size)
 
     return jax.tree_util.tree_map_with_path(fix, grads)
 
@@ -143,16 +217,30 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
                                   gradient_average=True,
                                   gradient_predivide_factor=1.0,
                                   expert_param_predicate=None,
-                                  expert_axis_name="dp"):
+                                  expert_axis_name="dp",
+                                  compress=None,
+                                  compress_block_size=compression.BLOCK_SIZE,
+                                  residual=None):
     """Bucketed DDP allreduce: flatten same-dtype runs of leaves into
     ``message_size``-element buckets and psum each bucket as ONE collective
     (reference allreduce_bucket over apex_C-flattened buffers,
     distributed.py:429-479). Fewer, larger ICI collectives than the
     per-leaf path; use inside a jitted step. Expert-parallel handling as in
     :func:`all_reduce_gradients` — expert leaves bucket separately and
-    reduce over ``expert_axis_name``."""
+    reduce over ``expert_axis_name``.
+
+    ``compress`` works per BUCKET (one quantization grid per flat
+    buffer — fewer ragged tails than per-leaf); with ``"int8"`` the
+    return is ``(grads, residual)`` and the residual pytree stays
+    leaf-shaped (it is flattened into the bucket alongside the grads),
+    so the same residual state works for either sync path."""
+    is_int8 = compress == "int8"
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(grads)
     leaves = [l for _, l in paths_leaves]
+    if is_int8:
+        if residual is None:
+            residual = init_residual(grads)
+        res_leaves = jax.tree_util.tree_leaves(residual)
     if expert_param_predicate is None:
         groups = [(axis_name, list(range(len(leaves))))]
     else:
@@ -162,6 +250,7 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
         dense = [i for i in range(len(leaves)) if i not in expert_set]
         groups = [(axis_name, dense), (expert_axis_name, expert)]
     out = [None] * len(leaves)
+    out_res = [None] * len(leaves)
     n = 0
     for ax, idxs in groups:
         if not idxs:
@@ -172,13 +261,32 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
             # around allreduce_bucket (distributed.py:429, prof flag)
             with jax.named_scope(f"ddp_allreduce_bucket_{n}"):
                 flat = flatten([leaves[i] for i in bucket])
-                flat = _psum_with_policy(flat, ax, allreduce_always_fp32,
-                                         gradient_average,
-                                         gradient_predivide_factor)
+                if is_int8:
+                    flat_r = flatten([res_leaves[i] for i in bucket])
+                    flat, flat_r = _psum_with_policy(
+                        flat, ax, allreduce_always_fp32, gradient_average,
+                        gradient_predivide_factor, compress="int8",
+                        compress_block_size=compress_block_size,
+                        residual=flat_r)
+                    for i, piece in zip(
+                            bucket,
+                            unflatten(flat_r,
+                                      [res_leaves[i] for i in bucket])):
+                        out_res[i] = piece
+                else:
+                    flat = _psum_with_policy(flat, ax, allreduce_always_fp32,
+                                             gradient_average,
+                                             gradient_predivide_factor,
+                                             compress=compress,
+                                             compress_block_size=
+                                             compress_block_size)
                 for i, piece in zip(
                         bucket, unflatten(flat, [leaves[i] for i in bucket])):
                     out[i] = piece
             n += 1
+    if is_int8:
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                jax.tree_util.tree_unflatten(treedef, out_res))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -229,7 +337,9 @@ class DistributedDataParallel:
                  prof: bool = False,
                  axis_name: str = "dp",
                  expert_param_predicate: Optional[Callable] = None,
-                 expert_axis_name: str = "dp"):
+                 expert_axis_name: str = "dp",
+                 compress: Optional[str] = None,
+                 compress_block_size: int = compression.BLOCK_SIZE):
         self.module = module
         self.axis_name = axis_name
         self.message_size = message_size
@@ -244,11 +354,32 @@ class DistributedDataParallel:
         # module-wrapping mode syncs every param uniformly.
         self.expert_param_predicate = expert_param_predicate
         self.expert_axis_name = expert_axis_name
+        # Compressed gradient collectives (parallel/compression.py):
+        # None | "bf16" | "int8". int8 makes .sync stateful — it returns
+        # (grads, residual) and the caller threads the residual pytree
+        # through the jitted step (donate it like optimizer state).
+        self.compress = compress
+        self.compress_block_size = compress_block_size
 
-    def sync(self, grads):
+    def init_residual(self, grads_or_params):
+        """Zero error-feedback state for ``compress="int8"`` (a pytree
+        shaped like the grads; donate it through the train step)."""
+        return init_residual(grads_or_params)
+
+    def sync(self, grads, residual=None):
         """Bucketed grad allreduce honoring ``message_size`` (reference
         create_hooks bucketing); pass ``message_size=None`` at construction
-        for the per-leaf path."""
+        for the per-leaf path.
+
+        With ``compress="int8"`` returns ``(grads, residual)``; pass the
+        previous step's residual in (``None`` starts from zeros — step 0
+        of error feedback)."""
+        kw = {}
+        if self.compress is not None:
+            kw = dict(compress=self.compress,
+                      compress_block_size=self.compress_block_size)
+            if self.compress == "int8":
+                kw["residual"] = residual
         if self.message_size:
             return all_reduce_gradients_bucketed(
                 grads, self.axis_name, message_size=self.message_size,
@@ -256,14 +387,14 @@ class DistributedDataParallel:
                 gradient_average=self.gradient_average,
                 gradient_predivide_factor=self.gradient_predivide_factor,
                 expert_param_predicate=self.expert_param_predicate,
-                expert_axis_name=self.expert_axis_name)
+                expert_axis_name=self.expert_axis_name, **kw)
         return all_reduce_gradients(
             grads, self.axis_name,
             allreduce_always_fp32=self.allreduce_always_fp32,
             gradient_average=self.gradient_average,
             gradient_predivide_factor=self.gradient_predivide_factor,
             expert_param_predicate=self.expert_param_predicate,
-            expert_axis_name=self.expert_axis_name)
+            expert_axis_name=self.expert_axis_name, **kw)
 
     def __call__(self, fn=None, *args, **kwargs):
         """If constructed around a module/apply fn, call it; DDP on TPU is
@@ -324,9 +455,15 @@ def _ddp_bwd(fn, axis_name, gradient_average, vjp, g):
     # first one.
     axes = (tuple(axis_name) if isinstance(axis_name, (tuple, list))
             else (axis_name,))
-    states = {
-        ax in getattr(jax.typeof(lax.axis_index(ax)), "vma", frozenset())
-        for ax in axes}
+    if not hasattr(jax, "typeof"):
+        # jax < 0.6 has no vma typing at all: the experimental shard_map
+        # used there runs check_rep=False (apex_tpu.testing.shard_map),
+        # i.e. always the unchecked regime — DDP performs the allreduce.
+        states = {False}
+    else:
+        states = {
+            ax in getattr(jax.typeof(lax.axis_index(ax)), "vma", frozenset())
+            for ax in axes}
     if len(states) != 1:
         raise ValueError(
             f"mixed vma checking states across mesh axes {axes}; DDP "

@@ -22,6 +22,11 @@
                                            SwiGLU + RMSNorm) tokens/sec/chip
     python bench.py decode [batch] [new]   KV-cache decode throughput
                                            (serving) tokens/sec/chip
+    python bench.py ddp_compressed [batch] [steps]  DDP step with int8
+                                           block-quantized grad
+                                           collectives + error feedback;
+                                           emits comm_bytes_per_step
+                                           (int8 vs fp32)
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
@@ -52,11 +57,58 @@ def _emit_bench_error(error, kind):
     """The one bench_error emission point — the driver and the capture
     scripts parse this line, and the queue aborts only on kind='wedge'
     (a backend-level failure poisons every later bench in this process
-    tree; a single bench's crash/OOM must not)."""
+    tree; a single bench's crash/OOM must not). ``comm_bytes_per_step``
+    rides along even here (the round-6 capture contract: the comm-bytes
+    field must appear in every BENCH JSON) — it carries the last
+    estimate the dying bench computed, or null before model init."""
     print(json.dumps({
         "metric": "bench_error", "value": 0, "unit": "error",
         "vs_baseline": 0.0, "kind": kind, "error": error,
+        "comm_bytes_per_step": _LAST_COMM_BYTES,
     }), flush=True)
+
+
+# last comm-bytes estimate computed by any bench in this process; the
+# bench_error path reports it so a crash after model init still records
+# the comm accounting for the config that died
+_LAST_COMM_BYTES = None
+
+
+def _tree_size(params):
+    return int(sum(np.prod(l.shape)
+                   for l in jax.tree_util.tree_leaves(params)))
+
+
+def _comm_fields(params=None, *, compress=None, n_elements=None,
+                 training=True):
+    """Estimated per-step gradient-sync bytes for the emitted JSON.
+
+    Single-chip captures have no live collectives, so this models the
+    DP allreduce the config would run at scale: a ring over
+    APEX_TPU_COMM_WORLD replicas (default 8) moving one gradient set of
+    the model's parameter count per step, at the wire width selected by
+    ``compress`` (see compression.estimate_allreduce_bytes — int8
+    counts the EQuARX-style quantized payload). Serving benches pass
+    ``training=False`` and report 0 — no grad sync exists to compress.
+    """
+    global _LAST_COMM_BYTES
+    from apex_tpu.parallel import compression
+
+    if not training:
+        fields = {"comm_bytes_per_step": 0,
+                  "comm_model": "none (serving: no grad sync)"}
+        _LAST_COMM_BYTES = 0
+        return fields
+    n = _tree_size(params) if n_elements is None else int(n_elements)
+    world = int(os.environ.get("APEX_TPU_COMM_WORLD", "8"))
+    fields = {
+        "comm_bytes_per_step": compression.estimate_allreduce_bytes(
+            n, world=world, compress=compress),
+        "comm_model": f"ring allreduce, dp={world}, "
+                      f"payload={compress or 'fp32'}",
+    }
+    _LAST_COMM_BYTES = fields["comm_bytes_per_step"]
+    return fields
 
 
 def _arm_watchdog():
@@ -194,7 +246,8 @@ def bench_bert(batch, steps):
                         loss_index=2)
     flops = 3 * batch * seq * _transformer_fwd_flops_per_token(cfg, seq)
     _emit("bert_large_fused_lamb_samples_per_sec_per_chip",
-          batch * steps / dt, "samples/sec", flops, steps, dt)
+          batch * steps / dt, "samples/sec", flops, steps, dt,
+          **_comm_fields(params))
 
 
 def bench_gpt_long(seq, steps):
@@ -233,7 +286,8 @@ def bench_gpt_long(seq, steps):
                         loss_index=2)
     flops = 3 * seq * _transformer_fwd_flops_per_token(cfg, seq)
     _emit(f"gpt_long_context_seq{seq}_tokens_per_sec_per_chip",
-          seq * steps / dt, "tokens/sec", flops, steps, dt)
+          seq * steps / dt, "tokens/sec", flops, steps, dt,
+          **_comm_fields(params))
 
 
 def bench_llama(batch, steps):
@@ -275,7 +329,8 @@ def bench_llama(batch, steps):
                         loss_index=2)
     flops = 3 * batch * seq * _transformer_fwd_flops_per_token(cfg, seq)
     _emit("llama_style_gpt_tokens_per_sec_per_chip",
-          batch * seq * steps / dt, "tokens/sec", flops, steps, dt)
+          batch * seq * steps / dt, "tokens/sec", flops, steps, dt,
+          **_comm_fields(params))
 
 
 def bench_decode(batch, steps):
@@ -311,7 +366,8 @@ def bench_decode(batch, steps):
     flops = batch * steps * _transformer_fwd_flops_per_token(
         cfg, prompt.shape[1] + steps // 2)
     _emit("llama_style_decode_tokens_per_sec_per_chip",
-          batch * steps / dt, "tokens/sec", flops, 1, dt)
+          batch * steps / dt, "tokens/sec", flops, 1, dt,
+          **_comm_fields(training=False))
 
 
 def bench_gpt2(batch, steps, *, flash=None, scan=None, remat=None,
@@ -388,7 +444,8 @@ def bench_gpt2(batch, steps, *, flash=None, scan=None, remat=None,
     }
     if emit:
         _emit("gpt2_345m_tokens_per_sec_per_chip",
-              batch * seq * steps / dt, "tokens/sec", flops, steps, dt)
+              batch * seq * steps / dt, "tokens/sec", flops, steps, dt,
+              **_comm_fields(params))
     return result
 
 
@@ -441,7 +498,8 @@ def bench_t5(batch, steps):
     flops = 3 * fwd  # train = fwd + bwd (2x)
     total_tokens = batch * (enc_s + dec_s)
     _emit("t5_base_tokens_per_sec_per_chip",
-          total_tokens * steps / dt, "tokens/sec", flops, steps, dt)
+          total_tokens * steps / dt, "tokens/sec", flops, steps, dt,
+          **_comm_fields(params))
 
 
 def bench_whisper(batch, steps):
@@ -495,7 +553,8 @@ def bench_whisper(batch, steps):
            + batch * 2 * enc_s * 2 * (3 * cfg.num_mel_bins * h
                                       + 3 * h * h) // 2)
     _emit("whisper_base_audio_seconds_per_sec_per_chip",
-          batch * 30.0 * steps / dt, "audio_s/sec", 3 * fwd, steps, dt)
+          batch * 30.0 * steps / dt, "audio_s/sec", 3 * fwd, steps, dt,
+          **_comm_fields(params))
 
 
 def bench_vit(batch, steps):
@@ -535,7 +594,7 @@ def bench_vit(batch, steps):
     patch = 2 * (16 * 16 * 3) * h  # per patch position
     fwd = batch * (s * per_tok + (s - 1) * patch + 2 * h * 1000)
     _emit("vit_base_imgs_per_sec_per_chip", batch * steps / dt,
-          "imgs/sec", 3 * fwd, steps, dt)
+          "imgs/sec", 3 * fwd, steps, dt, **_comm_fields(params))
 
 
 def bench_moe(batch, steps):
@@ -581,7 +640,8 @@ def bench_moe(batch, steps):
                         loss_index=2)
     flops = 3 * batch * seq * _transformer_fwd_flops_per_token(cfg, seq)
     _emit("gpt_moe_8expert_tokens_per_sec_per_chip",
-          batch * seq * steps / dt, "tokens/sec", flops, steps, dt)
+          batch * seq * steps / dt, "tokens/sec", flops, steps, dt,
+          **_comm_fields(params))
 
 
 def bench_moe_serve(seq, steps):
@@ -642,7 +702,8 @@ def bench_moe_serve(seq, steps):
     flops = seq * _transformer_fwd_flops_per_token(cfg, seq)
     _emit("moe_dropless_serve_tokens_per_sec_per_chip",
           seq * steps / dt, "tokens/sec", flops, steps, dt,
-          seq=seq, dispatch_flops_ratio=round(float(ratio), 3))
+          seq=seq, dispatch_flops_ratio=round(float(ratio), 3),
+          **_comm_fields(training=False))
 
 
 def bench_mla_decode(prefix, steps):
@@ -719,7 +780,8 @@ def bench_mla_decode(prefix, steps):
           batch * steps / dt_flash, "tokens/sec", flops, 1, dt_flash,
           prefix=prefix,
           einsum_tokens_per_sec=round(batch * steps / dt_einsum, 2),
-          speedup=round(dt_einsum / dt_flash, 3))
+          speedup=round(dt_einsum / dt_flash, 3),
+          **_comm_fields(training=False))
 
 
 def _require_backend(attempts=3, probe_timeout=240, retry_wait=60):
@@ -861,7 +923,80 @@ def bench_resnet(batch, steps):
     imgs_per_sec = batch * steps / dt
     # ResNet-50 fwd ~4.09 GFLOPs/image at 224x224; train = 3x fwd
     _emit("resnet50_amp_o2_fused_adam_imgs_per_sec_per_chip",
-          imgs_per_sec, "imgs/sec", 3 * 4.09e9 * batch, steps, dt)
+          imgs_per_sec, "imgs/sec", 3 * 4.09e9 * batch, steps, dt,
+          **_comm_fields(params))
+
+
+def bench_ddp_compressed(batch, steps):
+    """DDP training step with block-quantized int8 gradient collectives
+    + error feedback (parallel/compression.py) over ALL visible devices
+    — the comm-compression capability capture. The emitted line carries
+    the estimated per-step grad-sync bytes for the int8 payload
+    (``comm_bytes_per_step``) next to the fp32 baseline
+    (``comm_bytes_per_step_fp32``) and their ratio, so the byte win is
+    visible even when the capture itself is compute-bound (or runs on
+    the single tunneled chip, where the dp axis degenerates to 1).
+
+    Model: a 4x1024 MLP regressor — big enough that the flat grad
+    bucket spans thousands of quantization blocks, small enough to
+    compile in seconds on the 1-core CPU host (the smoke path).
+    """
+    from apex_tpu.parallel import DistributedDataParallel, compression
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    hidden, depth = 1024, 4
+    rng = np.random.RandomState(0)
+    params = {}
+    for i in range(depth):
+        params[f"w{i}"] = jnp.asarray(
+            rng.randn(hidden, hidden).astype(np.float32)
+            / np.sqrt(hidden))
+        params[f"b{i}"] = jnp.zeros((hidden,), jnp.float32)
+    x = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8")
+    residual = ddp.init_residual(params)
+
+    def loss_fn(p, xb, yb):
+        h = xb
+        for i in range(depth):
+            h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+        return jnp.mean((h - yb) ** 2)
+
+    def step_fn(p, res, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        grads, res = ddp.sync(grads, res)
+        p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, p, grads)
+        return p, res, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P(), P("dp"), P("dp")),
+                            out_specs=(P(), P(), P()),
+                            check_vma=False)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, res):
+        return sharded(p, res, x, y)
+
+    dt, _ = _time_steps(train_step, (params, residual), steps,
+                        loss_index=2)
+    n = _tree_size(params)
+    fields = _comm_fields(params, compress="int8")
+    fp32_bytes = compression.estimate_allreduce_bytes(
+        n, world=int(os.environ.get("APEX_TPU_COMM_WORLD", "8")))
+    # fwd 2 flops/param-touch, train = 3x fwd
+    flops = 6 * batch * world * depth * hidden * hidden
+    _emit("ddp_compressed_int8_steps_per_sec",
+          steps / dt, "steps/sec", flops, steps, dt,
+          dp_world=world, grad_elements=n,
+          comm_bytes_per_step_fp32=fp32_bytes,
+          comm_bytes_reduction=round(
+              fp32_bytes / max(fields["comm_bytes_per_step"], 1), 2),
+          **fields)
 
 
 # The canonical (size, steps) per bench — the ONLY place these defaults
@@ -883,6 +1018,7 @@ BENCH_SPECS = {
     "llama": ((4, 15), bench_llama),
     "decode": ((8, 128), bench_decode),
     "resnet": ((256, 50), bench_resnet),
+    "ddp_compressed": ((64, 30), bench_ddp_compressed),
 }
 
 

@@ -1,0 +1,232 @@
+"""Block-quantized gradient collectives + error feedback (ISSUE 1).
+
+Covers the tentpole acceptance criteria on the virtual 8-device CPU mesh:
+int8 compressed allreduce matches the fp32 psum within the per-block
+quantization bound; compress="bf16" is exact on bf16 grads; the ragged
+tail (size not divisible by the block) round-trips within bound; the
+Pallas quantize/dequantize kernel (interpreter mode) matches the jnp
+oracle; and an int8-compressed DDP training run converges within 2% of
+the uncompressed baseline thanks to the error-feedback residual.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import (
+    DistributedDataParallel,
+    all_reduce_gradients,
+    all_reduce_gradients_bucketed,
+    compression,
+    init_residual,
+)
+from apex_tpu.testing import shard_map
+
+
+class TestQuantizeDequantize:
+    def test_roundtrip_bound_ragged_tail(self, rng):
+        """n=1000 with block 256 -> 3 full blocks + a 232-ragged tail;
+        every element round-trips within half the block's scale."""
+        n = 1000
+        x = jnp.asarray((rng.randn(n) * 3).astype(np.float32))
+        q, s = compression.quantize_blockwise(x)
+        assert q.dtype == jnp.int8 and q.shape == (4, 256)
+        y = compression.dequantize_blockwise(q, s, n=n)
+        err = np.abs(np.asarray(y) - np.asarray(x))
+        bound = np.repeat(np.asarray(s).reshape(-1), 256)[:n] / 2
+        assert (err <= bound * (1 + 1e-6) + 1e-8).all()
+
+    def test_zero_block_is_exact(self):
+        x = jnp.zeros((512,), jnp.float32)
+        q, s = compression.quantize_blockwise(x)
+        y = compression.dequantize_blockwise(q, s, n=512)
+        np.testing.assert_array_equal(np.asarray(y), np.zeros(512))
+        assert np.isfinite(np.asarray(s)).all()
+
+    def test_pallas_kernel_matches_jnp(self, rng):
+        """Interpreter-mode Pallas kernel vs the pure-jnp oracle: the
+        int8 codes are identical and the dequantized values match."""
+        n = 300  # ragged + forces row padding inside the kernel wrapper
+        x = jnp.asarray((rng.randn(n) * 0.7).astype(np.float32))
+        q_ref, s_ref = compression.quantize_blockwise(x)
+        y_ref = compression.dequantize_blockwise(q_ref, s_ref, n=n)
+        compression.force_interpret(True)
+        try:
+            q_pl, s_pl = compression.quantize_blockwise(x)
+            y_pl = compression.dequantize_blockwise(q_pl, s_pl, n=n)
+        finally:
+            compression.force_interpret(False)
+        np.testing.assert_array_equal(np.asarray(q_ref), np.asarray(q_pl))
+        np.testing.assert_allclose(np.asarray(s_ref), np.asarray(s_pl))
+        np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_pl))
+
+
+@pytest.mark.multi_device
+class TestCompressedAllReduce:
+    def test_int8_matches_fp32_within_block_bound(self, rng, dp_mesh):
+        """The acceptance parity check: compressed allreduce (average)
+        vs fp32 psum, elementwise within shared-block-scale/2 — each
+        replica's quantization error is <= s/2, and averaging the 8
+        errors keeps the bound."""
+        mesh = dp_mesh(8)
+        n = 1000
+        g = jnp.asarray(rng.randn(8, n).astype(np.float32))
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
+                           out_specs=(P("dp"), P("dp")))
+        def f(gs):
+            out, res = all_reduce_gradients({"w": gs[0]}, "dp",
+                                            compress="int8")
+            return out["w"][None], res["w"][None]
+
+        out, res = f(g)
+        x = np.asarray(g)
+        mean = x.mean(0)
+        err = np.abs(np.asarray(out)[0] - mean)
+        padded = np.pad(x, ((0, 0), (0, 1024 - n))).reshape(8, 4, 256)
+        shared_scale = np.abs(padded).max(-1).max(0) / 127.0
+        bound = np.repeat(shared_scale, 256)[:n] / 2
+        assert (err <= bound * (1 + 1e-5) + 1e-8).all()
+        # the residual is exactly the local quantization error: nonzero
+        assert np.abs(np.asarray(res)).max() > 0
+
+    def test_bf16_mode_exact_on_bf16_grads(self, rng, dp_mesh):
+        """compress="bf16" on bf16 grads is a no-op cast: bitwise equal
+        to the uncompressed psum (which also sums in bf16)."""
+        mesh = dp_mesh(8)
+        g = jnp.asarray(rng.randn(8, 512).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
+                           out_specs=(P("dp"), P("dp")))
+        def f(gs):
+            a = all_reduce_gradients({"w": gs[0]}, "dp")["w"]
+            b = all_reduce_gradients({"w": gs[0]}, "dp",
+                                     compress="bf16")["w"]
+            return a[None], b[None]
+
+        a, b = f(g)
+        assert b.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(a.astype(jnp.float32)),
+            np.asarray(b.astype(jnp.float32)))
+
+    def test_bucketed_int8(self, rng, dp_mesh):
+        """Bucketed path: quantization runs per flat bucket; result
+        within the global bound max|g|/127/2 and the residual pytree
+        stays leaf-shaped."""
+        mesh = dp_mesh(8)
+        n = 1000
+        g = jnp.asarray(rng.randn(8, n).astype(np.float32))
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
+                           out_specs=(P("dp"), P("dp"), P("dp")))
+        def f(gs):
+            grads = {"a": gs[0, :600].reshape(30, 20), "b": gs[0, 600:]}
+            out, res = all_reduce_gradients_bucketed(
+                grads, "dp", message_size=350, compress="int8")
+            return (out["a"].reshape(-1)[None], out["b"][None],
+                    res["a"].reshape(-1)[None])
+
+        oa, ob, ra = f(g)
+        x = np.asarray(g)
+        mean = x.mean(0)
+        got = np.concatenate([np.asarray(oa)[0], np.asarray(ob)[0]])
+        bound = np.abs(x).max() / 127.0 / 2
+        assert np.abs(got - mean).max() <= bound * (1 + 1e-5)
+        assert np.asarray(ra).shape == (8, 600)  # per-replica residuals
+
+    def test_predivide_composes(self, rng, dp_mesh):
+        """gradient_predivide_factor with int8: same average within the
+        (rescaled) quantization bound."""
+        mesh = dp_mesh(8)
+        g = jnp.asarray(rng.randn(8, 256).astype(np.float32))
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
+                           out_specs=P("dp"))
+        def f(gs):
+            out, _ = all_reduce_gradients(
+                {"w": gs[0]}, "dp", compress="int8",
+                gradient_predivide_factor=4.0)
+            return out["w"][None]
+
+        out = f(g)
+        x = np.asarray(g)
+        bound = (np.abs(x / 4).max() / 127.0 / 2) * 4 * (1 + 1e-5)
+        assert np.abs(np.asarray(out)[0] - x.mean(0)).max() <= bound
+
+
+def _mlp_init(rng):
+    return {
+        "w1": jnp.asarray((rng.randn(16, 32) / 4).astype(np.float32)),
+        "b1": jnp.zeros((32,), jnp.float32),
+        "w2": jnp.asarray((rng.randn(32, 1) / 5).astype(np.float32)),
+        "b2": jnp.zeros((1,), jnp.float32),
+    }
+
+
+def _mlp_loss(p, x, y):
+    h = jnp.tanh(x @ p["w1"] + p["b1"])
+    out = h @ p["w2"] + p["b2"]
+    return jnp.mean((out - y) ** 2)
+
+
+@pytest.mark.multi_device
+class TestErrorFeedbackConvergence:
+    def test_toy_mlp_within_2pct(self, rng, dp_mesh):
+        """The acceptance convergence check: 200 SGD steps on a toy MLP
+        regression (noisy targets -> nonzero loss floor), int8-compressed
+        DDP with error feedback vs fp32 psum; final losses within 2%."""
+        mesh = dp_mesh(8)
+        w_true = rng.randn(16, 1).astype(np.float32)
+        x = rng.randn(256, 16).astype(np.float32)
+        y = x @ w_true + 0.1 * rng.randn(256, 1).astype(np.float32)
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+        params0 = _mlp_init(rng)
+
+        def train(compress):
+            ddp = DistributedDataParallel(axis_name="dp",
+                                          compress=compress)
+            params = jax.tree_util.tree_map(lambda a: a, params0)
+            residual = init_residual(params) if compress else None
+
+            def step(p, res, xb, yb):
+                loss, grads = jax.value_and_grad(_mlp_loss)(p, xb, yb)
+                if compress == "int8":
+                    grads, res = ddp.sync(grads, res)
+                else:
+                    grads = ddp.sync(grads)
+                p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g,
+                                           p, grads)
+                return p, res, loss
+
+            sharded = shard_map(step, mesh=mesh,
+                                in_specs=(P(), P(), P("dp"), P("dp")),
+                                out_specs=(P(), P(), P()))
+            jitted = jax.jit(sharded)
+            loss = None
+            for _ in range(200):
+                params, residual, loss = jitted(params, residual, xj, yj)
+            return float(loss)
+
+        loss_fp32 = train(None)
+        loss_int8 = train("int8")
+        assert loss_int8 == pytest.approx(loss_fp32, rel=0.02), \
+            f"int8+EF {loss_int8} vs fp32 {loss_fp32}"
+
+
+class TestByteAccounting:
+    def test_int8_cuts_bytes_3x(self):
+        n = 25_600_000  # ~ResNet-50 parameter count
+        fp32 = compression.estimate_allreduce_bytes(n, world=8)
+        int8 = compression.estimate_allreduce_bytes(n, world=8,
+                                                    compress="int8")
+        bf16 = compression.estimate_allreduce_bytes(n, world=8,
+                                                    compress="bf16")
+        assert fp32 / int8 >= 3.0
+        assert fp32 / bf16 == pytest.approx(2.0)
+        assert compression.estimate_allreduce_bytes(n, world=1) == 0

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from apex_tpu.testing import shard_map
 
 from apex_tpu.parallel import (
@@ -25,10 +25,6 @@ from apex_tpu.parallel import (
 from apex_tpu.optimizers import FusedSGD
 
 
-def dp_mesh(n=8):
-    return Mesh(np.asarray(jax.devices()[:n]), ("dp",))
-
-
 class TestFlattenUnflatten:
     def test_roundtrip(self, rng):
         ts = [jnp.asarray(rng.randn(3, 4).astype(np.float32)),
@@ -39,9 +35,33 @@ class TestFlattenUnflatten:
         for a, b in zip(ts, outs):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_mixed_dtype_rejected(self):
+        """Regression: flatten used to let jnp.concatenate silently
+        promote a mixed-dtype leaf list to the widest dtype (while its
+        docstring claimed an fp32-width buffer) and unflatten papered
+        over it with .astype — a lossy, not-round-trip-exact pair. The
+        contract is now a single dtype, which plan_buckets guarantees
+        on the bucketed allreduce path."""
+        ts = [jnp.zeros((3,), jnp.float32), jnp.zeros((3,), jnp.bfloat16)]
+        with pytest.raises(ValueError, match="mixed dtypes"):
+            flatten(ts)
 
+    def test_bf16_roundtrip_exact(self, rng):
+        ts = [jnp.asarray(rng.randn(5, 3).astype(np.float32)
+                          ).astype(jnp.bfloat16),
+              jnp.asarray(rng.randn(7).astype(np.float32)
+                          ).astype(jnp.bfloat16)]
+        flat = flatten(ts)
+        assert flat.dtype == jnp.bfloat16  # no silent widening
+        for a, b in zip(ts, unflatten(flat, ts)):
+            np.testing.assert_array_equal(
+                np.asarray(a.astype(jnp.float32)),
+                np.asarray(b.astype(jnp.float32)))
+
+
+@pytest.mark.multi_device
 class TestAllReduceGradients:
-    def test_grad_average(self, rng):
+    def test_grad_average(self, rng, dp_mesh):
         mesh = dp_mesh()
         grads = jnp.asarray(rng.randn(8, 4).astype(np.float32))
 
@@ -55,7 +75,7 @@ class TestAllReduceGradients:
             np.asarray(grads).mean(0, keepdims=True), (8, 4))
         np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
 
-    def test_predivide_factor(self, rng):
+    def test_predivide_factor(self, rng, dp_mesh):
         mesh = dp_mesh()
         grads = jnp.asarray(rng.randn(8, 4).astype(np.float32))
 
@@ -71,7 +91,7 @@ class TestAllReduceGradients:
             np.asarray(grads).mean(0, keepdims=True), (8, 4))
         np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
 
-    def test_no_average(self, rng):
+    def test_no_average(self, rng, dp_mesh):
         mesh = dp_mesh()
         grads = jnp.asarray(rng.randn(8, 4).astype(np.float32))
 
@@ -87,8 +107,9 @@ class TestAllReduceGradients:
         np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
 
 
+@pytest.mark.multi_device
 class TestBroadcastParams:
-    def test_rank0_wins(self, rng):
+    def test_rank0_wins(self, rng, dp_mesh):
         mesh = dp_mesh()
         params = jnp.asarray(rng.randn(8, 4).astype(np.float32))
 
@@ -102,8 +123,9 @@ class TestBroadcastParams:
             np.testing.assert_array_equal(out[i], np.asarray(params)[0])
 
 
+@pytest.mark.multi_device
 class TestDDPWrapper:
-    def test_grads_are_synced(self, rng):
+    def test_grads_are_synced(self, rng, dp_mesh):
         """DDP-wrapped loss fn: per-device grads equal the dp average
         (the reference's race-condition test checks exactly grad values,
         tests/distributed/DDP/ddp_race_condition_test.py:28-40)."""
@@ -130,7 +152,8 @@ class TestDDPWrapper:
 
 
 class TestSyncBatchNorm:
-    def test_matches_global_batchnorm(self, rng):
+    @pytest.mark.multi_device
+    def test_matches_global_batchnorm(self, rng, dp_mesh):
         """Sync-BN over the dp axis == plain BN over the concatenated batch
         (reference tests/distributed/synced_batchnorm)."""
         mesh = dp_mesh()

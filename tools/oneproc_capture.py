@@ -131,6 +131,8 @@ def _stages(smoke):
             ("gpt2_scan", None,
              lambda: bench.bench_gpt2(2, 2, tiny=True, scan=True)),
             ("moe_serve", None, lambda: bench.bench_moe_serve(128, 2)),
+            ("ddp_compressed", None,
+             lambda: bench.bench_ddp_compressed(8, 2)),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -168,6 +170,11 @@ def _stages(smoke):
         ("gpt2_noflash", None, gpt2_variant("noflash", flash=False)),
         # BASELINE.json headline 2
         ("bert", None, spec("bert")),
+        # round-6 compressed-collective capture: int8 grad allreduce +
+        # error feedback; the emitted comm_bytes_per_step /
+        # comm_bytes_per_step_fp32 pair is the evidence for the >=3x
+        # byte cut (ISSUE 1 acceptance)
+        ("ddp_compressed", None, spec("ddp_compressed")),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),
