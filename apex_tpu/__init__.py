@@ -20,6 +20,9 @@ reference (juncongmoo/apex, mounted at /root/reference):
   flash attention, fused dense/MLP (reference apex/contrib/).
 - ``apex_tpu.models``         — ResNet, GPT, BERT, DCGAN model families used
   by the examples and benchmarks (reference examples/, apex/transformer/testing/).
+- ``apex_tpu.telemetry``      — unified tracing/metrics/XLA cost accounting
+  (spans, collective byte counters, MFU from ``cost_analysis()``); no
+  reference counterpart — see docs/observability.md.
 
 Design notes (TPU-first, not a port):
 - CUDA multi-tensor kernels -> one jitted update over the parameter pytree;
@@ -80,6 +83,7 @@ from apex_tpu._logging import RankInfoFormatter, deprecated_warning  # noqa: F40
 # Light-weight subpackages are imported eagerly so `import apex_tpu` gives the
 # same surface as `import apex` (reference apex/__init__.py imports amp etc.
 # lazily behind try/except; we are pure-Python+JAX so imports are cheap).
+from apex_tpu import telemetry  # noqa: F401
 from apex_tpu import multi_tensor_apply  # noqa: F401
 from apex_tpu import optimizers  # noqa: F401
 from apex_tpu import normalization  # noqa: F401

@@ -12,21 +12,45 @@ On TPU there is one Python process per host rather than per chip, so the
 import logging
 import warnings
 
+# Resolved providers, cached after first successful import: every log
+# record used to re-run the import machinery (and swallow the resulting
+# exceptions) inside the formatter — pure overhead on the hot logging
+# path. False = import failed (don't retry per record); the
+# *initialization state* stays dynamic: parallel_state may become
+# initialized after the first record, so only the module lookup is
+# cached, not the answer.
+_PARALLEL_STATE = None
+_JAX = None
+
 
 def _get_rank_info():
-    try:
-        from apex_tpu.transformer import parallel_state
+    global _PARALLEL_STATE, _JAX
+    if _PARALLEL_STATE is None:
+        try:
+            from apex_tpu.transformer import parallel_state
 
-        if parallel_state.model_parallel_is_initialized():
-            return parallel_state.get_rank_info()
-    except Exception:
-        pass
-    try:
-        import jax
+            _PARALLEL_STATE = parallel_state
+        except Exception:
+            _PARALLEL_STATE = False
+    if _PARALLEL_STATE:
+        try:
+            if _PARALLEL_STATE.model_parallel_is_initialized():
+                return _PARALLEL_STATE.get_rank_info()
+        except Exception:
+            pass
+    if _JAX is None:
+        try:
+            import jax
 
-        return (jax.process_index(),)
-    except Exception:
-        return (0,)
+            _JAX = jax
+        except Exception:
+            _JAX = False
+    if _JAX:
+        try:
+            return (_JAX.process_index(),)
+        except Exception:
+            pass
+    return (0,)
 
 
 class RankInfoFormatter(logging.Formatter):

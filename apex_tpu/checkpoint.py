@@ -24,6 +24,8 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from apex_tpu.telemetry import trace as _telemetry_trace
+
 try:
     import orbax.checkpoint as ocp
 
@@ -86,10 +88,12 @@ def save(directory: str, step: int, state: Optional[Dict[str, Any]] = None,
     if use_orbax is None:
         use_orbax = _HAVE_ORBAX
     path = _step_dir(directory, step)
-    os.makedirs(directory, exist_ok=True)
-    repair_orphaned_steps(directory)
-    host_state = jax.device_get(state)
-    _write_state(path, host_state, use_orbax)
+    with _telemetry_trace.span("checkpoint/save", step=step,
+                               orbax=use_orbax):
+        os.makedirs(directory, exist_ok=True)
+        repair_orphaned_steps(directory)
+        host_state = jax.device_get(state)
+        _write_state(path, host_state, use_orbax)
     return path
 
 
@@ -119,15 +123,18 @@ def restore(directory: str, step: Optional[int] = None, *,
     pkl = os.path.join(path, "state.pkl")
     if use_orbax is None:
         use_orbax = _HAVE_ORBAX and not os.path.exists(pkl)
-    if use_orbax:
-        ckptr = ocp.PyTreeCheckpointer()
-        if template is not None:
-            restored = ckptr.restore(path, item=jax.device_get(template))
-        else:
-            restored = ckptr.restore(path)
-        return dict(restored)
-    with open(pkl, "rb") as f:
-        return pickle.load(f)
+    with _telemetry_trace.span("checkpoint/restore", step=step,
+                               orbax=use_orbax):
+        if use_orbax:
+            ckptr = ocp.PyTreeCheckpointer()
+            if template is not None:
+                restored = ckptr.restore(path,
+                                         item=jax.device_get(template))
+            else:
+                restored = ckptr.restore(path)
+            return dict(restored)
+        with open(pkl, "rb") as f:
+            return pickle.load(f)
 
 
 def _write_state(path: str, host_state, use_orbax: bool) -> None:
@@ -210,12 +217,15 @@ class AsyncCheckpointer:
             repair_orphaned_steps(directory)
             # synchronous D2H: after this the device buffers are free to
             # be donated/overwritten by the next step
-            host_state = jax.device_get(merged)
+            with _telemetry_trace.span("checkpoint/snapshot", step=step):
+                host_state = jax.device_get(merged)
 
             def job():
                 if self._pre_write_hook is not None:
                     self._pre_write_hook()
-                _write_state(path, host_state, self._use_orbax)
+                with _telemetry_trace.span("checkpoint/async_write",
+                                           step=step):
+                    _write_state(path, host_state, self._use_orbax)
 
             self._future = self._pool.submit(job)
             return path

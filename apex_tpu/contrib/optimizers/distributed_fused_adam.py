@@ -39,6 +39,8 @@ import numpy as np
 from jax import lax
 
 from apex_tpu.parallel import compression
+from apex_tpu.telemetry import comm as _telemetry_comm
+from apex_tpu.telemetry import trace as _telemetry_trace
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
 
 
@@ -137,24 +139,36 @@ class DistributedFusedAdam:
         or None)."""
         if world == 1:
             return flat_g, state.get("grad_residual")
-        if self.grad_compress is None:
-            # overlapped reduce-scatter grad sync (reference hook pipeline)
-            g_shard = lax.psum_scatter(flat_g, self.axis_name, tiled=True)
-            return g_shard / world, None
-        g_shard, residual = compression.psum_scatter_compressed(
-            flat_g, self.axis_name, mode=self.grad_compress,
-            residual=state.get("grad_residual"),
-            block_size=self.compress_block_size)
-        return g_shard / world, residual
+        with _telemetry_trace.span("zero/grad_reduce_scatter",
+                                   compress=self.grad_compress or "none"):
+            if self.grad_compress is None:
+                # overlapped reduce-scatter grad sync (reference hook
+                # pipeline); compressed paths record their own bytes
+                _telemetry_comm.record_collective(
+                    "psum_scatter", elements=flat_g.size,
+                    dtype=flat_g.dtype, world=world)
+                g_shard = lax.psum_scatter(flat_g, self.axis_name,
+                                           tiled=True)
+                return g_shard / world, None
+            g_shard, residual = compression.psum_scatter_compressed(
+                flat_g, self.axis_name, mode=self.grad_compress,
+                residual=state.get("grad_residual"),
+                block_size=self.compress_block_size)
+            return g_shard / world, residual
 
     def _gather_params(self, p_new, world):
         if world == 1:
             return p_new
-        if self.param_compress is None:
-            return lax.all_gather(p_new, self.axis_name, tiled=True)
-        return compression.all_gather_compressed(
-            p_new, self.axis_name, mode=self.param_compress,
-            block_size=self.compress_block_size)
+        with _telemetry_trace.span("zero/param_all_gather",
+                                   compress=self.param_compress or "none"):
+            if self.param_compress is None:
+                _telemetry_comm.record_collective(
+                    "all_gather", elements=p_new.size, dtype=p_new.dtype,
+                    world=world)
+                return lax.all_gather(p_new, self.axis_name, tiled=True)
+            return compression.all_gather_compressed(
+                p_new, self.axis_name, mode=self.param_compress,
+                block_size=self.compress_block_size)
 
     def step(self, grads, state, params, *, lr: Optional[float] = None,
              found_inf=None, scale: float = 1.0):

@@ -23,6 +23,8 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     _unflatten_like,
 )
 from apex_tpu.parallel import compression
+from apex_tpu.telemetry import comm as _telemetry_comm
+from apex_tpu.telemetry import trace as _telemetry_trace
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
 
 
@@ -120,15 +122,22 @@ class DistributedFusedLAMB:
         flat_g = jnp.pad(flat_g, (0, padded - n))
         grad_residual = state.get("grad_residual")
         if world > 1:
-            if self.grad_compress is None:
-                g_shard = lax.psum_scatter(flat_g, self.axis_name,
-                                           tiled=True)
-            else:
-                g_shard, grad_residual = \
-                    compression.psum_scatter_compressed(
-                        flat_g, self.axis_name, mode=self.grad_compress,
-                        residual=grad_residual,
-                        block_size=self.compress_block_size)
+            with _telemetry_trace.span("zero/grad_reduce_scatter",
+                                       compress=self.grad_compress
+                                       or "none"):
+                if self.grad_compress is None:
+                    _telemetry_comm.record_collective(
+                        "psum_scatter", elements=flat_g.size,
+                        dtype=flat_g.dtype, world=world)
+                    g_shard = lax.psum_scatter(flat_g, self.axis_name,
+                                               tiled=True)
+                else:
+                    g_shard, grad_residual = \
+                        compression.psum_scatter_compressed(
+                            flat_g, self.axis_name,
+                            mode=self.grad_compress,
+                            residual=grad_residual,
+                            block_size=self.compress_block_size)
             if self.grad_averaging:
                 g_shard = g_shard / world
         else:
@@ -186,12 +195,19 @@ class DistributedFusedLAMB:
         v = jnp.where(keep, state["exp_avg_sq_shard"], v)
 
         if world > 1:
-            if self.param_compress is None:
-                flat_p = lax.all_gather(p_new, self.axis_name, tiled=True)
-            else:
-                flat_p = compression.all_gather_compressed(
-                    p_new, self.axis_name, mode=self.param_compress,
-                    block_size=self.compress_block_size)
+            with _telemetry_trace.span("zero/param_all_gather",
+                                       compress=self.param_compress
+                                       or "none"):
+                if self.param_compress is None:
+                    _telemetry_comm.record_collective(
+                        "all_gather", elements=p_new.size,
+                        dtype=p_new.dtype, world=world)
+                    flat_p = lax.all_gather(p_new, self.axis_name,
+                                            tiled=True)
+                else:
+                    flat_p = compression.all_gather_compressed(
+                        p_new, self.axis_name, mode=self.param_compress,
+                        block_size=self.compress_block_size)
         else:
             flat_p = p_new
         new_params = _unflatten_like(flat_p[:n], params)

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.telemetry import comm as _telemetry_comm
+
 # ~256 lanes per scale: 2 TPU lane-groups wide, 0.4% scale overhead.
 BLOCK_SIZE = 256
 
@@ -197,7 +199,11 @@ def _shared_scales(x2d, axis_name):
     """Per-replica block scales combined to the replica-set max — the
     all-gather of per-replica scales collapsed into one lax.pmax (bytes:
     nblocks fp32, ~0.4% of the payload at block 256)."""
-    return lax.pmax(block_scales(x2d), axis_name)
+    scales = block_scales(x2d)
+    _telemetry_comm.record_collective(
+        "pmax", elements=scales.size, dtype=jnp.float32,
+        axis_name=axis_name, mode="int8")
+    return lax.pmax(scales, axis_name)
 
 
 def psum_compressed(flat, axis_name, *, mode="int8", residual=None,
@@ -211,6 +217,9 @@ def psum_compressed(flat, axis_name, *, mode="int8", residual=None,
     passed through unchanged (None stays None).
     """
     if mode == "bf16":
+        _telemetry_comm.record_collective(
+            "psum", elements=flat.size, dtype=jnp.bfloat16,
+            axis_name=axis_name, mode="bf16")
         out = lax.psum(flat.astype(jnp.bfloat16), axis_name)
         return out.astype(flat.dtype), residual
     if mode != "int8":
@@ -222,6 +231,12 @@ def psum_compressed(flat, axis_name, *, mode="int8", residual=None,
     x2d = pad_to_blocks(g, block_size)
     scales = _shared_scales(x2d, axis_name)
     q, _ = quantize_blockwise(g, block_size, scales=scales)
+    # semantic wire width: int8 lanes + the fp32 scale pmax (the psum
+    # emulation ships int32 partials until XLA grows a quantized
+    # collective — estimate_allreduce_bytes models the same wire format)
+    _telemetry_comm.record_collective(
+        "psum", elements=q.size, dtype=jnp.int8, axis_name=axis_name,
+        mode="int8", emulated=True)
     total = lax.psum(q.astype(jnp.int32), axis_name)
     out = dequantize_blockwise(total, scales, n=n)
     err = (x2d - _dequantize_jnp(q, scales)).reshape(-1)[:n]
@@ -239,6 +254,9 @@ def psum_scatter_compressed(flat, axis_name, *, mode="int8", residual=None,
     gradient was quantized, not where the shard landed).
     """
     if mode == "bf16":
+        _telemetry_comm.record_collective(
+            "psum_scatter", elements=flat.size, dtype=jnp.bfloat16,
+            axis_name=axis_name, mode="bf16")
         shard = lax.psum_scatter(flat.astype(jnp.bfloat16), axis_name,
                                  tiled=True)
         return shard.astype(jnp.float32), residual
@@ -253,6 +271,9 @@ def psum_scatter_compressed(flat, axis_name, *, mode="int8", residual=None,
     scales = _shared_scales(x2d, axis_name)
     q = _quantize_pallas(x2d, scales) if _gate().enabled() \
         else _quantize_jnp(x2d, scales)
+    _telemetry_comm.record_collective(
+        "psum_scatter", elements=q.size, dtype=jnp.int8,
+        axis_name=axis_name, mode="int8", emulated=True)
     total = lax.psum_scatter(q.astype(jnp.int32), axis_name, tiled=True)
     rank = lax.axis_index(axis_name)
     my_scales = lax.dynamic_slice_in_dim(scales, rank * (nb // world),
@@ -273,12 +294,21 @@ def all_gather_compressed(shard, axis_name, *, mode="bf16",
     concatenation. Returns the full fp32 flat vector.
     """
     if mode == "bf16":
+        _telemetry_comm.record_collective(
+            "all_gather", elements=shard.size, dtype=jnp.bfloat16,
+            axis_name=axis_name, mode="bf16")
         full = lax.all_gather(shard.astype(jnp.bfloat16), axis_name,
                               tiled=True)
         return full.astype(jnp.float32)
     if mode != "int8":
         raise ValueError(f"unknown compression mode {mode!r}")
     q, scales = quantize_blockwise(shard, block_size)
+    _telemetry_comm.record_collective(
+        "all_gather", elements=q.size, dtype=jnp.int8,
+        axis_name=axis_name, mode="int8")
+    _telemetry_comm.record_collective(
+        "all_gather", elements=scales.size, dtype=jnp.float32,
+        axis_name=axis_name, mode="int8")
     q_full = lax.all_gather(q, axis_name, tiled=True)
     s_full = lax.all_gather(scales, axis_name, tiled=True)
     return dequantize_blockwise(q_full, s_full)

@@ -25,6 +25,8 @@ from jax import lax
 from apex_tpu import _C
 from apex_tpu.parallel import compression
 from apex_tpu.parallel.compression import init_residual  # noqa: F401
+from apex_tpu.telemetry import comm as _telemetry_comm
+from apex_tpu.telemetry import trace as _telemetry_trace
 
 
 def flatten(tensors):
@@ -111,6 +113,8 @@ def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
             block_size=compress_block_size)
         g = g.reshape(shape)
     else:
+        _telemetry_comm.record_collective(
+            "psum", elements=g.size, dtype=g.dtype, axis_name=axis_name)
         g = lax.psum(g, axis_name)
     if gradient_average:
         n = _axis_size_total(axis_name)
@@ -380,21 +384,26 @@ class DistributedDataParallel:
                       compress_block_size=self.compress_block_size)
             if self.compress == "int8":
                 kw["residual"] = residual
-        if self.message_size:
-            return all_reduce_gradients_bucketed(
-                grads, self.axis_name, message_size=self.message_size,
+        # host-side span (trace-time when called inside jit); the comm
+        # byte counters accumulate underneath via _psum_with_policy
+        with _telemetry_trace.span("ddp/sync",
+                                   compress=self.compress or "none",
+                                   bucketed=bool(self.message_size)):
+            if self.message_size:
+                return all_reduce_gradients_bucketed(
+                    grads, self.axis_name, message_size=self.message_size,
+                    allreduce_always_fp32=self.allreduce_always_fp32,
+                    gradient_average=self.gradient_average,
+                    gradient_predivide_factor=self.gradient_predivide_factor,
+                    expert_param_predicate=self.expert_param_predicate,
+                    expert_axis_name=self.expert_axis_name, **kw)
+            return all_reduce_gradients(
+                grads, self.axis_name,
                 allreduce_always_fp32=self.allreduce_always_fp32,
                 gradient_average=self.gradient_average,
                 gradient_predivide_factor=self.gradient_predivide_factor,
                 expert_param_predicate=self.expert_param_predicate,
                 expert_axis_name=self.expert_axis_name, **kw)
-        return all_reduce_gradients(
-            grads, self.axis_name,
-            allreduce_always_fp32=self.allreduce_always_fp32,
-            gradient_average=self.gradient_average,
-            gradient_predivide_factor=self.gradient_predivide_factor,
-            expert_param_predicate=self.expert_param_predicate,
-            expert_axis_name=self.expert_axis_name, **kw)
 
     def __call__(self, fn=None, *args, **kwargs):
         """If constructed around a module/apply fn, call it; DDP on TPU is

@@ -1,0 +1,44 @@
+"""apex_tpu.telemetry — unified tracing, metrics, and XLA cost accounting.
+
+The observability layer under the parallel/optimizer/bench stack:
+
+- :mod:`registry`  — process-wide counters/gauges/histograms + a JSONL
+  event sink under ``$APEX_TPU_TELEMETRY_DIR`` (rank-aware).
+- :mod:`trace`     — named :func:`span` context managers (optional
+  device-sync fencing, nested under ``jax.profiler.TraceAnnotation`` /
+  ``jax.named_scope``) and a ``start_profiler_trace``/``stop`` pair
+  gated by ``APEX_TPU_PROFILE_DIR``.
+- :mod:`xla_cost`  — ``lower().cost_analysis()`` extraction for a
+  jitted step + achieved MFU / HBM-utilization against a per-backend
+  peak table.
+- :mod:`comm`      — measured collective accounting (per-call payload
+  dtype/bytes from ``_psum_with_policy`` and the compression paths),
+  the measured counterpart to ``compression.estimate_allreduce_bytes``.
+
+Everything is host-side: recording inside jitted code happens at trace
+time (once per compilation == once per step of the compiled program)
+and never inserts callbacks into compiled programs. Disabled — the
+default, when ``APEX_TPU_TELEMETRY_DIR`` is unset and nothing called
+``enable()`` — every instrument is a shared no-op.
+
+Quickstart (docs/observability.md has the full tour)::
+
+    APEX_TPU_TELEMETRY_DIR=/tmp/tel python bench.py ddp_compressed
+    python tools/telemetry_report.py /tmp/tel
+"""
+
+from apex_tpu.telemetry.registry import (  # noqa: F401
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+    use_registry,
+)
+from apex_tpu.telemetry.trace import (  # noqa: F401
+    Span,
+    device_sync,
+    span,
+    start_profiler_trace,
+    stop_profiler_trace,
+)
+from apex_tpu.telemetry import comm  # noqa: F401
+from apex_tpu.telemetry import xla_cost  # noqa: F401

@@ -1,0 +1,91 @@
+"""Collective accounting: measured per-call payload bytes.
+
+``record_collective`` is called from the collective call sites
+(``parallel/distributed._psum_with_policy``, the
+``parallel/compression`` paths, the ZeRO optimizers) with the *actual*
+payload the op ships — element count, dtype, axis — and folds ring-model
+wire bytes into the registry. Because the call sites live inside jitted
+step functions, recording happens at **trace time**: exactly once per
+compilation, which is exactly once per step of the compiled program —
+so the accumulated counters after one trace are the measured per-step
+bytes that ``bench.py`` emits as ``measured_comm_bytes_per_step`` and
+compares against the analytic ``compression.estimate_allreduce_bytes``
+model. Payloads are recorded at their semantic wire width (the int8
+psum emulation moves int32 partials through XLA today; the *wire format*
+a production quantized collective ships is int8 + scales, and events
+carry ``emulated=True`` for honesty — keep this consistent with
+``estimate_allreduce_bytes``'s model or measured-vs-modeled drifts).
+
+Ring wire model (bytes each replica transmits, ``w`` = axis size):
+  psum (allreduce)   2*(w-1)/w * payload      reduce-scatter + all-gather
+  psum_scatter       (w-1)/w   * payload
+  all_gather         (w-1)     * shard_bytes  == (w-1)/w * full
+  pmax / psum_small  2*(w-1)/w * payload      (scale exchanges)
+"""
+
+import numpy as np
+
+from apex_tpu.telemetry.registry import get_registry
+
+# ops whose ``payload`` argument is the per-replica *shard* (each rank
+# transmits its shard to the other w-1 ranks)
+_SHARD_OPS = {"all_gather"}
+# allreduce-shaped ops: two ring phases
+_TWO_PHASE_OPS = {"psum", "pmax", "pmin", "all_reduce"}
+
+
+def axis_world(axis_name):
+    """Concrete size of a (possibly tuple) mesh axis, resolved at trace
+    time; 1 when no axis is bound (single-device fallback paths)."""
+    from jax import lax
+
+    try:
+        if isinstance(axis_name, (tuple, list)):
+            n = 1
+            for a in axis_name:
+                n *= lax.axis_size(a)
+            return int(n)
+        return int(lax.axis_size(axis_name))
+    except Exception:
+        return 1
+
+
+def wire_bytes(op, payload_bytes, world):
+    """Ring-model bytes each replica transmits for one collective."""
+    if world <= 1:
+        return 0.0
+    if op in _TWO_PHASE_OPS:
+        return 2.0 * (world - 1) / world * payload_bytes
+    if op in _SHARD_OPS:
+        return float((world - 1) * payload_bytes)
+    # psum_scatter and anything one-phase
+    return (world - 1) / world * payload_bytes
+
+
+def record_collective(op, *, elements, dtype, axis_name=None, world=None,
+                      mode=None, emulated=False, registry=None):
+    """Account one collective call (host-side, trace-time).
+
+    ``elements``/``dtype`` describe the semantic wire payload;
+    ``world`` may be passed when the caller already resolved the axis
+    size (the ZeRO optimizers), else it is read from ``axis_name`` via
+    ``lax.axis_size`` (static under tracing). No-op when the registry
+    is disabled or no axis spans more than one device.
+    """
+    reg = registry or get_registry()
+    if not reg.enabled:
+        return 0.0
+    if world is None:
+        world = axis_world(axis_name)
+    itemsize = np.dtype(dtype).itemsize
+    payload = float(elements) * itemsize
+    wire = wire_bytes(op, payload, world)
+    reg.counter("comm/calls").inc()
+    reg.counter("comm/bytes").inc(wire)
+    reg.counter(f"comm/{op}_bytes").inc(wire)
+    reg.counter(f"comm/dtype/{np.dtype(dtype).name}_bytes").inc(wire)
+    reg.event("collective", op, elements=int(elements),
+              dtype=np.dtype(dtype).name, world=int(world),
+              payload_bytes=int(payload), wire_bytes=int(round(wire)),
+              mode=mode, emulated=bool(emulated) or None)
+    return wire

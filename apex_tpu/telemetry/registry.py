@@ -1,0 +1,338 @@
+"""Process-wide metrics registry with a JSONL event sink.
+
+The observability substrate for apex_tpu: counters (monotonic),
+gauges (last value), and histograms (count/total/min/max/last), plus a
+structured event stream written as JSON Lines under
+``$APEX_TPU_TELEMETRY_DIR``. Everything is **host-side**: recording
+happens in Python (at trace time for code inside ``jit`` — once per
+compilation, which is exactly the per-step accounting for a compiled
+step function) and never inserts callbacks into compiled programs.
+
+Disabled is the default and costs nothing: ``get_registry()`` resolves
+to a registry whose ``enabled`` flag is False unless
+``APEX_TPU_TELEMETRY_DIR`` is set (or ``enable()`` is called
+programmatically — ``bench.py`` does this to collect in-memory comm
+accounting even when no sink directory is configured), and every
+``counter()/gauge()/histogram()`` call on a disabled registry returns a
+shared no-op instrument.
+
+Rank discipline: on multi-process runs each process writes its own
+``telemetry-rank<N>.jsonl``; ``APEX_TPU_TELEMETRY_RANK0_ONLY=1``
+restricts both the sink and the ``log_summary`` logging path (built on
+:mod:`apex_tpu._logging`'s rank-aware formatter) to process 0.
+"""
+
+import json
+import os
+import threading
+import time
+
+ENV_DIR = "APEX_TPU_TELEMETRY_DIR"
+ENV_RANK0_ONLY = "APEX_TPU_TELEMETRY_RANK0_ONLY"
+
+
+def _process_index():
+    """Best-effort process index; 0 when jax is absent/uninitialized.
+    (Same resolution order as ``_logging._get_rank_info`` — the jax
+    fallback — but kept independent so the registry never forces a
+    backend bring-up.)"""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class Counter:
+    """Monotonic float counter. ``inc`` only; use a Gauge for levels."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount=1.0):
+        with self._lock:
+            self.value += float(amount)
+
+
+class Gauge:
+    """Last-value-wins instrument."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+
+    def set(self, value):
+        self.value = float(value)
+
+
+class Histogram:
+    """Streaming summary (count/total/min/max/last) — enough for span
+    latency reporting without storing samples."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "last", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.last = None
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            self.last = value
+
+    def summary(self):
+        return {
+            "count": self.count,
+            "total": self.total,
+            "mean": (self.total / self.count) if self.count else None,
+            "min": self.min,
+            "max": self.max,
+            "last": self.last,
+        }
+
+
+class _Null:
+    """Shared no-op instrument handed out by a disabled registry — the
+    zero-overhead-off contract: call sites never branch on enablement."""
+
+    __slots__ = ()
+    value = 0.0
+    count = 0
+
+    def inc(self, amount=1.0):
+        pass
+
+    def set(self, value):
+        pass
+
+    def observe(self, value):
+        pass
+
+
+_NULL = _Null()
+
+
+class MetricsRegistry:
+    """Counters + gauges + histograms + a JSONL event sink.
+
+    ``enabled`` gates *everything*; a disabled registry returns no-op
+    instruments and drops events, so library code records
+    unconditionally and pays nothing by default.
+    """
+
+    def __init__(self, *, enabled=False, jsonl_dir=None, rank0_only=None):
+        self._lock = threading.RLock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+        self._enabled = bool(enabled) or jsonl_dir is not None
+        self._jsonl_dir = jsonl_dir
+        self._sink = None
+        self._rank0_only = (os.environ.get(ENV_RANK0_ONLY) == "1"
+                            if rank0_only is None else bool(rank0_only))
+
+    # -- enablement ---------------------------------------------------------
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    @property
+    def jsonl_dir(self):
+        return self._jsonl_dir
+
+    def enable(self, jsonl_dir=None):
+        """Turn collection on; idempotent. ``jsonl_dir`` (may be None
+        for in-memory-only collection) attaches/retargets the event
+        sink."""
+        with self._lock:
+            self._enabled = True
+            if jsonl_dir and jsonl_dir != self._jsonl_dir:
+                self._close_sink_locked()
+                self._jsonl_dir = jsonl_dir
+        return self
+
+    def disable(self):
+        with self._lock:
+            self._enabled = False
+            self._close_sink_locked()
+        return self
+
+    # -- instruments --------------------------------------------------------
+
+    def counter(self, name):
+        if not self._enabled:
+            return _NULL
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name):
+        if not self._enabled:
+            return _NULL
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name):
+        if not self._enabled:
+            return _NULL
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    def counter_value(self, name):
+        """Current value of a counter (0.0 when absent/disabled) —
+        the delta-measurement hook bench.py uses."""
+        with self._lock:
+            c = self._counters.get(name)
+            return c.value if c is not None else 0.0
+
+    # -- events -------------------------------------------------------------
+
+    def event(self, kind, name, **fields):
+        """Append one structured event to the JSONL sink (no-op unless
+        enabled AND a sink dir is configured AND this rank may write)."""
+        if not self._enabled or self._jsonl_dir is None:
+            return
+        if self._rank0_only and _process_index() != 0:
+            return
+        rec = {"t": round(time.time(), 6), "kind": kind, "name": name}
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            sink = self._open_sink_locked()
+            if sink is not None:
+                sink.write(line + "\n")
+                sink.flush()
+
+    def _open_sink_locked(self):
+        if self._sink is None and self._jsonl_dir is not None:
+            try:
+                os.makedirs(self._jsonl_dir, exist_ok=True)
+                path = os.path.join(
+                    self._jsonl_dir,
+                    f"telemetry-rank{_process_index()}.jsonl")
+                self._sink = open(path, "a")
+            except OSError:
+                # an unwritable sink dir must never take down training
+                self._jsonl_dir = None
+                self._sink = None
+        return self._sink
+
+    def _close_sink_locked(self):
+        if self._sink is not None:
+            try:
+                self._sink.close()
+            finally:
+                self._sink = None
+
+    # -- snapshots ----------------------------------------------------------
+
+    def snapshot(self):
+        """Plain-dict view of every instrument (JSON-serializable)."""
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {k: h.summary()
+                               for k, h in self._histograms.items()},
+            }
+
+    def flush(self):
+        """Write one ``kind="summary"`` event carrying the full
+        snapshot — the record tools/telemetry_report.py aggregates."""
+        self.event("summary", "registry", **self.snapshot())
+
+    def reset(self):
+        """Drop all instruments (tests / per-phase accounting)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    def log_summary(self, logger=None, level=None):
+        """Emit a one-line snapshot through the rank-aware logging path
+        (``_logging.RankInfoFormatter`` provides ``%(rank_info)s``).
+        Honors rank0-only mode."""
+        import logging
+
+        from apex_tpu.transformer.log_util import get_transformer_logger
+
+        if not self._enabled:
+            return
+        if self._rank0_only and _process_index() != 0:
+            return
+        logger = logger or get_transformer_logger("apex_tpu.telemetry")
+        logger.log(level or logging.INFO,
+                   "telemetry %s", json.dumps(self.snapshot()))
+
+
+_REGISTRY = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def get_registry():
+    """The process-wide registry, created on first use. Enabled (with
+    the JSONL sink attached) iff ``APEX_TPU_TELEMETRY_DIR`` was set when
+    first resolved; call ``get_registry().enable(...)`` to opt in
+    programmatically afterwards."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = MetricsRegistry(
+                    jsonl_dir=os.environ.get(ENV_DIR) or None)
+    return _REGISTRY
+
+
+def set_registry(registry):
+    """Swap the process-wide registry (tests); returns the previous one."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        prev, _REGISTRY = _REGISTRY, registry
+    return prev
+
+
+class use_registry:
+    """Context manager installing ``registry`` as process-wide for the
+    block — the test idiom for isolated measurement::
+
+        with use_registry(MetricsRegistry(enabled=True)) as reg:
+            ...
+            assert reg.counter_value("comm/bytes") > 0
+    """
+
+    def __init__(self, registry):
+        self.registry = registry
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_registry(self.registry)
+        return self.registry
+
+    def __exit__(self, *exc):
+        set_registry(self._prev)
+        return False

@@ -1,0 +1,158 @@
+"""Named spans with optional device-sync fencing + profiler hooks.
+
+A span measures host wall-clock (``time.perf_counter`` — monotonic; the
+pipeline timers corrupted elapsed times under NTP skew with
+``time.time``) between ``start()`` and ``stop()``, optionally fencing
+outstanding device work on both edges so the interval matches device
+time (the ``torch.cuda.synchronize`` analog). While open, a span nests
+under ``jax.profiler.TraceAnnotation`` (host timeline) and
+``jax.named_scope`` (HLO op names), so spans opened around traced code
+show up in real profiler traces.
+
+Spans are host-side only: nothing here inserts callbacks into compiled
+programs, so a span wrapped around code *inside* ``jit`` measures trace
+time (once per compilation) — by design, and the reason telemetry
+disabled adds zero overhead to jitted step functions.
+
+``start_profiler_trace()``/``stop_profiler_trace()`` bracket a real
+``jax.profiler`` trace, gated by ``APEX_TPU_PROFILE_DIR`` so production
+entry points can call them unconditionally.
+"""
+
+import contextlib
+import os
+import time
+
+from apex_tpu.telemetry.registry import get_registry
+
+ENV_PROFILE_DIR = "APEX_TPU_PROFILE_DIR"
+
+
+def device_sync():
+    """Fence outstanding device work (best-effort; the TPU analog of
+    ``torch.cuda.synchronize``)."""
+    try:
+        import jax
+
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def _annotations(name):
+    """TraceAnnotation + named_scope, each best-effort (profiling
+    support can be absent on exotic backends)."""
+    stack = contextlib.ExitStack()
+    try:
+        import jax
+
+        try:
+            stack.enter_context(jax.profiler.TraceAnnotation(name))
+        except Exception:
+            pass
+        try:
+            stack.enter_context(jax.named_scope(
+                name.replace("/", "_").replace(" ", "_")))
+        except Exception:
+            pass
+    except Exception:
+        pass
+    return stack
+
+
+class Span:
+    """Restartable named timer; also usable as a context manager.
+
+    ``sync=True`` fences the device on both edges. Timing always works
+    (``_timers.py`` shims onto this even with telemetry off); metric
+    recording — a ``span/<name>`` histogram in seconds plus a ``span``
+    event — happens only when the registry is enabled.
+    """
+
+    __slots__ = ("name", "sync", "attrs", "start_time", "_stack",
+                 "_registry")
+
+    def __init__(self, name, *, sync=False, registry=None, **attrs):
+        self.name = name
+        self.sync = sync
+        self.attrs = attrs
+        self.start_time = None
+        self._stack = None
+        self._registry = registry
+
+    def start(self):
+        if self.sync:
+            device_sync()
+        self._stack = _annotations(self.name)
+        self.start_time = time.perf_counter()
+        return self
+
+    def stop(self):
+        """Close the span; returns the elapsed seconds."""
+        if self.sync:
+            device_sync()
+        elapsed = time.perf_counter() - self.start_time
+        if self._stack is not None:
+            self._stack.close()
+            self._stack = None
+        reg = self._registry or get_registry()
+        if reg.enabled:
+            reg.histogram(f"span/{self.name}").observe(elapsed)
+            reg.event("span", self.name, duration_s=round(elapsed, 9),
+                      **self.attrs)
+        return elapsed
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def span(name, *, sync=False, registry=None, **attrs):
+    """``with span("train/step"): ...`` — see :class:`Span`."""
+    return Span(name, sync=sync, registry=registry, **attrs)
+
+
+_PROFILER_ACTIVE = False
+
+
+def start_profiler_trace(logdir=None):
+    """Start a ``jax.profiler`` trace when ``APEX_TPU_PROFILE_DIR`` (or
+    ``logdir``) names a directory; returns True iff a trace started.
+    Safe to call unconditionally and when a trace is already running."""
+    global _PROFILER_ACTIVE
+    logdir = logdir or os.environ.get(ENV_PROFILE_DIR)
+    if not logdir or _PROFILER_ACTIVE:
+        return False
+    try:
+        import jax
+
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        return False
+    _PROFILER_ACTIVE = True
+    reg = get_registry()
+    if reg.enabled:
+        reg.event("profiler", "start", logdir=logdir)
+    return True
+
+
+def stop_profiler_trace():
+    """Stop the trace started by :func:`start_profiler_trace`; returns
+    True iff one was stopped."""
+    global _PROFILER_ACTIVE
+    if not _PROFILER_ACTIVE:
+        return False
+    _PROFILER_ACTIVE = False
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:
+        return False
+    reg = get_registry()
+    if reg.enabled:
+        reg.event("profiler", "stop")
+    return True

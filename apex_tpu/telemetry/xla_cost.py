@@ -1,0 +1,130 @@
+"""XLA cost accounting: FLOPs / bytes-accessed for a jitted step, and
+achieved MFU / HBM-utilization against a per-backend peak table.
+
+``step_cost(jitted, *args)`` extracts XLA's own cost analysis from the
+lowered (or compiled) computation — the measured counterpart to the
+analytic FLOP formulas in ``bench.py``. By default it stops at
+``.lower(...)``: the trace-only HLO cost analysis avoids paying a second
+compilation (the jit call's own compile is cached separately, and a
+large model can take tens of minutes to compile on this host's 1-core
+CPU). Pass ``use_compiled=True`` for post-optimization numbers when a
+compile is acceptable (or already cached).
+
+The peak table is deliberately small: per-backend (peak FLOP/s, peak
+HBM bytes/s), overridable via ``APEX_TPU_PEAK_TFLOPS`` and
+``APEX_TPU_PEAK_HBM_GBPS``. The TPU default is the measured 154 bf16
+TFLOP/s of this chip class (PERF.md), matching ``bench.py``.
+"""
+
+import os
+
+# (peak_flops_per_sec, peak_hbm_bytes_per_sec) by jax backend platform.
+# CPU numbers are order-of-magnitude placeholders — the CPU mesh exists
+# for tests, not rooflines.
+_PEAK_DEFAULTS = {
+    "tpu": (154e12, 1.23e12),
+    "gpu": (312e12, 2.0e12),
+    "cpu": (0.1e12, 0.05e12),
+}
+
+
+def peak_table(backend=None):
+    """(peak_flops_per_sec, peak_hbm_bytes_per_sec) for ``backend``
+    (default: the current jax default backend), honoring the env
+    overrides."""
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+    flops, hbm = _PEAK_DEFAULTS.get(backend, _PEAK_DEFAULTS["tpu"])
+    env_flops = os.environ.get("APEX_TPU_PEAK_TFLOPS")
+    if env_flops:
+        flops = float(env_flops) * 1e12
+    env_hbm = os.environ.get("APEX_TPU_PEAK_HBM_GBPS")
+    if env_hbm:
+        hbm = float(env_hbm) * 1e9
+    return flops, hbm
+
+
+def _normalize(analysis):
+    """XLA returns a dict (Lowered) or a list of per-computation dicts
+    (Compiled); collapse to {"flops", "bytes_accessed"} floats."""
+    if analysis is None:
+        return None
+    if not isinstance(analysis, dict):
+        entries = [a for a in analysis if isinstance(a, dict)]
+        if not entries:
+            return None
+        analysis = entries[0]
+    return {
+        "flops": float(analysis.get("flops", 0.0)),
+        "bytes_accessed": float(analysis.get("bytes accessed", 0.0)),
+    }
+
+
+def step_cost(jitted, *args, use_compiled=False, **kwargs):
+    """Cost analysis of one invocation of ``jitted(*args, **kwargs)``:
+    ``{"flops", "bytes_accessed"}``, or None when the backend offers no
+    analysis. Lowering re-traces the function (host-side only — safe on
+    donated/deleted example arrays since only avals are read)."""
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+    except Exception:
+        return None
+    if use_compiled:
+        try:
+            return _normalize(lowered.compile().cost_analysis())
+        except Exception:
+            pass
+    try:
+        return _normalize(lowered.cost_analysis())
+    except Exception:
+        return None
+
+
+def utilization(flops_per_step, step_seconds, *, bytes_per_step=None,
+                backend=None):
+    """Achieved fractions of peak: ``{"mfu", "hbm_util", ...}``.
+
+    ``mfu`` = model FLOP/s over peak FLOP/s (PaLM convention — pass
+    model FLOPs, not hardware FLOPs, if you want the classic MFU);
+    ``hbm_util`` = bytes-accessed/s over peak HBM bandwidth (an upper
+    bound on demand — XLA's bytes-accessed counts every operand touch,
+    not DRAM traffic)."""
+    peak_flops, peak_hbm = peak_table(backend)
+    out = {
+        "flops_per_sec": flops_per_step / step_seconds,
+        "mfu": flops_per_step / step_seconds / peak_flops,
+    }
+    if bytes_per_step is not None:
+        out["bytes_per_sec"] = bytes_per_step / step_seconds
+        out["hbm_util"] = bytes_per_step / step_seconds / peak_hbm
+    return out
+
+
+def record_step_cost(cost, step_seconds, *, registry=None, backend=None):
+    """Fold a :func:`step_cost` result + measured step time into the
+    registry: ``mfu`` / ``hbm_util`` / ``model_flops_per_step_xla``
+    gauges. Returns the :func:`utilization` dict (or None)."""
+    from apex_tpu.telemetry.registry import get_registry
+
+    if cost is None or not step_seconds:
+        return None
+    util = utilization(cost["flops"], step_seconds,
+                       bytes_per_step=cost.get("bytes_accessed"),
+                       backend=backend)
+    reg = registry or get_registry()
+    if reg.enabled:
+        reg.gauge("model_flops_per_step_xla").set(cost["flops"])
+        reg.gauge("mfu").set(util["mfu"])
+        if "hbm_util" in util:
+            reg.gauge("hbm_util").set(util["hbm_util"])
+        reg.event("xla_cost", "step",
+                  flops=cost["flops"],
+                  bytes_accessed=cost.get("bytes_accessed"),
+                  step_seconds=step_seconds,
+                  mfu=util["mfu"], hbm_util=util.get("hbm_util"))
+    return util

@@ -2,22 +2,20 @@
 
 Parity: reference apex/transformer/pipeline_parallel/_timers.py:6-83 —
 cuda-synchronized named timers with tensorboard write + rank-0 logging.
-TPU sync = ``block_until_ready`` fence via ``jax.effects_barrier`` /
-device sync on start/stop.
+
+Re-based as a thin shim over :mod:`apex_tpu.telemetry.trace` spans: each
+``_Timer`` drives a device-sync-fenced :class:`telemetry.trace.Span`
+(``jax.effects_barrier`` on both edges — the ``torch.cuda.synchronize``
+analog), so pipeline timers show up in profiler traces and, when
+telemetry is enabled, land in the registry as ``span/timers/<name>``
+histograms + JSONL events. The clock is ``time.perf_counter``
+(monotonic): ``time.time`` steps under NTP skew and corrupted elapsed
+times. The public ``_Timer``/``_Timers`` API is unchanged.
 """
 
 import time
 
-import jax
-
-
-def _sync():
-    try:
-        # Fence outstanding device work so the timer matches device time
-        # (the reference calls torch.cuda.synchronize()).
-        jax.effects_barrier()
-    except Exception:
-        pass
+from apex_tpu.telemetry.trace import Span
 
 
 class _Timer:
@@ -25,23 +23,25 @@ class _Timer:
         self.name_ = name
         self.elapsed_ = 0.0
         self.started_ = False
-        self.start_time = time.time()
+        self.start_time = time.perf_counter()
+        self._span = None
 
     def start(self):
         assert not self.started_, "timer has already been started"
-        _sync()
-        self.start_time = time.time()
+        self._span = Span(f"timers/{self.name_}", sync=True).start()
+        self.start_time = self._span.start_time
         self.started_ = True
 
     def stop(self):
         assert self.started_, "timer is not started"
-        _sync()
-        self.elapsed_ += time.time() - self.start_time
+        self.elapsed_ += self._span.stop()
+        self._span = None
         self.started_ = False
 
     def reset(self):
         self.elapsed_ = 0.0
         self.started_ = False
+        self._span = None
 
     def elapsed(self, reset=True):
         started_ = self.started_

@@ -38,7 +38,17 @@ discount) and ``mfu`` = achieved / peak. Peak defaults to the measured
 APEX_TPU_PEAK_TFLOPS.
 
 Prints ONE JSON line:
-{"metric", "value", "unit", "vs_baseline", "tflops_per_sec", "mfu"}.
+{"metric", "value", "unit", "vs_baseline", "tflops_per_sec", "mfu",
+"measured_comm_bytes_per_step", "model_flops_per_step_xla"}.
+
+Telemetry (apex_tpu.telemetry, docs/observability.md): the bench opts
+the registry in so every line carries the measured per-step collective
+bytes (comm-counter delta around one trace of the step — compare with
+the modeled ``comm_bytes_per_step``) and XLA's own FLOP count for the
+step (``lower().cost_analysis()`` — no extra compile). Set
+APEX_TPU_TELEMETRY_DIR to also get the JSONL event stream (step spans,
+per-collective payloads, the cost_analysis-derived mfu gauge); read it
+with tools/telemetry_report.py.
 """
 
 import functools
@@ -166,8 +176,67 @@ def _transformer_fwd_flops_per_token(cfg, seq):
     return 2 * matmul_params + 4 * seq * h * L
 
 
+def _enable_bench_telemetry():
+    """Opt the process-wide registry in for the bench run: in-memory
+    collection always (so ``measured_comm_bytes_per_step`` appears in
+    the emitted JSON even without a sink), JSONL events too when
+    APEX_TPU_TELEMETRY_DIR is set. Library defaults stay off — this is
+    the bench's explicit opt-in."""
+    from apex_tpu import telemetry
+
+    telemetry.get_registry().enable(
+        jsonl_dir=os.environ.get("APEX_TPU_TELEMETRY_DIR") or None)
+
+
+# per-bench measured fields staged by _measure_step_cost / consumed
+# (and cleared) by _emit, so a bench that skips measurement emits nulls
+# instead of a stale predecessor's numbers
+_PENDING_MEASURED = {}
+
+
+def _measure_step_cost(jitted, args):
+    """One extra host-side trace of the step (``.lower()`` — no second
+    compile) with the telemetry comm counters delta'd around it: the
+    measured per-step collective bytes plus XLA's own FLOP/byte count
+    for the step. Called BEFORE the first real invocation so donated
+    buffers are still live. Returns its findings and stages them for
+    the next _emit."""
+    from apex_tpu import telemetry
+
+    _enable_bench_telemetry()
+    reg = telemetry.get_registry()
+    before = reg.counter_value("comm/bytes")
+    cost = telemetry.xla_cost.step_cost(jitted, *args)
+    measured = reg.counter_value("comm/bytes") - before
+    _PENDING_MEASURED.clear()
+    _PENDING_MEASURED.update({
+        "measured_comm_bytes_per_step": int(round(measured)),
+        "model_flops_per_step_xla": cost["flops"] if cost else None,
+        "_xla_cost": cost,
+    })
+    return cost, measured
+
+
 def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
+    from apex_tpu import telemetry
+
     tflops = flops_per_step * steps / dt / 1e12
+    measured = _PENDING_MEASURED.pop("measured_comm_bytes_per_step", None)
+    flops_xla = _PENDING_MEASURED.pop("model_flops_per_step_xla", None)
+    xla_cost = _PENDING_MEASURED.pop("_xla_cost", None)
+    _PENDING_MEASURED.clear()
+    reg = telemetry.get_registry()
+    if reg.enabled:
+        reg.gauge(f"bench/{metric}").set(value)
+        reg.gauge("tflops_per_sec").set(tflops)
+        # the mfu gauge from the analytic model; overwritten below by
+        # the cost_analysis()-derived value when one was measured
+        reg.gauge("mfu").set(tflops / PEAK_TFLOPS)
+        telemetry.xla_cost.record_step_cost(xla_cost, dt / max(steps, 1),
+                                            registry=reg)
+        reg.event("bench", metric, value=round(value, 2), unit=unit,
+                  steps=steps, seconds=round(dt, 4))
+        reg.flush()
     print(json.dumps({
         "metric": metric,
         "value": round(value, 2),
@@ -180,6 +249,8 @@ def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
                              "see mfu",
         "tflops_per_sec": round(tflops, 2),
         "mfu": round(tflops / PEAK_TFLOPS, 4),
+        "measured_comm_bytes_per_step": measured,
+        "model_flops_per_step_xla": flops_xla,
         **extra,
     }))
 
@@ -189,16 +260,28 @@ def _time_steps(train_step, state, steps, loss_index):
     steps. Each boundary is a host fetch of the loss — data-dependent on
     the whole step chain, the only reliable completion barrier on the
     tunneled TPU runtime (block_until_ready returns early there; see the
-    resnet bench note). Returns (elapsed_seconds, final_out)."""
+    resnet bench note). Returns (elapsed_seconds, final_out).
+
+    Also the telemetry hook: before the first call (donated buffers
+    still live) one ``.lower()`` trace measures the step's collective
+    bytes and XLA cost (:func:`_measure_step_cost`), and the timed loop
+    runs under host-side spans (``bench/step`` per dispatch,
+    ``bench/timed_loop`` around loop + completion barrier)."""
+    from apex_tpu.telemetry import span
+
+    _measure_step_cost(train_step, state)
     out = train_step(*state)
     float(out[loss_index])
     out = train_step(*out[:loss_index])
     float(out[loss_index])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = train_step(*out[:loss_index])
-    float(out[loss_index])
-    return time.perf_counter() - t0, out
+    with span("bench/timed_loop", steps=steps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            with span("bench/step"):
+                out = train_step(*out[:loss_index])
+        float(out[loss_index])
+        dt = time.perf_counter() - t0
+    return dt, out
 
 
 def bench_bert(batch, steps):
@@ -441,11 +524,20 @@ def bench_gpt2(batch, steps, *, flash=None, scan=None, remat=None,
         "ms_per_step": round(dt / steps * 1e3, 2),
         "tflops_per_sec": round(tflops, 2),
         "mfu": round(tflops / PEAK_TFLOPS, 4),
+        "measured_comm_bytes_per_step":
+            _PENDING_MEASURED.get("measured_comm_bytes_per_step"),
+        "model_flops_per_step_xla":
+            _PENDING_MEASURED.get("model_flops_per_step_xla"),
     }
     if emit:
         _emit("gpt2_345m_tokens_per_sec_per_chip",
               batch * seq * steps / dt, "tokens/sec", flops, steps, dt,
               **_comm_fields(params))
+    else:
+        # emit=False variants consume their staging here: a later bench
+        # that measures nothing must emit nulls, not this config's stale
+        # numbers
+        _PENDING_MEASURED.clear()
     return result
 
 
@@ -903,6 +995,8 @@ def bench_resnet(batch, steps):
         new_params, new_opt_state = opt.step(grads, opt_state, params)
         return new_params, new_bs, new_opt_state, loss / scale
 
+    _measure_step_cost(train_step,
+                       (params, batch_stats, opt_state, images, labels))
     # warmup / compile. Timing ends with a host fetch of the loss, which
     # is data-dependent on the whole step chain — an execution barrier
     # equivalent to block_until_ready, and on the tunneled single-chip
@@ -927,7 +1021,7 @@ def bench_resnet(batch, steps):
           **_comm_fields(params))
 
 
-def bench_ddp_compressed(batch, steps):
+def bench_ddp_compressed(batch, steps, *, hidden=1024, depth=4):
     """DDP training step with block-quantized int8 gradient collectives
     + error feedback (parallel/compression.py) over ALL visible devices
     — the comm-compression capability capture. The emitted line carries
@@ -939,7 +1033,9 @@ def bench_ddp_compressed(batch, steps):
 
     Model: a 4x1024 MLP regressor — big enough that the flat grad
     bucket spans thousands of quantization blocks, small enough to
-    compile in seconds on the 1-core CPU host (the smoke path).
+    compile in seconds on the 1-core CPU host (the smoke path;
+    ``hidden``/``depth`` shrink it further for the tier-1 telemetry
+    test).
     """
     from apex_tpu.parallel import DistributedDataParallel, compression
     from jax.sharding import Mesh, PartitionSpec as P
@@ -947,7 +1043,6 @@ def bench_ddp_compressed(batch, steps):
     devices = jax.devices()
     world = len(devices)
     mesh = Mesh(np.asarray(devices), ("dp",))
-    hidden, depth = 1024, 4
     rng = np.random.RandomState(0)
     params = {}
     for i in range(depth):
@@ -1026,6 +1121,7 @@ def main():
     _arm_watchdog()
     _require_backend()
     _enable_bench_compile_cache()
+    _enable_bench_telemetry()
 
     name = sys.argv[1] if len(sys.argv) > 1 and sys.argv[1] in BENCH_SPECS \
         else None

@@ -1,0 +1,94 @@
+"""tools/bench_schema_check over the repo's checked-in BENCH_*.json
+files + the live bench._emit output format (ISSUE 2 satellite: the
+bench JSON contract — incl. the telemetry fields — is now enforced)."""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+sys.path.insert(0, ROOT)
+
+import bench_schema_check as schema  # noqa: E402
+
+
+def test_checked_in_bench_jsons_valid():
+    files = sorted(glob.glob(os.path.join(ROOT, "BENCH_*.json")))
+    assert files, "no checked-in BENCH_*.json found"
+    errors = []
+    for path in files:
+        schema.check_file(path, errors)
+    assert errors == []
+
+
+def test_cli_over_repo_root():
+    assert schema.main([ROOT]) == 0
+
+
+def test_wrapper_schema_rejects_bad_records():
+    errors = schema.check_wrapper({"n": "one", "cmd": 3, "rc": 0},
+                                  errors=[])
+    joined = "\n".join(errors)
+    assert "key 'n'" in joined
+    assert "key 'cmd'" in joined
+    assert "missing required key 'tail'" in joined
+    assert "rc == 0 but no parsed metric line" in joined
+
+
+def test_metric_line_requires_telemetry_fields_since_round7():
+    line = {"metric": "m", "value": 1.0, "unit": "x/sec",
+            "vs_baseline": 1.0, "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 10}
+    # round 6: telemetry fields not yet required
+    assert schema.check_metric_line(dict(line), round_n=6, errors=[]) == []
+    errors = schema.check_metric_line(dict(line), round_n=7, errors=[])
+    assert any("measured_comm_bytes_per_step" in e for e in errors)
+    line.update(measured_comm_bytes_per_step=None,
+                model_flops_per_step_xla=1e9)
+    assert schema.check_metric_line(line, round_n=7, errors=[]) == []
+
+
+def test_bench_error_contract_by_round():
+    err = {"metric": "bench_error", "value": 0, "unit": "error",
+           "vs_baseline": 0.0, "kind": "wedge"}
+    assert schema.check_metric_line(dict(err), round_n=5, errors=[]) == []
+    msgs = schema.check_metric_line(dict(err), round_n=6, errors=[])
+    assert any("comm_bytes_per_step" in m for m in msgs)
+    err["comm_bytes_per_step"] = None
+    assert schema.check_metric_line(err, round_n=6, errors=[]) == []
+
+
+def test_live_emit_passes_current_schema(capsys):
+    """What bench._emit prints today must satisfy the round-7 (current)
+    metric-line contract — telemetry fields included."""
+    import bench
+
+    bench._emit("unit_test_metric", 12.5, "things/sec",
+                flops_per_step=1e9, steps=10, dt=1.0,
+                **bench._comm_fields(n_elements=1000))
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert schema.check_metric_line(line, round_n=7, errors=[]) == []
+    assert line["measured_comm_bytes_per_step"] is None  # none staged
+    assert "comm_bytes_per_step" in line
+
+
+def test_live_bench_error_passes_current_schema(capsys):
+    import bench
+
+    bench._emit_bench_error("unit test error", "crash")
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert schema.check_metric_line(line, round_n=7, errors=[]) == []
+
+
+@pytest.mark.parametrize("bad", [
+    {"metric": "m"},                              # missing most keys
+    {"metric": "m", "value": True, "unit": "u",   # bool is not numeric
+     "vs_baseline": 1.0},
+])
+def test_metric_line_rejects(bad):
+    assert schema.check_metric_line(bad, errors=[]) != []

@@ -1,0 +1,47 @@
+"""_compile_cache.maybe_enable_compile_cache coverage (ISSUE 2
+satellite): env unset -> False with NO config mutation; env set -> True
+with the cache dir applied."""
+
+import jax
+import pytest
+
+from apex_tpu._compile_cache import maybe_enable_compile_cache
+
+
+@pytest.fixture
+def restore_cache_config():
+    before_dir = jax.config.jax_compilation_cache_dir
+    before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", before_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      before_min)
+
+
+def test_env_unset_returns_false_without_config_mutation(
+        monkeypatch, restore_cache_config):
+    monkeypatch.delenv("APEX_TPU_COMPILE_CACHE", raising=False)
+    before_dir = jax.config.jax_compilation_cache_dir
+    before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    assert maybe_enable_compile_cache() is False
+    assert jax.config.jax_compilation_cache_dir == before_dir
+    assert (jax.config.jax_persistent_cache_min_compile_time_secs
+            == before_min)
+
+
+def test_env_empty_string_counts_as_unset(monkeypatch,
+                                          restore_cache_config):
+    monkeypatch.setenv("APEX_TPU_COMPILE_CACHE", "")
+    before_dir = jax.config.jax_compilation_cache_dir
+    assert maybe_enable_compile_cache() is False
+    assert jax.config.jax_compilation_cache_dir == before_dir
+
+
+def test_env_set_applies_cache_dir(monkeypatch, tmp_path,
+                                   restore_cache_config):
+    cache_dir = str(tmp_path / "jit_cache")
+    monkeypatch.setenv("APEX_TPU_COMPILE_CACHE", cache_dir)
+    assert maybe_enable_compile_cache(min_compile_secs=0.25) is True
+    assert jax.config.jax_compilation_cache_dir == cache_dir
+    assert (jax.config.jax_persistent_cache_min_compile_time_secs
+            == 0.25)

@@ -1,0 +1,58 @@
+"""_logging: RankInfoFormatter emits the rank tuple; the rank-info
+provider is cached after first resolution (satellite of ISSUE 2 — the
+formatter used to re-run the import machinery on EVERY log record)."""
+
+import logging
+
+from apex_tpu import _logging
+
+
+def _format_one(msg="hello"):
+    fmt = _logging.RankInfoFormatter("%(rank_info)s %(message)s")
+    record = logging.LogRecord("apex_tpu.test", logging.INFO, __file__,
+                               1, msg, None, None)
+    return fmt.format(record)
+
+
+def test_formatter_emits_rank_tuple():
+    out = _format_one()
+    assert out.endswith(" hello")
+    rank = out[:-len(" hello")]
+    # uninitialized model parallel on a single process -> the jax
+    # process-index fallback, a 1-tuple
+    assert rank == "(0,)"
+
+
+def test_provider_cached_after_first_record(monkeypatch):
+    _format_one()
+    # both providers resolved (module objects or False), never None again
+    assert _logging._PARALLEL_STATE is not None
+    assert _logging._JAX is not None
+
+    # a poisoned import path must not matter anymore: caching means no
+    # re-import happens on later records
+    import builtins
+
+    real_import = builtins.__import__
+
+    def exploding_import(name, *a, **kw):
+        if "parallel_state" in name or name == "jax":
+            raise ImportError(f"re-import of {name} on the hot path")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", exploding_import)
+    assert _format_one("again").endswith(" again")
+
+
+def test_rank_info_tracks_model_parallel_init():
+    """Caching the module must not freeze the ANSWER: once model
+    parallel initializes, records pick up the full rank tuple."""
+    from apex_tpu.transformer import parallel_state
+
+    _format_one()  # cache the provider pre-init
+    parallel_state.initialize_model_parallel(1, 1)
+    try:
+        rank = _logging._get_rank_info()
+        assert len(rank) > 1  # (dp, tp, pp, ...) tuple, not the fallback
+    finally:
+        parallel_state.destroy_model_parallel()

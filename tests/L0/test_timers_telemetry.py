@@ -1,0 +1,88 @@
+"""Pipeline-parallel _timers: monotonic clock + telemetry-span shim.
+
+Satellite of ISSUE 2: the timers moved from ``time.time`` (wall clock —
+steps under NTP skew corrupted elapsed times) to ``time.perf_counter``
+via telemetry spans, with the public API preserved.
+"""
+
+import time
+
+import pytest
+
+from apex_tpu.telemetry import MetricsRegistry, use_registry
+from apex_tpu.transformer.pipeline_parallel._timers import _Timer, _Timers
+
+
+def test_timer_api_preserved():
+    timers = _Timers()
+    t = timers("fwd")
+    assert timers("fwd") is t  # named lookup is cached
+    t.start()
+    t.stop()
+    first = t.elapsed(reset=False)
+    assert first >= 0.0
+    t.start()
+    t.stop()
+    assert t.elapsed(reset=True) >= first  # accumulates until reset
+    assert t.elapsed_ == 0.0
+
+
+def test_timer_elapsed_restarts_running_timer():
+    t = _Timer("x")
+    t.start()
+    e = t.elapsed(reset=True)  # must stop, read, reset, restart
+    assert e >= 0.0
+    assert t.started_
+    t.stop()
+
+
+def test_timer_double_start_asserts():
+    t = _Timer("y")
+    t.start()
+    with pytest.raises(AssertionError):
+        t.start()
+    t.stop()
+    with pytest.raises(AssertionError):
+        t.stop()
+
+
+def test_timer_immune_to_wall_clock_steps(monkeypatch):
+    """An NTP step (time.time jumping backwards an hour) must not
+    corrupt elapsed — the timers run on perf_counter now."""
+    wall = iter([1e9, 1e9 - 3600.0, 1e9 - 7200.0, 1e9 + 9999.0])
+    monkeypatch.setattr(time, "time", lambda: next(wall))
+    t = _Timer("ntp")
+    t.start()
+    t.stop()
+    assert 0.0 <= t.elapsed(reset=True) < 60.0
+
+
+def test_timers_write_and_log(capsys):
+    class Writer:
+        def __init__(self):
+            self.rows = []
+
+        def add_scalar(self, name, value, it):
+            self.rows.append((name, value, it))
+
+    timers = _Timers()
+    timers("tick").start()
+    timers("tick").stop()
+    w = Writer()
+    timers.write(["tick"], w, iteration=3, normalizer=2.0)
+    assert len(w.rows) == 1
+    name, value, it = w.rows[0]
+    assert name == "tick-time" and it == 3 and value >= 0.0
+    timers.log(["tick"])
+    assert "tick" in capsys.readouterr().out
+
+
+def test_timer_records_span_when_telemetry_enabled():
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        t = _Timer("layer0")
+        t.start()
+        t.stop()
+    h = reg.snapshot()["histograms"]["span/timers/layer0"]
+    assert h["count"] == 1
+    assert h["last"] >= 0.0

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Validate the repo's checked-in BENCH_*.json capture records and raw
+bench.py metric lines against the capture contract.
+
+Two layers of schema:
+
+1. **Wrapper records** (``BENCH_rNN.json``, written by the capture
+   driver): ``{"n": int, "cmd": str, "rc": int, "tail": str}`` with an
+   optional ``"parsed"`` dict holding the last JSON line bench.py
+   printed.
+2. **Metric lines** (what ``bench._emit`` / ``_emit_bench_error``
+   print): ``metric/value/unit/vs_baseline`` always; successful lines
+   additionally carry the roofline (``tflops_per_sec``, ``mfu``) and
+   the comm/telemetry accounting.
+
+The contract grew over rounds, so requirements are gated on the round
+number ``n`` (old checked-in records stay valid):
+
+- ``n >= 6``: ``comm_bytes_per_step`` must be present in ``parsed``
+  (the round-6 capture contract — even on the bench_error path).
+- ``n >= 7``: successful metric lines must carry the telemetry fields
+  ``measured_comm_bytes_per_step`` and ``model_flops_per_step_xla``
+  (nullable — null means "not measured in this config", e.g. a serving
+  bench) next to ``mfu``.
+
+Usage::
+
+    python tools/bench_schema_check.py            # repo root BENCH_*.json
+    python tools/bench_schema_check.py DIR ...    # explicit dirs/files
+
+Exit code 0 = every file valid; 1 = violations (printed one per line).
+"""
+
+import glob
+import json
+import os
+import sys
+
+# the round from which the telemetry fields (measured comm bytes + XLA
+# flops) became part of the successful-metric-line contract
+TELEMETRY_FIELDS_SINCE_ROUND = 7
+COMM_BYTES_SINCE_ROUND = 6
+# bench_error lines grew the wedge/crash discriminator in round 3
+ERROR_KIND_SINCE_ROUND = 3
+
+_NUM = (int, float)
+
+
+def _type_ok(value, types):
+    # bool is an int subclass; never accept it where a number is meant
+    if isinstance(value, bool):
+        return bool in types if isinstance(types, tuple) else types is bool
+    return isinstance(value, types)
+
+
+def check_metric_line(obj, *, round_n=None, errors=None, where=""):
+    """Validate one bench.py-emitted JSON object (success or
+    bench_error). Appends messages to ``errors`` (or raises ValueError
+    on the first problem when ``errors`` is None)."""
+    own = errors if errors is not None else []
+
+    def bad(msg):
+        own.append(f"{where}{msg}")
+
+    for key, types in (("metric", str), ("value", _NUM), ("unit", str),
+                       ("vs_baseline", _NUM)):
+        if key not in obj:
+            bad(f"missing required key {key!r}")
+        elif not _type_ok(obj[key], types):
+            bad(f"key {key!r} has type {type(obj[key]).__name__}, "
+                f"wanted {types}")
+    if obj.get("metric") == "bench_error":
+        if ((round_n is None or round_n >= ERROR_KIND_SINCE_ROUND)
+                and obj.get("kind") not in ("crash", "wedge")):
+            bad(f"bench_error kind {obj.get('kind')!r} not in "
+                f"('crash', 'wedge')")
+        if (round_n is not None and round_n >= COMM_BYTES_SINCE_ROUND
+                and "comm_bytes_per_step" not in obj):
+            bad("bench_error missing comm_bytes_per_step "
+                f"(required since round {COMM_BYTES_SINCE_ROUND})")
+    else:
+        for key in ("tflops_per_sec", "mfu"):
+            if key not in obj:
+                bad(f"successful metric line missing {key!r}")
+            elif not _type_ok(obj[key], _NUM):
+                bad(f"key {key!r} must be numeric")
+        if "comm_bytes_per_step" not in obj:
+            bad("successful metric line missing comm_bytes_per_step")
+        elif not (obj["comm_bytes_per_step"] is None
+                  or _type_ok(obj["comm_bytes_per_step"], _NUM)):
+            bad("comm_bytes_per_step must be numeric or null")
+        if round_n is None or round_n >= TELEMETRY_FIELDS_SINCE_ROUND:
+            for key in ("measured_comm_bytes_per_step",
+                        "model_flops_per_step_xla"):
+                if key not in obj:
+                    bad(f"missing telemetry field {key!r} (required "
+                        f"since round {TELEMETRY_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"telemetry field {key!r} must be numeric or "
+                        f"null")
+    if errors is None and own:
+        raise ValueError("; ".join(own))
+    return own
+
+
+def check_wrapper(obj, *, errors=None, where=""):
+    """Validate one BENCH_rNN.json capture-wrapper record."""
+    own = errors if errors is not None else []
+
+    def bad(msg):
+        own.append(f"{where}{msg}")
+
+    for key, types in (("n", int), ("cmd", str), ("rc", int),
+                       ("tail", str)):
+        if key not in obj:
+            bad(f"missing required key {key!r}")
+        elif not _type_ok(obj[key], types):
+            bad(f"key {key!r} has type {type(obj[key]).__name__}, "
+                f"wanted {types.__name__}")
+    parsed = obj.get("parsed")
+    if parsed is not None:
+        if not isinstance(parsed, dict):
+            bad("'parsed' must be a dict when present")
+        else:
+            check_metric_line(parsed, round_n=obj.get("n"), errors=own,
+                              where=where + "parsed: ")
+    elif obj.get("rc") == 0:
+        bad("rc == 0 but no parsed metric line")
+    if errors is None and own:
+        raise ValueError("; ".join(own))
+    return own
+
+
+def check_file(path, errors):
+    where = f"{os.path.basename(path)}: "
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        errors.append(f"{where}unreadable/invalid JSON ({e})")
+        return
+    if not isinstance(obj, dict):
+        errors.append(f"{where}top level must be a JSON object")
+        return
+    if "metric" in obj and "n" not in obj:
+        check_metric_line(obj, errors=errors, where=where)
+    else:
+        check_wrapper(obj, errors=errors, where=where)
+
+
+def collect_paths(args):
+    if not args:
+        args = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    paths = []
+    for a in args:
+        if os.path.isdir(a):
+            paths.extend(sorted(glob.glob(os.path.join(a, "BENCH_*.json"))))
+        else:
+            paths.append(a)
+    return paths
+
+
+def main(argv=None):
+    paths = collect_paths(list(argv if argv is not None else sys.argv[1:]))
+    if not paths:
+        print("bench_schema_check: no BENCH_*.json files found")
+        return 1
+    errors = []
+    for path in paths:
+        check_file(path, errors)
+    for e in errors:
+        print(f"SCHEMA ERROR {e}")
+    print(f"bench_schema_check: {len(paths)} file(s), "
+          f"{len(errors)} violation(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

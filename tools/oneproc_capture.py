@@ -117,6 +117,48 @@ def _with_env(var, value, thunk):
     return run
 
 
+def _telemetry_smoke(bench):
+    """Run one DDP config with APEX_TPU_TELEMETRY_DIR set and assert the
+    JSONL lands with spans + collective counters (+ the mfu gauge in the
+    summary). Raises on any missing piece so the stage shows up as
+    ERROR rather than silently passing."""
+    import glob
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_telemetry_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        bench.bench_ddp_compressed(8, 2)
+    finally:
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+    events = []
+    for path in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(path) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    spans = [e for e in events if e["kind"] == "span"
+             and e["name"] == "bench/step"]
+    colls = [e for e in events if e["kind"] == "collective"]
+    summaries = [e for e in events if e["kind"] == "summary"]
+    if not spans:
+        raise RuntimeError("telemetry smoke: no bench/step spans landed")
+    if not colls:
+        raise RuntimeError("telemetry smoke: no collective events landed")
+    if not summaries or "mfu" not in summaries[-1]["gauges"]:
+        raise RuntimeError("telemetry smoke: no mfu gauge in summary")
+    comm_bytes = summaries[-1]["counters"].get("comm/bytes", 0)
+    return {"telemetry_dir": tel_dir, "events": len(events),
+            "step_spans": len(spans), "collectives": len(colls),
+            "comm_bytes": comm_bytes,
+            "mfu_gauge": summaries[-1]["gauges"]["mfu"]}
+
+
 def _stages(smoke):
     import bench
 
@@ -133,6 +175,7 @@ def _stages(smoke):
             ("moe_serve", None, lambda: bench.bench_moe_serve(128, 2)),
             ("ddp_compressed", None,
              lambda: bench.bench_ddp_compressed(8, 2)),
+            ("telemetry", None, lambda: _telemetry_smoke(bench)),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -210,6 +253,7 @@ def main():
     if not smoke:
         bench._require_backend()
     bench._enable_bench_compile_cache()
+    bench._enable_bench_telemetry()
 
     import re
 

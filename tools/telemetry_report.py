@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Summarize a telemetry JSONL directory (APEX_TPU_TELEMETRY_DIR).
+
+Reads every ``telemetry-rank*.jsonl`` under the given directory (or
+explicit file paths), aggregates the event stream, and prints a
+human-readable report: span latency table, collective byte accounting
+by op/dtype, bench results, and the last registry summary (counters /
+gauges incl. ``mfu``). ``--json`` emits the aggregate as one JSON
+object instead — for scripts.
+
+    python tools/telemetry_report.py /tmp/tel
+    python tools/telemetry_report.py --json /tmp/tel | jq .gauges.mfu
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_events(paths):
+    """Yield (rank_file, event) for every parseable JSONL line."""
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield os.path.basename(path), json.loads(line)
+                except ValueError:
+                    continue  # a torn line from a crashed writer
+
+
+def aggregate(events):
+    """Fold the event stream into one report dict."""
+    spans = {}
+    collectives = {}
+    benches = []
+    profiler = []
+    last_summary = None
+    n_events = 0
+    for _, ev in events:
+        n_events += 1
+        kind = ev.get("kind")
+        if kind == "span":
+            s = spans.setdefault(ev.get("name", "?"), {
+                "count": 0, "total_s": 0.0, "max_s": 0.0})
+            d = float(ev.get("duration_s") or 0.0)
+            s["count"] += 1
+            s["total_s"] += d
+            s["max_s"] = max(s["max_s"], d)
+        elif kind == "collective":
+            key = (ev.get("name", "?"), ev.get("dtype", "?"))
+            c = collectives.setdefault(key, {
+                "calls": 0, "wire_bytes": 0, "elements": 0})
+            c["calls"] += 1
+            c["wire_bytes"] += int(ev.get("wire_bytes") or 0)
+            c["elements"] += int(ev.get("elements") or 0)
+        elif kind == "bench":
+            benches.append({k: ev.get(k)
+                            for k in ("name", "value", "unit", "steps",
+                                      "seconds")})
+        elif kind == "summary":
+            last_summary = ev
+        elif kind == "profiler":
+            profiler.append({"event": ev.get("name"),
+                             "logdir": ev.get("logdir")})
+    return {
+        "events": n_events,
+        "spans": {name: dict(s, mean_s=(s["total_s"] / s["count"])
+                             if s["count"] else None)
+                  for name, s in spans.items()},
+        "collectives": {f"{op}/{dtype}": c
+                        for (op, dtype), c in collectives.items()},
+        "benches": benches,
+        "profiler": profiler,
+        "counters": (last_summary or {}).get("counters", {}),
+        "gauges": (last_summary or {}).get("gauges", {}),
+        "histograms": (last_summary or {}).get("histograms", {}),
+    }
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def print_report(report, out=sys.stdout):
+    w = out.write
+    w(f"telemetry report — {report['events']} events\n")
+    if report["spans"]:
+        w("\nspans (host wall-clock):\n")
+        w(f"  {'name':<32} {'count':>6} {'total':>10} {'mean':>10} "
+          f"{'max':>10}\n")
+        for name in sorted(report["spans"]):
+            s = report["spans"][name]
+            w(f"  {name:<32} {s['count']:>6} {s['total_s']*1e3:>8.1f}ms "
+              f"{(s['mean_s'] or 0)*1e3:>8.2f}ms {s['max_s']*1e3:>8.2f}ms\n")
+    if report["collectives"]:
+        w("\ncollectives (ring-model wire bytes, per trace):\n")
+        w(f"  {'op/dtype':<28} {'calls':>6} {'elements':>12} "
+          f"{'wire bytes':>12}\n")
+        for key in sorted(report["collectives"]):
+            c = report["collectives"][key]
+            w(f"  {key:<28} {c['calls']:>6} {c['elements']:>12} "
+              f"{_fmt_bytes(c['wire_bytes']):>12}\n")
+    if report["benches"]:
+        w("\nbench results:\n")
+        for b in report["benches"]:
+            w(f"  {b['name']}: {b['value']} {b['unit']} "
+              f"({b['steps']} steps in {b['seconds']}s)\n")
+    if report["gauges"]:
+        w("\ngauges (last):\n")
+        for name in sorted(report["gauges"]):
+            w(f"  {name} = {report['gauges'][name]}\n")
+    if report["counters"]:
+        w("\ncounters (last summary):\n")
+        for name in sorted(report["counters"]):
+            val = report["counters"][name]
+            shown = _fmt_bytes(val) if name.endswith("_bytes") or \
+                name.endswith("/bytes") else val
+            w(f"  {name} = {shown}\n")
+
+
+def collect_paths(args):
+    paths = []
+    for a in args:
+        if os.path.isdir(a):
+            paths.extend(sorted(glob.glob(os.path.join(a, "*.jsonl"))))
+        else:
+            paths.append(a)
+    return paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=[os.environ.get("APEX_TPU_TELEMETRY_DIR", ".")],
+                    help="telemetry dirs or .jsonl files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON")
+    args = ap.parse_args(argv)
+    paths = collect_paths(args.paths)
+    if not paths:
+        print("telemetry_report: no .jsonl files found", file=sys.stderr)
+        return 1
+    report = aggregate(load_events(paths))
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
