@@ -90,6 +90,7 @@ from apex_tpu import normalization  # noqa: F401
 from apex_tpu import amp  # noqa: F401
 from apex_tpu import parallel  # noqa: F401
 from apex_tpu import fp16_utils  # noqa: F401
+from apex_tpu import resilience  # noqa: F401
 from apex_tpu import transformer  # noqa: F401
 
 _pylogging.getLogger(__name__).addHandler(_pylogging.NullHandler())

@@ -70,10 +70,12 @@ class LossScaler(object):
         if not self.dynamic:
             return state
         overflow = found_inf > 0
+        # explicit None test: min_loss_scale=0 is a legal floor ("no
+        # floor at all") that a truthiness check silently coerced to 1.0
+        floor = 1.0 if self._min_loss_scale is None else self._min_loss_scale
         new_scale = jnp.where(
             overflow,
-            jnp.maximum(state.loss_scale / self._scale_factor,
-                        self._min_loss_scale if self._min_loss_scale else 1.0),
+            jnp.maximum(state.loss_scale / self._scale_factor, floor),
             jnp.where(state.unskipped + 1 >= self._scale_window,
                       jnp.minimum(state.loss_scale * self._scale_factor,
                                   self._max_loss_scale),

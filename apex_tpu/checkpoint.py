@@ -1,4 +1,4 @@
-"""Checkpoint / resume for training state.
+"""Checkpoint / resume for training state, with durability guarantees.
 
 Parity: the reference's checkpoint story (SURVEY.md §5) is amp
 ``state_dict``/``load_state_dict`` (apex/amp/frontend.py:365-404) plus
@@ -13,18 +13,46 @@ via orbax when available (async, sharding-aware) with a pickle fallback.
                     batch_stats=batch_stats)
     state = checkpoint.restore("ckpt/")          # latest step
     state = checkpoint.restore("ckpt/", step=5)  # specific step
+
+Durability (the apex_tpu.resilience checkpoint pillar — docs/resilience.md):
+
+- every ``save`` writes a ``manifest.json`` inside the step dir (landing
+  atomically with the data): per-leaf tree paths/shapes/dtypes/crc32
+  checksums plus per-file size/sha256 of every payload file, so a torn
+  write, a bit flip, or a half-restored tree is *detectable*;
+- ``restore`` verifies files before decoding and leaves after, wraps any
+  decode failure (unpickle, orbax) in :class:`CheckpointCorruptError`,
+  and — on the resume path (``step=None``) — walks back through older
+  steps with a loud warning naming exactly what was rejected;
+- transient write failures retry with exponential backoff + jitter
+  (``retries`` / ``$APEX_TPU_CKPT_RETRIES``, telemetry counter
+  ``checkpoint/write_retries``);
+- ``keep_last_n`` prunes old steps only AFTER the new one has landed and
+  passed shallow verification — retention can never eat the only good
+  checkpoint.
+
+Pre-manifest checkpoints (or foreign orbax trees) still restore: a
+missing manifest downgrades to a warning, not a rejection.
 """
 
 import concurrent.futures
+import hashlib
+import json
 import os
 import pickle
+import random
 import re
 import threading
+import time
+import warnings
+import zlib
 from typing import Any, Callable, Dict, Optional
 
 import jax
+import numpy as np
 
 from apex_tpu.telemetry import trace as _telemetry_trace
+from apex_tpu.telemetry.registry import get_registry as _get_registry
 
 try:
     import orbax.checkpoint as ocp
@@ -34,22 +62,39 @@ except Exception:  # orbax missing or incompatible
     ocp = None
     _HAVE_ORBAX = False
 
+MANIFEST_NAME = "manifest.json"
+MANIFEST_FORMAT = 1
+ENV_RETRIES = "APEX_TPU_CKPT_RETRIES"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification or could not be
+    decoded (torn write, bit flip, truncated pickle, orbax failure).
+    The resume path (``restore(dir)``) catches this per step and falls
+    back to the next-older checkpoint."""
+
 
 def _step_dir(directory: str, step: int) -> str:
     # orbax/tensorstore require absolute paths
     return os.path.join(os.path.abspath(directory), f"step_{step:010d}")
 
 
-def latest_step(directory: str) -> Optional[int]:
-    """Newest checkpointed step in ``directory``, or None."""
+def _all_steps(directory: str):
+    """Sorted (ascending) list of step numbers present in ``directory``."""
     if not os.path.isdir(directory):
-        return None
+        return []
     steps = []
     for name in os.listdir(directory):
         m = re.fullmatch(r"step_(\d+)", name)
         if m:
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest checkpointed step in ``directory``, or None."""
+    steps = _all_steps(directory)
+    return steps[-1] if steps else None
 
 
 def repair_orphaned_steps(directory: str) -> list:
@@ -76,13 +121,165 @@ def repair_orphaned_steps(directory: str) -> list:
     return recovered
 
 
-def save(directory: str, step: int, state: Optional[Dict[str, Any]] = None,
-         *, use_orbax: Optional[bool] = None, **extra: Any) -> str:
-    """Snapshot ``state`` (a dict of pytrees, merged with ``extra``
-    kwargs) under ``directory/step_N``.
+# ---------------------------------------------------------------------------
+# manifest: per-leaf checksums + per-file hashes
+# ---------------------------------------------------------------------------
 
-    Returns the checkpoint path. Device arrays are fetched to host;
-    orbax (when available) writes the tree natively.
+def _key_str(k) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _leaf_fingerprint(leaf):
+    """(crc32, dtype name, shape list) of one host-side leaf. Arrays
+    checksum their raw bytes; anything numpy can't type (rare ``extra``
+    payloads) falls back to its repr."""
+    arr = np.asarray(leaf)
+    if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+        return zlib.crc32(repr(leaf).encode()), "object", []
+    return (zlib.crc32(np.ascontiguousarray(arr).tobytes()),
+            arr.dtype.name, list(arr.shape))
+
+
+def _manifest_for(host_state, writer: str) -> Dict[str, Any]:
+    """The integrity manifest for a host-side state tree: tree
+    structure, and per-leaf path/shape/dtype/crc32."""
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(host_state)
+    leaves = []
+    for path, leaf in paths_leaves:
+        crc, dtype, shape = _leaf_fingerprint(leaf)
+        leaves.append({"path": "/".join(_key_str(k) for k in path),
+                       "shape": shape, "dtype": dtype, "crc32": crc})
+    return {"format": MANIFEST_FORMAT, "writer": writer,
+            "num_leaves": len(leaves), "treedef": str(treedef),
+            "leaves": leaves}
+
+
+def _sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hash_files(path: str) -> Dict[str, Dict[str, Any]]:
+    """size + sha256 of every payload file under ``path`` (recursively;
+    the manifest itself excluded) — works for the single-file pickle
+    layout and orbax's ocdbt tree alike."""
+    out = {}
+    for root, _, names in os.walk(path):
+        for name in names:
+            if name == MANIFEST_NAME:
+                continue
+            full = os.path.join(root, name)
+            h = hashlib.sha256()
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            out[os.path.relpath(full, path)] = {
+                "size": os.path.getsize(full), "sha256": h.hexdigest()}
+    return out
+
+
+def _read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The step's manifest, None when absent (pre-manifest checkpoint),
+    CheckpointCorruptError when present but unreadable."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable {MANIFEST_NAME} ({e})") from e
+
+
+def _verify_files(path: str, manifest: Dict[str, Any]) -> None:
+    """Byte-level integrity: every manifest-listed file exists with the
+    recorded size and sha256 (catches torn writes before a decoder sees
+    the bytes)."""
+    for rel, info in (manifest.get("files") or {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            raise CheckpointCorruptError(f"{path}: payload file {rel} "
+                                         "missing")
+        size = os.path.getsize(full)
+        if size != info.get("size"):
+            raise CheckpointCorruptError(
+                f"{path}: {rel} is {size} bytes, manifest recorded "
+                f"{info.get('size')} (torn write?)")
+        h = hashlib.sha256()
+        with open(full, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != info.get("sha256"):
+            raise CheckpointCorruptError(
+                f"{path}: {rel} sha256 mismatch (bit corruption?)")
+
+
+def _verify_tree(restored, manifest: Dict[str, Any], path: str) -> None:
+    """Logical integrity: the restored tree's leaves match the manifest
+    (count, and per-path shape/dtype/crc32). Restore backends may
+    re-spell container types (orbax returns plain dicts for NamedTuple
+    nodes), so when the path *names* differ the comparison degrades to
+    the multiset of leaf fingerprints rather than flagging a
+    re-spelling as corruption."""
+    want = manifest.get("leaves")
+    if want is None:
+        return
+    got = _manifest_for(restored, manifest.get("writer", "?"))["leaves"]
+    if len(got) != len(want):
+        raise CheckpointCorruptError(
+            f"{path}: restored {len(got)} leaves, manifest recorded "
+            f"{len(want)}")
+    want_by_path = {e["path"]: e for e in want}
+    got_by_path = {e["path"]: e for e in got}
+    if set(want_by_path) == set(got_by_path):
+        for p, w in want_by_path.items():
+            g = got_by_path[p]
+            for field in ("shape", "dtype", "crc32"):
+                if g[field] != w[field]:
+                    raise CheckpointCorruptError(
+                        f"{path}: leaf {p!r} {field} mismatch "
+                        f"(restored {g[field]!r}, manifest {w[field]!r})")
+    else:
+        fp = lambda e: (e["dtype"], tuple(e["shape"]), e["crc32"])  # noqa: E731
+        if sorted(map(fp, got)) != sorted(map(fp, want)):
+            raise CheckpointCorruptError(
+                f"{path}: restored leaf set does not match manifest "
+                "(content checksums differ)")
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Shallow verification of a landed step dir: the manifest parses
+    and every payload file matches its recorded size/sha256. Raises
+    :class:`CheckpointCorruptError` (manifest absent counts as a
+    failure — this is the gate retention uses before pruning). Returns
+    the manifest."""
+    manifest = _read_manifest(path)
+    if manifest is None:
+        raise CheckpointCorruptError(f"{path}: no {MANIFEST_NAME}")
+    _verify_files(path, manifest)
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+def save(directory: str, step: int, state: Optional[Dict[str, Any]] = None,
+         *, use_orbax: Optional[bool] = None, retries: Optional[int] = None,
+         retry_base_delay: float = 0.05, keep_last_n: Optional[int] = None,
+         **extra: Any) -> str:
+    """Snapshot ``state`` (a dict of pytrees, merged with ``extra``
+    kwargs) under ``directory/step_N``, with a ``manifest.json`` of
+    content checksums landing atomically alongside the data.
+
+    ``retries`` transient-write retries (default ``$APEX_TPU_CKPT_RETRIES``
+    or 2) run with exponential backoff + jitter. ``keep_last_n`` prunes
+    older steps — only after this one verified. Returns the checkpoint
+    path. Device arrays are fetched to host; orbax (when available)
+    writes the tree natively.
     """
     state = {**(state or {}), **extra}
     if use_orbax is None:
@@ -93,21 +290,93 @@ def save(directory: str, step: int, state: Optional[Dict[str, Any]] = None,
         os.makedirs(directory, exist_ok=True)
         repair_orphaned_steps(directory)
         host_state = jax.device_get(state)
-        _write_state(path, host_state, use_orbax)
+        _write_state_with_retries(path, host_state, use_orbax,
+                                  retries=retries,
+                                  retry_base_delay=retry_base_delay)
+        if keep_last_n is not None:
+            verify_checkpoint(path)  # never prune behind an unverified save
+            _prune_old_steps(directory, keep_last_n)
     return path
 
 
+def _write_state_with_retries(path: str, host_state, use_orbax: bool, *,
+                              retries: Optional[int] = None,
+                              retry_base_delay: float = 0.05) -> None:
+    """``_write_state`` with exponential backoff + jitter on transient
+    failures. ``retries`` counts re-attempts after the first try; the
+    final failure re-raises. Each retry lands a
+    ``checkpoint/write_retries`` counter tick and a warning."""
+    if retries is None:
+        retries = int(os.environ.get(ENV_RETRIES, "2"))
+    attempt = 0
+    while True:
+        try:
+            # module-global lookup on purpose: the fault injectors
+            # (resilience.faults) patch checkpoint._write_state
+            return _write_state(path, host_state, use_orbax)
+        except Exception as e:
+            if attempt >= retries:
+                raise
+            delay = retry_base_delay * (2 ** attempt)
+            delay += random.uniform(0, delay)  # jitter: desync replicas
+            attempt += 1
+            reg = _get_registry()
+            if reg.enabled:
+                reg.counter("checkpoint/write_retries").inc()
+                reg.event("checkpoint", "write_retry", path=path,
+                          attempt=attempt, error=str(e)[:200])
+            warnings.warn(
+                f"checkpoint: write attempt {attempt}/{retries + 1} for "
+                f"{path} failed ({type(e).__name__}: {e}); retrying in "
+                f"{delay:.2f}s")
+            time.sleep(delay)
+
+
+def _prune_old_steps(directory: str, keep_last_n: int) -> list:
+    """Retention: delete all but the newest ``keep_last_n`` steps.
+    Only called after the newest step verified (see :func:`save`).
+    Returns the pruned step numbers."""
+    import shutil
+
+    if keep_last_n < 1:
+        raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+    steps = _all_steps(directory)
+    pruned = steps[:-keep_last_n]
+    for s in pruned:
+        shutil.rmtree(_step_dir(directory, s), ignore_errors=True)
+    if pruned:
+        reg = _get_registry()
+        if reg.enabled:
+            reg.counter("checkpoint/steps_pruned").inc(len(pruned))
+            reg.event("checkpoint", "pruned", steps=pruned,
+                      kept=keep_last_n)
+    return pruned
+
+
+# ---------------------------------------------------------------------------
+# restore (with verification + fallback chain)
+# ---------------------------------------------------------------------------
+
 def restore(directory: str, step: Optional[int] = None, *,
-            use_orbax: Optional[bool] = None,
-            template: Any = None) -> Dict[str, Any]:
+            use_orbax: Optional[bool] = None, template: Any = None,
+            verify: bool = True,
+            fallback: Optional[bool] = None) -> Dict[str, Any]:
     """Load the state dict saved by :func:`save`.
 
-    ``step=None`` loads the newest step. ``template`` (a pytree with the
-    wanted structure/custom node types, e.g. the live training state) makes
-    the orbax path restore into that structure — orbax stores custom pytree
-    nodes (NamedTuples, dataclasses) structurally and returns plain dicts
+    ``step=None`` loads the newest step — and, when that step fails
+    verification or decoding (:class:`CheckpointCorruptError`), walks
+    back through older steps with a loud warning naming what was
+    rejected and why, until one verifies (``fallback`` defaults to True
+    on the resume path, False for an explicit ``step``). ``verify=False``
+    skips manifest verification entirely (not recommended outside
+    debugging). ``template`` (a pytree with the wanted structure/custom
+    node types, e.g. the live training state) makes the orbax path
+    restore into that structure — orbax stores custom pytree nodes
+    (NamedTuples, dataclasses) structurally and returns plain dicts
     otherwise. Raises FileNotFoundError when no checkpoints exist.
     """
+    if fallback is None:
+        fallback = step is None
     if step is None:
         # The resume flow is where a step stranded mid-overwrite (crash
         # between _write_state's two renames) would otherwise silently
@@ -116,32 +385,102 @@ def restore(directory: str, step: Optional[int] = None, *,
         # a concurrent writer mid-rename-window would fail its landing
         # rename loudly rather than lose data silently.)
         repair_orphaned_steps(directory)
-        step = latest_step(directory)
-        if step is None:
+        candidates = _all_steps(directory)[::-1]  # newest first
+        if not candidates:
             raise FileNotFoundError(f"no checkpoints under {directory}")
+    else:
+        candidates = [step]
+    rejected = []
+    for i, s in enumerate(candidates):
+        try:
+            return _restore_step(directory, s, use_orbax=use_orbax,
+                                 template=template, verify=verify)
+        except CheckpointCorruptError as e:
+            if not fallback:
+                raise
+            rejected.append((s, e))
+            reg = _get_registry()
+            if reg.enabled:
+                reg.counter("checkpoint/restore_rejected").inc()
+                reg.event("checkpoint", "restore_rejected", step=s,
+                          error=str(e)[:300])
+            older = candidates[i + 1] if i + 1 < len(candidates) else None
+            warnings.warn(
+                f"checkpoint: REJECTED step {s} under {directory} — "
+                f"{e} — "
+                + (f"falling back to step {older}" if older is not None
+                   else "no older step to fall back to"))
+    raise CheckpointCorruptError(
+        f"every checkpoint under {directory} failed to load: "
+        + "; ".join(f"step {s}: {e}" for s, e in rejected))
+
+
+def _restore_step(directory: str, step: int, *,
+                  use_orbax: Optional[bool] = None, template: Any = None,
+                  verify: bool = True) -> Dict[str, Any]:
+    """Load + verify one step. Any integrity or decode failure —
+    manifest/file mismatch, unpickle error, orbax failure, a step dir
+    with no loadable payload — surfaces as
+    :class:`CheckpointCorruptError` so the fallback chain (and callers)
+    see one failure type instead of an opaque backend traceback."""
     path = _step_dir(directory, step)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint dir {path}")
+    manifest = _read_manifest(path)
     pkl = os.path.join(path, "state.pkl")
     if use_orbax is None:
         use_orbax = _HAVE_ORBAX and not os.path.exists(pkl)
     with _telemetry_trace.span("checkpoint/restore", step=step,
                                orbax=use_orbax):
+        if manifest is not None and verify:
+            _verify_files(path, manifest)
+        elif manifest is None and verify:
+            warnings.warn(
+                f"checkpoint: {path} has no {MANIFEST_NAME} "
+                "(pre-manifest checkpoint?) — loading without integrity "
+                "verification")
         if use_orbax:
-            ckptr = ocp.PyTreeCheckpointer()
-            if template is not None:
-                restored = ckptr.restore(path,
-                                         item=jax.device_get(template))
-            else:
-                restored = ckptr.restore(path)
-            return dict(restored)
-        with open(pkl, "rb") as f:
-            return pickle.load(f)
+            if not _HAVE_ORBAX:
+                raise CheckpointCorruptError(
+                    f"{path}: no state.pkl and orbax is unavailable — "
+                    "nothing can decode this step")
+            try:
+                ckptr = ocp.PyTreeCheckpointer()
+                if template is not None:
+                    restored = ckptr.restore(path,
+                                             item=jax.device_get(template))
+                else:
+                    restored = ckptr.restore(path)
+                restored = dict(restored)
+            except CheckpointCorruptError:
+                raise
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"{path}: orbax restore failed "
+                    f"({type(e).__name__}: {str(e)[:300]})") from e
+        else:
+            if not os.path.exists(pkl):
+                raise CheckpointCorruptError(
+                    f"{path}: state.pkl missing")
+            try:
+                with open(pkl, "rb") as f:
+                    restored = pickle.load(f)
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"{path}: state.pkl failed to unpickle "
+                    f"({type(e).__name__}: {str(e)[:300]})") from e
+        if manifest is not None and verify:
+            _verify_tree(restored, manifest, path)
+    return restored
 
 
 def _write_state(path: str, host_state, use_orbax: bool) -> None:
-    """Write into a temp dir, then rename to ``path`` — ``latest_step``'s
-    ``step_\\d+`` fullmatch skips the temp name, so a concurrent
-    ``restore(dir)`` never selects a checkpoint whose bytes are still
-    landing (the async writer's whole window)."""
+    """Write into a temp dir — payload first, then the integrity
+    manifest (leaf checksums + per-file hashes) — then rename to
+    ``path``: ``latest_step``'s ``step_\\d+`` fullmatch skips the temp
+    name, so a concurrent ``restore(dir)`` never selects a checkpoint
+    whose bytes are still landing (the async writer's whole window),
+    and the manifest is atomically present for every landed step."""
     import shutil
 
     tmp = f"{path}.tmp-{os.getpid()}"
@@ -155,6 +494,11 @@ def _write_state(path: str, host_state, use_orbax: bool) -> None:
             os.makedirs(tmp, exist_ok=True)
             with open(os.path.join(tmp, "state.pkl"), "wb") as f:
                 pickle.dump(host_state, f)
+        manifest = _manifest_for(host_state,
+                                 "orbax" if use_orbax else "pickle")
+        manifest["files"] = _hash_files(tmp)
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f)
         old = None
         if os.path.exists(path):
             # force-overwrite: park the old dir under a non-matching name
@@ -181,7 +525,13 @@ class AsyncCheckpointer:
     serialization — to a background thread, returning before any byte
     hits storage. One checkpoint is in flight at a time: a new ``save``
     first waits for the previous write, and a failed write re-raises on
-    the next ``save``/``wait_until_finished`` rather than vanishing.
+    the next ``save``/``wait_until_finished``/``close`` rather than
+    vanishing. A failed write never lands its step dir, so
+    ``latest_step``/``restore`` can never select it.
+
+    The background write runs the same durability path as the blocking
+    :func:`save`: manifest, transient-failure retries (``retries``),
+    and ``keep_last_n`` retention gated on post-landing verification.
 
     The reference has no async story (example-level blocking
     ``torch.save``, examples/imagenet/main_amp.py:95-101); this matches
@@ -197,8 +547,14 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, *, use_orbax: Optional[bool] = None,
+                 retries: Optional[int] = None,
+                 retry_base_delay: float = 0.05,
+                 keep_last_n: Optional[int] = None,
                  _pre_write_hook: Optional[Callable[[], None]] = None):
         self._use_orbax = _HAVE_ORBAX if use_orbax is None else use_orbax
+        self._retries = retries
+        self._retry_base_delay = retry_base_delay
+        self._keep_last_n = keep_last_n
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="apex_tpu_ckpt")
         self._future: Optional[concurrent.futures.Future] = None
@@ -225,7 +581,13 @@ class AsyncCheckpointer:
                     self._pre_write_hook()
                 with _telemetry_trace.span("checkpoint/async_write",
                                            step=step):
-                    _write_state(path, host_state, self._use_orbax)
+                    _write_state_with_retries(
+                        path, host_state, self._use_orbax,
+                        retries=self._retries,
+                        retry_base_delay=self._retry_base_delay)
+                    if self._keep_last_n is not None:
+                        verify_checkpoint(path)
+                        _prune_old_steps(directory, self._keep_last_n)
 
             self._future = self._pool.submit(job)
             return path
@@ -269,8 +631,6 @@ def save_training_state(directory: str, step: int, params, opt_state,
     try:
         state["amp"] = amp.state_dict()
     except Exception as e:
-        import warnings
-
         warnings.warn(f"checkpoint: amp state not saved ({e})")
     return save(directory, step, state, **kw)
 
@@ -293,8 +653,6 @@ def restore_training_state(directory: str, step: Optional[int] = None,
         try:
             amp.load_state_dict(state["amp"])
         except Exception as e:
-            import warnings
-
             warnings.warn(
                 f"checkpoint: amp scaler state failed to load ({e}); "
                 "resuming with the current scaler — loss scale may differ "

@@ -13,7 +13,22 @@ from apex_tpu.ops import multi_tensor_l2norm, multi_tensor_scale
 
 
 def clip_grad_norm_(grads, max_norm, norm_type=2.0, error_if_nonfinite=False):
-    """Clip a grad pytree by global norm; returns (new_grads, total_norm)."""
+    """Clip a grad pytree by global norm; returns (new_grads, total_norm).
+
+    ``error_if_nonfinite`` (torch parity, and — unlike the previous
+    revision — actually honored):
+
+    - ``True``: raise :class:`~apex_tpu.resilience.NonFiniteError` when
+      ``total_norm`` is non-finite. Raising needs a concrete value, so
+      this mode is eager-only; called under ``jit`` it raises a
+      ``ValueError`` at trace time pointing at the in-graph
+      alternatives.
+    - ``False`` (default): a non-finite ``total_norm`` leaves the
+      gradients **unclipped** instead of scaling every leaf by
+      NaN/``max_norm/inf`` — the poison then stays visible to
+      ``resilience.guarded_update``, which is the jit-native place to
+      skip the step.
+    """
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if norm_type == 2.0:
         total_norm, _ = multi_tensor_applier(
@@ -25,8 +40,30 @@ def clip_grad_norm_(grads, max_norm, norm_type=2.0, error_if_nonfinite=False):
         total_norm = jnp.power(
             sum(jnp.sum(jnp.power(jnp.abs(l.astype(jnp.float32)), norm_type))
                 for l in leaves), 1.0 / norm_type)
+    norm_is_finite = jnp.isfinite(total_norm)
+    if error_if_nonfinite:
+        try:
+            concrete_finite = bool(norm_is_finite)
+        except jax.errors.TracerBoolConversionError as e:
+            raise ValueError(
+                "clip_grad_norm_(error_if_nonfinite=True) must run "
+                "eagerly — raising needs a concrete norm. Inside jit, "
+                "use error_if_nonfinite=False (non-finite norms fall "
+                "back to unclipped grads) and skip the step with "
+                "apex_tpu.resilience.guarded_update") from e
+        if not concrete_finite:
+            from apex_tpu.resilience.guard import NonFiniteError
+
+            raise NonFiniteError(
+                f"clip_grad_norm_: total norm of order {norm_type} is "
+                f"non-finite ({float(jnp.asarray(total_norm))}); set "
+                "error_if_nonfinite=False to fall back to unclipped "
+                "gradients")
     clip_coef = max_norm / (total_norm + 1e-6)
-    clip_coef = jnp.minimum(clip_coef, 1.0)
+    # non-finite norm => coefficient 1.0 (leave grads untouched), never
+    # a NaN broadcast into every parameter's gradient
+    clip_coef = jnp.where(norm_is_finite,
+                          jnp.minimum(clip_coef, 1.0), 1.0)
     outs, _ = multi_tensor_applier(
         multi_tensor_scale, jnp.zeros(()), [leaves, leaves], clip_coef)
     return jax.tree_util.tree_unflatten(treedef, outs), total_norm

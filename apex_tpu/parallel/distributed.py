@@ -74,6 +74,22 @@ def _axis_size_total(axis_name):
     return lax.axis_size(axis_name)
 
 
+def all_reduce_flag(flag, axis_name="dp"):
+    """Global-OR of a scalar fault/overflow flag over the replica set —
+    the one collective in the resilience guard's hot path
+    (``resilience.guard.guarded_update``). One f32 lane on the wire; a
+    psum is an OR because flags are non-negative. Tuple axes reduce
+    over every named axis; an empty tuple (or None) is the no-op
+    single-replica case, mirroring ``_psum_with_policy``."""
+    if axis_name is None or (isinstance(axis_name, (tuple, list))
+                             and len(axis_name) == 0):
+        return jnp.asarray(flag, jnp.float32)
+    flag = jnp.asarray(flag, jnp.float32)
+    _telemetry_comm.record_collective(
+        "psum", elements=flag.size, dtype=flag.dtype, axis_name=axis_name)
+    return lax.psum(flag, axis_name)
+
+
 def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
                       gradient_predivide_factor, compress=None,
                       compress_block_size=compression.BLOCK_SIZE,

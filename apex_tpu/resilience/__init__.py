@@ -1,0 +1,33 @@
+"""apex_tpu.resilience — surviving the failures a long run will see.
+
+Four pillars (docs/resilience.md has the operational tour):
+
+- :mod:`guard`      — jit-native non-finite step guard:
+  :func:`guarded_update` skips poisoned optimizer steps in-graph (one
+  all-reduced scalar flag, ``jnp.where`` commit, no host sync) and
+  :func:`check_guard` escalates to :class:`NonFiniteError` after K
+  consecutive skips.
+- ``checkpoint``    — durability lives in :mod:`apex_tpu.checkpoint`:
+  every save writes a ``manifest.json`` (per-leaf shapes/dtypes/crc32 +
+  per-file sha256), writes retry with exponential backoff + jitter,
+  ``restore`` verifies and walks back through older steps on
+  corruption (:class:`~apex_tpu.checkpoint.CheckpointCorruptError`),
+  and ``keep_last_n`` prunes only after the new step verified.
+- :mod:`preemption` — :class:`PreemptionGuard` turns SIGTERM/SIGINT
+  into a pollable checkpoint-now flag plus one final synchronous save.
+- :mod:`faults`     — deterministic, env/API-gated injectors (NaN at
+  step N, partial/torn checkpoint writes, byte corruption, simulated
+  SIGTERM) powering the tests/L0/test_resilience.py chaos suite.
+"""
+
+from apex_tpu.resilience import faults  # noqa: F401
+from apex_tpu.resilience import preemption  # noqa: F401
+from apex_tpu.resilience.guard import (  # noqa: F401
+    GuardState,
+    NonFiniteError,
+    check_guard,
+    guarded_update,
+    init_guard_state,
+    nonfinite_flag,
+)
+from apex_tpu.resilience.preemption import PreemptionGuard  # noqa: F401

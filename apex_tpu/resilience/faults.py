@@ -1,0 +1,182 @@
+"""Deterministic fault injection for the resilience chaos suite.
+
+Every injector is explicit and deterministic — a fault fires at the
+step / call you named, never randomly — so a chaos test is a regular
+regression test. Gating is API-first (call the injector) with env
+escape hatches for end-to-end drills from the bench/capture drivers:
+
+- ``APEX_TPU_FAULT_NAN_STEP=<n>`` — :func:`nan_step_from_env`, read by
+  ``bench.bench_ddp_resilience`` and anything else calling
+  :func:`inject_nan` with ``nan_step=None``.
+- ``APEX_TPU_FAULT_CKPT_WRITE_FAILURES=<n>`` — default failure count
+  for :func:`failing_checkpoint_writes`.
+
+Injector catalogue:
+
+- :func:`inject_nan` — jit-native NaN poisoning of a grad pytree at
+  one chosen step (a ``jnp.where`` on the step counter; compiles into
+  the step, costs one select when armed, is the identity when not).
+- :func:`failing_checkpoint_writes` — the next N checkpoint writes die
+  after flushing a few real payload bytes into the temp location
+  (transient disk/FS failure; nothing lands, exercising the retry path
+  and ``AsyncCheckpointer`` error surfacing).
+- :func:`torn_checkpoint_write` — the next checkpoint write LANDS, but
+  with a truncated ``state.pkl`` behind a manifest describing the full
+  intended bytes (a crash/power-cut that lost the file tail):
+  ``restore`` must reject the step and fall back.
+- :func:`corrupt_checkpoint` — flip bytes in a landed checkpoint's
+  payload in place (bit rot / torn sector).
+- :func:`simulate_preemption` — raise a real SIGTERM in-process, which
+  a :class:`~apex_tpu.resilience.preemption.PreemptionGuard` fields.
+"""
+
+import contextlib
+import os
+import pickle
+import signal
+
+import jax.numpy as jnp
+from jax import tree_util
+
+ENV_NAN_STEP = "APEX_TPU_FAULT_NAN_STEP"
+ENV_CKPT_WRITE_FAILURES = "APEX_TPU_FAULT_CKPT_WRITE_FAILURES"
+
+
+class FaultInjected(OSError):
+    """The error raised by injected I/O faults — distinguishable from a
+    real failure in test assertions."""
+
+
+def nan_step_from_env():
+    """The step to poison per ``$APEX_TPU_FAULT_NAN_STEP``, or None."""
+    v = os.environ.get(ENV_NAN_STEP)
+    return int(v) if v not in (None, "") else None
+
+
+def inject_nan(tree, step, nan_step=None):
+    """Poison every floating leaf of ``tree`` with NaN when ``step ==
+    nan_step`` (jit-native; identity for other steps and when no step
+    is armed). ``nan_step=None`` consults the env var; still None means
+    no injection — safe to leave in production step functions."""
+    if nan_step is None:
+        nan_step = nan_step_from_env()
+    if nan_step is None:
+        return tree
+    step = jnp.asarray(step)
+
+    def poison(leaf):
+        leaf = jnp.asarray(leaf)
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        return jnp.where(step == nan_step, jnp.full_like(leaf, jnp.nan),
+                         leaf)
+
+    return tree_util.tree_map(poison, tree)
+
+
+@contextlib.contextmanager
+def failing_checkpoint_writes(failures=None, after_bytes=64):
+    """Make the next ``failures`` checkpoint writes fail after writing
+    ``after_bytes`` of the real pickle payload into the temp location
+    (the partial-write fault). The canonical step dir never appears, so
+    ``latest_step`` must never select the failed step. Yields a dict
+    whose ``"fired"`` counts injected failures."""
+    from apex_tpu import checkpoint
+
+    if failures is None:
+        failures = int(os.environ.get(ENV_CKPT_WRITE_FAILURES, "1"))
+    real = checkpoint._write_state
+    stats = {"fired": 0}
+
+    def fake(path, host_state, use_orbax):
+        if stats["fired"] < failures:
+            stats["fired"] += 1
+            tmp = f"{path}.tmp-fault"
+            os.makedirs(tmp, exist_ok=True)
+            payload = pickle.dumps(host_state)
+            with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+                f.write(payload[:after_bytes])
+            raise FaultInjected(
+                f"injected write failure #{stats['fired']} after "
+                f"{min(after_bytes, len(payload))} bytes ({path})")
+        return real(path, host_state, use_orbax)
+
+    checkpoint._write_state = fake
+    try:
+        yield stats
+    finally:
+        checkpoint._write_state = real
+
+
+@contextlib.contextmanager
+def torn_checkpoint_write(keep_bytes=64):
+    """Make the next checkpoint write land a TRUNCATED ``state.pkl``
+    behind a manifest describing the full intended payload — the
+    durable wreckage of a crash that lost the file tail. The step IS
+    visible to ``latest_step``; only manifest verification can tell it
+    from a good one. Yields a dict whose ``"fired"`` flags firing."""
+    import json
+
+    from apex_tpu import checkpoint
+
+    real = checkpoint._write_state
+    stats = {"fired": 0}
+
+    def fake(path, host_state, use_orbax):
+        if stats["fired"]:
+            return real(path, host_state, use_orbax)
+        stats["fired"] = 1
+        payload = pickle.dumps(host_state)
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            f.write(payload[:keep_bytes])
+        manifest = checkpoint._manifest_for(host_state, "pickle")
+        manifest["files"] = {
+            "state.pkl": {"size": len(payload),
+                          "sha256": checkpoint._sha256_bytes(payload)}}
+        with open(os.path.join(path, checkpoint.MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f)
+
+    checkpoint._write_state = fake
+    try:
+        yield stats
+    finally:
+        checkpoint._write_state = real
+
+
+def corrupt_checkpoint(directory, step, *, offset=-8, nbytes=4):
+    """Flip ``nbytes`` bytes of a landed checkpoint's payload in place
+    (negative ``offset`` counts from the file end). Targets
+    ``state.pkl`` when present, else the largest orbax data file.
+    Returns the corrupted file's path."""
+    from apex_tpu import checkpoint
+
+    path = checkpoint._step_dir(directory, step)
+    target = os.path.join(path, "state.pkl")
+    if not os.path.exists(target):
+        candidates = []
+        for root, _, names in os.walk(path):
+            for nm in names:
+                if nm == checkpoint.MANIFEST_NAME:
+                    continue
+                full = os.path.join(root, nm)
+                candidates.append((os.path.getsize(full), full))
+        if not candidates:
+            raise FileNotFoundError(f"no payload files under {path}")
+        target = max(candidates)[1]
+    with open(target, "r+b") as f:
+        f.seek(offset, os.SEEK_END if offset < 0 else os.SEEK_SET)
+        pos = f.tell()
+        data = f.read(nbytes)
+        f.seek(pos)
+        f.write(bytes(b ^ 0xFF for b in data))
+    return target
+
+
+def simulate_preemption(sig=signal.SIGTERM):
+    """Deliver a real signal to this process (default SIGTERM — what a
+    TPU-pod preemption sends). Pair with an installed
+    :class:`~apex_tpu.resilience.preemption.PreemptionGuard`, or the
+    default handler will kill the process, which is the point of the
+    drill."""
+    signal.raise_signal(sig)

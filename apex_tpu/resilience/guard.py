@@ -1,0 +1,218 @@
+"""Non-finite step guard: skip poisoned optimizer steps in-graph.
+
+Why: one NaN microbatch — an fp16 overflow the scaler missed, a bad
+input record, a transient ICI bit flip — poisons every parameter
+forever once the optimizer commits it, and a multi-day run only finds
+out when the loss curve flatlines. The reference's answer is amp's
+host-synced overflow check (apex/amp/scaler.py:200 D2H-syncs the
+overflow flag every step); the TPU-native answer must stay inside the
+compiled step: no host sync, no callback, nothing XLA can't schedule.
+
+:func:`guarded_update` is that answer. It derives a single
+found-non-finite flag from the (pre-update) gradients, ORs it across
+the data-parallel replica set with one scalar ``psum`` (every replica
+must agree to skip, or params diverge), computes the candidate update
+anyway, and commits it with ``jnp.where`` — the skip costs one select
+per leaf, not a branch, and composes with donation. The skip decision
+also:
+
+- **does not commit dependent state**: whatever pytree the caller
+  passes as ``state`` is reverted wholesale on a skipped step. Put the
+  ``compress="int8"`` error-feedback residual in there — a residual
+  computed from NaN gradients must not feed back into the next step.
+- **still drives the loss scaler**: ``scaler.update`` *wants* to see
+  the overflow (that is how dynamic scaling backs off), so when a
+  ``scaler``/``scaler_state`` pair is supplied its update always
+  commits, fed with the global flag.
+- carries a consecutive-skip counter in :class:`GuardState` so the
+  host can distinguish "one bad batch" (skip and move on) from "the
+  run is diverging" (:func:`check_guard` raises
+  :class:`NonFiniteError` after K consecutive skips).
+
+Escalation and telemetry are host-side by design: :func:`check_guard`
+fetches the three-scalar ``GuardState`` (the only sync, amortizable to
+every N steps), lands the ``guard/steps_skipped`` counter and
+``guard/consecutive_skips`` gauge in the registry, and raises once the
+skip streak crosses the threshold. The compiled step stays clean — the
+chaos suite asserts no ``callback`` custom-calls in the lowered HLO.
+"""
+
+import os
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.telemetry import trace as _telemetry_trace
+from apex_tpu.telemetry.registry import get_registry
+
+ENV_MAX_SKIPS = "APEX_TPU_GUARD_MAX_SKIPS"
+DEFAULT_MAX_CONSECUTIVE_SKIPS = 3
+
+
+class NonFiniteError(RuntimeError):
+    """Raised host-side when non-finite gradients persist past the
+    consecutive-skip budget (or eagerly by
+    ``clip_grad_norm_(..., error_if_nonfinite=True)``)."""
+
+
+class GuardState(NamedTuple):
+    """Skip accounting carried through the jitted step (three i32
+    scalars — donate it with the rest of the training state)."""
+
+    consecutive_skips: jnp.ndarray  # i32: current skip streak
+    total_skips: jnp.ndarray        # i32: lifetime skipped steps
+    last_skipped: jnp.ndarray       # i32: 1 iff the latest step skipped
+
+
+def init_guard_state() -> GuardState:
+    return GuardState(
+        consecutive_skips=jnp.zeros((), jnp.int32),
+        total_skips=jnp.zeros((), jnp.int32),
+        last_skipped=jnp.zeros((), jnp.int32),
+    )
+
+
+def nonfinite_flag(tree) -> jnp.ndarray:
+    """f32 scalar: 1.0 iff any inexact leaf of ``tree`` holds a
+    non-finite value. One fused reduction per leaf — cheap against the
+    backward pass that produced the leaves."""
+    leaves = [jnp.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+    bad = [jnp.any(~jnp.isfinite(l)) for l in leaves
+           if jnp.issubdtype(l.dtype, jnp.inexact)]
+    if not bad:
+        return jnp.zeros((), jnp.float32)
+    flag = bad[0]
+    for b in bad[1:]:
+        flag = flag | b
+    return flag.astype(jnp.float32)
+
+
+def guarded_update(grads, opt_update: Callable[[Any, Any], Any], state,
+                   guard_state: GuardState, *, axis_name=None,
+                   flag=None, found_inf=None, scaler=None,
+                   scaler_state=None):
+    """Commit ``opt_update(grads, state)`` only when the gradients are
+    globally finite; otherwise keep ``state`` bit-identical.
+
+    jit-native: the non-finite flag is derived in-graph
+    (:func:`nonfinite_flag`), all-reduced over ``axis_name`` with one
+    scalar psum (``parallel.distributed.all_reduce_flag`` — every
+    replica takes the same branch), and the commit is a ``jnp.where``
+    select per leaf. No host sync, no callback.
+
+    Args:
+      grads: gradient pytree the flag is derived from. Check the
+        *local pre-compression* gradients: an int8-quantized psum can
+        launder a replica's NaN into finite garbage on the wire, so
+        the flag — not the payload — is what crosses replicas.
+      opt_update: ``(grads, state) -> new_state`` computing the
+        candidate (optimizer step, EF-residual commit, step counter —
+        anything that must NOT advance on a poisoned step). Must
+        return the same tree structure as ``state``.
+      state: the pytree to protect.
+      guard_state: :class:`GuardState` from the previous step
+        (:func:`init_guard_state` on step 0).
+      axis_name: mesh axis (or tuple) to OR the flag over; ``None``
+        for single-replica.
+      flag: optionally override the derived flag (f32, >0 = skip) —
+        e.g. when the caller already computed it pre-sync.
+      found_inf: optional extra overflow flag ORed in (the f32 count
+        ``LossScaler.unscale_grads`` returns).
+      scaler / scaler_state: when both given, ``scaler.update`` runs
+        on the *global* flag and its new state is returned third —
+        committed even on skipped steps, because backing the loss
+        scale off IS the reaction to the overflow.
+
+    Returns ``(new_state, new_guard_state)`` — plus
+    ``new_scaler_state`` when a scaler was supplied.
+    """
+    with _telemetry_trace.span("guard/update", axis=str(axis_name),
+                               scaled=scaler is not None):
+        local = nonfinite_flag(grads) if flag is None \
+            else jnp.asarray(flag, jnp.float32)
+        if found_inf is not None:
+            local = jnp.maximum(
+                local, (jnp.asarray(found_inf, jnp.float32) > 0)
+                .astype(jnp.float32))
+        if axis_name is not None:
+            from apex_tpu.parallel.distributed import all_reduce_flag
+
+            global_flag = all_reduce_flag(local, axis_name)
+        else:
+            global_flag = local
+        skip = global_flag > 0
+
+        candidate = opt_update(grads, state)
+        if (jax.tree_util.tree_structure(candidate)
+                != jax.tree_util.tree_structure(state)):
+            raise ValueError(
+                "guarded_update: opt_update returned a different tree "
+                "structure than state — the skip path could not revert "
+                f"it ({jax.tree_util.tree_structure(candidate)} vs "
+                f"{jax.tree_util.tree_structure(state)})")
+        new_state = jax.tree_util.tree_map(
+            lambda old, new: jnp.where(skip, old, new), state, candidate)
+
+        skip_i = skip.astype(jnp.int32)
+        new_guard = GuardState(
+            consecutive_skips=jnp.where(
+                skip, guard_state.consecutive_skips + 1, 0)
+            .astype(jnp.int32),
+            total_skips=(guard_state.total_skips + skip_i)
+            .astype(jnp.int32),
+            last_skipped=skip_i,
+        )
+        if scaler is not None:
+            if scaler_state is None:
+                raise ValueError("guarded_update: scaler given without "
+                                 "scaler_state")
+            new_scaler_state = scaler.update(scaler_state, global_flag)
+            return new_state, new_guard, new_scaler_state
+        return new_state, new_guard
+
+
+def check_guard(guard_state: GuardState,
+                max_consecutive_skips: Optional[int] = None, *,
+                registry=None) -> int:
+    """Host-side escalation + telemetry poll for the guard.
+
+    Fetches the three GuardState scalars (the only host sync in the
+    guard story — call it every step or every N, it is three i32s),
+    reconciles the ``guard/steps_skipped`` counter and
+    ``guard/consecutive_skips`` gauge with the device truth, and raises
+    :class:`NonFiniteError` once the consecutive-skip streak reaches
+    ``max_consecutive_skips`` (default ``$APEX_TPU_GUARD_MAX_SKIPS`` or
+    3) — skipping forever just burns a pod on a diverged run.
+
+    Returns the current consecutive-skip count.
+    """
+    if max_consecutive_skips is None:
+        max_consecutive_skips = int(
+            os.environ.get(ENV_MAX_SKIPS, str(DEFAULT_MAX_CONSECUTIVE_SKIPS)))
+    consecutive = int(guard_state.consecutive_skips)
+    total = int(guard_state.total_skips)
+    last = int(guard_state.last_skipped)
+    reg = registry or get_registry()
+    if reg.enabled:
+        counter = reg.counter("guard/steps_skipped")
+        # counters only go up; reconcile to the device-side total so
+        # check_guard may be called every N steps without undercounting
+        delta = total - counter.value
+        if delta > 0:
+            counter.inc(delta)
+        reg.gauge("guard/consecutive_skips").set(consecutive)
+        if last:
+            reg.event("guard", "step_skipped", consecutive=consecutive,
+                      total=total)
+    if consecutive >= max_consecutive_skips > 0:
+        if reg.enabled:
+            reg.event("guard", "escalate", consecutive=consecutive,
+                      total=total, limit=max_consecutive_skips)
+        raise NonFiniteError(
+            f"{consecutive} consecutive optimizer steps skipped on "
+            f"non-finite gradients (limit {max_consecutive_skips}; "
+            f"{total} skipped in total) — the run is diverging, not "
+            f"hitting one bad batch. Inspect the data pipeline / loss "
+            f"scale; restore from the last verified checkpoint.")
+    return consecutive

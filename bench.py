@@ -1094,6 +1094,115 @@ def bench_ddp_compressed(batch, steps, *, hidden=1024, depth=4):
           **fields)
 
 
+def bench_ddp_resilience(batch, steps, *, hidden=256, depth=2,
+                         nan_step=None):
+    """DDP training under the full resilience spine: int8-compressed
+    grad collectives with error feedback, deterministic NaN injection
+    at ``nan_step`` (default ``$APEX_TPU_FAULT_NAN_STEP``; None = no
+    fault), and ``resilience.guarded_update`` skipping poisoned steps
+    in-graph — the poisoned step must cost one skip, never the run.
+
+    The emitted line carries ``steps_skipped`` (from the device-side
+    GuardState, reconciled into the ``guard/steps_skipped`` telemetry
+    counter by ``check_guard``) and ``final_loss`` so a capture proves
+    the guard fired AND training stayed finite. Timing includes the
+    first-call compile — this is a robustness capture, not a perf
+    flagship; the guard's cost shows up in ``ddp_compressed`` deltas.
+
+    Returns ``{"steps_skipped", "final_loss", "nan_step"}`` for the
+    oneproc resilience smoke stage.
+    """
+    from apex_tpu import resilience
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.resilience import faults
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    if nan_step is None:
+        nan_step = faults.nan_step_from_env()
+    rng = np.random.RandomState(0)
+    params = {}
+    for i in range(depth):
+        params[f"w{i}"] = jnp.asarray(
+            rng.randn(hidden, hidden).astype(np.float32)
+            / np.sqrt(hidden))
+        params[f"b{i}"] = jnp.zeros((hidden,), jnp.float32)
+    x = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8")
+    residual = ddp.init_residual(params)
+    gstate = resilience.init_guard_state()
+
+    def loss_fn(p, xb, yb):
+        h = xb
+        for i in range(depth):
+            h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+        return jnp.mean((h - yb) ** 2)
+
+    def step_fn(p, res, gst, step, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        grads = faults.inject_nan(grads, step, nan_step)
+        # flag from the LOCAL pre-compression grads: int8 quantization
+        # can launder a NaN into finite wire garbage, so the flag — not
+        # the payload — is what crosses replicas (inside guarded_update)
+        flag = resilience.nonfinite_flag(grads)
+        synced, new_res = ddp.sync(grads, res)
+
+        def commit(g, st):
+            prev_p, _ = st
+            new_p = jax.tree_util.tree_map(
+                lambda w, gg: w - 0.05 * gg, prev_p, g)
+            return (new_p, new_res)  # residual commits only with the step
+
+        (p, res), gst = resilience.guarded_update(
+            synced, commit, (p, res), gst, axis_name="dp", flag=flag)
+        return p, res, gst, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P(), P(), P(), P("dp"),
+                                      P("dp")),
+                            out_specs=(P(), P(), P(), P()),
+                            check_vma=False)
+
+    @jax.jit
+    def train_step(p, res, gst, step):
+        return sharded(p, res, gst, step, x, y)
+
+    _measure_step_cost(train_step,
+                       (params, residual, gstate,
+                        jnp.zeros((), jnp.int32)))
+    from apex_tpu.telemetry import span
+
+    p, res, gst = params, residual, gstate
+    loss = None
+    t0 = time.perf_counter()
+    with span("bench/timed_loop", steps=steps):
+        for i in range(steps):
+            with span("bench/step"):
+                p, res, gst, loss = train_step(
+                    p, res, gst, jnp.asarray(i, jnp.int32))
+            # host-side escalation poll (3 i32 scalars per step);
+            # max=steps+1 records telemetry without ever escalating a
+            # deliberate injection
+            resilience.check_guard(gst, max_consecutive_skips=steps + 1)
+        final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    skipped = int(gst.total_skips)
+
+    n = _tree_size(params)
+    fields = _comm_fields(params, compress="int8")
+    flops = 6 * batch * world * depth * hidden * hidden
+    _emit("ddp_resilience_steps_per_sec", steps / dt, "steps/sec",
+          flops, steps, dt, dp_world=world, grad_elements=n,
+          steps_skipped=skipped,
+          nan_step=nan_step, final_loss=final_loss, **fields)
+    return {"steps_skipped": skipped, "final_loss": final_loss,
+            "nan_step": nan_step}
+
+
 # The canonical (size, steps) per bench — the ONLY place these defaults
 # live; both the CLI dispatch below and the one-process capture plan
 # (tools/oneproc_capture.py) read them, so a tuning change (like resnet
@@ -1114,6 +1223,7 @@ BENCH_SPECS = {
     "decode": ((8, 128), bench_decode),
     "resnet": ((256, 50), bench_resnet),
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
+    "ddp_resilience": ((32, 12), bench_ddp_resilience),
 }
 
 

@@ -1,0 +1,741 @@
+"""apex_tpu.resilience chaos suite (ISSUE 3 acceptance).
+
+Every fault here is injected deterministically by resilience.faults —
+NaN grads at a chosen step, checkpoint writes that die after partial
+bytes, torn/corrupted landed checkpoints, simulated SIGTERM — so each
+chaos scenario is a plain regression test:
+
+- NaN at step N  -> exactly that step skipped, training stays finite
+  and lands within tolerance of the uninjected run;
+- kill mid-write -> the step never becomes selectable; a landed torn
+  write is rejected by manifest verification and ``restore`` falls
+  back to the last verified step with a loud warning;
+- the guard adds zero host syncs to the compiled step (no callback
+  custom-calls in the lowered HLO, same assertion as test_telemetry).
+
+The clip_grad / LossScaler satellite regressions live here too: both
+fixes exist because of the guard story (non-finite handling must not
+silently poison or silently floor).
+"""
+
+import json
+import os
+import pickle
+import signal
+
+import concurrent.futures
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import checkpoint, resilience
+from apex_tpu.checkpoint import (
+    AsyncCheckpointer,
+    CheckpointCorruptError,
+    MANIFEST_NAME,
+    latest_step,
+    restore,
+    save,
+    verify_checkpoint,
+)
+from apex_tpu.resilience import (
+    GuardState,
+    NonFiniteError,
+    PreemptionGuard,
+    check_guard,
+    faults,
+    guarded_update,
+    init_guard_state,
+    nonfinite_flag,
+)
+from apex_tpu.telemetry import MetricsRegistry, use_registry
+
+
+# ---------------------------------------------------------------------------
+# guard: flag derivation
+# ---------------------------------------------------------------------------
+
+def test_nonfinite_flag_detects_nan_and_inf():
+    clean = {"w": jnp.ones((4,)), "n": jnp.arange(3)}  # ints ignored
+    assert float(nonfinite_flag(clean)) == 0.0
+    assert float(nonfinite_flag({"w": jnp.array([1.0, jnp.nan])})) == 1.0
+    assert float(nonfinite_flag({"w": jnp.array([jnp.inf, 0.0])})) == 1.0
+    # integer-only trees have nothing to be non-finite
+    assert float(nonfinite_flag({"n": jnp.arange(5)})) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# guard: skip semantics
+# ---------------------------------------------------------------------------
+
+def _sgd(lr=0.1):
+    def update(grads, params):
+        return jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                      params, grads)
+    return update
+
+
+def test_guarded_update_commits_finite_and_skips_poisoned():
+    params = {"w": jnp.ones((4,))}
+    gst = init_guard_state()
+
+    good = {"w": jnp.full((4,), 2.0)}
+    params1, gst = guarded_update(good, _sgd(), params, gst)
+    np.testing.assert_allclose(params1["w"], 1.0 - 0.1 * 2.0)
+    assert int(gst.total_skips) == 0
+    assert int(gst.last_skipped) == 0
+
+    bad = {"w": jnp.array([1.0, jnp.nan, 1.0, 1.0])}
+    params2, gst = guarded_update(bad, _sgd(), params1, gst)
+    # skipped step: state bit-identical
+    np.testing.assert_array_equal(params2["w"], params1["w"])
+    assert (int(gst.consecutive_skips), int(gst.total_skips),
+            int(gst.last_skipped)) == (1, 1, 1)
+
+    # a clean step resets the streak but not the lifetime total
+    params3, gst = guarded_update(good, _sgd(), params2, gst)
+    assert not np.array_equal(params3["w"], params2["w"])
+    assert (int(gst.consecutive_skips), int(gst.total_skips)) == (0, 1)
+
+
+def test_guarded_update_works_under_jit():
+    @jax.jit
+    def step(params, grads, gst):
+        return guarded_update(grads, _sgd(), params, gst)
+
+    params = {"w": jnp.ones((4,))}
+    gst = init_guard_state()
+    params, gst = step(params, {"w": jnp.full((4,), jnp.nan)}, gst)
+    np.testing.assert_array_equal(params["w"], 1.0)
+    assert int(gst.total_skips) == 1
+
+
+def test_guarded_update_rejects_structure_change():
+    def bad_update(grads, params):
+        return {"w": params["w"], "extra": params["w"]}
+
+    with pytest.raises(ValueError, match="tree structure"):
+        guarded_update({"w": jnp.ones(2)}, bad_update,
+                       {"w": jnp.ones(2)}, init_guard_state())
+
+
+def test_guarded_update_found_inf_forces_skip():
+    """The scaler's found_inf count composes into the skip decision even
+    when the (already-unscaled) grads look finite."""
+    params = {"w": jnp.ones((2,))}
+    new, gst = guarded_update({"w": jnp.ones((2,))}, _sgd(), params,
+                              init_guard_state(),
+                              found_inf=jnp.asarray(3.0))
+    np.testing.assert_array_equal(new["w"], params["w"])
+    assert int(gst.last_skipped) == 1
+
+
+def test_guarded_update_scaler_always_commits():
+    """LossScaler.update WANTS the overflow (that is how dynamic scaling
+    backs off) — its state commits even on skipped steps."""
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler("dynamic", init_scale=8.0, scale_factor=2.0)
+    sstate = scaler.init_state()
+    params = {"w": jnp.ones((2,))}
+
+    bad = {"w": jnp.full((2,), jnp.nan)}
+    new, gst, sstate = guarded_update(
+        bad, _sgd(), params, init_guard_state(),
+        scaler=scaler, scaler_state=sstate)
+    np.testing.assert_array_equal(new["w"], params["w"])  # step skipped
+    assert float(sstate.loss_scale) == 4.0                # scale backed off
+    assert int(gst.last_skipped) == 1
+
+    good = {"w": jnp.ones((2,))}
+    new, gst, sstate = guarded_update(
+        good, _sgd(), new, gst, scaler=scaler, scaler_state=sstate)
+    assert float(sstate.loss_scale) == 4.0  # clean step: window counts up
+    assert int(sstate.unskipped) == 1
+    assert int(gst.consecutive_skips) == 0
+
+
+def test_guarded_update_scaler_requires_state():
+    from apex_tpu.amp.scaler import LossScaler
+
+    with pytest.raises(ValueError, match="scaler_state"):
+        guarded_update({"w": jnp.ones(2)}, _sgd(), {"w": jnp.ones(2)},
+                       init_guard_state(),
+                       scaler=LossScaler("dynamic"))
+
+
+# ---------------------------------------------------------------------------
+# guard: host-side escalation + telemetry
+# ---------------------------------------------------------------------------
+
+def test_check_guard_escalates_after_k_consecutive():
+    gst = GuardState(consecutive_skips=jnp.asarray(2, jnp.int32),
+                     total_skips=jnp.asarray(5, jnp.int32),
+                     last_skipped=jnp.asarray(1, jnp.int32))
+    assert check_guard(gst, max_consecutive_skips=3) == 2
+    with pytest.raises(NonFiniteError, match="3 consecutive"):
+        check_guard(gst._replace(
+            consecutive_skips=jnp.asarray(3, jnp.int32)),
+            max_consecutive_skips=3)
+
+
+def test_check_guard_env_threshold(monkeypatch):
+    monkeypatch.setenv(resilience.guard.ENV_MAX_SKIPS, "1")
+    gst = GuardState(consecutive_skips=jnp.asarray(1, jnp.int32),
+                     total_skips=jnp.asarray(1, jnp.int32),
+                     last_skipped=jnp.asarray(1, jnp.int32))
+    with pytest.raises(NonFiniteError):
+        check_guard(gst)
+
+
+def test_check_guard_reconciles_counter_when_polled_sparsely():
+    """check_guard may run every N steps; the steps_skipped counter must
+    match the device-side lifetime total, not the poll count."""
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        gst = GuardState(consecutive_skips=jnp.asarray(1, jnp.int32),
+                         total_skips=jnp.asarray(4, jnp.int32),
+                         last_skipped=jnp.asarray(1, jnp.int32))
+        check_guard(gst, max_consecutive_skips=100)
+        check_guard(gst, max_consecutive_skips=100)  # no double count
+    snap = reg.snapshot()
+    assert snap["counters"]["guard/steps_skipped"] == 4
+    assert snap["gauges"]["guard/consecutive_skips"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: NaN injection through the real DDP + EF-residual step
+# ---------------------------------------------------------------------------
+
+def _make_guarded_ddp_step(mesh, hidden, nan_step):
+    """The docs/parallelism.md composition: int8-compressed sync, EF
+    residual inside the guarded state, flag from LOCAL pre-compression
+    grads, deterministic NaN injection at ``nan_step``."""
+    from apex_tpu.parallel import DistributedDataParallel
+
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8")
+
+    def loss_fn(p, xb, yb):
+        h = jnp.tanh(xb @ p["w0"] + p["b0"]) @ p["w1"]
+        return jnp.mean((h - yb) ** 2)
+
+    def step_fn(p, res, gst, step, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        grads = faults.inject_nan(grads, step, nan_step)
+        flag = nonfinite_flag(grads)
+        synced, new_res = ddp.sync(grads, res)
+
+        def commit(g, st):
+            prev_p, _ = st
+            new_p = jax.tree_util.tree_map(
+                lambda w, gg: w - 0.05 * gg, prev_p, g)
+            return (new_p, new_res)
+
+        (p, res), gst = guarded_update(synced, commit, (p, res), gst,
+                                       axis_name="dp", flag=flag)
+        return p, res, gst, loss
+
+    sharded = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P(), P()), check_vma=False)
+    return ddp, jax.jit(sharded)
+
+
+def _init_problem(hidden, batch):
+    rng = np.random.RandomState(0)
+    params = {
+        "w0": jnp.asarray(rng.randn(hidden, hidden).astype(np.float32)
+                          / np.sqrt(hidden)),
+        "b0": jnp.zeros((hidden,), jnp.float32),
+        "w1": jnp.asarray(rng.randn(hidden, hidden).astype(np.float32)
+                          / np.sqrt(hidden)),
+    }
+    x = jnp.asarray(rng.randn(batch, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch, hidden).astype(np.float32))
+    return params, x, y
+
+
+@pytest.mark.multi_device
+def test_nan_injection_skips_exactly_one_step_and_converges(dp_mesh):
+    """Chaos (a): NaN grads at step 3 of a guarded int8-EF DDP run ->
+    exactly that step skipped, final params finite, final loss within
+    tolerance of the uninjected run."""
+    mesh = dp_mesh(8)
+    hidden, batch, steps = 32, 16, 10
+    finals = {}
+    for nan_step in (None, 3):
+        ddp, train = _make_guarded_ddp_step(mesh, hidden, nan_step)
+        params, x, y = _init_problem(hidden, batch)
+        res = ddp.init_residual(params)
+        gst = init_guard_state()
+        loss0 = None
+        for i in range(steps):
+            params, res, gst, loss = train(
+                params, res, gst, jnp.asarray(i, jnp.int32), x, y)
+            if loss0 is None:
+                loss0 = float(loss)
+            check_guard(gst, max_consecutive_skips=steps + 1)
+        finals[nan_step] = (params, float(loss), int(gst.total_skips))
+
+    _, loss_clean, skipped_clean = finals[None]
+    params_inj, loss_inj, skipped_inj = finals[3]
+    assert skipped_clean == 0
+    assert skipped_inj == 1  # exactly the poisoned step
+    assert all(bool(jnp.all(jnp.isfinite(l)))
+               for l in jax.tree_util.tree_leaves(params_inj))
+    assert np.isfinite(loss_inj)
+    assert loss_inj < loss0          # training progressed past the fault
+    # one skipped SGD step on a smooth quadratic: small final-loss gap
+    assert abs(loss_inj - loss_clean) <= 0.25 * abs(loss_clean) + 1e-4
+
+
+@pytest.mark.multi_device
+def test_skipped_step_does_not_commit_ef_residual(dp_mesh):
+    """EF composition: the residual computed from poisoned gradients
+    must not feed back into the next step — on a skipped step it stays
+    bit-identical to the previous one."""
+    mesh = dp_mesh(8)
+    hidden, batch = 32, 16
+    ddp, train = _make_guarded_ddp_step(mesh, hidden, nan_step=1)
+    params, x, y = _init_problem(hidden, batch)
+    res = ddp.init_residual(params)
+    gst = init_guard_state()
+
+    params, res0, gst, _ = train(params, res, gst,
+                                 jnp.asarray(0, jnp.int32), x, y)
+    # step 0 was clean: the residual carries quantization error
+    assert any(float(jnp.max(jnp.abs(l))) > 0
+               for l in jax.tree_util.tree_leaves(res0))
+    params1, res1, gst, _ = train(params, res0, gst,
+                                  jnp.asarray(1, jnp.int32), x, y)
+    assert int(gst.last_skipped) == 1
+    np.testing.assert_array_equal(np.asarray(params1["w0"]),
+                                  np.asarray(params["w0"]))
+    for a, b in zip(jax.tree_util.tree_leaves(res1),
+                    jax.tree_util.tree_leaves(res0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_guard_adds_no_host_callbacks_to_compiled_step():
+    """Chaos (iii): the lowered HLO of a guarded step — telemetry
+    enabled, injection armed — contains no callback custom-calls (the
+    guard is pure in-graph selects + one scalar psum)."""
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        _, train = _make_guarded_ddp_step(mesh, 16, nan_step=2)
+        params, x, y = _init_problem(16, 8)
+        res = jax.tree_util.tree_map(jnp.zeros_like, params)
+        text = train.lower(params, res, init_guard_state(),
+                           jnp.zeros((), jnp.int32), x, y).as_text()
+    assert "callback" not in text
+
+
+# ---------------------------------------------------------------------------
+# fault injectors
+# ---------------------------------------------------------------------------
+
+def test_inject_nan_is_identity_when_unarmed(monkeypatch):
+    monkeypatch.delenv(faults.ENV_NAN_STEP, raising=False)
+    tree = {"w": jnp.ones((3,)), "n": jnp.arange(2)}
+    out = faults.inject_nan(tree, jnp.asarray(0))
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    # armed via env: fires only at the named step
+    monkeypatch.setenv(faults.ENV_NAN_STEP, "2")
+    assert not np.any(np.isnan(
+        faults.inject_nan(tree, jnp.asarray(1))["w"]))
+    poisoned = faults.inject_nan(tree, jnp.asarray(2))
+    assert np.all(np.isnan(poisoned["w"]))
+    np.testing.assert_array_equal(poisoned["n"], tree["n"])  # ints kept
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability: manifest + verification + fallback chain
+# ---------------------------------------------------------------------------
+
+def _state(v=1.0):
+    return {"w": jnp.full((8,), v), "step": jnp.asarray(int(v))}
+
+
+def test_save_writes_manifest_and_verifies(tmp_path):
+    path = save(str(tmp_path), 1, _state(), use_orbax=False)
+    manifest = verify_checkpoint(path)
+    assert manifest["format"] == checkpoint.MANIFEST_FORMAT
+    assert manifest["num_leaves"] == 2
+    assert "state.pkl" in manifest["files"]
+    paths = {e["path"] for e in manifest["leaves"]}
+    assert paths == {"w", "step"}
+    restored = restore(str(tmp_path))
+    np.testing.assert_array_equal(restored["w"], _state()["w"])
+
+
+def test_restore_falls_back_past_corrupted_step(tmp_path):
+    """Chaos (c): a bit-flipped newest checkpoint is rejected by its
+    manifest and restore transparently falls back to the last verified
+    step, warning loudly about what it rejected."""
+    save(str(tmp_path), 1, _state(1.0), use_orbax=False)
+    save(str(tmp_path), 2, _state(2.0), use_orbax=False)
+    faults.corrupt_checkpoint(str(tmp_path), 2)
+    with pytest.warns(UserWarning, match="REJECTED step 2"):
+        restored = restore(str(tmp_path))
+    assert int(restored["step"]) == 1  # the older, verified step
+
+
+def test_restore_explicit_step_does_not_fall_back(tmp_path):
+    save(str(tmp_path), 1, _state(1.0), use_orbax=False)
+    save(str(tmp_path), 2, _state(2.0), use_orbax=False)
+    faults.corrupt_checkpoint(str(tmp_path), 2)
+    with pytest.raises(CheckpointCorruptError, match="sha256 mismatch"):
+        restore(str(tmp_path), step=2)
+    # the older step is still explicitly loadable
+    assert int(restore(str(tmp_path), step=1)["step"]) == 1
+
+
+def test_restore_rejects_torn_write_and_falls_back(tmp_path):
+    """Chaos (b): a write killed mid-stream that still landed its step
+    dir (truncated payload behind a full-size manifest) is caught by
+    size verification before the unpickler ever sees the bytes."""
+    save(str(tmp_path), 1, _state(1.0), use_orbax=False)
+    with faults.torn_checkpoint_write(keep_bytes=32) as stats:
+        save(str(tmp_path), 2, _state(2.0), use_orbax=False, retries=0)
+    assert stats["fired"] == 1
+    assert latest_step(str(tmp_path)) == 2  # the torn step IS visible
+    with pytest.warns(UserWarning, match="torn write"):
+        restored = restore(str(tmp_path))
+    assert int(restored["step"]) == 1
+
+
+def test_restore_all_steps_corrupt_raises_with_inventory(tmp_path):
+    save(str(tmp_path), 1, _state(1.0), use_orbax=False)
+    faults.corrupt_checkpoint(str(tmp_path), 1)
+    with pytest.warns(UserWarning, match="no older step"):
+        with pytest.raises(CheckpointCorruptError,
+                           match="every checkpoint"):
+            restore(str(tmp_path))
+
+
+def test_truncated_pickle_without_manifest_is_corrupt_not_opaque(
+        tmp_path):
+    """Even with verification unavailable (no manifest), a decode
+    failure surfaces as CheckpointCorruptError, not a raw unpickle
+    traceback."""
+    path = checkpoint._step_dir(str(tmp_path), 3)
+    os.makedirs(path)
+    with open(os.path.join(path, "state.pkl"), "wb") as f:
+        f.write(pickle.dumps(_state())[:20])
+    with pytest.warns(UserWarning, match="no manifest.json"):
+        with pytest.raises(CheckpointCorruptError,
+                           match="failed to unpickle"):
+            restore(str(tmp_path), step=3)
+
+
+def test_orbax_selected_step_failure_is_corrupt_error(tmp_path):
+    """Satellite: a step dir with no state.pkl hard-selects the orbax
+    path; any orbax failure (or orbax being absent) must surface as
+    CheckpointCorruptError feeding the fallback chain, never an opaque
+    backend traceback."""
+    path = checkpoint._step_dir(str(tmp_path), 5)
+    os.makedirs(path)
+    with open(os.path.join(path, "not_orbax_data"), "wb") as f:
+        f.write(b"\x00" * 64)
+    with pytest.warns(UserWarning, match="no manifest.json"):
+        with pytest.raises(CheckpointCorruptError,
+                           match="orbax"):
+            restore(str(tmp_path), step=5)
+
+
+@pytest.mark.skipif(not checkpoint._HAVE_ORBAX,
+                    reason="orbax not installed")
+def test_orbax_corrupted_payload_falls_back(tmp_path):
+    """Corruption injector against a real orbax checkpoint: the resume
+    path rejects it (manifest hash mismatch wraps whatever orbax would
+    have said) and falls back to the older pickle step."""
+    save(str(tmp_path), 1, _state(1.0), use_orbax=False)
+    save(str(tmp_path), 2, _state(2.0), use_orbax=True)
+    faults.corrupt_checkpoint(str(tmp_path), 2)
+    with pytest.warns(UserWarning, match="REJECTED step 2"):
+        restored = restore(str(tmp_path))
+    assert int(restored["step"]) == 1
+
+
+def test_pre_manifest_checkpoint_still_restores(tmp_path):
+    """Backwards compatibility: a checkpoint written before the
+    manifest era loads with a warning, not a rejection."""
+    path = checkpoint._step_dir(str(tmp_path), 1)
+    os.makedirs(path)
+    with open(os.path.join(path, "state.pkl"), "wb") as f:
+        pickle.dump({"w": np.ones(4)}, f)
+    with pytest.warns(UserWarning, match="pre-manifest"):
+        restored = restore(str(tmp_path))
+    np.testing.assert_array_equal(restored["w"], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint durability: retries + retention
+# ---------------------------------------------------------------------------
+
+def test_transient_write_failure_retries_and_lands(tmp_path):
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        with faults.failing_checkpoint_writes(failures=1) as stats:
+            with pytest.warns(UserWarning, match="retrying"):
+                save(str(tmp_path), 1, _state(), use_orbax=False,
+                     retries=2, retry_base_delay=0.001)
+    assert stats["fired"] == 1
+    assert latest_step(str(tmp_path)) == 1
+    verify_checkpoint(checkpoint._step_dir(str(tmp_path), 1))
+    assert reg.snapshot()["counters"]["checkpoint/write_retries"] == 1
+
+
+def test_write_failure_exhausting_retries_raises_and_lands_nothing(
+        tmp_path):
+    with faults.failing_checkpoint_writes(failures=3):
+        with pytest.warns(UserWarning, match="retrying"):
+            with pytest.raises(faults.FaultInjected):
+                save(str(tmp_path), 1, _state(), use_orbax=False,
+                     retries=1, retry_base_delay=0.001)
+    assert latest_step(str(tmp_path)) is None  # nothing selectable
+
+
+def test_keep_last_n_prunes_only_verified(tmp_path):
+    for s in range(4):
+        save(str(tmp_path), s, _state(float(s)), use_orbax=False,
+             keep_last_n=2)
+    assert checkpoint._all_steps(str(tmp_path)) == [2, 3]
+    # both survivors verify
+    for s in (2, 3):
+        verify_checkpoint(checkpoint._step_dir(str(tmp_path), s))
+
+
+def test_keep_last_n_not_applied_when_save_fails(tmp_path):
+    """Retention can never eat the only good checkpoint: a failed save
+    must not prune the older steps it was supposed to supersede."""
+    save(str(tmp_path), 1, _state(1.0), use_orbax=False)
+    with faults.failing_checkpoint_writes(failures=2):
+        with pytest.raises(faults.FaultInjected):
+            save(str(tmp_path), 2, _state(2.0), use_orbax=False,
+                 retries=0, keep_last_n=1)
+    assert checkpoint._all_steps(str(tmp_path)) == [1]
+    assert int(restore(str(tmp_path))["step"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointer failure semantics (satellite)
+# ---------------------------------------------------------------------------
+
+def _wait_done(ck):
+    """Let the background write finish WITHOUT consuming its result
+    (wait_until_finished would re-raise and clear it)."""
+    concurrent.futures.wait([ck._future])
+
+
+def test_async_partial_write_surfaces_on_next_save(tmp_path):
+    ck = AsyncCheckpointer(use_orbax=False, retries=0)
+    with faults.failing_checkpoint_writes(failures=1):
+        ck.save(str(tmp_path), 0, _state(0.0))
+        _wait_done(ck)
+    with pytest.raises(faults.FaultInjected):
+        ck.save(str(tmp_path), 1, _state(1.0))
+    # the failed step never became selectable
+    assert latest_step(str(tmp_path)) is None
+    # the failed future is consumed; a clean save works end to end
+    ck.save(str(tmp_path), 2, _state(2.0))
+    ck.wait_until_finished()
+    assert latest_step(str(tmp_path)) == 2
+    ck.close()
+
+
+def test_async_partial_write_surfaces_on_close(tmp_path):
+    ck = AsyncCheckpointer(use_orbax=False, retries=0)
+    with faults.failing_checkpoint_writes(failures=1):
+        ck.save(str(tmp_path), 0, _state(0.0))
+        _wait_done(ck)
+    with pytest.raises(faults.FaultInjected):
+        ck.close()
+    assert latest_step(str(tmp_path)) is None
+
+
+def test_async_background_retry_lands(tmp_path):
+    """The background write runs the same retry path as blocking save."""
+    ck = AsyncCheckpointer(use_orbax=False, retries=2,
+                           retry_base_delay=0.001)
+    with faults.failing_checkpoint_writes(failures=1):
+        with pytest.warns(UserWarning, match="retrying"):
+            ck.save(str(tmp_path), 4, _state(4.0))
+            ck.wait_until_finished()
+    ck.close()
+    assert latest_step(str(tmp_path)) == 4
+    assert int(restore(str(tmp_path))["step"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+def test_preemption_guard_fields_sigterm_and_restores_handlers(
+        tmp_path):
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert signal.getsignal(signal.SIGTERM) != prev
+        assert not guard.should_checkpoint()
+        faults.simulate_preemption(signal.SIGTERM)
+        assert guard.preempted
+        assert guard.signum == signal.SIGTERM
+        assert guard.should_checkpoint()
+        # the loop saves and acknowledges
+        save(str(tmp_path), 7, _state(7.0), use_orbax=False)
+        guard.mark_saved()
+        assert not guard.should_checkpoint()
+    assert signal.getsignal(signal.SIGTERM) == prev
+    assert latest_step(str(tmp_path)) == 7
+
+
+def test_preemption_guard_final_save_runs_once_on_exit():
+    calls = []
+    with PreemptionGuard(final_save=lambda: calls.append(1)) as guard:
+        guard.trigger()
+    assert calls == [1]
+    # not preempted -> no save; mark_saved suppresses the exit save
+    calls.clear()
+    with PreemptionGuard(final_save=lambda: calls.append(1)):
+        pass
+    assert calls == []
+    with PreemptionGuard(final_save=lambda: calls.append(1)) as guard:
+        guard.trigger()
+        guard.mark_saved()
+    assert calls == []
+
+
+def test_preemption_counts_once_in_telemetry():
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        with PreemptionGuard() as guard:
+            guard.trigger()
+            guard.should_checkpoint()
+            guard.should_checkpoint()  # polled twice, counted once
+    assert reg.snapshot()["counters"]["preemption/signals"] == 1
+
+
+def test_preemption_guard_handlers_restored_on_exception():
+    prev = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(RuntimeError):
+        with PreemptionGuard():
+            raise RuntimeError("boom")
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: clip_grad_norm_ non-finite handling
+# ---------------------------------------------------------------------------
+
+def test_clip_grad_norm_error_if_nonfinite_raises():
+    from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+    grads = {"w": jnp.array([1.0, jnp.nan])}
+    with pytest.raises(NonFiniteError, match="non-finite"):
+        clip_grad_norm_(grads, 1.0, error_if_nonfinite=True)
+
+
+def test_clip_grad_norm_nonfinite_falls_back_to_unclipped():
+    """error_if_nonfinite=False: a NaN total norm must leave the grads
+    untouched (previously every leaf was scaled by NaN)."""
+    from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+    grads = {"good": jnp.array([3.0, 4.0]),
+             "bad": jnp.array([jnp.nan, 0.0])}
+    out, norm = clip_grad_norm_(grads, 1.0, error_if_nonfinite=False)
+    assert not np.isfinite(float(norm))
+    np.testing.assert_array_equal(out["good"], grads["good"])  # unclipped
+    assert np.isnan(np.asarray(out["bad"])[0])  # poison stays visible
+
+
+def test_clip_grad_norm_finite_path_unchanged():
+    from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+    grads = {"w": jnp.array([3.0, 4.0])}  # norm 5
+    out, norm = clip_grad_norm_(grads, 1.0)
+    assert float(norm) == pytest.approx(5.0)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.array([0.6, 0.8]), rtol=1e-5)
+    # under the clip threshold: untouched, error_if_nonfinite happy
+    out2, norm2 = clip_grad_norm_(grads, 10.0, error_if_nonfinite=True)
+    np.testing.assert_allclose(out2["w"], grads["w"], rtol=1e-6)
+
+
+def test_clip_grad_norm_error_mode_rejects_jit():
+    from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+    with pytest.raises(ValueError, match="eagerly"):
+        jax.jit(lambda g: clip_grad_norm_(
+            g, 1.0, error_if_nonfinite=True))({"w": jnp.ones(2)})
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: LossScaler min_loss_scale floor
+# ---------------------------------------------------------------------------
+
+def _overflow_n(scaler, state, n):
+    for _ in range(n):
+        state = scaler.update(state, jnp.asarray(1.0))
+    return state
+
+
+def test_loss_scaler_min_scale_zero_is_honored():
+    """min_loss_scale=0 means 'no floor' — the old truthiness check
+    silently coerced it to 1.0."""
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler("dynamic", init_scale=4.0, scale_factor=2.0,
+                        min_loss_scale=0)
+    state = _overflow_n(scaler, scaler.init_state(), 4)
+    assert float(state.loss_scale) == 0.25  # fell below 1.0
+
+
+def test_loss_scaler_min_scale_default_floor():
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler("dynamic", init_scale=4.0, scale_factor=2.0)
+    state = _overflow_n(scaler, scaler.init_state(), 6)
+    assert float(state.loss_scale) == 1.0  # None -> legacy floor of 1.0
+
+
+def test_loss_scaler_min_scale_positive_floor():
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler("dynamic", init_scale=16.0, scale_factor=2.0,
+                        min_loss_scale=4.0)
+    state = _overflow_n(scaler, scaler.init_state(), 5)
+    assert float(state.loss_scale) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: preemption -> final save -> verified resume
+# ---------------------------------------------------------------------------
+
+def test_preemption_to_resume_roundtrip(tmp_path):
+    """The full drill: train, get preempted mid-run, land one final
+    synchronous checkpoint, 'restart', resume from the verified step."""
+    state = _state(0.0)
+    step_holder = {"step": 0, "state": state}
+
+    def final_save():
+        save(str(tmp_path), step_holder["step"], step_holder["state"],
+             use_orbax=False)
+
+    with PreemptionGuard(final_save=final_save) as guard:
+        for i in range(10):
+            step_holder["step"] = i
+            step_holder["state"] = _state(float(i))
+            if i == 6:
+                faults.simulate_preemption()
+            if guard.should_checkpoint():
+                break
+    # the guard ran final_save on exit for the step the loop stopped at
+    assert latest_step(str(tmp_path)) == 6
+    restored = restore(str(tmp_path))
+    assert int(restored["step"]) == 6
+    verify_checkpoint(checkpoint._step_dir(str(tmp_path), 6))

@@ -39,6 +39,12 @@ import sys
 # the round from which the telemetry fields (measured comm bytes + XLA
 # flops) became part of the successful-metric-line contract
 TELEMETRY_FIELDS_SINCE_ROUND = 7
+# the resilience capture contract: steps_skipped (the guard's skipped-
+# step count) is an OPTIONAL field defined from round 8 — only the
+# guarded configs (ddp_resilience) emit it, old records stay valid
+# without it, and a pre-round-8 record carrying it is flagged (the
+# field did not exist yet)
+STEPS_SKIPPED_SINCE_ROUND = 8
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -98,6 +104,16 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"telemetry field {key!r} must be numeric or "
                         f"null")
+        if "steps_skipped" in obj:
+            if (round_n is not None
+                    and round_n < STEPS_SKIPPED_SINCE_ROUND):
+                bad(f"steps_skipped is only defined from round "
+                    f"{STEPS_SKIPPED_SINCE_ROUND}")
+            elif not (obj["steps_skipped"] is None
+                      or (_type_ok(obj["steps_skipped"], int)
+                          and obj["steps_skipped"] >= 0)):
+                bad("steps_skipped must be a non-negative integer or "
+                    "null")
     if errors is None and own:
         raise ValueError("; ".join(own))
     return own
