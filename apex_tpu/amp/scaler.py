@@ -66,7 +66,19 @@ class LossScaler(object):
         return jax.tree_util.tree_unflatten(treedef, outs), found_inf
 
     def update(self, state: ScalerState, found_inf) -> ScalerState:
-        """Dynamic scale update (reference scaler.py:197-217)."""
+        """Dynamic scale update (reference scaler.py:197-217).
+
+        Telemetry: when the registry is enabled AND the values are
+        concrete (eager use — ``update_scale``, host-side drivers), the
+        new state lands as the ``amp/loss_scale`` gauge plus the
+        ``amp/overflow`` / ``amp/scale_window_growth`` counters and an
+        ``amp`` JSONL event, so scale dynamics are visible next to the
+        guard events. Inside jit the values are tracers and recording
+        is skipped entirely — telemetry never adds a host callback to
+        the compiled update (the lowered HLO is byte-identical with the
+        registry on or off), and a disabled registry costs one
+        attribute read, no allocation.
+        """
         if not self.dynamic:
             return state
         overflow = found_inf > 0
@@ -83,7 +95,37 @@ class LossScaler(object):
         new_unskipped = jnp.where(
             overflow | (state.unskipped + 1 >= self._scale_window),
             0, state.unskipped + 1).astype(jnp.int32)
-        return ScalerState(new_scale, new_unskipped)
+        new_state = ScalerState(new_scale, new_unskipped)
+        self.record_update(state, new_state, found_inf)
+        return new_state
+
+    def record_update(self, state: ScalerState, new_state: ScalerState,
+                      found_inf, registry=None):
+        """Host-side telemetry for one scale update. No-op (and
+        allocation-free) when the registry is disabled, and a no-op
+        under tracing — concrete values are required, so callers
+        polling device-side scaler state can invoke this directly with
+        the fetched states."""
+        from apex_tpu.telemetry.registry import get_registry
+
+        reg = registry or get_registry()
+        if not reg.enabled:
+            return
+        if any(isinstance(v, jax.core.Tracer)
+               for v in (state.loss_scale, new_state.loss_scale,
+                         found_inf)):
+            return
+        scale = float(new_state.loss_scale)
+        prev = float(state.loss_scale)
+        overflow = float(found_inf) > 0
+        grew = scale > prev
+        reg.gauge("amp/loss_scale").set(scale)
+        if overflow:
+            reg.counter("amp/overflow").inc()
+        if grew:
+            reg.counter("amp/scale_window_growth").inc()
+        reg.event("amp", "loss_scale", scale=scale, overflow=overflow,
+                  grew=grew, unskipped=int(new_state.unskipped))
 
     # -- eager/stateful API (reference parity) -----------------------------
     def loss_scale(self):

@@ -40,6 +40,7 @@ from jax import lax
 
 from apex_tpu.parallel import compression
 from apex_tpu.telemetry import comm as _telemetry_comm
+from apex_tpu.telemetry import numerics as _numerics
 from apex_tpu.telemetry import trace as _telemetry_trace
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
 
@@ -76,7 +77,8 @@ class DistributedFusedAdam:
                  compress: bool = False,
                  grad_compress: Optional[str] = None,
                  param_compress: Optional[str] = None,
-                 compress_block_size: int = compression.BLOCK_SIZE):
+                 compress_block_size: int = compression.BLOCK_SIZE,
+                 numerics=None):
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -98,6 +100,18 @@ class DistributedFusedAdam:
         self.grad_compress = grad_compress
         self.param_compress = param_compress
         self.compress_block_size = compress_block_size
+        # In-graph numerics (telemetry/numerics.py): True / an int
+        # grouping depth makes ``step`` return a third element — the
+        # per-module stats of the INCOMING grads (pre-flatten, pre-
+        # compression: the flat ZeRO buffers lose module attribution,
+        # so stats are taken where the module structure still exists).
+        self.numerics = numerics
+
+    def _grad_stats(self, grads):
+        depth = (_numerics.default_prefix_depth() if self.numerics is True
+                 else int(self.numerics))
+        return _numerics.tree_stats(grads, prefix_depth=depth,
+                                    prefix="grads")
 
     def _shard_info(self, params):
         n = _flat_size(params)
@@ -173,6 +187,7 @@ class DistributedFusedAdam:
     def step(self, grads, state, params, *, lr: Optional[float] = None,
              found_inf=None, scale: float = 1.0):
         lr = self.lr if lr is None else lr
+        stats = self._grad_stats(grads) if self.numerics else None
         n, padded, world = self._shard_info(params)
         noop = (jnp.zeros((), jnp.float32) if found_inf is None
                 else jnp.asarray(found_inf, jnp.float32))
@@ -216,4 +231,6 @@ class DistributedFusedAdam:
             # its quantization error instead of feeding it back
             new_state["grad_residual"] = jnp.where(
                 keep, state["grad_residual"], grad_residual)
+        if self.numerics:
+            return new_params, new_state, stats
         return new_params, new_state

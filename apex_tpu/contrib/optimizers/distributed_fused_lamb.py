@@ -24,6 +24,7 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (
 )
 from apex_tpu.parallel import compression
 from apex_tpu.telemetry import comm as _telemetry_comm
+from apex_tpu.telemetry import numerics as _numerics
 from apex_tpu.telemetry import trace as _telemetry_trace
 from apex_tpu.transformer.tensor_parallel.mappings import _axis_size
 
@@ -35,7 +36,8 @@ class DistributedFusedLAMB:
                  clip_after_ar=True, axis_name: str = "dp",
                  compress: bool = False,
                  grad_compress=None, param_compress=None,
-                 compress_block_size: int = compression.BLOCK_SIZE):
+                 compress_block_size: int = compression.BLOCK_SIZE,
+                 numerics=None):
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -59,6 +61,16 @@ class DistributedFusedLAMB:
         self.grad_compress = grad_compress
         self.param_compress = param_compress
         self.compress_block_size = compress_block_size
+        # same contract as DistributedFusedAdam: truthy -> ``step``
+        # returns (params, state, stats) with stats of the incoming
+        # (pre-flatten, pre-compression) grads
+        self.numerics = numerics
+
+    def _grad_stats(self, grads):
+        depth = (_numerics.default_prefix_depth() if self.numerics is True
+                 else int(self.numerics))
+        return _numerics.tree_stats(grads, prefix_depth=depth,
+                                    prefix="grads")
 
     def _layout(self, params):
         leaves = jax.tree_util.tree_leaves(params)
@@ -113,6 +125,7 @@ class DistributedFusedLAMB:
     def step(self, grads, state, params, *, lr: Optional[float] = None,
              found_inf=None, scale: float = 1.0):
         lr = self.lr if lr is None else lr
+        stats = self._grad_stats(grads) if self.numerics else None
         n, padded, world, T, seg = self._layout(params)
         seg_shards = self._shard_segments(seg, padded, world)
         noop = (jnp.zeros((), jnp.float32) if found_inf is None
@@ -221,6 +234,8 @@ class DistributedFusedLAMB:
             # overflow-skipped steps drop the bogus quantization error
             new_state["grad_residual"] = jnp.where(
                 keep, state["grad_residual"], grad_residual)
+        if self.numerics:
+            return new_params, new_state, stats
         return new_params, new_state
 
     # reference-API hooks kept for drop-in use

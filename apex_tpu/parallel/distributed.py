@@ -26,7 +26,32 @@ from apex_tpu import _C
 from apex_tpu.parallel import compression
 from apex_tpu.parallel.compression import init_residual  # noqa: F401
 from apex_tpu.telemetry import comm as _telemetry_comm
+from apex_tpu.telemetry import numerics as _numerics
 from apex_tpu.telemetry import trace as _telemetry_trace
+
+
+def _numerics_depth(numerics):
+    """Resolve the ``numerics=`` knob: True -> env/default grouping
+    depth, an int -> that depth."""
+    return (_numerics.default_prefix_depth() if numerics is True
+            else int(numerics))
+
+
+def _grad_sync_stats(local_grads, synced_grads, numerics):
+    """The two stat groups the DDP ``numerics=`` knob exposes:
+    ``grads/<prefix>`` from the LOCAL PRE-COMPRESSION gradients (an
+    int8 psum can launder a replica's NaN into finite wire garbage, so
+    only the local view sees the true non-finite source — same
+    reasoning as the guard flag) and ``synced/<prefix>`` from the
+    post-collective (dequantized) gradients, so int8 quantization error
+    is directly observable as the dequant-vs-source rms delta per
+    module prefix."""
+    depth = _numerics_depth(numerics)
+    stats = _numerics.tree_stats(local_grads, prefix_depth=depth,
+                                 prefix="grads")
+    stats.update(_numerics.tree_stats(synced_grads, prefix_depth=depth,
+                                      prefix="synced"))
+    return stats
 
 
 def flatten(tensors):
@@ -149,7 +174,7 @@ def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
                          expert_param_predicate=None, expert_axis_name="dp",
                          compress=None,
                          compress_block_size=compression.BLOCK_SIZE,
-                         residual=None):
+                         residual=None, numerics=None):
     """Allreduce a grad pytree over a mesh axis (the DDP hot path).
 
     With expert parallelism (mesh has an 'ep' axis), dense params replicate
@@ -164,7 +189,30 @@ def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
     parallel/compression.py). With ``"int8"`` the return becomes
     ``(grads, residual)`` — carry the residual pytree to the next call
     (``residual=None`` starts from zeros).
+
+    ``numerics=True`` (or an int grouping depth) appends a per-module
+    stats dict as the LAST return element — ``grads/<prefix>`` rows
+    from the local pre-compression gradients, ``synced/<prefix>`` from
+    the post-collective result (telemetry/numerics.py; in-graph, no
+    host callback). Feed it to a
+    :class:`~apex_tpu.telemetry.recorder.FlightRecorder` /
+    ``resilience.guarded_update(stats=...)``.
     """
+    if numerics:
+        out = all_reduce_gradients(
+            grads, axis_name,
+            allreduce_always_fp32=allreduce_always_fp32,
+            gradient_average=gradient_average,
+            gradient_predivide_factor=gradient_predivide_factor,
+            expert_param_predicate=expert_param_predicate,
+            expert_axis_name=expert_axis_name, compress=compress,
+            compress_block_size=compress_block_size, residual=residual)
+        if compress == "int8":
+            synced, new_residual = out
+            return synced, new_residual, _grad_sync_stats(grads, synced,
+                                                          numerics)
+        return out, _grad_sync_stats(grads, out, numerics)
+
     if compress == "int8":
         if residual is None:
             residual = init_residual(grads)
@@ -359,7 +407,8 @@ class DistributedDataParallel:
                  expert_param_predicate: Optional[Callable] = None,
                  expert_axis_name: str = "dp",
                  compress: Optional[str] = None,
-                 compress_block_size: int = compression.BLOCK_SIZE):
+                 compress_block_size: int = compression.BLOCK_SIZE,
+                 numerics=None):
         self.module = module
         self.axis_name = axis_name
         self.message_size = message_size
@@ -380,6 +429,11 @@ class DistributedDataParallel:
         # through the jitted step (donate it like optimizer state).
         self.compress = compress
         self.compress_block_size = compress_block_size
+        # In-graph numerics (telemetry/numerics.py): True / an int
+        # grouping depth makes .sync also return a per-module stats
+        # dict — pre-compression local grads + post-sync (dequantized)
+        # grads, so int8 quantization error shows as a rms delta.
+        self.numerics = numerics
 
     def init_residual(self, grads_or_params):
         """Zero error-feedback state for ``compress="int8"`` (a pytree
@@ -393,7 +447,10 @@ class DistributedDataParallel:
 
         With ``compress="int8"`` returns ``(grads, residual)``; pass the
         previous step's residual in (``None`` starts from zeros — step 0
-        of error feedback)."""
+        of error feedback). With ``numerics=`` set at construction, a
+        per-module stats dict (``grads/*`` pre-compression local,
+        ``synced/*`` post-collective — see ``_grad_sync_stats``) is
+        appended as the last return element, for either sync path."""
         kw = {}
         if self.compress is not None:
             kw = dict(compress=self.compress,
@@ -404,22 +461,31 @@ class DistributedDataParallel:
         # byte counters accumulate underneath via _psum_with_policy
         with _telemetry_trace.span("ddp/sync",
                                    compress=self.compress or "none",
-                                   bucketed=bool(self.message_size)):
+                                   bucketed=bool(self.message_size),
+                                   numerics=bool(self.numerics)):
             if self.message_size:
-                return all_reduce_gradients_bucketed(
+                out = all_reduce_gradients_bucketed(
                     grads, self.axis_name, message_size=self.message_size,
                     allreduce_always_fp32=self.allreduce_always_fp32,
                     gradient_average=self.gradient_average,
                     gradient_predivide_factor=self.gradient_predivide_factor,
                     expert_param_predicate=self.expert_param_predicate,
                     expert_axis_name=self.expert_axis_name, **kw)
-            return all_reduce_gradients(
-                grads, self.axis_name,
-                allreduce_always_fp32=self.allreduce_always_fp32,
-                gradient_average=self.gradient_average,
-                gradient_predivide_factor=self.gradient_predivide_factor,
-                expert_param_predicate=self.expert_param_predicate,
-                expert_axis_name=self.expert_axis_name, **kw)
+            else:
+                out = all_reduce_gradients(
+                    grads, self.axis_name,
+                    allreduce_always_fp32=self.allreduce_always_fp32,
+                    gradient_average=self.gradient_average,
+                    gradient_predivide_factor=self.gradient_predivide_factor,
+                    expert_param_predicate=self.expert_param_predicate,
+                    expert_axis_name=self.expert_axis_name, **kw)
+            if not self.numerics:
+                return out
+            if self.compress == "int8":
+                synced, new_residual = out
+                return synced, new_residual, _grad_sync_stats(
+                    grads, synced, self.numerics)
+            return out, _grad_sync_stats(grads, out, self.numerics)
 
     def __call__(self, fn=None, *args, **kwargs):
         """If constructed around a module/apply fn, call it; DDP on TPU is

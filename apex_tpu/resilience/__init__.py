@@ -6,7 +6,12 @@ Four pillars (docs/resilience.md has the operational tour):
   :func:`guarded_update` skips poisoned optimizer steps in-graph (one
   all-reduced scalar flag, ``jnp.where`` commit, no host sync) and
   :func:`check_guard` escalates to :class:`NonFiniteError` after K
-  consecutive skips.
+  consecutive skips. With a
+  :class:`~apex_tpu.telemetry.recorder.FlightRecorder` attached, the
+  skip/escalation also dumps a ``numerics-postmortem-rank<N>.json``
+  naming the first module prefix that went non-finite
+  (telemetry/numerics.py — per-layer stats, still zero host
+  callbacks).
 - ``checkpoint``    — durability lives in :mod:`apex_tpu.checkpoint`:
   every save writes a ``manifest.json`` (per-leaf shapes/dtypes/crc32 +
   per-file sha256), writes retry with exponential backoff + jitter,

@@ -53,25 +53,47 @@ def nan_step_from_env():
     return int(v) if v not in (None, "") else None
 
 
-def inject_nan(tree, step, nan_step=None):
+def _leaf_path_str(path):
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def inject_nan(tree, step, nan_step=None, path_filter=None):
     """Poison every floating leaf of ``tree`` with NaN when ``step ==
     nan_step`` (jit-native; identity for other steps and when no step
     is armed). ``nan_step=None`` consults the env var; still None means
-    no injection — safe to leave in production step functions."""
+    no injection — safe to leave in production step functions.
+
+    ``path_filter`` targets the fault at a single module: a string is
+    matched as a prefix of each leaf's '/'-joined path (the same path
+    formatting ``telemetry.numerics.tree_stats`` groups by, so the
+    numerics post-mortem can be asserted to name exactly the poisoned
+    module), a callable receives the path string and returns whether to
+    poison. Leaves that don't match pass through untouched."""
     if nan_step is None:
         nan_step = nan_step_from_env()
     if nan_step is None:
         return tree
     step = jnp.asarray(step)
 
-    def poison(leaf):
+    if path_filter is None:
+        def match(path_str):
+            return True
+    elif callable(path_filter):
+        match = path_filter
+    else:
+        def match(path_str, _prefix=str(path_filter)):
+            return path_str.startswith(_prefix)
+
+    def poison(path, leaf):
         leaf = jnp.asarray(leaf)
-        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        if not jnp.issubdtype(leaf.dtype, jnp.floating) \
+                or not match(_leaf_path_str(path)):
             return leaf
         return jnp.where(step == nan_step, jnp.full_like(leaf, jnp.nan),
                          leaf)
 
-    return tree_util.tree_map(poison, tree)
+    return tree_util.tree_map_with_path(poison, tree)
 
 
 @contextlib.contextmanager

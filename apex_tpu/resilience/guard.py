@@ -35,6 +35,16 @@ every N steps), lands the ``guard/steps_skipped`` counter and
 ``guard/consecutive_skips`` gauge in the registry, and raises once the
 skip streak crosses the threshold. The compiled step stays clean — the
 chaos suite asserts no ``callback`` custom-calls in the lowered HLO.
+
+Numerics attribution (telemetry/numerics.py + telemetry/recorder.py):
+pass a :class:`~apex_tpu.telemetry.recorder.FlightRecorder` (plus its
+carry state) to :func:`guarded_update` and every step's per-module
+stats land in the device-side ring — recorded OUTSIDE the skip revert,
+so the poisoned step's stats survive their own skip. On a skipped step
+(and on escalation) :func:`check_guard` fetches the ring once and
+dumps ``numerics-postmortem-rank<N>.json`` naming the first module
+prefix whose non-finite count is > 0 — the "which layer, which step,
+how did it trend" answer a bare ``NonFiniteError`` was missing.
 """
 
 import os
@@ -91,7 +101,8 @@ def nonfinite_flag(tree) -> jnp.ndarray:
 def guarded_update(grads, opt_update: Callable[[Any, Any], Any], state,
                    guard_state: GuardState, *, axis_name=None,
                    flag=None, found_inf=None, scaler=None,
-                   scaler_state=None):
+                   scaler_state=None, recorder=None, recorder_state=None,
+                   stats=None, step=None):
     """Commit ``opt_update(grads, state)`` only when the gradients are
     globally finite; otherwise keep ``state`` bit-identical.
 
@@ -123,9 +134,22 @@ def guarded_update(grads, opt_update: Callable[[Any, Any], Any], state,
         on the *global* flag and its new state is returned third —
         committed even on skipped steps, because backing the loss
         scale off IS the reaction to the overflow.
+      recorder / recorder_state: when both given, this step's
+        per-module stats land in the
+        :class:`~apex_tpu.telemetry.recorder.FlightRecorder` ring and
+        the new ring state is returned LAST. Recording commits
+        unconditionally — the poisoned step's stats are the
+        post-mortem evidence and are never reverted with the state.
+      stats: optional precomputed ``tree_stats(grads, ...)`` dict (the
+        DDP ``numerics=`` knob returns one computed on the local
+        pre-compression grads — prefer it; deriving here sees only
+        what the caller passed as ``grads``).
+      step: optional i32 step number stamped into the ring rows
+        (defaults to the ring's lifetime record count).
 
     Returns ``(new_state, new_guard_state)`` — plus
-    ``new_scaler_state`` when a scaler was supplied.
+    ``new_scaler_state`` when a scaler was supplied, plus
+    ``new_recorder_state`` (always last) when a recorder was supplied.
     """
     with _telemetry_trace.span("guard/update", axis=str(axis_name),
                                scaled=scaler is not None):
@@ -163,18 +187,34 @@ def guarded_update(grads, opt_update: Callable[[Any, Any], Any], state,
             .astype(jnp.int32),
             last_skipped=skip_i,
         )
+        outs = (new_state, new_guard)
         if scaler is not None:
             if scaler_state is None:
                 raise ValueError("guarded_update: scaler given without "
                                  "scaler_state")
-            new_scaler_state = scaler.update(scaler_state, global_flag)
-            return new_state, new_guard, new_scaler_state
-        return new_state, new_guard
+            outs = outs + (scaler.update(scaler_state, global_flag),)
+        if recorder is not None:
+            if recorder_state is None:
+                raise ValueError("guarded_update: recorder given without "
+                                 "recorder_state")
+            if stats is None:
+                from apex_tpu.telemetry import numerics as _numerics
+
+                stats = _numerics.tree_stats(
+                    grads, prefix_depth=recorder.prefix_depth)
+            # unconditional: the ring keeps the poisoned step's stats
+            # whether or not the state commit was reverted above
+            outs = outs + (recorder.record(
+                recorder_state,
+                recorder_state.cursor if step is None else step,
+                stats),)
+        return outs
 
 
 def check_guard(guard_state: GuardState,
                 max_consecutive_skips: Optional[int] = None, *,
-                registry=None) -> int:
+                registry=None, recorder=None, recorder_state=None,
+                postmortem_dir=None) -> int:
     """Host-side escalation + telemetry poll for the guard.
 
     Fetches the three GuardState scalars (the only host sync in the
@@ -184,6 +224,17 @@ def check_guard(guard_state: GuardState,
     :class:`NonFiniteError` once the consecutive-skip streak reaches
     ``max_consecutive_skips`` (default ``$APEX_TPU_GUARD_MAX_SKIPS`` or
     3) — skipping forever just burns a pod on a diverged run.
+
+    When a ``recorder`` + ``recorder_state`` pair (the flight-recorder
+    ring this run's ``guarded_update`` has been feeding) is supplied,
+    a skipped step fetches the ring ONCE and dumps
+    ``numerics-postmortem-rank<N>.json`` into ``postmortem_dir``
+    (default ``$APEX_TPU_NUMERICS_DIR``, else the telemetry JSONL dir,
+    else the CWD), and the escalation error names the first module
+    prefix whose non-finite count went positive — attribution instead
+    of a blind death. The dump costs one device->host transfer of the
+    small ring, and only ever happens on a step that was already
+    skipped.
 
     Returns the current consecutive-skip count.
     """
@@ -205,14 +256,37 @@ def check_guard(guard_state: GuardState,
         if last:
             reg.event("guard", "step_skipped", consecutive=consecutive,
                       total=total)
-    if consecutive >= max_consecutive_skips > 0:
+    escalate = consecutive >= max_consecutive_skips > 0
+    postmortem = None
+    if recorder is not None and recorder_state is not None \
+            and (last or escalate):
+        postmortem = recorder.dump_postmortem(
+            recorder_state, postmortem_dir,
+            reason="escalation" if escalate else "step_skipped",
+            registry=reg,
+            extra={"consecutive_skips": consecutive,
+                   "total_skips": total})
+    if escalate:
         if reg.enabled:
             reg.event("guard", "escalate", consecutive=consecutive,
                       total=total, limit=max_consecutive_skips)
+        culprit = ""
+        if postmortem is not None:
+            prefix = postmortem.get("first_nonfinite_prefix")
+            if prefix:
+                culprit = (
+                    f" Flight record: first non-finite stats in module "
+                    f"prefix '{prefix}' at step "
+                    f"{postmortem.get('first_nonfinite_step')} "
+                    f"(post-mortem: {postmortem.get('path')}).")
+            elif postmortem.get("path"):
+                culprit = (f" Flight record dumped to "
+                           f"{postmortem['path']}.")
         raise NonFiniteError(
             f"{consecutive} consecutive optimizer steps skipped on "
             f"non-finite gradients (limit {max_consecutive_skips}; "
             f"{total} skipped in total) — the run is diverging, not "
             f"hitting one bad batch. Inspect the data pipeline / loss "
-            f"scale; restore from the last verified checkpoint.")
+            f"scale; restore from the last verified checkpoint."
+            + culprit)
     return consecutive

@@ -14,6 +14,15 @@ The observability layer under the parallel/optimizer/bench stack:
 - :mod:`comm`      — measured collective accounting (per-call payload
   dtype/bytes from ``_psum_with_policy`` and the compression paths),
   the measured counterpart to ``compression.estimate_allreduce_bytes``.
+- :mod:`numerics`  — jit-native per-layer gradient/activation stats
+  (:func:`~apex_tpu.telemetry.numerics.tensor_stats` /
+  :func:`~apex_tpu.telemetry.numerics.tree_stats`): norms, zero
+  fraction, non-finite counts, fp16/bf16 under/overflow fractions —
+  computed entirely in-graph.
+- :mod:`recorder`  — :class:`~apex_tpu.telemetry.recorder.FlightRecorder`,
+  a device-side ring buffer of the last K steps' stats, fetched once
+  for a ``numerics-postmortem-rank<N>.json`` when the resilience guard
+  trips.
 
 Everything is host-side: recording inside jitted code happens at trace
 time (once per compilation == once per step of the compiled program)
@@ -41,4 +50,15 @@ from apex_tpu.telemetry.trace import (  # noqa: F401
     stop_profiler_trace,
 )
 from apex_tpu.telemetry import comm  # noqa: F401
+from apex_tpu.telemetry import numerics  # noqa: F401
+from apex_tpu.telemetry import recorder  # noqa: F401
 from apex_tpu.telemetry import xla_cost  # noqa: F401
+from apex_tpu.telemetry.numerics import (  # noqa: F401
+    TensorStats,
+    tensor_stats,
+    tree_stats,
+)
+from apex_tpu.telemetry.recorder import (  # noqa: F401
+    FlightRecorder,
+    RecorderState,
+)

@@ -1,0 +1,200 @@
+"""In-graph numerics: per-tensor / per-module-prefix gradient and
+activation statistics, computed entirely inside the compiled step.
+
+Why in-graph: mixed-precision training fails silently — an fp16/bf16
+under/overflow shows up only as a tripped loss scaler or a NaN loss,
+with no indication of WHICH tensor went bad (the entire rationale for
+dynamic loss scaling in the reference's apex.amp). The resilience
+guard (resilience/guard.py) detects a poisoned step and skips it, but
+detection without attribution still kills the run blind when the skips
+persist. T3 (PAPERS.md) makes the case that fine-grained tracking of
+in-flight tensors must live inside the compiled program, not in host
+callbacks; this module applies that to numerics:
+
+- :func:`tensor_stats` — one compact, fixed pytree of f32 scalars per
+  tensor (:class:`TensorStats`): l2 norm, absmax, rms, zero fraction,
+  non-finite count, and fp16/bf16 under/overflow fractions against the
+  formats' representable ranges. Pure ``jnp`` reductions — no host
+  callback, ever (the tier-1 suite asserts ``"callback" not in`` the
+  lowered HLO of a numerics-enabled step).
+- :func:`tree_stats` — aggregates a grad/activation pytree into
+  per-module-prefix groups (first ``prefix_depth`` components of each
+  leaf path), so a gpt2-sized model yields ~tens of stat rows, not
+  thousands. Group membership is resolved host-side at trace time; the
+  values stay on device.
+
+Norm/fraction stats are computed over the FINITE elements only (non-
+finite values are masked to 0 before the reductions) so the trend
+stays readable right through a blow-up — the poison signal is carried
+by the ``nonfinite`` count, and the step that went bad still reports
+the finite norms it had. An ``inf`` therefore counts as ``nonfinite``,
+not as an fp16/bf16 overflow; the overflow fractions count *finite*
+magnitudes beyond the target format's max.
+
+Stats feed the :class:`~apex_tpu.telemetry.recorder.FlightRecorder`
+ring buffer (the last-K-steps post-mortem story) and the opt-in
+``numerics=`` knobs on ``DistributedDataParallel`` and the ZeRO
+optimizers. Env knob: ``APEX_TPU_NUMERICS_DEPTH`` sets the default
+grouping depth (default 2). See docs/observability.md ("Numerics").
+"""
+
+import os
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+ENV_DEPTH = "APEX_TPU_NUMERICS_DEPTH"
+DEFAULT_PREFIX_DEPTH = 2
+
+# Representable-range thresholds (jnp.finfo values, hard-coded so the
+# thresholds are visible in reviews and never depend on backend float
+# support): largest finite magnitude and smallest positive NORMAL.
+FP16_MAX = 65504.0
+FP16_TINY = 6.103515625e-05          # 2**-14
+BF16_MAX = 3.3895313892515355e+38
+BF16_TINY = 1.1754943508222875e-38   # 2**-126
+
+
+class TensorStats(NamedTuple):
+    """Fixed per-tensor/per-group stats pytree — nine f32 scalars, so a
+    ring buffer of them is K*9 floats per group. Fractions are over the
+    group's total element count; ``nonfinite`` is a count."""
+
+    l2: jnp.ndarray                   # sqrt(sum of squares of finite elems)
+    absmax: jnp.ndarray               # max |finite elem|
+    rms: jnp.ndarray                  # sqrt(mean square of finite elems)
+    zero_frac: jnp.ndarray            # fraction of exact (finite) zeros
+    nonfinite: jnp.ndarray            # COUNT of NaN/Inf elements
+    fp16_overflow_frac: jnp.ndarray   # finite |x| >  FP16_MAX
+    fp16_underflow_frac: jnp.ndarray  # finite 0 < |x| < FP16_TINY
+    bf16_overflow_frac: jnp.ndarray   # finite |x| >  BF16_MAX
+    bf16_underflow_frac: jnp.ndarray  # finite 0 < |x| < BF16_TINY
+
+
+STAT_FIELDS = TensorStats._fields
+
+
+def default_prefix_depth() -> int:
+    return int(os.environ.get(ENV_DEPTH, str(DEFAULT_PREFIX_DEPTH)))
+
+
+def _raw_sums(x) -> Optional[Dict[str, Any]]:
+    """Per-leaf partial sums (group-aggregatable: sums add, maxes max).
+    None for non-inexact leaves — step counters can't be non-finite."""
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.inexact) or x.size == 0:
+        return None
+    xf = x.astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    a = jnp.abs(jnp.where(finite, xf, 0.0))
+    f32 = jnp.float32
+    return {
+        "n": int(x.size),  # static — python int, never a tracer
+        "sumsq": jnp.sum(a * a),
+        "absmax": jnp.max(a),
+        "zeros": jnp.sum(finite & (xf == 0)).astype(f32),
+        "nonfinite": jnp.sum(~finite).astype(f32),
+        "fp16_over": jnp.sum(a > FP16_MAX).astype(f32),
+        "fp16_under": jnp.sum((a > 0) & (a < FP16_TINY)).astype(f32),
+        "bf16_over": jnp.sum(a > BF16_MAX).astype(f32),
+        "bf16_under": jnp.sum((a > 0) & (a < BF16_TINY)).astype(f32),
+    }
+
+
+def _finalize(acc) -> TensorStats:
+    n = float(acc["n"])
+    return TensorStats(
+        l2=jnp.sqrt(acc["sumsq"]),
+        absmax=acc["absmax"],
+        rms=jnp.sqrt(acc["sumsq"] / n),
+        zero_frac=acc["zeros"] / n,
+        nonfinite=acc["nonfinite"],
+        fp16_overflow_frac=acc["fp16_over"] / n,
+        fp16_underflow_frac=acc["fp16_under"] / n,
+        bf16_overflow_frac=acc["bf16_over"] / n,
+        bf16_underflow_frac=acc["bf16_under"] / n,
+    )
+
+
+def tensor_stats(x) -> TensorStats:
+    """:class:`TensorStats` of one array, fully in-graph (jit-safe, no
+    host callback). Raises on non-float input — there is nothing to
+    observe about an int tensor's dynamic range."""
+    raw = _raw_sums(x)
+    if raw is None:
+        raise TypeError(
+            f"tensor_stats: need a floating/complex array, got "
+            f"{jnp.asarray(x).dtype}")
+    return _finalize(raw)
+
+
+def _leaf_path_str(path) -> str:
+    # same formatting as parallel.distributed._leaf_path_str so prefix
+    # groups line up with expert_param_predicate matching
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def group_prefix(path_str: str, prefix_depth: int) -> str:
+    """First ``prefix_depth`` '/'-components of a leaf path — the
+    module-prefix grouping key ("transformer/layer_3/attn/q_proj/w"
+    at depth 2 -> "transformer/layer_3")."""
+    parts = [p for p in path_str.split("/") if p]
+    if not parts:
+        return "<root>"
+    return "/".join(parts[:max(1, int(prefix_depth))])
+
+
+def tree_stats(tree, prefix_depth: Optional[int] = None, *,
+               prefix: Optional[str] = None) -> Dict[str, TensorStats]:
+    """Aggregate a pytree into ``{module_prefix: TensorStats}``.
+
+    Grouping (leaf path -> first ``prefix_depth`` components) happens
+    host-side at trace time; the per-group reductions are in-graph.
+    Non-inexact leaves are skipped. ``prefix`` namespaces every key
+    (``prefix="grads"`` -> ``"grads/<group>"``) so multiple stat sets —
+    e.g. pre-compression gradients vs the dequantized synced gradients
+    — can share one flat dict (and one flight-recorder ring).
+
+    The result is a plain dict: a valid pytree with a FIXED structure
+    for a fixed model, so it can ride through jit as carry state.
+    """
+    if prefix_depth is None:
+        prefix_depth = default_prefix_depth()
+    paths_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    groups: Dict[str, Dict[str, Any]] = {}
+    for path, leaf in paths_leaves:
+        raw = _raw_sums(leaf)
+        if raw is None:
+            continue
+        key = group_prefix(_leaf_path_str(path), prefix_depth)
+        if prefix:
+            key = f"{prefix}/{key}"
+        acc = groups.get(key)
+        if acc is None:
+            groups[key] = raw
+        else:
+            acc["n"] += raw["n"]
+            acc["absmax"] = jnp.maximum(acc["absmax"], raw["absmax"])
+            for k in ("sumsq", "zeros", "nonfinite", "fp16_over",
+                      "fp16_under", "bf16_over", "bf16_under"):
+                acc[k] = acc[k] + raw[k]
+    return {k: _finalize(groups[k]) for k in sorted(groups)}
+
+
+def stats_to_floats(stats) -> Dict[str, Dict[str, float]]:
+    """Host-side: one ``jax.device_get`` of a ``{prefix: TensorStats}``
+    dict into plain nested floats (JSON-ready)."""
+    host = jax.device_get(stats)
+    return {k: {f: float(getattr(v, f)) for f in STAT_FIELDS}
+            for k, v in host.items()}
+
+
+def first_nonfinite_prefix(stats_floats) -> Optional[str]:
+    """First (sorted) module prefix whose non-finite count is > 0 in a
+    host-side stats dict; None when everything is finite."""
+    for k in sorted(stats_floats):
+        if stats_floats[k].get("nonfinite", 0) > 0:
+            return k
+    return None

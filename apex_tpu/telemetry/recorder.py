@@ -1,0 +1,221 @@
+"""Step flight recorder: a device-side ring buffer of per-layer stats.
+
+The post-mortem problem: when ``check_guard`` escalates to
+``NonFiniteError`` after K consecutive skipped steps, the run dies with
+no record of which layer went bad or how its norms trended into the
+blow-up — per-step host transfers of stats would answer it, but at the
+cost of a device->host sync every step, which is exactly what the
+jit-native guard exists to avoid.
+
+The :class:`FlightRecorder` answer: keep the last K steps of
+:func:`~apex_tpu.telemetry.numerics.tree_stats` resident ON DEVICE as a
+stacked ring buffer threaded through the step as carry state (donate it
+with the optimizer state). :meth:`record` is one dynamic-update-slice
+per stat leaf at ``cursor % K`` — no host callback, no transfer, one
+small fixed buffer (K x 9 floats per module prefix). The host fetches
+the ring exactly once, when something already went wrong:
+:meth:`dump_postmortem` writes ``numerics-postmortem-rank<N>.json``
+naming the first module prefix whose non-finite count is > 0, with the
+prior steps' (finite) stat trend alongside — the "which layer, which
+step, how did it trend" answer the guard escalation was missing.
+
+Recording is UNCONDITIONAL by design: ``guarded_update`` records the
+step's stats outside its ``jnp.where`` revert, so the ring contents
+after a skipped step are bit-identical to the committed case — the
+poisoned step's stats are precisely the evidence the post-mortem
+exists to capture, and must never be reverted away with the state.
+
+Env knobs: ``APEX_TPU_NUMERICS_RING`` (ring length, default 8),
+``APEX_TPU_NUMERICS_DIR`` (post-mortem directory; falls back to the
+telemetry JSONL dir, then the CWD). See docs/observability.md.
+"""
+
+import json
+import os
+import time
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.telemetry import numerics
+from apex_tpu.telemetry.registry import _process_index, get_registry
+
+ENV_RING = "APEX_TPU_NUMERICS_RING"
+ENV_DIR = "APEX_TPU_NUMERICS_DIR"
+DEFAULT_RING_LENGTH = 8
+POSTMORTEM_BASENAME = "numerics-postmortem-rank{rank}.json"
+
+
+def default_ring_length() -> int:
+    return int(os.environ.get(ENV_RING, str(DEFAULT_RING_LENGTH)))
+
+
+class RecorderState(NamedTuple):
+    """The device-resident ring (a pytree — donate it through the jitted
+    step like optimizer state)."""
+
+    buffer: Any           # {prefix: TensorStats of (K,) f32 arrays}
+    steps: jnp.ndarray    # (K,) i32 step numbers; -1 = never written
+    cursor: jnp.ndarray   # () i32: lifetime records (next slot = cursor % K)
+
+
+class FlightRecorder:
+    """Ring-buffer policy object (host-side; the state is the pytree).
+
+    ``length`` is the ring capacity K (default
+    ``$APEX_TPU_NUMERICS_RING`` or 8); ``prefix_depth`` is the
+    module-prefix grouping depth used when ``guarded_update`` derives
+    stats itself (default ``$APEX_TPU_NUMERICS_DEPTH`` or 2).
+    """
+
+    def __init__(self, length: Optional[int] = None,
+                 prefix_depth: Optional[int] = None):
+        self.length = default_ring_length() if length is None else int(length)
+        if self.length < 1:
+            raise ValueError(f"FlightRecorder: length must be >= 1, "
+                             f"got {self.length}")
+        self.prefix_depth = (numerics.default_prefix_depth()
+                             if prefix_depth is None else int(prefix_depth))
+        # set by dump_postmortem — lets callers (bench, smoke stages)
+        # find the record check_guard dumped on their behalf
+        self.last_postmortem = None
+
+    # -- state ----------------------------------------------------------
+
+    def init_state(self, tree, prefixes=None) -> RecorderState:
+        """Zeroed ring shaped for ``tree`` — either the grads/params
+        pytree the step will record stats of, or an already-computed
+        ``{prefix: TensorStats}`` dict (e.g. traced once via
+        ``jax.eval_shape`` around the DDP sync). ``prefixes`` mirrors
+        the namespacing the step will record — pass
+        ``("grads", "synced")`` when feeding the ring from
+        ``DistributedDataParallel(numerics=...)``'s stats. Uses
+        ``jax.eval_shape`` so init costs no compute and is
+        trace-safe."""
+        if isinstance(tree, dict) and tree and all(
+                isinstance(v, numerics.TensorStats) for v in tree.values()):
+            shapes = jax.eval_shape(lambda t: t, tree)
+        else:
+            def build(t):
+                if not prefixes:
+                    return numerics.tree_stats(
+                        t, prefix_depth=self.prefix_depth)
+                out = {}
+                for pre in prefixes:
+                    out.update(numerics.tree_stats(
+                        t, prefix_depth=self.prefix_depth, prefix=pre))
+                return out
+
+            shapes = jax.eval_shape(build, tree)
+        buffer = jax.tree_util.tree_map(
+            lambda s: jnp.zeros((self.length,), s.dtype), shapes)
+        return RecorderState(
+            buffer=buffer,
+            steps=jnp.full((self.length,), -1, jnp.int32),
+            cursor=jnp.zeros((), jnp.int32),
+        )
+
+    def record(self, state: RecorderState, step, stats) -> RecorderState:
+        """Write one step's ``{prefix: TensorStats}`` into the ring slot
+        ``cursor % K`` (evicting the oldest entry once full) and advance
+        the cursor. Pure in-graph: one dynamic-update-slice per stat
+        leaf, no host callback — safe inside jit/shard_map."""
+        idx = state.cursor % self.length
+        buffer = jax.tree_util.tree_map(
+            lambda buf, s: buf.at[idx].set(jnp.asarray(s, buf.dtype)),
+            state.buffer, stats)
+        return RecorderState(
+            buffer=buffer,
+            steps=state.steps.at[idx].set(
+                jnp.asarray(step, jnp.int32)),
+            cursor=state.cursor + 1,
+        )
+
+    # -- host side ------------------------------------------------------
+
+    def fetch(self, state: RecorderState):
+        """ONE device->host transfer of the whole ring, unrolled into
+        rows oldest -> newest: ``[{"step": int, "stats": {prefix:
+        {field: float}}}, ...]`` (at most K rows; fewer before the ring
+        fills)."""
+        host = jax.device_get(state)
+        cursor = int(host.cursor)
+        count = min(cursor, self.length)
+        rows = []
+        for j in range(count):
+            i = (cursor - count + j) % self.length
+            rows.append({
+                "step": int(host.steps[i]),
+                "stats": {
+                    prefix: {f: float(getattr(ts, f)[i])
+                             for f in numerics.STAT_FIELDS}
+                    for prefix, ts in host.buffer.items()},
+            })
+        return rows
+
+    @staticmethod
+    def first_nonfinite(rows):
+        """Scan rows oldest -> newest for the first module prefix whose
+        non-finite count is > 0; returns ``(step, prefix)`` or
+        ``(None, None)`` when the whole ring is finite."""
+        for row in rows:
+            prefix = numerics.first_nonfinite_prefix(row["stats"])
+            if prefix is not None:
+                return row["step"], prefix
+        return None, None
+
+    def resolve_dir(self, directory=None, registry=None):
+        if directory:
+            return directory
+        env = os.environ.get(ENV_DIR)
+        if env:
+            return env
+        reg = registry or get_registry()
+        return reg.jsonl_dir or "."
+
+    def dump_postmortem(self, state: RecorderState, directory=None, *,
+                        reason="guard_skip", registry=None, extra=None):
+        """Fetch the ring once and write
+        ``numerics-postmortem-rank<N>.json`` (atomic tmp+rename;
+        overwrites — the newest wreckage is the one that matters).
+        Returns the record dict (with ``"path"``) and remembers it as
+        ``self.last_postmortem``; also lands a ``numerics`` event in
+        the registry when enabled."""
+        rows = self.fetch(state)
+        step, prefix = self.first_nonfinite(rows)
+        rank = _process_index()
+        directory = self.resolve_dir(directory, registry)
+        record = {
+            "t": round(time.time(), 6),
+            "reason": reason,
+            "rank": rank,
+            "ring_length": self.length,
+            "prefix_depth": self.prefix_depth,
+            "first_nonfinite_step": step,
+            "first_nonfinite_prefix": prefix,
+            "rows": rows,
+        }
+        if extra:
+            record.update(extra)
+        path = os.path.join(directory,
+                            POSTMORTEM_BASENAME.format(rank=rank))
+        try:
+            os.makedirs(directory, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f, indent=1)
+            os.replace(tmp, path)
+            record["path"] = path
+        except OSError:
+            # an unwritable post-mortem dir must never mask the
+            # escalation that triggered the dump
+            record["path"] = None
+        reg = registry or get_registry()
+        if reg.enabled:
+            reg.event("numerics", "postmortem", reason=reason,
+                      path=record["path"], rows=len(rows),
+                      first_nonfinite_step=step,
+                      first_nonfinite_prefix=prefix)
+        self.last_postmortem = record
+        return record

@@ -27,6 +27,11 @@
                                            collectives + error feedback;
                                            emits comm_bytes_per_step
                                            (int8 vs fp32)
+    python bench.py ddp_numerics [batch] [steps]  guarded DDP step with
+                                           in-graph per-layer stats +
+                                           flight-recorder ring; emits
+                                           numerics_overhead_pct vs the
+                                           numerics-off step
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
@@ -1203,6 +1208,159 @@ def bench_ddp_resilience(batch, steps, *, hidden=256, depth=2,
             "nan_step": nan_step}
 
 
+def bench_ddp_numerics(batch, steps, *, hidden=256, depth=2,
+                       nan_step=None, ring=8):
+    """DDP training with the full numerics-observability spine: per-
+    layer in-graph stats on the local pre-compression grads + the
+    dequantized synced grads (``DistributedDataParallel(numerics=1)``),
+    a device-side :class:`~apex_tpu.telemetry.recorder.FlightRecorder`
+    ring of the last ``ring`` steps threaded through the guarded step,
+    and ``check_guard`` dumping ``numerics-postmortem-rank<N>.json``
+    when a NaN injection (``nan_step`` / ``$APEX_TPU_FAULT_NAN_STEP``,
+    targeted at the LAST layer only via ``inject_nan``'s path filter)
+    trips the guard.
+
+    The headline number is ``numerics_overhead_pct``: the timed-loop
+    cost of stats+ring versus the identical guarded int8 DDP step with
+    numerics off — the price of always-on per-layer observability.
+    Timing excludes compiles (both variants warm first); the post-
+    mortem dump (one small host fetch, only on an already-skipped
+    step) stays inside the loop because that IS the integration under
+    measurement.
+
+    Returns ``{"steps_skipped", "final_loss", "nan_step",
+    "numerics_overhead_pct", "postmortem_path",
+    "first_nonfinite_prefix"}`` for the oneproc numerics smoke stage.
+    """
+    from apex_tpu import resilience
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.resilience import faults
+    from apex_tpu.telemetry import span
+    from apex_tpu.telemetry.recorder import FlightRecorder
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    if nan_step is None:
+        nan_step = faults.nan_step_from_env()
+    target_prefix = f"layer{depth - 1}"
+    rng = np.random.RandomState(0)
+    params = {}
+    for i in range(depth):
+        params[f"layer{i}"] = {
+            "w": jnp.asarray(rng.randn(hidden, hidden).astype(np.float32)
+                             / np.sqrt(hidden)),
+            "b": jnp.zeros((hidden,), jnp.float32),
+        }
+    x = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+
+    def loss_fn(p, xb, yb):
+        h = xb
+        for i in range(depth):
+            lyr = p[f"layer{i}"]
+            h = jnp.tanh(h @ lyr["w"] + lyr["b"])
+        return jnp.mean((h - yb) ** 2)
+
+    def make_step(numerics_on):
+        ddp = DistributedDataParallel(
+            axis_name="dp", compress="int8",
+            numerics=1 if numerics_on else None)
+        rec = FlightRecorder(length=ring, prefix_depth=1) \
+            if numerics_on else None
+
+        def step_fn(p, res, gst, rstate, step, xb, yb):
+            loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+            grads = faults.inject_nan(grads, step, nan_step,
+                                      path_filter=target_prefix)
+            flag = resilience.nonfinite_flag(grads)
+            if numerics_on:
+                synced, new_res, stats = ddp.sync(grads, res)
+            else:
+                synced, new_res = ddp.sync(grads, res)
+
+            def commit(g, st):
+                prev_p, _ = st
+                new_p = jax.tree_util.tree_map(
+                    lambda w, gg: w - 0.05 * gg, prev_p, g)
+                return (new_p, new_res)
+
+            if numerics_on:
+                (p, res), gst, rstate = resilience.guarded_update(
+                    synced, commit, (p, res), gst, axis_name="dp",
+                    flag=flag, recorder=rec, recorder_state=rstate,
+                    stats=stats, step=step)
+            else:
+                (p, res), gst = resilience.guarded_update(
+                    synced, commit, (p, res), gst, axis_name="dp",
+                    flag=flag)
+            return p, res, gst, rstate, loss
+
+        sharded = jax.shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P(), P(), P()), check_vma=False)
+
+        @jax.jit
+        def train_step(p, res, gst, rstate, step):
+            return sharded(p, res, gst, rstate, step, x, y)
+
+        return ddp, rec, train_step
+
+    ddp_base, _, base_step = make_step(False)
+    ddp_num, rec, num_step = make_step(True)
+    rstate0 = rec.init_state(params, prefixes=("grads", "synced"))
+
+    def run(train_step, ddp, rstate, label, with_recorder):
+        p = params
+        res = ddp.init_residual(params)
+        gst = resilience.init_guard_state()
+        # warm: compile + one steady step, outside the timed window
+        p, res, gst, rstate, loss = train_step(
+            p, res, gst, rstate, jnp.asarray(-2, jnp.int32))
+        float(loss)
+        with span(f"bench/timed_loop_{label}", steps=steps):
+            t0 = time.perf_counter()
+            for i in range(steps):
+                p, res, gst, rstate, loss = train_step(
+                    p, res, gst, rstate, jnp.asarray(i, jnp.int32))
+                resilience.check_guard(
+                    gst, max_consecutive_skips=steps + 1,
+                    recorder=rec if with_recorder else None,
+                    recorder_state=rstate if with_recorder else None)
+            final_loss = float(loss)
+            dt = time.perf_counter() - t0
+        return dt, final_loss, gst
+
+    _measure_step_cost(num_step, (params, ddp_num.init_residual(params),
+                                  resilience.init_guard_state(), rstate0,
+                                  jnp.zeros((), jnp.int32)))
+    dt_base, _, _ = run(base_step, ddp_base, rstate0, "plain", False)
+    dt_num, final_loss, gst = run(num_step, ddp_num, rstate0, "numerics",
+                                  True)
+    overhead_pct = (dt_num - dt_base) / dt_base * 100.0
+    skipped = int(gst.total_skips)
+    pm = rec.last_postmortem
+    first_prefix = pm["first_nonfinite_prefix"] if pm else None
+
+    n = _tree_size(params)
+    fields = _comm_fields(params, compress="int8")
+    flops = 6 * batch * world * depth * hidden * hidden
+    _emit("ddp_numerics_steps_per_sec", steps / dt_num, "steps/sec",
+          flops, steps, dt_num, dp_world=world, grad_elements=n,
+          steps_skipped=skipped, nan_step=nan_step,
+          final_loss=final_loss,
+          numerics_overhead_pct=round(overhead_pct, 2),
+          numerics_ring=ring,
+          first_nonfinite_prefix=first_prefix, **fields)
+    return {"steps_skipped": skipped, "final_loss": final_loss,
+            "nan_step": nan_step,
+            "numerics_overhead_pct": round(overhead_pct, 2),
+            "postmortem_path": pm["path"] if pm else None,
+            "first_nonfinite_prefix": first_prefix}
+
+
 # The canonical (size, steps) per bench — the ONLY place these defaults
 # live; both the CLI dispatch below and the one-process capture plan
 # (tools/oneproc_capture.py) read them, so a tuning change (like resnet
@@ -1224,6 +1382,7 @@ BENCH_SPECS = {
     "resnet": ((256, 50), bench_resnet),
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
     "ddp_resilience": ((32, 12), bench_ddp_resilience),
+    "ddp_numerics": ((32, 12), bench_ddp_numerics),
 }
 
 

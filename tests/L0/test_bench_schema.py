@@ -63,6 +63,31 @@ def test_bench_error_contract_by_round():
     assert schema.check_metric_line(err, round_n=6, errors=[]) == []
 
 
+def test_numerics_overhead_gated_at_round9():
+    """ISSUE 4 satellite: numerics_overhead_pct (the ddp_numerics
+    field) is defined from round 9 — older records carrying it are
+    flagged, newer ones must hold a number or null."""
+    line = {"metric": "ddp_numerics_steps_per_sec", "value": 1.0,
+            "unit": "steps/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 10,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "numerics_overhead_pct": 3.2}
+    assert schema.check_metric_line(dict(line), round_n=9, errors=[]) == []
+    msgs = schema.check_metric_line(dict(line), round_n=8, errors=[])
+    assert any("numerics_overhead_pct" in m for m in msgs)
+    # absent stays valid at every round
+    del line["numerics_overhead_pct"]
+    assert schema.check_metric_line(dict(line), round_n=8, errors=[]) == []
+    # type enforcement from round 9
+    line["numerics_overhead_pct"] = "fast"
+    msgs = schema.check_metric_line(dict(line), round_n=9, errors=[])
+    assert any("must be numeric or null" in m for m in msgs)
+    line["numerics_overhead_pct"] = None
+    assert schema.check_metric_line(dict(line), round_n=9, errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-7 (current)
     metric-line contract — telemetry fields included."""

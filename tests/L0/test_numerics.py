@@ -1,0 +1,744 @@
+"""In-graph numerics observability (ISSUE 4): per-layer stats, the
+flight-recorder ring, guard-trip post-mortems, and the amp/report
+satellites.
+
+The acceptance story covered here end-to-end: an 8-device DDP run with
+``inject_nan`` targeting ONE module at step N trips the guard, and the
+dumped flight record identifies that module prefix as the first
+non-finite source with the prior K-1 steps' stats finite — while the
+lowered HLO of the numerics-enabled step contains no host callbacks
+(the same ``"callback" not in`` assertion as test_telemetry /
+test_resilience).
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import resilience
+from apex_tpu.parallel import DistributedDataParallel, distributed
+from apex_tpu.resilience import faults
+from apex_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    numerics,
+    use_registry,
+)
+
+ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# tensor_stats
+# ---------------------------------------------------------------------------
+
+def test_tensor_stats_known_values():
+    x = jnp.asarray([3.0, -4.0, 0.0, 0.0])
+    s = numerics.tensor_stats(x)
+    assert float(s.l2) == pytest.approx(5.0)
+    assert float(s.rms) == pytest.approx(2.5)
+    assert float(s.absmax) == 4.0
+    assert float(s.zero_frac) == 0.5
+    assert float(s.nonfinite) == 0.0
+    for f in ("fp16_overflow_frac", "fp16_underflow_frac",
+              "bf16_overflow_frac", "bf16_underflow_frac"):
+        assert float(getattr(s, f)) == 0.0
+
+
+def test_tensor_stats_range_fractions():
+    """fp16/bf16 thresholds: 1e5 overflows fp16 only, 1e-6 underflows
+    fp16 only; both are comfortably inside bf16's range (bf16 shares
+    fp32's exponent range, so bf16 under/overflow of an fp32 tensor
+    only fires on fp32-subnormal/huge values — and XLA CPU flushes
+    subnormals, so they are not assertable portably)."""
+    s = numerics.tensor_stats(jnp.asarray([1e5, 1e-6, 1.0, 1.0]))
+    assert float(s.fp16_overflow_frac) == pytest.approx(0.25)
+    assert float(s.fp16_underflow_frac) == pytest.approx(0.25)
+    assert float(s.bf16_overflow_frac) == 0.0
+    assert float(s.bf16_underflow_frac) == 0.0
+
+
+def test_tensor_stats_nonfinite_masked_but_counted():
+    """NaN/Inf carry the signal through ``nonfinite``; the norm stats
+    stay finite (masked) so the trend survives the blow-up. An inf is
+    nonfinite, NOT an fp16/bf16 overflow."""
+    s = numerics.tensor_stats(
+        jnp.asarray([1.0, jnp.nan, jnp.inf, -jnp.inf]))
+    assert float(s.nonfinite) == 3.0
+    assert float(s.l2) == pytest.approx(1.0)
+    assert float(s.absmax) == 1.0
+    assert float(s.fp16_overflow_frac) == 0.0
+    assert float(s.bf16_overflow_frac) == 0.0
+    assert np.isfinite([float(getattr(s, f))
+                        for f in numerics.STAT_FIELDS]).all()
+
+
+def test_tensor_stats_rejects_int():
+    with pytest.raises(TypeError, match="floating"):
+        numerics.tensor_stats(jnp.arange(4))
+
+
+def test_tensor_stats_under_jit_no_callback():
+    f = jax.jit(lambda x: numerics.tensor_stats(x))
+    s = f(jnp.asarray([1.0, 2.0]))
+    assert float(s.l2) == pytest.approx(np.sqrt(5.0))
+    assert "callback" not in f.lower(jnp.ones((8,))).as_text()
+
+
+# ---------------------------------------------------------------------------
+# tree_stats grouping
+# ---------------------------------------------------------------------------
+
+def _two_layer_tree(poison=None):
+    tree = {
+        "layer0": {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))},
+        "layer1": {"w": jnp.full((4, 4), 2.0), "b": jnp.zeros((4,))},
+        "step": jnp.asarray(7),  # int leaf: skipped
+    }
+    if poison:
+        tree[poison]["w"] = jnp.full((4, 4), jnp.nan)
+    return tree
+
+
+def test_tree_stats_groups_by_prefix_depth():
+    st1 = numerics.tree_stats(_two_layer_tree(), prefix_depth=1)
+    assert sorted(st1) == ["layer0", "layer1"]
+    # depth 2: w and b split out, int step leaf still skipped
+    st2 = numerics.tree_stats(_two_layer_tree(), prefix_depth=2)
+    assert sorted(st2) == ["layer0/b", "layer0/w",
+                           "layer1/b", "layer1/w"]
+    # group aggregation: layer0 = 16 ones + 4 zeros
+    s = st1["layer0"]
+    assert float(s.l2) == pytest.approx(4.0)
+    assert float(s.zero_frac) == pytest.approx(4 / 20)
+    assert float(s.absmax) == 1.0
+
+
+def test_tree_stats_prefix_namespace_and_env_depth(monkeypatch):
+    st = numerics.tree_stats(_two_layer_tree(), prefix_depth=1,
+                             prefix="grads")
+    assert sorted(st) == ["grads/layer0", "grads/layer1"]
+    monkeypatch.setenv(numerics.ENV_DEPTH, "1")
+    assert sorted(numerics.tree_stats(_two_layer_tree())) == \
+        ["layer0", "layer1"]
+
+
+def test_first_nonfinite_prefix_sorted_order():
+    st = numerics.stats_to_floats(
+        numerics.tree_stats(_two_layer_tree(poison="layer1"),
+                            prefix_depth=1))
+    assert numerics.first_nonfinite_prefix(st) == "layer1"
+    st_clean = numerics.stats_to_floats(
+        numerics.tree_stats(_two_layer_tree(), prefix_depth=1))
+    assert numerics.first_nonfinite_prefix(st_clean) is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder ring semantics
+# ---------------------------------------------------------------------------
+
+def _stats_for(v, nan=False):
+    leaf = jnp.full((4,), jnp.nan if nan else float(v))
+    return numerics.tree_stats({"m": {"w": leaf}}, prefix_depth=1)
+
+
+def test_ring_exact_length_and_eviction_order():
+    rec = FlightRecorder(length=4, prefix_depth=1)
+    state = rec.init_state({"m": {"w": jnp.zeros((4,))}})
+    assert rec.fetch(state) == []  # empty ring: no rows
+    for i in range(3):
+        state = rec.record(state, i, _stats_for(i))
+    rows = rec.fetch(state)
+    assert [r["step"] for r in rows] == [0, 1, 2]  # partial fill
+    for i in range(3, 7):
+        state = rec.record(state, i, _stats_for(i))
+    rows = rec.fetch(state)
+    # exactly K rows, oldest evicted, oldest -> newest order
+    assert [r["step"] for r in rows] == [3, 4, 5, 6]
+    assert [r["stats"]["m"]["absmax"] for r in rows] == [3, 4, 5, 6]
+
+
+def test_ring_first_nonfinite_and_prior_rows_finite():
+    rec = FlightRecorder(length=8, prefix_depth=1)
+    state = rec.init_state({"m": {"w": jnp.zeros((4,))}})
+    for i in range(5):
+        state = rec.record(state, i, _stats_for(i, nan=(i == 3)))
+    rows = rec.fetch(state)
+    assert rec.first_nonfinite(rows) == (3, "m")
+    for r in rows[:3]:
+        assert r["stats"]["m"]["nonfinite"] == 0.0
+    clean = rec.fetch(rec.record(
+        rec.init_state({"m": {"w": jnp.zeros((4,))}}), 0, _stats_for(1)))
+    assert rec.first_nonfinite(clean) == (None, None)
+
+
+def test_ring_record_under_jit_with_traced_cursor():
+    rec = FlightRecorder(length=3, prefix_depth=1)
+
+    @jax.jit
+    def push(state, step, v):
+        return rec.record(state, step, numerics.tree_stats(
+            {"m": {"w": jnp.full((4,), v)}}, prefix_depth=1))
+
+    state = rec.init_state({"m": {"w": jnp.zeros((4,))}})
+    for i in range(5):
+        state = push(state, jnp.asarray(i, jnp.int32),
+                     jnp.asarray(float(i)))
+    assert [r["step"] for r in rec.fetch(state)] == [2, 3, 4]
+    text = push.lower(state, jnp.zeros((), jnp.int32),
+                      jnp.zeros(())).as_text()
+    assert "callback" not in text
+
+
+def test_ring_init_from_stats_dict_and_prefixes():
+    rec = FlightRecorder(length=2, prefix_depth=1)
+    tree = {"m": {"w": jnp.zeros((4,))}}
+    by_prefixes = rec.init_state(tree, prefixes=("grads", "synced"))
+    assert sorted(by_prefixes.buffer) == ["grads/m", "synced/m"]
+    stats = numerics.tree_stats(tree, prefix_depth=1, prefix="grads")
+    stats.update(numerics.tree_stats(tree, prefix_depth=1,
+                                     prefix="synced"))
+    from_stats = rec.init_state(stats)
+    assert sorted(from_stats.buffer) == ["grads/m", "synced/m"]
+
+
+def test_ring_rejects_zero_length():
+    with pytest.raises(ValueError, match="length"):
+        FlightRecorder(length=0)
+
+
+def test_ring_env_length(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_NUMERICS_RING", "5")
+    assert FlightRecorder().length == 5
+
+
+# ---------------------------------------------------------------------------
+# guard integration: recording survives the skip, post-mortems dump
+# ---------------------------------------------------------------------------
+
+def _sgd(lr=0.1):
+    def update(grads, params):
+        return jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                      params, grads)
+    return update
+
+
+def test_ring_contents_bit_identical_skipped_or_not():
+    """The satellite contract: guarded_update records OUTSIDE the skip
+    revert, so after the same grad sequence the ring is bit-identical
+    whether steps were guarded (and one skipped) or recorded
+    manually."""
+    grads_seq = [
+        {"m": {"w": jnp.full((4,), 1.0)}},
+        {"m": {"w": jnp.full((4,), jnp.nan)}},   # skipped
+        {"m": {"w": jnp.full((4,), 3.0)}},
+    ]
+    rec = FlightRecorder(length=4, prefix_depth=1)
+    params = {"m": {"w": jnp.ones((4,))}}
+
+    guarded = rec.init_state(params)
+    gst = resilience.init_guard_state()
+    p = params
+    for i, g in enumerate(grads_seq):
+        p, gst, guarded = resilience.guarded_update(
+            g, _sgd(), p, gst, recorder=rec, recorder_state=guarded,
+            step=i)
+    assert int(gst.total_skips) == 1
+
+    manual = rec.init_state(params)
+    for i, g in enumerate(grads_seq):
+        manual = rec.record(manual, i,
+                            numerics.tree_stats(g, prefix_depth=1))
+
+    for a, b in zip(jax.tree_util.tree_leaves(guarded),
+                    jax.tree_util.tree_leaves(manual)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_guarded_update_recorder_arity_and_validation():
+    from apex_tpu.amp.scaler import LossScaler
+
+    params = {"m": {"w": jnp.ones((4,))}}
+    rec = FlightRecorder(length=2, prefix_depth=1)
+    rstate = rec.init_state(params)
+    gst = resilience.init_guard_state()
+    grads = {"m": {"w": jnp.full((4,), 2.0)}}
+
+    out = resilience.guarded_update(grads, _sgd(), params, gst,
+                                    recorder=rec, recorder_state=rstate)
+    assert len(out) == 3  # state, guard, recorder_state
+    assert int(out[2].cursor) == 1
+
+    scaler = LossScaler("dynamic", init_scale=8.0)
+    out = resilience.guarded_update(
+        grads, _sgd(), params, gst, scaler=scaler,
+        scaler_state=scaler.init_state(), recorder=rec,
+        recorder_state=rstate)
+    assert len(out) == 4  # + scaler_state third, recorder LAST
+    assert isinstance(out[3], type(rstate))
+
+    with pytest.raises(ValueError, match="recorder_state"):
+        resilience.guarded_update(grads, _sgd(), params, gst,
+                                  recorder=rec)
+
+
+def test_check_guard_dumps_postmortem_and_names_prefix(tmp_path):
+    rec = FlightRecorder(length=4, prefix_depth=1)
+    params = {"good": {"w": jnp.ones((4,))},
+              "bad": {"w": jnp.ones((4,))}}
+    rstate = rec.init_state(params)
+    gst = resilience.init_guard_state()
+    for i, poison in enumerate([False, False, True]):
+        grads = {"good": {"w": jnp.full((4,), 1.0)},
+                 "bad": {"w": jnp.full((4,), jnp.nan if poison else 1.0)}}
+        params, gst, rstate = resilience.guarded_update(
+            grads, _sgd(), params, gst, recorder=rec,
+            recorder_state=rstate, step=i)
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        resilience.check_guard(gst, max_consecutive_skips=10,
+                               recorder=rec, recorder_state=rstate,
+                               postmortem_dir=str(tmp_path))
+    pm_path = tmp_path / "numerics-postmortem-rank0.json"
+    assert pm_path.exists()
+    pm = json.loads(pm_path.read_text())
+    assert pm["reason"] == "step_skipped"
+    assert pm["first_nonfinite_prefix"] == "bad"
+    assert pm["first_nonfinite_step"] == 2
+    assert len(pm["rows"]) == 3
+    # prior rows finite in every group
+    for row in pm["rows"][:2]:
+        for stats in row["stats"].values():
+            assert stats["nonfinite"] == 0.0
+    assert rec.last_postmortem["path"] == str(pm_path)
+
+
+def test_check_guard_escalation_names_layer(tmp_path):
+    from apex_tpu.resilience import NonFiniteError
+
+    rec = FlightRecorder(length=4, prefix_depth=1)
+    params = {"layerX": {"w": jnp.ones((4,))}}
+    rstate = rec.init_state(params)
+    gst = resilience.init_guard_state()
+    for i in range(3):
+        params, gst, rstate = resilience.guarded_update(
+            {"layerX": {"w": jnp.full((4,), jnp.nan)}}, _sgd(), params,
+            gst, recorder=rec, recorder_state=rstate, step=i)
+    with pytest.raises(NonFiniteError, match="layerX"):
+        resilience.check_guard(gst, max_consecutive_skips=3,
+                               recorder=rec, recorder_state=rstate,
+                               postmortem_dir=str(tmp_path))
+    pm = json.loads(
+        (tmp_path / "numerics-postmortem-rank0.json").read_text())
+    assert pm["reason"] == "escalation"
+
+
+def test_check_guard_without_recorder_unchanged():
+    """Regression: the recorder is opt-in; the bare API and return
+    stay as before."""
+    gst = resilience.init_guard_state()
+    assert resilience.check_guard(gst, max_consecutive_skips=3) == 0
+
+
+# ---------------------------------------------------------------------------
+# targeted fault injection
+# ---------------------------------------------------------------------------
+
+def test_inject_nan_path_filter_targets_one_module():
+    tree = {"layer0": {"w": jnp.ones((3,))},
+            "layer1": {"w": jnp.ones((3,))}}
+    out = faults.inject_nan(tree, jnp.asarray(2), 2,
+                            path_filter="layer1")
+    np.testing.assert_array_equal(out["layer0"]["w"], 1.0)
+    assert np.all(np.isnan(out["layer1"]["w"]))
+    # other steps: identity everywhere
+    out = faults.inject_nan(tree, jnp.asarray(1), 2,
+                            path_filter="layer1")
+    assert not np.any(np.isnan(out["layer1"]["w"]))
+    # callable filter
+    out = faults.inject_nan(tree, jnp.asarray(2), 2,
+                            path_filter=lambda p: p.endswith("0/w"))
+    assert np.all(np.isnan(out["layer0"]["w"]))
+    np.testing.assert_array_equal(out["layer1"]["w"], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# DDP / ZeRO wiring
+# ---------------------------------------------------------------------------
+
+def _grads_tree():
+    return {"layer0": {"w": jnp.ones((512,))},
+            "layer1": {"w": jnp.full((512,), 2.0)}}
+
+
+@pytest.mark.multi_device
+@pytest.mark.parametrize("message_size", [None, 64])
+def test_ddp_sync_numerics_int8_returns_stats(dp_mesh, message_size):
+    """Both sync paths (per-leaf and bucketed) append the stats dict:
+    grads/* from the local pre-compression grads, synced/* from the
+    dequantized result — the quantization error shows as an rms
+    delta."""
+    mesh = dp_mesh(8)
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8",
+                                  numerics=1, message_size=message_size)
+    grads = _grads_tree()
+    res = ddp.init_residual(grads)
+
+    def f(g, r):
+        synced, new_r, stats = ddp.sync(g, r)
+        return synced, new_r, stats
+
+    synced, new_r, stats = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P(), P()),
+        check_vma=False))(grads, res)
+    assert sorted(stats) == ["grads/layer0", "grads/layer1",
+                             "synced/layer0", "synced/layer1"]
+    assert float(stats["grads/layer1"].rms) == pytest.approx(2.0)
+    # dequant-vs-source rms delta: small but measurable quantization
+    # error on the synced side
+    delta = abs(float(stats["synced/layer1"].rms)
+                - float(stats["grads/layer1"].rms))
+    assert delta < 0.05
+
+
+def test_all_reduce_gradients_numerics_no_compress():
+    out, stats = distributed.all_reduce_gradients(
+        _grads_tree(), (), numerics=1)
+    assert sorted(stats) == ["grads/layer0", "grads/layer1",
+                             "synced/layer0", "synced/layer1"]
+    np.testing.assert_array_equal(out["layer0"]["w"],
+                                  _grads_tree()["layer0"]["w"])
+    assert float(stats["synced/layer0"].rms) == pytest.approx(1.0)
+
+
+@pytest.mark.multi_device
+@pytest.mark.parametrize("opt_name", ["adam", "lamb"])
+def test_zero_optimizer_numerics_stats(dp_mesh, opt_name):
+    """The ZeRO optimizers return pre-flatten grad stats third when
+    numerics= is set (trace-only through the real optimizer)."""
+    from apex_tpu.contrib.optimizers import (
+        DistributedFusedAdam,
+        DistributedFusedLAMB,
+    )
+
+    mesh = dp_mesh(8)
+    cls = DistributedFusedAdam if opt_name == "adam" \
+        else DistributedFusedLAMB
+    opt = cls(lr=1e-3, axis_name="dp", numerics=1)
+
+    def f(params, grads):
+        state = opt.init(params)
+        new_p, _, stats = opt.step(grads, state, params)
+        return new_p, stats
+
+    tree = {"enc": {"w": jnp.zeros((1024,), jnp.float32)}}
+    jitted = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        check_vma=False))
+    assert "callback" not in jitted.lower(tree, tree).as_text()
+    _, stats = jitted(tree, tree)
+    assert sorted(stats) == ["grads/enc"]
+    assert float(stats["grads/enc"].zero_frac) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance: 8-device DDP, targeted NaN, post-mortem
+# ---------------------------------------------------------------------------
+
+def _make_numerics_ddp_step(mesh, hidden, nan_step, rec, target):
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8",
+                                  numerics=1)
+
+    def loss_fn(p, xb, yb):
+        h = jnp.tanh(xb @ p["layer0"]["w"])
+        h = h @ p["layer1"]["w"]
+        return jnp.mean((h - yb) ** 2)
+
+    def step_fn(p, res, gst, rstate, step, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        grads = faults.inject_nan(grads, step, nan_step,
+                                  path_filter=target)
+        flag = resilience.nonfinite_flag(grads)
+        synced, new_res, stats = ddp.sync(grads, res)
+
+        def commit(g, st):
+            prev_p, _ = st
+            new_p = jax.tree_util.tree_map(
+                lambda w, gg: w - 0.05 * gg, prev_p, g)
+            return (new_p, new_res)
+
+        (p, res), gst, rstate = resilience.guarded_update(
+            synced, commit, (p, res), gst, axis_name="dp", flag=flag,
+            recorder=rec, recorder_state=rstate, stats=stats, step=step)
+        return p, res, gst, rstate, loss
+
+    sharded = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P(), P(), P()), check_vma=False)
+    return ddp, jax.jit(sharded)
+
+
+@pytest.mark.multi_device
+def test_e2e_postmortem_identifies_poisoned_module(dp_mesh, tmp_path):
+    """ISSUE 4 acceptance: NaN targeted at layer1 at step 5 of an
+    8-device guarded DDP run -> guard trips (exactly one skip), the
+    flight record names grads/layer1 as the first non-finite source,
+    the prior K-1 ring rows are finite, and the lowered HLO has no
+    host callbacks."""
+    mesh = dp_mesh(8)
+    hidden, batch, steps, nan_step = 16, 8, 6, 5
+    rec = FlightRecorder(length=4, prefix_depth=1)
+    ddp, train = _make_numerics_ddp_step(mesh, hidden, nan_step, rec,
+                                         "layer1")
+    rng = np.random.RandomState(0)
+    params = {f"layer{i}": {"w": jnp.asarray(
+        rng.randn(hidden, hidden).astype(np.float32) / np.sqrt(hidden))}
+        for i in range(2)}
+    x = jnp.asarray(rng.randn(batch, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch, hidden).astype(np.float32))
+    res = ddp.init_residual(params)
+    gst = resilience.init_guard_state()
+    rstate = rec.init_state(params, prefixes=("grads", "synced"))
+
+    text = train.lower(params, res, gst, rstate,
+                       jnp.zeros((), jnp.int32), x, y).as_text()
+    assert "callback" not in text
+
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        for i in range(steps):
+            params, res, gst, rstate, loss = train(
+                params, res, gst, rstate, jnp.asarray(i, jnp.int32),
+                x, y)
+            resilience.check_guard(gst, max_consecutive_skips=steps + 1,
+                                   recorder=rec, recorder_state=rstate,
+                                   postmortem_dir=str(tmp_path))
+    assert int(gst.total_skips) == 1
+    assert np.isfinite(float(loss))
+    assert reg.snapshot()["counters"]["guard/steps_skipped"] == 1
+
+    pm = json.loads(
+        (tmp_path / "numerics-postmortem-rank0.json").read_text())
+    assert pm["first_nonfinite_prefix"] == "grads/layer1"
+    assert pm["first_nonfinite_step"] == nan_step
+    # ring of length 4 after 6 steps: rows 2..5, the first K-1 finite
+    assert [r["step"] for r in pm["rows"]] == [2, 3, 4, 5]
+    for row in pm["rows"][:-1]:
+        for stats in row["stats"].values():
+            assert stats["nonfinite"] == 0.0
+    # the untouched module never went non-finite, even on the bad step
+    assert pm["rows"][-1]["stats"]["grads/layer0"]["nonfinite"] == 0.0
+    assert pm["rows"][-1]["stats"]["grads/layer1"]["nonfinite"] > 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: LossScaler telemetry
+# ---------------------------------------------------------------------------
+
+def test_loss_scaler_update_records_amp_metrics(tmp_path):
+    from apex_tpu.amp.scaler import LossScaler
+
+    reg = MetricsRegistry(jsonl_dir=str(tmp_path))
+    scaler = LossScaler("dynamic", init_scale=8.0, scale_factor=2.0,
+                        scale_window=2)
+    with use_registry(reg):
+        state = scaler.init_state()
+        state = scaler.update(state, jnp.asarray(1.0))   # overflow: 8->4
+        state = scaler.update(state, jnp.asarray(0.0))
+        state = scaler.update(state, jnp.asarray(0.0))   # window: 4->8
+    snap = reg.snapshot()
+    assert snap["gauges"]["amp/loss_scale"] == 8.0
+    assert snap["counters"]["amp/overflow"] == 1
+    assert snap["counters"]["amp/scale_window_growth"] == 1
+    events = []
+    for f in tmp_path.glob("*.jsonl"):
+        events.extend(json.loads(l) for l in f.read_text().splitlines())
+    amp_ev = [e for e in events if e["kind"] == "amp"]
+    assert len(amp_ev) == 3
+    assert amp_ev[0]["overflow"] is True and amp_ev[0]["scale"] == 4.0
+    assert amp_ev[2]["grew"] is True
+
+
+def test_loss_scaler_disabled_registry_records_nothing():
+    from apex_tpu.amp.scaler import LossScaler
+
+    reg = MetricsRegistry()  # disabled
+    scaler = LossScaler("dynamic", init_scale=8.0)
+    with use_registry(reg):
+        scaler.update(scaler.init_state(), jnp.asarray(1.0))
+    assert reg.snapshot() == {"counters": {}, "gauges": {},
+                              "histograms": {}}
+
+
+def test_loss_scaler_update_lowering_identical_and_callback_free():
+    """The regression the satellite asks for: telemetry adds no host
+    callback to the lowered update — the HLO is identical whether the
+    registry is enabled or disabled (recording under tracing is
+    skipped entirely)."""
+    from apex_tpu.amp.scaler import LossScaler
+
+    scaler = LossScaler("dynamic", init_scale=8.0)
+    state = scaler.init_state()
+
+    def lowered_text(registry):
+        with use_registry(registry):
+            return jax.jit(scaler.update).lower(
+                state, jnp.zeros(())).as_text()
+
+    off = lowered_text(MetricsRegistry())
+    on = lowered_text(MetricsRegistry(enabled=True))
+    assert "callback" not in on
+    assert on == off
+
+
+def test_loss_scaler_static_mode_update_untouched():
+    from apex_tpu.amp.scaler import LossScaler
+
+    reg = MetricsRegistry(enabled=True)
+    scaler = LossScaler(128.0)  # static
+    with use_registry(reg):
+        state = scaler.init_state()
+        assert scaler.update(state, jnp.asarray(1.0)) is state
+    assert reg.snapshot()["gauges"] == {}
+
+
+# ---------------------------------------------------------------------------
+# satellite: telemetry_report forward compat + new kinds
+# ---------------------------------------------------------------------------
+
+def _report_module():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.remove(os.path.join(ROOT, "tools"))
+    return telemetry_report
+
+
+def test_telemetry_report_skips_unknown_kinds_with_footer(capsys):
+    rep = _report_module()
+    events = [
+        ("r0", {"kind": "span", "name": "a", "duration_s": 0.5}),
+        ("r0", {"kind": "from_the_future", "name": "x"}),
+        ("r0", {"kind": "from_the_future", "name": "y"}),
+        ("r0", {"kind": "hologram"}),
+        ("r0", {"kind": "collective", "name": "psum",
+                "wire_bytes": "not-a-number"}),  # malformed, not fatal
+    ]
+    report = rep.aggregate(events)
+    assert report["events"] == 5
+    assert report["unknown_kinds"] == {"from_the_future": 2,
+                                       "hologram": 1}
+    assert report["malformed_events"] == 1
+    assert report["spans"]["a"]["count"] == 1
+    rep.print_report(report, out=sys.stdout)
+    out = capsys.readouterr().out
+    assert "skipped 4 event(s)" in out
+    assert "from_the_future: 2" in out
+
+
+def test_telemetry_report_aggregates_numerics_and_amp(capsys):
+    rep = _report_module()
+    events = [
+        ("r0", {"kind": "amp", "name": "loss_scale", "scale": 4.0,
+                "overflow": True, "grew": False}),
+        ("r0", {"kind": "amp", "name": "loss_scale", "scale": 8.0,
+                "overflow": False, "grew": True}),
+        ("r0", {"kind": "numerics", "name": "postmortem",
+                "reason": "step_skipped", "path": "/tmp/pm.json",
+                "first_nonfinite_prefix": "grads/layer1",
+                "first_nonfinite_step": 5}),
+        ("r0", {"kind": "guard", "name": "step_skipped"}),
+    ]
+    report = rep.aggregate(events)
+    assert report["amp"] == {"updates": 2, "overflows": 1, "growths": 1,
+                             "last_loss_scale": 8.0}
+    assert report["numerics"]["postmortems"][0][
+        "first_nonfinite_prefix"] == "grads/layer1"
+    assert report["guard"]["skips"] == 1
+    assert report["unknown_kinds"] == {}
+    rep.print_report(report, out=sys.stdout)
+    out = capsys.readouterr().out
+    assert "grads/layer1" in out
+    assert "last loss_scale = 8.0" in out
+
+
+# ---------------------------------------------------------------------------
+# tools/numerics_report renderer
+# ---------------------------------------------------------------------------
+
+def test_numerics_report_renders_postmortem(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import numerics_report
+    finally:
+        sys.path.remove(os.path.join(ROOT, "tools"))
+
+    rec = FlightRecorder(length=3, prefix_depth=1)
+    state = rec.init_state({"m": {"w": jnp.zeros((4,))}})
+    for i in range(3):
+        state = rec.record(state, i, _stats_for(i, nan=(i == 2)))
+    rec.dump_postmortem(state, str(tmp_path), reason="unit")
+
+    assert numerics_report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "FIRST NON-FINITE: module prefix 'm' at step 2" in out
+    assert "m:" in out
+
+    assert numerics_report.main(["--json", str(tmp_path)]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["postmortems"][0]["first_nonfinite_prefix"] == "m"
+    assert [r["step"] for r in data["postmortems"][0]["rows"]] == \
+        [0, 1, 2]
+
+    # nothing found -> exit 1, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert numerics_report.main([str(empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# bench config (tiny, CPU): emission + post-mortem + overhead field
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multi_device
+def test_bench_ddp_numerics_emits_and_dumps(monkeypatch, tmp_path,
+                                            capsys):
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(ROOT)
+
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry.registry import ENV_DIR
+
+    tel_dir = tmp_path / "tel"
+    monkeypatch.setenv(ENV_DIR, str(tel_dir))
+    prev = telemetry.set_registry(None)  # force re-resolution from env
+    try:
+        ret = bench.bench_ddp_numerics(2, 5, hidden=32, depth=2,
+                                       nan_step=3, ring=4)
+    finally:
+        telemetry.set_registry(prev)
+
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["metric"] == "ddp_numerics_steps_per_sec"
+    assert isinstance(line["numerics_overhead_pct"], float)
+    assert line["steps_skipped"] == 1
+    assert line["first_nonfinite_prefix"] == "grads/layer1"
+    assert ret["postmortem_path"] and os.path.exists(
+        ret["postmortem_path"])
+    pm = json.loads(open(ret["postmortem_path"]).read())
+    assert pm["first_nonfinite_prefix"] == "grads/layer1"
+    assert pm["first_nonfinite_step"] == 3
+    # the post-mortem landed in the telemetry dir (no explicit dir set)
+    assert os.path.dirname(ret["postmortem_path"]) == str(tel_dir)

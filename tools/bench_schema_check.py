@@ -45,6 +45,11 @@ TELEMETRY_FIELDS_SINCE_ROUND = 7
 # without it, and a pre-round-8 record carrying it is flagged (the
 # field did not exist yet)
 STEPS_SKIPPED_SINCE_ROUND = 8
+# the numerics capture contract: numerics_overhead_pct (cost of the
+# in-graph per-layer stats + flight-recorder ring vs the numerics-off
+# step) is an OPTIONAL field defined from round 9 — only ddp_numerics
+# emits it; same gating discipline as steps_skipped
+NUMERICS_OVERHEAD_SINCE_ROUND = 9
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -114,6 +119,14 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                           and obj["steps_skipped"] >= 0)):
                 bad("steps_skipped must be a non-negative integer or "
                     "null")
+        if "numerics_overhead_pct" in obj:
+            if (round_n is not None
+                    and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):
+                bad(f"numerics_overhead_pct is only defined from round "
+                    f"{NUMERICS_OVERHEAD_SINCE_ROUND}")
+            elif not (obj["numerics_overhead_pct"] is None
+                      or _type_ok(obj["numerics_overhead_pct"], _NUM)):
+                bad("numerics_overhead_pct must be numeric or null")
     if errors is None and own:
         raise ValueError("; ".join(own))
     return own

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Render numerics flight records: per-layer stat trends from
+``numerics-postmortem-rank<N>.json`` dumps and/or the ``numerics``
+events in a telemetry JSONL directory.
+
+The post-mortem (written by ``check_guard`` when the resilience guard
+skips a step with a flight recorder attached — see
+docs/observability.md "Numerics") holds the last K steps of per-module
+stats. This tool turns it into the table you actually read at 3am:
+one trend block per module prefix, oldest step first, with the first
+non-finite source called out at the top.
+
+    python tools/numerics_report.py /tmp/tel
+    python tools/numerics_report.py numerics-postmortem-rank0.json
+    python tools/numerics_report.py --json /tmp/tel | jq .
+
+Directories are scanned for both ``numerics-postmortem-*.json`` and
+``telemetry-rank*.jsonl`` (for ``kind == "numerics"`` pointer events);
+explicit file paths are classified by name. Exit code 1 when nothing
+parseable was found.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# columns: (header, stats field, format)
+_COLUMNS = (
+    ("l2", "l2", "{:>10.3e}"),
+    ("rms", "rms", "{:>10.3e}"),
+    ("absmax", "absmax", "{:>10.3e}"),
+    ("zero%", "zero_frac", "{:>7.1%}"),
+    ("nonfin", "nonfinite", "{:>7.0f}"),
+    ("f16ov%", "fp16_overflow_frac", "{:>7.2%}"),
+    ("f16un%", "fp16_underflow_frac", "{:>7.2%}"),
+    ("bf16ov%", "bf16_overflow_frac", "{:>8.2%}"),
+)
+
+
+def collect_paths(args):
+    postmortems, jsonls = [], []
+    for a in args:
+        if os.path.isdir(a):
+            postmortems.extend(sorted(glob.glob(
+                os.path.join(a, "numerics-postmortem-*.json"))))
+            jsonls.extend(sorted(glob.glob(
+                os.path.join(a, "telemetry-rank*.jsonl"))))
+        elif a.endswith(".jsonl"):
+            jsonls.append(a)
+        else:
+            postmortems.append(a)
+    return postmortems, jsonls
+
+
+def load_postmortem(path):
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"numerics_report: unreadable {path} ({e})",
+              file=sys.stderr)
+        return None
+    if not isinstance(record, dict) or "rows" not in record:
+        print(f"numerics_report: {path} is not a numerics post-mortem",
+              file=sys.stderr)
+        return None
+    record.setdefault("path", path)
+    return record
+
+
+def load_numerics_events(paths):
+    """``kind == "numerics"`` events from telemetry JSONL files —
+    pointers to dumped post-mortems, in write order."""
+    events = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue  # torn line from a crashed writer
+                    if ev.get("kind") == "numerics":
+                        events.append(ev)
+        except OSError:
+            continue
+    return events
+
+
+def trend_table(record):
+    """``{prefix: [ {step, <field>: float...}, ... ]}`` oldest-first —
+    the per-layer trend the post-mortem rows encode column-wise."""
+    trends = {}
+    for row in record.get("rows", []):
+        for prefix, stats in sorted(row.get("stats", {}).items()):
+            trends.setdefault(prefix, []).append(
+                dict(stats, step=row.get("step")))
+    return trends
+
+
+def print_postmortem(record, out=sys.stdout):
+    w = out.write
+    w(f"post-mortem {record.get('path')}\n")
+    w(f"  reason={record.get('reason')} rank={record.get('rank')} "
+      f"ring={record.get('ring_length')} "
+      f"rows={len(record.get('rows', []))}\n")
+    prefix = record.get("first_nonfinite_prefix")
+    if prefix:
+        w(f"  FIRST NON-FINITE: module prefix '{prefix}' at step "
+          f"{record.get('first_nonfinite_step')}\n")
+    else:
+        w("  no non-finite stats in the ring\n")
+    for pfx, rows in trend_table(record).items():
+        w(f"\n  {pfx}:\n")
+        w("    " + f"{'step':>6} " +
+          " ".join(f"{h:>{len(fmt.format(0))}}"
+                   for h, _, fmt in _COLUMNS) + "\n")
+        for r in rows:
+            cells = []
+            for _, field, fmt in _COLUMNS:
+                v = r.get(field)
+                cells.append(fmt.format(v) if v is not None
+                             else f"{'-':>7}")
+            w(f"    {r.get('step', '?'):>6} " + " ".join(cells) + "\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=[os.environ.get("APEX_TPU_NUMERICS_DIR")
+                             or os.environ.get("APEX_TPU_TELEMETRY_DIR")
+                             or "."],
+                    help="post-mortem JSONs, telemetry .jsonl files, "
+                         "or directories holding either")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON")
+    args = ap.parse_args(argv)
+    pm_paths, jsonl_paths = collect_paths(args.paths)
+    records = [r for r in (load_postmortem(p) for p in pm_paths) if r]
+    events = load_numerics_events(jsonl_paths)
+    if not records and not events:
+        print("numerics_report: no post-mortems or numerics events "
+              "found", file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump({
+            "postmortems": [dict(r, trends=trend_table(r))
+                            for r in records],
+            "events": events,
+        }, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    for record in records:
+        print_postmortem(record)
+    if events:
+        print(f"\n{len(events)} numerics event(s) in telemetry JSONL:")
+        for ev in events:
+            print(f"  [{ev.get('reason')}] "
+                  f"prefix={ev.get('first_nonfinite_prefix')} "
+                  f"step={ev.get('first_nonfinite_step')} "
+                  f"-> {ev.get('path')}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `numerics_report ... | head` closing the pipe is not an error
+        sys.exit(0)

@@ -210,6 +210,66 @@ def _resilience_smoke(bench):
             "guard_events": len(guard_events)}
 
 
+def _numerics_smoke(bench):
+    """Numerics post-mortem smoke: run ``ddp_numerics`` with a NaN
+    injected at step 3 (targeted at the last layer) and assert (a) the
+    ``numerics-postmortem-rank<N>.json`` landed and names a non-empty
+    module prefix, (b) the guard still recorded exactly one skipped
+    step in the telemetry JSONL, (c) the final loss stayed finite.
+    Raises on any missing piece so the stage shows up as ERROR rather
+    than silently passing."""
+    import glob
+    import math
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_numerics_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        ret = bench.bench_ddp_numerics(4, 6, hidden=64, depth=2,
+                                       nan_step=3)
+    finally:
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+    path = ret["postmortem_path"]
+    if not path or not os.path.exists(path):
+        raise RuntimeError("numerics smoke: no post-mortem JSON landed "
+                           f"({path!r})")
+    with open(path) as f:
+        pm = json.load(f)
+    if not pm.get("first_nonfinite_prefix"):
+        raise RuntimeError("numerics smoke: post-mortem names no "
+                           "non-finite module prefix")
+    if ret["steps_skipped"] != 1:
+        raise RuntimeError("numerics smoke: expected exactly 1 skipped "
+                           f"step, got {ret['steps_skipped']}")
+    if not math.isfinite(ret["final_loss"]):
+        raise RuntimeError("numerics smoke: final loss is non-finite "
+                           f"({ret['final_loss']})")
+    events = []
+    for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(p) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    summaries = [e for e in events if e["kind"] == "summary"]
+    if not summaries:
+        raise RuntimeError("numerics smoke: no summary event landed")
+    skipped = summaries[-1]["counters"].get("guard/steps_skipped")
+    if skipped != 1:
+        raise RuntimeError("numerics smoke: guard/steps_skipped == "
+                           f"{skipped} in the JSONL summary, wanted 1")
+    if not [e for e in events if e["kind"] == "numerics"]:
+        raise RuntimeError("numerics smoke: no numerics events landed")
+    return {"telemetry_dir": tel_dir, "postmortem": path,
+            "first_nonfinite_prefix": pm["first_nonfinite_prefix"],
+            "steps_skipped": skipped,
+            "numerics_overhead_pct": ret["numerics_overhead_pct"]}
+
+
 def _stages(smoke):
     import bench
 
@@ -228,6 +288,7 @@ def _stages(smoke):
              lambda: bench.bench_ddp_compressed(8, 2)),
             ("telemetry", None, lambda: _telemetry_smoke(bench)),
             ("resilience", None, lambda: _resilience_smoke(bench)),
+            ("numerics", None, lambda: _numerics_smoke(bench)),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -275,6 +336,12 @@ def _stages(smoke):
         # guard fires (and stays skip-exact) on real hardware
         ("ddp_resilience", None, spec("ddp_resilience")),
         ("resilience", None, lambda: _resilience_smoke(bench)),
+        # round-9 numerics captures: the numerics-enabled guarded DDP
+        # config (numerics_overhead_pct = the cost of always-on
+        # per-layer stats + flight recorder) and the post-mortem chaos
+        # smoke proving a targeted NaN is attributed to its module
+        ("ddp_numerics", None, spec("ddp_numerics")),
+        ("numerics", None, lambda: _numerics_smoke(bench)),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

@@ -33,40 +33,89 @@ def load_events(paths):
                     continue  # a torn line from a crashed writer
 
 
+# every kind this report understands; anything else is skipped and
+# counted in the footer (forward compat: a newer writer must never
+# crash an older reader — and previously an unknown kind vanished
+# silently, which is almost as bad)
+KNOWN_KINDS = frozenset({
+    "span", "collective", "bench", "summary", "profiler", "xla_cost",
+    "guard", "checkpoint", "preemption", "numerics", "amp",
+})
+
+
 def aggregate(events):
-    """Fold the event stream into one report dict."""
+    """Fold the event stream into one report dict. Unknown ``kind``
+    values — and rows malformed enough to throw mid-fold — are skipped
+    and counted, never fatal."""
     spans = {}
     collectives = {}
     benches = []
     profiler = []
+    numerics = {"events": 0, "postmortems": []}
+    amp = {"updates": 0, "overflows": 0, "growths": 0,
+           "last_loss_scale": None}
+    guard = {"skips": 0, "escalations": 0}
     last_summary = None
     n_events = 0
+    unknown = {}
+    malformed = 0
     for _, ev in events:
         n_events += 1
         kind = ev.get("kind")
-        if kind == "span":
-            s = spans.setdefault(ev.get("name", "?"), {
-                "count": 0, "total_s": 0.0, "max_s": 0.0})
-            d = float(ev.get("duration_s") or 0.0)
-            s["count"] += 1
-            s["total_s"] += d
-            s["max_s"] = max(s["max_s"], d)
-        elif kind == "collective":
-            key = (ev.get("name", "?"), ev.get("dtype", "?"))
-            c = collectives.setdefault(key, {
-                "calls": 0, "wire_bytes": 0, "elements": 0})
-            c["calls"] += 1
-            c["wire_bytes"] += int(ev.get("wire_bytes") or 0)
-            c["elements"] += int(ev.get("elements") or 0)
-        elif kind == "bench":
-            benches.append({k: ev.get(k)
-                            for k in ("name", "value", "unit", "steps",
-                                      "seconds")})
-        elif kind == "summary":
-            last_summary = ev
-        elif kind == "profiler":
-            profiler.append({"event": ev.get("name"),
-                             "logdir": ev.get("logdir")})
+        try:
+            if kind == "span":
+                s = spans.setdefault(ev.get("name", "?"), {
+                    "count": 0, "total_s": 0.0, "max_s": 0.0})
+                d = float(ev.get("duration_s") or 0.0)
+                s["count"] += 1
+                s["total_s"] += d
+                s["max_s"] = max(s["max_s"], d)
+            elif kind == "collective":
+                key = (ev.get("name", "?"), ev.get("dtype", "?"))
+                c = collectives.setdefault(key, {
+                    "calls": 0, "wire_bytes": 0, "elements": 0})
+                c["calls"] += 1
+                c["wire_bytes"] += int(ev.get("wire_bytes") or 0)
+                c["elements"] += int(ev.get("elements") or 0)
+            elif kind == "bench":
+                benches.append({k: ev.get(k)
+                                for k in ("name", "value", "unit", "steps",
+                                          "seconds")})
+            elif kind == "summary":
+                last_summary = ev
+            elif kind == "profiler":
+                profiler.append({"event": ev.get("name"),
+                                 "logdir": ev.get("logdir")})
+            elif kind == "numerics":
+                numerics["events"] += 1
+                if ev.get("name") == "postmortem":
+                    numerics["postmortems"].append({
+                        "reason": ev.get("reason"),
+                        "path": ev.get("path"),
+                        "first_nonfinite_prefix":
+                            ev.get("first_nonfinite_prefix"),
+                        "first_nonfinite_step":
+                            ev.get("first_nonfinite_step"),
+                    })
+            elif kind == "amp":
+                amp["updates"] += 1
+                if ev.get("overflow"):
+                    amp["overflows"] += 1
+                if ev.get("grew"):
+                    amp["growths"] += 1
+                if ev.get("scale") is not None:
+                    amp["last_loss_scale"] = float(ev["scale"])
+            elif kind == "guard":
+                if ev.get("name") == "step_skipped":
+                    guard["skips"] += 1
+                elif ev.get("name") == "escalate":
+                    guard["escalations"] += 1
+            elif kind in KNOWN_KINDS:
+                pass  # known but needs no aggregation (checkpoint, ...)
+            else:
+                unknown[str(kind)] = unknown.get(str(kind), 0) + 1
+        except (TypeError, ValueError, KeyError):
+            malformed += 1
     return {
         "events": n_events,
         "spans": {name: dict(s, mean_s=(s["total_s"] / s["count"])
@@ -76,6 +125,11 @@ def aggregate(events):
                         for (op, dtype), c in collectives.items()},
         "benches": benches,
         "profiler": profiler,
+        "numerics": numerics,
+        "amp": amp,
+        "guard": guard,
+        "unknown_kinds": unknown,
+        "malformed_events": malformed,
         "counters": (last_summary or {}).get("counters", {}),
         "gauges": (last_summary or {}).get("gauges", {}),
         "histograms": (last_summary or {}).get("histograms", {}),
@@ -125,6 +179,32 @@ def print_report(report, out=sys.stdout):
             shown = _fmt_bytes(val) if name.endswith("_bytes") or \
                 name.endswith("/bytes") else val
             w(f"  {name} = {shown}\n")
+    amp = report.get("amp") or {}
+    if amp.get("updates"):
+        w(f"\namp: {amp['updates']} scale updates, "
+          f"{amp['overflows']} overflow(s), {amp['growths']} window "
+          f"growth(s), last loss_scale = {amp['last_loss_scale']}\n")
+    numerics = report.get("numerics") or {}
+    if numerics.get("events"):
+        w(f"\nnumerics: {numerics['events']} event(s)\n")
+        for pm in numerics.get("postmortems", []):
+            w(f"  postmortem [{pm.get('reason')}] first non-finite "
+              f"prefix: {pm.get('first_nonfinite_prefix') or '<none>'} "
+              f"(step {pm.get('first_nonfinite_step')}) -> "
+              f"{pm.get('path')}\n")
+    guard = report.get("guard") or {}
+    if guard.get("skips") or guard.get("escalations"):
+        w(f"\nguard: {guard['skips']} skipped step(s), "
+          f"{guard['escalations']} escalation(s)\n")
+    unknown = report.get("unknown_kinds") or {}
+    skipped = sum(unknown.values()) + report.get("malformed_events", 0)
+    if skipped:
+        detail = ", ".join(f"{k}: {n}" for k, n in sorted(unknown.items()))
+        if report.get("malformed_events"):
+            detail = (detail + ", " if detail else "") + \
+                f"malformed: {report['malformed_events']}"
+        w(f"\nskipped {skipped} event(s) this report does not "
+          f"understand ({detail})\n")
 
 
 def collect_paths(args):
