@@ -1,5 +1,5 @@
 """Opt-in persistent XLA compilation cache (one switch for tests, the
-driver dryrun and local tooling).
+driver dryrun and local tooling) — with hit/miss observability.
 
 Compile time dominates the L0 suite and the multichip dryrun on slow
 hosts; a warm cache cuts serial wall-clock substantially. Off by default:
@@ -7,15 +7,70 @@ XLA:CPU AOT reload can log machine-feature-mismatch errors when the cache
 dir migrates across heterogeneous hosts. Enable on a fixed host with e.g.
 
     APEX_TPU_COMPILE_CACHE=/tmp/apex_tpu_jit_cache pytest tests/L0 -q
+
+Enabling also installs ``jax.monitoring`` listeners for the persistent
+cache's hit/miss events, so :func:`cache_stats` (and the
+``compile_cache/hits`` / ``compile_cache/misses`` telemetry counters)
+answer "is the cache actually warm?" — a cache that silently misses
+every compile (key drift across jax versions, an evicted dir) costs the
+full compile time while looking enabled.
 """
 
 import os
+import threading
+
+_ENV_CACHE = "APEX_TPU_COMPILE_CACHE"
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_STATS_LOCK = threading.Lock()
+_STATS = {"hits": 0, "misses": 0}
+_LISTENER_INSTALLED = False
+
+
+def _on_cache_event(event, **kwargs):
+    if event == _HIT_EVENT:
+        key = "hits"
+    elif event == _MISS_EVENT:
+        key = "misses"
+    else:
+        return
+    with _STATS_LOCK:
+        _STATS[key] += 1
+    from apex_tpu.telemetry.registry import get_registry
+
+    reg = get_registry()
+    if reg.enabled:
+        reg.counter(f"compile_cache/{key}").inc()
+
+
+def install_cache_counters() -> None:
+    """Register the (one, idempotent) monitoring listener feeding
+    :func:`cache_stats`. jax offers no per-listener removal, so this
+    registers once per process; the listener is a counter bump."""
+    global _LISTENER_INSTALLED
+    with _STATS_LOCK:
+        if _LISTENER_INSTALLED:
+            return
+        _LISTENER_INSTALLED = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_listener(_on_cache_event)
+
+
+def cache_stats() -> dict:
+    """``{"hits", "misses"}`` persistent-cache lookups observed since
+    :func:`install_cache_counters` ran (0/0 before — counting starts
+    when the cache is enabled)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
 
 
 def maybe_enable_compile_cache(min_compile_secs: float = 0.5) -> bool:
     """Point jax at $APEX_TPU_COMPILE_CACHE if set. Returns True when
     enabled. Call before the first compilation."""
-    cache_dir = os.environ.get("APEX_TPU_COMPILE_CACHE", "")
+    cache_dir = os.environ.get(_ENV_CACHE, "")
     if not cache_dir:
         return False
     import jax
@@ -23,4 +78,15 @@ def maybe_enable_compile_cache(min_compile_secs: float = 0.5) -> bool:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
+    # jax caches its "is the cache used?" decision once per task; if
+    # anything compiled before we set the dir, that decision is a
+    # permanent False. Reset it (best-effort, private API) so enabling
+    # mid-process actually enables.
+    try:
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:
+        pass
+    install_cache_counters()
     return True

@@ -21,6 +21,7 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     _flat_size,
     _flatten_f32,
     _unflatten_like,
+    zero_state_bytes,
 )
 from apex_tpu.parallel import compression
 from apex_tpu.telemetry import comm as _telemetry_comm
@@ -71,6 +72,22 @@ class DistributedFusedLAMB:
                  else int(self.numerics))
         return _numerics.tree_stats(grads, prefix_depth=depth,
                                     prefix="grads")
+
+    def state_bytes(self, params, *, world=None, registry=None,
+                    record=True):
+        """Per-device sharded vs unsharded optimizer-state bytes for
+        ``params`` at ``world``-way ZeRO sharding (default: the bound
+        axis size, or 1 outside shard_map — pass ``world=`` host-side).
+        See :func:`~apex_tpu.contrib.optimizers.distributed_fused_adam.
+        zero_state_bytes`."""
+        if world is None:
+            world = _axis_size(self.axis_name)
+        return zero_state_bytes(
+            params, world=world, grad_compress=self.grad_compress,
+            param_compress=self.param_compress,
+            block_size=self.compress_block_size,
+            axis_name=self.axis_name, optimizer="DistributedFusedLAMB",
+            registry=registry, record=record)
 
     def _layout(self, params):
         leaves = jax.tree_util.tree_leaves(params)

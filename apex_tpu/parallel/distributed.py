@@ -440,6 +440,23 @@ class DistributedDataParallel:
         shaped like the grads; donate it through the train step)."""
         return init_residual(grads_or_params)
 
+    def memory_report(self, jitted_step, *args, **kwargs):
+        """HBM accounting for the jitted step this DDP instance syncs
+        inside (``telemetry.memory.step_memory`` — XLA's own
+        ``memory_analysis()`` -> argument/output/temp bytes, peak, and
+        the ``memory/hbm_headroom`` gauge), tagged with the sync
+        config: the int8 payload trades wire bytes for quantization
+        temps, and this is where that trade shows up as bytes. Host-
+        side AOT only — never dispatches the step. Returns the report
+        dict (None when the backend offers no analysis)."""
+        from apex_tpu.telemetry import memory as _memory
+
+        report = _memory.step_memory(jitted_step, *args, **kwargs)
+        if report is not None:
+            report = dict(report, compress=self.compress or "none",
+                          axis_name=str(self.axis_name))
+        return report
+
     def sync(self, grads, residual=None):
         """Bucketed grad allreduce honoring ``message_size`` (reference
         create_hooks bucketing); pass ``message_size=None`` at construction

@@ -21,8 +21,16 @@ Four pillars (docs/resilience.md has the operational tour):
 - :mod:`preemption` — :class:`PreemptionGuard` turns SIGTERM/SIGINT
   into a pollable checkpoint-now flag plus one final synchronous save.
 - :mod:`faults`     — deterministic, env/API-gated injectors (NaN at
-  step N, partial/torn checkpoint writes, byte corruption, simulated
-  SIGTERM) powering the tests/L0/test_resilience.py chaos suite.
+  step N, synthetic RESOURCE_EXHAUSTED at step N, partial/torn
+  checkpoint writes, byte corruption, simulated SIGTERM) powering the
+  tests/L0/test_resilience.py chaos suite.
+
+OOM joins NaN as a post-mortem-producing failure: wrap the step
+dispatch in :func:`guarded_call` (or ``telemetry.memory.oom_guard``)
+and a RESOURCE_EXHAUSTED writes ``memory-postmortem-rank<N>.json``
+(live-buffer census + headroom trend — telemetry/memory.py) before
+re-raising as :class:`HBMExhaustedError`, the way :func:`check_guard`
+turns persistent NaN skips into an attributed :class:`NonFiniteError`.
 """
 
 from apex_tpu.resilience import faults  # noqa: F401
@@ -31,8 +39,10 @@ from apex_tpu.resilience.guard import (  # noqa: F401
     GuardState,
     NonFiniteError,
     check_guard,
+    guarded_call,
     guarded_update,
     init_guard_state,
     nonfinite_flag,
 )
 from apex_tpu.resilience.preemption import PreemptionGuard  # noqa: F401
+from apex_tpu.telemetry.memory import HBMExhaustedError  # noqa: F401

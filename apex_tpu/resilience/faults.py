@@ -10,12 +10,21 @@ escape hatches for end-to-end drills from the bench/capture drivers:
   :func:`inject_nan` with ``nan_step=None``.
 - ``APEX_TPU_FAULT_CKPT_WRITE_FAILURES=<n>`` — default failure count
   for :func:`failing_checkpoint_writes`.
+- ``APEX_TPU_FAULT_ALLOC_STEP=<n>`` — :func:`alloc_step_from_env`,
+  read by ``bench.bench_ddp_memwatch`` and anything else calling
+  :func:`inject_alloc_failure` with ``alloc_step=None``.
 
 Injector catalogue:
 
 - :func:`inject_nan` — jit-native NaN poisoning of a grad pytree at
   one chosen step (a ``jnp.where`` on the step counter; compiles into
   the step, costs one select when armed, is the identity when not).
+- :func:`inject_alloc_failure` — host-side synthetic
+  ``RESOURCE_EXHAUSTED`` at one chosen step (a real HBM exhaustion is
+  raised by the runtime at dispatch, so the injector fires on the host
+  just before it), making the OOM post-mortem path
+  (``telemetry.memory.oom_guard`` / ``resilience.guarded_call``)
+  testable on CPU — the allocation sibling of :func:`inject_nan`.
 - :func:`failing_checkpoint_writes` — the next N checkpoint writes die
   after flushing a few real payload bytes into the temp location
   (transient disk/FS failure; nothing lands, exercising the retry path
@@ -40,6 +49,7 @@ from jax import tree_util
 
 ENV_NAN_STEP = "APEX_TPU_FAULT_NAN_STEP"
 ENV_CKPT_WRITE_FAILURES = "APEX_TPU_FAULT_CKPT_WRITE_FAILURES"
+ENV_ALLOC_STEP = "APEX_TPU_FAULT_ALLOC_STEP"
 
 
 class FaultInjected(OSError):
@@ -47,10 +57,40 @@ class FaultInjected(OSError):
     real failure in test assertions."""
 
 
+class SyntheticResourceExhausted(FaultInjected):
+    """Injected allocation failure. The message carries the literal
+    ``RESOURCE_EXHAUSTED`` marker so ``telemetry.memory.is_oom_error``
+    treats it exactly like the XLA runtime error it stands in for."""
+
+
 def nan_step_from_env():
     """The step to poison per ``$APEX_TPU_FAULT_NAN_STEP``, or None."""
     v = os.environ.get(ENV_NAN_STEP)
     return int(v) if v not in (None, "") else None
+
+
+def alloc_step_from_env():
+    """The step to OOM per ``$APEX_TPU_FAULT_ALLOC_STEP``, or None."""
+    v = os.environ.get(ENV_ALLOC_STEP)
+    return int(v) if v not in (None, "") else None
+
+
+def inject_alloc_failure(step, alloc_step=None, *, bytes_requested=None):
+    """Raise a synthetic ``RESOURCE_EXHAUSTED`` when ``step ==
+    alloc_step`` (host-side — call it in the train loop just before the
+    step dispatch, inside the ``oom_guard``/``guarded_call`` whose
+    post-mortem path is under test). ``alloc_step=None`` consults
+    ``$APEX_TPU_FAULT_ALLOC_STEP``; still None means no injection —
+    safe to leave in production loops, mirroring :func:`inject_nan`."""
+    if alloc_step is None:
+        alloc_step = alloc_step_from_env()
+    if alloc_step is None or int(step) != int(alloc_step):
+        return
+    detail = (f" while allocating {int(bytes_requested)} bytes"
+              if bytes_requested else "")
+    raise SyntheticResourceExhausted(
+        f"RESOURCE_EXHAUSTED: injected allocation failure at step "
+        f"{int(step)}{detail} (faults.inject_alloc_failure)")
 
 
 def _leaf_path_str(path):

@@ -36,6 +36,17 @@ every N steps), lands the ``guard/steps_skipped`` counter and
 skip streak crosses the threshold. The compiled step stays clean — the
 chaos suite asserts no ``callback`` custom-calls in the lowered HLO.
 
+OOM joins NaN as a post-mortem-producing failure (telemetry/memory.py):
+a non-finite step is skippable in-graph, but HBM exhaustion kills the
+dispatch itself — the runtime raises RESOURCE_EXHAUSTED before any
+flag could be computed. :func:`guarded_call` is the host-side
+companion: wrap the step *dispatch* (plus its completion barrier) and
+an OOM writes ``memory-postmortem-rank<N>.json`` (live-buffer census +
+headroom trend) before re-raising as
+:class:`~apex_tpu.telemetry.memory.HBMExhaustedError` — the same
+"die with attribution, not a bare traceback" contract
+:func:`check_guard` gives NaN escalation.
+
 Numerics attribution (telemetry/numerics.py + telemetry/recorder.py):
 pass a :class:`~apex_tpu.telemetry.recorder.FlightRecorder` (plus its
 carry state) to :func:`guarded_update` and every step's per-module
@@ -209,6 +220,26 @@ def guarded_update(grads, opt_update: Callable[[Any, Any], Any], state,
                 recorder_state.cursor if step is None else step,
                 stats),)
         return outs
+
+
+def guarded_call(fn, *args, oom_dir=None, registry=None, labels=None,
+                 **kwargs):
+    """Dispatch one (jitted) step under the OOM post-mortem handler.
+
+    ``fn(*args, **kwargs)`` with RESOURCE_EXHAUSTED — the real XLA
+    runtime error, or ``faults.inject_alloc_failure``'s synthetic one —
+    caught by :func:`telemetry.memory.oom_guard`: the handler writes an
+    atomic ``memory-postmortem-rank<N>.json`` (live-buffer census, last
+    ``step_memory`` report, headroom trend) into ``oom_dir`` (default
+    ``$APEX_TPU_MEMORY_DIR`` -> telemetry dir -> CWD) and re-raises as
+    :class:`~apex_tpu.telemetry.memory.HBMExhaustedError`. ``labels``
+    (``{"params": params, ...}``) attribute census rows to the caller's
+    pytrees. Every other exception passes through untouched; the happy
+    path costs one ``try`` — nothing enters the compiled program."""
+    from apex_tpu.telemetry import memory as _memory
+
+    with _memory.oom_guard(oom_dir, registry=registry, labels=labels):
+        return fn(*args, **kwargs)
 
 
 def check_guard(guard_state: GuardState,

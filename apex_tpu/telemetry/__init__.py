@@ -23,6 +23,19 @@ The observability layer under the parallel/optimizer/bench stack:
   a device-side ring buffer of the last K steps' stats, fetched once
   for a ``numerics-postmortem-rank<N>.json`` when the resilience guard
   trips.
+- :mod:`compile_watch` — trace/compile accounting per jitted function
+  (:class:`~apex_tpu.telemetry.compile_watch.CompileWatcher`):
+  ``compile`` events that name exactly which argument changed on a
+  recompile, ``compile/count``/``compile/seconds`` counters, and the
+  :func:`~apex_tpu.telemetry.compile_watch.assert_no_recompiles`
+  test primitive. Opt-in via ``APEX_TPU_COMPILE_WATCH=1``.
+- :mod:`memory`    — HBM budget accounting:
+  :func:`~apex_tpu.telemetry.memory.step_memory` (XLA
+  ``memory_analysis()`` -> peak bytes + ``memory/hbm_headroom``
+  gauge), :func:`~apex_tpu.telemetry.memory.live_buffer_census`,
+  :func:`~apex_tpu.telemetry.memory.preflight`, and the
+  ``memory-postmortem-rank<N>.json`` OOM handler
+  (:func:`~apex_tpu.telemetry.memory.oom_guard`).
 
 Everything is host-side: recording inside jitted code happens at trace
 time (once per compilation == once per step of the compiled program)
@@ -50,9 +63,25 @@ from apex_tpu.telemetry.trace import (  # noqa: F401
     stop_profiler_trace,
 )
 from apex_tpu.telemetry import comm  # noqa: F401
+from apex_tpu.telemetry import compile_watch  # noqa: F401
+from apex_tpu.telemetry import memory  # noqa: F401
 from apex_tpu.telemetry import numerics  # noqa: F401
 from apex_tpu.telemetry import recorder  # noqa: F401
 from apex_tpu.telemetry import xla_cost  # noqa: F401
+from apex_tpu.telemetry.compile_watch import (  # noqa: F401
+    CompileWatcher,
+    RecompileError,
+    assert_no_recompiles,
+)
+from apex_tpu.telemetry.memory import (  # noqa: F401
+    HBMExhaustedError,
+    MemoryBudgetError,
+    live_buffer_census,
+    oom_guard,
+    oom_postmortem,
+    preflight,
+    step_memory,
+)
 from apex_tpu.telemetry.numerics import (  # noqa: F401
     TensorStats,
     tensor_stats,

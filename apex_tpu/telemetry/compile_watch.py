@@ -1,0 +1,377 @@
+"""Compile watch: make silent recompilation a first-class, observable
+event.
+
+The failure mode: XLA recompiles whenever a jitted function sees a new
+abstract signature — a shape-unstable input pipeline, a Python scalar
+whose type drifts, a sharding that flips between calls — and on TPU a
+large-model compile costs minutes. A per-step retrace therefore turns a
+"fast" run into one that spends 99% of wall-clock in the compiler while
+the step-time telemetry (PR 2) sees only mysteriously slow steps: the
+compile itself was invisible. This module is the missing signal:
+
+- :class:`CompileWatcher` — wrap a jitted callable with
+  :meth:`~CompileWatcher.watch`; every call snapshots the pjit cache
+  size (``fn._cache_size()``), so a cache-size increase IS a
+  trace+compile, attributed to exactly that call. On a *re*compile the
+  watcher diffs the new abstract signature (per-argument shapes /
+  dtypes / weak-types / named shardings / Python-scalar values) against
+  the cached one and emits a ``compile`` JSONL event naming exactly
+  which argument changed (path, old -> new). Metrics land in the
+  existing registry: ``compile/count`` / ``compile/seconds`` counters
+  (fed by a ``jax.monitoring`` listener, so they also count compiles of
+  *unwatched* functions) plus per-function ``compile/count/<name>``.
+- :func:`assert_no_recompiles` — the test/CI primitive: a context
+  manager that counts backend compiles across the block (via the same
+  monitoring listener) and raises :class:`RecompileError` when any
+  happened, naming the changed argument when a watched function saw it.
+  Wrap N steady-state steps after warmup and any future per-step
+  retrace fails tier-1 loudly.
+
+Everything is host-side: watching never touches the traced program, so
+the lowered HLO of a watched step is byte-identical to the unwatched
+one (asserted in tests/L0/test_compile_watch.py — the same contract the
+numerics layer keeps).
+
+Opt-in: ``APEX_TPU_COMPILE_WATCH=1`` enables the process-global watcher
+returned by :func:`get_watcher` (``bench.py ddp_memwatch`` enables it
+programmatically); a disabled watcher's ``watch`` returns the function
+unchanged — zero overhead off. :func:`assert_no_recompiles` works
+regardless of the opt-in (tests should not depend on env state).
+"""
+
+import contextlib
+import os
+import threading
+import time
+
+from apex_tpu.telemetry.registry import get_registry
+
+ENV_WATCH = "APEX_TPU_COMPILE_WATCH"
+
+# jax.monitoring event names (stable across the jax 0.4.x line; probed
+# in tests). backend_compile fires once per XLA compilation, with the
+# compile wall-time as the duration.
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RecompileError(RuntimeError):
+    """Raised by :func:`assert_no_recompiles` when a compile happened
+    inside the guarded block."""
+
+
+# -- process-wide backend compile accounting --------------------------------
+
+_MONITOR_LOCK = threading.Lock()
+_MONITOR_INSTALLED = False
+_BACKEND = {"count": 0, "seconds": 0.0}
+
+
+def _on_backend_compile(event, duration, **kwargs):
+    if not event.endswith("backend_compile_duration"):
+        return
+    with _MONITOR_LOCK:
+        _BACKEND["count"] += 1
+        _BACKEND["seconds"] += float(duration)
+    reg = get_registry()
+    if reg.enabled:
+        reg.counter("compile/count").inc()
+        reg.counter("compile/seconds").inc(float(duration))
+
+
+def install_monitoring():
+    """Register the (one, idempotent) ``jax.monitoring`` listener that
+    feeds :func:`backend_compiles` and the ``compile/count`` /
+    ``compile/seconds`` registry counters. jax offers no per-listener
+    removal, so this registers exactly once per process and the listener
+    stays — it is a counter bump, nanoseconds per compile."""
+    global _MONITOR_INSTALLED
+    with _MONITOR_LOCK:
+        if _MONITOR_INSTALLED:
+            return
+        _MONITOR_INSTALLED = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(
+        _on_backend_compile)
+
+
+def backend_compiles():
+    """``(count, total_seconds)`` of XLA backend compiles observed since
+    :func:`install_monitoring` ran (process-wide, watched or not)."""
+    with _MONITOR_LOCK:
+        return _BACKEND["count"], _BACKEND["seconds"]
+
+
+# -- abstract signatures ----------------------------------------------------
+
+def _leaf_path_str(path):
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def _describe_leaf(x):
+    """One stable string per argument leaf — everything that can key a
+    retrace: shape/dtype/weak-type for arrays, the named-sharding spec
+    when one is attached (a resharded input retraces), and the VALUE of
+    Python scalars/strings (value-keyed when the arg is static; for a
+    traced weak-typed scalar the extra precision is harmless because
+    diffs are only taken on calls that did compile)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        try:
+            desc = f"{dtype.name if hasattr(dtype, 'name') else dtype}" \
+                   f"{list(shape)}"
+        except Exception:
+            desc = f"{dtype}[?]"
+        if getattr(x, "weak_type", False):
+            desc += "~"
+        sharding = getattr(x, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is not None:
+            desc += f"@{spec}"
+        return desc
+    if isinstance(x, (bool, int, float, complex, str, bytes, type(None))):
+        return f"py:{type(x).__name__}={x!r}"
+    return f"static:{type(x).__name__}"
+
+
+def abstract_signature(args, kwargs=None):
+    """``{arg_path: descriptor}`` for a call's arguments — the host-side
+    mirror of the signature jit keys its cache on. Paths are '/'-joined
+    pytree paths under ``args/<i>`` / ``kwargs/<name>``."""
+    import jax
+
+    sig = {}
+    for root, tree in (("args", tuple(args)),
+                       ("kwargs", dict(kwargs or {}))):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                tree, is_leaf=lambda l: l is None)[0]:
+            sig[f"{root}/{_leaf_path_str(path)}"] = _describe_leaf(leaf)
+    return sig
+
+
+def diff_signatures(old, new):
+    """Per-argument changes between two :func:`abstract_signature`
+    dicts: ``[{"arg", "old", "new"}, ...]`` (``None`` marks an
+    added/removed argument), sorted by argument path."""
+    changes = []
+    for key in sorted(set(old) | set(new)):
+        a, b = old.get(key), new.get(key)
+        if a != b:
+            changes.append({"arg": key, "old": a, "new": b})
+    return changes
+
+
+# -- the watcher ------------------------------------------------------------
+
+class _FnStats:
+    __slots__ = ("name", "signature", "compiles", "recompiles",
+                 "compile_seconds", "last_change")
+
+    def __init__(self, name):
+        self.name = name
+        self.signature = None
+        self.compiles = 0
+        self.recompiles = 0
+        self.compile_seconds = 0.0
+        self.last_change = None
+
+
+def _cache_size(fn):
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+class _WatchedFunction:
+    """Host-side wrapper around one jitted callable. Delegates every
+    attribute (``lower``, ``_cache_size``, ...) to the wrapped function,
+    so it drops into code that uses the AOT API."""
+
+    def __init__(self, fn, name, watcher):
+        self._fn = fn
+        self._name = name
+        self._watcher = watcher
+        self.__wrapped__ = fn
+
+    def __call__(self, *args, **kwargs):
+        w = self._watcher
+        if not w.enabled:
+            return self._fn(*args, **kwargs)
+        before = _cache_size(self._fn)
+        nb_before = backend_compiles()[0]
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        after = _cache_size(self._fn)
+        if after is not None and before is not None:
+            compiled = after > before
+        else:  # no pjit cache introspection: fall back to process count
+            compiled = backend_compiles()[0] > nb_before
+        if compiled:
+            w._on_compile(self._name, abstract_signature(args, kwargs), dt)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+class CompileWatcher:
+    """Trace/compile accounting for jitted functions (host-side only).
+
+    Usable three ways: as a plain object (``w = CompileWatcher();
+    step = w.watch(step)``), as a context manager (the exit emits a
+    ``compile`` summary event covering the block), and process-globally
+    via :func:`get_watcher` + ``APEX_TPU_COMPILE_WATCH=1``. A disabled
+    watcher's ``watch`` returns the function unchanged.
+    """
+
+    def __init__(self, *, enabled=None, registry=None):
+        if enabled is None:
+            enabled = os.environ.get(ENV_WATCH, "") not in ("", "0")
+        self.enabled = bool(enabled)
+        self._registry = registry
+        self.functions = {}
+        self._entered_at = None
+        if self.enabled:
+            install_monitoring()
+
+    # -- enablement ---------------------------------------------------------
+
+    def enable(self):
+        self.enabled = True
+        install_monitoring()
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    def _reg(self):
+        return self._registry or get_registry()
+
+    # -- watching -----------------------------------------------------------
+
+    def watch(self, fn, name=None):
+        """Wrap ``fn`` (typically a jitted callable) so every
+        trace+compile is counted, timed, and — when it is a recompile —
+        signature-diffed. Returns ``fn`` itself when disabled."""
+        if not self.enabled:
+            return fn
+        if name is None:
+            name = getattr(fn, "__name__", None) or repr(fn)
+        self.functions.setdefault(name, _FnStats(name))
+        return _WatchedFunction(fn, name, self)
+
+    def _on_compile(self, name, signature, call_seconds):
+        rec = self.functions.setdefault(name, _FnStats(name))
+        rec.compiles += 1
+        rec.compile_seconds += call_seconds
+        changed = None
+        if rec.signature is not None:  # a RE-compile: name the culprit
+            rec.recompiles += 1
+            changed = diff_signatures(rec.signature, signature)
+            rec.last_change = changed
+        rec.signature = signature
+        reg = self._reg()
+        if reg.enabled:
+            reg.counter(f"compile/count/{name}").inc()
+            reg.histogram("compile/call_seconds").observe(call_seconds)
+            reg.event("compile", name,
+                      compiles=rec.compiles,
+                      recompile=rec.recompiles > 0 and changed is not None,
+                      call_seconds=round(call_seconds, 6),
+                      changed=changed)
+
+    # -- accounting ---------------------------------------------------------
+
+    def compile_count(self, name=None):
+        """Compiles of one watched function (or the sum over all)."""
+        if name is not None:
+            rec = self.functions.get(name)
+            return rec.compiles if rec else 0
+        return sum(r.compiles for r in self.functions.values())
+
+    def recompile_count(self):
+        return sum(r.recompiles for r in self.functions.values())
+
+    def last_changes(self):
+        """``{fn_name: [{"arg", "old", "new"}, ...]}`` for every watched
+        function whose latest compile was a signature-diffed recompile."""
+        return {n: r.last_change for n, r in self.functions.items()
+                if r.last_change}
+
+    # -- context manager ----------------------------------------------------
+
+    def __enter__(self):
+        self.enable()
+        self._entered_at = backend_compiles()
+        return self
+
+    def __exit__(self, *exc):
+        count0, secs0 = self._entered_at or (0, 0.0)
+        count1, secs1 = backend_compiles()
+        reg = self._reg()
+        if reg.enabled:
+            reg.event("compile", "watch_summary",
+                      backend_compiles=count1 - count0,
+                      backend_compile_seconds=round(secs1 - secs0, 6),
+                      watched={n: {"compiles": r.compiles,
+                                   "recompiles": r.recompiles}
+                               for n, r in self.functions.items()})
+        return False
+
+
+_GLOBAL = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_watcher():
+    """The process-global watcher, created on first use — enabled iff
+    ``APEX_TPU_COMPILE_WATCH`` was set at that point (call
+    ``get_watcher().enable()`` to opt in programmatically, as
+    ``bench.py ddp_memwatch`` does)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = CompileWatcher()
+    return _GLOBAL
+
+
+@contextlib.contextmanager
+def assert_no_recompiles(watcher=None, *, allow=0):
+    """Fail loudly if anything compiled inside the block.
+
+    The test/CI primitive for shape stability: warm the step up, then
+    run N steady-state steps under this context — any retrace (a Python
+    scalar leaking into the traced signature, a drifting input shape, a
+    flipped sharding) raises :class:`RecompileError`. Counting is
+    process-wide via the ``jax.monitoring`` backend-compile listener,
+    so even compiles of helpers you forgot to watch are caught; when a
+    watched function saw the recompile, the error names the changed
+    argument (path, old -> new). ``allow`` tolerates that many compiles
+    (e.g. a known one-off lazy init inside the block)."""
+    install_monitoring()
+    watcher = watcher or get_watcher()
+    before = backend_compiles()[0]
+    marks = {n: r.recompiles for n, r in watcher.functions.items()}
+    yield watcher
+    delta = backend_compiles()[0] - before
+    if delta <= allow:
+        return
+    detail = ""
+    for name, rec in watcher.functions.items():
+        if rec.recompiles > marks.get(name, 0) and rec.last_change:
+            first = rec.last_change[0]
+            detail = (f" Watched fn '{name}' recompiled: argument "
+                      f"'{first['arg']}' changed "
+                      f"{first['old']} -> {first['new']}.")
+            break
+    raise RecompileError(
+        f"{delta} XLA compile(s) happened inside an "
+        f"assert_no_recompiles block (allowed {allow}) — something is "
+        f"retracing per call; check input shapes/dtypes and Python "
+        f"scalars reaching the jitted signature.{detail}")

@@ -65,15 +65,10 @@ def _normalize(analysis):
     }
 
 
-def step_cost(jitted, *args, use_compiled=False, **kwargs):
-    """Cost analysis of one invocation of ``jitted(*args, **kwargs)``:
-    ``{"flops", "bytes_accessed"}``, or None when the backend offers no
-    analysis. Lowering re-traces the function (host-side only — safe on
-    donated/deleted example arrays since only avals are read)."""
-    try:
-        lowered = jitted.lower(*args, **kwargs)
-    except Exception:
-        return None
+def cost_from_lowered(lowered, use_compiled=False):
+    """Cost analysis of an already-``.lower()``-ed computation (lets a
+    caller that also wants ``memory_analysis`` pay for one lowering,
+    not two — see ``bench._measure_step_cost``)."""
     if use_compiled:
         try:
             return _normalize(lowered.compile().cost_analysis())
@@ -83,6 +78,18 @@ def step_cost(jitted, *args, use_compiled=False, **kwargs):
         return _normalize(lowered.cost_analysis())
     except Exception:
         return None
+
+
+def step_cost(jitted, *args, use_compiled=False, **kwargs):
+    """Cost analysis of one invocation of ``jitted(*args, **kwargs)``:
+    ``{"flops", "bytes_accessed"}``, or None when the backend offers no
+    analysis. Lowering re-traces the function (host-side only — safe on
+    donated/deleted example arrays since only avals are read)."""
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+    except Exception:
+        return None
+    return cost_from_lowered(lowered, use_compiled=use_compiled)
 
 
 def utilization(flops_per_step, step_seconds, *, bytes_per_step=None,

@@ -32,6 +32,14 @@
                                            flight-recorder ring; emits
                                            numerics_overhead_pct vs the
                                            numerics-off step
+    python bench.py ddp_memwatch [batch] [steps]  guarded DDP step under
+                                           the compile watcher + HBM
+                                           accounting (+ optional
+                                           injected alloc failure ->
+                                           memory post-mortem); emits
+                                           peak_hbm_bytes /
+                                           hbm_headroom_pct /
+                                           compile_count
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported as 1.0 by convention until a measured baseline lands in
@@ -205,21 +213,52 @@ def _measure_step_cost(jitted, args):
     measured per-step collective bytes plus XLA's own FLOP/byte count
     for the step. Called BEFORE the first real invocation so donated
     buffers are still live. Returns its findings and stages them for
-    the next _emit."""
+    the next _emit.
+
+    The same lowering also feeds the HBM accounting
+    (``telemetry.memory.report_from_lowered`` — argument/output/temp
+    bytes, peak, headroom vs the backend's capacity). That step DOES
+    compile the lowered program; with the persistent compile cache
+    (default-on for bench runs) the jit call that follows is then a
+    disk hit, so the total compile cost stays ~1x. Set
+    APEX_TPU_BENCH_MEMWATCH=0 to skip it (e.g. cache off + a 25-minute
+    model)."""
     from apex_tpu import telemetry
 
     _enable_bench_telemetry()
     reg = telemetry.get_registry()
     before = reg.counter_value("comm/bytes")
-    cost = telemetry.xla_cost.step_cost(jitted, *args)
+    try:
+        lowered = jitted.lower(*args)
+    except Exception:
+        lowered = None
     measured = reg.counter_value("comm/bytes") - before
+    cost = (telemetry.xla_cost.cost_from_lowered(lowered)
+            if lowered is not None else None)
+    mem = None
+    if lowered is not None and \
+            os.environ.get("APEX_TPU_BENCH_MEMWATCH", "1") != "0":
+        mem = telemetry.memory.report_from_lowered(lowered)
     _PENDING_MEASURED.clear()
     _PENDING_MEASURED.update({
         "measured_comm_bytes_per_step": int(round(measured)),
         "model_flops_per_step_xla": cost["flops"] if cost else None,
         "_xla_cost": cost,
+        "peak_hbm_bytes": mem["peak_bytes"] if mem else None,
+        "hbm_headroom_pct": round(mem["headroom_frac"] * 100.0, 2)
+        if mem and mem.get("headroom_frac") is not None else None,
     })
     return cost, measured
+
+
+def _stage_compile_count(jitted):
+    """Stage the step function's trace/compile count (the pjit cache
+    size — 1 in a shape-stable run) for the next _emit. Call AFTER the
+    timed loop so any mid-run retrace is counted."""
+    try:
+        _PENDING_MEASURED["compile_count"] = int(jitted._cache_size())
+    except Exception:
+        pass
 
 
 def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
@@ -229,6 +268,9 @@ def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
     measured = _PENDING_MEASURED.pop("measured_comm_bytes_per_step", None)
     flops_xla = _PENDING_MEASURED.pop("model_flops_per_step_xla", None)
     xla_cost = _PENDING_MEASURED.pop("_xla_cost", None)
+    peak_hbm = _PENDING_MEASURED.pop("peak_hbm_bytes", None)
+    headroom_pct = _PENDING_MEASURED.pop("hbm_headroom_pct", None)
+    compile_count = _PENDING_MEASURED.pop("compile_count", None)
     _PENDING_MEASURED.clear()
     reg = telemetry.get_registry()
     if reg.enabled:
@@ -256,6 +298,12 @@ def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
         "mfu": round(tflops / PEAK_TFLOPS, 4),
         "measured_comm_bytes_per_step": measured,
         "model_flops_per_step_xla": flops_xla,
+        # HBM + compile accounting (round-10 capture contract;
+        # telemetry/memory.py + telemetry/compile_watch.py): null when
+        # the config measured neither
+        "peak_hbm_bytes": peak_hbm,
+        "hbm_headroom_pct": headroom_pct,
+        "compile_count": compile_count,
         **extra,
     }))
 
@@ -286,6 +334,7 @@ def _time_steps(train_step, state, steps, loss_index):
                 out = train_step(*out[:loss_index])
         float(out[loss_index])
         dt = time.perf_counter() - t0
+    _stage_compile_count(train_step)
     return dt, out
 
 
@@ -1018,6 +1067,7 @@ def bench_resnet(batch, steps):
         out = train_step(*out[:3], images, labels)
     float(out[3])  # host fetch = completion barrier for the whole chain
     dt = time.perf_counter() - t0
+    _stage_compile_count(train_step)
 
     imgs_per_sec = batch * steps / dt
     # ResNet-50 fwd ~4.09 GFLOPs/image at 224x224; train = 3x fwd
@@ -1195,6 +1245,7 @@ def bench_ddp_resilience(batch, steps, *, hidden=256, depth=2,
             resilience.check_guard(gst, max_consecutive_skips=steps + 1)
         final_loss = float(loss)
     dt = time.perf_counter() - t0
+    _stage_compile_count(train_step)
     skipped = int(gst.total_skips)
 
     n = _tree_size(params)
@@ -1339,6 +1390,7 @@ def bench_ddp_numerics(batch, steps, *, hidden=256, depth=2,
     dt_base, _, _ = run(base_step, ddp_base, rstate0, "plain", False)
     dt_num, final_loss, gst = run(num_step, ddp_num, rstate0, "numerics",
                                   True)
+    _stage_compile_count(num_step)
     overhead_pct = (dt_num - dt_base) / dt_base * 100.0
     skipped = int(gst.total_skips)
     pm = rec.last_postmortem
@@ -1359,6 +1411,163 @@ def bench_ddp_numerics(batch, steps, *, hidden=256, depth=2,
             "numerics_overhead_pct": round(overhead_pct, 2),
             "postmortem_path": pm["path"] if pm else None,
             "first_nonfinite_prefix": first_prefix}
+
+
+def bench_ddp_memwatch(batch, steps, *, hidden=256, depth=2,
+                       alloc_step=None):
+    """Guarded int8 DDP training under the full compile & memory
+    observability spine: the train step runs watched by a
+    :class:`~apex_tpu.telemetry.compile_watch.CompileWatcher` (every
+    trace/compile counted and signature-diffed), its HBM budget is
+    accounted up front (``preflight`` + ``step_memory`` -> the
+    ``memory/hbm_headroom`` gauge and the per-device ZeRO-relevant
+    census), and each dispatch goes through
+    ``resilience.guarded_call`` so a RESOURCE_EXHAUSTED — real, or the
+    deterministic ``faults.inject_alloc_failure`` at ``alloc_step``
+    (default ``$APEX_TPU_FAULT_ALLOC_STEP``; None = no fault) — writes
+    ``memory-postmortem-rank<N>.json`` (live-buffer census + headroom
+    trend) instead of dying with a bare traceback. An injected OOM
+    costs that one step: the loop records the post-mortem and
+    continues, proving the handler path without killing the capture.
+
+    The emitted line carries the round-10 fields ``peak_hbm_bytes`` /
+    ``hbm_headroom_pct`` / ``compile_count`` (== 1 in a shape-stable
+    run — the recompile-stability evidence) plus
+    ``oom_postmortem_path``. The observation contract matches PR 4:
+    everything here is host-side, so the lowered steady-state HLO is
+    byte-identical with the watcher on or off (asserted in
+    tests/L0/test_memory_watch.py).
+
+    Returns ``{"compile_count", "recompiles", "peak_hbm_bytes",
+    "hbm_headroom_pct", "oom_postmortem_path", "alloc_step",
+    "steps_skipped", "final_loss"}`` for the oneproc memwatch smoke
+    stage.
+    """
+    from apex_tpu import resilience, telemetry
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.resilience import faults
+    from apex_tpu.telemetry import span
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    world = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    if alloc_step is None:
+        alloc_step = faults.alloc_step_from_env()
+    rng = np.random.RandomState(0)
+    params = {}
+    for i in range(depth):
+        params[f"w{i}"] = jnp.asarray(
+            rng.randn(hidden, hidden).astype(np.float32)
+            / np.sqrt(hidden))
+        params[f"b{i}"] = jnp.zeros((hidden,), jnp.float32)
+    x = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(batch * world, hidden).astype(np.float32))
+
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8")
+    residual = ddp.init_residual(params)
+    gstate = resilience.init_guard_state()
+    # commit the carried state to the replicated sharding the step's
+    # out_specs produce, so call 0 and call N share ONE abstract
+    # signature — otherwise the warmup call (single-device inputs)
+    # and the steady state (replicated outputs fed back) are two
+    # signatures = two compiles, and compile_count could never be 1
+    from jax.sharding import NamedSharding
+
+    replicated = NamedSharding(mesh, P())
+    params, residual, gstate = jax.device_put(
+        (params, residual, gstate), replicated)
+
+    def loss_fn(p, xb, yb):
+        h = xb
+        for i in range(depth):
+            h = jnp.tanh(h @ p[f"w{i}"] + p[f"b{i}"])
+        return jnp.mean((h - yb) ** 2)
+
+    def step_fn(p, res, gst, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        flag = resilience.nonfinite_flag(grads)
+        synced, new_res = ddp.sync(grads, res)
+
+        def commit(g, st):
+            prev_p, _ = st
+            new_p = jax.tree_util.tree_map(
+                lambda w, gg: w - 0.05 * gg, prev_p, g)
+            return (new_p, new_res)
+
+        (p, res), gst = resilience.guarded_update(
+            synced, commit, (p, res), gst, axis_name="dp", flag=flag)
+        return p, res, gst, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P(), P(), P("dp"), P("dp")),
+                            out_specs=(P(), P(), P(), P()),
+                            check_vma=False)
+
+    @jax.jit
+    def train_step(p, res, gst):
+        return sharded(p, res, gst, x, y)
+
+    # the explicit opt-in: watch the step (host-side wrapper; the HLO
+    # stays byte-identical) and account its HBM budget before dispatch.
+    # A fresh watcher per run — the process-global get_watcher() would
+    # diff this run's first compile against a previous run's signature
+    watcher = telemetry.CompileWatcher(enabled=True)
+    watched_step = watcher.watch(train_step, "ddp_memwatch/train_step")
+    _measure_step_cost(train_step, (params, residual, gstate))
+    mem = telemetry.memory.preflight(train_step, params, residual, gstate,
+                                     name="ddp_memwatch/train_step")
+
+    labels = {"params": params, "residual": residual, "batch": (x, y)}
+    oom_path = None
+    p, res, gst = params, residual, gstate
+    loss = None
+    # warmup (compile + one steady step) outside the timed window
+    p, res, gst, loss = watched_step(p, res, gst)
+    float(loss)
+
+    def dispatch(step_i, *state):
+        # the injector fires where a real HBM exhaustion would: on the
+        # host, at dispatch, inside guarded_call's oom_guard
+        faults.inject_alloc_failure(step_i, alloc_step)
+        return watched_step(*state)
+
+    t0 = time.perf_counter()
+    with span("bench/timed_loop", steps=steps):
+        for i in range(steps):
+            try:
+                with span("bench/step"):
+                    p, res, gst, loss = resilience.guarded_call(
+                        dispatch, i, p, res, gst, labels=labels)
+            except resilience.HBMExhaustedError:
+                # the post-mortem landed; an injected OOM costs one
+                # step, never the capture
+                pm = telemetry.memory.last_postmortem()
+                oom_path = pm["path"] if pm else None
+                continue
+            resilience.check_guard(gst, max_consecutive_skips=steps + 1)
+        final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    _stage_compile_count(watched_step)
+    compile_count = _PENDING_MEASURED.get("compile_count")
+    skipped = int(gst.total_skips)
+
+    n = _tree_size(params)
+    fields = _comm_fields(params, compress="int8")
+    flops = 6 * batch * world * depth * hidden * hidden
+    _emit("ddp_memwatch_steps_per_sec", steps / dt, "steps/sec",
+          flops, steps, dt, dp_world=world, grad_elements=n,
+          steps_skipped=skipped, alloc_step=alloc_step,
+          final_loss=final_loss, oom_postmortem_path=oom_path,
+          **fields)
+    return {"compile_count": compile_count,
+            "recompiles": watcher.recompile_count(),
+            "peak_hbm_bytes": mem["peak_bytes"] if mem else None,
+            "hbm_headroom_pct":
+                round(mem["headroom_frac"] * 100.0, 2)
+                if mem and mem.get("headroom_frac") is not None else None,
+            "oom_postmortem_path": oom_path, "alloc_step": alloc_step,
+            "steps_skipped": skipped, "final_loss": final_loss}
 
 
 # The canonical (size, steps) per bench — the ONLY place these defaults
@@ -1383,6 +1592,7 @@ BENCH_SPECS = {
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
     "ddp_resilience": ((32, 12), bench_ddp_resilience),
     "ddp_numerics": ((32, 12), bench_ddp_numerics),
+    "ddp_memwatch": ((32, 12), bench_ddp_memwatch),
 }
 
 

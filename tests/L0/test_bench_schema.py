@@ -88,9 +88,45 @@ def test_numerics_overhead_gated_at_round9():
     assert schema.check_metric_line(dict(line), round_n=9, errors=[]) == []
 
 
+def test_memwatch_fields_gated_at_round10():
+    """ISSUE 5 satellite: peak_hbm_bytes / hbm_headroom_pct /
+    compile_count (the compile & memory observability fields) are
+    required — nullable — from round 10; BENCH_r01-r06 records without
+    them stay valid."""
+    line = {"metric": "ddp_memwatch_steps_per_sec", "value": 1.0,
+            "unit": "steps/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 10,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None}
+    # round 9: not yet part of the contract
+    assert schema.check_metric_line(dict(line), round_n=9, errors=[]) == []
+    msgs = schema.check_metric_line(dict(line), round_n=10, errors=[])
+    assert any("peak_hbm_bytes" in m for m in msgs)
+    assert any("hbm_headroom_pct" in m for m in msgs)
+    assert any("compile_count" in m for m in msgs)
+    line.update(peak_hbm_bytes=123456, hbm_headroom_pct=87.5,
+                compile_count=1)
+    assert schema.check_metric_line(dict(line), round_n=10,
+                                    errors=[]) == []
+    # nullable: a config that measured neither still conforms
+    line.update(peak_hbm_bytes=None, hbm_headroom_pct=None,
+                compile_count=None)
+    assert schema.check_metric_line(dict(line), round_n=10,
+                                    errors=[]) == []
+    # typed when present
+    line["peak_hbm_bytes"] = "big"
+    msgs = schema.check_metric_line(dict(line), round_n=10, errors=[])
+    assert any("must be numeric or null" in m for m in msgs)
+    line["peak_hbm_bytes"] = None
+    line["compile_count"] = -2
+    msgs = schema.check_metric_line(dict(line), round_n=10, errors=[])
+    assert any("non-negative" in m for m in msgs)
+
+
 def test_live_emit_passes_current_schema(capsys):
-    """What bench._emit prints today must satisfy the round-7 (current)
-    metric-line contract — telemetry fields included."""
+    """What bench._emit prints today must satisfy the round-10 (current)
+    metric-line contract — telemetry + memwatch fields included."""
     import bench
 
     bench._emit("unit_test_metric", 12.5, "things/sec",
@@ -98,7 +134,10 @@ def test_live_emit_passes_current_schema(capsys):
                 **bench._comm_fields(n_elements=1000))
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert schema.check_metric_line(line, round_n=7, errors=[]) == []
+    assert schema.check_metric_line(line, round_n=10, errors=[]) == []
     assert line["measured_comm_bytes_per_step"] is None  # none staged
+    assert line["peak_hbm_bytes"] is None                # none staged
+    assert line["compile_count"] is None                 # none staged
     assert "comm_bytes_per_step" in line
 
 

@@ -50,6 +50,13 @@ STEPS_SKIPPED_SINCE_ROUND = 8
 # step) is an OPTIONAL field defined from round 9 — only ddp_numerics
 # emits it; same gating discipline as steps_skipped
 NUMERICS_OVERHEAD_SINCE_ROUND = 9
+# the compile & memory observability contract: peak_hbm_bytes /
+# hbm_headroom_pct (telemetry/memory.py step accounting) and
+# compile_count (the step function's trace count — 1 in a shape-stable
+# run) are REQUIRED (nullable — null means "not measured in this
+# config") on successful metric lines from round 10; BENCH_r01-r06
+# records stay valid without them
+MEMWATCH_FIELDS_SINCE_ROUND = 10
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -109,6 +116,19 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"telemetry field {key!r} must be numeric or "
                         f"null")
+        if round_n is None or round_n >= MEMWATCH_FIELDS_SINCE_ROUND:
+            for key in ("peak_hbm_bytes", "hbm_headroom_pct",
+                        "compile_count"):
+                if key not in obj:
+                    bad(f"missing memwatch field {key!r} (required "
+                        f"since round {MEMWATCH_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"memwatch field {key!r} must be numeric or "
+                        f"null")
+            cc = obj.get("compile_count")
+            if isinstance(cc, (int, float)) and not isinstance(cc, bool) \
+                    and cc < 0:
+                bad("compile_count must be non-negative")
         if "steps_skipped" in obj:
             if (round_n is not None
                     and round_n < STEPS_SKIPPED_SINCE_ROUND):

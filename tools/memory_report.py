@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Render compile & memory observability artifacts into one report.
+
+Reads, from the given directories (or explicit file paths):
+
+- ``memory-postmortem-rank*.json`` — the OOM post-mortems
+  ``telemetry.memory.oom_postmortem`` writes (live-buffer census, last
+  step_memory report, headroom trend),
+- ``telemetry-rank*.jsonl`` — the ``memory`` / ``compile`` event kinds
+  (step_memory reports, preflight warnings, ZeRO state-bytes records,
+  per-function compile events with signature diffs),
+
+and prints the triage view: headroom trend, top live buffers at death,
+what compiled and why. ``--json`` emits the aggregate as one JSON
+object for scripts.
+
+    python tools/memory_report.py /tmp/tel
+    python tools/memory_report.py --json $APEX_TPU_MEMORY_DIR | jq .
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def collect_paths(args):
+    postmortems, jsonls = [], []
+    for a in args:
+        if os.path.isdir(a):
+            postmortems.extend(sorted(glob.glob(
+                os.path.join(a, "memory-postmortem-rank*.json"))))
+            jsonls.extend(sorted(glob.glob(os.path.join(a, "*.jsonl"))))
+        elif a.endswith(".jsonl"):
+            jsonls.append(a)
+        else:
+            postmortems.append(a)
+    return postmortems, jsonls
+
+
+def load_postmortems(paths):
+    out = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append({"path": path, "error": f"unreadable ({e})"})
+            continue
+        rec.setdefault("path", path)
+        out.append(rec)
+    return out
+
+
+def aggregate_events(paths):
+    """Fold the ``memory`` + ``compile`` JSONL kinds into one dict (the
+    same tolerance discipline as tools/telemetry_report.py: malformed
+    rows are counted, never fatal)."""
+    agg = {
+        "headroom_trend": [],        # step_memory events, in file order
+        "preflight_warnings": [],
+        "zero_state": [],
+        "postmortem_events": [],
+        "compiles": {},              # name -> count/seconds/last change
+        "malformed": 0,
+    }
+    for path in paths:
+        try:
+            f = open(path)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                    kind = ev.get("kind")
+                    if kind == "memory":
+                        _fold_memory(agg, ev)
+                    elif kind == "compile":
+                        _fold_compile(agg, ev)
+                except (ValueError, TypeError, KeyError):
+                    agg["malformed"] += 1
+    return agg
+
+
+def _fold_memory(agg, ev):
+    name = ev.get("name")
+    if name == "step_memory":
+        agg["headroom_trend"].append({
+            "t": ev.get("t"), "step": ev.get("step"),
+            "peak_bytes": ev.get("peak_bytes"),
+            "headroom_frac": ev.get("headroom_frac")})
+    elif name == "preflight_over_budget":
+        agg["preflight_warnings"].append({
+            "peak_bytes": ev.get("peak_bytes"),
+            "budget_bytes": ev.get("budget_bytes")})
+    elif name == "zero_state_bytes":
+        agg["zero_state"].append({
+            k: ev.get(k) for k in (
+                "optimizer", "world", "params_bytes",
+                "unsharded_state_bytes", "sharded_state_bytes",
+                "residual_bytes", "savings_ratio")})
+    elif name == "postmortem":
+        agg["postmortem_events"].append({
+            "path": ev.get("path"), "error": ev.get("error")})
+
+
+def _fold_compile(agg, ev):
+    name = ev.get("name")
+    if name == "watch_summary":
+        return
+    c = agg["compiles"].setdefault(name, {
+        "count": 0, "total_s": 0.0, "recompiles": 0, "last_change": None})
+    c["count"] += 1
+    c["total_s"] += float(ev.get("call_seconds") or 0.0)
+    changed = ev.get("changed")
+    if changed:
+        c["recompiles"] += 1
+        c["last_change"] = changed
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def print_report(postmortems, agg, out=None):
+    w = (out or sys.stdout).write
+    if agg["compiles"]:
+        w("compiles (per watched function):\n")
+        w(f"  {'name':<36} {'count':>6} {'total':>9} {'re':>4}  changed\n")
+        for name in sorted(agg["compiles"]):
+            c = agg["compiles"][name]
+            change = ""
+            if c["last_change"]:
+                first = c["last_change"][0]
+                change = (f"{first.get('arg')}: {first.get('old')} -> "
+                          f"{first.get('new')}")
+            w(f"  {name:<36} {c['count']:>6} {c['total_s']:>8.2f}s "
+              f"{c['recompiles']:>4}  {change}\n")
+    if agg["headroom_trend"]:
+        w("\nheadroom trend (step_memory events):\n")
+        for p in agg["headroom_trend"][-10:]:
+            frac = p.get("headroom_frac")
+            w(f"  peak {_fmt_bytes(p.get('peak_bytes')):>12}  headroom "
+              f"{frac * 100:6.2f}%\n" if frac is not None else
+              f"  peak {_fmt_bytes(p.get('peak_bytes')):>12}\n")
+    if agg["zero_state"]:
+        w("\nZeRO optimizer state (per device):\n")
+        for z in agg["zero_state"]:
+            w(f"  {z.get('optimizer')} world={z.get('world')}: "
+              f"unsharded {_fmt_bytes(z.get('unsharded_state_bytes'))} "
+              f"-> sharded {_fmt_bytes(z.get('sharded_state_bytes'))} "
+              f"({(z.get('savings_ratio') or 0):.2f}x)\n")
+    if agg["preflight_warnings"]:
+        w(f"\npreflight: {len(agg['preflight_warnings'])} over-budget "
+          f"warning(s)\n")
+    for pm in postmortems:
+        w(f"\npost-mortem {pm.get('path')}\n")
+        if pm.get("error") and "census" not in pm:
+            w(f"  {pm['error']}\n")
+            continue
+        if pm.get("error"):
+            w(f"  error: {pm['error']}\n")
+        census = pm.get("census") or {}
+        w(f"  live buffers at death: {census.get('total_arrays')} arrays"
+          f", {_fmt_bytes(census.get('total_bytes'))}\n")
+        for g in (census.get("groups") or [])[:8]:
+            w(f"    {g.get('label', '?'):<12} "
+              f"{g.get('dtype'):<10} {str(g.get('shape')):<20} "
+              f"x{g.get('count'):<4} {_fmt_bytes(g.get('bytes'))}\n")
+        trend = pm.get("headroom_trend") or []
+        if trend:
+            last = trend[-1]
+            frac = last.get("headroom_frac")
+            w(f"  headroom trend: {len(trend)} point(s), last peak "
+              f"{_fmt_bytes(last.get('peak_bytes'))}"
+              + (f" ({frac * 100:.2f}% headroom)\n"
+                 if frac is not None else "\n"))
+        last_mem = pm.get("last_step_memory")
+        if last_mem:
+            w(f"  last step_memory: peak "
+              f"{_fmt_bytes(last_mem.get('peak_bytes'))} of "
+              f"{_fmt_bytes(last_mem.get('capacity_bytes'))} capacity\n")
+    if agg["malformed"]:
+        w(f"\nskipped {agg['malformed']} malformed event(s)\n")
+    if not (postmortems or agg["compiles"] or agg["headroom_trend"]
+            or agg["zero_state"]):
+        w("memory_report: nothing to report (no post-mortems, no "
+          "memory/compile events)\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "paths", nargs="*",
+        default=[os.environ.get("APEX_TPU_MEMORY_DIR")
+                 or os.environ.get("APEX_TPU_TELEMETRY_DIR", ".")],
+        help="dirs (scanned for post-mortems + .jsonl) or files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregate as JSON")
+    args = ap.parse_args(argv)
+    pm_paths, jsonl_paths = collect_paths(args.paths)
+    postmortems = load_postmortems(pm_paths)
+    agg = aggregate_events(jsonl_paths)
+    if args.json:
+        json.dump({"postmortems": postmortems, **agg}, sys.stdout,
+                  indent=2, default=str)
+        print()
+    else:
+        print_report(postmortems, agg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

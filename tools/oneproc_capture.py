@@ -270,6 +270,83 @@ def _numerics_smoke(bench):
             "numerics_overhead_pct": ret["numerics_overhead_pct"]}
 
 
+def _memwatch_smoke(bench):
+    """Compile & memory observability smoke (round 10): run
+    ``ddp_memwatch`` twice — once with a synthetic RESOURCE_EXHAUSTED
+    injected at step 3 and assert the ``memory-postmortem-rank<N>.json``
+    landed with a non-empty live-buffer census and a headroom trend;
+    once uninjected and assert the shape-stable contract
+    (``compile_count == 1`` after warmup, no watched recompiles) plus
+    the ``memory/hbm_headroom`` gauge in the telemetry JSONL. Raises on
+    any missing piece so the stage shows up as ERROR rather than
+    silently passing."""
+    import glob
+    import math
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_memwatch_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    prev_mem = os.environ.get(telemetry.memory.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    os.environ[telemetry.memory.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        injected = bench.bench_ddp_memwatch(4, 6, hidden=64, depth=2,
+                                            alloc_step=3)
+        clean = bench.bench_ddp_memwatch(4, 5, hidden=64, depth=2,
+                                         alloc_step=-1)
+    finally:
+        for var, old in ((telemetry.registry.ENV_DIR, prev),
+                         (telemetry.memory.ENV_DIR, prev_mem)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+    path = injected["oom_postmortem_path"]
+    if not path or not os.path.exists(path):
+        raise RuntimeError("memwatch smoke: no memory post-mortem "
+                           f"landed ({path!r})")
+    with open(path) as f:
+        pm = json.load(f)
+    if not (pm.get("census") or {}).get("total_bytes"):
+        raise RuntimeError("memwatch smoke: post-mortem census is empty")
+    if not pm.get("headroom_trend"):
+        raise RuntimeError("memwatch smoke: post-mortem has no headroom "
+                           "trend")
+    if clean["compile_count"] != 1:
+        raise RuntimeError("memwatch smoke: expected compile_count == 1 "
+                           f"after warmup, got {clean['compile_count']} "
+                           "— something is retracing per step")
+    if clean["recompiles"] != 0:
+        raise RuntimeError("memwatch smoke: watcher saw "
+                           f"{clean['recompiles']} recompile(s) in the "
+                           "steady state")
+    if not math.isfinite(clean["final_loss"]):
+        raise RuntimeError("memwatch smoke: final loss is non-finite "
+                           f"({clean['final_loss']})")
+    events = []
+    for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(p) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    summaries = [e for e in events if e["kind"] == "summary"]
+    if not summaries:
+        raise RuntimeError("memwatch smoke: no summary event landed")
+    headroom = summaries[-1]["gauges"].get("memory/hbm_headroom")
+    if headroom is None:
+        raise RuntimeError("memwatch smoke: no memory/hbm_headroom "
+                           "gauge in the JSONL summary")
+    if not [e for e in events if e["kind"] == "memory"]:
+        raise RuntimeError("memwatch smoke: no memory events landed")
+    return {"telemetry_dir": tel_dir, "postmortem": path,
+            "census_bytes": pm["census"]["total_bytes"],
+            "trend_points": len(pm["headroom_trend"]),
+            "compile_count": clean["compile_count"],
+            "hbm_headroom_gauge": headroom,
+            "hbm_headroom_pct": clean["hbm_headroom_pct"]}
+
+
 def _stages(smoke):
     import bench
 
@@ -289,6 +366,7 @@ def _stages(smoke):
             ("telemetry", None, lambda: _telemetry_smoke(bench)),
             ("resilience", None, lambda: _resilience_smoke(bench)),
             ("numerics", None, lambda: _numerics_smoke(bench)),
+            ("memwatch", None, lambda: _memwatch_smoke(bench)),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -342,6 +420,13 @@ def _stages(smoke):
         # smoke proving a targeted NaN is attributed to its module
         ("ddp_numerics", None, spec("ddp_numerics")),
         ("numerics", None, lambda: _numerics_smoke(bench)),
+        # round-10 compile & memory captures: the watched guarded DDP
+        # config (peak_hbm_bytes / hbm_headroom_pct / compile_count in
+        # the bench JSON) and the OOM chaos smoke proving an injected
+        # RESOURCE_EXHAUSTED yields an attributed memory post-mortem
+        # while the clean run stays at exactly one compile
+        ("ddp_memwatch", None, spec("ddp_memwatch")),
+        ("memwatch", None, lambda: _memwatch_smoke(bench)),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

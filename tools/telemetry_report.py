@@ -40,6 +40,7 @@ def load_events(paths):
 KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
+    "compile", "memory",
 })
 
 
@@ -55,6 +56,9 @@ def aggregate(events):
     amp = {"updates": 0, "overflows": 0, "growths": 0,
            "last_loss_scale": None}
     guard = {"skips": 0, "escalations": 0}
+    compiles = {}
+    memory = {"headroom_trend": [], "postmortems": [],
+              "preflight_warnings": 0, "zero_state": []}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -110,6 +114,39 @@ def aggregate(events):
                     guard["skips"] += 1
                 elif ev.get("name") == "escalate":
                     guard["escalations"] += 1
+            elif kind == "compile":
+                if ev.get("name") == "watch_summary":
+                    pass  # per-fn events carry the detail
+                else:
+                    c = compiles.setdefault(ev.get("name", "?"), {
+                        "count": 0, "total_s": 0.0, "recompiles": 0,
+                        "last_change": None})
+                    c["count"] += 1
+                    c["total_s"] += float(ev.get("call_seconds") or 0.0)
+                    if ev.get("changed"):
+                        c["recompiles"] += 1
+                        c["last_change"] = ev["changed"]
+            elif kind == "memory":
+                mname = ev.get("name")
+                if mname == "step_memory":
+                    memory["headroom_trend"].append({
+                        "peak_bytes": ev.get("peak_bytes"),
+                        "headroom_frac": ev.get("headroom_frac")})
+                elif mname == "postmortem":
+                    memory["postmortems"].append({
+                        "path": ev.get("path"),
+                        "error": ev.get("error")})
+                elif mname == "preflight_over_budget":
+                    memory["preflight_warnings"] += 1
+                elif mname == "zero_state_bytes":
+                    memory["zero_state"].append({
+                        "optimizer": ev.get("optimizer"),
+                        "world": ev.get("world"),
+                        "unsharded_state_bytes":
+                            ev.get("unsharded_state_bytes"),
+                        "sharded_state_bytes":
+                            ev.get("sharded_state_bytes"),
+                        "savings_ratio": ev.get("savings_ratio")})
             elif kind in KNOWN_KINDS:
                 pass  # known but needs no aggregation (checkpoint, ...)
             else:
@@ -128,6 +165,8 @@ def aggregate(events):
         "numerics": numerics,
         "amp": amp,
         "guard": guard,
+        "compiles": compiles,
+        "memory": memory,
         "unknown_kinds": unknown,
         "malformed_events": malformed,
         "counters": (last_summary or {}).get("counters", {}),
@@ -196,6 +235,42 @@ def print_report(report, out=sys.stdout):
     if guard.get("skips") or guard.get("escalations"):
         w(f"\nguard: {guard['skips']} skipped step(s), "
           f"{guard['escalations']} escalation(s)\n")
+    compiles = report.get("compiles") or {}
+    if compiles:
+        w("\ncompiles (watched functions):\n")
+        w(f"  {'name':<32} {'count':>6} {'total':>9} {'re':>4}  "
+          f"changed arg\n")
+        for name in sorted(compiles):
+            c = compiles[name]
+            change = ""
+            if c.get("last_change"):
+                first = c["last_change"][0]
+                change = (f"{first.get('arg')}: {first.get('old')} -> "
+                          f"{first.get('new')}")
+            w(f"  {name:<32} {c['count']:>6} {c['total_s']:>8.2f}s "
+              f"{c['recompiles']:>4}  {change}\n")
+    memory = report.get("memory") or {}
+    if memory.get("headroom_trend") or memory.get("postmortems") \
+            or memory.get("zero_state"):
+        trend = memory.get("headroom_trend") or []
+        w(f"\nmemory: {len(trend)} step_memory report(s)")
+        if trend:
+            last = trend[-1]
+            frac = last.get("headroom_frac")
+            w(f", last peak {_fmt_bytes(last.get('peak_bytes') or 0)}")
+            if frac is not None:
+                w(f" ({frac * 100:.2f}% headroom)")
+        w("\n")
+        if memory.get("preflight_warnings"):
+            w(f"  preflight: {memory['preflight_warnings']} over-budget "
+              f"warning(s)\n")
+        for z in memory.get("zero_state", []):
+            w(f"  zero [{z.get('optimizer')}] world={z.get('world')}: "
+              f"{_fmt_bytes(z.get('unsharded_state_bytes') or 0)} -> "
+              f"{_fmt_bytes(z.get('sharded_state_bytes') or 0)} "
+              f"({(z.get('savings_ratio') or 0):.2f}x)\n")
+        for pm in memory.get("postmortems", []):
+            w(f"  OOM postmortem -> {pm.get('path')}\n")
     unknown = report.get("unknown_kinds") or {}
     skipped = sum(unknown.values()) + report.get("malformed_events", 0)
     if skipped:
