@@ -7,6 +7,11 @@ static shapes throughout, cache carried as scan state. Compiled step
 functions are cached per (model, shape, sampling-config), so a serving
 loop pays compile cost once.
 
+The primitives are the pure module-level :func:`prefill` /
+:func:`decode_step` pair; :func:`generate` is a thin jit+scan wrapper
+over them, and ``apex_tpu.serving.ServeEngine`` vmaps the same pair
+over cache slots for continuous batching.
+
     model = GPTModel(cfg, decode=True)
     out = generate(model, params, prompt_tokens, max_new_tokens=64,
                    temperature=0.8, top_k=40, rng=jax.random.PRNGKey(0))
@@ -59,20 +64,52 @@ def _full_vocab(logits):
     return gather_from_tensor_model_parallel_region(logits)
 
 
+def prefill(model, params, cache, tokens, positions, *,
+            full_logits=False):
+    """Run one prompt chunk through the KV cache (pure, trace-friendly).
+
+    The reusable prefill building block: every compiled entry point
+    here (:func:`generate`'s jitted prefill, the serving engine's
+    per-slot AOT prefill) is this function under a ``jit``/``vmap`` of
+    the caller's choosing. ``positions`` is ``[b, s]`` (or ``[1, s]``)
+    absolute positions of ``tokens``. Returns ``(new_cache, logits)``
+    where ``logits`` is the full-vocabulary (tp-gathered) logits at the
+    LAST position ``[b, vocab]`` — or at every position ``[b, s,
+    vocab]`` with ``full_logits=True`` (a right-padded serving prefill
+    picks its own true-length position)."""
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              tokens, positions, mutable=["cache"])
+    if full_logits:
+        return mut["cache"], _full_vocab(logits)
+    return mut["cache"], _full_vocab(logits[:, -1])
+
+
+def decode_step(model, params, cache, tokens, positions):
+    """One incremental decode forward over the KV cache (pure).
+
+    ``tokens`` is ``[b, s]`` (s=1 in the serving hot loop), ``positions``
+    the matching absolute positions. Returns ``(new_cache, logits)``
+    with full-vocabulary logits at the last position ``[b, vocab]`` —
+    the sampling input for the next token. :func:`generate`'s scan body
+    and the serving engine's AOT decode step both consume this."""
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              tokens, positions, mutable=["cache"])
+    return mut["cache"], _full_vocab(logits[:, -1])
+
+
 @functools.lru_cache(maxsize=32)
 def _compiled(model, plen, max_new_tokens, temperature, top_k, top_p,
               eos_token_id, pad_token_id, prefix_len=0):
     """jitted prefill + scan-decode, cached per model/config (shape
     specialization is jit's own cache). ``prefix_len`` > 0 means the
     cache already holds a shared prefilled prefix: the prompt chunk and
-    the decode steps run at offset absolute positions."""
+    the decode steps run at offset absolute positions. Thin jit/scan
+    shells over the reusable :func:`prefill` / :func:`decode_step`."""
 
     @jax.jit
-    def prefill(params, cache, tokens):
-        logits, mut = model.apply(
-            {"params": params, "cache": cache}, tokens,
-            (prefix_len + jnp.arange(plen))[None, :], mutable=["cache"])
-        return mut["cache"], _full_vocab(logits[:, -1])
+    def prefill_fn(params, cache, tokens):
+        return prefill(model, params, cache, tokens,
+                       (prefix_len + jnp.arange(plen))[None, :])
 
     def step(params, carry, _):
         cache, logits, t, key, done = carry
@@ -83,18 +120,16 @@ def _compiled(model, plen, max_new_tokens, temperature, top_k, top_p,
         if eos_token_id is not None:
             done = done | (nxt == eos_token_id)
         pos = jnp.broadcast_to(t[None, None], (b, 1))
-        new_logits, mut = model.apply(
-            {"params": params, "cache": cache}, nxt[:, None], pos,
-            mutable=["cache"])
-        return ((mut["cache"], _full_vocab(new_logits[:, -1]), t + 1, key,
-                 done), nxt)
+        cache, new_logits = decode_step(model, params, cache,
+                                        nxt[:, None], pos)
+        return ((cache, new_logits, t + 1, key, done), nxt)
 
     @jax.jit
     def decode_all(params, init):
         return jax.lax.scan(functools.partial(step, params), init, None,
                             length=max_new_tokens)
 
-    return prefill, decode_all
+    return prefill_fn, decode_all
 
 
 def init_cache(model, batch_size: int, dtype_token=jnp.int32):

@@ -184,6 +184,43 @@ def dequantize_blockwise(q2d, scales, n=None):
     return out if n is None else out[:n]
 
 
+def quantize_rows_blockwise(x, block_size: int = BLOCK_SIZE):
+    """Per-row lane-blocked symmetric int8: ``[..., F]`` ->
+    ``(q [..., nb, block] int8, scales [..., nb, 1] fp32)``.
+
+    The KV-cache quantization primitive (apex_tpu.serving.kv_cache):
+    every leading-dim row (a cache position) is quantized independently
+    against its own per-256-lane-block absmax scales, so appending one
+    position never re-quantizes — and never drifts — the rest of the
+    cache. Same grid and kernels as the flat gradient path (the Pallas
+    gate applies; the parity oracle is the pure-jnp formulation)."""
+    lead, n = x.shape[:-1], x.shape[-1]
+    nb = num_blocks(n, block_size)
+    flat = jnp.pad(x.astype(jnp.float32).reshape(-1, n),
+                   ((0, 0), (0, nb * block_size - n)))
+    flat = flat.reshape(-1, block_size)
+    scales = block_scales(flat)
+    q = (_quantize_pallas(flat, scales) if _gate().enabled()
+         else _quantize_jnp(flat, scales))
+    return (q.reshape(*lead, nb, block_size),
+            scales.reshape(*lead, nb, 1))
+
+
+def dequantize_rows_blockwise(q, scales, n=None):
+    """Inverse of :func:`quantize_rows_blockwise`:
+    ``(q [..., nb, block], scales [..., nb, 1])`` -> ``[..., F]`` fp32
+    (``n`` truncates the zero-padded ragged tail; default keeps
+    ``nb * block`` lanes)."""
+    lead = q.shape[:-2]
+    block_size = q.shape[-1]
+    flat = q.reshape(-1, block_size)
+    s = scales.reshape(-1, 1)
+    out = (_dequantize_pallas(flat, s) if _gate().enabled()
+           else _dequantize_jnp(flat, s))
+    out = out.reshape(*lead, q.shape[-2] * block_size)
+    return out if n is None else out[..., :n]
+
+
 def init_residual(grads):
     """Zero error-feedback residual pytree matching ``grads`` (fp32
     leaves — the residual accumulates sub-ulp-of-bf16 errors)."""

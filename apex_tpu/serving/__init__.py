@@ -1,0 +1,37 @@
+"""apex_tpu.serving — AOT-compiled continuous-batching decode.
+
+The forward-only production path (ROADMAP open item 3): a
+:class:`~apex_tpu.serving.engine.ServeEngine` ahead-of-time compiles
+one prefill and one decode executable per (batch-bucket, seq-bucket)
+pair over a preallocated, slotted, optionally int8-quantized KV cache
+(:mod:`~apex_tpu.serving.kv_cache`), and a host-side
+:class:`~apex_tpu.serving.scheduler.Scheduler` continuously batches
+concurrent requests through it — admission into free slots, eviction
+on finish, per-request TTFT / per-token latency into the telemetry
+registry (``serve/*``). Compile count equals the bucket-ladder size
+and stays flat under any traffic shape (``assert_no_recompiles`` is a
+hard invariant of the steady state).
+
+Quickstart (docs/serving.md has the full tour)::
+
+    from apex_tpu.serving import (ServeConfig, ServeEngine,
+                                  synthetic_trace)
+    engine = ServeEngine(model, params, ServeConfig(
+        batch_buckets=(2, 4, 8), prefill_buckets=(16, 32),
+        num_slots=8, cache_mode="int8"))
+    completed, stats = engine.serve(synthetic_trace(32, seed=0))
+"""
+
+from apex_tpu.serving.engine import ServeConfig, ServeEngine  # noqa: F401
+from apex_tpu.serving.kv_cache import (  # noqa: F401
+    KVCacheSpec,
+    row_template,
+    store_lengths,
+    zero_row,
+)
+from apex_tpu.serving.scheduler import (  # noqa: F401
+    CompletedRequest,
+    Request,
+    Scheduler,
+    synthetic_trace,
+)

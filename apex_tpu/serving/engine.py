@@ -1,0 +1,412 @@
+"""ServeEngine — AOT-compiled, bucketed, continuous-batching decode.
+
+The forward-only production path the ROADMAP's open item 3 asks for.
+Shape discipline is the whole design: at startup the engine
+ahead-of-time compiles (``jax.jit(...).lower(...).compile()``) exactly
+ONE prefill executable per (batch-bucket, seq-bucket) pair and ONE
+decode executable per batch-bucket, registers every compile with the
+:class:`~apex_tpu.telemetry.compile_watch.CompileWatcher`, and from
+then on steady-state traffic — whatever its arrival pattern — only
+ever *calls* those executables. ``assert_no_recompiles`` around the
+serving loop is therefore a hard invariant, not a hope: the compile
+count equals the bucket-ladder size and stays flat as traffic varies
+(the compile watcher was built for exactly this; see
+docs/observability.md).
+
+The decode step reuses the model's own incremental-decode semantics:
+``generation.prefill`` / ``generation.decode_step`` vmapped over cache
+slots, each slot carrying its own ``cache_index`` so mixed sequence
+lengths coexist in one batch (greedy output is token-identical to
+``generation.generate`` for the bf16 cache — pinned in
+tests/L0/test_serving.py). The KV cache is the slotted store of
+:mod:`apex_tpu.serving.kv_cache`: sharded over the data axis,
+optionally int8-quantized with dequant-on-read inside the compiled
+step.
+
+Resource discipline mirrors the training substrate: cache preallocation
+(the dominant HBM cost) runs under ``telemetry.memory.oom_guard``, the
+decode step's budget is preflighted before any traffic, and every
+decode dispatch goes through ``resilience.guarded_call`` so a real (or
+injected) RESOURCE_EXHAUSTED writes a memory post-mortem instead of a
+bare traceback. See docs/serving.md for the operational tour.
+"""
+
+import dataclasses
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models import generation
+from apex_tpu.parallel import compression
+from apex_tpu.serving import kv_cache as kvc
+from apex_tpu.telemetry import compile_watch
+from apex_tpu.telemetry import memory as tmemory
+from apex_tpu.telemetry.registry import get_registry
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Static serving knobs — everything that shapes an executable.
+
+    ``batch_buckets`` is the decode ladder (active sequences pad up to
+    the smallest bucket that fits); ``prefill_buckets`` the prompt-
+    length ladder (prompts right-pad up to a bucket, the pad positions
+    stay masked by the cache's absolute-position attention). The AOT
+    compile count is ``len(batch_buckets) * len(prefill_buckets) +
+    len(batch_buckets)`` — fixed at startup, flat under any traffic.
+    """
+
+    batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64)
+    num_slots: int = 8
+    cache_mode: str = "bf16"            # "bf16" | "int8"
+    block_size: int = compression.BLOCK_SIZE
+    temperature: float = 0.0            # 0 = greedy
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    data_axis: str = "data"             # mesh axis the slot dim shards over
+    donate: bool = True                 # donate the store through the step
+    preflight: bool = True
+    preflight_strict: bool = False
+
+
+class ServeEngine:
+    """AOT-compiled prefill/decode over a slotted KV cache.
+
+    The engine owns the device store and the compiled executables; it
+    is deliberately ignorant of *requests* — admission, eviction, and
+    latency accounting live in
+    :class:`~apex_tpu.serving.scheduler.Scheduler` (which
+    :meth:`serve` constructs for the common case). ``slot_ids`` in the
+    host API are plain Python ints; padding a bucket uses caller-
+    provided FREE slots (distinct ids — a duplicate scatter would
+    collide), which the scheduler always has by construction.
+    """
+
+    def __init__(self, model, params, config: ServeConfig = None, *,
+                 mesh=None, watcher=None, registry=None):
+        from apex_tpu.transformer.parallel_state import (
+            get_tensor_model_parallel_world_size,
+        )
+
+        if get_tensor_model_parallel_world_size() > 1:
+            raise NotImplementedError(
+                "ServeEngine drives a tp=1 model (shard the cache over "
+                "the data axis; a TP serving loop composes later)")
+        if not getattr(model, "decode", False):
+            raise ValueError("ServeEngine needs a model built with "
+                             "decode=True")
+        config = config or ServeConfig()
+        if not config.batch_buckets or not config.prefill_buckets:
+            raise ValueError("empty bucket ladder")
+        bb = tuple(sorted(set(int(b) for b in config.batch_buckets)))
+        sb = tuple(sorted(set(int(s) for s in config.prefill_buckets)))
+        if bb[-1] > config.num_slots:
+            raise ValueError(
+                f"largest batch bucket ({bb[-1]}) exceeds num_slots "
+                f"({config.num_slots}) — a bucket gathers distinct slots")
+        limit = model.config.max_position_embeddings
+        if sb[-1] > limit:
+            raise ValueError(
+                f"largest prefill bucket ({sb[-1]}) exceeds "
+                f"max_position_embeddings ({limit})")
+        if mesh is not None and config.num_slots % mesh.devices.size:
+            raise ValueError(
+                f"num_slots ({config.num_slots}) must divide evenly "
+                f"over the {mesh.devices.size}-device mesh")
+        self.model = model
+        self.config = dataclasses.replace(config, batch_buckets=bb,
+                                          prefill_buckets=sb)
+        self.mesh = mesh
+        self.max_len = limit
+        self._watcher = watcher if watcher is not None \
+            else compile_watch.get_watcher()
+        self._registry = registry
+        self.spec = kvc.KVCacheSpec(model, config.num_slots,
+                                    mode=config.cache_mode,
+                                    block_size=config.block_size)
+
+        # --- allocate the store (THE serving HBM cost) under the OOM
+        # post-mortem handler, then commit shardings ---------------------
+        labels = {"params": params}
+        with tmemory.oom_guard(registry=registry, labels=labels):
+            store = self.spec.allocate()
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._sharded = NamedSharding(
+                    mesh, PartitionSpec(config.data_axis))
+                self._replicated = NamedSharding(mesh, PartitionSpec())
+                store = jax.device_put(store, self._sharded)
+                params = jax.device_put(params, self._replicated)
+            else:
+                self._sharded = self._replicated = None
+        self._store = store
+        self._params = params
+        self._key0 = jax.random.PRNGKey(0)
+        self._step_counter = 0
+
+        # --- AOT compile the whole ladder, registered with the watcher --
+        self._decode_exec = {}
+        self._prefill_exec = {}
+        self.aot_compile_seconds = 0.0
+        decode_lowered = None
+        with tmemory.oom_guard(registry=registry, labels=labels):
+            for b in self.config.batch_buckets:
+                args = (self._store, self._params,
+                        self._ids_aval(b), self._ids_aval(b),
+                        self._key0)
+                lowered = jax.jit(
+                    self._decode_fn,
+                    donate_argnums=(0,) if config.donate else ()
+                ).lower(*args)
+                self._decode_exec[b] = self._compile(
+                    lowered, f"serve/{config.cache_mode}/decode_b{b}", args)
+                decode_lowered = lowered
+                for s in self.config.prefill_buckets:
+                    pargs = (self._store, self._params,
+                             self._ids_aval(b),
+                             self._tokens_aval(b, s),
+                             self._ids_aval(b), self._key0)
+                    plow = jax.jit(
+                        self._prefill_fn,
+                        donate_argnums=(0,) if config.donate else ()
+                    ).lower(*pargs)
+                    self._prefill_exec[(b, s)] = self._compile(
+                        plow, f"serve/{config.cache_mode}/prefill_b{b}_s{s}", pargs)
+        if config.temperature:
+            # warm the host-side PRNG fold so the first sampled step
+            # inside an assert_no_recompiles window compiles nothing
+            jax.random.fold_in(self._key0, 0).block_until_ready()
+
+        # --- HBM accounting: the decode step IS the steady state --------
+        self.memory_report = None
+        if config.preflight and decode_lowered is not None:
+            self.memory_report = tmemory.report_from_lowered(
+                decode_lowered, registry=registry, name="serve/decode")
+            rep = self.memory_report
+            if rep is not None and rep.get("headroom_frac") is not None \
+                    and rep["headroom_frac"] < 0.0:
+                msg = (f"serve decode step peak "
+                       f"{rep['peak_bytes'] / 1e9:.2f} GB exceeds HBM "
+                       f"capacity {rep['capacity_bytes'] / 1e9:.2f} GB "
+                       f"— shrink num_slots, the bucket ladder, or "
+                       f"switch cache_mode='int8'")
+                if config.preflight_strict:
+                    raise tmemory.MemoryBudgetError(msg)
+                import warnings
+
+                warnings.warn(msg, stacklevel=2)
+
+        reg = self._reg()
+        if reg.enabled:
+            reg.gauge("serve/kv_cache_bytes").set(self.kv_cache_bytes())
+            reg.counter("serve/aot_compiles").inc(self.compile_count)
+            reg.event("serve", "engine_start",
+                      batch_buckets=list(self.config.batch_buckets),
+                      prefill_buckets=list(self.config.prefill_buckets),
+                      num_slots=config.num_slots,
+                      cache_dtype=self.spec.cache_dtype_name(),
+                      kv_cache_bytes=self.kv_cache_bytes(),
+                      compile_count=self.compile_count,
+                      aot_compile_seconds=round(
+                          self.aot_compile_seconds, 4))
+
+    # -- small helpers -----------------------------------------------------
+
+    def _reg(self):
+        return self._registry or get_registry()
+
+    def _compile(self, lowered, name, args):
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        self.aot_compile_seconds += dt
+        self._watcher.record_aot(name, args, seconds=dt)
+        return compiled
+
+    def _ids_aval(self, b):
+        return self._put(np.zeros((b,), np.int32))
+
+    def _tokens_aval(self, b, s):
+        return self._put(np.zeros((b, s), np.int32))
+
+    def _put(self, x):
+        x = np.asarray(x)
+        if self._replicated is not None:
+            return jax.device_put(x, self._replicated)
+        return jnp.asarray(x)
+
+    def _key(self):
+        if not self.config.temperature:
+            return self._key0
+        self._step_counter += 1
+        return jax.random.fold_in(self._key0, self._step_counter)
+
+    @property
+    def compile_count(self):
+        """AOT executables compiled at startup — the serving compile
+        budget, by construction flat under any traffic shape."""
+        return len(self._decode_exec) + len(self._prefill_exec)
+
+    def kv_cache_bytes(self):
+        return self.spec.total_bytes()
+
+    def slot_lengths(self):
+        """Host copy of the per-slot fill levels (one tiny fetch)."""
+        return np.asarray(kvc.store_lengths(self._store))
+
+    def _pick_bucket(self, ladder, n, what):
+        for b in ladder:
+            if n <= b:
+                return b
+        raise ValueError(f"{what} ({n}) exceeds the largest bucket "
+                         f"({ladder[-1]})")
+
+    # -- the compiled step bodies (pure; AOT-lowered at startup) -----------
+
+    def _sample(self, logits, key):
+        cfg = self.config
+        return generation.sample_logits(
+            logits, key, cfg.temperature, cfg.top_k, cfg.top_p
+        ).astype(jnp.int32)
+
+    def _prefill_fn(self, store, params, slot_ids, tokens, true_len,
+                    key):
+        """Admit a bucket: fresh per-slot prefill at padded length S,
+        cache_index rolled back to each row's true length (pad
+        positions stay resident but masked — the speculative-decode
+        rollback trick), first token sampled from the true last
+        position's logits."""
+        s = tokens.shape[1]
+
+        def one(tok_row, n):
+            cache, logits = generation.prefill(
+                self.model, params, kvc.zero_row(self.spec.template),
+                tok_row[None, :], jnp.arange(s)[None, :],
+                full_logits=True)
+            last = logits[0, n - 1]                  # [vocab], true last
+            return generation._set_cache_index(cache, n), last
+
+        rows, last_logits = jax.vmap(one)(tokens, true_len)
+        first = self._sample(last_logits, key)
+        rows = self.spec.quantize_rows(rows)
+        store = jax.tree_util.tree_map(
+            lambda st, r: st.at[slot_ids].set(r), store, rows)
+        return store, first
+
+    def _decode_fn(self, store, params, slot_ids, tokens, key):
+        """One continuous-batching decode step over a slot bucket:
+        gather rows, dequantize on read, run the model's own decode
+        attention per slot at its own length, re-quantize ONLY the
+        appended position, scatter back, sample."""
+        rows = jax.tree_util.tree_map(lambda l: l[slot_ids], store)
+        model_rows = self.spec.materialize_rows(rows)
+        lengths = kvc.store_lengths(model_rows)
+
+        def one(cache_row, tok, n):
+            cache_row = generation._set_cache_index(cache_row, n)
+            cache_row, logits = generation.decode_step(
+                self.model, params, cache_row, tok[None, None],
+                jnp.full((1, 1), n, jnp.int32))
+            return cache_row, logits[0]
+
+        new_rows, logits = jax.vmap(one)(model_rows, tokens, lengths)
+        nxt = self._sample(logits, key)
+        updated = self.spec.update_rows_at(rows, new_rows, lengths)
+        store = jax.tree_util.tree_map(
+            lambda st, r: st.at[slot_ids].set(r), store, updated)
+        return store, nxt
+
+    # -- host API (the scheduler's surface) --------------------------------
+
+    def _padded_ids(self, slot_ids, pad_slot_ids, bucket):
+        ids = list(int(i) for i in slot_ids)
+        need = bucket - len(ids)
+        if need:
+            pads = [int(i) for i in (pad_slot_ids or ())
+                    if int(i) not in ids][:need]
+            if len(pads) < need:
+                raise ValueError(
+                    f"bucket {bucket} needs {need} pad slot(s) but only "
+                    f"{len(pads)} free id(s) were provided — pad ids "
+                    f"must be distinct unused slots (a duplicate "
+                    f"scatter would collide)")
+            ids += pads
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate slot ids in {ids}")
+        return ids
+
+    def prefill(self, slot_ids, prompts, *, pad_slot_ids=None):
+        """Prefill ``prompts[i]`` (unpadded 1-D int arrays) into
+        ``slot_ids[i]`` and return the first generated token per
+        prompt, ``np.ndarray [len(prompts)]``. Pads the call up to the
+        smallest (batch, seq) bucket pair; TTFT is this call's wall
+        clock (it blocks on the sampled tokens)."""
+        if len(slot_ids) != len(prompts):
+            raise ValueError("slot_ids and prompts disagree")
+        n = len(prompts)
+        plens = [len(p) for p in prompts]
+        if min(plens) < 1:
+            raise ValueError("empty prompt")
+        sbucket = self._pick_bucket(self.config.prefill_buckets,
+                                    max(plens), "prompt length")
+        bbucket = self._pick_bucket(self.config.batch_buckets, n,
+                                    "prefill batch")
+        ids = self._padded_ids(slot_ids, pad_slot_ids, bbucket)
+        toks = np.full((bbucket, sbucket), self.config.pad_token_id,
+                       np.int32)
+        lens = np.ones((bbucket,), np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, :plens[i]] = np.asarray(p, np.int32)
+            lens[i] = plens[i]
+        self._store, first = self._prefill_exec[(bbucket, sbucket)](
+            self._store, self._params, self._put(np.asarray(ids,
+                                                            np.int32)),
+            self._put(toks), self._put(lens), self._key())
+        return np.asarray(first)[:n]
+
+    def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
+               guarded=True):
+        """One decode step for the active ``slot_ids`` fed their last
+        ``tokens``; returns the next token per slot,
+        ``np.ndarray [len(slot_ids)]``. Runs under
+        ``resilience.guarded_call`` (``guarded=False`` opts out): an
+        HBM exhaustion mid-traffic writes the memory post-mortem and
+        surfaces as ``HBMExhaustedError``."""
+        n = len(slot_ids)
+        bbucket = self._pick_bucket(self.config.batch_buckets, n,
+                                    "decode batch")
+        ids = self._padded_ids(slot_ids, pad_slot_ids, bbucket)
+        toks = np.zeros((bbucket,), np.int32)
+        toks[:n] = np.asarray(tokens, np.int32)
+        args = (self._store, self._params,
+                self._put(np.asarray(ids, np.int32)), self._put(toks),
+                self._key())
+        if guarded:
+            from apex_tpu import resilience
+
+            store, nxt = resilience.guarded_call(
+                self._decode_exec[bbucket], *args,
+                registry=self._registry,
+                labels={"params": self._params})
+        else:
+            store, nxt = self._decode_exec[bbucket](*args)
+        self._store = store
+        return np.asarray(nxt)[:n]
+
+    def serve(self, requests, **kw):
+        """Run a request list to completion through a fresh
+        :class:`~apex_tpu.serving.scheduler.Scheduler`; returns
+        ``(completed, stats)``. The convenience entry point bench.py's
+        ``serve_decode`` and the oneproc serve smoke drive."""
+        from apex_tpu.serving.scheduler import Scheduler
+
+        sched = Scheduler(self, registry=self._registry)
+        completed = sched.run(requests, **kw)
+        return completed, sched.stats()

@@ -1,0 +1,286 @@
+"""Slotted KV-cache store for continuous-batching decode.
+
+The serving cache is an **explicit pytree**, not a flax variable
+collection: one *slot* per concurrent sequence, preallocated for the
+model's full ``max_position_embeddings`` window, with every leaf
+carrying a leading ``[num_slots]`` axis. The layout is derived from the
+model itself (``jax.eval_shape`` of its ``decode=True`` init — no
+parameters materialize), so any architecture the incremental-decode
+path supports (MHA/GQA, rope/learned positions, ``scan_layers``) gets
+a correct store for free.
+
+Why slots: continuous batching admits and evicts *individual*
+sequences while the decode step keeps one static shape. The engine
+gathers a bucket of slot rows, runs the model's own decode attention
+per row (each slot carries its own scalar ``cache_index``, so mixed
+sequence lengths coexist), and scatters the rows back — admission is a
+prefill-scatter into free slots, eviction is just forgetting a slot id.
+
+Sharding: every leaf's leading axis is the slot axis, so one
+``NamedSharding(mesh, P(data_axis))`` spreads the store — byte-for-byte
+the dominant HBM cost of serving — across the data axis of the mesh.
+
+int8 mode (``mode="int8"``): K/V leaves are stored as blockwise
+symmetric int8 with fp32 scales per ``block_size``-lane block —
+``parallel.compression``'s gradient-collective scheme pointed at the
+cache (EQuARX-adjacent: the quantized-block layout stays collective-
+friendly). Each cache *position* quantizes independently
+(:func:`~apex_tpu.parallel.compression.quantize_rows_blockwise` over
+the flattened ``[groups * head_dim]`` feature lanes), so appending one
+token's K/V never re-quantizes — and never drifts — previously written
+positions. Reads dequantize on the fly inside the compiled decode step
+(:meth:`KVCacheSpec.materialize_rows`); the error per lane is bounded
+by half a quantization step, ``absmax_block / 254`` — the same
+per-block bound the compression tests pin, inherited verbatim here
+(tests/L0/test_serving.py holds a 64-token decode to it).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.parallel import compression
+
+# flax decode-cache leaf naming (transformer_lm._decode_attention):
+# cached_key / cached_value hold K/V, cache_index the scalar fill level.
+KV_LEAF_PREFIX = "cached_"
+CACHE_INDEX = "cache_index"
+
+CACHE_MODES = ("bf16", "int8")
+
+
+def _names(path):
+    return tuple(str(getattr(e, "key", getattr(e, "idx", e)))
+                 for e in path)
+
+
+def _is_kv(names):
+    return bool(names) and names[-1].startswith(KV_LEAF_PREFIX)
+
+
+def row_template(model, token_dtype=jnp.int32):
+    """ShapeDtypeStruct pytree of ONE slot's cache (batch 1) for a
+    ``decode=True`` model — a shape-only trace, no params materialize
+    (the serving sibling of ``generation.init_cache``)."""
+    dummy = jnp.zeros((1, 1), token_dtype)
+    return jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), dummy))["cache"]
+
+
+def zero_row(template):
+    """Concrete zeroed cache row from a :func:`row_template` tree
+    (trace-friendly: the serving prefill builds fresh rows in-graph)."""
+    return jax.tree_util.tree_map(
+        lambda sd: jnp.zeros(sd.shape, sd.dtype), template)
+
+
+def store_lengths(store):
+    """Per-slot fill level ``[num_slots] i32`` from the first
+    ``cache_index`` leaf (all layers agree — the engine keeps them in
+    lockstep, like ``generation._set_cache_index``)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(store)[0]:
+        names = _names(path)
+        if names and names[-1] == CACHE_INDEX:
+            return leaf.reshape(leaf.shape[0], -1)[:, 0]
+    raise ValueError("store has no cache_index leaf — not a decode "
+                     "cache pytree")
+
+
+class KVCacheSpec:
+    """Host-side layout descriptor + the pure in-graph conversion
+    helpers between the slotted store and model-ready cache rows.
+
+    Everything here is trace-friendly (pure jnp): the engine calls
+    these inside its AOT-compiled prefill/decode steps. The spec itself
+    holds only shapes and static config — it never owns device memory
+    (the engine owns the store array it allocates here).
+    """
+
+    def __init__(self, model, num_slots, *, mode="bf16",
+                 block_size=compression.BLOCK_SIZE,
+                 token_dtype=jnp.int32):
+        if mode not in CACHE_MODES:
+            raise ValueError(f"cache mode {mode!r} not in {CACHE_MODES}")
+        if num_slots < 1:
+            raise ValueError(f"num_slots ({num_slots}) must be >= 1")
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.mode = mode
+        self.block_size = int(block_size)
+        self.template = row_template(model, token_dtype)
+        # path -> template ShapeDtypeStruct, for shape/dtype recovery
+        # when materializing quantized leaves
+        self._by_path = {
+            _names(p): sd for p, sd in
+            jax.tree_util.tree_flatten_with_path(self.template)[0]}
+
+    # -- layout ------------------------------------------------------------
+
+    def _kv_feature_width(self, sd):
+        """Lanes per cache position: the trailing (batch=1, groups,
+        head_dim) axes flattened — the blockwise quantization row."""
+        return int(np.prod(sd.shape[-3:]))
+
+    def _block_size(self, sd):
+        """Effective block for this leaf: the configured 256-lane grid,
+        clamped to the feature width — a model whose per-position K/V
+        row is narrower than one block would otherwise store zero-
+        padded lanes at full price (observed 2x blowup on toy heads)."""
+        return min(self.block_size, self._kv_feature_width(sd))
+
+    def _num_blocks(self, sd):
+        return compression.num_blocks(self._kv_feature_width(sd),
+                                      self._block_size(sd))
+
+    def allocate(self):
+        """Zeroed slotted store: every template leaf stacked to
+        ``[num_slots, ...]``; in int8 mode K/V leaves become
+        ``{"q": int8 [..., nb, block], "scale": f32 [..., nb, 1]}``
+        subtrees (positions axis preserved, feature lanes blocked)."""
+        def leaf(path, sd):
+            names = _names(path)
+            if self.mode == "int8" and _is_kv(names):
+                lead = (self.num_slots,) + tuple(sd.shape[:-3])
+                nb = self._num_blocks(sd)
+                return {
+                    "q": jnp.zeros(lead + (nb, self._block_size(sd)),
+                                   jnp.int8),
+                    "scale": jnp.zeros(lead + (nb, 1), jnp.float32),
+                }
+            return jnp.zeros((self.num_slots,) + tuple(sd.shape),
+                             sd.dtype)
+
+        return jax.tree_util.tree_map_with_path(leaf, self.template)
+
+    # -- bytes accounting --------------------------------------------------
+
+    def _leaf_bytes(self, sd, *, kv_itemsize=None):
+        if kv_itemsize is None:
+            kv_itemsize = jnp.dtype(sd.dtype).itemsize
+        return int(np.prod(sd.shape)) * kv_itemsize
+
+    def bytes_per_slot(self, *, kv_itemsize=None):
+        """Device bytes one slot occupies. ``kv_itemsize`` overrides
+        the K/V element width (e.g. 4 for the fp32-equivalent model in
+        docs/serving.md); int8 mode counts 1 byte per lane PLUS the
+        fp32 scale per ``block_size`` lanes — the honest,
+        scale-inclusive figure."""
+        total = 0
+        for names, sd in self._by_path.items():
+            if _is_kv(names):
+                if self.mode == "int8" and kv_itemsize is None:
+                    positions = int(np.prod(sd.shape[:-3]))
+                    nb = self._num_blocks(sd)
+                    total += positions * nb * (self._block_size(sd) + 4)
+                else:
+                    total += self._leaf_bytes(sd, kv_itemsize=kv_itemsize)
+            else:
+                total += self._leaf_bytes(sd)
+        return total
+
+    def total_bytes(self, **kw):
+        return self.num_slots * self.bytes_per_slot(**kw)
+
+    def cache_dtype_name(self):
+        if self.mode == "int8":
+            return "int8"
+        for names, sd in self._by_path.items():
+            if _is_kv(names):
+                return jnp.dtype(sd.dtype).name
+        return "bf16"
+
+    # -- store <-> model-row conversion (pure, in-graph) -------------------
+
+    def materialize_rows(self, rows):
+        """Quantized store rows -> the model-ready cache tree (K/V at
+        the template dtype, dequantized on read). Identity in bf16
+        mode. Works on a gathered bucket ``[B, ...]`` or a single row
+        alike (shapes come from the leading dims of ``q``)."""
+        if self.mode != "int8":
+            return rows
+
+        def fix(path, leaf):
+            if not (isinstance(leaf, dict) and "q" in leaf):
+                return leaf
+            sd = self._by_path[_names(path)]
+            n = self._kv_feature_width(sd)
+            out = compression.dequantize_rows_blockwise(
+                leaf["q"], leaf["scale"], n=n)
+            return out.reshape(leaf["q"].shape[:-2] + tuple(sd.shape[-3:])
+                               ).astype(sd.dtype)
+
+        return jax.tree_util.tree_map_with_path(
+            fix, rows,
+            is_leaf=lambda l: isinstance(l, dict) and "q" in l)
+
+    def quantize_rows(self, rows):
+        """Model-ready cache rows -> store layout (full-row quantize).
+        Only correct for FRESH rows (admission prefill): every position
+        gets new scales, so calling this on a row holding previously
+        quantized content would re-quantize it against a drifted grid —
+        the decode hot path uses :meth:`update_rows_at` instead."""
+        if self.mode != "int8":
+            return rows
+
+        def fix(path, leaf):
+            if not _is_kv(_names(path)):
+                return leaf
+            lead = leaf.shape[:-3]
+            q, s = compression.quantize_rows_blockwise(
+                leaf.reshape(lead + (-1,)),
+                self._block_size(self._by_path[_names(path)]))
+            return {"q": q, "scale": s}
+
+        return jax.tree_util.tree_map_with_path(fix, rows)
+
+    def update_rows_at(self, store_rows, new_rows, positions):
+        """Merge one decode step's K/V append back into quantized rows.
+
+        ``store_rows`` is the gathered (still-quantized) bucket,
+        ``new_rows`` the model-ready rows after the decode forward
+        (each row's K/V updated at its own ``positions[i]``), and only
+        that single position is (re)quantized per row — every other
+        block's int8 payload and scale pass through bit-identical, the
+        no-drift invariant the parity test pins. bf16 mode returns
+        ``new_rows`` unchanged."""
+        if self.mode != "int8":
+            return new_rows
+        flat_store, treedef = jax.tree_util.tree_flatten_with_path(
+            store_rows,
+            is_leaf=lambda l: isinstance(l, dict) and "q" in l)
+        new_by_path = {
+            _names(p): leaf for p, leaf in
+            jax.tree_util.tree_flatten_with_path(new_rows)[0]}
+        b = positions.shape[0]
+        out = []
+        for path, leaf in flat_store:
+            names = _names(path)
+            if not (isinstance(leaf, dict) and "q" in leaf):
+                out.append(new_by_path[names])
+                continue
+            sd = self._by_path[names]
+            x = new_by_path[names]                       # [B, *mid, T,1,g,d]
+            n = self._kv_feature_width(sd)
+            flat = x.reshape(x.shape[:-3] + (-1,))       # [B, *mid, T, F]
+            idx = positions.reshape((b,) + (1,) * (flat.ndim - 1))
+            sel = jnp.take_along_axis(flat, idx, axis=-2)  # [B, *mid, 1, F]
+            q_new, s_new = compression.quantize_rows_blockwise(
+                sel, self._block_size(sd))               # [B,*mid,1,nb,*]
+            q_old, s_old = leaf["q"], leaf["scale"]
+            t = q_old.shape[-3]
+            mask = (jnp.arange(t).reshape((t, 1, 1))
+                    == positions.reshape((b,) + (1,) * (q_old.ndim - 1)))
+            out.append({
+                "q": jnp.where(mask, q_new, q_old),
+                "scale": jnp.where(mask, s_new, s_old),
+            })
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- per-block parity bound --------------------------------------------
+
+    def quantization_bound(self, kv_absmax):
+        """Worst-case per-lane dequantization error for a block whose
+        absmax is ``kv_absmax``: half a grid step, ``absmax / 254``
+        (the symmetric int8 grid spans [-127, 127]). The documented
+        bound the int8-vs-bf16 decode parity test holds per read."""
+        return float(kv_absmax) / (2.0 * 127.0)

@@ -1,0 +1,312 @@
+"""Host-side continuous batching: admit, decode, evict — and account.
+
+The scheduler is deliberately plain Python over numpy: it owns the
+request queue and the slot map, and the ONLY device work it triggers is
+calls into the engine's AOT-compiled executables — nothing here can
+compile, which is what lets a whole serving trace run under
+``assert_no_recompiles``.
+
+Time has two faces here. *Arrivals* are virtual — ``Request.arrival``
+is measured in decode ticks (one tick per scheduler step), so a trace
+is deterministic: the same seed yields the same admission schedule, the
+same bucket sequence, and therefore the same (zero) steady-state
+compile count on every run, regardless of host speed. *Latencies* are
+wall-clock — TTFT runs from the moment a request became eligible
+(arrival tick reached) to its first sampled token landing on the host,
+so queueing-for-a-slot time counts, which is the honest serving number.
+
+Telemetry (``serve/*``, docs/serving.md has the glossary): ``serve/ttft``
+and ``serve/tok_latency`` histograms (milliseconds; p50/p99 from the
+registry's reservoir), ``serve/slot_occupancy`` gauge,
+``serve/tokens_generated`` / ``serve/requests_completed`` counters, a
+``serve`` JSONL event per completed request, and a ``kv_cache`` slot
+census event at end of run (slots used/free, bytes per slot, cache
+dtype — tools/memory_report.py renders it).
+"""
+
+import dataclasses
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from apex_tpu.telemetry.registry import get_registry
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request. ``arrival`` is in decode ticks (virtual
+    time — see module docstring); ``max_new_tokens`` bounds generation
+    (eos, when the engine's config defines one, may end it earlier)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival: float = 0.0
+
+
+@dataclasses.dataclass
+class CompletedRequest:
+    rid: int
+    tokens: np.ndarray          # generated tokens (prompt excluded)
+    ttft_s: float               # eligible -> first token, wall clock
+    mean_tok_latency_s: float   # decode steps only (excludes TTFT)
+    finish_reason: str          # "length" | "eos"
+
+
+def synthetic_trace(n_requests=16, *, seed=0, mean_interarrival=0.5,
+                    prompt_lens=(4, 8, 12, 24), max_new=(8, 16, 24),
+                    vocab_size=256):
+    """Deterministic many-user trace: Poisson arrivals (exponential
+    inter-arrival gaps in decode ticks) with mixed prompt/output
+    lengths — the bench.py ``serve_decode`` workload. Same seed, same
+    trace, byte for byte."""
+    rs = np.random.RandomState(seed)
+    gaps = rs.exponential(mean_interarrival, size=n_requests)
+    arrivals = np.cumsum(gaps) - gaps[0]        # first request at t=0
+    out = []
+    for i in range(n_requests):
+        plen = int(rs.choice(prompt_lens))
+        out.append(Request(
+            rid=i,
+            prompt=rs.randint(0, vocab_size, size=plen).astype(np.int32),
+            max_new_tokens=int(rs.choice(max_new)),
+            arrival=float(arrivals[i])))
+    return out
+
+
+class _Active:
+    __slots__ = ("req", "tokens", "last", "latencies", "ttft_s")
+
+    def __init__(self, req, first_token, ttft_s):
+        self.req = req
+        self.tokens = [int(first_token)]
+        self.last = int(first_token)
+        self.latencies = []
+        self.ttft_s = float(ttft_s)
+
+
+class Scheduler:
+    """Continuous batching over one :class:`ServeEngine`.
+
+    One :meth:`step` = admit every eligible request into free slots
+    (grouped prefills), then one decode pass over the active set
+    (padded to the engine's batch bucket with distinct free slots),
+    then evict finished sequences. :meth:`run` drives a request list to
+    completion; fast-forwards virtual time across idle gaps so a sparse
+    trace never spins.
+    """
+
+    def __init__(self, engine, *, registry=None,
+                 clock=time.perf_counter):
+        self.engine = engine
+        self._registry = registry
+        self._clock = clock
+        self.num_slots = engine.config.num_slots
+        self.free = list(range(self.num_slots))
+        self.pending: List[Request] = []
+        self.active = {}                      # slot -> _Active
+        self.completed: List[CompletedRequest] = []
+        self.tick = 0.0
+        self.decode_steps = 0
+        self.prefill_calls = 0
+        self.tokens_generated = 0
+        self._eligible_wall = {}
+        self._ttft_ms = []
+        self._tok_latency_ms = []
+        self._t_start = None
+        self._t_end = None
+
+    def _reg(self):
+        return self._registry or get_registry()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, request: Request):
+        plen = len(request.prompt)
+        eng = self.engine
+        if plen > eng.config.prefill_buckets[-1]:
+            raise ValueError(
+                f"request {request.rid}: prompt ({plen}) exceeds the "
+                f"largest prefill bucket "
+                f"({eng.config.prefill_buckets[-1]})")
+        if plen + request.max_new_tokens > eng.max_len:
+            raise ValueError(
+                f"request {request.rid}: prompt ({plen}) + "
+                f"max_new_tokens ({request.max_new_tokens}) exceeds "
+                f"max_position_embeddings ({eng.max_len})")
+        self.pending.append(request)
+        self.pending.sort(key=lambda r: (r.arrival, r.rid))
+
+    # -- the three phases --------------------------------------------------
+
+    def _admit(self):
+        now = self._clock()
+        eligible = [r for r in self.pending if r.arrival <= self.tick]
+        for r in eligible:
+            self._eligible_wall.setdefault(r.rid, now)
+        buckets = self.engine.config.batch_buckets
+        while eligible and self.free:
+            # the prefill call occupies a whole batch bucket (real +
+            # pad slots, all distinct), so the group must shrink to the
+            # largest bucket that fits entirely inside the free pool
+            fits = [b for b in buckets if b <= len(self.free)]
+            if not fits:
+                break
+            group = eligible[:min(len(self.free), fits[-1])]
+            eligible = eligible[len(group):]
+            for r in group:
+                self.pending.remove(r)
+            slots = [self.free.pop(0) for _ in group]
+            t0 = self._clock()
+            first = self.engine.prefill(
+                slots, [r.prompt for r in group],
+                pad_slot_ids=self.free)
+            t1 = self._clock()
+            self.prefill_calls += 1
+            reg = self._reg()
+            for slot, r, tok in zip(slots, group, first):
+                ttft = t1 - self._eligible_wall[r.rid]
+                self._ttft_ms.append(ttft * 1e3)
+                reg.histogram("serve/ttft").observe(ttft * 1e3)
+                reg.counter("serve/requests_admitted").inc()
+                self.tokens_generated += 1
+                st = _Active(r, tok, ttft)
+                if self._finished(st):
+                    self._evict(slot, st)
+                else:
+                    self.active[slot] = st
+
+    def _finished(self, st):
+        eos = self.engine.config.eos_token_id
+        if eos is not None and st.last == eos:
+            return True
+        return len(st.tokens) >= st.req.max_new_tokens
+
+    def _decode_once(self):
+        if not self.active:
+            return
+        max_bucket = self.engine.config.batch_buckets[-1]
+        slots = sorted(self.active)
+        for i in range(0, len(slots), max_bucket):
+            chunk = slots[i:i + max_bucket]
+            toks = [self.active[s].last for s in chunk]
+            t0 = self._clock()
+            nxt = self.engine.decode(chunk, toks,
+                                     pad_slot_ids=self.free)
+            dt = self._clock() - t0
+            self.decode_steps += 1
+            reg = self._reg()
+            reg.counter("serve/decode_steps").inc()
+            for s, tok in zip(chunk, nxt):
+                st = self.active[s]
+                st.tokens.append(int(tok))
+                st.last = int(tok)
+                st.latencies.append(dt)
+                self._tok_latency_ms.append(dt * 1e3)
+                reg.histogram("serve/tok_latency").observe(dt * 1e3)
+                self.tokens_generated += 1
+                if self._finished(st):
+                    del self.active[s]
+                    self._evict(s, st)
+
+    def _evict(self, slot, st):
+        if slot in self.active:
+            del self.active[slot]
+        self.free.append(slot)
+        self.free.sort()
+        eos = self.engine.config.eos_token_id
+        reason = "eos" if (eos is not None and st.last == eos) \
+            else "length"
+        rec = CompletedRequest(
+            rid=st.req.rid,
+            tokens=np.asarray(st.tokens, np.int32),
+            ttft_s=st.ttft_s,
+            mean_tok_latency_s=(float(np.mean(st.latencies))
+                                if st.latencies else 0.0),
+            finish_reason=reason)
+        self.completed.append(rec)
+        reg = self._reg()
+        reg.counter("serve/requests_completed").inc()
+        reg.counter("serve/tokens_generated").inc(len(st.tokens))
+        reg.event("serve", "request_done", rid=st.req.rid,
+                  tokens=len(st.tokens),
+                  prompt_len=len(st.req.prompt),
+                  ttft_ms=round(rec.ttft_s * 1e3, 3),
+                  mean_tok_latency_ms=round(
+                      rec.mean_tok_latency_s * 1e3, 3),
+                  finish_reason=reason)
+
+    # -- driving -----------------------------------------------------------
+
+    def step(self):
+        """One scheduler iteration: admit, decode once, advance the
+        virtual clock one tick."""
+        if self._t_start is None:
+            self._t_start = self._clock()
+        self._admit()
+        self._decode_once()
+        self._reg().gauge("serve/slot_occupancy").set(
+            len(self.active) / self.num_slots)
+        self.tick += 1.0
+
+    def run(self, requests=None, *, max_steps=100_000):
+        """Drive ``requests`` (plus anything already submitted) to
+        completion; returns the completed list in finish order."""
+        for r in requests or ():
+            self.submit(r)
+        steps = 0
+        while self.pending or self.active:
+            if not self.active and self.pending and \
+                    min(r.arrival for r in self.pending) > self.tick:
+                # idle gap: fast-forward virtual time to the next
+                # arrival instead of spinning empty decode ticks
+                self.tick = min(r.arrival for r in self.pending)
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"scheduler exceeded max_steps ({max_steps}) with "
+                    f"{len(self.pending)} pending / {len(self.active)} "
+                    f"active — a request is not converging")
+        self._t_end = self._clock()
+        self._census_event()
+        return self.completed
+
+    # -- accounting --------------------------------------------------------
+
+    def _census_event(self):
+        eng = self.engine
+        reg = self._reg()
+        reg.gauge("serve/kv_cache_bytes").set(eng.kv_cache_bytes())
+        reg.event("serve", "kv_cache",
+                  slots_total=self.num_slots,
+                  slots_used=len(self.active),
+                  slots_free=len(self.free),
+                  bytes_per_slot=eng.spec.bytes_per_slot(),
+                  cache_dtype=eng.spec.cache_dtype_name(),
+                  kv_cache_bytes=eng.kv_cache_bytes())
+
+    @staticmethod
+    def _pct(samples, q):
+        return float(np.percentile(samples, q)) if samples else None
+
+    def stats(self):
+        """Host-side summary of the run (independent of registry
+        enablement — the bench's emission source)."""
+        wall = ((self._t_end or self._clock())
+                - (self._t_start or self._clock()))
+        return {
+            "requests_completed": len(self.completed),
+            "tokens_generated": self.tokens_generated,
+            "wall_s": wall,
+            "tokens_per_sec": (self.tokens_generated / wall)
+            if wall > 0 else None,
+            "decode_steps": self.decode_steps,
+            "prefill_calls": self.prefill_calls,
+            "ttft_p50_ms": self._pct(self._ttft_ms, 50),
+            "ttft_p99_ms": self._pct(self._ttft_ms, 99),
+            "tok_latency_p50_ms": self._pct(self._tok_latency_ms, 50),
+            "tok_latency_p99_ms": self._pct(self._tok_latency_ms, 99),
+            "slot_occupancy_last": len(self.active) / self.num_slots,
+        }

@@ -285,6 +285,21 @@ class CompileWatcher:
                       call_seconds=round(call_seconds, 6),
                       changed=changed)
 
+    def record_aot(self, name, args=(), kwargs=None, *, seconds=0.0):
+        """Register an ahead-of-time compile (``jit(...).lower(args)
+        .compile()`` — the ServeEngine startup path) under ``name``.
+
+        AOT executables never pass through :meth:`watch`'s cache-size
+        probe (calling one cannot compile), so the startup compile is
+        recorded explicitly here: it lands in the same per-function
+        stats, ``compile`` JSONL events, and signature bookkeeping as a
+        watched jit compile — and a second ``record_aot`` under the
+        same name with a different signature shows up as a named
+        recompile, exactly like a drifting jit signature would."""
+        if not self.enabled:
+            return
+        self._on_compile(name, abstract_signature(args, kwargs), seconds)
+
     # -- accounting ---------------------------------------------------------
 
     def compile_count(self, name=None):

@@ -22,6 +22,7 @@ restricts both the sink and the ``log_summary`` logging path (built on
 :mod:`apex_tpu._logging`'s rank-aware formatter) to process 0.
 """
 
+import collections
 import json
 import os
 import threading
@@ -73,10 +74,20 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming summary (count/total/min/max/last) — enough for span
-    latency reporting without storing samples."""
+    """Streaming summary (count/total/min/max/last) plus a bounded
+    sample reservoir for tail percentiles.
 
-    __slots__ = ("name", "count", "total", "min", "max", "last", "_lock")
+    The reservoir keeps the most recent ``RESERVOIR`` observations (a
+    sliding window, not a statistical sample — serving latency wants
+    the RECENT tail, and p99-of-the-last-4096 answers "how is the
+    system doing now"); :meth:`percentile` and the ``p50``/``p99``
+    summary fields read it. Older aggregate fields are exact over the
+    full stream."""
+
+    RESERVOIR = 4096
+
+    __slots__ = ("name", "count", "total", "min", "max", "last",
+                 "_samples", "_lock")
 
     def __init__(self, name):
         self.name = name
@@ -85,6 +96,7 @@ class Histogram:
         self.min = None
         self.max = None
         self.last = None
+        self._samples = collections.deque(maxlen=self.RESERVOIR)
         self._lock = threading.Lock()
 
     def observe(self, value):
@@ -95,6 +107,21 @@ class Histogram:
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
             self.last = value
+            self._samples.append(value)
+
+    def percentile(self, q):
+        """Linear-interpolated percentile (``q`` in [0, 100]) over the
+        reservoir window; None before the first observation."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return None
+        if len(samples) == 1:
+            return samples[0]
+        pos = (len(samples) - 1) * (float(q) / 100.0)
+        lo = int(pos)
+        hi = min(lo + 1, len(samples) - 1)
+        return samples[lo] + (samples[hi] - samples[lo]) * (pos - lo)
 
     def summary(self):
         return {
@@ -104,6 +131,8 @@ class Histogram:
             "min": self.min,
             "max": self.max,
             "last": self.last,
+            "p50": self.percentile(50),
+            "p99": self.percentile(99),
         }
 
 
@@ -123,6 +152,9 @@ class _Null:
 
     def observe(self, value):
         pass
+
+    def percentile(self, q):
+        return None
 
 
 _NULL = _Null()

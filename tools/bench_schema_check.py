@@ -22,6 +22,9 @@ number ``n`` (old checked-in records stay valid):
   ``measured_comm_bytes_per_step`` and ``model_flops_per_step_xla``
   (nullable — null means "not measured in this config", e.g. a serving
   bench) next to ``mfu``.
+- ``n >= 11``: ``serve_decode`` metric lines must carry the serving
+  contract — p50/p99 TTFT and per-token latency plus
+  ``kv_cache_bytes`` — next to their tokens/sec value.
 
 Usage::
 
@@ -57,6 +60,16 @@ NUMERICS_OVERHEAD_SINCE_ROUND = 9
 # config") on successful metric lines from round 10; BENCH_r01-r06
 # records stay valid without them
 MEMWATCH_FIELDS_SINCE_ROUND = 10
+# the serving capture contract (apex_tpu.serving, round 11): a
+# serve_decode metric line must carry the latency percentiles and the
+# KV-cache byte accounting next to its tokens/sec value; the fields
+# did not exist before round 11, so a pre-round-11 record carrying
+# them is flagged — same gating discipline as steps_skipped
+SERVE_FIELDS_SINCE_ROUND = 11
+SERVE_METRIC_PREFIX = "serve_decode"
+SERVE_REQUIRED_FIELDS = ("ttft_p50_ms", "ttft_p99_ms",
+                         "tok_latency_p50_ms", "tok_latency_p99_ms",
+                         "kv_cache_bytes")
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -139,6 +152,21 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                           and obj["steps_skipped"] >= 0)):
                 bad("steps_skipped must be a non-negative integer or "
                     "null")
+        is_serve = str(obj.get("metric", "")).startswith(
+            SERVE_METRIC_PREFIX)
+        present_serve = [k for k in SERVE_REQUIRED_FIELDS if k in obj]
+        if present_serve and (round_n is not None
+                              and round_n < SERVE_FIELDS_SINCE_ROUND):
+            bad(f"serve fields {present_serve} are only defined from "
+                f"round {SERVE_FIELDS_SINCE_ROUND}")
+        elif is_serve and (round_n is None
+                           or round_n >= SERVE_FIELDS_SINCE_ROUND):
+            for key in SERVE_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"serve_decode line missing {key!r} (required "
+                        f"since round {SERVE_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"serve field {key!r} must be numeric or null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

@@ -63,6 +63,7 @@ def aggregate_events(paths):
         "zero_state": [],
         "postmortem_events": [],
         "compiles": {},              # name -> count/seconds/last change
+        "kv_cache": [],              # serve kv_cache censuses, in order
         "malformed": 0,
     }
     for path in paths:
@@ -82,6 +83,8 @@ def aggregate_events(paths):
                         _fold_memory(agg, ev)
                     elif kind == "compile":
                         _fold_compile(agg, ev)
+                    elif kind == "serve":
+                        _fold_serve(agg, ev)
                 except (ValueError, TypeError, KeyError):
                     agg["malformed"] += 1
     return agg
@@ -107,6 +110,18 @@ def _fold_memory(agg, ev):
     elif name == "postmortem":
         agg["postmortem_events"].append({
             "path": ev.get("path"), "error": ev.get("error")})
+
+
+def _fold_serve(agg, ev):
+    """The serving engine's KV-cache slot census (the cache is the
+    dominant serving HBM cost, so it belongs in the memory view):
+    slots used/free, bytes per slot, cache dtype."""
+    if ev.get("name") != "kv_cache":
+        return
+    agg["kv_cache"].append({
+        k: ev.get(k) for k in (
+            "slots_total", "slots_used", "slots_free",
+            "bytes_per_slot", "cache_dtype", "kv_cache_bytes")})
 
 
 def _fold_compile(agg, ev):

@@ -347,6 +347,72 @@ def _memwatch_smoke(bench):
             "hbm_headroom_pct": clean["hbm_headroom_pct"]}
 
 
+def _serve_smoke(bench):
+    """Serving smoke (round 11): drive ``serve_decode`` on the tiny
+    model (APEX_TPU_SERVE_SMOKE=1) with a 3-request trace and assert
+    (a) the ``serve/ttft`` histogram landed in the telemetry JSONL
+    summary with one observation per request, (b) ``compile_count``
+    equals the bucket-ladder size — the AOT executables are the ONLY
+    compiles the engine owns, (c) trace B (different arrival pattern)
+    compiled nothing, and (d) the ``kv_cache`` slot-census event landed
+    (tools/memory_report.py renders it). Raises on any missing piece so
+    the stage shows up as ERROR rather than silently passing."""
+    import glob
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_serve_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    prev_smoke = os.environ.get("APEX_TPU_SERVE_SMOKE")
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    os.environ["APEX_TPU_SERVE_SMOKE"] = "1"
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        ret = bench.bench_serve_decode(3, 4)
+    finally:
+        for var, old in ((telemetry.registry.ENV_DIR, prev),
+                         ("APEX_TPU_SERVE_SMOKE", prev_smoke)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+    # the smoke ServeConfig ladder: 3 batch-buckets x 2 prefill-buckets
+    # + 3 decode executables (bench.bench_serve_decode smoke shape)
+    expected = 3 * 2 + 3
+    if ret["compile_count"] != expected:
+        raise RuntimeError(
+            f"serve smoke: compile_count == {ret['compile_count']}, "
+            f"wanted the bucket-ladder size ({expected})")
+    if ret["recompiles_trace_b"] != 0:
+        raise RuntimeError(
+            f"serve smoke: {ret['recompiles_trace_b']} backend "
+            f"compile(s) during trace B — traffic shape leaked into "
+            f"compiled code")
+    events = []
+    for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(p) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    summaries = [e for e in events if e["kind"] == "summary"]
+    if not summaries:
+        raise RuntimeError("serve smoke: no summary event landed")
+    hist = summaries[-1]["histograms"].get("serve/ttft")
+    if not hist or not hist.get("count"):
+        raise RuntimeError("serve smoke: no serve/ttft histogram in "
+                           "the JSONL summary")
+    serve_events = [e for e in events if e["kind"] == "serve"]
+    if not serve_events:
+        raise RuntimeError("serve smoke: no serve events landed")
+    if not [e for e in serve_events if e.get("name") == "kv_cache"]:
+        raise RuntimeError("serve smoke: no kv_cache slot-census event")
+    return {"telemetry_dir": tel_dir,
+            "compile_count": ret["compile_count"],
+            "ttft_observations": hist["count"],
+            "ttft_p99_ms": ret["ttft_p99_ms"],
+            "kv_cache_bytes": ret["kv_cache_bytes"],
+            "kv_cache_bytes_int8": ret.get("kv_cache_bytes_int8")}
+
+
 def _stages(smoke):
     import bench
 
@@ -367,6 +433,7 @@ def _stages(smoke):
             ("resilience", None, lambda: _resilience_smoke(bench)),
             ("numerics", None, lambda: _numerics_smoke(bench)),
             ("memwatch", None, lambda: _memwatch_smoke(bench)),
+            ("serve", None, lambda: _serve_smoke(bench)),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -427,6 +494,13 @@ def _stages(smoke):
         # while the clean run stays at exactly one compile
         ("ddp_memwatch", None, spec("ddp_memwatch")),
         ("memwatch", None, lambda: _memwatch_smoke(bench)),
+        # round-11 serving captures: the continuous-batching engine at
+        # bench size (tokens/sec + p50/p99 TTFT/latency + kv_cache_bytes
+        # bf16 vs int8, compile_count flat across two traces) and the
+        # tiny-model smoke proving the serve/ttft histogram + slot
+        # census land in the JSONL
+        ("serve_decode", None, spec("serve_decode")),
+        ("serve", None, lambda: _serve_smoke(bench)),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

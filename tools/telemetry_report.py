@@ -40,7 +40,7 @@ def load_events(paths):
 KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
-    "compile", "memory",
+    "compile", "memory", "serve",
 })
 
 
@@ -59,6 +59,8 @@ def aggregate(events):
     compiles = {}
     memory = {"headroom_trend": [], "postmortems": [],
               "preflight_warnings": 0, "zero_state": []}
+    serve = {"engines": [], "requests_done": 0, "tokens": 0,
+             "ttft_ms": [], "kv_cache": None}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -147,6 +149,25 @@ def aggregate(events):
                         "sharded_state_bytes":
                             ev.get("sharded_state_bytes"),
                         "savings_ratio": ev.get("savings_ratio")})
+            elif kind == "serve":
+                sname = ev.get("name")
+                if sname == "engine_start":
+                    serve["engines"].append({
+                        k: ev.get(k) for k in (
+                            "batch_buckets", "prefill_buckets",
+                            "num_slots", "cache_dtype",
+                            "kv_cache_bytes", "compile_count")})
+                elif sname == "request_done":
+                    serve["requests_done"] += 1
+                    serve["tokens"] += int(ev.get("tokens") or 0)
+                    if ev.get("ttft_ms") is not None:
+                        serve["ttft_ms"].append(float(ev["ttft_ms"]))
+                elif sname == "kv_cache":
+                    serve["kv_cache"] = {
+                        k: ev.get(k) for k in (
+                            "slots_total", "slots_used", "slots_free",
+                            "bytes_per_slot", "cache_dtype",
+                            "kv_cache_bytes")}
             elif kind in KNOWN_KINDS:
                 pass  # known but needs no aggregation (checkpoint, ...)
             else:
@@ -167,6 +188,7 @@ def aggregate(events):
         "guard": guard,
         "compiles": compiles,
         "memory": memory,
+        "serve": serve,
         "unknown_kinds": unknown,
         "malformed_events": malformed,
         "counters": (last_summary or {}).get("counters", {}),
@@ -271,6 +293,31 @@ def print_report(report, out=sys.stdout):
               f"({(z.get('savings_ratio') or 0):.2f}x)\n")
         for pm in memory.get("postmortems", []):
             w(f"  OOM postmortem -> {pm.get('path')}\n")
+    serve = report.get("serve") or {}
+    if serve.get("engines") or serve.get("requests_done"):
+        w("\nserving (apex_tpu.serving):\n")
+        for e in serve.get("engines", []):
+            w(f"  engine: {e.get('num_slots')} slots, cache "
+              f"{e.get('cache_dtype')} "
+              f"({_fmt_bytes(e.get('kv_cache_bytes') or 0)}), "
+              f"buckets b={e.get('batch_buckets')} "
+              f"s={e.get('prefill_buckets')}, "
+              f"{e.get('compile_count')} AOT compile(s)\n")
+        if serve.get("requests_done"):
+            ttft = sorted(serve.get("ttft_ms") or [])
+            line = (f"  {serve['requests_done']} request(s) done, "
+                    f"{serve['tokens']} token(s)")
+            if ttft:
+                line += (f", ttft p50 "
+                         f"{ttft[len(ttft) // 2]:.2f}ms max "
+                         f"{ttft[-1]:.2f}ms")
+            w(line + "\n")
+        kv = serve.get("kv_cache")
+        if kv:
+            w(f"  kv cache: {kv.get('slots_used')}/"
+              f"{kv.get('slots_total')} slots used, "
+              f"{_fmt_bytes(kv.get('bytes_per_slot') or 0)}/slot "
+              f"({kv.get('cache_dtype')})\n")
     unknown = report.get("unknown_kinds") or {}
     skipped = sum(unknown.values()) + report.get("malformed_events", 0)
     if skipped:
