@@ -37,6 +37,24 @@ Injector catalogue:
   payload in place (bit rot / torn sector).
 - :func:`simulate_preemption` — raise a real SIGTERM in-process, which
   a :class:`~apex_tpu.resilience.preemption.PreemptionGuard` fields.
+
+Serving injectors (the ISSUE-7 chaos surface; all deterministic,
+keyed on the engine's lifetime decode-call counter):
+
+- :func:`inject_slot_nan` — poison ONE slot's decode logits at one
+  decode call (``APEX_TPU_FAULT_SLOT_NAN="slot:step"``). The engine
+  folds the armed slot id into its compiled decode step as a traced
+  i32 scalar (identity at -1), so arming never changes the executable
+  — the per-slot quarantine path runs under
+  ``assert_no_recompiles``.
+- :func:`inject_decode_failure` — fail a decode *dispatch* host-side
+  at one decode call (``APEX_TPU_FAULT_DECODE_STEP``), transient
+  (fires once; the retry succeeds) or permanent (fires on every
+  attempt until the retry budget exhausts and the engine raises
+  ``serving.robust.DecodeFailedError``).
+- :func:`request_storm` — a burst trace (every request arriving at
+  the same tick) for admission-control drills: with a bounded pending
+  queue the overflow must shed, not grow without bound.
 """
 
 import contextlib
@@ -45,11 +63,15 @@ import pickle
 import signal
 
 import jax.numpy as jnp
+import numpy as np
 from jax import tree_util
 
 ENV_NAN_STEP = "APEX_TPU_FAULT_NAN_STEP"
 ENV_CKPT_WRITE_FAILURES = "APEX_TPU_FAULT_CKPT_WRITE_FAILURES"
 ENV_ALLOC_STEP = "APEX_TPU_FAULT_ALLOC_STEP"
+ENV_SLOT_NAN = "APEX_TPU_FAULT_SLOT_NAN"
+ENV_DECODE_STEP = "APEX_TPU_FAULT_DECODE_STEP"
+ENV_DECODE_TRANSIENT = "APEX_TPU_FAULT_DECODE_TRANSIENT"
 
 
 class FaultInjected(OSError):
@@ -61,6 +83,19 @@ class SyntheticResourceExhausted(FaultInjected):
     """Injected allocation failure. The message carries the literal
     ``RESOURCE_EXHAUSTED`` marker so ``telemetry.memory.is_oom_error``
     treats it exactly like the XLA runtime error it stands in for."""
+
+
+class InjectedDecodeFailure(FaultInjected):
+    """Injected decode-dispatch failure. ``transient`` distinguishes a
+    blip (fires once; the engine's retry must succeed) from a
+    persistent fault (fires every attempt; the retry budget must
+    exhaust). The message carries ``UNAVAILABLE`` so
+    ``serving.robust.is_retryable_decode_error`` classifies it exactly
+    like the runtime error it stands in for."""
+
+    def __init__(self, msg, *, transient=True):
+        super().__init__(msg)
+        self.transient = bool(transient)
 
 
 def nan_step_from_env():
@@ -233,6 +268,147 @@ def corrupt_checkpoint(directory, step, *, offset=-8, nbytes=4):
         f.seek(pos)
         f.write(bytes(b ^ 0xFF for b in data))
     return target
+
+
+# -- serving injectors (ISSUE 7) --------------------------------------------
+#
+# Armed state is module-level so the engine (which owns the decode-call
+# counter) and the driver (which owns the scenario) need no plumbing
+# between them; ``arm_*``/context-manager both write the same slot.
+
+_slot_nan_state = None      # {"slot", "step", "fired"}
+_decode_fail_state = None   # {"step", "transient", "fired"}
+
+
+def _slot_nan_from_env():
+    v = os.environ.get(ENV_SLOT_NAN)
+    if v in (None, ""):
+        return None
+    slot, _, step = v.partition(":")
+    return {"slot": int(slot), "step": int(step or 0), "fired": 0}
+
+
+def arm_slot_nan(slot, step):
+    """Arm a one-shot slot-NaN: the decode call numbered ``step`` (the
+    engine's lifetime decode-call counter, 0-based) poisons the logits
+    of cache slot ``slot`` in-graph. Returns the armed-state dict
+    (``"fired"`` counts firings). Overwrites any previous arming."""
+    global _slot_nan_state
+    _slot_nan_state = {"slot": int(slot), "step": int(step), "fired": 0}
+    return _slot_nan_state
+
+
+def disarm_slot_nan():
+    global _slot_nan_state
+    _slot_nan_state = None
+
+
+@contextlib.contextmanager
+def inject_slot_nan(slot, step):
+    """Context-manager form of :func:`arm_slot_nan`; disarms on exit.
+    Yields the state dict so tests can assert ``state["fired"] == 1``."""
+    state = arm_slot_nan(slot, step)
+    try:
+        yield state
+    finally:
+        disarm_slot_nan()
+
+
+def poison_slot_for(decode_step):
+    """The slot id to poison at decode call ``decode_step``, or -1.
+
+    Called by ``ServeEngine.decode`` on every dispatch; the returned
+    int feeds the compiled step's traced ``poison_slot`` argument
+    (identity at -1 — the unarmed fast path costs one ``is None``).
+    One-shot: a matching call marks the arming fired so the NEXT
+    decode call is clean — the quarantine must recover, not re-poison.
+    Env arming (``APEX_TPU_FAULT_SLOT_NAN=slot:step``) is read lazily
+    on first consult and follows the same one-shot contract."""
+    global _slot_nan_state
+    if _slot_nan_state is None and ENV_SLOT_NAN in os.environ:
+        _slot_nan_state = _slot_nan_from_env()
+    st = _slot_nan_state
+    if not st or st["fired"] or int(decode_step) != st["step"]:
+        return -1
+    st["fired"] += 1
+    return st["slot"]
+
+
+def arm_decode_failure(step, transient=None):
+    """Arm a decode-dispatch failure at decode call ``step``.
+    ``transient=True`` (default) fires once — the engine's first retry
+    finds clean air; ``transient=False`` fires on every attempt at
+    that step, exhausting the retry budget. Returns the state dict."""
+    global _decode_fail_state
+    if transient is None:
+        transient = os.environ.get(ENV_DECODE_TRANSIENT, "1") != "0"
+    _decode_fail_state = {"step": int(step), "transient": bool(transient),
+                          "fired": 0}
+    return _decode_fail_state
+
+
+def disarm_decode_failure():
+    global _decode_fail_state
+    _decode_fail_state = None
+
+
+@contextlib.contextmanager
+def inject_decode_failure(step, transient=True):
+    """Context-manager form of :func:`arm_decode_failure`; disarms on
+    exit. Yields the state dict (``"fired"`` counts raises)."""
+    state = arm_decode_failure(step, transient=transient)
+    try:
+        yield state
+    finally:
+        disarm_decode_failure()
+
+
+def maybe_fail_decode(decode_step):
+    """Raise :class:`InjectedDecodeFailure` when a failure is armed for
+    decode call ``decode_step`` — called by ``ServeEngine.decode``
+    just before each dispatch attempt (the host-side stand-in for the
+    runtime raising at dispatch). Transient armings clear after one
+    raise; permanent ones keep raising at their step. Env arming via
+    ``APEX_TPU_FAULT_DECODE_STEP`` (+ ``..._TRANSIENT=0`` for the
+    permanent flavor) is read lazily on first consult."""
+    global _decode_fail_state
+    if _decode_fail_state is None and ENV_DECODE_STEP in os.environ:
+        v = os.environ.get(ENV_DECODE_STEP)
+        if v not in (None, ""):
+            arm_decode_failure(int(v))
+    st = _decode_fail_state
+    if not st or int(decode_step) != st["step"]:
+        return
+    if st["transient"] and st["fired"]:
+        return
+    st["fired"] += 1
+    raise InjectedDecodeFailure(
+        f"UNAVAILABLE: injected {'transient' if st['transient'] else 'persistent'} "
+        f"decode failure at decode call {int(decode_step)} "
+        f"(attempt {st['fired']}; faults.inject_decode_failure)",
+        transient=st["transient"])
+
+
+def request_storm(n_requests, *, at_tick=0.0, seed=0,
+                  prompt_lens=(4, 8, 12), max_new=(4, 8),
+                  vocab_size=256, rid_base=10_000):
+    """A burst trace: ``n_requests`` all arriving at ``at_tick`` — the
+    admission-control drill (``synthetic_trace``'s Poisson arrivals
+    never pile up fast enough to exercise shedding on a small trace).
+    Deterministic per seed; rids start at ``rid_base`` so a storm can
+    ride on top of a regular trace without colliding."""
+    from apex_tpu.serving.scheduler import Request
+
+    rs = np.random.RandomState(seed)
+    out = []
+    for i in range(int(n_requests)):
+        plen = int(rs.choice(prompt_lens))
+        out.append(Request(
+            rid=rid_base + i,
+            prompt=rs.randint(0, vocab_size, size=plen).astype("int32"),
+            max_new_tokens=int(rs.choice(max_new)),
+            arrival=float(at_tick)))
+    return out
 
 
 def simulate_preemption(sig=signal.SIGTERM):

@@ -12,14 +12,25 @@ registry (``serve/*``). Compile count equals the bucket-ladder size
 and stays flat under any traffic shape (``assert_no_recompiles`` is a
 hard invariant of the steady state).
 
+Fault tolerance (:mod:`~apex_tpu.serving.robust`): a bounded pending
+queue with reject-newest / shed-oldest load shedding, per-request TTFT
+and total-latency deadlines, per-slot NaN quarantine (a poisoned
+sequence is evicted with its KV rows reset in-graph while healthy
+slots keep decoding), capped-backoff decode retries that fail only the
+implicated requests, and PreemptionGuard-driven graceful drain — all
+host-side policy, so every failure path holds
+``assert_no_recompiles``.
+
 Quickstart (docs/serving.md has the full tour)::
 
-    from apex_tpu.serving import (ServeConfig, ServeEngine,
-                                  synthetic_trace)
+    from apex_tpu.serving import (RobustConfig, ServeConfig,
+                                  ServeEngine, synthetic_trace)
     engine = ServeEngine(model, params, ServeConfig(
         batch_buckets=(2, 4, 8), prefill_buckets=(16, 32),
         num_slots=8, cache_mode="int8"))
-    completed, stats = engine.serve(synthetic_trace(32, seed=0))
+    completed, stats = engine.serve(
+        synthetic_trace(32, seed=0),
+        robust=RobustConfig(max_pending=64, ttft_deadline_s=30.0))
 """
 
 from apex_tpu.serving.engine import ServeConfig, ServeEngine  # noqa: F401
@@ -28,6 +39,13 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     row_template,
     store_lengths,
     zero_row,
+)
+from apex_tpu.serving.robust import (  # noqa: F401
+    DecodeFailedError,
+    DrainReport,
+    RejectedRequest,
+    RobustConfig,
+    ServeHealth,
 )
 from apex_tpu.serving.scheduler import (  # noqa: F401
     CompletedRequest,

@@ -150,6 +150,11 @@ class ServeEngine:
         self._params = params
         self._key0 = jax.random.PRNGKey(0)
         self._step_counter = 0
+        self._decode_calls = 0
+        self.decode_retries_total = 0
+        # census attribution for every OOM post-mortem from here on:
+        # a serve-time death names KV-cache slots, not anonymous buffers
+        labels["kv_cache"] = self._store
 
         # --- AOT compile the whole ladder, registered with the watcher --
         self._decode_exec = {}
@@ -160,7 +165,7 @@ class ServeEngine:
             for b in self.config.batch_buckets:
                 args = (self._store, self._params,
                         self._ids_aval(b), self._ids_aval(b),
-                        self._key0)
+                        self._key0, self._put(np.int32(-1)))
                 lowered = jax.jit(
                     self._decode_fn,
                     donate_argnums=(0,) if config.donate else ()
@@ -257,6 +262,13 @@ class ServeEngine:
     def kv_cache_bytes(self):
         return self.spec.total_bytes()
 
+    def census_labels(self):
+        """OOM post-mortem attribution (`live_buffer_census` matches
+        leaves by identity): rebuilt per call because donation replaces
+        the store arrays on every dispatch — a serve-time census must
+        name the CURRENT KV-cache slots, not dead buffers."""
+        return {"params": self._params, "kv_cache": self._store}
+
     def slot_lengths(self):
         """Host copy of the per-slot fill levels (one tiny fetch)."""
         return np.asarray(kvc.store_lengths(self._store))
@@ -300,11 +312,21 @@ class ServeEngine:
             lambda st, r: st.at[slot_ids].set(r), store, rows)
         return store, first
 
-    def _decode_fn(self, store, params, slot_ids, tokens, key):
+    def _decode_fn(self, store, params, slot_ids, tokens, key,
+                   poison_slot):
         """One continuous-batching decode step over a slot bucket:
         gather rows, dequantize on read, run the model's own decode
         attention per slot at its own length, re-quantize ONLY the
-        appended position, scatter back, sample."""
+        appended position, scatter back, sample.
+
+        Per-slot quarantine rides in the same executable: a per-slot
+        finite flag is derived from each row's logits (vmapped with
+        the step — no executable beyond the ladder) and a non-finite
+        row scatters ZEROED rows back (its KV and ``cache_index``
+        reset in-graph) while sampling the pad token; healthy rows are
+        untouched. ``poison_slot`` is the fault injector's traced i32
+        handle (-1 = identity): ``faults.inject_slot_nan`` poisons one
+        named slot's logits without changing the compiled program."""
         rows = jax.tree_util.tree_map(lambda l: l[slot_ids], store)
         model_rows = self.spec.materialize_rows(rows)
         lengths = kvc.store_lengths(model_rows)
@@ -317,11 +339,25 @@ class ServeEngine:
             return cache_row, logits[0]
 
         new_rows, logits = jax.vmap(one)(model_rows, tokens, lengths)
+        logits = jnp.where(
+            (slot_ids == poison_slot)[:, None],
+            jnp.asarray(jnp.nan, logits.dtype), logits)
+        finite = jnp.all(jnp.isfinite(
+            logits.astype(jnp.float32)), axis=-1)
         nxt = self._sample(logits, key)
+        nxt = jnp.where(finite, nxt,
+                        jnp.asarray(self.config.pad_token_id, nxt.dtype))
         updated = self.spec.update_rows_at(rows, new_rows, lengths)
+        b = finite.shape[0]
+
+        def keep(u):
+            f = finite.reshape((b,) + (1,) * (u.ndim - 1))
+            return jnp.where(f, u, jnp.zeros_like(u))
+
+        updated = jax.tree_util.tree_map(keep, updated)
         store = jax.tree_util.tree_map(
             lambda st, r: st.at[slot_ids].set(r), store, updated)
-        return store, nxt
+        return store, nxt, finite
 
     # -- host API (the scheduler's surface) --------------------------------
 
@@ -372,41 +408,87 @@ class ServeEngine:
         return np.asarray(first)[:n]
 
     def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
-               guarded=True):
+               guarded=True, retries=0, backoff_s=0.05,
+               backoff_cap_s=1.0):
         """One decode step for the active ``slot_ids`` fed their last
-        ``tokens``; returns the next token per slot,
-        ``np.ndarray [len(slot_ids)]``. Runs under
-        ``resilience.guarded_call`` (``guarded=False`` opts out): an
-        HBM exhaustion mid-traffic writes the memory post-mortem and
-        surfaces as ``HBMExhaustedError``."""
+        ``tokens``; returns ``(next_tokens, finite)`` — each
+        ``np.ndarray [len(slot_ids)]``, ``finite[i]`` False iff slot
+        ``i``'s logits went non-finite this step (its KV rows are
+        already reset in-graph; the scheduler evicts it as
+        ``poisoned``).
+
+        Dispatch runs under ``resilience.guarded_call``
+        (``guarded=False`` opts out): an HBM exhaustion mid-traffic
+        writes the memory post-mortem — census labeled with the KV
+        cache and weights — and surfaces as ``HBMExhaustedError``.
+        ``retries`` re-dispatches after transient failures
+        (``robust.is_retryable_decode_error``) with capped exponential
+        backoff; past the budget the call raises
+        ``robust.DecodeFailedError`` so the caller fails only the
+        implicated requests. The injection checkpoint
+        (``faults.maybe_fail_decode`` / ``faults.poison_slot_for``)
+        is keyed on the engine's lifetime decode-call counter."""
+        from apex_tpu import resilience
+        from apex_tpu.resilience import faults
+        from apex_tpu.serving import robust
+
         n = len(slot_ids)
         bbucket = self._pick_bucket(self.config.batch_buckets, n,
                                     "decode batch")
         ids = self._padded_ids(slot_ids, pad_slot_ids, bbucket)
         toks = np.zeros((bbucket,), np.int32)
         toks[:n] = np.asarray(tokens, np.int32)
-        args = (self._store, self._params,
-                self._put(np.asarray(ids, np.int32)), self._put(toks),
-                self._key())
-        if guarded:
-            from apex_tpu import resilience
-
-            store, nxt = resilience.guarded_call(
-                self._decode_exec[bbucket], *args,
-                registry=self._registry,
-                labels={"params": self._params})
-        else:
-            store, nxt = self._decode_exec[bbucket](*args)
+        step_idx = self._decode_calls
+        self._decode_calls += 1
+        poison = faults.poison_slot_for(step_idx)
+        key = self._key()
+        for attempt in range(int(retries) + 1):
+            try:
+                faults.maybe_fail_decode(step_idx)
+                args = (self._store, self._params,
+                        self._put(np.asarray(ids, np.int32)),
+                        self._put(toks), key,
+                        self._put(np.int32(poison)))
+                if guarded:
+                    store, nxt, finite = resilience.guarded_call(
+                        self._decode_exec[bbucket], *args,
+                        registry=self._registry,
+                        labels=self.census_labels())
+                else:
+                    store, nxt, finite = self._decode_exec[bbucket](*args)
+                break
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not robust.is_retryable_decode_error(e):
+                    raise
+                if attempt >= int(retries):
+                    raise robust.DecodeFailedError(
+                        f"decode call {step_idx} (bucket {bbucket}, "
+                        f"slots {list(ids[:n])}) failed "
+                        f"{attempt + 1} time(s); retry budget "
+                        f"({retries}) exhausted: {e}",
+                        attempts=attempt + 1, last_error=e) from e
+                self.decode_retries_total += 1
+                reg = self._reg()
+                reg.counter("serve/decode_retries").inc()
+                reg.event("serve", "decode_retry", step=step_idx,
+                          attempt=attempt, error=type(e).__name__)
+                time.sleep(robust.retry_backoff_s(
+                    attempt, backoff_s, backoff_cap_s))
         self._store = store
-        return np.asarray(nxt)[:n]
+        return np.asarray(nxt)[:n], np.asarray(finite)[:n]
 
-    def serve(self, requests, **kw):
+    def serve(self, requests, *, robust=None, guard=None, **kw):
         """Run a request list to completion through a fresh
         :class:`~apex_tpu.serving.scheduler.Scheduler`; returns
-        ``(completed, stats)``. The convenience entry point bench.py's
-        ``serve_decode`` and the oneproc serve smoke drive."""
+        ``(completed, stats)``. ``robust`` (a
+        :class:`~apex_tpu.serving.robust.RobustConfig`) and ``guard``
+        (a :class:`~apex_tpu.resilience.preemption.PreemptionGuard`)
+        pass through to the scheduler. The convenience entry point
+        bench.py's ``serve_decode``/``serve_chaos`` and the oneproc
+        serve smokes drive."""
         from apex_tpu.serving.scheduler import Scheduler
 
-        sched = Scheduler(self, registry=self._registry)
+        sched = Scheduler(self, registry=self._registry, robust=robust,
+                          guard=guard)
         completed = sched.run(requests, **kw)
         return completed, sched.stats()

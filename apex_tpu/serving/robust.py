@@ -1,0 +1,233 @@
+"""Serving-path fault tolerance: admission policy, deadlines, retries.
+
+PR 6 made the serving loop *fast* (one AOT executable per bucket, flat
+compile count under any traffic); this module makes it *survivable*.
+The production failure modes all land here as host-side policy —
+nothing in this file ever traces or compiles, so every knob composes
+with ``assert_no_recompiles`` by construction:
+
+- **Admission control & load shedding** (:class:`RobustConfig`
+  ``max_pending`` / ``admission_policy``): the pending queue is
+  bounded; past the bound either the newcomer is rejected
+  (``reject_newest``) or the oldest queued request is shed to make
+  room (``shed_oldest`` — the newest request is the one the user is
+  still waiting at). Every shed lands a ``serve/rejected`` counter
+  tick and a ``serve`` JSONL event with the reason.
+- **Per-request deadlines** (``ttft_deadline_s`` /
+  ``total_deadline_s``, overridable per :class:`~apex_tpu.serving.
+  scheduler.Request`): checked each scheduler tick; an expired request
+  is evicted with the ``deadline_exceeded`` terminal status instead of
+  occupying a slot (or a queue position) forever.
+- **Per-slot NaN quarantine**: the engine's decode step derives an
+  in-graph per-slot finite flag from the decode logits (vmapped with
+  the step itself — no executable beyond the ladder) and resets a
+  poisoned slot's KV rows to zero in the same dispatch; the scheduler
+  evicts the poisoned sequence with status ``poisoned`` while healthy
+  slots keep decoding. The *whole-batch* guard — every slot non-finite
+  at once, which smells like poisoned weights, not one poisoned
+  request — escalates to :class:`~apex_tpu.resilience.NonFiniteError`.
+- **Decode retry with capped exponential backoff**
+  (:func:`retry_backoff_s`, :func:`is_retryable_decode_error`): a
+  transient dispatch failure (``UNAVAILABLE`` / ``RESOURCE_EXHAUSTED``
+  / an armed :func:`~apex_tpu.resilience.faults.inject_decode_failure`)
+  is retried up to ``decode_retries`` times before
+  :class:`DecodeFailedError` fails ONLY the implicated requests.
+- **Graceful drain**: a :class:`~apex_tpu.resilience.preemption.
+  PreemptionGuard` (or an explicit ``Scheduler.drain()``) stops
+  admissions, lets in-flight work finish up to ``drain_deadline_s``,
+  and emits a drain report — see :class:`DrainReport`.
+
+Terminal statuses (``CompletedRequest.finish_reason``): ``length`` and
+``eos`` are the *goodput* statuses (:data:`OK_STATUSES`); everything
+else — ``deadline_exceeded``, ``poisoned``, ``failed``, ``drained``,
+``max_steps`` — is a non-silent failure with its own counter and JSONL
+event. docs/serving.md has the symptom -> status -> telemetry ->
+operator-action triage table.
+"""
+
+import dataclasses
+from typing import Optional
+
+ADMISSION_POLICIES = ("reject_newest", "shed_oldest")
+
+# finish_reason values that count toward goodput; every other terminal
+# status is a failure mode with its own serve/* counter
+OK_STATUSES = ("length", "eos")
+FAILURE_STATUSES = ("deadline_exceeded", "poisoned", "failed",
+                    "drained", "max_steps")
+
+# rejection reasons recorded on serve/rejected events (requests that
+# never reached a slot; distinct from the terminal statuses above)
+REJECT_REASONS = ("queue_full", "shed", "prompt_too_long",
+                  "budget_too_long", "duplicate_rid", "draining")
+
+
+class DecodeFailedError(RuntimeError):
+    """A decode dispatch kept failing past the retry budget. Carries
+    ``attempts`` (total tries) and ``last_error``; the scheduler
+    catches it and fails only the implicated requests."""
+
+    def __init__(self, msg, *, attempts=0, last_error=None):
+        super().__init__(msg)
+        self.attempts = int(attempts)
+        self.last_error = last_error
+
+
+@dataclasses.dataclass(frozen=True)
+class RobustConfig:
+    """Serving fault-tolerance knobs — all host-side policy.
+
+    ``None`` disables a deadline; ``max_pending=None`` leaves the
+    queue unbounded (the PR-6 behavior). Defaults are deliberately
+    permissive: an unconfigured scheduler behaves exactly like before,
+    except that failures now carry terminal statuses instead of
+    raising out of ``run``.
+    """
+
+    max_pending: Optional[int] = None
+    admission_policy: str = "reject_newest"
+    ttft_deadline_s: Optional[float] = None
+    total_deadline_s: Optional[float] = None
+    decode_retries: int = 2
+    retry_backoff_s: float = 0.05
+    retry_backoff_cap_s: float = 1.0
+    drain_deadline_s: float = 30.0
+    quarantine: bool = True
+    health_every: int = 0          # ticks between health events; 0 = end only
+
+    def __post_init__(self):
+        if self.admission_policy not in ADMISSION_POLICIES:
+            raise ValueError(
+                f"admission_policy {self.admission_policy!r} not in "
+                f"{ADMISSION_POLICIES}")
+        if self.max_pending is not None and self.max_pending < 0:
+            raise ValueError(
+                f"max_pending ({self.max_pending}) must be >= 0 or None")
+        if self.decode_retries < 0:
+            raise ValueError(
+                f"decode_retries ({self.decode_retries}) must be >= 0")
+        for name in ("ttft_deadline_s", "total_deadline_s"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} ({v}) must be > 0 or None")
+        if self.retry_backoff_s < 0 or self.retry_backoff_cap_s < 0:
+            raise ValueError("retry backoff must be >= 0")
+        if self.drain_deadline_s < 0:
+            raise ValueError(
+                f"drain_deadline_s ({self.drain_deadline_s}) must be >= 0")
+
+
+def retry_backoff_s(attempt, base_s, cap_s):
+    """Capped exponential backoff before retry ``attempt`` (0-based):
+    ``min(base * 2**attempt, cap)``. The cap keeps a retry burst from
+    blowing a request's total-latency deadline on its own."""
+    return min(float(base_s) * (2.0 ** int(attempt)), float(cap_s))
+
+
+# markers in a runtime error message that make a decode dispatch worth
+# retrying: the XLA runtime's transient statuses, plus the literal
+# RESOURCE_EXHAUSTED an HBM blip raises (a fragmented allocator often
+# succeeds on the re-dispatch once transient buffers are freed)
+_RETRYABLE_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "ABORTED",
+                      "DEADLINE_EXCEEDED", "INTERNAL")
+
+
+def is_retryable_decode_error(exc) -> bool:
+    """Whether a decode dispatch failure is worth re-dispatching.
+
+    True for the armed :class:`~apex_tpu.resilience.faults.
+    InjectedDecodeFailure` (both transient and permanent flavors — a
+    permanent one simply keeps failing until the budget runs out,
+    which is exactly the drill), for
+    :class:`~apex_tpu.telemetry.memory.HBMExhaustedError` (already
+    post-mortemed by ``guarded_call``; the retry is free), and for
+    runtime errors carrying a transient XLA status marker. Anything
+    else — a shape error, a Python bug — fails fast."""
+    from apex_tpu.resilience.faults import InjectedDecodeFailure
+    from apex_tpu.telemetry.memory import HBMExhaustedError
+
+    if isinstance(exc, (InjectedDecodeFailure, HBMExhaustedError)):
+        return True
+    msg = str(exc)
+    return any(marker in msg for marker in _RETRYABLE_MARKERS)
+
+
+@dataclasses.dataclass
+class RejectedRequest:
+    """A request that never reached a slot: shed at admission, bounced
+    for an impossible shape, or refused during drain. Lands in
+    ``Scheduler.rejected`` next to a ``serve/rejected`` counter tick
+    and a ``serve`` JSONL event naming the reason."""
+
+    rid: int
+    reason: str                    # one of REJECT_REASONS
+    tick: float
+    prompt_len: int = 0
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class DrainReport:
+    """What a graceful drain accomplished inside its deadline: emitted
+    as the ``serve``/``drain_report`` JSONL event and kept on the
+    scheduler as ``drain_report`` for the caller (the bench prints
+    it; an operator reads it to decide whether the grace window is
+    long enough)."""
+
+    reason: str                    # "preempted" | "requested"
+    started_tick: float
+    drain_s: float
+    completed_in_drain: int        # in-flight requests that finished
+    cancelled_active: int          # evicted at the deadline, status "drained"
+    cancelled_pending: int         # never admitted, status "drained"
+    deadline_hit: bool
+
+    def as_event_fields(self):
+        return dataclasses.asdict(self)
+
+
+class ServeHealth:
+    """Rolling backpressure / failure accounting for one scheduler.
+
+    One instance per :class:`~apex_tpu.serving.scheduler.Scheduler`;
+    the scheduler increments the fields as requests move through
+    terminal states and calls :meth:`emit` for the periodic
+    health-snapshot event (``serve``/``health``) plus the
+    ``serve/pending_depth`` gauge. Counters here are *host truth* —
+    they exist even when the telemetry registry is disabled, so
+    ``Scheduler.stats()`` can report shed rate and goodput without a
+    sink configured."""
+
+    __slots__ = ("submitted", "rejected", "expired", "quarantined",
+                 "failed", "drained", "max_steps", "decode_retries",
+                 "decode_failures", "all_slots_nonfinite")
+
+    def __init__(self):
+        self.submitted = 0
+        self.rejected = 0
+        self.expired = 0
+        self.quarantined = 0
+        self.failed = 0
+        self.drained = 0
+        self.max_steps = 0
+        self.decode_retries = 0
+        self.decode_failures = 0
+        self.all_slots_nonfinite = 0
+
+    def shed_rate(self):
+        """Fraction of submitted requests rejected at admission."""
+        return (self.rejected / self.submitted) if self.submitted else 0.0
+
+    def snapshot(self):
+        return {name: getattr(self, name) for name in self.__slots__}
+
+    def emit(self, registry, *, tick, pending, active, free,
+             completed_ok, draining):
+        """Land the health snapshot: gauge + one structured event."""
+        if not registry.enabled:
+            return
+        registry.gauge("serve/pending_depth").set(pending)
+        registry.event(
+            "serve", "health", tick=tick, pending=pending, active=active,
+            free=free, completed_ok=completed_ok, draining=draining,
+            shed_rate=round(self.shed_rate(), 4), **self.snapshot())

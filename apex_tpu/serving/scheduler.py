@@ -1,35 +1,61 @@
-"""Host-side continuous batching: admit, decode, evict — and account.
+"""Host-side continuous batching: admit, decode, evict — and survive.
 
 The scheduler is deliberately plain Python over numpy: it owns the
 request queue and the slot map, and the ONLY device work it triggers is
 calls into the engine's AOT-compiled executables — nothing here can
-compile, which is what lets a whole serving trace run under
-``assert_no_recompiles``.
+compile, which is what lets a whole serving trace (including every
+fault-tolerance path) run under ``assert_no_recompiles``.
 
 Time has two faces here. *Arrivals* are virtual — ``Request.arrival``
 is measured in decode ticks (one tick per scheduler step), so a trace
 is deterministic: the same seed yields the same admission schedule, the
 same bucket sequence, and therefore the same (zero) steady-state
-compile count on every run, regardless of host speed. *Latencies* are
-wall-clock — TTFT runs from the moment a request became eligible
-(arrival tick reached) to its first sampled token landing on the host,
-so queueing-for-a-slot time counts, which is the honest serving number.
+compile count on every run, regardless of host speed. *Latencies* and
+*deadlines* are wall-clock — TTFT runs from the moment a request became
+eligible (arrival tick reached) to its first sampled token landing on
+the host, so queueing-for-a-slot time counts, which is the honest
+serving number.
+
+Fault tolerance (:mod:`apex_tpu.serving.robust` holds the policy):
+
+- **admission control** — a bounded pending queue sheds overflow
+  (reject-newest or shed-oldest) with a ``serve/rejected`` event per
+  shed request instead of growing without bound;
+- **deadlines** — TTFT and total-latency budgets are checked each
+  tick; an expired request is evicted with the ``deadline_exceeded``
+  terminal status instead of occupying a slot forever;
+- **quarantine** — the engine's per-slot finite flag evicts a poisoned
+  sequence (status ``poisoned``, KV rows already reset in-graph) while
+  healthy slots keep decoding; every slot non-finite at once escalates
+  to :class:`~apex_tpu.resilience.NonFiniteError` (that is poisoned
+  weights, not one poisoned request);
+- **retry & partial failure** — transient decode failures retry inside
+  the engine with capped backoff; a
+  :class:`~apex_tpu.serving.robust.DecodeFailedError` past the budget
+  fails ONLY the implicated slots' requests (status ``failed``);
+- **graceful drain** — a :class:`~apex_tpu.resilience.preemption.
+  PreemptionGuard` (or :meth:`Scheduler.drain`) stops admissions,
+  finishes in-flight work up to the drain deadline, and emits a
+  :class:`~apex_tpu.serving.robust.DrainReport`.
 
 Telemetry (``serve/*``, docs/serving.md has the glossary): ``serve/ttft``
 and ``serve/tok_latency`` histograms (milliseconds; p50/p99 from the
-registry's reservoir), ``serve/slot_occupancy`` gauge,
-``serve/tokens_generated`` / ``serve/requests_completed`` counters, a
-``serve`` JSONL event per completed request, and a ``kv_cache`` slot
-census event at end of run (slots used/free, bytes per slot, cache
-dtype — tools/memory_report.py renders it).
+registry's reservoir), ``serve/slot_occupancy`` + ``serve/pending_depth``
+gauges, ``serve/tokens_generated`` / ``serve/requests_completed`` /
+``serve/rejected`` / ``serve/expired`` / ``serve/quarantined`` /
+``serve/drained`` counters, a ``serve`` JSONL event per terminal
+request, a periodic + end-of-run ``health`` snapshot event, and a
+``kv_cache`` slot census event at end of run.
 """
 
 import dataclasses
 import time
+import warnings
 from typing import List, Optional
 
 import numpy as np
 
+from apex_tpu.serving import robust as robust_mod
 from apex_tpu.telemetry.registry import get_registry
 
 
@@ -37,21 +63,26 @@ from apex_tpu.telemetry.registry import get_registry
 class Request:
     """One serving request. ``arrival`` is in decode ticks (virtual
     time — see module docstring); ``max_new_tokens`` bounds generation
-    (eos, when the engine's config defines one, may end it earlier)."""
+    (eos, when the engine's config defines one, may end it earlier).
+    ``ttft_deadline_s`` / ``total_deadline_s`` override the scheduler's
+    :class:`~apex_tpu.serving.robust.RobustConfig` defaults for this
+    request (None = inherit)."""
 
     rid: int
     prompt: np.ndarray
     max_new_tokens: int
     arrival: float = 0.0
+    ttft_deadline_s: Optional[float] = None
+    total_deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
 class CompletedRequest:
     rid: int
     tokens: np.ndarray          # generated tokens (prompt excluded)
-    ttft_s: float               # eligible -> first token, wall clock
+    ttft_s: float               # eligible -> first token; NaN if never served
     mean_tok_latency_s: float   # decode steps only (excludes TTFT)
-    finish_reason: str          # "length" | "eos"
+    finish_reason: str          # robust.OK_STATUSES | robust.FAILURE_STATUSES
 
 
 def synthetic_trace(n_requests=16, *, seed=0, mean_interarrival=0.5,
@@ -89,56 +120,195 @@ class _Active:
 class Scheduler:
     """Continuous batching over one :class:`ServeEngine`.
 
-    One :meth:`step` = admit every eligible request into free slots
-    (grouped prefills), then one decode pass over the active set
-    (padded to the engine's batch bucket with distinct free slots),
-    then evict finished sequences. :meth:`run` drives a request list to
-    completion; fast-forwards virtual time across idle gaps so a sparse
-    trace never spins.
+    One :meth:`step` = expire deadline-blown requests, admit every
+    eligible request into free slots (grouped prefills; skipped while
+    draining), then one decode pass over the active set (padded to the
+    engine's batch bucket with distinct free slots), then evict
+    finished/poisoned sequences. :meth:`run` drives a request list to
+    completion; fast-forwards virtual time across idle gaps so a
+    sparse trace never spins.
     """
 
     def __init__(self, engine, *, registry=None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, robust=None, guard=None):
         self.engine = engine
         self._registry = registry
         self._clock = clock
+        self.robust = robust or robust_mod.RobustConfig()
+        self.guard = guard
         self.num_slots = engine.config.num_slots
         self.free = list(range(self.num_slots))
         self.pending: List[Request] = []
         self.active = {}                      # slot -> _Active
         self.completed: List[CompletedRequest] = []
+        self.rejected: List[robust_mod.RejectedRequest] = []
+        self.health = robust_mod.ServeHealth()
         self.tick = 0.0
         self.decode_steps = 0
         self.prefill_calls = 0
         self.tokens_generated = 0
+        self.draining = False
+        self.drain_report: Optional[robust_mod.DrainReport] = None
+        self._drain_reason = None
+        self._drain_start_wall = None
+        self._drain_start_tick = None
+        self._drain_completed_before = 0
+        self._known_rids = set()
         self._eligible_wall = {}
         self._ttft_ms = []
         self._tok_latency_ms = []
         self._t_start = None
         self._t_end = None
+        self._retries_before = engine.decode_retries_total
 
     def _reg(self):
         return self._registry or get_registry()
 
-    # -- submission --------------------------------------------------------
+    # -- submission & admission control ------------------------------------
+
+    def _reject(self, request, reason, detail=""):
+        """Record one shed/bounced request: host list + counter +
+        JSONL event. Returns False (the ``submit`` contract)."""
+        rec = robust_mod.RejectedRequest(
+            rid=request.rid, reason=reason, tick=self.tick,
+            prompt_len=len(request.prompt), detail=detail)
+        self.rejected.append(rec)
+        self.health.rejected += 1
+        reg = self._reg()
+        reg.counter("serve/rejected").inc()
+        reg.event("serve", "rejected", rid=request.rid, reason=reason,
+                  tick=self.tick, prompt_len=len(request.prompt),
+                  detail=detail)
+        return False
 
     def submit(self, request: Request):
-        plen = len(request.prompt)
+        """Queue a request, or shed it. Returns True when queued;
+        False when rejected — with the reason recorded in
+        :attr:`rejected`, the ``serve/rejected`` counter, and a
+        ``serve`` JSONL event (never an exception: admission control
+        is traffic policy, not a caller bug)."""
+        rc = self.robust
         eng = self.engine
+        plen = len(request.prompt)
+        self.health.submitted += 1
+        if request.rid in self._known_rids:
+            return self._reject(
+                request, "duplicate_rid",
+                f"rid {request.rid} is already tracked by this scheduler")
+        if self.draining:
+            return self._reject(
+                request, "draining",
+                "scheduler is draining; admissions are closed")
         if plen > eng.config.prefill_buckets[-1]:
-            raise ValueError(
-                f"request {request.rid}: prompt ({plen}) exceeds the "
-                f"largest prefill bucket "
+            return self._reject(
+                request, "prompt_too_long",
+                f"prompt ({plen}) exceeds the largest prefill bucket "
                 f"({eng.config.prefill_buckets[-1]})")
         if plen + request.max_new_tokens > eng.max_len:
-            raise ValueError(
-                f"request {request.rid}: prompt ({plen}) + "
-                f"max_new_tokens ({request.max_new_tokens}) exceeds "
+            return self._reject(
+                request, "budget_too_long",
+                f"prompt ({plen}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds "
                 f"max_position_embeddings ({eng.max_len})")
+        if rc.max_pending is not None and len(self.pending) >= rc.max_pending:
+            if rc.admission_policy == "reject_newest":
+                return self._reject(
+                    request, "queue_full",
+                    f"pending queue at max_pending ({rc.max_pending})")
+            # shed_oldest: the newcomer is the one a user is still
+            # waiting at; the oldest queued request has already blown
+            # the most patience — shed it to make room
+            oldest = self.pending.pop(0)
+            self._known_rids.discard(oldest.rid)
+            self._reject(oldest, "shed",
+                         f"shed for rid {request.rid} "
+                         f"(max_pending {rc.max_pending})")
+        self._known_rids.add(request.rid)
         self.pending.append(request)
         self.pending.sort(key=lambda r: (r.arrival, r.rid))
+        return True
 
-    # -- the three phases --------------------------------------------------
+    # -- terminal bookkeeping ----------------------------------------------
+
+    _TERMINAL_COUNTERS = {
+        "deadline_exceeded": "serve/expired",
+        "poisoned": "serve/quarantined",
+        "failed": "serve/failed",
+        "drained": "serve/drained",
+        "max_steps": "serve/cancelled",
+    }
+
+    def _terminal(self, req, reason, *, tokens=(), ttft_s=float("nan"),
+                  latencies=(), **event_fields):
+        """Land one request in a terminal state: completed-list record,
+        per-status counter, ``serve`` JSONL event. Every failure path
+        funnels through here so no request ever vanishes silently."""
+        rec = CompletedRequest(
+            rid=req.rid,
+            tokens=np.asarray(list(tokens), np.int32),
+            ttft_s=float(ttft_s),
+            mean_tok_latency_s=(float(np.mean(list(latencies)))
+                                if latencies else 0.0),
+            finish_reason=reason)
+        self.completed.append(rec)
+        reg = self._reg()
+        counter = self._TERMINAL_COUNTERS.get(reason)
+        if counter:
+            reg.counter(counter).inc()
+        reg.counter("serve/requests_completed").inc()
+        reg.counter("serve/tokens_generated").inc(len(rec.tokens))
+        reg.event("serve", "request_done", rid=req.rid,
+                  tokens=len(rec.tokens), prompt_len=len(req.prompt),
+                  ttft_ms=(round(rec.ttft_s * 1e3, 3)
+                           if np.isfinite(rec.ttft_s) else None),
+                  mean_tok_latency_ms=round(
+                      rec.mean_tok_latency_s * 1e3, 3),
+                  finish_reason=reason, **event_fields)
+        return rec
+
+    # -- the phases --------------------------------------------------------
+
+    def _ttft_deadline(self, req):
+        return (req.ttft_deadline_s if req.ttft_deadline_s is not None
+                else self.robust.ttft_deadline_s)
+
+    def _total_deadline(self, req):
+        return (req.total_deadline_s if req.total_deadline_s is not None
+                else self.robust.total_deadline_s)
+
+    def _expire_deadlines(self):
+        """Evict every request past its budget — queued requests past
+        their TTFT deadline, active ones past their total-latency
+        deadline — with the ``deadline_exceeded`` terminal status."""
+        now = self._clock()
+        # eligibility is stamped here (not only at admission) so a
+        # request stuck in the queue accrues wait time toward its
+        # TTFT deadline from the moment it became eligible
+        for r in self.pending:
+            if r.arrival <= self.tick:
+                self._eligible_wall.setdefault(r.rid, now)
+        for r in list(self.pending):
+            limit = self._ttft_deadline(r)
+            t0 = self._eligible_wall.get(r.rid)
+            if limit is None or t0 is None or now - t0 <= limit:
+                continue
+            self.pending.remove(r)
+            self.health.expired += 1
+            self._terminal(r, "deadline_exceeded", where="pending",
+                           waited_ms=round((now - t0) * 1e3, 3))
+        for slot in sorted(self.active):
+            st = self.active[slot]
+            limit = self._total_deadline(st.req)
+            t0 = self._eligible_wall.get(st.req.rid)
+            if limit is None or t0 is None or now - t0 <= limit:
+                continue
+            del self.active[slot]
+            self._release(slot)
+            self.health.expired += 1
+            self._terminal(st.req, "deadline_exceeded", where="active",
+                           tokens=st.tokens, ttft_s=st.ttft_s,
+                           latencies=st.latencies,
+                           waited_ms=round((now - t0) * 1e3, 3))
 
     def _admit(self):
         now = self._clock()
@@ -158,7 +328,6 @@ class Scheduler:
             for r in group:
                 self.pending.remove(r)
             slots = [self.free.pop(0) for _ in group]
-            t0 = self._clock()
             first = self.engine.prefill(
                 slots, [r.prompt for r in group],
                 pad_slot_ids=self.free)
@@ -186,20 +355,67 @@ class Scheduler:
     def _decode_once(self):
         if not self.active:
             return
+        rc = self.robust
         max_bucket = self.engine.config.batch_buckets[-1]
         slots = sorted(self.active)
         for i in range(0, len(slots), max_bucket):
             chunk = slots[i:i + max_bucket]
             toks = [self.active[s].last for s in chunk]
             t0 = self._clock()
-            nxt = self.engine.decode(chunk, toks,
-                                     pad_slot_ids=self.free)
+            try:
+                nxt, finite = self.engine.decode(
+                    chunk, toks, pad_slot_ids=self.free,
+                    retries=rc.decode_retries,
+                    backoff_s=rc.retry_backoff_s,
+                    backoff_cap_s=rc.retry_backoff_cap_s)
+            except robust_mod.DecodeFailedError as e:
+                # persistent dispatch failure: fail ONLY this chunk's
+                # requests; other chunks (and future traffic) continue
+                self.health.decode_failures += 1
+                reg = self._reg()
+                reg.counter("serve/decode_failures").inc()
+                reg.event("serve", "decode_failed", slots=list(chunk),
+                          attempts=e.attempts,
+                          error=type(e.last_error).__name__)
+                for s in chunk:
+                    st = self.active.pop(s)
+                    self._release(s)
+                    self.health.failed += 1
+                    self._terminal(st.req, "failed", tokens=st.tokens,
+                                   ttft_s=st.ttft_s,
+                                   latencies=st.latencies,
+                                   attempts=e.attempts)
+                continue
             dt = self._clock() - t0
             self.decode_steps += 1
             reg = self._reg()
             reg.counter("serve/decode_steps").inc()
-            for s, tok in zip(chunk, nxt):
+            if rc.quarantine and len(chunk) >= 2 and not finite.any():
+                # every slot non-finite at once: that is poisoned
+                # weights/activations, not one poisoned request — the
+                # whole-batch guard escalates after the quarantine
+                # bookkeeping lands (a 1-slot batch can't distinguish
+                # the two, so it stays a per-slot quarantine)
+                from apex_tpu.resilience import NonFiniteError
+
+                self.health.all_slots_nonfinite += 1
+                for s in chunk:
+                    st = self.active.pop(s)
+                    self._quarantine(s, st)
+                reg.event("serve", "all_slots_nonfinite",
+                          slots=list(chunk), tick=self.tick)
+                raise NonFiniteError(
+                    f"every slot in the decode batch ({list(chunk)}) "
+                    f"produced non-finite logits at tick {self.tick} — "
+                    f"this is model-level poison (weights/activations), "
+                    f"not a per-request fault; restore from the last "
+                    f"verified checkpoint")
+            for s, tok, ok in zip(chunk, nxt, finite):
                 st = self.active[s]
+                if rc.quarantine and not ok:
+                    del self.active[s]
+                    self._quarantine(s, st)
+                    continue
                 st.tokens.append(int(tok))
                 st.last = int(tok)
                 st.latencies.append(dt)
@@ -210,53 +426,125 @@ class Scheduler:
                     del self.active[s]
                     self._evict(s, st)
 
+    def _release(self, slot):
+        self.free.append(slot)
+        self.free.sort()
+
+    def _quarantine(self, slot, st):
+        """Evict one poisoned sequence: its KV rows were already reset
+        in-graph by the decode step; here the slot returns to the free
+        pool and the request lands with status ``poisoned``."""
+        self._release(slot)
+        self.health.quarantined += 1
+        self._terminal(st.req, "poisoned", tokens=st.tokens,
+                       ttft_s=st.ttft_s, latencies=st.latencies,
+                       slot=slot, tick=self.tick)
+
     def _evict(self, slot, st):
         if slot in self.active:
             del self.active[slot]
-        self.free.append(slot)
-        self.free.sort()
+        self._release(slot)
         eos = self.engine.config.eos_token_id
         reason = "eos" if (eos is not None and st.last == eos) \
             else "length"
-        rec = CompletedRequest(
-            rid=st.req.rid,
-            tokens=np.asarray(st.tokens, np.int32),
-            ttft_s=st.ttft_s,
-            mean_tok_latency_s=(float(np.mean(st.latencies))
-                                if st.latencies else 0.0),
-            finish_reason=reason)
-        self.completed.append(rec)
+        self._terminal(st.req, reason, tokens=st.tokens,
+                       ttft_s=st.ttft_s, latencies=st.latencies)
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self, reason="requested"):
+        """Stop admissions now; :meth:`run` finishes in-flight work up
+        to ``robust.drain_deadline_s`` and emits the drain report."""
+        if not self.draining:
+            self._begin_drain(reason)
+
+    def _begin_drain(self, reason):
+        self.draining = True
+        self._drain_reason = reason
+        self._drain_start_wall = self._clock()
+        self._drain_start_tick = self.tick
+        self._drain_completed_before = len(self.completed)
         reg = self._reg()
-        reg.counter("serve/requests_completed").inc()
-        reg.counter("serve/tokens_generated").inc(len(st.tokens))
-        reg.event("serve", "request_done", rid=st.req.rid,
-                  tokens=len(st.tokens),
-                  prompt_len=len(st.req.prompt),
-                  ttft_ms=round(rec.ttft_s * 1e3, 3),
-                  mean_tok_latency_ms=round(
-                      rec.mean_tok_latency_s * 1e3, 3),
-                  finish_reason=reason)
+        reg.event("serve", "drain_start", reason=reason, tick=self.tick,
+                  active=len(self.active), pending=len(self.pending))
+
+    def _drain_deadline_passed(self):
+        return (self._clock() - self._drain_start_wall
+                > self.robust.drain_deadline_s)
+
+    def _finish_drain(self):
+        """Cancel whatever the drain deadline stranded and emit the
+        report: every cancelled request gets the ``drained`` terminal
+        status (non-silent), the counter ticks per request, and the
+        ``drain_report`` event summarizes what the grace window
+        bought."""
+        cancelled_active = 0
+        for slot in sorted(self.active):
+            st = self.active.pop(slot)
+            self._release(slot)
+            self.health.drained += 1
+            cancelled_active += 1
+            self._terminal(st.req, "drained", tokens=st.tokens,
+                           ttft_s=st.ttft_s, latencies=st.latencies)
+        cancelled_pending = 0
+        for r in list(self.pending):
+            self.pending.remove(r)
+            self.health.drained += 1
+            cancelled_pending += 1
+            self._terminal(r, "drained", where="pending")
+        drain_s = self._clock() - self._drain_start_wall
+        completed_in_drain = (len(self.completed)
+                              - self._drain_completed_before
+                              - cancelled_active - cancelled_pending)
+        self.drain_report = robust_mod.DrainReport(
+            reason=self._drain_reason,
+            started_tick=self._drain_start_tick,
+            drain_s=drain_s,
+            completed_in_drain=completed_in_drain,
+            cancelled_active=cancelled_active,
+            cancelled_pending=cancelled_pending,
+            deadline_hit=cancelled_active > 0)
+        reg = self._reg()
+        reg.event("serve", "drain_report",
+                  **self.drain_report.as_event_fields())
 
     # -- driving -----------------------------------------------------------
 
     def step(self):
-        """One scheduler iteration: admit, decode once, advance the
-        virtual clock one tick."""
+        """One scheduler iteration: check for preemption, expire
+        deadline-blown requests, admit (unless draining), decode once,
+        advance the virtual clock one tick."""
         if self._t_start is None:
             self._t_start = self._clock()
-        self._admit()
+        if not self.draining and self.guard is not None \
+                and self.guard.preempted:
+            self._begin_drain("preempted")
+        self._expire_deadlines()
+        if not self.draining:
+            self._admit()
         self._decode_once()
-        self._reg().gauge("serve/slot_occupancy").set(
+        reg = self._reg()
+        reg.gauge("serve/slot_occupancy").set(
             len(self.active) / self.num_slots)
+        every = self.robust.health_every
+        if every and int(self.tick) % every == 0:
+            self._health_event()
         self.tick += 1.0
 
     def run(self, requests=None, *, max_steps=100_000):
-        """Drive ``requests`` (plus anything already submitted) to
-        completion; returns the completed list in finish order."""
+        """Drive ``requests`` (plus anything already submitted) to a
+        terminal state; returns the completed list in finish order.
+        Every request ends with an explicit ``finish_reason`` —
+        deadline-blown, poisoned, failed, drained at preemption, or
+        cancelled at ``max_steps`` exhaustion — never an unexplained
+        disappearance."""
         for r in requests or ():
             self.submit(r)
         steps = 0
         while self.pending or self.active:
+            if self.draining and (not self.active
+                                  or self._drain_deadline_passed()):
+                break
             if not self.active and self.pending and \
                     min(r.arrival for r in self.pending) > self.tick:
                 # idle gap: fast-forward virtual time to the next
@@ -265,13 +553,40 @@ class Scheduler:
             self.step()
             steps += 1
             if steps > max_steps:
-                raise RuntimeError(
-                    f"scheduler exceeded max_steps ({max_steps}) with "
-                    f"{len(self.pending)} pending / {len(self.active)} "
-                    f"active — a request is not converging")
+                self._exhaust_max_steps(max_steps)
+                break
+        if self.draining:
+            self._finish_drain()
         self._t_end = self._clock()
         self._census_event()
+        self._health_event()
         return self.completed
+
+    def _exhaust_max_steps(self, max_steps):
+        """``max_steps`` ran out with work left: cancel it loudly —
+        terminal status ``max_steps`` per request plus a warning —
+        instead of raising away the scheduler's accounting."""
+        stranded_active = len(self.active)
+        stranded_pending = len(self.pending)
+        for slot in sorted(self.active):
+            st = self.active.pop(slot)
+            self._release(slot)
+            self.health.max_steps += 1
+            self._terminal(st.req, "max_steps", tokens=st.tokens,
+                           ttft_s=st.ttft_s, latencies=st.latencies)
+        for r in list(self.pending):
+            self.pending.remove(r)
+            self.health.max_steps += 1
+            self._terminal(r, "max_steps", where="pending")
+        self._reg().event("serve", "max_steps_exhausted",
+                          max_steps=max_steps, tick=self.tick,
+                          cancelled_active=stranded_active,
+                          cancelled_pending=stranded_pending)
+        warnings.warn(
+            f"scheduler exhausted max_steps ({max_steps}) with "
+            f"{stranded_pending} pending / {stranded_active} active "
+            f"request(s) — all cancelled with terminal status "
+            f"'max_steps' (a request was not converging)", stacklevel=3)
 
     # -- accounting --------------------------------------------------------
 
@@ -287,20 +602,56 @@ class Scheduler:
                   cache_dtype=eng.spec.cache_dtype_name(),
                   kv_cache_bytes=eng.kv_cache_bytes())
 
+    def _health_event(self):
+        self.health.decode_retries = (self.engine.decode_retries_total
+                                      - self._retries_before)
+        self.health.emit(
+            self._reg(), tick=self.tick, pending=len(self.pending),
+            active=len(self.active), free=len(self.free),
+            completed_ok=sum(
+                1 for c in self.completed
+                if c.finish_reason in robust_mod.OK_STATUSES),
+            draining=self.draining)
+
     @staticmethod
     def _pct(samples, q):
         return float(np.percentile(samples, q)) if samples else None
 
     def stats(self):
         """Host-side summary of the run (independent of registry
-        enablement — the bench's emission source)."""
+        enablement — the bench's emission source). Goodput counts only
+        requests that finished ``length``/``eos``; every failure mode
+        has its own count next to the shed rate."""
         wall = ((self._t_end or self._clock())
                 - (self._t_start or self._clock()))
+        self.health.decode_retries = (self.engine.decode_retries_total
+                                      - self._retries_before)
+        by_reason = {}
+        goodput_tokens = 0
+        for c in self.completed:
+            by_reason[c.finish_reason] = \
+                by_reason.get(c.finish_reason, 0) + 1
+            if c.finish_reason in robust_mod.OK_STATUSES:
+                goodput_tokens += len(c.tokens)
+        h = self.health
         return {
             "requests_completed": len(self.completed),
+            "requests_ok": sum(by_reason.get(r, 0)
+                               for r in robust_mod.OK_STATUSES),
+            "requests_by_reason": by_reason,
+            "requests_rejected": h.rejected,
+            "requests_expired": h.expired,
+            "requests_quarantined": h.quarantined,
+            "requests_failed": h.failed,
+            "requests_drained": h.drained,
+            "shed_rate": round(h.shed_rate(), 4),
+            "decode_retries": h.decode_retries,
             "tokens_generated": self.tokens_generated,
+            "goodput_tokens": goodput_tokens,
             "wall_s": wall,
             "tokens_per_sec": (self.tokens_generated / wall)
+            if wall > 0 else None,
+            "goodput_tokens_per_sec": (goodput_tokens / wall)
             if wall > 0 else None,
             "decode_steps": self.decode_steps,
             "prefill_calls": self.prefill_calls,

@@ -29,6 +29,14 @@
                                            p50/p99 TTFT/latency +
                                            kv_cache_bytes (bf16 + int8)
                                            + flat compile_count
+    python bench.py serve_chaos [reqs] [len]  serving fault-tolerance
+                                           chaos: injected slot-NaN +
+                                           transient decode failure +
+                                           request storm through one
+                                           engine; emits goodput_ratio,
+                                           shed_rate, poisoned
+                                           evictions, p99 — compile
+                                           count still the ladder
     python bench.py ddp_compressed [batch] [steps]  DDP step with int8
                                            block-quantized grad
                                            collectives + error feedback;
@@ -1617,6 +1625,42 @@ def bench_ddp_memwatch(batch, steps, *, hidden=256, depth=2,
             "steps_skipped": skipped, "final_loss": final_loss}
 
 
+def _serve_bench_setup():
+    """Shared model/mesh setup for the serving benches: the llama-style
+    decode shape (or the APEX_TPU_SERVE_SMOKE=1 tiny variant for the
+    1-core CPU host), with num_query_groups * kv_channels = 256 so the
+    K/V row is exactly one 256-lane quantization block per position.
+    Returns ``(smoke, cfg, model, params, num_slots, mesh)``."""
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.transformer import parallel_state
+    from jax.sharding import Mesh
+
+    parallel_state.destroy_model_parallel()
+    smoke = os.environ.get("APEX_TPU_SERVE_SMOKE") == "1"
+    cfg = TransformerConfig(
+        hidden_size=128 if smoke else 1024,
+        num_layers=2 if smoke else 16,
+        num_attention_heads=4 if smoke else 16,
+        vocab_size=512 if smoke else 32000,
+        max_position_embeddings=128 if smoke else 2048,
+        compute_dtype=jnp.bfloat16, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu",
+        num_query_groups=4 if smoke else 4,
+        ffn_hidden_size=256 if smoke else 2816)
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(0)
+    params = GPTModel(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))["params"]
+    num_slots = 8
+    devices = jax.devices()
+    mesh = (Mesh(np.asarray(devices), ("data",))
+            if len(devices) > 1 and num_slots % len(devices) == 0
+            else None)
+    return smoke, cfg, model, params, num_slots, mesh
+
+
 def bench_serve_decode(requests, steps, *, cache_mode="bf16",
                        with_int8=True):
     """Continuous-batching serve bench (apex_tpu.serving): a
@@ -1645,38 +1689,10 @@ def bench_serve_decode(requests, steps, *, cache_mode="bf16",
     run uses the llama-style decode shape). Returns a dict for the
     oneproc serve smoke stage.
     """
-    from apex_tpu.models import GPTModel, TransformerConfig
     from apex_tpu.serving import ServeConfig, ServeEngine, synthetic_trace
     from apex_tpu.telemetry import CompileWatcher, compile_watch
-    from apex_tpu.transformer import parallel_state
-    from jax.sharding import Mesh
 
-    parallel_state.destroy_model_parallel()
-    smoke = os.environ.get("APEX_TPU_SERVE_SMOKE") == "1"
-    # num_query_groups * kv_channels = 256 in both shapes: the K/V row
-    # is exactly one 256-lane quantization block per position
-    cfg = TransformerConfig(
-        hidden_size=128 if smoke else 1024,
-        num_layers=2 if smoke else 16,
-        num_attention_heads=4 if smoke else 16,
-        vocab_size=512 if smoke else 32000,
-        max_position_embeddings=128 if smoke else 2048,
-        compute_dtype=jnp.bfloat16, use_flash_attention=False,
-        normalization="rmsnorm", position_embedding_type="rope",
-        activation="swiglu",
-        num_query_groups=4 if smoke else 4,
-        ffn_hidden_size=256 if smoke else 2816)
-    model = GPTModel(cfg, decode=True)
-    rng = np.random.RandomState(0)
-    params = GPTModel(cfg).init(
-        jax.random.PRNGKey(0),
-        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))["params"]
-
-    num_slots = 8
-    devices = jax.devices()
-    mesh = (Mesh(np.asarray(devices), ("data",))
-            if len(devices) > 1 and num_slots % len(devices) == 0
-            else None)
+    smoke, cfg, model, params, num_slots, mesh = _serve_bench_setup()
     serve_cfg = ServeConfig(
         batch_buckets=(2, 4, 8),
         prefill_buckets=(16, 32) if smoke else (32, 64, 128),
@@ -1750,7 +1766,7 @@ def bench_serve_decode(requests, steps, *, cache_mode="bf16",
     _emit("serve_decode_tokens_per_sec_per_chip", tokens_per_sec,
           "tokens/sec", flops, 1, dt,
           requests=requests, num_slots=num_slots,
-          data_devices=len(devices) if mesh is not None else 1,
+          data_devices=int(mesh.devices.size) if mesh is not None else 1,
           cache_mode=cache_mode,
           kv_cache_bytes_fp32_equiv=kv_fp32,
           requests_completed=stats_b["requests_completed"],
@@ -1758,6 +1774,134 @@ def bench_serve_decode(requests, steps, *, cache_mode="bf16",
           prefill_calls=stats_b["prefill_calls"],
           **{k: v for k, v in ret.items()
              if k not in ("tokens_per_sec", "compile_count")},
+          **_comm_fields(training=False))
+    return ret
+
+
+def bench_serve_chaos(requests, steps):
+    """Serving fault-tolerance chaos bench (apex_tpu.serving.robust):
+    ONE engine serves (a) a clean Poisson trace — the goodput
+    baseline, (b) the SAME trace with one slot-NaN injection (the
+    per-slot quarantine evicts exactly one request as ``poisoned``
+    while healthy slots keep decoding) and one transient decode
+    failure (retried with capped backoff; zero requests fail), and
+    (c) a request storm through a bounded pending queue (the overflow
+    sheds with recorded ``serve/rejected`` events instead of growing
+    the queue without bound).
+
+    Headline value is the chaos-run goodput (tokens of ``length``/
+    ``eos`` completions per second); ``goodput_ratio`` is chaos
+    goodput tokens / clean goodput tokens (the ISSUE-7 acceptance
+    floor is 0.9 — one quarantined request is the only loss).
+    ``compile_count`` must still equal the bucket-ladder size and
+    ``recompiles_chaos`` 0: every fault-tolerance path is host-side
+    policy, so injected chaos compiles nothing.
+    """
+    import dataclasses as _dc
+
+    from apex_tpu.resilience import faults
+    from apex_tpu.serving import (RobustConfig, Scheduler, ServeConfig,
+                                  ServeEngine, synthetic_trace)
+    from apex_tpu.telemetry import CompileWatcher, compile_watch
+
+    smoke, cfg, model, params, num_slots, mesh = _serve_bench_setup()
+    serve_cfg = ServeConfig(
+        batch_buckets=(2, 4, 8),
+        prefill_buckets=(16, 32) if smoke else (32, 64, 128),
+        num_slots=num_slots, cache_mode="bf16",
+        eos_token_id=None, temperature=0.0)
+    robust = RobustConfig(decode_retries=2, retry_backoff_s=0.01,
+                          retry_backoff_cap_s=0.1)
+    max_new = (max(steps // 2, 2), steps, steps * 2)
+    plens = (4, 8, 12, 24) if smoke else (8, 24, 48, 96)
+
+    def trace():
+        return synthetic_trace(
+            requests, seed=0, mean_interarrival=0.5,
+            prompt_lens=plens, max_new=max_new,
+            vocab_size=cfg.vocab_size)
+
+    watcher = CompileWatcher(enabled=True)
+    engine = ServeEngine(model, params, serve_cfg, mesh=mesh,
+                         watcher=watcher)
+
+    # (a) clean run: the goodput baseline
+    _, clean = engine.serve(trace(), robust=robust)
+    clean_goodput = clean["goodput_tokens"]
+
+    # (b) chaos run: same trace, one slot-NaN + one transient decode
+    # failure, driven step-by-step so the injections target a decode
+    # call with >= 2 active slots (quarantine must leave healthy slots
+    # decoding — and the whole-batch guard must NOT trip)
+    compiles_before = compile_watch.backend_compiles()[0]
+    sched = Scheduler(engine, robust=robust)
+    for r in trace():
+        sched.submit(r)
+    nan_armed = fail_armed = False
+    t0 = time.perf_counter()
+    try:
+        while sched.pending or sched.active:
+            if not nan_armed and len(sched.active) >= 2:
+                faults.arm_slot_nan(sorted(sched.active)[0],
+                                    engine._decode_calls)
+                nan_armed = True
+            elif nan_armed and not fail_armed and sched.active:
+                faults.arm_decode_failure(engine._decode_calls,
+                                          transient=True)
+                fail_armed = True
+            if not sched.active and sched.pending and \
+                    min(r.arrival for r in sched.pending) > sched.tick:
+                sched.tick = min(r.arrival for r in sched.pending)
+            sched.step()
+    finally:
+        faults.disarm_slot_nan()
+        faults.disarm_decode_failure()
+    dt = time.perf_counter() - t0
+    sched._t_end = time.perf_counter()
+    sched._census_event()
+    chaos = sched.stats()
+    recompiles = compile_watch.backend_compiles()[0] - compiles_before
+
+    # (c) request storm through a bounded queue: shedding, not OOM
+    storm_sched = Scheduler(engine, robust=_dc.replace(
+        robust, max_pending=max(requests // 2, 2),
+        admission_policy="shed_oldest"))
+    for r in faults.request_storm(requests * 2,
+                                  vocab_size=cfg.vocab_size):
+        storm_sched.submit(r)
+    storm_sched.run()
+    storm = storm_sched.stats()
+
+    _stage_aot_compile_count(engine.compile_count)
+    goodput = chaos["goodput_tokens_per_sec"] or 0.0
+    avg_len = float(np.mean(plens)) + steps
+    flops = chaos["goodput_tokens"] * _transformer_fwd_flops_per_token(
+        cfg, int(avg_len))
+    ret = {
+        "goodput_tokens_per_sec": round(goodput, 2),
+        "goodput_ratio": round(
+            chaos["goodput_tokens"] / clean_goodput, 4)
+        if clean_goodput else None,
+        "shed_rate": storm["shed_rate"],
+        "poisoned_evictions": chaos["requests_quarantined"],
+        "expired": chaos["requests_expired"],
+        "failed_requests": chaos["requests_failed"],
+        "decode_retries": chaos["decode_retries"],
+        "ttft_p99_ms": round(chaos["ttft_p99_ms"] or 0.0, 3),
+        "tok_latency_p99_ms": round(
+            chaos["tok_latency_p99_ms"] or 0.0, 3),
+        "compile_count": engine.compile_count,
+        "recompiles_chaos": int(recompiles),
+    }
+    _emit("serve_chaos_goodput_tokens_per_sec", goodput,
+          "tokens/sec", flops, 1, dt,
+          requests=requests, num_slots=num_slots,
+          clean_goodput_tokens=clean_goodput,
+          chaos_goodput_tokens=chaos["goodput_tokens"],
+          requests_ok=chaos["requests_ok"],
+          storm_rejected=storm["requests_rejected"],
+          **{k: v for k, v in ret.items()
+             if k not in ("goodput_tokens_per_sec", "compile_count")},
           **_comm_fields(training=False))
     return ret
 
@@ -1781,6 +1925,7 @@ BENCH_SPECS = {
     "llama": ((4, 15), bench_llama),
     "decode": ((8, 128), bench_decode),
     "serve_decode": ((24, 16), bench_serve_decode),
+    "serve_chaos": ((24, 16), bench_serve_chaos),
     "resnet": ((256, 50), bench_resnet),
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
     "ddp_resilience": ((32, 12), bench_ddp_resilience),

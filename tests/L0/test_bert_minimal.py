@@ -41,6 +41,7 @@ def bert_setup():
     parallel_state.destroy_model_parallel()
 
 
+@pytest.mark.slow
 def test_bert_tp_dp_training_loss_decreases(bert_setup):
     mesh, cfg = bert_setup
     model = bert_model_provider(config=cfg)
@@ -93,6 +94,7 @@ def test_bert_tp_dp_training_loss_decreases(bert_setup):
     assert losses[-1] < 0.8 * losses[0], losses
 
 
+@pytest.mark.slow
 def test_bert_tp2_output_shape_matches_tp1(bert_setup):
     """TP=2 vocab-sharded logits reassemble to the TP=1 output shape
     (value parity across tp sizes is covered at layer level in

@@ -180,6 +180,7 @@ class TestBottleneck:
         y, _ = m.apply(variables, x, train=True, mutable=["batch_stats"])
         assert y.shape == (2, 8, 8, 16)
 
+    @pytest.mark.slow
     def test_spatial_matches_dense(self, rng):
         """Spatial-parallel bottleneck == single-device bottleneck on the
         gathered input (reference

@@ -9,6 +9,7 @@ the per-leaf path, and donated training steps must stay correct across
 iterations.
 """
 
+import pytest
 import functools
 
 import jax
@@ -32,6 +33,7 @@ def _random_tree(rng, n_leaves=37):
     return tree
 
 
+@pytest.mark.slow
 def test_bucketed_matches_per_leaf_across_random_caps(rng):
     mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
     grads = _random_tree(rng)

@@ -103,6 +103,7 @@ class TestCompressedZeRO:
         ]
         return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_rank)
 
+    @pytest.mark.slow
     def test_int8_grads_track_uncompressed(self, rng, dp_mesh):
         """int8 grad sync + error feedback stays close to the exact
         reduce-scatter over a few steps (per-step quantization error is

@@ -234,6 +234,7 @@ class TestTensorParallelGenerate:
             compute_dtype=jnp.float32, use_flash_attention=False)
         return mesh, cfg, GPTModel(cfg, decode=True), GPTModel(cfg)
 
+    @pytest.mark.slow
     def test_tp2_decode_matches_full_forward(self):
         import functools
 
@@ -277,6 +278,7 @@ class TestTensorParallelGenerate:
                            match="tensor_parallel_generate"):
             generate(dmodel, {}, jnp.zeros((1, 4), jnp.int32), 4)
 
+    @pytest.mark.slow
     def test_tp2_beam1_equals_greedy(self):
         """num_beams=1 beam search == greedy decode, under tp=2."""
         from apex_tpu.models import (init_params_tp,
@@ -296,6 +298,7 @@ class TestTensorParallelGenerate:
                                       np.asarray(greedy))
         assert np.isfinite(np.asarray(scores)).all()
 
+    @pytest.mark.slow
     def test_tp2_beam_search_runs(self):
         from apex_tpu.models import (init_params_tp,
                                      tensor_parallel_beam_search)

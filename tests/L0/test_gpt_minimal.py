@@ -37,6 +37,7 @@ def gpt_setup():
     parallel_state.destroy_model_parallel()
 
 
+@pytest.mark.slow
 def test_gpt_3d_parallel_training_loss_decreases(gpt_setup):
     mesh, cfg = gpt_setup
     global_b = MB * M * DP
@@ -68,6 +69,7 @@ def test_gpt_3d_parallel_training_loss_decreases(gpt_setup):
     assert min(losses[6:]) < min(losses[:6])
 
 
+@pytest.mark.slow
 def test_gpt_3d_interleaved_vpp_training_loss_decreases():
     """Same 3D harness with virtual pipelining (vpp=2): 8 layers as 4
     global stages (2 chunks x 2 ranks), interleaved 1F1B. The real-model

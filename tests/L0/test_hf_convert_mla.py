@@ -58,6 +58,7 @@ def test_logits_match_hf_deepseek_mla(q_lora_rank):
                                atol=2e-4)
 
 
+@pytest.mark.slow
 def test_deepseek_greedy_matches_hf():
     from tools.convert_hf_deepseek import convert_deepseek
 
@@ -98,6 +99,7 @@ def test_deepseek_converter_refusals():
         convert_deepseek({}, cfg2)
 
 
+@pytest.mark.slow
 def test_deepseek_tp2_logits_match_tp1():
     """MLA under tensor parallelism: latent projections replicated,
     per-head expansions column-split, logits identical."""
@@ -231,6 +233,7 @@ def test_deepseek_norm_topk_prob_refused():
         convert_deepseek({}, cfg)
 
 
+@pytest.mark.slow
 def test_deepseek_moe_tp2_logits_match_tp1():
     """MoE DeepSeek under tensor parallelism: router replicated, expert
     w1 split as packed [gate | up] halves, expert w2 row-split, shared

@@ -63,6 +63,7 @@ def test_logits_match_hf_t5(gated, tie):
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_logits_match_hf_t5_asymmetric_depth_and_long_relpos():
     """Decoder deeper than encoder, and sequences past
     relative_attention_max_distance (exercises the log-spaced bucket
@@ -111,6 +112,7 @@ def test_t5_encoder_padding_mask_matches_hf():
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_t5_greedy_generation_matches_hf():
     from tools.convert_hf_t5 import convert_t5
 
@@ -129,6 +131,7 @@ def test_t5_greedy_generation_matches_hf():
     np.testing.assert_array_equal(np.asarray(ours), ref)
 
 
+@pytest.mark.slow
 def test_t5_tp2_logits_match_tp1():
     """Cross-TP serving oracle: head-sharded relative bias, column/row
     parallel q/k/v/o and (gated) FFN, vocab-parallel tied head."""
@@ -238,6 +241,7 @@ def test_t5_decode_step_without_prefill_raises():
                     None, mutable=["cache"], method=T5Model.decode_step)
 
 
+@pytest.mark.slow
 def test_t5_tp2_cached_generate_matches_tp1():
     """Tensor-parallel T5 serving: tp=2 cached decode emits tokens
     identical to the tp=1 path (and hence to HF, by the oracle above)."""

@@ -9,6 +9,7 @@ full llama-style GPT through the 3D-parallel harness.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu.models.transformer_lm import (
     ParallelAttention,
@@ -249,6 +250,7 @@ def test_llama_style_gpt_trains():
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+@pytest.mark.slow
 def test_llama_style_3d_parallel_step():
     """Llama-style config through the full pipelined pp x dp x tp harness
     (SP on): one training step, finite losses."""

@@ -104,6 +104,7 @@ class TestEndToEnd:
                 mode="step", mutable=["cache"])
         return seq
 
+    @pytest.mark.slow
     def test_cached_decode_token_exact_vs_einsum_path(self,
                                                       interpret_kernel):
         m, params, prompt = self._model()

@@ -51,6 +51,7 @@ def test_resnet18_forward_shape():
     assert bool(jnp.isfinite(logits.astype(jnp.float32)).all())
 
 
+@pytest.mark.slow
 def test_dcgan_one_amp_step_finite(rng):
     """One O2 train step of the example's D loss stays finite."""
     from apex_tpu import amp

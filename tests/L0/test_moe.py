@@ -272,6 +272,7 @@ class TestSwitchMLP:
         assert not is_expert_param("blk/experts_gate/kernel")
         assert not is_expert_param("blk/shared_experts_norm/scale")
 
+    @pytest.mark.slow
     def test_jitter_key_forced_tp_uniform(self):
         """Even an adversarial per-tp-rank jitter key (the dropout-key
         discipline) must yield identical routing on every tp rank."""
@@ -414,6 +415,7 @@ class TestParallelStateEP:
 
 
 class TestSequenceParallelMoE:
+    @pytest.mark.slow
     def test_sp_matches_non_sp_on_tp_mesh(self):
         """SwitchMLP under sequence parallelism (seq-sharded input,
         gather on entry / scatter on exit) == the non-SP layer on the
@@ -616,6 +618,7 @@ class TestDDPExpertSync:
 
 
 class TestGPTMoEEndToEnd:
+    @pytest.mark.slow
     def test_moe_gpt_ep_training_loss_decreases(self):
         """dp=2 x ep=2 x tp=2 MoE GPT: loss trends down over real steps
         (the ep analog of test_gpt_minimal's 3D run)."""
@@ -718,6 +721,7 @@ class TestMoEWithZeRO:
 
 
 class TestMoECheckpoint:
+    @pytest.mark.slow
     def test_moe_ep_training_state_roundtrip(self, tmp_path):
         """ep-sharded MoE training state survives save/restore: the
         resumed run reproduces the uninterrupted run's losses exactly."""

@@ -8,6 +8,7 @@ expert ffn over 'tp'. No reference counterpart (the reference has neither
 capability; SURVEY.md §2.3 note).
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +43,7 @@ def _reference(q, k, v, layer, params):
     return jnp.concatenate(shards, axis=0)  # [s, b, hid]
 
 
+@pytest.mark.slow
 def test_ring_attention_plus_moe_on_five_axis_mesh():
     parallel_state.destroy_model_parallel()
     mesh = parallel_state.initialize_model_parallel(

@@ -171,6 +171,7 @@ class TestAutoResolution:
 
 
 class TestExpertParallelScatter:
+    @pytest.mark.slow
     def test_scatter_under_ep4_matches_einsum(self):
         """Identical params + routing: the scatter dispatch's [E, C, h]
         slot layout must ride the expert-parallel all_to_all exactly like

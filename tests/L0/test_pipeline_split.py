@@ -68,6 +68,7 @@ def _reference(stage_params, mbs, split, cfg):
     return np.asarray(losses), grads, stacked
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("PP,split", [(2, 1), (4, 2)])
 def test_split_pipeline_matches_unpipelined_reference(rng, PP, split):
     cfg = t5_test_config()

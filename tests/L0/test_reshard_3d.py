@@ -71,6 +71,7 @@ def _clean_state():
     parallel_state.destroy_model_parallel()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("vpp", [None, 2])
 def test_resharded_checkpoint_matches_full_model_loss(vpp):
     cfg = _cfg()
@@ -83,6 +84,7 @@ def test_resharded_checkpoint_matches_full_model_loss(vpp):
     np.testing.assert_allclose(pipe_loss, ref_loss, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_resharded_tied_checkpoint_unties_head():
     """A tie_word_embeddings checkpoint has no lm_head param; resharding
     materializes embedding.T so stages can run the untied head."""
@@ -122,6 +124,7 @@ def test_pp_split_validates_layer_count():
         split_gpt_params_for_pp(cfg, {}, pp=3)
 
 
+@pytest.mark.slow
 def test_hf_gemma_checkpoint_through_3d_pipeline():
     """The full migration story on an external model family: HF Gemma
     (GeGLU, tied head, sqrt(hidden) embedding scale, GQA) converted,
@@ -161,6 +164,7 @@ def test_hf_gemma_checkpoint_through_3d_pipeline():
     np.testing.assert_allclose(pipe_loss, ref_loss, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_hf_mixtral_checkpoint_through_ep_sharding():
     """MoE migration story: HF Mixtral converted, expert-sharded over
     dp=2 x ep=2 x tp=2 (E sliced over ep, expert ffn tp-split two-region,
@@ -241,6 +245,7 @@ def test_moe_scan_layers_split_slices_expert_axis():
                         2 * cfg.ffn_size // 2)
 
 
+@pytest.mark.slow
 def test_hf_phi_checkpoint_through_3d_pipeline():
     """Biased-head migration story: HF Phi (shared-LN parallel residual,
     partial rotary, lm_head bias) converted, resharded to pp x tp x dp —

@@ -240,14 +240,19 @@ class TestEngineParity:
             _engine(model, params, batch_buckets=(16,), num_slots=4)
         with pytest.raises(ValueError, match="max_position"):
             _engine(model, params, prefill_buckets=(4096,))
+        # impossible shapes are admission-control rejections (recorded
+        # serve/rejected events), not exceptions — tests/L0/
+        # test_serving_robust.py covers the full rejection surface
         eng = _engine(model, params)
-        with pytest.raises(ValueError, match="exceeds the largest"):
-            Scheduler(eng).submit(Request(
-                rid=0, prompt=np.zeros(99, np.int32), max_new_tokens=1))
-        with pytest.raises(ValueError, match="max_position"):
-            Scheduler(eng).submit(Request(
-                rid=0, prompt=np.zeros(8, np.int32),
-                max_new_tokens=10_000))
+        sched = Scheduler(eng)
+        assert not sched.submit(Request(
+            rid=0, prompt=np.zeros(99, np.int32), max_new_tokens=1))
+        assert not sched.submit(Request(
+            rid=1, prompt=np.zeros(8, np.int32),
+            max_new_tokens=10_000))
+        assert [r.reason for r in sched.rejected] == \
+            ["prompt_too_long", "budget_too_long"]
+        assert not sched.pending
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,7 @@ def cfg():
         compute_dtype=jnp.float32)
 
 
+@pytest.mark.slow
 def test_t5_model_split_pipeline_matches_two_program_composition(cfg):
     if len(jax.devices()) < 2:
         pytest.skip("needs 2 devices")

@@ -25,6 +25,10 @@ number ``n`` (old checked-in records stay valid):
 - ``n >= 11``: ``serve_decode`` metric lines must carry the serving
   contract — p50/p99 TTFT and per-token latency plus
   ``kv_cache_bytes`` — next to their tokens/sec value.
+- ``n >= 12``: ``serve_chaos`` metric lines must carry the serving
+  fault-tolerance contract — ``goodput_ratio``, ``shed_rate``,
+  ``poisoned_evictions``, ``decode_retries`` and ``ttft_p99_ms`` —
+  next to their goodput tokens/sec value.
 
 Usage::
 
@@ -70,6 +74,16 @@ SERVE_METRIC_PREFIX = "serve_decode"
 SERVE_REQUIRED_FIELDS = ("ttft_p50_ms", "ttft_p99_ms",
                          "tok_latency_p50_ms", "tok_latency_p99_ms",
                          "kv_cache_bytes")
+# the serving fault-tolerance contract (apex_tpu.serving.robust, round
+# 12): a serve_chaos metric line must carry the chaos accounting —
+# goodput ratio vs the clean run, storm shed rate, quarantine/retry
+# counts, and the tail latency under fault — next to its goodput
+# tokens/sec value; pre-round-12 records carrying them are flagged
+SERVE_CHAOS_FIELDS_SINCE_ROUND = 12
+SERVE_CHAOS_METRIC_PREFIX = "serve_chaos"
+SERVE_CHAOS_REQUIRED_FIELDS = ("goodput_ratio", "shed_rate",
+                               "poisoned_evictions", "decode_retries",
+                               "ttft_p99_ms")
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -167,6 +181,25 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                         f"since round {SERVE_FIELDS_SINCE_ROUND})")
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"serve field {key!r} must be numeric or null")
+        is_chaos = str(obj.get("metric", "")).startswith(
+            SERVE_CHAOS_METRIC_PREFIX)
+        # presence-gate only the chaos-specific fields: ttft_p99_ms is
+        # shared with the round-11 serve_decode contract
+        present_chaos = [k for k in SERVE_CHAOS_REQUIRED_FIELDS
+                         if k in obj and k not in SERVE_REQUIRED_FIELDS]
+        if present_chaos and (round_n is not None
+                              and round_n < SERVE_CHAOS_FIELDS_SINCE_ROUND):
+            bad(f"serve_chaos fields {present_chaos} are only defined "
+                f"from round {SERVE_CHAOS_FIELDS_SINCE_ROUND}")
+        elif is_chaos and (round_n is None
+                           or round_n >= SERVE_CHAOS_FIELDS_SINCE_ROUND):
+            for key in SERVE_CHAOS_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"serve_chaos line missing {key!r} (required "
+                        f"since round {SERVE_CHAOS_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"serve_chaos field {key!r} must be numeric or "
+                        f"null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

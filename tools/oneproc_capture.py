@@ -413,6 +413,89 @@ def _serve_smoke(bench):
             "kv_cache_bytes_int8": ret.get("kv_cache_bytes_int8")}
 
 
+def _serve_chaos_smoke(bench):
+    """Serving fault-tolerance smoke (round 12): drive ``serve_chaos``
+    on the tiny model (APEX_TPU_SERVE_SMOKE=1) and assert (a) the
+    injected slot-NaN produced EXACTLY ONE ``poisoned`` eviction and
+    zero failed requests (healthy slots kept decoding), (b) goodput
+    stayed positive under chaos, (c) the transient decode failure was
+    absorbed by a retry, (d) the storm shed through the bounded queue
+    (``serve/rejected`` events in the JSONL), and (e) the compile
+    count is still the bucket-ladder size with zero chaos-time
+    compiles — every fault path is host-side policy. Raises on any
+    missing piece so the stage shows up as ERROR."""
+    import glob
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_serve_chaos_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    prev_smoke = os.environ.get("APEX_TPU_SERVE_SMOKE")
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    os.environ["APEX_TPU_SERVE_SMOKE"] = "1"
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        ret = bench.bench_serve_chaos(8, 4)
+    finally:
+        for var, old in ((telemetry.registry.ENV_DIR, prev),
+                         ("APEX_TPU_SERVE_SMOKE", prev_smoke)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+    expected = 3 * 2 + 3      # the smoke ServeConfig bucket ladder
+    if ret["compile_count"] != expected:
+        raise RuntimeError(
+            f"serve_chaos smoke: compile_count == {ret['compile_count']}, "
+            f"wanted the bucket-ladder size ({expected})")
+    if ret["recompiles_chaos"] != 0:
+        raise RuntimeError(
+            f"serve_chaos smoke: {ret['recompiles_chaos']} backend "
+            f"compile(s) under chaos — a fault path leaked into "
+            f"compiled code")
+    if ret["poisoned_evictions"] != 1:
+        raise RuntimeError(
+            f"serve_chaos smoke: {ret['poisoned_evictions']} poisoned "
+            f"eviction(s), wanted exactly 1 (the injected slot)")
+    if ret["failed_requests"] != 0:
+        raise RuntimeError(
+            f"serve_chaos smoke: {ret['failed_requests']} request(s) "
+            f"failed — the quarantine/retry did not contain the fault")
+    if not ret["goodput_tokens_per_sec"] or \
+            ret["goodput_tokens_per_sec"] <= 0:
+        raise RuntimeError("serve_chaos smoke: zero goodput under chaos")
+    if ret["decode_retries"] < 1:
+        raise RuntimeError("serve_chaos smoke: the transient decode "
+                           "failure was never retried")
+    if not ret["shed_rate"] or ret["shed_rate"] <= 0:
+        raise RuntimeError("serve_chaos smoke: the request storm shed "
+                           "nothing through the bounded queue")
+    events = []
+    for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(p) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    serve_events = [e for e in events if e["kind"] == "serve"]
+    for name in ("rejected", "request_done", "decode_retry", "health"):
+        if not [e for e in serve_events if e.get("name") == name]:
+            raise RuntimeError(
+                f"serve_chaos smoke: no serve/{name} event landed")
+    poisoned_ev = [e for e in serve_events
+                   if e.get("name") == "request_done"
+                   and e.get("finish_reason") == "poisoned"]
+    if len(poisoned_ev) != 1:
+        raise RuntimeError(
+            f"serve_chaos smoke: {len(poisoned_ev)} poisoned "
+            f"request_done event(s) in the JSONL, wanted 1")
+    return {"telemetry_dir": tel_dir,
+            "compile_count": ret["compile_count"],
+            "poisoned_evictions": ret["poisoned_evictions"],
+            "goodput_tokens_per_sec": ret["goodput_tokens_per_sec"],
+            "goodput_ratio": ret["goodput_ratio"],
+            "shed_rate": ret["shed_rate"],
+            "decode_retries": ret["decode_retries"]}
+
+
 def _stages(smoke):
     import bench
 
@@ -434,6 +517,7 @@ def _stages(smoke):
             ("numerics", None, lambda: _numerics_smoke(bench)),
             ("memwatch", None, lambda: _memwatch_smoke(bench)),
             ("serve", None, lambda: _serve_smoke(bench)),
+            ("serve_chaos", None, lambda: _serve_chaos_smoke(bench)),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -501,6 +585,14 @@ def _stages(smoke):
         # census land in the JSONL
         ("serve_decode", None, spec("serve_decode")),
         ("serve", None, lambda: _serve_smoke(bench)),
+        # round-12 serving fault-tolerance captures: the chaos config
+        # at bench size (goodput ratio vs clean, shed rate, p99 under
+        # injected slot-NaN + transient decode failure + request
+        # storm, compile_count still the ladder) and the chaos smoke
+        # proving exactly one poisoned eviction with positive goodput
+        # and a flat compile count
+        ("serve_chaos", None, spec("serve_chaos")),
+        ("serve_chaos_smoke", None, lambda: _serve_chaos_smoke(bench)),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

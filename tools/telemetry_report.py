@@ -60,7 +60,9 @@ def aggregate(events):
     memory = {"headroom_trend": [], "postmortems": [],
               "preflight_warnings": 0, "zero_state": []}
     serve = {"engines": [], "requests_done": 0, "tokens": 0,
-             "ttft_ms": [], "kv_cache": None}
+             "ttft_ms": [], "kv_cache": None,
+             "by_reason": {}, "rejected": {}, "decode_retries": 0,
+             "decode_failures": 0, "drains": [], "last_health": None}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -160,8 +162,32 @@ def aggregate(events):
                 elif sname == "request_done":
                     serve["requests_done"] += 1
                     serve["tokens"] += int(ev.get("tokens") or 0)
+                    reason = str(ev.get("finish_reason"))
+                    serve["by_reason"][reason] = \
+                        serve["by_reason"].get(reason, 0) + 1
                     if ev.get("ttft_ms") is not None:
                         serve["ttft_ms"].append(float(ev["ttft_ms"]))
+                elif sname == "rejected":
+                    reason = str(ev.get("reason"))
+                    serve["rejected"][reason] = \
+                        serve["rejected"].get(reason, 0) + 1
+                elif sname == "decode_retry":
+                    serve["decode_retries"] += 1
+                elif sname == "decode_failed":
+                    serve["decode_failures"] += 1
+                elif sname == "drain_report":
+                    serve["drains"].append({
+                        k: ev.get(k) for k in (
+                            "reason", "drain_s", "completed_in_drain",
+                            "cancelled_active", "cancelled_pending",
+                            "deadline_hit")})
+                elif sname == "health":
+                    serve["last_health"] = {
+                        k: ev.get(k) for k in (
+                            "tick", "pending", "active", "free",
+                            "completed_ok", "draining", "shed_rate",
+                            "rejected", "expired", "quarantined",
+                            "failed", "drained", "decode_retries")}
                 elif sname == "kv_cache":
                     serve["kv_cache"] = {
                         k: ev.get(k) for k in (
@@ -312,6 +338,35 @@ def print_report(report, out=sys.stdout):
                          f"{ttft[len(ttft) // 2]:.2f}ms max "
                          f"{ttft[-1]:.2f}ms")
             w(line + "\n")
+        by_reason = serve.get("by_reason") or {}
+        bad = {k: v for k, v in by_reason.items()
+               if k not in ("length", "eos")}
+        if bad:
+            detail = ", ".join(f"{k}: {n}" for k, n in sorted(bad.items()))
+            w(f"  non-goodput terminals: {detail}\n")
+        rejected = serve.get("rejected") or {}
+        if rejected:
+            detail = ", ".join(f"{k}: {n}"
+                               for k, n in sorted(rejected.items()))
+            w(f"  rejected at admission: {detail}\n")
+        if serve.get("decode_retries") or serve.get("decode_failures"):
+            w(f"  decode retries: {serve.get('decode_retries', 0)}, "
+              f"exhausted-budget failures: "
+              f"{serve.get('decode_failures', 0)}\n")
+        for d in serve.get("drains") or []:
+            w(f"  drain [{d.get('reason')}]: "
+              f"{d.get('completed_in_drain')} finished in "
+              f"{(d.get('drain_s') or 0):.2f}s, "
+              f"{d.get('cancelled_active')} active + "
+              f"{d.get('cancelled_pending')} pending cancelled"
+              f"{' (deadline hit)' if d.get('deadline_hit') else ''}\n")
+        health = serve.get("last_health")
+        if health:
+            w(f"  last health: tick {health.get('tick')}, "
+              f"{health.get('pending')} pending / "
+              f"{health.get('active')} active / "
+              f"{health.get('free')} free, shed rate "
+              f"{health.get('shed_rate')}\n")
         kv = serve.get("kv_cache")
         if kv:
             w(f"  kv cache: {kv.get('slots_used')}/"
