@@ -66,6 +66,19 @@ MANIFEST_NAME = "manifest.json"
 MANIFEST_FORMAT = 1
 ENV_RETRIES = "APEX_TPU_CKPT_RETRIES"
 
+# audit record of the last successful restore() in this process (see
+# last_restore_metadata); None until a restore succeeded
+_LAST_RESTORE_META: Optional[Dict[str, Any]] = None
+
+
+def last_restore_metadata() -> Optional[Dict[str, Any]]:
+    """The audit record of this process's most recent successful
+    :func:`restore`: ``{"directory", "requested_step", "settled_step",
+    "rejected": [{"step", "error"}], "fallback_depth"}`` — the answer
+    to "what did the fallback chain actually load, and what did it walk
+    past". None before any restore."""
+    return _LAST_RESTORE_META
+
 
 class CheckpointCorruptError(RuntimeError):
     """A checkpoint failed integrity verification or could not be
@@ -360,7 +373,8 @@ def _prune_old_steps(directory: str, keep_last_n: int) -> list:
 def restore(directory: str, step: Optional[int] = None, *,
             use_orbax: Optional[bool] = None, template: Any = None,
             verify: bool = True,
-            fallback: Optional[bool] = None) -> Dict[str, Any]:
+            fallback: Optional[bool] = None,
+            with_metadata: bool = False):
     """Load the state dict saved by :func:`save`.
 
     ``step=None`` loads the newest step — and, when that step fails
@@ -374,7 +388,18 @@ def restore(directory: str, step: Optional[int] = None, *,
     restore into that structure — orbax stores custom pytree nodes
     (NamedTuples, dataclasses) structurally and returns plain dicts
     otherwise. Raises FileNotFoundError when no checkpoints exist.
+
+    ``with_metadata=True`` returns ``(state, metadata)`` where
+    metadata is the audit record of what was *actually* loaded —
+    ``settled_step``, the ``rejected`` ``[{"step", "error"}]`` the
+    fallback chain walked past, and ``fallback_depth`` — so a
+    supervisor (or a human reading the logs) can see that "resumed"
+    meant "resumed from an OLDER step". The same record is always
+    kept at :func:`last_restore_metadata`, and a non-empty fallback
+    additionally lands the ``checkpoint/restore_fallback_step`` gauge
+    + a ``restore_fallback`` event in the registry.
     """
+    global _LAST_RESTORE_META
     if fallback is None:
         fallback = step is None
     if step is None:
@@ -393,8 +418,26 @@ def restore(directory: str, step: Optional[int] = None, *,
     rejected = []
     for i, s in enumerate(candidates):
         try:
-            return _restore_step(directory, s, use_orbax=use_orbax,
-                                 template=template, verify=verify)
+            restored = _restore_step(directory, s, use_orbax=use_orbax,
+                                     template=template, verify=verify)
+            meta = {
+                "directory": directory,
+                "requested_step": step,
+                "settled_step": s,
+                "rejected": [{"step": rs, "error": str(re)[:300]}
+                             for rs, re in rejected],
+                "fallback_depth": len(rejected),
+            }
+            _LAST_RESTORE_META = meta
+            if rejected:
+                reg = _get_registry()
+                if reg.enabled:
+                    reg.gauge("checkpoint/restore_fallback_step").set(s)
+                    reg.event("checkpoint", "restore_fallback",
+                              settled_step=s,
+                              rejected_steps=[r["step"]
+                                              for r in meta["rejected"]])
+            return (restored, meta) if with_metadata else restored
         except CheckpointCorruptError as e:
             if not fallback:
                 raise
@@ -618,9 +661,19 @@ class AsyncCheckpointer:
 
 
 def save_training_state(directory: str, step: int, params, opt_state,
-                        batch_stats=None, extra=None, **kw) -> str:
+                        batch_stats=None, extra=None, topology=None,
+                        **kw) -> str:
     """Convenience wrapper bundling the common training tuple + amp scaler
-    state (the reference's model+optimizer+amp torch.save pattern)."""
+    state (the reference's model+optimizer+amp torch.save pattern).
+
+    ``topology`` records the WRITING topology in the checkpoint (and so
+    in its manifest) — ``{"world": 8, "axis_name": "dp", "optimizer":
+    "DistributedFusedAdam", "block_size": 256}`` or whatever the run's
+    sharded state needs for an elastic restore. ZeRO shards written at
+    world=8 can only be re-partitioned onto a world=4 mesh if the
+    restorer knows they WERE world=8 —
+    ``DistributedFusedAdam.load_state_dict_resharded`` consumes exactly
+    this record (docs/resilience.md, "Supervised training")."""
     from apex_tpu import amp
 
     state = {"params": params, "opt_state": opt_state, "step": step}
@@ -628,6 +681,8 @@ def save_training_state(directory: str, step: int, params, opt_state,
         state["batch_stats"] = batch_stats
     if extra is not None:
         state["extra"] = extra
+    if topology is not None:
+        state["topology"] = {k: v for k, v in dict(topology).items()}
     try:
         state["amp"] = amp.state_dict()
     except Exception as e:
@@ -636,15 +691,19 @@ def save_training_state(directory: str, step: int, params, opt_state,
 
 
 def restore_training_state(directory: str, step: Optional[int] = None,
-                           **kw) -> Dict[str, Any]:
+                           **kw):
     """Load what :func:`save_training_state` wrote; re-installs amp scaler
     state when present and rebuilds the optimizer ScalerState (orbax
     stores NamedTuples structurally — pass ``template=`` for full custom-
-    node fidelity on arbitrary states)."""
+    node fidelity on arbitrary states). The saved ``topology`` record
+    (writing world size etc.) comes back under ``state["topology"]``;
+    ``with_metadata=True`` forwards to :func:`restore` and returns
+    ``(state, metadata)``."""
     from apex_tpu import amp
     from apex_tpu.amp.scaler import ScalerState
 
-    state = restore(directory, step, **kw)
+    out = restore(directory, step, **kw)
+    state, meta = out if kw.get("with_metadata") else (out, None)
     opt_state = state.get("opt_state")
     if isinstance(opt_state, dict) and isinstance(opt_state.get("scaler"),
                                                   dict):
@@ -657,4 +716,4 @@ def restore_training_state(directory: str, step: Optional[int] = None,
                 f"checkpoint: amp scaler state failed to load ({e}); "
                 "resuming with the current scaler — loss scale may differ "
                 "from the saved run")
-    return state
+    return (state, meta) if meta is not None else state

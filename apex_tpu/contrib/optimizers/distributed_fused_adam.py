@@ -31,6 +31,7 @@ over 'ep'. Pre-average dense grads over 'ep' first::
 (total dense averaging = ep here x dp inside = the full replica set).
 """
 
+import warnings
 from typing import Optional
 
 import jax
@@ -64,6 +65,173 @@ def _unflatten_like(flat, params):
     return jax.tree_util.tree_unflatten(treedef, outs)
 
 
+def _padded_size(n, world, grad_compress, param_compress, block_size):
+    """Padded flat length for ``world``-way sharding: shards must be
+    equal-length, and int8 modes additionally need every rank's shard
+    to cover whole quantization blocks."""
+    align = world
+    if "int8" in (grad_compress, param_compress):
+        align *= block_size
+    return ((n + align - 1) // align) * align
+
+
+def _global_flat(arr, padded, world, name):
+    """Normalize one ZeRO state buffer to the host-global ``(padded,)``
+    flat vector: accepts the ``out_specs=P(axis)`` concatenation
+    (already ``(padded,)``), the ``(world, padded // world)`` per-rank
+    stack, or a single-device ``(padded,)`` shard. Rank order is the
+    concatenation order either way (``init`` slices rank*shard_len)."""
+    a = np.asarray(arr)
+    if a.ndim == 2:
+        if a.shape != (world, padded // world):
+            raise ValueError(
+                f"{name}: stacked shards have shape {a.shape}, wanted "
+                f"({world}, {padded // world})")
+        a = a.reshape(-1)
+    if a.shape != (padded,):
+        raise ValueError(
+            f"{name}: flat length {a.shape} does not match the padded "
+            f"length {padded} for world={world} — wrong world, or a "
+            f"state written with different compression alignment?")
+    return a
+
+
+def consolidate_zero_state(state, params, *, world, grad_compress=None,
+                           param_compress=None,
+                           block_size=compression.BLOCK_SIZE,
+                           optimizer="zero"):
+    """Host-side: the per-rank ZeRO shards -> one full, UNPADDED
+    state_dict (the re-shardable canonical form).
+
+    ``state`` is the host-global view of a run's optimizer state: each
+    ``*_shard`` leaf either the ``(padded,)`` concatenation of the
+    per-rank shards (what the ``out_specs=P(axis)`` carry idiom hands
+    the host) or a ``(world, padded // world)`` stack; the per-rank
+    full-length EF residual is a ``(world, padded)`` stack. The
+    returned dict holds fp32 ``master`` / ``exp_avg`` / ``exp_avg_sq``
+    of the *logical* length ``n`` (shard padding stripped — padding is
+    a function of the world size and must be recomputed per topology),
+    the int8 error-feedback ``grad_residual`` as the SUM over ranks
+    (the total pending correction — the only topology-invariant view;
+    unpadded, its pad tail being identically zero), and the layout
+    metadata an elastic restore needs (``world``, ``block_size``,
+    compression modes). Bit-exact: values are copied, never
+    re-quantized or re-rounded."""
+    n = _flat_size(params)
+    padded = _padded_size(n, world, grad_compress, param_compress,
+                          block_size)
+    full = {
+        "format": 1,
+        "optimizer": optimizer,
+        "world": int(world),
+        "n_elements": n,
+        "block_size": int(block_size),
+        "grad_compress": grad_compress,
+        "param_compress": param_compress,
+        "step": np.asarray(state["step"], np.int32).reshape(()),
+    }
+    for src, dst in (("master_shard", "master"),
+                     ("exp_avg_shard", "exp_avg"),
+                     ("exp_avg_sq_shard", "exp_avg_sq")):
+        full[dst] = _global_flat(state[src], padded, world, src)[:n]
+    if state.get("grad_residual") is not None:
+        # The EF residual is full-length and PER-RANK (each rank's own
+        # local quantization error), so the host-global carry stacks it
+        # on a leading world axis. The canonical consolidated form is
+        # the SUM over ranks — the total pending correction the replica
+        # set owes the gradients: each rank adds its residual before
+        # the psum, so only the sum is topology-invariant.
+        res = np.asarray(state["grad_residual"])
+        if res.ndim == 2:
+            if res.shape != (world, padded):
+                raise ValueError(
+                    f"grad_residual: stacked shape {res.shape}, wanted "
+                    f"({world}, {padded})")
+            res = res.sum(axis=0)
+        elif res.shape == (padded,):
+            if world != 1:
+                raise ValueError(
+                    f"grad_residual: got one ({padded},) vector for "
+                    f"world={world} — the per-rank residuals must be "
+                    f"stacked ({world}, {padded}); a single vector is "
+                    "only unambiguous at world=1")
+        else:
+            raise ValueError(
+                f"grad_residual: shape {res.shape}, wanted "
+                f"({world}, {padded}) or ({padded},) at world=1")
+        full["grad_residual"] = res[:n]
+    return full
+
+
+def reshard_zero_state(full, params, *, world, grad_compress=None,
+                       param_compress=None,
+                       block_size=compression.BLOCK_SIZE):
+    """Host-side: one full unpadded state_dict
+    (:func:`consolidate_zero_state`) -> the host-global ZeRO state for
+    a ``world``-way mesh, with the shard padding recomputed for the NEW
+    topology (int8 block alignment included).
+
+    Returns ``{"step", "master_shard", "exp_avg_shard",
+    "exp_avg_sq_shard"[, "grad_residual"]}`` where each ``*_shard``
+    leaf is the ``(new_padded,)`` concatenation — feed it through
+    ``in_specs=P(axis)`` and every rank receives exactly its
+    ``new_padded // world`` slice (``world=1`` consumes it whole) —
+    and ``grad_residual`` is the per-rank ``(world, new_padded)``
+    stack (rank 0 carrying the whole summed correction, so the
+    topology-invariant total is preserved to the bit).
+    Master/moment values are bit-identical to the writer's on the
+    logical prefix; only the zero pad tail changes length, so an
+    8 -> 4 -> 1 -> 8 round-trip reproduces the consolidated state_dict
+    exactly."""
+    n = _flat_size(params)
+    if full.get("n_elements") not in (None, n):
+        raise ValueError(
+            f"state_dict is for {full['n_elements']} elements, params "
+            f"flatten to {n} — wrong model for this checkpoint")
+    padded = _padded_size(n, world, grad_compress, param_compress,
+                          block_size)
+
+    def pad(v):
+        a = np.asarray(v, np.float32)
+        if a.shape != (n,):
+            raise ValueError(f"full state buffer has shape {a.shape}, "
+                             f"wanted ({n},)")
+        return np.pad(a, (0, padded - n))
+
+    state = {
+        "step": jnp.asarray(np.asarray(full["step"], np.int32)
+                            .reshape(())),
+        "master_shard": jnp.asarray(pad(full["master"])),
+        "exp_avg_shard": jnp.asarray(pad(full["exp_avg"])),
+        "exp_avg_sq_shard": jnp.asarray(pad(full["exp_avg_sq"])),
+    }
+    written_residual = full.get("grad_residual")
+    if grad_compress == "int8":
+        if written_residual is None:
+            # written without EF (fp32/bf16 grads): start a fresh,
+            # zeroed residual — correct, just loses nothing real
+            state["grad_residual"] = jnp.zeros((world, padded),
+                                               jnp.float32)
+        else:
+            # rank 0 carries the whole pending correction, the rest
+            # start at zero: the sum over ranks — the only
+            # topology-invariant quantity — is preserved TO THE BIT
+            # (an even total/world split would round on
+            # re-consolidation: sequentially summing w identical fp32
+            # values is inexact for non-power-of-two partial sums)
+            rows = np.zeros((world, padded), np.float32)
+            rows[0] = pad(written_residual)
+            state["grad_residual"] = jnp.asarray(rows)
+    elif written_residual is not None:
+        warnings.warn(
+            "reshard_zero_state: the checkpoint carries an int8 "
+            "error-feedback residual but the target optimizer is not "
+            "grad_compress='int8' — dropping the residual (its error "
+            "will re-enter the gradients once, bounded by one "
+            "quantization step)")
+    return state
+
+
 def zero_state_bytes(params, *, world, grad_compress=None,
                      param_compress=None,
                      block_size=compression.BLOCK_SIZE, axis_name="dp",
@@ -87,10 +255,8 @@ def zero_state_bytes(params, *, world, grad_compress=None,
     from apex_tpu.telemetry.registry import get_registry
 
     n = _flat_size(params)
-    align = world
-    if "int8" in (grad_compress, param_compress):
-        align *= block_size
-    padded = ((n + align - 1) // align) * align
+    padded = _padded_size(n, world, grad_compress, param_compress,
+                          block_size)
     f32 = 4
     unsharded = 3 * padded * f32
     sharded = 3 * (padded // world) * f32
@@ -187,15 +353,53 @@ class DistributedFusedAdam:
             axis_name=self.axis_name, optimizer="DistributedFusedAdam",
             registry=registry, record=record)
 
+    # -- elastic re-sharding (host-side; docs/resilience.md) ------------
+
+    def topology(self, world):
+        """The writing-topology record for
+        ``checkpoint.save_training_state(topology=...)`` — what
+        :meth:`load_state_dict_resharded` needs to re-partition this
+        state onto a different world size."""
+        return {"optimizer": type(self).__name__, "world": int(world),
+                "axis_name": str(self.axis_name),
+                "grad_compress": self.grad_compress,
+                "param_compress": self.param_compress,
+                "block_size": int(self.compress_block_size)}
+
+    def state_dict_full(self, state, params, *, world):
+        """Host-side: the run's ZeRO state (each ``*_shard`` leaf the
+        ``(padded,)`` concatenation of the per-rank shards — the
+        ``out_specs=P(axis)`` carry idiom — or a ``(world, shard)``
+        stack) -> one full UNPADDED state_dict that
+        :meth:`load_state_dict_resharded` can re-partition onto any
+        world size. ``world`` is explicit because the axis is unbound
+        on the host. See :func:`consolidate_zero_state`."""
+        return consolidate_zero_state(
+            state, params, world=world, grad_compress=self.grad_compress,
+            param_compress=self.param_compress,
+            block_size=self.compress_block_size,
+            optimizer=type(self).__name__)
+
+    def load_state_dict_resharded(self, full, params, *, world):
+        """Host-side: a :meth:`state_dict_full` dict (written at ANY
+        world size) -> this optimizer's state re-partitioned for a
+        ``world``-way mesh, shard padding recomputed (int8 block
+        alignment included). fp32 masters/moments and the EF residual
+        restore bit-exactly; only the zero pad tail changes length.
+        See :func:`reshard_zero_state`."""
+        return reshard_zero_state(
+            full, params, world=world, grad_compress=self.grad_compress,
+            param_compress=self.param_compress,
+            block_size=self.compress_block_size)
+
     def _shard_info(self, params):
         n = _flat_size(params)
         world = _axis_size(self.axis_name)
         # int8 modes need every rank's shard to cover whole quantization
         # blocks (scales slice cleanly at shard boundaries)
-        align = world
-        if "int8" in (self.grad_compress, self.param_compress):
-            align *= self.compress_block_size
-        padded = ((n + align - 1) // align) * align
+        padded = _padded_size(n, world, self.grad_compress,
+                              self.param_compress,
+                              self.compress_block_size)
         return n, padded, world
 
     def init(self, params):

@@ -20,7 +20,10 @@ from jax import lax
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     _flat_size,
     _flatten_f32,
+    _padded_size,
     _unflatten_like,
+    consolidate_zero_state,
+    reshard_zero_state,
     zero_state_bytes,
 )
 from apex_tpu.parallel import compression
@@ -89,16 +92,42 @@ class DistributedFusedLAMB:
             axis_name=self.axis_name, optimizer="DistributedFusedLAMB",
             registry=registry, record=record)
 
+    # -- elastic re-sharding: same flat layout as DistributedFusedAdam
+    # (master/moment shards + optional full-length EF residual), so the
+    # same consolidate/reshard math applies verbatim
+
+    def topology(self, world):
+        """See :meth:`DistributedFusedAdam.topology`."""
+        return {"optimizer": type(self).__name__, "world": int(world),
+                "axis_name": str(self.axis_name),
+                "grad_compress": self.grad_compress,
+                "param_compress": self.param_compress,
+                "block_size": int(self.compress_block_size)}
+
+    def state_dict_full(self, state, params, *, world):
+        """See :meth:`DistributedFusedAdam.state_dict_full`."""
+        return consolidate_zero_state(
+            state, params, world=world, grad_compress=self.grad_compress,
+            param_compress=self.param_compress,
+            block_size=self.compress_block_size,
+            optimizer=type(self).__name__)
+
+    def load_state_dict_resharded(self, full, params, *, world):
+        """See :meth:`DistributedFusedAdam.load_state_dict_resharded`."""
+        return reshard_zero_state(
+            full, params, world=world, grad_compress=self.grad_compress,
+            param_compress=self.param_compress,
+            block_size=self.compress_block_size)
+
     def _layout(self, params):
         leaves = jax.tree_util.tree_leaves(params)
         sizes = [int(np.prod(l.shape)) for l in leaves]
         n = sum(sizes)
         world = _axis_size(self.axis_name)
-        align = world
-        if "int8" in (self.grad_compress, self.param_compress):
-            # shard boundaries must land on quantization-block boundaries
-            align *= self.compress_block_size
-        padded = ((n + align - 1) // align) * align
+        # shard boundaries must land on quantization-block boundaries
+        padded = _padded_size(n, world, self.grad_compress,
+                              self.param_compress,
+                              self.compress_block_size)
         # static segment ids over the padded flat vector (pad -> segment T)
         seg = np.repeat(np.arange(len(sizes)), sizes)
         seg = np.concatenate([seg, np.full(padded - n, len(sizes))])

@@ -1,6 +1,16 @@
 """apex_tpu.resilience — surviving the failures a long run will see.
 
-Four pillars (docs/resilience.md has the operational tour):
+Five pillars (docs/resilience.md has the operational tour):
+
+- :mod:`supervisor` — the recovery loop on top of everything below:
+  :class:`Supervisor` wraps a user step function, classifies each
+  failure (:func:`classify_failure`) and applies a per-class
+  :class:`RecoveryPolicy` — hot-snapshot revert + loss-scale backoff
+  for numerics, checkpoint-fallback restore for corruption, one final
+  save + clean exit for preemption, a mesh-shrink restart (elastic
+  ZeRO re-sharding) for device loss — with bounded restarts, capped
+  backoff, and a step-monotonic :class:`StepLedger` proving no step
+  was silently lost or double-applied.
 
 - :mod:`guard`      — jit-native non-finite step guard:
   :func:`guarded_update` skips poisoned optimizer steps in-graph (one
@@ -35,6 +45,8 @@ turns persistent NaN skips into an attributed :class:`NonFiniteError`.
 
 from apex_tpu.resilience import faults  # noqa: F401
 from apex_tpu.resilience import preemption  # noqa: F401
+from apex_tpu.resilience import supervisor  # noqa: F401
+from apex_tpu.resilience.faults import DeviceLostError  # noqa: F401
 from apex_tpu.resilience.guard import (  # noqa: F401
     GuardState,
     NonFiniteError,
@@ -45,4 +57,15 @@ from apex_tpu.resilience.guard import (  # noqa: F401
     nonfinite_flag,
 )
 from apex_tpu.resilience.preemption import PreemptionGuard  # noqa: F401
+from apex_tpu.resilience.supervisor import (  # noqa: F401
+    FailureClass,
+    LedgerError,
+    RecoveryExhaustedError,
+    RecoveryPolicy,
+    StepLedger,
+    Supervisor,
+    classify_failure,
+    default_policies,
+    loss_scale_backoff,
+)
 from apex_tpu.telemetry.memory import HBMExhaustedError  # noqa: F401

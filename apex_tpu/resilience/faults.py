@@ -2,17 +2,28 @@
 
 Every injector is explicit and deterministic — a fault fires at the
 step / call you named, never randomly — so a chaos test is a regular
-regression test. Gating is API-first (call the injector) with env
-escape hatches for end-to-end drills from the bench/capture drivers:
+regression test. Gating is API-first (call the injector) with one env
+escape hatch for end-to-end drills from the bench/capture drivers:
 
-- ``APEX_TPU_FAULT_NAN_STEP=<n>`` — :func:`nan_step_from_env`, read by
-  ``bench.bench_ddp_resilience`` and anything else calling
-  :func:`inject_nan` with ``nan_step=None``.
-- ``APEX_TPU_FAULT_CKPT_WRITE_FAILURES=<n>`` — default failure count
-  for :func:`failing_checkpoint_writes`.
-- ``APEX_TPU_FAULT_ALLOC_STEP=<n>`` — :func:`alloc_step_from_env`,
-  read by ``bench.bench_ddp_memwatch`` and anything else calling
-  :func:`inject_alloc_failure` with ``alloc_step=None``.
+- ``APEX_TPU_FAULT_PLAN`` — the consolidated fault spec, a
+  ``;``-separated list of ``kind@step[:arg]`` entries parsed by
+  :func:`parse_fault_plan` / read by :func:`fault_plan`::
+
+      APEX_TPU_FAULT_PLAN="nan@3:layer1;alloc@5;preempt@9"
+
+  Kinds: ``nan`` (arg = module-path prefix filter), ``alloc``,
+  ``preempt``, ``device_loss`` (arg = shrink-to world),
+  ``decode`` (arg = ``transient``/``persistent``), ``slot_nan``
+  (arg = slot id), ``ckpt_torn``, ``ckpt_fail`` (step = failure
+  count). Every ``*_from_env`` helper consults the plan, so one
+  var scripts a whole chaos campaign.
+
+The pre-plan per-injector vars still work — ``APEX_TPU_FAULT_NAN_STEP``,
+``_ALLOC_STEP``, ``_CKPT_WRITE_FAILURES``, ``_SLOT_NAN``,
+``_DECODE_STEP``/``_TRANSIENT`` — but are DEPRECATED in favor of the
+plan (one ``DeprecationWarning`` per var per process); when both name
+the same fault the legacy var wins, so existing drills keep their
+meaning.
 
 Injector catalogue:
 
@@ -61,17 +72,32 @@ import contextlib
 import os
 import pickle
 import signal
+import warnings
 
 import jax.numpy as jnp
 import numpy as np
 from jax import tree_util
 
+ENV_FAULT_PLAN = "APEX_TPU_FAULT_PLAN"
 ENV_NAN_STEP = "APEX_TPU_FAULT_NAN_STEP"
 ENV_CKPT_WRITE_FAILURES = "APEX_TPU_FAULT_CKPT_WRITE_FAILURES"
 ENV_ALLOC_STEP = "APEX_TPU_FAULT_ALLOC_STEP"
 ENV_SLOT_NAN = "APEX_TPU_FAULT_SLOT_NAN"
 ENV_DECODE_STEP = "APEX_TPU_FAULT_DECODE_STEP"
 ENV_DECODE_TRANSIENT = "APEX_TPU_FAULT_DECODE_TRANSIENT"
+
+#: every spec kind ``parse_fault_plan`` accepts, with the meaning of
+#: the optional ``:arg`` suffix (None = no arg defined for the kind)
+PLAN_KINDS = {
+    "nan": "module-path prefix filter (inject_nan path_filter)",
+    "alloc": None,
+    "preempt": None,
+    "device_loss": "shrink-to world size for the mesh-shrink restart",
+    "decode": "'transient' (default) or 'persistent'",
+    "slot_nan": "slot id to poison (default 0)",
+    "ckpt_torn": None,
+    "ckpt_fail": None,  # step field = number of failing writes
+}
 
 
 class FaultInjected(OSError):
@@ -98,16 +124,171 @@ class InjectedDecodeFailure(FaultInjected):
         self.transient = bool(transient)
 
 
+class DeviceLostError(RuntimeError):
+    """Injected device/slice loss. The message carries the literal
+    ``DEVICE_LOST`` marker (what the PJRT runtime surfaces when a pod
+    slice drops out), so ``resilience.supervisor.classify_failure``
+    routes it to the mesh-shrink policy. ``shrink_to`` optionally names
+    the world size the surviving mesh should restart at (None = let the
+    policy decide, typically world // 2)."""
+
+    def __init__(self, msg, *, shrink_to=None):
+        super().__init__(msg)
+        self.shrink_to = shrink_to
+
+
+# -- the consolidated fault plan --------------------------------------------
+
+class FaultPlan:
+    """A parsed ``APEX_TPU_FAULT_PLAN`` spec: ``entries`` maps kind ->
+    ``{"kind", "step", "arg"}``. One entry per kind (a campaign names
+    each fault class at most once — sweep classes across runs, not
+    within one)."""
+
+    def __init__(self, entries=None, spec=""):
+        self.entries = dict(entries or {})
+        self.spec = spec
+
+    def get(self, kind):
+        """The entry dict for ``kind``, or None when the plan does not
+        name that fault class."""
+        return self.entries.get(kind)
+
+    def step(self, kind):
+        """The armed step for ``kind``, or None."""
+        e = self.entries.get(kind)
+        return e["step"] if e else None
+
+    def __bool__(self):
+        return bool(self.entries)
+
+    def __repr__(self):
+        return f"FaultPlan({self.spec!r})"
+
+
+def parse_fault_plan(spec):
+    """Parse one ``kind@step[:arg]``-list spec (``;``-separated) into a
+    :class:`FaultPlan`. Raises ValueError naming the offending entry on
+    an unknown kind, a non-integer step, or a duplicate kind."""
+    entries = {}
+    for raw in (spec or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, at, rest = raw.partition("@")
+        kind = kind.strip()
+        if not at or kind not in PLAN_KINDS:
+            known = ", ".join(sorted(PLAN_KINDS))
+            raise ValueError(
+                f"{ENV_FAULT_PLAN}: bad entry {raw!r} — want "
+                f"'kind@step[:arg]' with kind in ({known})")
+        step_s, _, arg = rest.partition(":")
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"{ENV_FAULT_PLAN}: entry {raw!r} has a non-integer "
+                f"step {step_s!r}") from None
+        if kind in entries:
+            raise ValueError(
+                f"{ENV_FAULT_PLAN}: duplicate entry for kind "
+                f"{kind!r} ({raw!r}); name each fault class once")
+        entries[kind] = {"kind": kind, "step": step,
+                         "arg": arg if arg != "" else None}
+    return FaultPlan(entries, spec or "")
+
+
+def fault_plan():
+    """The plan parsed from ``$APEX_TPU_FAULT_PLAN`` (re-read on every
+    call — cheap, and monkeypatched envs in tests stay honest). An
+    unset/empty var yields an empty plan that gates nothing."""
+    return parse_fault_plan(os.environ.get(ENV_FAULT_PLAN, ""))
+
+
+_legacy_warned = set()
+
+
+def _legacy_env_step(var, plan_kind):
+    """Read a deprecated per-injector step var, warning once per var
+    per process that the consolidated plan supersedes it. The legacy
+    var wins over a plan entry of the same kind (existing drills keep
+    their meaning); returns None when unset."""
+    v = os.environ.get(var)
+    if v in (None, ""):
+        return None
+    if var not in _legacy_warned:
+        _legacy_warned.add(var)
+        warnings.warn(
+            f"{var} is deprecated — express the fault in "
+            f"{ENV_FAULT_PLAN} instead (e.g. "
+            f"'{plan_kind}@{v}'); the legacy var still wins when both "
+            "are set", DeprecationWarning, stacklevel=3)
+    return int(v)
+
+
 def nan_step_from_env():
-    """The step to poison per ``$APEX_TPU_FAULT_NAN_STEP``, or None."""
-    v = os.environ.get(ENV_NAN_STEP)
-    return int(v) if v not in (None, "") else None
+    """The step to poison per ``$APEX_TPU_FAULT_NAN_STEP`` (deprecated)
+    or the plan's ``nan@N`` entry, or None."""
+    legacy = _legacy_env_step(ENV_NAN_STEP, "nan")
+    if legacy is not None:
+        return legacy
+    return fault_plan().step("nan")
+
+
+def nan_path_from_env():
+    """The module-path prefix filter of the plan's ``nan@N:prefix``
+    entry, or None (poison everything). The legacy var has no path
+    field, so this is plan-only."""
+    e = fault_plan().get("nan")
+    return e["arg"] if e else None
 
 
 def alloc_step_from_env():
-    """The step to OOM per ``$APEX_TPU_FAULT_ALLOC_STEP``, or None."""
-    v = os.environ.get(ENV_ALLOC_STEP)
-    return int(v) if v not in (None, "") else None
+    """The step to OOM per ``$APEX_TPU_FAULT_ALLOC_STEP`` (deprecated)
+    or the plan's ``alloc@N`` entry, or None."""
+    legacy = _legacy_env_step(ENV_ALLOC_STEP, "alloc")
+    if legacy is not None:
+        return legacy
+    return fault_plan().step("alloc")
+
+
+def preempt_step_from_env():
+    """The step to deliver the simulated SIGTERM at, per the plan's
+    ``preempt@N`` entry (plan-only — no legacy var existed), or None.
+    Consumed by drivers (``tools/chaos_run.py``): preemption is a
+    signal, not an in-graph fault, so the driver owns the delivery."""
+    return fault_plan().step("preempt")
+
+
+def device_loss_spec_from_env():
+    """``(step, shrink_to)`` of the plan's ``device_loss@N[:world]``
+    entry, or ``(None, None)``."""
+    e = fault_plan().get("device_loss")
+    if not e:
+        return None, None
+    return e["step"], int(e["arg"]) if e["arg"] else None
+
+
+def inject_device_loss(step, device_loss_step=None, *, shrink_to=None,
+                       world=None):
+    """Raise :class:`DeviceLostError` when ``step ==
+    device_loss_step`` (host-side — a real device loss kills the
+    dispatch, so the injector fires just before it, the topology
+    sibling of :func:`inject_alloc_failure`). ``device_loss_step=None``
+    consults the plan's ``device_loss@N[:world]`` entry; still None
+    means no injection. ``shrink_to`` (default: the plan's arg, else
+    None) rides on the error so the supervisor's mesh-shrink policy
+    knows the surviving world size."""
+    if device_loss_step is None:
+        device_loss_step, plan_shrink = device_loss_spec_from_env()
+        if shrink_to is None:
+            shrink_to = plan_shrink
+    if device_loss_step is None or int(step) != int(device_loss_step):
+        return
+    detail = f" (world was {int(world)})" if world else ""
+    raise DeviceLostError(
+        f"DEVICE_LOST: injected device loss at step {int(step)}{detail} "
+        f"(faults.inject_device_loss)", shrink_to=shrink_to)
 
 
 def inject_alloc_failure(step, alloc_step=None, *, bytes_requested=None):
@@ -147,6 +328,9 @@ def inject_nan(tree, step, nan_step=None, path_filter=None):
     poison. Leaves that don't match pass through untouched."""
     if nan_step is None:
         nan_step = nan_step_from_env()
+        if path_filter is None:
+            # the plan's nan@N:prefix arg targets the fault for free
+            path_filter = nan_path_from_env()
     if nan_step is None:
         return tree
     step = jnp.asarray(step)
@@ -181,7 +365,19 @@ def failing_checkpoint_writes(failures=None, after_bytes=64):
     from apex_tpu import checkpoint
 
     if failures is None:
-        failures = int(os.environ.get(ENV_CKPT_WRITE_FAILURES, "1"))
+        legacy = os.environ.get(ENV_CKPT_WRITE_FAILURES)
+        if legacy not in (None, ""):
+            if ENV_CKPT_WRITE_FAILURES not in _legacy_warned:
+                _legacy_warned.add(ENV_CKPT_WRITE_FAILURES)
+                warnings.warn(
+                    f"{ENV_CKPT_WRITE_FAILURES} is deprecated — use "
+                    f"{ENV_FAULT_PLAN}='ckpt_fail@{legacy}'",
+                    DeprecationWarning, stacklevel=3)
+            failures = int(legacy)
+        else:
+            failures = fault_plan().step("ckpt_fail")
+            if failures is None:
+                failures = 1
     real = checkpoint._write_state
     stats = {"fired": 0}
 
@@ -282,10 +478,20 @@ _decode_fail_state = None   # {"step", "transient", "fired"}
 
 def _slot_nan_from_env():
     v = os.environ.get(ENV_SLOT_NAN)
-    if v in (None, ""):
+    if v not in (None, ""):
+        if ENV_SLOT_NAN not in _legacy_warned:
+            _legacy_warned.add(ENV_SLOT_NAN)
+            slot_s, _, step_s = v.partition(":")
+            warnings.warn(
+                f"{ENV_SLOT_NAN} is deprecated — use "
+                f"{ENV_FAULT_PLAN}='slot_nan@{step_s or 0}:{slot_s}'",
+                DeprecationWarning, stacklevel=3)
+        slot, _, step = v.partition(":")
+        return {"slot": int(slot), "step": int(step or 0), "fired": 0}
+    e = fault_plan().get("slot_nan")
+    if e is None:
         return None
-    slot, _, step = v.partition(":")
-    return {"slot": int(slot), "step": int(step or 0), "fired": 0}
+    return {"slot": int(e["arg"] or 0), "step": e["step"], "fired": 0}
 
 
 def arm_slot_nan(slot, step):
@@ -325,7 +531,8 @@ def poison_slot_for(decode_step):
     Env arming (``APEX_TPU_FAULT_SLOT_NAN=slot:step``) is read lazily
     on first consult and follows the same one-shot contract."""
     global _slot_nan_state
-    if _slot_nan_state is None and ENV_SLOT_NAN in os.environ:
+    if _slot_nan_state is None and (ENV_SLOT_NAN in os.environ
+                                    or fault_plan().get("slot_nan")):
         _slot_nan_state = _slot_nan_from_env()
     st = _slot_nan_state
     if not st or st["fired"] or int(decode_step) != st["step"]:
@@ -372,10 +579,21 @@ def maybe_fail_decode(decode_step):
     ``APEX_TPU_FAULT_DECODE_STEP`` (+ ``..._TRANSIENT=0`` for the
     permanent flavor) is read lazily on first consult."""
     global _decode_fail_state
-    if _decode_fail_state is None and ENV_DECODE_STEP in os.environ:
+    if _decode_fail_state is None:
         v = os.environ.get(ENV_DECODE_STEP)
         if v not in (None, ""):
+            if ENV_DECODE_STEP not in _legacy_warned:
+                _legacy_warned.add(ENV_DECODE_STEP)
+                warnings.warn(
+                    f"{ENV_DECODE_STEP} is deprecated — use "
+                    f"{ENV_FAULT_PLAN}='decode@{v}'",
+                    DeprecationWarning, stacklevel=2)
             arm_decode_failure(int(v))
+        else:
+            e = fault_plan().get("decode")
+            if e is not None:
+                arm_decode_failure(
+                    e["step"], transient=(e["arg"] != "persistent"))
     st = _decode_fail_state
     if not st or int(decode_step) != st["step"]:
         return

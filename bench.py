@@ -1625,6 +1625,57 @@ def bench_ddp_memwatch(batch, steps, *, hidden=256, depth=2,
             "steps_skipped": skipped, "final_loss": final_loss}
 
 
+def bench_ddp_recovery(batch, steps, *, hidden=24, depth=2):
+    """Supervised-training chaos campaign (resilience.supervisor over
+    guarded int8 DDP+ZeRO): ONE run takes a NaN-escalation streak, a
+    synthetic OOM, a torn checkpoint write, and a simulated preemption
+    — every class recovered automatically by the per-class
+    RecoveryPolicy (hot-snapshot revert + loss-scale backoff,
+    checkpoint-fallback restore, save-and-exit + resume), with the
+    step ledger proving no step was lost or double-applied and the
+    final loss matching an un-faulted baseline (tools/chaos_run.py
+    owns the harness and the invariant asserts — a violated invariant
+    is a bench crash, not a quietly wrong number).
+
+    The emitted line carries the round-13 recovery contract:
+    ``restarts``, ``mttr_steps`` (mean steps replayed per recovery —
+    the snapshot cadence bound), ``snapshot_restores``,
+    ``checkpoint_restores``, ``goodput_step_ratio`` (committed steps /
+    total dispatches incl. replays), and ``final_loss_delta`` vs the
+    clean run. Timing covers the whole campaign (clean + chaos +
+    resume) — this is a robustness capture, not a perf flagship.
+    """
+    from tools.chaos_run import run_acceptance
+
+    world = len(jax.devices())
+    while world > 1 and batch % world:
+        world //= 2  # an odd device count still gets a valid mesh
+    t0 = time.perf_counter()
+    out = run_acceptance(steps=steps, world=world, hidden=hidden,
+                         depth=depth, global_batch=batch)
+    dt = time.perf_counter() - t0
+    if out["violations"]:
+        raise RuntimeError("ddp_recovery invariants violated: "
+                           + "; ".join(out["violations"]))
+    n = depth * (hidden * hidden + hidden)
+    fields = _comm_fields(n_elements=n, compress="int8")
+    flops = 6 * batch * depth * hidden * hidden
+    _emit("ddp_recovery_steps_per_sec", steps / dt, "steps/sec",
+          flops, steps, dt, dp_world=out["world"], grad_elements=n,
+          restarts=out["restarts"],
+          mttr_steps=round(out["mttr_steps"], 3),
+          snapshot_restores=out["snapshot_restores"],
+          checkpoint_restores=out["checkpoint_restores"],
+          goodput_step_ratio=round(out["goodput_step_ratio"], 4),
+          final_loss_delta=out["final_loss_delta"],
+          reshard_bitexact=out["reshard_bitexact"],
+          cause_histogram=out["cause_histogram"], **fields)
+    return {k: out[k] for k in (
+        "restarts", "mttr_steps", "snapshot_restores",
+        "checkpoint_restores", "goodput_step_ratio", "final_loss_delta",
+        "reshard_bitexact", "cause_histogram", "steps_lost")}
+
+
 def _serve_bench_setup():
     """Shared model/mesh setup for the serving benches: the llama-style
     decode shape (or the APEX_TPU_SERVE_SMOKE=1 tiny variant for the
@@ -1931,6 +1982,7 @@ BENCH_SPECS = {
     "ddp_resilience": ((32, 12), bench_ddp_resilience),
     "ddp_numerics": ((32, 12), bench_ddp_numerics),
     "ddp_memwatch": ((32, 12), bench_ddp_memwatch),
+    "ddp_recovery": ((32, 18), bench_ddp_recovery),
 }
 
 

@@ -124,6 +124,39 @@ def test_memwatch_fields_gated_at_round10():
     assert any("non-negative" in m for m in msgs)
 
 
+def test_recovery_fields_gated_at_round13():
+    """ISSUE 8 satellite: ddp_recovery's supervised-chaos accounting
+    (restarts / mttr_steps / snapshot_restores / goodput_step_ratio)
+    is required on ddp_recovery lines from round 13, and flagged on
+    records from rounds where the fields did not exist."""
+    base = {"metric": "ddp_recovery_steps_per_sec", "value": 1.0,
+            "unit": "steps/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 10,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": None}
+    line = dict(base, restarts=3, mttr_steps=2.7, snapshot_restores=2,
+                goodput_step_ratio=0.64)
+    assert schema.check_metric_line(dict(line), round_n=13, errors=[]) == []
+    # a pre-13 record carrying them is flagged — the fields did not exist
+    msgs = schema.check_metric_line(dict(line), round_n=12, errors=[])
+    assert any("only defined" in m for m in msgs)
+    # from 13, a ddp_recovery line without them is incomplete
+    msgs = schema.check_metric_line(dict(base), round_n=13, errors=[])
+    for key in ("restarts", "mttr_steps", "snapshot_restores",
+                "goodput_step_ratio"):
+        assert any(key in m for m in msgs)
+    # other configs never need them
+    other = dict(base, metric="gpt2_345m_tokens_per_sec_per_chip")
+    assert schema.check_metric_line(other, round_n=13, errors=[]) == []
+    # typed when present
+    line["mttr_steps"] = "fast"
+    msgs = schema.check_metric_line(dict(line), round_n=13, errors=[])
+    assert any("must be numeric or null" in m for m in msgs)
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-10 (current)
     metric-line contract — telemetry + memwatch fields included."""

@@ -341,6 +341,7 @@ def test_guard_adds_no_host_callbacks_to_compiled_step():
 
 def test_inject_nan_is_identity_when_unarmed(monkeypatch):
     monkeypatch.delenv(faults.ENV_NAN_STEP, raising=False)
+    monkeypatch.delenv(faults.ENV_FAULT_PLAN, raising=False)
     tree = {"w": jnp.ones((3,)), "n": jnp.arange(2)}
     out = faults.inject_nan(tree, jnp.asarray(0))
     np.testing.assert_array_equal(out["w"], tree["w"])
@@ -351,6 +352,82 @@ def test_inject_nan_is_identity_when_unarmed(monkeypatch):
     poisoned = faults.inject_nan(tree, jnp.asarray(2))
     assert np.all(np.isnan(poisoned["w"]))
     np.testing.assert_array_equal(poisoned["n"], tree["n"])  # ints kept
+
+
+# ---------------------------------------------------------------------------
+# the consolidated fault plan (APEX_TPU_FAULT_PLAN)
+# ---------------------------------------------------------------------------
+
+def test_parse_fault_plan_grammar():
+    plan = faults.parse_fault_plan(
+        "nan@3:layer1;alloc@5;preempt@9;device_loss@7:4;"
+        "decode@2:persistent;slot_nan@4:1;ckpt_torn@6;ckpt_fail@2")
+    assert plan.step("nan") == 3
+    assert plan.get("nan")["arg"] == "layer1"
+    assert plan.step("alloc") == 5
+    assert plan.step("preempt") == 9
+    assert plan.get("device_loss") == {"kind": "device_loss",
+                                       "step": 7, "arg": "4"}
+    assert plan.get("decode")["arg"] == "persistent"
+    assert plan.step("ckpt_fail") == 2
+    assert bool(plan)
+    assert not faults.parse_fault_plan("")
+    assert faults.parse_fault_plan("  ;  ").get("nan") is None
+
+
+@pytest.mark.parametrize("bad,match", [
+    ("bogus@3", "bad entry"),
+    ("nan=3", "bad entry"),
+    ("nan@three", "non-integer step"),
+    ("nan@1;nan@2", "duplicate entry"),
+])
+def test_parse_fault_plan_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        faults.parse_fault_plan(bad)
+
+
+def test_fault_plan_feeds_the_env_helpers(monkeypatch):
+    for var in (faults.ENV_NAN_STEP, faults.ENV_ALLOC_STEP):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv(faults.ENV_FAULT_PLAN,
+                       "nan@3:layer1;alloc@5;preempt@9;device_loss@7:4")
+    assert faults.nan_step_from_env() == 3
+    assert faults.nan_path_from_env() == "layer1"
+    assert faults.alloc_step_from_env() == 5
+    assert faults.preempt_step_from_env() == 9
+    assert faults.device_loss_spec_from_env() == (7, 4)
+    # inject_nan picks the plan's path filter up for free
+    tree = {"layer1": {"w": jnp.ones((2,))}, "layer2": {"w": jnp.ones((2,))}}
+    poisoned = faults.inject_nan(tree, jnp.asarray(3))
+    assert np.all(np.isnan(poisoned["layer1"]["w"]))
+    assert not np.any(np.isnan(poisoned["layer2"]["w"]))
+    with pytest.raises(faults.SyntheticResourceExhausted):
+        faults.inject_alloc_failure(5)
+    faults.inject_alloc_failure(4)  # other steps untouched
+
+
+def test_legacy_fault_vars_win_with_deprecation(monkeypatch):
+    monkeypatch.setenv(faults.ENV_FAULT_PLAN, "nan@3")
+    monkeypatch.setenv(faults.ENV_NAN_STEP, "7")
+    faults._legacy_warned.discard(faults.ENV_NAN_STEP)
+    with pytest.warns(DeprecationWarning, match="APEX_TPU_FAULT_PLAN"):
+        assert faults.nan_step_from_env() == 7  # legacy wins
+    # warned once per process, honored silently afterwards
+    assert faults.nan_step_from_env() == 7
+
+
+def test_inject_device_loss(monkeypatch):
+    monkeypatch.delenv(faults.ENV_FAULT_PLAN, raising=False)
+    faults.inject_device_loss(3)  # unarmed: no-op
+    with pytest.raises(faults.DeviceLostError, match="DEVICE_LOST") \
+            as exc:
+        faults.inject_device_loss(3, 3, shrink_to=4, world=8)
+    assert exc.value.shrink_to == 4
+    monkeypatch.setenv(faults.ENV_FAULT_PLAN, "device_loss@2:1")
+    faults.inject_device_loss(1)
+    with pytest.raises(faults.DeviceLostError) as exc:
+        faults.inject_device_loss(2)
+    assert exc.value.shrink_to == 1
 
 
 # ---------------------------------------------------------------------------
@@ -407,6 +484,60 @@ def test_restore_rejects_torn_write_and_falls_back(tmp_path):
     with pytest.warns(UserWarning, match="torn write"):
         restored = restore(str(tmp_path))
     assert int(restored["step"]) == 1
+
+
+def test_restore_metadata_audits_the_fallback(tmp_path):
+    """ISSUE-8 satellite: the fallback chain's settling is auditable —
+    restore metadata names the settled step and every rejected one,
+    and the checkpoint/restore_fallback_step gauge lands."""
+    save(str(tmp_path), 1, _state(1.0), use_orbax=False)
+    save(str(tmp_path), 2, _state(2.0), use_orbax=False)
+    save(str(tmp_path), 3, _state(3.0), use_orbax=False)
+    faults.corrupt_checkpoint(str(tmp_path), 2)
+    faults.corrupt_checkpoint(str(tmp_path), 3)
+    reg = MetricsRegistry(enabled=True)
+    with use_registry(reg):
+        with pytest.warns(UserWarning, match="REJECTED"):
+            restored, meta = restore(str(tmp_path), with_metadata=True)
+    assert int(restored["step"]) == 1
+    assert meta["settled_step"] == 1
+    assert meta["fallback_depth"] == 2
+    assert [r["step"] for r in meta["rejected"]] == [3, 2]
+    assert all("sha256 mismatch" in r["error"] for r in meta["rejected"])
+    assert checkpoint.last_restore_metadata() == meta
+    snap = reg.snapshot()
+    assert snap["gauges"]["checkpoint/restore_fallback_step"] == 1
+    assert snap["counters"]["checkpoint/restore_rejected"] == 2
+
+
+def test_restore_metadata_clean_path_has_no_fallback(tmp_path):
+    save(str(tmp_path), 4, _state(4.0), use_orbax=False)
+    restored, meta = restore(str(tmp_path), with_metadata=True)
+    assert meta == {"directory": str(tmp_path), "requested_step": None,
+                    "settled_step": 4, "rejected": [],
+                    "fallback_depth": 0}
+    # default return shape unchanged: a bare dict, no tuple
+    assert int(restore(str(tmp_path))["step"]) == 4
+
+
+def test_training_state_topology_roundtrip(tmp_path):
+    """The writing topology rides in the checkpoint (and manifest) so
+    an elastic restore knows the shard layout it must re-partition."""
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    opt = DistributedFusedAdam(compress=True)
+    checkpoint.save_training_state(
+        str(tmp_path), 5, {"w": jnp.ones(3)}, {"m": jnp.zeros(3)},
+        topology=opt.topology(8), use_orbax=False)
+    state, meta = checkpoint.restore_training_state(
+        str(tmp_path), with_metadata=True)
+    assert state["topology"]["world"] == 8
+    assert state["topology"]["optimizer"] == "DistributedFusedAdam"
+    assert state["topology"]["grad_compress"] == "int8"
+    assert meta["settled_step"] == 5
+    manifest = verify_checkpoint(checkpoint._step_dir(str(tmp_path), 5))
+    assert any(e["path"].startswith("topology/")
+               for e in manifest["leaves"])
 
 
 def test_restore_all_steps_corrupt_raises_with_inventory(tmp_path):

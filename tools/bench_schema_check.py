@@ -29,6 +29,10 @@ number ``n`` (old checked-in records stay valid):
   fault-tolerance contract — ``goodput_ratio``, ``shed_rate``,
   ``poisoned_evictions``, ``decode_retries`` and ``ttft_p99_ms`` —
   next to their goodput tokens/sec value.
+- ``n >= 13``: ``ddp_recovery`` metric lines must carry the training
+  recovery contract — ``restarts``, ``mttr_steps``,
+  ``snapshot_restores``, ``goodput_step_ratio`` — next to their
+  steps/sec value.
 
 Usage::
 
@@ -84,6 +88,15 @@ SERVE_CHAOS_METRIC_PREFIX = "serve_chaos"
 SERVE_CHAOS_REQUIRED_FIELDS = ("goodput_ratio", "shed_rate",
                                "poisoned_evictions", "decode_retries",
                                "ttft_p99_ms")
+# the training recovery contract (resilience.supervisor, round 13): a
+# ddp_recovery metric line must carry the supervised-chaos accounting —
+# restart count, MTTR in steps (snapshot-cadence bound), snapshot
+# restores, and the goodput ratio (committed steps over dispatches
+# incl. replays); pre-round-13 records carrying them are flagged
+RECOVERY_FIELDS_SINCE_ROUND = 13
+RECOVERY_METRIC_PREFIX = "ddp_recovery"
+RECOVERY_REQUIRED_FIELDS = ("restarts", "mttr_steps",
+                            "snapshot_restores", "goodput_step_ratio")
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -199,6 +212,23 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                         f"since round {SERVE_CHAOS_FIELDS_SINCE_ROUND})")
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"serve_chaos field {key!r} must be numeric or "
+                        f"null")
+        is_recovery = str(obj.get("metric", "")).startswith(
+            RECOVERY_METRIC_PREFIX)
+        present_recovery = [k for k in RECOVERY_REQUIRED_FIELDS
+                            if k in obj]
+        if present_recovery and (round_n is not None
+                                 and round_n < RECOVERY_FIELDS_SINCE_ROUND):
+            bad(f"recovery fields {present_recovery} are only defined "
+                f"from round {RECOVERY_FIELDS_SINCE_ROUND}")
+        elif is_recovery and (round_n is None
+                              or round_n >= RECOVERY_FIELDS_SINCE_ROUND):
+            for key in RECOVERY_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"ddp_recovery line missing {key!r} (required "
+                        f"since round {RECOVERY_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"recovery field {key!r} must be numeric or "
                         f"null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None

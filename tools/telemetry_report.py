@@ -40,7 +40,7 @@ def load_events(paths):
 KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
-    "compile", "memory", "serve",
+    "compile", "memory", "serve", "recovery",
 })
 
 
@@ -63,6 +63,10 @@ def aggregate(events):
              "ttft_ms": [], "kv_cache": None,
              "by_reason": {}, "rejected": {}, "decode_retries": 0,
              "decode_failures": 0, "drains": [], "last_health": None}
+    recovery = {"failures": 0, "recovered": 0, "gave_up": 0,
+                "by_cause": {}, "by_action": {}, "snapshots": 0,
+                "steps_lost": 0, "preempted_exits": 0,
+                "last_run": None}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -194,6 +198,33 @@ def aggregate(events):
                             "slots_total", "slots_used", "slots_free",
                             "bytes_per_slot", "cache_dtype",
                             "kv_cache_bytes")}
+            elif kind == "recovery":
+                rname = ev.get("name")
+                if rname == "failure":
+                    recovery["failures"] += 1
+                    cause = str(ev.get("cls"))
+                    recovery["by_cause"][cause] = \
+                        recovery["by_cause"].get(cause, 0) + 1
+                elif rname == "recovered":
+                    recovery["recovered"] += 1
+                    action = str(ev.get("action"))
+                    recovery["by_action"][action] = \
+                        recovery["by_action"].get(action, 0) + 1
+                    recovery["steps_lost"] += int(
+                        ev.get("steps_lost") or 0)
+                elif rname == "gave_up":
+                    recovery["gave_up"] += 1
+                elif rname == "snapshot":
+                    recovery["snapshots"] += 1
+                elif rname == "preempted_exit":
+                    recovery["preempted_exits"] += 1
+                elif rname == "run_done":
+                    recovery["last_run"] = {
+                        k: ev.get(k) for k in (
+                            "exit", "final_step", "restarts",
+                            "snapshot_restores", "checkpoint_restores",
+                            "mesh_shrinks", "steps_lost", "mttr_steps",
+                            "goodput_step_ratio")}
             elif kind in KNOWN_KINDS:
                 pass  # known but needs no aggregation (checkpoint, ...)
             else:
@@ -215,6 +246,7 @@ def aggregate(events):
         "compiles": compiles,
         "memory": memory,
         "serve": serve,
+        "recovery": recovery,
         "unknown_kinds": unknown,
         "malformed_events": malformed,
         "counters": (last_summary or {}).get("counters", {}),
@@ -373,6 +405,33 @@ def print_report(report, out=sys.stdout):
               f"{kv.get('slots_total')} slots used, "
               f"{_fmt_bytes(kv.get('bytes_per_slot') or 0)}/slot "
               f"({kv.get('cache_dtype')})\n")
+    recovery = report.get("recovery") or {}
+    if recovery.get("failures") or recovery.get("snapshots") \
+            or recovery.get("preempted_exits"):
+        w("\nrecovery (resilience.supervisor):\n")
+        w(f"  {recovery.get('failures', 0)} failure(s), "
+          f"{recovery.get('recovered', 0)} recovered, "
+          f"{recovery.get('gave_up', 0)} gave up, "
+          f"{recovery.get('snapshots', 0)} hot snapshot(s), "
+          f"{recovery.get('steps_lost', 0)} step(s) replayed\n")
+        by_cause = recovery.get("by_cause") or {}
+        if by_cause:
+            detail = ", ".join(f"{k}: {n}"
+                               for k, n in sorted(by_cause.items()))
+            w(f"  cause histogram: {detail}\n")
+        by_action = recovery.get("by_action") or {}
+        if by_action:
+            detail = ", ".join(f"{k}: {n}"
+                               for k, n in sorted(by_action.items()))
+            w(f"  recovery actions: {detail}\n")
+        if recovery.get("preempted_exits"):
+            w(f"  preempted exits: {recovery['preempted_exits']}\n")
+        last = recovery.get("last_run")
+        if last:
+            w(f"  last run: {last.get('exit')} @ step "
+              f"{last.get('final_step')}, {last.get('restarts')} "
+              f"restart(s), mttr {last.get('mttr_steps')} step(s), "
+              f"goodput ratio {last.get('goodput_step_ratio')}\n")
     unknown = report.get("unknown_kinds") or {}
     skipped = sum(unknown.values()) + report.get("malformed_events", 0)
     if skipped:
