@@ -84,6 +84,7 @@ from apex_tpu._logging import RankInfoFormatter, deprecated_warning  # noqa: F40
 # same surface as `import apex` (reference apex/__init__.py imports amp etc.
 # lazily behind try/except; we are pure-Python+JAX so imports are cheap).
 from apex_tpu import telemetry  # noqa: F401
+from apex_tpu import analysis  # noqa: F401
 from apex_tpu import multi_tensor_apply  # noqa: F401
 from apex_tpu import optimizers  # noqa: F401
 from apex_tpu import normalization  # noqa: F401

@@ -47,7 +47,8 @@ class AmpOptimizer(object):
             # alias-free copy: astype is a no-op on already-fp32 leaves
             # (all norm params under O2) and would alias masters to the
             # live params — donating both then trips XLA's
-            # donate-same-buffer-twice check (tools/donation_repro.py)
+            # donate-same-buffer-twice check (the double-donation lint
+            # rule in apex_tpu.analysis catches this at trace time)
             from apex_tpu.optimizers._base import master_copy_tree
 
             inner_state["amp_master"] = master_copy_tree(params)

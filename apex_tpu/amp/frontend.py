@@ -13,7 +13,6 @@ TPU mapping of the opt levels (fp16 -> bf16):
   O3: pure bf16, no masters, loss_scale=1.
 """
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

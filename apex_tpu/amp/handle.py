@@ -11,7 +11,6 @@ jit). For fully-jitted training loops prefer the functional API:
 """
 
 import contextlib
-import warnings
 
 from apex_tpu.amp._amp_state import _amp_state
 

@@ -1,0 +1,65 @@
+"""apex_tpu.analysis — static lint over lowered HLO / jaxprs enforcing
+the repo's hot-path invariants (docs/analysis.md).
+
+The fused-kernel / fused-optimizer value proposition only holds while
+the compiled step stays free of hidden host syncs, dtype leaks, and
+redundant buffers. Those invariants used to live in fragile ad-hoc
+string greps spread across the test suite; this package makes them a
+rule-based, structured, waivable static-analysis pass over a
+``jax.jit(...).lower(...)`` artifact — trace-only, never compiling or
+executing anything.
+
+    from apex_tpu.analysis import assert_clean_hlo
+    assert_clean_hlo(train_step, params, opt_state, x, y)
+
+Integration points:
+
+- ``CompileWatcher`` lints every newly compiled executable when
+  ``APEX_TPU_HLO_LINT=1`` and emits ``lint`` JSONL events.
+- ``assert_clean_hlo(fn, *args, rules=...)`` is the CI primitive next
+  to ``assert_no_recompiles``.
+- ``tools/hlo_lint.py`` lints every default bench config's lowered
+  step and prints a rule x config table.
+- ``apex_tpu.analysis.pysrc`` is the repo's Python-source checker (the
+  ruff-config fallback when ruff itself isn't installed).
+"""
+
+from apex_tpu.analysis.lint import (  # noqa: F401
+    HloLintError,
+    LintContext,
+    LintReport,
+    assert_clean_hlo,
+    lint_fn,
+    lint_lowered,
+    run_rules,
+)
+from apex_tpu.analysis.rules import (  # noqa: F401
+    HOST_CALLBACK_TARGETS,
+    RULES,
+    Finding,
+    LintConfig,
+)
+
+
+def report_to_registry(report, *, registry=None, name=None):
+    """Emit a LintReport into the telemetry registry: one ``lint``
+    event per finding plus a summary event, and the
+    ``lint/violations`` counter. No-op (beyond the return) when the
+    registry is disabled — same contract as every other telemetry
+    producer."""
+    from apex_tpu.telemetry.registry import get_registry
+
+    reg = registry or get_registry()
+    if not reg.enabled:
+        return report
+    tag = name or report.name
+    if report.findings:
+        reg.counter("lint/violations").inc(len(report.findings))
+    for f in report.findings:
+        reg.event("lint", tag, **f.to_dict())
+    reg.event("lint", tag, summary=True,
+              violations=len(report.findings),
+              rules_run=list(report.rules_run),
+              rules_skipped=list(report.rules_skipped),
+              clean=report.ok)
+    return report

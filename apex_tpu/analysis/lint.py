@@ -1,0 +1,220 @@
+"""The lint driver: build a :class:`LintContext` from a jitted function
+or an already-lowered artifact, run the rule catalog, return a
+structured :class:`LintReport`.
+
+Three entrypoints, in decreasing order of evidence:
+
+- :func:`lint_fn` — trace the function (``jit(fn).trace(*args)``, no
+  compile) and lint with the FULL context: StableHLO text, closed
+  jaxpr, argument pytree (donation flags + concrete buffers). Every
+  rule runs.
+- :func:`lint_lowered` — lint an existing ``Lowered`` (the
+  CompileWatcher / bench path: the lowering already exists, re-tracing
+  would double the cost). Jaxpr-needing rules that can't run are
+  reported as *skipped*, and the trace-constant rule falls back to the
+  text parser.
+- :func:`assert_clean_hlo` — the test/CI primitive next to
+  ``assert_no_recompiles``: lint and raise :class:`HloLintError`
+  naming every finding (rule, op/argument path, message) when any
+  rule fires. ``rules=`` selects a subset, ``waive=`` excludes.
+
+Everything is host-side and trace-only: linting never compiles, never
+executes, and never mutates the function under test.
+"""
+
+import jax
+
+from apex_tpu.analysis.rules import RULES, Finding, LintConfig  # noqa: F401
+
+
+class HloLintError(AssertionError):
+    """Raised by :func:`assert_clean_hlo` when a rule fires. Subclasses
+    AssertionError so pytest reports it as a plain test failure."""
+
+
+class LintContext:
+    """Everything a rule may look at. ``hlo_text`` is always present;
+    ``closed_jaxpr`` / ``flat_args_info`` / ``flat_args`` /
+    ``out_avals`` are None when the entrypoint couldn't provide them
+    (rules needing them are skipped)."""
+
+    def __init__(self, *, hlo_text, name="", closed_jaxpr=None,
+                 flat_args_info=None, flat_args=None, out_avals=None):
+        self.hlo_text = hlo_text
+        self.name = name
+        self.closed_jaxpr = closed_jaxpr
+        self.flat_args_info = flat_args_info
+        self.flat_args = flat_args
+        self.out_avals = out_avals
+
+
+class LintReport:
+    """Findings plus which rules ran — a skipped rule is visible, never
+    a silent pass."""
+
+    def __init__(self, name, findings, rules_run, rules_skipped):
+        self.name = name
+        self.findings = list(findings)
+        self.rules_run = tuple(rules_run)
+        self.rules_skipped = tuple(rules_skipped)
+
+    @property
+    def ok(self):
+        return not self.findings
+
+    def counts(self):
+        """``{rule: finding_count}`` over every rule that ran."""
+        out = {r: 0 for r in self.rules_run}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def render(self):
+        head = (f"hlo lint[{self.name or '<fn>'}]: "
+                f"{len(self.findings)} violation(s), "
+                f"{len(self.rules_run)} rule(s) run"
+                + (f", skipped: {', '.join(self.rules_skipped)}"
+                   if self.rules_skipped else ""))
+        return "\n".join([head] + [f"  - {f}" for f in self.findings])
+
+    def to_dict(self):
+        return {"name": self.name,
+                "violations": len(self.findings),
+                "rules_run": list(self.rules_run),
+                "rules_skipped": list(self.rules_skipped),
+                "findings": [f.to_dict() for f in self.findings]}
+
+
+def _leaf_path_str(path):
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def _flatten_with_paths(tree, root=""):
+    flat = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        p = _leaf_path_str(path)
+        flat.append((f"{root}/{p}" if p else root or "arg", leaf))
+    return flat
+
+
+def _select_rules(rules=None, waive=()):
+    if rules is None:
+        names = list(RULES)
+    else:
+        names = [rules] if isinstance(rules, str) else list(rules)
+        unknown = [n for n in names if n not in RULES]
+        if unknown:
+            raise ValueError(
+                f"unknown lint rule(s) {unknown}; known: {list(RULES)}")
+    waive = {waive} if isinstance(waive, str) else set(waive or ())
+    return [n for n in names if n not in waive]
+
+
+def run_rules(ctx, *, rules=None, waive=(), config=None):
+    """Run the selected rules over a prepared context."""
+    cfg = config or LintConfig()
+    findings, ran, skipped = [], [], []
+    for name in _select_rules(rules, waive):
+        fn, _needs = RULES[name]
+        out = fn(ctx, cfg)
+        if out is None:  # the rule's required artifact is missing
+            skipped.append(name)
+            continue
+        ran.append(name)
+        findings.extend(out[:cfg.max_findings_per_rule])
+    return LintReport(ctx.name, findings, ran, skipped)
+
+
+def _is_staged(fn):
+    return hasattr(fn, "trace") and hasattr(fn, "lower")
+
+
+def _flat_out_info(staged):
+    """Flat leaf list of a Traced/Lowered ``out_info`` pytree (a bare
+    OutInfo for single-output functions), or None when unavailable."""
+    try:
+        info = staged.out_info
+    except Exception:
+        return None
+    leaves = jax.tree_util.tree_leaves(
+        info, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))
+    return [o for o in leaves
+            if hasattr(o, "shape") and hasattr(o, "dtype")] or None
+
+
+def lint_fn(fn, *args, rules=None, waive=(), config=None, name=None,
+            **kwargs):
+    """Trace ``fn`` (jitted or plain; plain functions are wrapped in
+    ``jax.jit``) on ``args``/``kwargs`` and lint with full context.
+    Returns a :class:`LintReport`. Trace-only — nothing compiles."""
+    # a watched function (CompileWatcher) delegates trace/lower to the
+    # wrapped pjit, so it counts as staged; only plain callables get a
+    # fresh jit wrapper here (never unwrap: jit sets __wrapped__ to the
+    # plain function, and unwrapping would drop donate_argnums)
+    jitted = fn if _is_staged(fn) else jax.jit(fn)
+    traced = jitted.trace(*args, **kwargs)
+    lowered = traced.lower()
+    args_info, kwargs_info = traced.args_info
+    flat_info = (_flatten_with_paths(tuple(args_info), "args")
+                 + _flatten_with_paths(dict(kwargs_info), "kwargs"))
+    flat_vals = (_flatten_with_paths(tuple(args), "args")
+                 + _flatten_with_paths(dict(kwargs), "kwargs"))
+    # align values to info by path (donated consts can drop from one
+    # side in exotic cases; a mismatch degrades double-donation to a
+    # path-keyed subset rather than crashing the lint)
+    val_by_path = dict(flat_vals)
+    flat_args = [(p, val_by_path.get(p)) for p, _ in flat_info]
+    ctx = LintContext(
+        hlo_text=lowered.as_text(),
+        name=name or getattr(fn, "__name__", "") or "<fn>",
+        closed_jaxpr=traced.jaxpr,
+        flat_args_info=flat_info,
+        flat_args=flat_args,
+        out_avals=_flat_out_info(traced),
+    )
+    return run_rules(ctx, rules=rules, waive=waive, config=config)
+
+
+def lint_lowered(lowered, *, rules=None, waive=(), config=None,
+                 name=None):
+    """Lint an existing ``jax.stages.Lowered``. Rules that need the
+    jaxpr or concrete arguments are skipped (visible in the report);
+    the trace-constant rule falls back to the HLO-text parser."""
+    try:
+        args_info, kwargs_info = lowered.args_info
+        flat_info = (_flatten_with_paths(tuple(args_info), "args")
+                     + _flatten_with_paths(dict(kwargs_info), "kwargs"))
+    except Exception:
+        flat_info = None
+    out_avals = _flat_out_info(lowered)
+    ctx = LintContext(
+        hlo_text=lowered.as_text(),
+        name=name or "<lowered>",
+        flat_args_info=flat_info,
+        out_avals=out_avals,
+    )
+    return run_rules(ctx, rules=rules, waive=waive, config=config)
+
+
+def assert_clean_hlo(fn, *args, rules=None, waive=(), config=None,
+                     name=None, **kwargs):
+    """Lint ``fn(*args, **kwargs)`` and raise :class:`HloLintError`
+    listing every finding when a rule fires; return the (clean)
+    :class:`LintReport` otherwise.
+
+    The CI primitive next to ``assert_no_recompiles``: replace
+
+        assert "callback" not in jitted.lower(x).as_text()
+
+    with
+
+        assert_clean_hlo(jitted, x, rules="no-host-callback")
+
+    — the rule matches actual ``custom_call`` targets, so a substring
+    in a comment or backend_config can neither pass nor fail it."""
+    report = lint_fn(fn, *args, rules=rules, waive=waive, config=config,
+                     name=name, **kwargs)
+    if not report.ok:
+        raise HloLintError(report.render())
+    return report

@@ -1,0 +1,299 @@
+"""Python-source static analysis for the repo itself.
+
+``ruff.toml`` at the repo root is the canonical configuration — run
+``ruff check .`` in any environment that has ruff. The driver container
+does NOT ship ruff (and nothing may be pip-installed into it), so this
+module implements the enforced subset with the stdlib ``ast``: the
+tier-1 test (tests/L0/test_static_analysis.py) runs ruff when it is on
+PATH and always runs this checker, so the invariants hold in every
+environment.
+
+Checks (ruff rule codes for cross-reference):
+
+- ``E999`` syntax error (the file doesn't parse)
+- ``F401`` unused import (module and function scope; names re-exported
+  via ``__all__`` count as used; ``__init__.py`` files are exempt per
+  the ruff per-file-ignores)
+- ``E711`` comparison to ``None`` with ``==`` / ``!=``
+- ``E722`` bare ``except:``
+- ``B006`` mutable default argument (list/dict/set literals or
+  constructor calls)
+
+Suppression mirrors ruff: a trailing ``# noqa`` (optionally with
+codes) on the offending line, plus the ``[lint.per-file-ignores]``
+table in ``ruff.toml`` (parsed here so both tools agree).
+"""
+
+import ast
+import fnmatch
+import os
+import re
+
+DEFAULT_DIRS = ("apex_tpu", "tools", "tests")
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?",
+                      re.IGNORECASE)
+
+
+class PyFinding:
+    __slots__ = ("path", "line", "code", "message")
+
+    def __init__(self, path, line, code, message):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.message = message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _noqa_codes(source_lines, lineno):
+    """Codes suppressed on a line: None = no noqa, () = bare noqa
+    (suppresses everything)."""
+    if not 1 <= lineno <= len(source_lines):
+        return None
+    m = _NOQA_RE.search(source_lines[lineno - 1])
+    if not m:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return ()
+    return tuple(c.strip().upper() for c in codes.split(",") if c.strip())
+
+
+def _suppressed(source_lines, lineno, code):
+    codes = _noqa_codes(source_lines, lineno)
+    if codes is None:
+        return False
+    return codes == () or code in codes
+
+
+def load_per_file_ignores(ruff_toml_path):
+    """Parse the ``[lint.per-file-ignores]`` table of OUR ruff.toml
+    (``"glob" = ["CODE", ...]`` lines). Python 3.10 has no tomllib, and
+    the file is repo-controlled, so a line parser is sufficient — an
+    unreadable file just yields no ignores."""
+    ignores = {}
+    try:
+        with open(ruff_toml_path) as f:
+            text = f.read()
+    except OSError:
+        return ignores
+    in_section = False
+    for line in text.splitlines():
+        s = line.strip()
+        if s.startswith("["):
+            in_section = s in ("[lint.per-file-ignores]",
+                               "[per-file-ignores]")
+            continue
+        if not in_section or "=" not in s or s.startswith("#"):
+            continue
+        glob_part, _, codes_part = s.partition("=")
+        glob = glob_part.strip().strip('"').strip("'")
+        codes = re.findall(r'["\']([A-Z0-9]+)["\']', codes_part)
+        if glob and codes:
+            ignores[glob] = tuple(codes)
+    return ignores
+
+
+def _file_ignored_codes(rel_path, per_file_ignores):
+    codes = set()
+    norm = rel_path.replace(os.sep, "/")
+    for glob, glob_codes in per_file_ignores.items():
+        if fnmatch.fnmatch(norm, glob) \
+                or fnmatch.fnmatch(os.path.basename(norm), glob):
+            codes.update(glob_codes)
+    return codes
+
+
+class _ImportScope:
+    """One scope's imported names and the usage accounting for F401."""
+
+    def __init__(self):
+        self.imports = {}  # name -> (lineno, display)
+        self.used = set()
+
+
+def _collect_f401(tree, source_lines, path, findings, ignored):
+    """Unused-import detection. Conservative where Python is dynamic:
+    any Name/Attribute-root usage anywhere in the same scope (or any
+    nested scope) counts, ``__all__`` strings count, and star imports
+    are never flagged."""
+    if "F401" in ignored:
+        return
+
+    all_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" \
+                        and isinstance(node.value, (ast.List, ast.Tuple)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, str):
+                            all_names.add(elt.value)
+
+    def scope_check(body_nodes, top_level):
+        scope = _ImportScope()
+        nested = []
+
+        def visit(node, in_same_scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)) \
+                    and not in_same_scope:
+                return
+            if isinstance(node, ast.Import) and in_same_scope:
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    scope.imports[name] = (node.lineno,
+                                           alias.asname or alias.name)
+            elif isinstance(node, ast.ImportFrom) and in_same_scope:
+                if node.module == "__future__":
+                    pass
+                else:
+                    for alias in node.names:
+                        if alias.name == "*":
+                            continue
+                        name = alias.asname or alias.name
+                        scope.imports[name] = (node.lineno, name)
+            if isinstance(node, ast.Name):
+                scope.used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # the root Name is visited separately
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                nested.append(node)
+                # names used in nested scopes still count as usage of
+                # the enclosing import; walk them for Names only
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        scope.used.add(sub.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_same_scope)
+
+        for n in body_nodes:
+            visit(n, True)
+        for name, (lineno, display) in scope.imports.items():
+            if name in scope.used or name in all_names:
+                continue
+            if name.startswith("_"):
+                continue  # conventional "imported for side effects"
+            if _suppressed(source_lines, lineno, "F401"):
+                continue
+            findings.append(PyFinding(
+                path, lineno, "F401",
+                f"'{display}' imported but unused"))
+        for node in nested:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope_check(node.body, False)
+            elif isinstance(node, ast.ClassDef):
+                scope_check(node.body, False)
+
+    scope_check(tree.body, True)
+
+
+_MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict",
+                  "Counter", "deque"}
+
+
+def _check_defaults(node, source_lines, path, findings, ignored):
+    if "B006" in ignored:
+        return
+    args = node.args
+    for default in list(args.defaults) + [d for d in args.kw_defaults
+                                          if d is not None]:
+        bad = isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+            isinstance(default, ast.Call)
+            and isinstance(default.func, ast.Name)
+            and default.func.id in _MUTABLE_CALLS)
+        if bad and not _suppressed(source_lines, default.lineno, "B006"):
+            findings.append(PyFinding(
+                path, default.lineno, "B006",
+                f"mutable default argument in '{node.name}' — shared "
+                f"across calls; use None and create inside"))
+
+
+def check_source(source, path="<string>", per_file_ignores=None):
+    """Run every check over one source string. Returns [PyFinding]."""
+    findings = []
+    ignored = _file_ignored_codes(path, per_file_ignores or {})
+    source_lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append(PyFinding(path, e.lineno or 0, "E999",
+                                  f"syntax error: {e.msg}"))
+        return findings
+    _collect_f401(tree, source_lines, path, findings, ignored)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if "E722" not in ignored and not _suppressed(
+                    source_lines, node.lineno, "E722"):
+                findings.append(PyFinding(
+                    path, node.lineno, "E722",
+                    "bare 'except:' — catches SystemExit/"
+                    "KeyboardInterrupt; name the exception"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_defaults(node, source_lines, path, findings, ignored)
+        elif isinstance(node, ast.Compare) and "E711" not in ignored:
+            for op, comparator in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) \
+                        and isinstance(comparator, ast.Constant) \
+                        and comparator.value is None \
+                        and not _suppressed(source_lines, node.lineno,
+                                            "E711"):
+                    findings.append(PyFinding(
+                        path, node.lineno, "E711",
+                        "comparison to None with ==/!= — use "
+                        "'is None' / 'is not None'"))
+    return findings
+
+
+def check_paths(root, dirs=DEFAULT_DIRS, extra_files=("bench.py",
+                                                      "setup.py")):
+    """Check every .py file under ``dirs`` (plus ``extra_files``)
+    relative to ``root``. Returns [PyFinding], repo-relative paths."""
+    per_file = load_per_file_ignores(os.path.join(root, "ruff.toml"))
+    findings = []
+    paths = []
+    for d in dirs:
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(root, d)):
+            dirnames[:] = [n for n in dirnames
+                           if n not in ("__pycache__", ".git")]
+            paths.extend(os.path.join(dirpath, f)
+                         for f in filenames if f.endswith(".py"))
+    for f in extra_files:
+        p = os.path.join(root, f)
+        if os.path.exists(p):
+            paths.append(p)
+    for p in sorted(paths):
+        rel = os.path.relpath(p, root)
+        try:
+            with open(p, encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(PyFinding(rel, 0, "E999",
+                                      f"unreadable: {e}"))
+            continue
+        findings.extend(check_source(source, rel, per_file))
+    return findings
+
+
+def main(argv=None):
+    import sys
+
+    root = (argv or sys.argv[1:] or [os.getcwd()])[0]
+    findings = check_paths(root)
+    for f in findings:
+        print(f)
+    print(f"pysrc: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,512 @@
+"""The hot-path invariant rules.
+
+Each rule is a pure function ``(ctx, cfg) -> [Finding, ...]`` over a
+:class:`~apex_tpu.analysis.lint.LintContext` (lowered StableHLO text,
+optionally the closed jaxpr and the concrete example arguments). Rules
+never raise on programs they don't understand — an unmatched construct
+is "no finding", and a rule whose required artifact is missing from the
+context is *skipped* (reported as such in the
+:class:`~apex_tpu.analysis.lint.LintReport`), never silently passed.
+
+The catalog (docs/analysis.md has the worked examples):
+
+==========================  ================================================
+rule                        catches
+==========================  ================================================
+``no-host-callback``        ``custom_call`` to a Python host callback (or
+                            infeed/outfeed) inside a compiled hot path — a
+                            per-step host sync
+``no-f64``                  any f64/complex128 tensor in the module — on
+                            TPU this means slow emulation and 2x memory
+``unexpected-upcast``       a dot/conv executing in f32 whose operands were
+                            both upcast from bf16/f16 — the matmul silently
+                            left the MXU's fast path
+``donation-coverage``       a large carry-state argument (same shape+dtype
+                            as an output) accepted but not donated — the
+                            2x-HBM footgun
+``double-donation``         one buffer appearing at two donated argument
+                            positions — XLA's runtime "donate the same
+                            buffer twice" INVALID_ARGUMENT, caught at trace
+                            time (the amp-O2 aliased-masters bug)
+``trace-constant-capture``  a large array baked into the executable as a
+                            trace-time constant (closed-over data)
+``collective-consistency``  collective sequences that diverge across
+                            ``cond``/``switch`` branches, or a collective
+                            over an axis the enclosing mesh doesn't bind —
+                            deadlock risk on real multi-host
+``replication-blowup``      mesh present but a large output/constrained
+                            intermediate explicitly replicated — per-device
+                            memory scales with global size
+==========================  ================================================
+"""
+
+import dataclasses
+import os
+from typing import Optional
+
+from apex_tpu.analysis import hlo
+
+
+@dataclasses.dataclass
+class Finding:
+    """One structured violation: which rule, what, and where."""
+
+    rule: str
+    message: str
+    where: str = ""          # op / argument path the finding anchors to
+    severity: str = "error"
+    extra: Optional[dict] = None
+
+    def to_dict(self):
+        d = {"rule": self.rule, "severity": self.severity,
+             "message": self.message, "where": self.where}
+        if self.extra:
+            d.update(self.extra)
+        return d
+
+    def __str__(self):
+        loc = f" [{self.where}]" if self.where else ""
+        return f"{self.rule}{loc}: {self.message}"
+
+
+def _env_bytes(name, default):
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Size thresholds (bytes) the rules key on. The defaults (1 MiB)
+    target real models; tests pass smaller ones. Env overrides let a
+    capture tighten/loosen a whole run without code changes."""
+
+    donate_min_bytes: int = 1 << 20
+    const_min_bytes: int = 1 << 20
+    replicated_min_bytes: int = 1 << 20
+    max_findings_per_rule: int = 16
+
+    def __post_init__(self):
+        self.donate_min_bytes = _env_bytes(
+            "APEX_TPU_HLO_LINT_DONATE_BYTES", self.donate_min_bytes)
+        self.const_min_bytes = _env_bytes(
+            "APEX_TPU_HLO_LINT_CONST_BYTES", self.const_min_bytes)
+        self.replicated_min_bytes = _env_bytes(
+            "APEX_TPU_HLO_LINT_REPLICATED_BYTES",
+            self.replicated_min_bytes)
+
+
+# custom_call targets that ARE host round-trips. Matched against parsed
+# target names (hlo.custom_call_targets), so a stray "callback" in a
+# backend_config or comment can never false-positive — and a new jax
+# callback target still matches via the substring fallback below.
+HOST_CALLBACK_TARGETS = frozenset({
+    "xla_python_cpu_callback",
+    "xla_python_gpu_callback",
+    "xla_ffi_python_cpu_callback",
+    "xla_ffi_python_gpu_callback",
+    "xla_ffi_partitioned_python_cpu_callback",
+})
+_CALLBACK_MARKERS = ("callback", "io_callback")
+
+
+def rule_no_host_callback(ctx, cfg):
+    findings = []
+    for target, count in sorted(
+            hlo.custom_call_targets(ctx.hlo_text).items()):
+        if target in HOST_CALLBACK_TARGETS or any(
+                m in target.lower() for m in _CALLBACK_MARKERS):
+            findings.append(Finding(
+                "no-host-callback",
+                f"custom_call to host callback target '{target}' "
+                f"({count}x) — every dispatch round-trips to Python",
+                where=f"custom_call @{target}"))
+    for op in ("stablehlo.infeed", "stablehlo.outfeed"):
+        n = ctx.hlo_text.count(op + " ")
+        if n:
+            findings.append(Finding(
+                "no-host-callback",
+                f"{op} ({n}x) — host transfer inside the compiled step",
+                where=op))
+    return findings
+
+
+def rule_no_f64(ctx, cfg):
+    findings = []
+    for dtype in ("f64", "complex<f64>"):
+        hits = hlo.find_dtype_lines(ctx.hlo_text, dtype)
+        if hits:
+            line_no, line = hits[0]
+            findings.append(Finding(
+                "no-f64",
+                f"{len(hits)} op(s) with {dtype} tensors (first at "
+                f"module line {line_no}: {line[:120]}) — f64 on the "
+                f"training step means emulation + 2x memory",
+                where=f"line {line_no}",
+                extra={"count": len(hits)}))
+    return findings
+
+
+_HALF = ("bfloat16", "float16")
+# layout-preserving primitives that carry the "came from half
+# precision" taint from a convert to the dot that consumes it
+_TAINT_THROUGH = frozenset({
+    "transpose", "reshape", "broadcast_in_dim", "squeeze", "copy",
+    "slice", "rev",
+})
+
+
+def _eqn_where(eqn):
+    """Best-effort source location of a jaxpr equation."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info.traceback)
+        if frame is not None:
+            return f"{os.path.basename(frame.file_name)}:{frame.line_num}"
+    except Exception:
+        pass
+    return ""
+
+
+def _iter_subjaxprs(eqn):
+    for v in eqn.params.values():
+        if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                    yield item.jaxpr
+                elif hasattr(item, "eqns"):
+                    yield item
+
+
+def _is_var(v):
+    # jaxpr invars mix Vars with (unhashable) Literals; taint tracking
+    # only ever applies to Vars
+    return not hasattr(v, "val")
+
+
+def rule_unexpected_upcast(ctx, cfg):
+    if ctx.closed_jaxpr is None:
+        return None  # needs the jaxpr — skipped, not passed
+    findings = []
+
+    def walk(jaxpr):
+        tainted = set()  # vars that are f32 upcasts of half-precision data
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "convert_element_type":
+                src = eqn.invars[0]
+                src_dtype = str(getattr(getattr(src, "aval", None),
+                                        "dtype", ""))
+                out_dtype = str(eqn.outvars[0].aval.dtype)
+                if src_dtype in _HALF and out_dtype == "float32":
+                    tainted.add(eqn.outvars[0])
+                elif out_dtype == "float32" and _is_var(src) \
+                        and src in tainted:
+                    tainted.add(eqn.outvars[0])
+            elif name in _TAINT_THROUGH:
+                if any(_is_var(v) and v in tainted for v in eqn.invars):
+                    tainted.update(eqn.outvars)
+            elif name in ("dot_general", "conv_general_dilated"):
+                operands = [v for v in eqn.invars if hasattr(v, "aval")
+                            and getattr(v.aval, "shape", None) is not None]
+                out_dtype = str(eqn.outvars[0].aval.dtype)
+                if (out_dtype == "float32" and len(operands) >= 2
+                        and all(_is_var(v) and v in tainted
+                                for v in operands[:2])):
+                    shapes = "x".join(
+                        str(list(v.aval.shape)) for v in operands[:2])
+                    findings.append(Finding(
+                        "unexpected-upcast",
+                        f"{name} executes in f32 but both operands were "
+                        f"upcast from half precision ({shapes}) — run it "
+                        f"in bf16 (use preferred_element_type=f32 if f32 "
+                        f"accumulation was the goal)",
+                        where=_eqn_where(eqn) or name))
+            for sub in _iter_subjaxprs(eqn):
+                walk(sub)
+
+    walk(ctx.closed_jaxpr.jaxpr)
+    return findings
+
+
+def _fmt_bytes(n):
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{n} B"
+
+
+_HLO_TO_NP_DTYPE = {
+    "f64": "float64", "f32": "float32", "f16": "float16",
+    "bf16": "bfloat16", "i64": "int64", "i32": "int32", "i16": "int16",
+    "i8": "int8", "i1": "bool", "ui64": "uint64", "ui32": "uint32",
+    "ui16": "uint16", "ui8": "uint8",
+}
+
+
+def _arg_aval(info):
+    aval = getattr(info, "aval", None)
+    return aval if aval is not None else getattr(info, "_aval", None)
+
+
+def rule_donation_coverage(ctx, cfg):
+    args = ctx.flat_args_info
+    if args is None:
+        return None
+    # multiset of result (shape, dtype) signatures; donated args'
+    # matching outputs are consumed first (they already carry state)
+    out_sigs = {}
+    if ctx.out_avals is not None:
+        for o in ctx.out_avals:
+            key = (tuple(o.shape), str(o.dtype))
+            out_sigs[key] = out_sigs.get(key, 0) + 1
+    else:
+        for r in hlo.entry_signature(ctx.hlo_text)["results"]:
+            if r["shape"] is None:
+                continue
+            key = (r["shape"], _HLO_TO_NP_DTYPE.get(r["dtype"],
+                                                    r["dtype"]))
+            out_sigs[key] = out_sigs.get(key, 0) + 1
+
+    def aval_key(aval):
+        return (tuple(aval.shape), str(getattr(aval, "dtype", "")))
+
+    findings = []
+    for key in (aval_key(_arg_aval(a)) for _, a in args
+                if a.donated):
+        if out_sigs.get(key, 0) > 0:
+            out_sigs[key] -= 1
+    for path, info in args:
+        if info.donated:
+            continue
+        aval = _arg_aval(info)
+        nbytes = getattr(aval, "size", 0) * getattr(
+            getattr(aval, "dtype", None), "itemsize", 4)
+        if nbytes < cfg.donate_min_bytes:
+            continue
+        key = aval_key(aval)
+        if out_sigs.get(key, 0) > 0:
+            out_sigs[key] -= 1
+            findings.append(Finding(
+                "donation-coverage",
+                f"carry-state argument '{path}' "
+                f"({key[1]}{list(key[0])}, {_fmt_bytes(nbytes)}) is "
+                f"returned with identical shape+dtype but not donated — "
+                f"XLA must keep both copies live (2x HBM for this "
+                f"buffer); add it to donate_argnums",
+                where=path,
+                extra={"nbytes": nbytes}))
+    return findings
+
+
+def rule_double_donation(ctx, cfg):
+    if ctx.flat_args is None or ctx.flat_args_info is None:
+        return None
+    by_buffer = {}
+    for (path, info), (_, value) in zip(ctx.flat_args_info,
+                                        ctx.flat_args):
+        if not info.donated or value is None:
+            continue
+        keys = [("id", id(value))]
+        try:
+            keys.append(("ptr", value.unsafe_buffer_pointer()))
+        except Exception:
+            pass
+        for key in keys:
+            by_buffer.setdefault(key, []).append(path)
+    findings = []
+    seen = set()
+    for key, paths in by_buffer.items():
+        unique = sorted(set(paths))
+        if len(unique) < 2 or tuple(unique) in seen:
+            continue
+        seen.add(tuple(unique))
+        findings.append(Finding(
+            "double-donation",
+            f"the same buffer is donated at {len(unique)} argument "
+            f"positions ({', '.join(unique)}) — XLA raises 'Attempt to "
+            f"donate the same buffer twice' at Execute(); make the "
+            f"copies distinct (see optimizers._base.master_copy_tree)",
+            where=unique[0],
+            extra={"paths": unique}))
+    return findings
+
+
+def rule_trace_constant_capture(ctx, cfg):
+    findings = []
+    if ctx.closed_jaxpr is not None:
+        for i, const in enumerate(ctx.closed_jaxpr.consts):
+            shape = getattr(const, "shape", None)
+            dtype = getattr(const, "dtype", None)
+            if shape is None:
+                continue
+            size = 1
+            for d in shape:
+                size *= int(d)
+            nbytes = size * getattr(dtype, "itemsize", 4)
+            if nbytes >= cfg.const_min_bytes:
+                findings.append(Finding(
+                    "trace-constant-capture",
+                    f"trace-time constant #{i} ({dtype}{list(shape)}, "
+                    f"{_fmt_bytes(nbytes)}) is baked into the "
+                    f"executable — closed-over array data retraces on "
+                    f"every new value and bloats the program; pass it "
+                    f"as an argument",
+                    where=f"const[{i}]",
+                    extra={"nbytes": nbytes}))
+        return findings
+    # text-only fallback (lint_lowered without a jaxpr)
+    for line_no, nbytes, spec in hlo.large_constant_bytes(
+            ctx.hlo_text, cfg.const_min_bytes):
+        findings.append(Finding(
+            "trace-constant-capture",
+            f"constant tensor<{spec}> ({_fmt_bytes(nbytes)}) baked into "
+            f"the module at line {line_no} — pass it as an argument",
+            where=f"line {line_no}",
+            extra={"nbytes": nbytes}))
+    return findings
+
+
+_COLLECTIVES = frozenset({
+    "psum", "pmax", "pmin", "all_gather", "reduce_scatter",
+    "psum_scatter", "all_to_all", "ppermute", "pbroadcast",
+    "reduce_precision_psum",
+})
+
+
+def _collective_axes(eqn):
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, (str, int)))
+
+
+def _collective_signature(jaxpr, acc):
+    """Ordered tuple of (primitive, axes) for every collective reachable
+    from ``jaxpr`` (recursing into sub-jaxprs in order)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _COLLECTIVES:
+            acc.append((eqn.primitive.name, _collective_axes(eqn)))
+        for sub in _iter_subjaxprs(eqn):
+            _collective_signature(sub, acc)
+    return acc
+
+
+def rule_collective_consistency(ctx, cfg):
+    if ctx.closed_jaxpr is None:
+        return None
+    findings = []
+
+    def walk(jaxpr, bound_axes):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _COLLECTIVES:
+                for ax in _collective_axes(eqn):
+                    if isinstance(ax, str) and bound_axes is not None \
+                            and ax not in bound_axes:
+                        findings.append(Finding(
+                            "collective-consistency",
+                            f"{name} over axis '{ax}' but the enclosing "
+                            f"mesh binds only {sorted(bound_axes)} — "
+                            f"this lowers to a collective a sibling "
+                            f"host will never enter (deadlock on real "
+                            f"multi-host)",
+                            where=_eqn_where(eqn) or name))
+            if name == "cond":
+                branches = eqn.params.get("branches", ())
+                sigs = [tuple(_collective_signature(b.jaxpr, []))
+                        for b in branches]
+                if len(set(sigs)) > 1 and any(sigs):
+                    desc = " vs ".join(
+                        "[" + ", ".join(
+                            f"{p}{list(a)}" for p, a in s) + "]"
+                        for s in sigs)
+                    findings.append(Finding(
+                        "collective-consistency",
+                        f"cond branches issue different collective "
+                        f"sequences ({desc}) — replicas taking "
+                        f"different branches deadlock; hoist the "
+                        f"collectives out of the branch or make the "
+                        f"sequences identical",
+                        where=_eqn_where(eqn) or "cond"))
+            if name == "while":
+                body = eqn.params.get("body_jaxpr")
+                sig = (tuple(_collective_signature(body.jaxpr, []))
+                       if body is not None else ())
+                if sig:
+                    desc = ", ".join(f"{p}{list(a)}" for p, a in sig)
+                    findings.append(Finding(
+                        "collective-consistency",
+                        f"collective(s) inside a data-dependent while "
+                        f"loop ({desc}) — replicas whose predicates "
+                        f"disagree run different collective counts and "
+                        f"deadlock; use a fixed-trip scan or hoist the "
+                        f"collective",
+                        where=_eqn_where(eqn) or "while"))
+            new_bound = bound_axes
+            if name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                axis_names = getattr(mesh, "axis_names", None)
+                if axis_names is not None:
+                    new_bound = (set(axis_names)
+                                 | (bound_axes or set()))
+            for sub in _iter_subjaxprs(eqn):
+                walk(sub, new_bound)
+
+    walk(ctx.closed_jaxpr.jaxpr, None)
+    return findings
+
+
+def rule_replication_blowup(ctx, cfg):
+    text = ctx.hlo_text
+    if hlo.num_partitions(text) <= 1:
+        return []  # no mesh in play: replication is the only layout
+    findings = []
+    sig = hlo.entry_signature(text)
+    for i, r in enumerate(sig["results"]):
+        if r["sharding"] == "{replicated}" \
+                and r["nbytes"] >= cfg.replicated_min_bytes:
+            findings.append(Finding(
+                "replication-blowup",
+                f"output #{i} (tensor<{r['type']}>, "
+                f"{_fmt_bytes(r['nbytes'])}) is explicitly replicated "
+                f"across a {hlo.num_partitions(text)}-partition mesh — "
+                f"every device holds the full buffer; shard it or "
+                f"confirm the replication is intended",
+                where=f"result[{i}]",
+                extra={"nbytes": r["nbytes"]}))
+    for line_no, sharding, spec in hlo.sharding_custom_calls(text):
+        if sharding != "{replicated}":
+            continue
+        _, _, nbytes = hlo.parse_tensor_type(spec)
+        if nbytes >= cfg.replicated_min_bytes:
+            findings.append(Finding(
+                "replication-blowup",
+                f"sharding constraint pins tensor<{spec}> "
+                f"({_fmt_bytes(nbytes)}) fully replicated at module "
+                f"line {line_no} — a large intermediate holds one full "
+                f"copy per device",
+                where=f"line {line_no}",
+                extra={"nbytes": nbytes}))
+    return findings
+
+
+# rule registry: name -> (fn, what it needs beyond the HLO text).
+# Order is the report order.
+RULES = {
+    "no-host-callback": (rule_no_host_callback, ()),
+    "no-f64": (rule_no_f64, ()),
+    "unexpected-upcast": (rule_unexpected_upcast, ("jaxpr",)),
+    "donation-coverage": (rule_donation_coverage, ("args_info",)),
+    "double-donation": (rule_double_donation, ("args",)),
+    "trace-constant-capture": (rule_trace_constant_capture, ()),
+    "collective-consistency": (rule_collective_consistency, ("jaxpr",)),
+    "replication-blowup": (rule_replication_blowup, ()),
+}

@@ -1,0 +1,226 @@
+"""Canonical lintable hot-path steps.
+
+``tools/hlo_lint.py`` and the tier-1 clean-pass tests need the repo's
+REAL hot paths — the DDP fp32 / int8 train steps, the ZeRO optimizer
+step, the guarded step, the serving decode step — as lowerable
+functions at a size the 1-core CPU host traces in seconds. This module
+builds them once, through the same ``DistributedDataParallel`` /
+``DistributedFusedAdam`` / ``guarded_update`` / ``ServeEngine``
+machinery the benches use (a lint target that re-implements the path
+would prove nothing), batch data passed as proper arguments and carry
+state donated — the idiom the rules enforce.
+
+Each builder returns ``(fn, args, kwargs)`` ready for
+:func:`apex_tpu.analysis.lint_fn` / ``assert_clean_hlo``. ``TARGETS``
+maps config name -> builder; everything needs the >= 2-device mesh
+(the virtual 8-device CPU platform in tests/the CLI).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _mesh(axis_name="dp"):
+    devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def _mlp_params(hidden=32, depth=2, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {}
+    for i in range(depth):
+        params[f"w{i}"] = jnp.asarray(
+            rng.randn(hidden, hidden).astype(np.float32)
+            / np.sqrt(hidden))
+        params[f"b{i}"] = jnp.zeros((hidden,), jnp.float32)
+    return params
+
+
+def _mlp_loss(params, xb, yb, depth=2):
+    h = xb
+    for i in range(depth):
+        h = jnp.tanh(h @ params[f"w{i}"] + params[f"b{i}"])
+    return jnp.mean((h - yb) ** 2)
+
+
+def _batch(mesh, hidden=32, batch=4, seed=1):
+    rng = np.random.RandomState(seed)
+    n = batch * mesh.devices.size
+    x = jnp.asarray(rng.randn(n, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(n, hidden).astype(np.float32))
+    return x, y
+
+
+def ddp_fp32_step():
+    """Plain fp32 DDP train step: shard_map over the dp mesh, exact
+    psum gradient sync, params donated, batch passed as arguments."""
+    from apex_tpu.parallel import DistributedDataParallel
+
+    mesh = _mesh()
+    params = _mlp_params()
+    x, y = _batch(mesh)
+    ddp = DistributedDataParallel(axis_name="dp")
+
+    def step_fn(p, xb, yb):
+        loss, grads = jax.value_and_grad(_mlp_loss)(p, xb, yb)
+        grads = ddp.sync(grads)
+        p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, p, grads)
+        return p, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P("dp"), P("dp")),
+                            out_specs=(P(), P()), check_vma=False)
+    train_step = jax.jit(sharded, donate_argnums=(0,))
+    return train_step, (params, x, y), {}
+
+
+def ddp_int8_step():
+    """Int8 block-quantized DDP train step with error feedback — the
+    compressed-collective hot path (params AND the EF residual are
+    carry state, both donated)."""
+    from apex_tpu.parallel import DistributedDataParallel
+
+    mesh = _mesh()
+    params = _mlp_params()
+    x, y = _batch(mesh)
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8")
+    residual = ddp.init_residual(params)
+
+    def step_fn(p, res, xb, yb):
+        loss, grads = jax.value_and_grad(_mlp_loss)(p, xb, yb)
+        grads, res = ddp.sync(grads, res)
+        p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, p, grads)
+        return p, res, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P(), P("dp"), P("dp")),
+                            out_specs=(P(), P(), P()), check_vma=False)
+    train_step = jax.jit(sharded, donate_argnums=(0, 1))
+    return train_step, (params, residual, x, y), {}
+
+
+def zero_step():
+    """ZeRO optimizer step (DistributedFusedAdam with int8 grad
+    reduce-scatter): sharded state carried and donated."""
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    mesh = _mesh()
+    params = _mlp_params()
+    x, y = _batch(mesh)
+    opt = DistributedFusedAdam(lr=1e-2, compress=True)
+
+    def step_fn(p, state, xb, yb):
+        loss, grads = jax.value_and_grad(_mlp_loss)(p, xb, yb)
+        p, state = opt.step(grads, state, p)
+        return p, state, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P(), P("dp"), P("dp")),
+                            out_specs=(P(), P(), P()), check_vma=False)
+    train_step = jax.jit(sharded, donate_argnums=(0, 1))
+
+    with mesh:
+        state = jax.jit(
+            lambda p: jax.shard_map(
+                opt.init, mesh=mesh, in_specs=P(), out_specs=P(),
+                check_vma=False)(p))(params)
+    return train_step, (params, state, x, y), {}
+
+
+def guarded_step():
+    """The resilience hot path: guarded int8 DDP step with the NaN
+    injection checkpoint armed (step index traced) — the exact shape
+    test_resilience pins callback-free."""
+    from apex_tpu import resilience
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.resilience import faults
+
+    mesh = _mesh()
+    params = _mlp_params()
+    x, y = _batch(mesh)
+    ddp = DistributedDataParallel(axis_name="dp", compress="int8")
+    residual = ddp.init_residual(params)
+    gstate = resilience.init_guard_state()
+
+    def step_fn(p, res, gst, step, xb, yb):
+        loss, grads = jax.value_and_grad(_mlp_loss)(p, xb, yb)
+        grads = faults.inject_nan(grads, step, nan_step=None)
+        flag = resilience.nonfinite_flag(grads)
+        synced, new_res = ddp.sync(grads, res)
+
+        def commit(g, st):
+            prev_p, _ = st
+            new_p = jax.tree_util.tree_map(
+                lambda w, gg: w - 0.05 * gg, prev_p, g)
+            return (new_p, new_res)
+
+        (p, res), gst = resilience.guarded_update(
+            synced, commit, (p, res), gst, axis_name="dp", flag=flag)
+        return p, res, gst, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P(), P(), P(), P("dp"),
+                                      P("dp")),
+                            out_specs=(P(), P(), P(), P()),
+                            check_vma=False)
+    train_step = jax.jit(sharded, donate_argnums=(0, 1, 2))
+    return train_step, (params, residual, gstate,
+                        jnp.zeros((), jnp.int32), x, y), {}
+
+
+@functools.lru_cache(maxsize=2)
+def _tiny_engine(cache_mode="bf16"):
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.serving import ServeConfig, ServeEngine
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=64,
+        compute_dtype=jnp.bfloat16, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=4, ffn_hidden_size=128)
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(0)
+    params = GPTModel(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))["params"]
+    devices = jax.devices()
+    mesh = (Mesh(np.asarray(devices), ("data",))
+            if len(devices) > 1 and 8 % len(devices) == 0 else None)
+    serve_cfg = ServeConfig(batch_buckets=(2,), prefill_buckets=(8,),
+                            num_slots=8, cache_mode=cache_mode,
+                            eos_token_id=None, temperature=0.0)
+    return ServeEngine(model, params, serve_cfg, mesh=mesh)
+
+
+def serve_decode_step():
+    """The serving hot loop: the engine's own continuous-batching
+    decode function at its smallest batch bucket (store donated, the
+    poison-slot quarantine handle traced — the exact executable the
+    bucket ladder compiles)."""
+    engine = _tiny_engine()
+    b = engine.config.batch_buckets[0]
+    args = (engine._store, engine._params,
+            engine._put(np.zeros((b,), np.int32)),
+            engine._put(np.zeros((b,), np.int32)),
+            jax.random.PRNGKey(0), engine._put(np.int32(-1)))
+    fn = jax.jit(engine._decode_fn,
+                 donate_argnums=(0,) if engine.config.donate else ())
+    return fn, args, {}
+
+
+# config name -> builder; the CLI's column set and the tier-1
+# clean-pass parametrization both read this
+TARGETS = {
+    "ddp_fp32": ddp_fp32_step,
+    "ddp_int8": ddp_int8_step,
+    "zero": zero_step,
+    "guarded": guarded_step,
+    "serve_decode": serve_decode_step,
+}

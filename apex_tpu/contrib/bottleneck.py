@@ -10,7 +10,7 @@ mesh axis and stitches a 1-row halo per side with
 :func:`apex_tpu.contrib.peer_memory.halo_exchange_1d` before a VALID conv.
 """
 
-from typing import Any, Callable, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -11,10 +11,9 @@ pass ``axis_name='dp_bn'``). The IPC machinery disappears — ICI collectives
 do the exchange.
 """
 
-from typing import Any, Optional
+from typing import Optional
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 

@@ -5,7 +5,6 @@ csrc/index_mul_2d) — ``out[i] = in1[idx[i]] * in2[i]`` fused
 gather-multiply with matching backward. One XLA gather+mul on TPU.
 """
 
-import jax.numpy as jnp
 
 
 def index_mul_2d(in1, in2, idx1):

@@ -11,7 +11,7 @@ attention (contrib.fmha) on TPU with the einsum reference elsewhere. Fused
 norm-add = FusedLayerNorm + residual in the same jit.
 """
 
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -10,7 +10,6 @@ ICI sends that overlap with compute. Interface mirrors
 ``PeerHaloExchanger1d.__call__`` (halo along the H dim of NHWC tensors).
 """
 
-from typing import Optional
 
 import jax.numpy as jnp
 from jax import lax

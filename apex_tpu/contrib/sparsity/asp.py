@@ -12,7 +12,6 @@ TPU design: masks are a pytree parallel to params; pruning is
 reference wraps optimizer.step the same way).
 """
 
-from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -37,7 +37,8 @@ class FP16_Optimizer(object):
         inner_state = self.inner.init(params)
         # alias-free copies: astype is a no-op on fp32 leaves and would
         # alias masters to live params (donation double-donate; see
-        # master_copy_tree / tools/donation_repro.py)
+        # master_copy_tree — the double-donation lint rule in
+        # apex_tpu.analysis enforces this at trace time)
         inner_state["fp32_master"] = master_copy_tree(params)
         return inner_state
 

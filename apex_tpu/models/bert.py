@@ -5,7 +5,6 @@ bidirectional (padding-mask) transformer with token-type embeddings, MLM
 head (dense + gelu + LN + tied-vocab projection) and binary NSP head.
 """
 
-from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -11,7 +11,6 @@ common.py:30-151) re-expressed as masked SPMD branches.
 
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from apex_tpu.models.gpt import _fold_tp

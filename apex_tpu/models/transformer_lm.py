@@ -19,9 +19,6 @@ import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer.enums import AttnMaskType
-from apex_tpu.transformer.functional.fused_softmax import (
-    FusedScaleMaskSoftmax,
-)
 from apex_tpu.transformer.parallel_state import (
     get_tensor_model_parallel_world_size,
 )

@@ -13,7 +13,7 @@ the reference's mixed-dtype kernels (layer_norm_cuda.cpp
 ``forward_affine_mixed_dtypes``).
 """
 
-from typing import Optional, Sequence, Union
+from typing import Sequence, Union
 
 import flax.linen as nn
 import jax.numpy as jnp

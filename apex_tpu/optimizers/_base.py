@@ -35,9 +35,11 @@ def master_copy_tree(params, dtype=None):
     wherever they are already fp32 (all norm params under amp O2), and a
     train step donating both params and opt_state then presents one
     buffer twice to XLA: "Attempt to donate the same buffer twice in
-    Execute()" (the round-3 'ResNet donation INVALID_ARGUMENT';
-    tools/donation_repro.py rung 4). ``jnp.array(..., copy=True)``
-    forces a distinct buffer for every leaf.
+    Execute()" (the round-3 'ResNet donation INVALID_ARGUMENT').
+    ``jnp.array(..., copy=True)`` forces a distinct buffer for every
+    leaf. The contract is enforced statically by the
+    ``double-donation`` lint rule (apex_tpu.analysis, caught at trace
+    time; regression in tests/L0/test_analysis.py).
     """
     dtype = jnp.float32 if dtype is None else dtype
     return jax.tree_util.tree_map(

@@ -15,7 +15,6 @@ from apex_tpu.multi_tensor_apply import multi_tensor_applier
 from apex_tpu.ops import multi_tensor_l2norm_scale, multi_tensor_lamb_mp
 from apex_tpu.optimizers._base import (
     FusedOptimizerBase,
-    cast_tree,
     master_copy_tree,
     resolve_found_inf,
     zeros_like_tree,

@@ -16,7 +16,6 @@ path is the default here. Fused ReLU and additive-z variants are kept.
 from typing import Any, Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 from jax import lax
 

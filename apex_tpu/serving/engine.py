@@ -232,7 +232,9 @@ class ServeEngine:
         compiled = lowered.compile()
         dt = time.perf_counter() - t0
         self.aot_compile_seconds += dt
-        self._watcher.record_aot(name, args, seconds=dt)
+        # lowered rides along so APEX_TPU_HLO_LINT=1 lints every ladder
+        # executable (apex_tpu.analysis) without a second trace
+        self._watcher.record_aot(name, args, seconds=dt, lowered=lowered)
         return compiled
 
     def _ids_aval(self, b):

@@ -47,6 +47,10 @@ import time
 from apex_tpu.telemetry.registry import get_registry
 
 ENV_WATCH = "APEX_TPU_COMPILE_WATCH"
+# opt-in for the static HLO lint pass (apex_tpu.analysis,
+# docs/analysis.md): an enabled watcher lints every newly compiled
+# executable it sees and emits `lint` JSONL events per finding
+ENV_LINT = "APEX_TPU_HLO_LINT"
 
 # jax.monitoring event names (stable across the jax 0.4.x line; probed
 # in tests). backend_compile fires once per XLA compilation, with the
@@ -212,6 +216,7 @@ class _WatchedFunction:
             compiled = backend_compiles()[0] > nb_before
         if compiled:
             w._on_compile(self._name, abstract_signature(args, kwargs), dt)
+            w._maybe_lint(self._name, self._fn, args, kwargs)
         return out
 
     def __getattr__(self, item):
@@ -228,12 +233,16 @@ class CompileWatcher:
     watcher's ``watch`` returns the function unchanged.
     """
 
-    def __init__(self, *, enabled=None, registry=None):
+    def __init__(self, *, enabled=None, registry=None, lint=None):
         if enabled is None:
             enabled = os.environ.get(ENV_WATCH, "") not in ("", "0")
+        if lint is None:
+            lint = os.environ.get(ENV_LINT, "") not in ("", "0")
         self.enabled = bool(enabled)
+        self.lint_enabled = bool(lint)
         self._registry = registry
         self.functions = {}
+        self.lint_reports = {}
         self._entered_at = None
         if self.enabled:
             install_monitoring()
@@ -285,7 +294,39 @@ class CompileWatcher:
                       call_seconds=round(call_seconds, 6),
                       changed=changed)
 
-    def record_aot(self, name, args=(), kwargs=None, *, seconds=0.0):
+    # -- HLO lint (apex_tpu.analysis; APEX_TPU_HLO_LINT=1) ------------------
+
+    def _maybe_lint(self, name, fn, args, kwargs, *, lowered=None):
+        """Lint the program that just compiled and emit ``lint`` events.
+        Never raises: a lint crash is a telemetry gap, not a training
+        failure. Reports accumulate in ``self.lint_reports``."""
+        if not (self.enabled and self.lint_enabled):
+            return None
+        from apex_tpu import analysis
+
+        try:
+            if lowered is not None:
+                report = analysis.lint_lowered(lowered, name=name)
+            else:
+                report = analysis.lint_fn(fn, *args, name=name,
+                                          **(kwargs or {}))
+        except Exception as e:  # noqa: BLE001 — lint must never kill a run
+            reg = self._reg()
+            if reg.enabled:
+                reg.event("lint", name, error=f"{type(e).__name__}: "
+                                             f"{str(e)[:200]}")
+            return None
+        self.lint_reports[name] = report
+        analysis.report_to_registry(report, registry=self._registry,
+                                    name=name)
+        return report
+
+    def lint_violation_count(self):
+        """Total findings across every lint this watcher ran."""
+        return sum(len(r.findings) for r in self.lint_reports.values())
+
+    def record_aot(self, name, args=(), kwargs=None, *, seconds=0.0,
+                   lowered=None):
         """Register an ahead-of-time compile (``jit(...).lower(args)
         .compile()`` — the ServeEngine startup path) under ``name``.
 
@@ -295,10 +336,17 @@ class CompileWatcher:
         stats, ``compile`` JSONL events, and signature bookkeeping as a
         watched jit compile — and a second ``record_aot`` under the
         same name with a different signature shows up as a named
-        recompile, exactly like a drifting jit signature would."""
+        recompile, exactly like a drifting jit signature would.
+
+        ``lowered`` (the pre-compile ``Lowered``) opts the AOT compile
+        into the HLO lint pass when ``APEX_TPU_HLO_LINT=1`` — the
+        ServeEngine passes each ladder entry's lowering here so the
+        serving executables are linted without a second trace."""
         if not self.enabled:
             return
         self._on_compile(name, abstract_signature(args, kwargs), seconds)
+        if lowered is not None:
+            self._maybe_lint(name, None, (), None, lowered=lowered)
 
     # -- accounting ---------------------------------------------------------
 

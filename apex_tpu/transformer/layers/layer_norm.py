@@ -11,9 +11,7 @@ tp psum. The tag is a module attribute; ``allreduce_sequence_parallel_grads``
 in pipeline_parallel.utils consumes it.
 """
 
-from typing import Any, Sequence, Union
 
-import jax.numpy as jnp
 
 from apex_tpu import normalization as _norm
 

@@ -17,7 +17,7 @@ amax "groups" are derivable subsets of the pp axis; helpers here expose the
 membership logic the schedules need.
 """
 
-from typing import List, Optional
+from typing import Optional
 
 import jax
 import numpy as np

@@ -7,7 +7,6 @@ across model-parallel ranks, ``average_losses_across_data_parallel_group``,
 ``get_ltor_masks_and_position_ids``, microbatch-calculator globals, timers.
 """
 
-from typing import List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -16,7 +15,9 @@ from jax import lax
 from apex_tpu.transformer.microbatches import (
     build_num_microbatches_calculator,
 )
-from apex_tpu.transformer.parallel_state import (
+from apex_tpu.transformer.parallel_state import (  # noqa: F401
+    # the get_* helpers are re-exported for parity with the reference
+    # apex.transformer.pipeline_parallel.utils public surface
     DATA_PARALLEL_AXIS,
     TENSOR_PARALLEL_AXIS,
     get_pipeline_model_parallel_rank,

@@ -21,7 +21,7 @@ shards are initialized from a per-rank key folded with the tp rank, so
 TP=n layers statistically match a TP=1 layer sliced n ways.
 """
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import flax.linen as nn
 import jax
@@ -40,7 +40,11 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     reduce_scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
 )
-from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
+from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
+    # VocabUtility re-exported for reference-apex layers API parity
+    VocabUtility,
+    divide,
+)
 
 _MODEL_PARALLEL_ATTRIBUTE_DEFAULTS = {
     "tensor_model_parallel": False,

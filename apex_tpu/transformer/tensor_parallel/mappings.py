@@ -15,7 +15,6 @@ all-reduce / all-gather / reduce-scatter.
 import functools
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS

@@ -254,6 +254,23 @@ def _measure_step_cost(jitted, args):
     if lowered is not None and \
             os.environ.get("APEX_TPU_BENCH_MEMWATCH", "1") != "0":
         mem = telemetry.memory.report_from_lowered(lowered)
+    lint_count = None
+    if lowered is not None and \
+            os.environ.get("APEX_TPU_HLO_LINT", "") not in ("", "0"):
+        # the round-14 capture contract: lint the lowered step against
+        # the hot-path invariants (apex_tpu.analysis) and carry the
+        # violation count in the emitted JSON; findings land as `lint`
+        # JSONL events. Opt-in (as_text on a big on-chip model is not
+        # free), so the field stays null when unset.
+        try:
+            from apex_tpu import analysis
+
+            report = analysis.report_to_registry(
+                analysis.lint_lowered(lowered, name="bench/step"),
+                registry=reg)
+            lint_count = len(report.findings)
+        except Exception:
+            lint_count = None
     _PENDING_MEASURED.clear()
     _PENDING_MEASURED.update({
         "measured_comm_bytes_per_step": int(round(measured)),
@@ -262,6 +279,7 @@ def _measure_step_cost(jitted, args):
         "peak_hbm_bytes": mem["peak_bytes"] if mem else None,
         "hbm_headroom_pct": round(mem["headroom_frac"] * 100.0, 2)
         if mem and mem.get("headroom_frac") is not None else None,
+        "lint_violations": lint_count,
     })
     return cost, measured
 
@@ -294,6 +312,7 @@ def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
     peak_hbm = _PENDING_MEASURED.pop("peak_hbm_bytes", None)
     headroom_pct = _PENDING_MEASURED.pop("hbm_headroom_pct", None)
     compile_count = _PENDING_MEASURED.pop("compile_count", None)
+    lint_violations = _PENDING_MEASURED.pop("lint_violations", None)
     _PENDING_MEASURED.clear()
     reg = telemetry.get_registry()
     if reg.enabled:
@@ -327,6 +346,9 @@ def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
         "peak_hbm_bytes": peak_hbm,
         "hbm_headroom_pct": headroom_pct,
         "compile_count": compile_count,
+        # static HLO lint (round-14 capture contract; apex_tpu.analysis):
+        # null unless the bench ran with APEX_TPU_HLO_LINT=1
+        "lint_violations": lint_violations,
         **extra,
     }))
 
@@ -1081,8 +1103,9 @@ def bench_resnet(batch, steps):
     # caused as OUR bug, not the backend's — amp O2's fp32 masters were
     # no-op-cast ALIASES of the already-fp32 norm params, so donating
     # params and opt_state presented the same buffer twice to Execute()
-    # (tools/donation_repro.py, reproduced on CPU; fixed by
-    # master_copy_tree). APEX_TPU_RESNET_DONATE=0 opts out.
+    # (reproduced on CPU; fixed by master_copy_tree, now enforced at
+    # trace time by the double-donation lint rule in
+    # apex_tpu.analysis). APEX_TPU_RESNET_DONATE=0 opts out.
     donate = ({} if os.environ.get("APEX_TPU_RESNET_DONATE") == "0"
               else dict(donate_argnums=(0, 1, 2)))
 

@@ -15,7 +15,6 @@ EVERY entry of the reference's three cast-list files (parsed from
 """
 
 import ast
-import functools
 import os
 
 import jax

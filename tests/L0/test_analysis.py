@@ -1,0 +1,535 @@
+"""apex_tpu.analysis — the static HLO/jaxpr lint pass (ISSUE 9).
+
+Three layers of evidence:
+
+- **Seeded violations**: each rule catches a deliberately bad program
+  and names the offending op/argument path in the structured finding
+  (the acceptance's per-rule requirement).
+- **Clean hot paths**: the real DDP fp32/int8, ZeRO, guarded, and
+  serving decode steps (``analysis.targets`` — built through the same
+  machinery the benches use) lint clean with every rule running.
+- **Integration**: the CompileWatcher lints on compile under
+  ``APEX_TPU_HLO_LINT=1`` and emits ``lint`` JSONL events; bench
+  staging carries ``lint_violations``; the donation-repro ladder is
+  retired into the double-donation regression here.
+
+Everything is trace-only except the watcher integration (one tiny
+compile) and the serving target (AOT ladder of 2 executables).
+"""
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import analysis
+from apex_tpu.analysis import (
+    Finding,
+    HloLintError,
+    LintConfig,
+    LintReport,
+    RULES,
+    assert_clean_hlo,
+    lint_fn,
+    lint_lowered,
+)
+from apex_tpu.analysis.targets import TARGETS
+
+
+def _rules_fired(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# ---------------------------------------------------------------------------
+# seeded violations — every rule must catch its bad program and name
+# the offending op/argument path
+# ---------------------------------------------------------------------------
+
+class TestSeededViolations:
+    def test_no_host_callback(self):
+        def poisoned(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return y * 2
+
+        report = lint_fn(poisoned, jnp.ones((4,)))
+        assert _rules_fired(report) == ["no-host-callback"]
+        f = report.findings[0]
+        assert "custom_call @" in f.where
+        assert "callback" in f.message
+
+    def test_no_host_callback_substring_cannot_false_positive(self):
+        """The precision the substring grep lacked: 'callback' inside
+        a plain op constant/name must not fire the rule."""
+        from apex_tpu.analysis.lint import LintContext, run_rules
+
+        text = ('module @jit_f {\n'
+                '  func.func public @main(%arg0: tensor<4xf32>) -> '
+                '(tensor<4xf32>) {\n'
+                '    // callback mentioned in a comment only\n'
+                '    return %arg0 : tensor<4xf32>\n  }\n}\n')
+        report = run_rules(LintContext(hlo_text=text),
+                           rules="no-host-callback")
+        assert report.ok
+
+    def test_no_f64(self):
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            report = lint_fn(lambda x: x.astype(jnp.float64) * 2.0,
+                             jnp.ones((4,), jnp.float32))
+        assert "no-f64" in _rules_fired(report)
+        assert "line" in report.findings[0].where
+
+    def test_unexpected_upcast(self):
+        def upcast_matmul(a, b):
+            return a.astype(jnp.float32) @ b.astype(jnp.float32).T
+
+        report = lint_fn(upcast_matmul, jnp.ones((8, 8), jnp.bfloat16),
+                         jnp.ones((8, 8), jnp.bfloat16))
+        assert _rules_fired(report) == ["unexpected-upcast"]
+        assert "dot_general" in report.findings[0].message
+
+    def test_bf16_matmul_and_f32_accumulate_are_clean(self):
+        report = lint_fn(lambda a, b: a @ b,
+                         jnp.ones((8, 8), jnp.bfloat16),
+                         jnp.ones((8, 8), jnp.bfloat16))
+        assert report.ok
+        # accumulating in f32 via preferred_element_type is the GOOD
+        # spelling and must not fire
+        report = lint_fn(
+            lambda a, b: jax.lax.dot(a, b,
+                                     preferred_element_type=jnp.float32),
+            jnp.ones((8, 8), jnp.bfloat16),
+            jnp.ones((8, 8), jnp.bfloat16))
+        assert report.ok
+
+    def test_donation_coverage(self):
+        def step(w, x):
+            return w - 0.01 * (x.T @ (x @ w)), jnp.sum(w)
+
+        cfg = LintConfig(donate_min_bytes=1024)
+        w = jnp.ones((64, 64))
+        report = lint_fn(step, w, jnp.ones((4, 64)), config=cfg)
+        assert _rules_fired(report) == ["donation-coverage"]
+        assert report.findings[0].where == "args/0"
+        # donated -> clean
+        report = lint_fn(jax.jit(step, donate_argnums=(0,)), w,
+                         jnp.ones((4, 64)), config=cfg)
+        assert report.ok
+        # below the size threshold -> clean (not carry-state worth 2x)
+        report = lint_fn(step, jnp.ones((4, 4)), jnp.ones((2, 4)),
+                         config=cfg)
+        assert report.ok
+
+    def test_double_donation(self):
+        shared = jnp.ones((8,))
+        params = {"scale": shared}
+        masters = {"master": shared.astype(jnp.float32)}  # no-op alias
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(p, m):
+            return (jax.tree_util.tree_map(lambda t: t * 2, p),
+                    jax.tree_util.tree_map(lambda t: t * 3, m))
+
+        report = lint_fn(step, params, masters)
+        assert _rules_fired(report) == ["double-donation"]
+        f = report.findings[0]
+        assert "args/0/scale" in f.extra["paths"]
+        assert "args/1/master" in f.extra["paths"]
+
+    def test_trace_constant_capture(self):
+        baked = jnp.arange(4096, dtype=jnp.float32)
+        report = lint_fn(lambda x: x + baked, jnp.ones((4096,)),
+                         config=LintConfig(const_min_bytes=1024))
+        assert _rules_fired(report) == ["trace-constant-capture"]
+        assert "const[" in report.findings[0].where
+        # passing the array as an argument is the fix
+        report = lint_fn(lambda x, c: x + c, jnp.ones((4096,)), baked,
+                         config=LintConfig(const_min_bytes=1024))
+        assert report.ok
+
+    @pytest.mark.multi_device
+    def test_collective_consistency_cond_divergence(self, dp_mesh):
+        mesh = dp_mesh(8)
+        allreduce = jax.shard_map(
+            lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+            in_specs=P("dp"), out_specs=P(), check_vma=False)
+
+        def diverging(x, pred):
+            return jax.lax.cond(
+                pred,
+                lambda v: jnp.broadcast_to(allreduce(v), v.shape),
+                lambda v: v, x)
+
+        report = lint_fn(diverging, jnp.ones((8, 4)), jnp.asarray(True))
+        assert "collective-consistency" in _rules_fired(report)
+        assert "cond branches" in report.findings[0].message
+
+    @pytest.mark.multi_device
+    def test_collective_consistency_while_loop(self, dp_mesh):
+        mesh = dp_mesh(8)
+
+        def body(x):
+            def cond(c):
+                return c[1].sum() < 10.0
+
+            def step(c):
+                i, v = c
+                return i + 1, jax.lax.psum(v, "dp") * 0.5
+
+            return jax.lax.while_loop(
+                cond, step, (jnp.zeros((), jnp.int32), x))[1]
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                           out_specs=P("dp"), check_vma=False)
+        report = lint_fn(sm, jnp.ones((8, 4)))
+        assert "collective-consistency" in _rules_fired(report)
+        assert "while" in report.findings[0].message
+
+    @pytest.mark.multi_device
+    def test_replication_blowup_output(self, dp_mesh):
+        mesh = dp_mesh(8)
+
+        @functools.partial(jax.jit,
+                           out_shardings=NamedSharding(mesh, P()))
+        def f(x):
+            return x @ x.T
+
+        xin = jax.device_put(jnp.ones((64, 64)),
+                             NamedSharding(mesh, P("dp", None)))
+        report = lint_fn(
+            f, xin, config=LintConfig(replicated_min_bytes=1024))
+        assert "replication-blowup" in _rules_fired(report)
+        assert report.findings[0].where == "result[0]"
+
+    @pytest.mark.multi_device
+    def test_replication_blowup_constraint(self, dp_mesh):
+        mesh = dp_mesh(8)
+
+        def f(x):
+            h = x @ x.T
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, P()))
+            return jnp.sum(h)
+
+        xin = jax.device_put(jnp.ones((64, 64)),
+                             NamedSharding(mesh, P("dp", None)))
+        report = lint_fn(
+            f, xin, config=LintConfig(replicated_min_bytes=1024))
+        assert "replication-blowup" in _rules_fired(report)
+
+    @pytest.mark.multi_device
+    def test_sharded_outputs_do_not_fire_replication(self, dp_mesh):
+        mesh = dp_mesh(8)
+
+        @functools.partial(
+            jax.jit, out_shardings=NamedSharding(mesh, P("dp", None)))
+        def f(x):
+            return x * 2
+
+        xin = jax.device_put(jnp.ones((64, 64)),
+                             NamedSharding(mesh, P("dp", None)))
+        report = lint_fn(
+            f, xin, config=LintConfig(replicated_min_bytes=1024))
+        assert report.ok
+
+
+# ---------------------------------------------------------------------------
+# clean pass over the real hot paths — the acceptance's other half
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multi_device
+class TestCleanHotPaths:
+    @pytest.mark.parametrize("name", [n for n in TARGETS
+                                      if n != "serve_decode"])
+    def test_training_steps_lint_clean(self, name):
+        fn, args, kwargs = TARGETS[name]()
+        report = assert_clean_hlo(fn, *args, name=name, **kwargs)
+        # every rule ran — nothing silently skipped on the full context
+        assert not report.rules_skipped
+        assert set(report.rules_run) == set(RULES)
+
+    def test_serve_decode_lints_clean(self):
+        fn, args, kwargs = TARGETS["serve_decode"]()
+        report = assert_clean_hlo(fn, *args, name="serve_decode",
+                                  **kwargs)
+        assert not report.rules_skipped
+
+
+# ---------------------------------------------------------------------------
+# the donation-repro retirement: the double-donate contract in
+# optimizers._base / fp16_optimizer / amp_optimizer, enforced
+# ---------------------------------------------------------------------------
+
+class TestDonationContractRegression:
+    def _amp_style_step(self, params, masters):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(p, m):
+            new_m = jax.tree_util.tree_map(
+                lambda t: t - 0.1 * t, m)
+            new_p = jax.tree_util.tree_map(
+                lambda t: t.astype(jnp.float32), new_m)
+            return new_p, new_m
+
+        return step
+
+    def test_astype_masters_trip_double_donation(self):
+        """The exact round-2/3 bug shape: fp32 masters built with a
+        no-op astype alias the already-fp32 (norm) params; donating
+        both would die in Execute() — the rule catches it at trace
+        time instead."""
+        params = {"conv": jnp.ones((8, 8), jnp.float32),
+                  "norm_scale": jnp.ones((8,), jnp.float32)}
+        aliased = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params)  # no-op = alias
+        step = self._amp_style_step(params, aliased)
+        report = lint_fn(step, params, aliased)
+        assert "double-donation" in _rules_fired(report)
+
+    def test_master_copy_tree_masters_are_clean(self):
+        """master_copy_tree (the fix) forces distinct buffers — the
+        same donated step lints clean."""
+        from apex_tpu.optimizers._base import master_copy_tree
+
+        params = {"conv": jnp.ones((8, 8), jnp.float32),
+                  "norm_scale": jnp.ones((8,), jnp.float32)}
+        masters = master_copy_tree(params)
+        step = self._amp_style_step(params, masters)
+        assert_clean_hlo(step, params, masters,
+                         rules="double-donation")
+
+    def test_amp_optimizer_masters_are_alias_free(self):
+        """The real amp O2 init path: AMPOptimizer's fp32 masters must
+        not alias params (the contract the comments in amp_optimizer
+        used to merely describe)."""
+        from apex_tpu.amp.amp_optimizer import AmpOptimizer
+        from apex_tpu.amp.scaler import LossScaler
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"dense": jnp.ones((16, 16), jnp.float32),
+                  "scale": jnp.ones((16,), jnp.float32)}
+        opt = AmpOptimizer(FusedAdam(lr=1e-3), LossScaler(128.0),
+                           master_weights=True)
+        state = opt.init(params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(p, s):
+            new_p, new_s = opt.step(
+                jax.tree_util.tree_map(jnp.ones_like, p), s, p)
+            return new_p, new_s
+
+        assert_clean_hlo(train_step, params, state,
+                         rules="double-donation")
+
+
+# ---------------------------------------------------------------------------
+# report / selection machinery
+# ---------------------------------------------------------------------------
+
+class TestLintMachinery:
+    def test_unknown_rule_raises(self):
+        with pytest.raises(ValueError, match="unknown lint rule"):
+            lint_fn(lambda x: x, jnp.ones(()), rules="no-such-rule")
+
+    def test_waive_excludes_rule(self):
+        baked = jnp.arange(2048, dtype=jnp.float32)
+        report = lint_fn(lambda x: x + baked, jnp.ones((2048,)),
+                         waive="trace-constant-capture",
+                         config=LintConfig(const_min_bytes=64))
+        assert report.ok
+        assert "trace-constant-capture" not in report.rules_run
+
+    def test_assert_clean_hlo_raises_with_rule_and_where(self):
+        def poisoned(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        with pytest.raises(HloLintError) as exc:
+            assert_clean_hlo(poisoned, jnp.ones((4,)))
+        msg = str(exc.value)
+        assert "no-host-callback" in msg
+        assert "custom_call @" in msg
+
+    def test_lint_lowered_skips_jaxpr_rules_visibly(self):
+        lowered = jax.jit(lambda x: x * 2).lower(jnp.ones((4,)))
+        report = lint_lowered(lowered)
+        assert report.ok
+        assert "unexpected-upcast" in report.rules_skipped
+        assert "collective-consistency" in report.rules_skipped
+        # text-capable rules still ran
+        assert "no-host-callback" in report.rules_run
+        assert "trace-constant-capture" in report.rules_run
+
+    def test_lint_lowered_const_fallback_uses_text(self):
+        baked = jnp.arange(4096, dtype=jnp.float32)
+        lowered = jax.jit(lambda x: x + baked).lower(jnp.ones((4096,)))
+        report = lint_lowered(
+            lowered, config=LintConfig(const_min_bytes=1024))
+        assert _rules_fired(report) == ["trace-constant-capture"]
+
+    def test_report_shapes(self):
+        report = lint_fn(lambda x: x, jnp.ones(()))
+        d = report.to_dict()
+        assert d["violations"] == 0
+        assert set(d["rules_run"]) == set(RULES)
+        assert "0 violation(s)" in report.render()
+        counts = report.counts()
+        assert all(v == 0 for v in counts.values())
+
+    def test_finding_to_dict(self):
+        f = Finding("r", "msg", where="w", extra={"nbytes": 3})
+        assert f.to_dict() == {"rule": "r", "severity": "error",
+                               "message": "msg", "where": "w",
+                               "nbytes": 3}
+
+    def test_report_to_registry_emits_events(self, tmp_path):
+        from apex_tpu.telemetry.registry import (MetricsRegistry,
+                                                 use_registry)
+
+        reg = MetricsRegistry(enabled=True)
+        reg.enable(jsonl_dir=str(tmp_path))
+        report = LintReport("prog", [Finding("no-f64", "bad")],
+                            ("no-f64",), ())
+        with use_registry(reg):
+            analysis.report_to_registry(report, registry=reg)
+        assert reg.counter_value("lint/violations") == 1
+        events = [json.loads(line) for p in tmp_path.glob("*.jsonl")
+                  for line in open(p) if line.strip()]
+        lint_events = [e for e in events if e["kind"] == "lint"]
+        assert any(e.get("rule") == "no-f64" for e in lint_events)
+        summary = [e for e in lint_events if e.get("summary")]
+        assert summary and summary[-1]["violations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CompileWatcher + bench integration
+# ---------------------------------------------------------------------------
+
+class TestWatcherIntegration:
+    def test_watcher_lints_on_compile(self, tmp_path, monkeypatch):
+        from apex_tpu.telemetry import CompileWatcher
+        from apex_tpu.telemetry.registry import (MetricsRegistry,
+                                                 use_registry)
+
+        reg = MetricsRegistry(enabled=True)
+        reg.enable(jsonl_dir=str(tmp_path))
+        watcher = CompileWatcher(enabled=True, lint=True,
+                                 registry=reg)
+
+        baked = jnp.arange(1024, dtype=jnp.float32)
+        monkeypatch.setenv("APEX_TPU_HLO_LINT_CONST_BYTES", "512")
+
+        @jax.jit
+        def step(x):
+            return x + baked
+
+        with use_registry(reg):
+            watched = watcher.watch(step, "bad_step")
+            watched(jnp.ones((1024,)))  # compiles -> lints
+        assert "bad_step" in watcher.lint_reports
+        assert watcher.lint_violation_count() >= 1
+        events = [json.loads(line) for p in tmp_path.glob("*.jsonl")
+                  for line in open(p) if line.strip()]
+        lint_events = [e for e in events if e["kind"] == "lint"]
+        assert any(e.get("rule") == "trace-constant-capture"
+                   for e in lint_events)
+
+    def test_watcher_lint_off_by_default(self):
+        from apex_tpu.telemetry import CompileWatcher
+
+        watcher = CompileWatcher(enabled=True, lint=False)
+        watched = watcher.watch(jax.jit(lambda x: x * 3), "clean")
+        watched(jnp.ones((4,)))
+        assert watcher.lint_reports == {}
+
+    def test_record_aot_lints_lowered(self, monkeypatch):
+        from apex_tpu.telemetry import CompileWatcher
+
+        monkeypatch.setenv("APEX_TPU_HLO_LINT_CONST_BYTES", "512")
+        watcher = CompileWatcher(enabled=True, lint=True)
+        baked = jnp.arange(1024, dtype=jnp.float32)
+        lowered = jax.jit(lambda x: x + baked).lower(jnp.ones((1024,)))
+        watcher.record_aot("aot_prog", (jnp.ones((1024,)),),
+                           seconds=0.1, lowered=lowered)
+        assert watcher.lint_violation_count() >= 1
+
+    def test_bench_stages_lint_violations(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("APEX_TPU_HLO_LINT", "1")
+        step = jax.jit(lambda x: (x * 2, jnp.sum(x)))
+        bench._measure_step_cost(step, (jnp.ones((8,)),))
+        assert bench._PENDING_MEASURED.get("lint_violations") == 0
+        bench._PENDING_MEASURED.clear()
+
+    def test_bench_lint_null_when_unset(self, monkeypatch):
+        import bench
+
+        monkeypatch.delenv("APEX_TPU_HLO_LINT", raising=False)
+        step = jax.jit(lambda x: (x * 2, jnp.sum(x)))
+        bench._measure_step_cost(step, (jnp.ones((8,)),))
+        assert bench._PENDING_MEASURED.get("lint_violations") is None
+        bench._PENDING_MEASURED.clear()
+
+    def test_emit_carries_lint_violations(self, capsys):
+        import bench
+
+        bench._PENDING_MEASURED["lint_violations"] = 2
+        bench._emit("lint_probe_metric", 1.0, "x/sec", 1e9, 1, 1.0)
+        line = json.loads(capsys.readouterr().out.strip())
+        assert line["lint_violations"] == 2
+        bench._PENDING_MEASURED.clear()
+
+
+# ---------------------------------------------------------------------------
+# tools: CLI table + telemetry_report lint kind
+# ---------------------------------------------------------------------------
+
+class TestTools:
+    def test_hlo_lint_run_and_table(self):
+        """The CLI machinery on a subset (the full table incl. the
+        serving engine is exercised by the CLI itself and the clean-
+        pass tests above)."""
+        import tools.hlo_lint as hlo_lint
+
+        reports = hlo_lint.run_lint(configs=["ddp_fp32"])
+        assert list(reports) == ["ddp_fp32"]
+        assert reports["ddp_fp32"].ok
+        table = hlo_lint.render_table(reports)
+        assert "ddp_fp32" in table
+        assert "no-host-callback" in table
+
+    def test_hlo_lint_unknown_config(self):
+        import tools.hlo_lint as hlo_lint
+
+        with pytest.raises(SystemExit, match="unknown config"):
+            hlo_lint.run_lint(configs=["nope"])
+
+    def test_telemetry_report_lint_kind(self):
+        from tools.telemetry_report import aggregate
+
+        events = [
+            ("r0", {"kind": "lint", "name": "step",
+                    "rule": "no-f64", "severity": "error",
+                    "message": "bad", "where": "line 3"}),
+            ("r0", {"kind": "lint", "name": "step", "summary": True,
+                    "violations": 1, "clean": False,
+                    "rules_run": ["no-f64"], "rules_skipped": []}),
+            ("r0", {"kind": "lint", "name": "other", "summary": True,
+                    "violations": 0, "clean": True,
+                    "rules_run": ["no-f64"], "rules_skipped": []}),
+        ]
+        rep = aggregate(events)
+        assert rep["lint"]["violations"] == 1
+        assert rep["lint"]["by_rule"] == {"no-f64": 1}
+        assert rep["lint"]["programs"]["step"]["clean"] is False
+        assert rep["lint"]["programs"]["other"]["clean"] is True
+        # and the kind is known — not counted as unknown
+        assert rep["unknown_kinds"] == {}

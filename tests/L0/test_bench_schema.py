@@ -157,9 +157,44 @@ def test_recovery_fields_gated_at_round13():
     assert any("must be numeric or null" in m for m in msgs)
 
 
+def test_lint_violations_gated_at_round14():
+    """ISSUE 9 satellite: lint_violations (the static HLO lint's
+    finding count over the lowered step — apex_tpu.analysis) is
+    required, nullable, on every successful metric line from round 14;
+    a pre-round-14 record carrying it is flagged."""
+    base = {"metric": "gpt2_345m_tokens_per_sec_per_chip", "value": 1.0,
+            "unit": "tokens/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 10,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": None}
+    # round 13: not yet part of the contract — absent is valid, and a
+    # live line carrying it (bench._emit always writes the key) is
+    # tolerated, same as the memwatch fields
+    assert schema.check_metric_line(dict(base), round_n=13,
+                                    errors=[]) == []
+    assert schema.check_metric_line(dict(base, lint_violations=0),
+                                    round_n=13, errors=[]) == []
+    # from 14 the key is required
+    msgs = schema.check_metric_line(dict(base), round_n=14, errors=[])
+    assert any("lint_violations" in m for m in msgs)
+    # nullable (bench ran without APEX_TPU_HLO_LINT=1) and zero both ok
+    for val in (None, 0, 3):
+        assert schema.check_metric_line(
+            dict(base, lint_violations=val), round_n=14, errors=[]) == []
+    # typed: negative or non-int rejected
+    for bad in (-1, "clean", 1.5):
+        msgs = schema.check_metric_line(
+            dict(base, lint_violations=bad), round_n=14, errors=[])
+        assert any("non-negative integer" in m for m in msgs)
+
+
 def test_live_emit_passes_current_schema(capsys):
-    """What bench._emit prints today must satisfy the round-10 (current)
-    metric-line contract — telemetry + memwatch fields included."""
+    """What bench._emit prints today must satisfy the round-14
+    (current) metric-line contract — telemetry + memwatch + lint
+    fields included."""
     import bench
 
     bench._emit("unit_test_metric", 12.5, "things/sec",
@@ -168,9 +203,11 @@ def test_live_emit_passes_current_schema(capsys):
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert schema.check_metric_line(line, round_n=7, errors=[]) == []
     assert schema.check_metric_line(line, round_n=10, errors=[]) == []
+    assert schema.check_metric_line(line, round_n=14, errors=[]) == []
     assert line["measured_comm_bytes_per_step"] is None  # none staged
     assert line["peak_hbm_bytes"] is None                # none staged
     assert line["compile_count"] is None                 # none staged
+    assert line["lint_violations"] is None               # none staged
     assert "comm_bytes_per_step" in line
 
 

@@ -5,7 +5,6 @@ ZeRO optimizer step."""
 
 import glob
 import json
-import os
 
 import jax
 import jax.numpy as jnp
@@ -13,7 +12,6 @@ import numpy as np
 import pytest
 
 from apex_tpu import _compile_cache, resilience
-from apex_tpu.telemetry import compile_watch
 from apex_tpu.telemetry.compile_watch import (
     CompileWatcher,
     RecompileError,

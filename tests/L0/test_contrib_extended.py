@@ -18,7 +18,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from apex_tpu.contrib.bottleneck import Bottleneck, SpatialBottleneck
 from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
 from apex_tpu.contrib.peer_memory import PeerHaloExchanger1d, halo_exchange_1d
-from apex_tpu.contrib.sparsity import ASP, create_mask, m4n2_1d
+from apex_tpu.contrib.sparsity import ASP, m4n2_1d
 from apex_tpu.contrib.transducer import TransducerJoint, TransducerLoss
 
 

@@ -178,6 +178,7 @@ class TestCompressedZeRO:
             np.testing.assert_allclose(a, np.asarray(b),
                                        atol=np.abs(a).max() * 2 ** -8)
 
+    @pytest.mark.slow  # tier-1 budget: the Adam variant stays tier-1
     def test_lamb_compressed_close(self, rng, dp_mesh):
         mesh = dp_mesh(4)
         params = make_params(rng)

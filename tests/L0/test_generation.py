@@ -320,10 +320,18 @@ class TestSplitParamsForTP:
     the SAME weights decoded at tp=1 and tp=2 must emit identical
     tokens (value parity, not just shape parity)."""
 
-    @pytest.mark.parametrize("arch", ["mha_gelu", "gqa_swiglu",
-                                      "phi_style", "mistral_swa",
-                                      "bloom_alibi", "qwen3_qknorm",
-                                      "gemma2_sandwich"])
+    # tier-1 budget (round 14): the parity mechanism is identical per
+    # arch — keep one classic + one modern layout in tier-1, the rest
+    # of the architecture matrix runs in the full (slow-inclusive) suite
+    @pytest.mark.parametrize("arch", [
+        "mha_gelu",
+        "gqa_swiglu",
+        pytest.param("phi_style", marks=pytest.mark.slow),
+        "mistral_swa",
+        pytest.param("bloom_alibi", marks=pytest.mark.slow),
+        pytest.param("qwen3_qknorm", marks=pytest.mark.slow),
+        pytest.param("gemma2_sandwich", marks=pytest.mark.slow),
+    ])
     def test_tp2_matches_tp1_greedy(self, arch):
         from apex_tpu.models import (GPTModel, TransformerConfig, generate,
                                      split_params_for_tp,

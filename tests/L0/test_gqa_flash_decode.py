@@ -5,7 +5,6 @@ prefetch) on the CPU mesh, and the end-to-end tests drive it through
 ``generate()`` so the model-integration gate (s == 1, no alibi) is what
 is actually tested."""
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

@@ -140,7 +140,10 @@ def test_deepseek_tp2_logits_match_tp1():
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("q_lora_rank", [16, None])
+@pytest.mark.parametrize("q_lora_rank", [
+    pytest.param(16, marks=pytest.mark.slow),  # tier-1 budget: one layout
+    None,
+])
 def test_mla_cached_generate_matches_oracle(q_lora_rank):
     """The absorbed-projection latent-cache decode (kv_b folded into the
     attention contractions; cache = kv_rank+rope floats/token shared

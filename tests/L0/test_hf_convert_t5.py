@@ -177,6 +177,7 @@ def test_t5_tp2_logits_match_tp1():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # tier-1 budget: gated_and_masked covers the cache path
 def test_t5_cached_generate_matches_oracle_and_hf():
     """KV-cache decode (prefill + O(1) steps, cross K/V never
     re-projected) is token-exact vs both the full-rerun oracle and HF."""

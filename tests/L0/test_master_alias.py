@@ -4,8 +4,10 @@ Root cause of the round-2/3 "ResNet donation INVALID_ARGUMENT":
 ``astype(fp32)`` is a no-op returning the SAME buffer for leaves already
 fp32 (all norm params under amp O2), so fp32 masters aliased live params
 and a step donating both presented one buffer twice to XLA's Execute().
-Masters must be alias-free copies; the full ladder is
-tools/donation_repro.py (all 5 rungs pass post-fix, CPU-reproducible).
+Masters must be alias-free copies; the ``double-donation`` lint rule
+(apex_tpu.analysis) now catches the aliasing at trace time —
+tests/L0/test_analysis.py holds the rule-level regression that retired
+the old tools/donation_repro.py bisection ladder.
 """
 
 import functools
@@ -13,7 +15,6 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from apex_tpu import amp
 from apex_tpu.optimizers import FusedAdam

@@ -13,7 +13,6 @@ import torch
 from apex_tpu.fused_dense import (
     FusedDense,
     FusedDenseGeluDense,
-    fused_dense_function,
 )
 from apex_tpu.mlp import MLP, mlp_function
 from apex_tpu.RNN import GRU, LSTM, Tanh, mLSTM

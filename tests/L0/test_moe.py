@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from apex_tpu.testing import shard_map
 from apex_tpu.transformer import parallel_state
@@ -179,6 +179,7 @@ class TestExpertChoiceRouting:
         with pytest.raises(ValueError, match="router_type"):
             layer.init(jax.random.PRNGKey(0), x)
 
+    @pytest.mark.slow  # tier-1 budget: routing units above cover EC
     def test_gpt_expert_choice_config(self):
         from apex_tpu.models import GPTModel, TransformerConfig
         from apex_tpu.models.gpt import gpt_loss_fn
@@ -348,6 +349,7 @@ class TestExpertParallel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget: ep4_matches_local covers the parity
     def test_ep_grads_match_local(self):
         E, ep = 4, 4
         params, x = self._params_and_input(E=E, b=ep)

@@ -223,6 +223,7 @@ class TestExpertParallelScatter:
 
 
 class TestLinearScaling:
+    @pytest.mark.slow  # tier-1 budget: bench-flavored scaling sweep
     def test_sorted_dispatch_work_is_linear_in_tokens(self):
         """FLOP accounting via jax.jit(...).lower().compile().cost_analysis:
         the dense einsum dispatch/combine cost per token grows ~linearly

@@ -7,8 +7,8 @@ The acceptance story covered here end-to-end: an 8-device DDP run with
 dumped flight record identifies that module prefix as the first
 non-finite source with the prior K-1 steps' stats finite — while the
 lowered HLO of the numerics-enabled step contains no host callbacks
-(the same ``"callback" not in`` assertion as test_telemetry /
-test_resilience).
+(the same ``assert_clean_hlo(..., rules="no-host-callback")`` lint as
+test_telemetry / test_resilience — apex_tpu.analysis).
 """
 
 import json
@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from apex_tpu import resilience
 from apex_tpu.parallel import DistributedDataParallel, distributed
@@ -86,10 +86,12 @@ def test_tensor_stats_rejects_int():
 
 
 def test_tensor_stats_under_jit_no_callback():
+    from apex_tpu.analysis import assert_clean_hlo
+
     f = jax.jit(lambda x: numerics.tensor_stats(x))
     s = f(jnp.asarray([1.0, 2.0]))
     assert float(s.l2) == pytest.approx(np.sqrt(5.0))
-    assert "callback" not in f.lower(jnp.ones((8,))).as_text()
+    assert_clean_hlo(f, jnp.ones((8,)), rules="no-host-callback")
 
 
 # ---------------------------------------------------------------------------
@@ -192,9 +194,10 @@ def test_ring_record_under_jit_with_traced_cursor():
         state = push(state, jnp.asarray(i, jnp.int32),
                      jnp.asarray(float(i)))
     assert [r["step"] for r in rec.fetch(state)] == [2, 3, 4]
-    text = push.lower(state, jnp.zeros((), jnp.int32),
-                      jnp.zeros(())).as_text()
-    assert "callback" not in text
+    from apex_tpu.analysis import assert_clean_hlo
+
+    assert_clean_hlo(push, state, jnp.zeros((), jnp.int32),
+                     jnp.zeros(()), rules="no-host-callback")
 
 
 def test_ring_init_from_stats_dict_and_prefixes():
@@ -442,7 +445,9 @@ def test_zero_optimizer_numerics_stats(dp_mesh, opt_name):
     jitted = jax.jit(jax.shard_map(
         f, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
         check_vma=False))
-    assert "callback" not in jitted.lower(tree, tree).as_text()
+    from apex_tpu.analysis import assert_clean_hlo
+
+    assert_clean_hlo(jitted, tree, tree, rules="no-host-callback")
     _, stats = jitted(tree, tree)
     assert sorted(stats) == ["grads/enc"]
     assert float(stats["grads/enc"].zero_frac) == 1.0
@@ -508,9 +513,11 @@ def test_e2e_postmortem_identifies_poisoned_module(dp_mesh, tmp_path):
     gst = resilience.init_guard_state()
     rstate = rec.init_state(params, prefixes=("grads", "synced"))
 
-    text = train.lower(params, res, gst, rstate,
-                       jnp.zeros((), jnp.int32), x, y).as_text()
-    assert "callback" not in text
+    from apex_tpu.analysis import assert_clean_hlo
+
+    assert_clean_hlo(train, params, res, gst, rstate,
+                     jnp.zeros((), jnp.int32), x, y,
+                     rules="no-host-callback")
 
     reg = MetricsRegistry(enabled=True)
     with use_registry(reg):
@@ -595,7 +602,11 @@ def test_loss_scaler_update_lowering_identical_and_callback_free():
 
     off = lowered_text(MetricsRegistry())
     on = lowered_text(MetricsRegistry(enabled=True))
-    assert "callback" not in on
+    from apex_tpu.analysis import assert_clean_hlo
+
+    with use_registry(MetricsRegistry(enabled=True)):
+        assert_clean_hlo(jax.jit(scaler.update), state, jnp.zeros(()),
+                         rules="no-host-callback")
     assert on == off
 
 

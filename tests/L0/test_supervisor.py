@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from apex_tpu import checkpoint, resilience
+from apex_tpu import checkpoint
 from apex_tpu.resilience import (
     FailureClass,
     LedgerError,

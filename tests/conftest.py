@@ -86,6 +86,17 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture
+def assert_clean_hlo():
+    """The static-lint CI primitive (apex_tpu.analysis,
+    docs/analysis.md) as a fixture, next to ``assert_no_recompiles``:
+    ``assert_clean_hlo(step, *args, rules=...)`` raises HloLintError
+    naming every hot-path-invariant violation in the lowered step."""
+    from apex_tpu.analysis import assert_clean_hlo as _ach
+
+    return _ach
+
+
 @pytest.fixture(autouse=True)
 def _reset_parallel_state():
     yield

@@ -33,6 +33,10 @@ number ``n`` (old checked-in records stay valid):
   recovery contract — ``restarts``, ``mttr_steps``,
   ``snapshot_restores``, ``goodput_step_ratio`` — next to their
   steps/sec value.
+- ``n >= 14``: successful metric lines must carry ``lint_violations``
+  (the static HLO lint's finding count over the lowered step —
+  apex_tpu.analysis; null means the bench ran without
+  ``APEX_TPU_HLO_LINT=1``).
 
 Usage::
 
@@ -97,6 +101,13 @@ RECOVERY_FIELDS_SINCE_ROUND = 13
 RECOVERY_METRIC_PREFIX = "ddp_recovery"
 RECOVERY_REQUIRED_FIELDS = ("restarts", "mttr_steps",
                             "snapshot_restores", "goodput_step_ratio")
+# the static-analysis capture contract (apex_tpu.analysis, round 14):
+# lint_violations (findings of the HLO lint pass over the lowered step;
+# null = the bench ran without APEX_TPU_HLO_LINT=1) is REQUIRED
+# (nullable) on successful metric lines from round 14 — same gating
+# discipline as the memwatch fields (bench._emit always writes the
+# key, so older-round checks of live lines must tolerate it)
+LINT_FIELDS_SINCE_ROUND = 14
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -230,6 +241,15 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"recovery field {key!r} must be numeric or "
                         f"null")
+        if round_n is None or round_n >= LINT_FIELDS_SINCE_ROUND:
+            if "lint_violations" not in obj:
+                bad(f"missing lint field 'lint_violations' (required "
+                    f"since round {LINT_FIELDS_SINCE_ROUND})")
+            elif not (obj["lint_violations"] is None
+                      or (_type_ok(obj["lint_violations"], int)
+                          and obj["lint_violations"] >= 0)):
+                bad("lint_violations must be a non-negative integer "
+                    "or null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

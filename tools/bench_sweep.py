@@ -11,7 +11,6 @@ Variants timed on the real chip (host-fetch barrier, see bench.py):
 Usage: python tools/bench_sweep.py [batch] [steps]
 """
 
-import functools
 import sys
 import time
 

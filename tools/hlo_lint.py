@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Lint every default bench config's lowered step against the hot-path
+invariants (apex_tpu.analysis; docs/analysis.md) and print a
+rule x config table.
+
+The configs are the canonical lintable targets from
+``apex_tpu.analysis.targets`` — the real DDP fp32 / int8 train steps,
+the ZeRO optimizer step, the guarded (resilience) step, and the serving
+decode step, built through the same machinery the benches use, at a
+size the 1-core CPU host traces in seconds. Everything is trace-only:
+nothing compiles, nothing executes.
+
+Usage::
+
+    python tools/hlo_lint.py                  # all configs, table
+    python tools/hlo_lint.py --json           # machine-readable
+    python tools/hlo_lint.py --config ddp_int8 --config zero
+    python tools/hlo_lint.py --rule no-host-callback
+
+Exit code 0 = every selected config clean; 1 = violations (each printed
+with its rule, offending op/argument path, and message).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# the virtual 8-device mesh (same recipe as tests/conftest.py) — must
+# land before jax initializes; harmless when a real accelerator plugin
+# registers first (the flag only affects the host platform)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+if os.environ.get("APEX_TPU_HLO_LINT_FULL_OPT") != "1":
+    os.environ["XLA_FLAGS"] += " --xla_backend_optimization_level=0"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_lint(configs=None, rules=None):
+    """Build + lint the selected targets. Returns
+    ``{config: LintReport}`` (insertion-ordered)."""
+    from apex_tpu.analysis import lint_fn
+    from apex_tpu.analysis.targets import TARGETS
+
+    names = list(configs) if configs else list(TARGETS)
+    unknown = [n for n in names if n not in TARGETS]
+    if unknown:
+        raise SystemExit(f"unknown config(s) {unknown}; "
+                         f"known: {list(TARGETS)}")
+    reports = {}
+    for name in names:
+        fn, args, kwargs = TARGETS[name]()
+        reports[name] = lint_fn(fn, *args, rules=rules, name=name,
+                                **kwargs)
+    return reports
+
+
+def render_table(reports):
+    """Rule x config counts ('.' = clean, 's' = rule skipped)."""
+    from apex_tpu.analysis import RULES
+
+    rules = [r for r in RULES
+             if any(r in rep.rules_run or r in rep.rules_skipped
+                    for rep in reports.values())]
+    width = max(len(r) for r in rules) + 2
+    cols = list(reports)
+    lines = [" " * width + "  ".join(f"{c:>12}" for c in cols)]
+    for rule in rules:
+        cells = []
+        for rep in reports.values():
+            if rule in rep.rules_skipped:
+                cells.append(f"{'s':>12}")
+            else:
+                n = rep.counts().get(rule, 0)
+                cells.append(f"{n if n else '.':>12}")
+        lines.append(f"{rule:<{width}}" + "  ".join(cells))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static HLO lint over the default bench configs' "
+                    "lowered steps")
+    ap.add_argument("--config", action="append", default=None,
+                    help="lint only this config (repeatable)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of the table")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if all(d.platform == "cpu" for d in jax.devices()):
+        jax.config.update("jax_platforms", "cpu")
+
+    reports = run_lint(args.config, args.rule)
+    total = sum(len(r.findings) for r in reports.values())
+    if args.json:
+        print(json.dumps({
+            "violations": total,
+            "configs": {n: r.to_dict() for n, r in reports.items()},
+        }, indent=2))
+    else:
+        print(render_table(reports))
+        for name, rep in reports.items():
+            for f in rep.findings:
+                print(f"VIOLATION [{name}] {f}")
+        print(f"hlo_lint: {len(reports)} config(s), "
+              f"{total} violation(s)")
+    return 1 if total else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

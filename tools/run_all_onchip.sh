@@ -47,11 +47,14 @@ if [ "$stage" = all ] || [ "$stage" = benches ] || [ "$stage" = sweep ]; then
 fi
 
 if [ "$stage" = all ] || [ "$stage" = extras ]; then
-  # round-4 addition: donation ladder (expects all 5 rungs OK post-fix).
+  # round-14: the donation-repro ladder retired into the static lint
+  # pass — double-donation is now caught at trace time by
+  # apex_tpu.analysis (tests/L0/test_analysis.py has the regression);
+  # hlo_lint checks every default config's lowered step.
   # NOTE interleave_cost (VERDICT r3 item 8) needs a P-device pp mesh —
   # impossible on this 1-chip environment; regime boundary documented in
   # docs/parallelism.md instead.
-  run donation_ladder python tools/donation_repro.py
+  run hlo_lint python tools/hlo_lint.py
   # VERDICT r3 item 4: windowed-flash seq*window scaling + alibi-flash
   run flash_window python tools/flash_window_sweep.py a
   run flash_alibi python tools/flash_window_sweep.py b

@@ -40,7 +40,7 @@ def load_events(paths):
 KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
-    "compile", "memory", "serve", "recovery",
+    "compile", "memory", "serve", "recovery", "lint",
 })
 
 
@@ -67,6 +67,8 @@ def aggregate(events):
                 "by_cause": {}, "by_action": {}, "snapshots": 0,
                 "steps_lost": 0, "preempted_exits": 0,
                 "last_run": None}
+    lint = {"programs": {}, "violations": 0, "by_rule": {},
+            "errors": 0}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -225,6 +227,20 @@ def aggregate(events):
                             "snapshot_restores", "checkpoint_restores",
                             "mesh_shrinks", "steps_lost", "mttr_steps",
                             "goodput_step_ratio")}
+            elif kind == "lint":
+                if ev.get("error"):
+                    lint["errors"] += 1
+                elif ev.get("summary"):
+                    lint["programs"][str(ev.get("name"))] = {
+                        "violations": int(ev.get("violations") or 0),
+                        "clean": bool(ev.get("clean")),
+                        "rules_skipped": ev.get("rules_skipped") or [],
+                    }
+                else:  # one event per finding
+                    lint["violations"] += 1
+                    rule = str(ev.get("rule"))
+                    lint["by_rule"][rule] = \
+                        lint["by_rule"].get(rule, 0) + 1
             elif kind in KNOWN_KINDS:
                 pass  # known but needs no aggregation (checkpoint, ...)
             else:
@@ -247,6 +263,7 @@ def aggregate(events):
         "memory": memory,
         "serve": serve,
         "recovery": recovery,
+        "lint": lint,
         "unknown_kinds": unknown,
         "malformed_events": malformed,
         "counters": (last_summary or {}).get("counters", {}),
@@ -432,6 +449,26 @@ def print_report(report, out=sys.stdout):
               f"{last.get('final_step')}, {last.get('restarts')} "
               f"restart(s), mttr {last.get('mttr_steps')} step(s), "
               f"goodput ratio {last.get('goodput_step_ratio')}\n")
+    lint = report.get("lint") or {}
+    if lint.get("programs") or lint.get("violations") \
+            or lint.get("errors"):
+        w("\nhlo lint (apex_tpu.analysis):\n")
+        for name in sorted(lint.get("programs") or {}):
+            p = lint["programs"][name]
+            status = "clean" if p.get("clean") else \
+                f"{p.get('violations', 0)} violation(s)"
+            skipped_rules = p.get("rules_skipped") or []
+            extra = (f" (skipped: {', '.join(skipped_rules)})"
+                     if skipped_rules else "")
+            w(f"  {name}: {status}{extra}\n")
+        by_rule = lint.get("by_rule") or {}
+        if by_rule:
+            detail = ", ".join(f"{k}: {n}"
+                               for k, n in sorted(by_rule.items()))
+            w(f"  findings by rule: {detail}\n")
+        if lint.get("errors"):
+            w(f"  lint errors (pass crashed, not findings): "
+              f"{lint['errors']}\n")
     unknown = report.get("unknown_kinds") or {}
     skipped = sum(unknown.values()) + report.get("malformed_events", 0)
     if skipped:
