@@ -217,6 +217,43 @@ def sharding_custom_calls(text):
     return out
 
 
+_INTERLEAVE_COLLECTIVE_RE = re.compile(
+    r"stablehlo\.(all_reduce|reduce_scatter|all_gather|all_to_all)\b")
+_INTERLEAVE_COMPUTE_RE = re.compile(
+    r"stablehlo\.(dot_general|dot|convolution)\b")
+
+
+def collective_compute_interleaving(text):
+    """Module-order interleaving of collectives and heavy compute.
+
+    StableHLO text preserves emission (trace) order, so an overlapped
+    step — which launches each bucket's collective before tracing the
+    earlier segments' backward — shows dot/convolution ops AFTER its
+    first collective, while a sync-after-backward step's collectives
+    form one trailing block. Returns ``{"collectives", "compute_ops",
+    "compute_after_first_collective", "collectives_before_last_compute",
+    "interleaved"}``; ``interleaved`` is True iff at least one
+    collective precedes at least one compute op AND vice versa. A
+    pre-scheduling heuristic (the scheduler may still reorder), used by
+    the overlap tests/bench next to the ``overlap-serialization``
+    dependence rule — order suggests, dependence proves."""
+    coll, comp = [], []
+    for i, line in enumerate(text.splitlines()):
+        if _INTERLEAVE_COLLECTIVE_RE.search(line):
+            coll.append(i)
+        if _INTERLEAVE_COMPUTE_RE.search(line):
+            comp.append(i)
+    after = sum(1 for c in comp if coll and c > coll[0])
+    before_last = sum(1 for c in coll if comp and c < comp[-1])
+    return {
+        "collectives": len(coll),
+        "compute_ops": len(comp),
+        "compute_after_first_collective": after,
+        "collectives_before_last_compute": before_last,
+        "interleaved": bool(after and before_last),
+    }
+
+
 def large_constant_bytes(text, min_bytes):
     """``[(lineno, nbytes, tensor_spec)]`` for ``stablehlo.constant``
     ops whose tensor type meets ``min_bytes`` — the text-level fallback

@@ -37,6 +37,12 @@ rule                        catches
 ``replication-blowup``      mesh present but a large output/constrained
                             intermediate explicitly replicated — per-device
                             memory scales with global size
+``overlap-serialization``   a large reduction collective whose operand
+                            transitively depends on ANOTHER large reduction
+                            collective's result — a serialized chain the
+                            latency-hiding scheduler cannot overlap (the
+                            static check that an overlapped step's buckets
+                            stay independent)
 ==========================  ================================================
 """
 
@@ -85,6 +91,7 @@ class LintConfig:
     donate_min_bytes: int = 1 << 20
     const_min_bytes: int = 1 << 20
     replicated_min_bytes: int = 1 << 20
+    overlap_min_bytes: int = 1 << 20
     max_findings_per_rule: int = 16
 
     def __post_init__(self):
@@ -95,6 +102,8 @@ class LintConfig:
         self.replicated_min_bytes = _env_bytes(
             "APEX_TPU_HLO_LINT_REPLICATED_BYTES",
             self.replicated_min_bytes)
+        self.overlap_min_bytes = _env_bytes(
+            "APEX_TPU_HLO_LINT_OVERLAP_BYTES", self.overlap_min_bytes)
 
 
 # custom_call targets that ARE host round-trips. Matched against parsed
@@ -498,6 +507,98 @@ def rule_replication_blowup(ctx, cfg):
     return findings
 
 
+# Reduction collectives an overlapped schedule must keep independent.
+# all_gather is deliberately EXCLUDED: the ZeRO param gather depends on
+# the shard update, which depends on the scatter — a legitimate
+# pipeline stage, not a serialization bug.
+_REDUCTION_COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "reduce_scatter", "pmax", "pmin",
+    "reduce_precision_psum",
+})
+
+
+def _collective_payload_bytes(eqn):
+    """Bytes of the first array operand — the collective's payload."""
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        if shape is not None:
+            size = 1
+            for d in shape:
+                size *= int(d)
+            return size * getattr(getattr(aval, "dtype", None),
+                                  "itemsize", 4)
+    return 0
+
+
+def rule_overlap_serialization(ctx, cfg):
+    """Flag a large reduction collective whose operand transitively
+    depends on the RESULT of another large reduction collective: the
+    downstream collective cannot start until the upstream one
+    completes, so the pair degenerates to a serial chain no
+    latency-hiding scheduler can overlap with compute (the
+    all-collectives-in-one-trailing-block failure mode the overlapped
+    step exists to avoid; parallel/overlap.py emits every bucket's
+    collective with NO cross-bucket dependence, and this rule is the
+    static proof it stays that way). Small collectives — the scalar
+    guard-flag psum, the int8 per-block scale pmax that feeds its OWN
+    bucket's payload — sit below ``overlap_min_bytes`` and neither
+    taint nor trip. ``optimization_barrier`` joins propagate dependence
+    like any other op, so a barrier between buckets is caught too."""
+    if ctx.closed_jaxpr is None:
+        return None
+    findings = []
+    counter = [0]
+
+    def walk(jaxpr, taint):
+        """``taint``: var -> frozenset of upstream big-collective ids.
+        Returns the ids minted inside this jaxpr (for the parent eqn's
+        outputs)."""
+        minted = set()
+        for eqn in jaxpr.eqns:
+            in_taint = set()
+            for v in eqn.invars:
+                if _is_var(v):
+                    in_taint |= taint.get(v, frozenset())
+            name = eqn.primitive.name
+            big = (name in _REDUCTION_COLLECTIVES
+                   and _collective_payload_bytes(eqn)
+                   >= cfg.overlap_min_bytes)
+            out_taint = set(in_taint)
+            if big:
+                if in_taint:
+                    findings.append(Finding(
+                        "overlap-serialization",
+                        f"{name} (payload "
+                        f"{_fmt_bytes(_collective_payload_bytes(eqn))}) "
+                        f"input depends on the result of "
+                        f"{len(in_taint)} earlier large reduction "
+                        f"collective(s) — the chain serializes them "
+                        f"into one block XLA cannot overlap with "
+                        f"compute; emit per-bucket collectives with "
+                        f"independent operands (see "
+                        f"parallel/overlap.py)",
+                        where=_eqn_where(eqn) or name,
+                        extra={"upstream": len(in_taint)}))
+                cid = counter[0]
+                counter[0] += 1
+                out_taint.add(cid)
+                minted.add(cid)
+            for sub in _iter_subjaxprs(eqn):
+                sub_taint = {v: frozenset(in_taint)
+                             for v in sub.invars if _is_var(v)}
+                inner = walk(sub, sub_taint)
+                out_taint |= inner
+                minted |= inner
+            frozen = frozenset(out_taint)
+            for v in eqn.outvars:
+                taint[v] = frozen
+        return minted
+
+    walk(ctx.closed_jaxpr.jaxpr, {})
+    return findings
+
+
 # rule registry: name -> (fn, what it needs beyond the HLO text).
 # Order is the report order.
 RULES = {
@@ -508,5 +609,6 @@ RULES = {
     "double-donation": (rule_double_donation, ("args",)),
     "trace-constant-capture": (rule_trace_constant_capture, ()),
     "collective-consistency": (rule_collective_consistency, ("jaxpr",)),
+    "overlap-serialization": (rule_overlap_serialization, ("jaxpr",)),
     "replication-blowup": (rule_replication_blowup, ()),
 }

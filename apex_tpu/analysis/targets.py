@@ -103,6 +103,46 @@ def ddp_int8_step():
     return train_step, (params, residual, x, y), {}
 
 
+def ddp_overlapped_step():
+    """The overlapped int8 DDP train step (parallel/overlap.py): a
+    2-segment MLP, segment-by-segment backward with per-bucket psum
+    emission — the step the ``overlap-serialization`` rule exists to
+    keep honest (every bucket's collective independent; carry state —
+    params, bucket-domain EF residual — donated)."""
+    from apex_tpu.parallel import OverlappedDataParallel
+
+    mesh = _mesh()
+    depth = 2
+    params = _mlp_params(depth=depth)
+    x, y = _batch(mesh)
+    odp = OverlappedDataParallel(axis_name="dp", compress="int8")
+    seg_params = [{f"w{i}": params[f"w{i}"], f"b{i}": params[f"b{i}"]}
+                  for i in range(depth)]
+    residual = odp.init_residual(seg_params)
+
+    def step_fn(sp, res, xb, yb):
+        segs = [lambda pk, h, i=i: jnp.tanh(h @ pk[f"w{i}"]
+                                            + pk[f"b{i}"])
+                for i in range(depth - 1)]
+
+        def last(pk, h, i=depth - 1):
+            h = jnp.tanh(h @ pk[f"w{i}"] + pk[f"b{i}"])
+            return jnp.mean((h - yb) ** 2)
+
+        segs.append(last)
+        loss, synced, new_res = odp.value_and_sync(segs, sp, xb,
+                                                   residual=res)
+        sp = [jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, pk, gk)
+              for pk, gk in zip(sp, synced)]
+        return sp, new_res, loss
+
+    sharded = jax.shard_map(step_fn, mesh=mesh,
+                            in_specs=(P(), P(), P("dp"), P("dp")),
+                            out_specs=(P(), P(), P()), check_vma=False)
+    train_step = jax.jit(sharded, donate_argnums=(0, 1))
+    return train_step, (seg_params, residual, x, y), {}
+
+
 def zero_step():
     """ZeRO optimizer step (DistributedFusedAdam with int8 grad
     reduce-scatter): sharded state carried and donated."""
@@ -220,6 +260,7 @@ def serve_decode_step():
 TARGETS = {
     "ddp_fp32": ddp_fp32_step,
     "ddp_int8": ddp_int8_step,
+    "ddp_overlapped": ddp_overlapped_step,
     "zero": zero_step,
     "guarded": guarded_step,
     "serve_decode": serve_decode_step,

@@ -32,7 +32,7 @@ over 'ep'. Pre-average dense grads over 'ep' first::
 """
 
 import warnings
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -289,11 +289,74 @@ def zero_state_bytes(params, *, world, grad_compress=None,
     return report
 
 
+class ZeroBucket(NamedTuple):
+    """One planned ZeRO overlap bucket: segment-local leaf indices, the
+    flat element count, and the world/block-aligned padded length its
+    shards are cut from."""
+
+    leaf_idx: tuple
+    n: int
+    padded: int
+
+
+def plan_zero_overlap(segment_params, *, world, grad_compress=None,
+                      param_compress=None,
+                      block_size=compression.BLOCK_SIZE,
+                      message_size=10000000):
+    """Host-side overlap bucket plan for a ZeRO optimizer: per segment
+    (a list of param pytrees — pass ``[params]`` for an unsegmented
+    model), the dtype-segregated ``message_size``-capped grouping of
+    ``parallel.distributed.plan_buckets``, each bucket independently
+    padded for ``world``-way sharding (int8 block alignment included).
+    Buckets never span a segment boundary, so each becomes ready the
+    moment its segment's backward finishes."""
+    from apex_tpu.parallel.distributed import plan_buckets
+
+    plan = []
+    for params in segment_params:
+        leaves = jax.tree_util.tree_leaves(params)
+        buckets = []
+        if leaves:
+            for idxs in plan_buckets(leaves, message_size):
+                n = int(sum(int(leaves[i].size) for i in idxs))
+                buckets.append(ZeroBucket(
+                    tuple(idxs), n,
+                    _padded_size(n, world, grad_compress, param_compress,
+                                 block_size)))
+        plan.append(tuple(buckets))
+    return tuple(plan)
+
+
+def _as_segments(tree_or_list):
+    """Normalize ``params``/``grads`` to the segmented form: a
+    list/tuple of CONTAINER pytrees (dicts etc.) passes through as
+    segments, anything else — including a plain list of arrays —
+    becomes one segment."""
+    if isinstance(tree_or_list, (list, tuple)) and tree_or_list and all(
+            not hasattr(t, "shape") for t in tree_or_list):
+        return list(tree_or_list), True
+    return [tree_or_list], False
+
+
 class DistributedFusedAdam:
     """Args mirror the reference's core knobs (distributed_fused_adam.py:147):
     lr, bias_correction, betas, eps, weight_decay, adam_w_mode,
     grad_sync_dtype (bucket dtype), process-group options map to
-    ``axis_name``."""
+    ``axis_name``.
+
+    ``overlap=True`` restructures the step for backward/collective
+    overlap (parallel/overlap.py, arXiv 2004.13336): the flat state is
+    partitioned into ``message_size``-capped buckets, and each bucket
+    runs its own reduce-scatter -> sharded Adam update -> all-gather
+    chain, data-dependent ONLY on that bucket's gradients — so XLA can
+    interleave bucket *i*'s collectives and update with the backward
+    compute that produces bucket *i-1*. ``init``/``step`` then also
+    accept a LIST of param/grad pytrees (one per model segment; buckets
+    never span segments), which is how
+    ``overlap.overlapped_zero_step`` drives the per-bucket machinery
+    from inside its segmented backward. Elastic re-sharding
+    (``state_dict_full``/``load_state_dict_resharded``) is not
+    supported for the bucket-partitioned state."""
 
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, adam_w_mode=True, weight_decay=0.0,
@@ -303,7 +366,8 @@ class DistributedFusedAdam:
                  grad_compress: Optional[str] = None,
                  param_compress: Optional[str] = None,
                  compress_block_size: int = compression.BLOCK_SIZE,
-                 numerics=None):
+                 numerics=None, overlap: bool = False,
+                 message_size: int = 10000000):
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -331,6 +395,180 @@ class DistributedFusedAdam:
         # compression: the flat ZeRO buffers lose module attribution,
         # so stats are taken where the module structure still exists).
         self.numerics = numerics
+        # Overlapped mode (parallel/overlap.py): bucket-partitioned
+        # state, per-bucket reduce-scatter -> shard update -> all-gather
+        # chains with no cross-bucket data dependence.
+        self.overlap = overlap
+        self.message_size = message_size
+
+    # -- overlapped mode: bucket plan + per-bucket primitives -----------
+
+    @property
+    def overlap_needs_global_norm(self):
+        """Adam has no cross-bucket coupling: every bucket's update is
+        data-dependent only on its own scattered grads."""
+        return False
+
+    def overlap_plan(self, params_or_segments):
+        segs, _ = _as_segments(params_or_segments)
+        return plan_zero_overlap(
+            segs, world=_axis_size(self.axis_name),
+            grad_compress=self.grad_compress,
+            param_compress=self.param_compress,
+            block_size=self.compress_block_size,
+            message_size=self.message_size)
+
+    def _init_bucket(self, leaves, bucket):
+        world = _axis_size(self.axis_name)
+        flat = jnp.concatenate(
+            [leaves[i].reshape(-1).astype(jnp.float32)
+             for i in bucket.leaf_idx])
+        flat = jnp.pad(flat, (0, bucket.padded - bucket.n))
+        if world > 1:
+            rank = lax.axis_index(self.axis_name)
+            shard = lax.dynamic_slice_in_dim(
+                flat, rank * (bucket.padded // world),
+                bucket.padded // world)
+        else:
+            shard = flat
+        bstate = {
+            "master_shard": shard,
+            "exp_avg_shard": jnp.zeros_like(shard),
+            "exp_avg_sq_shard": jnp.zeros_like(shard),
+        }
+        if self.grad_compress == "int8":
+            bstate["grad_residual"] = jnp.zeros((bucket.padded,),
+                                                jnp.float32)
+        return bstate
+
+    def bucket_reduce(self, flat_g, bstate):
+        """Reduce-scatter ONE bucket's padded flat gradient; returns
+        ``(averaged local shard, new residual or None)`` — the same
+        policy as :meth:`_sync_grads`, scoped to the bucket."""
+        world = _axis_size(self.axis_name)
+        if world == 1:
+            return flat_g, bstate.get("grad_residual")
+        with _telemetry_trace.span("zero/grad_reduce_scatter",
+                                   compress=self.grad_compress or "none",
+                                   overlap=True):
+            if self.grad_compress is None:
+                _telemetry_comm.record_collective(
+                    "psum_scatter", elements=flat_g.size,
+                    dtype=flat_g.dtype, world=world)
+                g_shard = lax.psum_scatter(flat_g, self.axis_name,
+                                           tiled=True)
+                return g_shard / world, None
+            g_shard, residual = compression.psum_scatter_compressed(
+                flat_g, self.axis_name, mode=self.grad_compress,
+                residual=bstate.get("grad_residual"),
+                block_size=self.compress_block_size)
+            return g_shard / world, residual
+
+    def _shard_adam_math(self, g_shard, bstate, *, lr, step):
+        """The fused Adam update on one local fp32 shard — byte-for-byte
+        the math :meth:`step` runs on the monolithic shard."""
+        b1, b2 = self.betas
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step
+            bc2 = 1.0 - b2 ** step
+        else:
+            bc1 = bc2 = 1.0
+        p = bstate["master_shard"]
+        if self.adam_w_mode == 0 or not self.adam_w_mode:
+            g_shard = g_shard + self.weight_decay * p
+        m = b1 * bstate["exp_avg_shard"] + (1 - b1) * g_shard
+        v = b2 * bstate["exp_avg_sq_shard"] \
+            + (1 - b2) * jnp.square(g_shard)
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.adam_w_mode and self.weight_decay != 0:
+            update = update + self.weight_decay * p
+        return p - lr * update, m, v
+
+    def bucket_update_gather(self, g_shard, bstate, bucket, p_leaves, *,
+                             lr=None, step, noop, clip=None,
+                             new_residual=None):
+        """Sharded optimizer update + param all-gather for ONE bucket.
+        Data-dependent only on this bucket's scattered grads (``clip``
+        must stay None for Adam — there is no global-norm coupling).
+        Returns ``(new param leaves, new bucket state)``."""
+        if clip is not None:
+            raise ValueError("DistributedFusedAdam has no global-norm "
+                             "clip; clip must be None")
+        lr = self.lr if lr is None else lr
+        world = _axis_size(self.axis_name)
+        p = bstate["master_shard"]
+        p_new, m, v = self._shard_adam_math(g_shard, bstate, lr=lr,
+                                            step=step)
+        keep = noop > 0
+        p_new = jnp.where(keep, p, p_new)
+        m = jnp.where(keep, bstate["exp_avg_shard"], m)
+        v = jnp.where(keep, bstate["exp_avg_sq_shard"], v)
+        flat_p = self._gather_params(p_new, world)
+        new_bstate = {"master_shard": p_new, "exp_avg_shard": m,
+                      "exp_avg_sq_shard": v}
+        if self.grad_compress == "int8":
+            new_bstate["grad_residual"] = jnp.where(
+                keep, bstate["grad_residual"], new_residual)
+        from apex_tpu.parallel.distributed import unflatten
+
+        new_leaves = unflatten(flat_p[:bucket.n], p_leaves)
+        return new_leaves, new_bstate
+
+    def _init_overlapped(self, params):
+        segs, _ = _as_segments(params)
+        plan = self.overlap_plan(segs)
+        buckets = []
+        for params_k, seg_plan in zip(segs, plan):
+            leaves = jax.tree_util.tree_leaves(params_k)
+            buckets.append(tuple(self._init_bucket(leaves, b)
+                                 for b in seg_plan))
+        return {"step": jnp.zeros((), jnp.int32),
+                "buckets": tuple(buckets)}
+
+    def _step_overlapped(self, grads, state, params, *, lr, found_inf,
+                         scale):
+        lr = self.lr if lr is None else lr
+        g_segs, was_list = _as_segments(grads)
+        p_segs, _ = _as_segments(params)
+        plan = self.overlap_plan(p_segs)
+        noop = (jnp.zeros((), jnp.float32) if found_inf is None
+                else jnp.asarray(found_inf, jnp.float32))
+        step = state["step"] + jnp.where(noop > 0, 0, 1).astype(jnp.int32)
+        new_params, new_buckets = [], []
+        for k, (grads_k, params_k, seg_plan) in enumerate(
+                zip(g_segs, p_segs, plan)):
+            g_leaves, treedef = jax.tree_util.tree_flatten(grads_k)
+            p_leaves = list(jax.tree_util.tree_leaves(params_k))
+            seg_states = []
+            for bi, bucket in enumerate(seg_plan):
+                bstate = state["buckets"][k][bi]
+                flat_g = jnp.concatenate(
+                    [g_leaves[i].reshape(-1).astype(jnp.float32)
+                     for i in bucket.leaf_idx]) / scale
+                flat_g = jnp.pad(flat_g, (0, bucket.padded - bucket.n))
+                g_shard, new_residual = self.bucket_reduce(flat_g, bstate)
+                new_leaves, nb = self.bucket_update_gather(
+                    g_shard, bstate, bucket,
+                    [p_leaves[i] for i in bucket.leaf_idx],
+                    lr=lr, step=step, noop=noop,
+                    new_residual=new_residual)
+                for i, leaf in zip(bucket.leaf_idx, new_leaves):
+                    p_leaves[i] = leaf
+                seg_states.append(nb)
+            new_params.append(
+                jax.tree_util.tree_unflatten(treedef, p_leaves))
+            new_buckets.append(tuple(seg_states))
+        new_state = {"step": step, "buckets": tuple(new_buckets)}
+        out_params = new_params if was_list else new_params[0]
+        if self.numerics:
+            stats = {}
+            depth = (_numerics.default_prefix_depth()
+                     if self.numerics is True else int(self.numerics))
+            for grads_k in g_segs:
+                stats.update(_numerics.tree_stats(
+                    grads_k, prefix_depth=depth, prefix="grads"))
+            return out_params, new_state, stats
+        return out_params, new_state
 
     def _grad_stats(self, grads):
         depth = (_numerics.default_prefix_depth() if self.numerics is True
@@ -374,6 +612,12 @@ class DistributedFusedAdam:
         :meth:`load_state_dict_resharded` can re-partition onto any
         world size. ``world`` is explicit because the axis is unbound
         on the host. See :func:`consolidate_zero_state`."""
+        if isinstance(state, dict) and "buckets" in state:
+            raise NotImplementedError(
+                "state_dict_full: elastic re-sharding is not supported "
+                "for the overlap=True bucket-partitioned state; "
+                "checkpoint with overlap=False (same training "
+                "semantics) when a topology change is expected")
         return consolidate_zero_state(
             state, params, world=world, grad_compress=self.grad_compress,
             param_compress=self.param_compress,
@@ -405,7 +649,11 @@ class DistributedFusedAdam:
     def init(self, params):
         """State: local fp32 master/moment shards of size padded/world
         (+ the full-length error-feedback residual when the grad sync is
-        int8-compressed)."""
+        int8-compressed). With ``overlap=True`` the state is instead
+        bucket-partitioned (``{"step", "buckets": ...}``) and ``params``
+        may be a list of per-segment pytrees."""
+        if self.overlap:
+            return self._init_overlapped(params)
         n, padded, world = self._shard_info(params)
         flat = _flatten_f32(params)
         flat = jnp.pad(flat, (0, padded - n))
@@ -464,6 +712,10 @@ class DistributedFusedAdam:
 
     def step(self, grads, state, params, *, lr: Optional[float] = None,
              found_inf=None, scale: float = 1.0):
+        if self.overlap:
+            return self._step_overlapped(grads, state, params, lr=lr,
+                                         found_inf=found_inf,
+                                         scale=scale)
         lr = self.lr if lr is None else lr
         stats = self._grad_stats(grads) if self.numerics else None
         n, padded, world = self._shard_info(params)

@@ -18,6 +18,8 @@ import numpy as np
 from jax import lax
 
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam as _Adam,
+    _as_segments,
     _flat_size,
     _flatten_f32,
     _padded_size,
@@ -41,7 +43,8 @@ class DistributedFusedLAMB:
                  compress: bool = False,
                  grad_compress=None, param_compress=None,
                  compress_block_size: int = compression.BLOCK_SIZE,
-                 numerics=None):
+                 numerics=None, overlap: bool = False,
+                 message_size: int = 10000000):
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -69,6 +72,215 @@ class DistributedFusedLAMB:
         # returns (params, state, stats) with stats of the incoming
         # (pre-flatten, pre-compression) grads
         self.numerics = numerics
+        # Overlapped mode (parallel/overlap.py): bucket-partitioned
+        # state, per-bucket reduce-scatter chains. LAMB's global
+        # grad-norm clip is the one cross-bucket coupling — the
+        # scatters still interleave with the backward, but with
+        # ``max_grad_norm > 0`` every (cheap, scalar-joined) shard
+        # update waits for the clip factor; set ``max_grad_norm=0``
+        # for strict bucket-i-only data dependence.
+        self.overlap = overlap
+        self.message_size = message_size
+
+    # -- overlapped mode: the bucket plan + init are layout-only and
+    # shared verbatim with DistributedFusedAdam (same master/moment
+    # shard cut, same padding math); only the update math is LAMB's
+    overlap_plan = _Adam.overlap_plan
+    _init_bucket = _Adam._init_bucket
+    _init_overlapped = _Adam._init_overlapped
+
+    @property
+    def overlap_needs_global_norm(self):
+        """True when clipping couples every bucket's update to the
+        global grad norm (one scalar join; the scatters stay
+        independent)."""
+        return bool(self.max_grad_norm and self.max_grad_norm > 0)
+
+    def bucket_reduce(self, flat_g, bstate):
+        """Reduce-scatter ONE bucket's padded flat gradient; returns
+        ``(local shard — averaged iff grad_averaging, new residual or
+        None)``."""
+        world = _axis_size(self.axis_name)
+        if world == 1:
+            return flat_g, bstate.get("grad_residual")
+        with _telemetry_trace.span("zero/grad_reduce_scatter",
+                                   compress=self.grad_compress or "none",
+                                   overlap=True):
+            if self.grad_compress is None:
+                _telemetry_comm.record_collective(
+                    "psum_scatter", elements=flat_g.size,
+                    dtype=flat_g.dtype, world=world)
+                g_shard = lax.psum_scatter(flat_g, self.axis_name,
+                                           tiled=True)
+                residual = None
+            else:
+                g_shard, residual = compression.psum_scatter_compressed(
+                    flat_g, self.axis_name, mode=self.grad_compress,
+                    residual=bstate.get("grad_residual"),
+                    block_size=self.compress_block_size)
+        if self.grad_averaging:
+            g_shard = g_shard / world
+        return g_shard, residual
+
+    def overlap_global_clip(self, g_shards):
+        """The clip factor from the GLOBAL grad norm: per-bucket local
+        sums of squares joined into one scalar psum — sum-of-squares
+        partitions exactly over buckets, so the value matches the
+        monolithic step's up to fp32 summation order."""
+        world = _axis_size(self.axis_name)
+        gsq = jnp.zeros((), jnp.float32)
+        for g in g_shards:
+            gsq = gsq + jnp.sum(jnp.square(g))
+        if world > 1:
+            gsq = lax.psum(gsq, self.axis_name)
+        gnorm = jnp.sqrt(gsq)
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            return jnp.maximum(gnorm / self.max_grad_norm, 1.0)
+        return jnp.asarray(1.0, jnp.float32)
+
+    def _bucket_segments(self, bucket, p_leaves):
+        """Static per-tensor segment ids for one bucket's padded flat
+        vector, shard-major — the bucket-local analog of
+        :meth:`_layout`'s map (pad -> segment T)."""
+        world = _axis_size(self.axis_name)
+        sizes = [int(np.prod(l.shape)) for l in p_leaves]
+        seg = np.repeat(np.arange(len(sizes)), sizes)
+        seg = np.concatenate([seg, np.full(bucket.padded - bucket.n,
+                                           len(sizes))])
+        return seg.reshape(world, bucket.padded // world), len(sizes)
+
+    def bucket_update_gather(self, g_shard, bstate, bucket, p_leaves, *,
+                             lr=None, step, noop, clip=None,
+                             new_residual=None):
+        """Sharded LAMB update (per-tensor trust ratios computed from
+        this bucket's own segment map) + param all-gather for ONE
+        bucket. ``clip`` is the global factor from
+        :meth:`overlap_global_clip` (None -> no clipping)."""
+        lr = self.lr if lr is None else lr
+        world = _axis_size(self.axis_name)
+        seg_shards, T = self._bucket_segments(bucket, p_leaves)
+        if clip is not None:
+            g_shard = g_shard / clip
+        b1, b2 = self.betas
+        beta3 = (1 - b1) if self.grad_averaging else 1.0
+        bc1 = 1.0 - b1 ** step if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** step if self.bias_correction else 1.0
+        p = bstate["master_shard"]
+        if not self.adam_w_mode and self.weight_decay != 0:
+            g_shard = g_shard + self.weight_decay * p
+        m = b1 * bstate["exp_avg_shard"] + beta3 * g_shard
+        v = b2 * bstate["exp_avg_sq_shard"] \
+            + (1 - b2) * jnp.square(g_shard)
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.adam_w_mode and self.weight_decay != 0:
+            update = update + self.weight_decay * p
+
+        w_sq = self._per_tensor_sq(p, seg_shards, world, T)
+        u_sq = self._per_tensor_sq(update, seg_shards, world, T)
+        w_norm = jnp.sqrt(w_sq)
+        u_norm = jnp.sqrt(u_sq)
+        if (self.weight_decay != 0) or self.use_nvlamb:
+            ratio_t = jnp.where((w_norm > 0) & (u_norm > 0),
+                                w_norm / u_norm, 1.0)
+        else:
+            ratio_t = jnp.ones((T,), jnp.float32)
+        if world > 1:
+            rank = lax.axis_index(self.axis_name)
+            seg_local = jnp.asarray(seg_shards)[rank]
+        else:
+            seg_local = jnp.asarray(seg_shards).reshape(-1)
+        ratio = jnp.concatenate(
+            [ratio_t, jnp.ones((1,), jnp.float32)])[seg_local]
+
+        p_new = p - lr * ratio * update
+        keep = noop > 0
+        p_new = jnp.where(keep, p, p_new)
+        m = jnp.where(keep, bstate["exp_avg_shard"], m)
+        v = jnp.where(keep, bstate["exp_avg_sq_shard"], v)
+
+        if world > 1:
+            with _telemetry_trace.span("zero/param_all_gather",
+                                       compress=self.param_compress
+                                       or "none", overlap=True):
+                if self.param_compress is None:
+                    _telemetry_comm.record_collective(
+                        "all_gather", elements=p_new.size,
+                        dtype=p_new.dtype, world=world)
+                    flat_p = lax.all_gather(p_new, self.axis_name,
+                                            tiled=True)
+                else:
+                    flat_p = compression.all_gather_compressed(
+                        p_new, self.axis_name, mode=self.param_compress,
+                        block_size=self.compress_block_size)
+        else:
+            flat_p = p_new
+        new_bstate = {"master_shard": p_new, "exp_avg_shard": m,
+                      "exp_avg_sq_shard": v}
+        if self.grad_compress == "int8":
+            new_bstate["grad_residual"] = jnp.where(
+                keep, bstate["grad_residual"], new_residual)
+        from apex_tpu.parallel.distributed import unflatten
+
+        new_leaves = unflatten(flat_p[:bucket.n], p_leaves)
+        return new_leaves, new_bstate
+
+    def _step_overlapped(self, grads, state, params, *, lr, found_inf,
+                         scale):
+        lr = self.lr if lr is None else lr
+        g_segs, was_list = _as_segments(grads)
+        p_segs, _ = _as_segments(params)
+        plan = self.overlap_plan(p_segs)
+        noop = (jnp.zeros((), jnp.float32) if found_inf is None
+                else jnp.asarray(found_inf, jnp.float32))
+        step = state["step"] + jnp.where(noop > 0, 0, 1).astype(jnp.int32)
+        # phase 1: every bucket's reduce-scatter (independent chains)
+        reduced = []
+        for k, (grads_k, seg_plan) in enumerate(zip(g_segs, plan)):
+            g_leaves = jax.tree_util.tree_leaves(grads_k)
+            for bi, bucket in enumerate(seg_plan):
+                bstate = state["buckets"][k][bi]
+                flat_g = jnp.concatenate(
+                    [g_leaves[i].reshape(-1).astype(jnp.float32)
+                     for i in bucket.leaf_idx]) / scale
+                flat_g = jnp.pad(flat_g, (0, bucket.padded - bucket.n))
+                g_shard, new_residual = self.bucket_reduce(flat_g, bstate)
+                reduced.append((k, bi, bucket, g_shard, new_residual))
+        # phase 2: the one scalar join (global clip), then per-bucket
+        # updates + gathers
+        clip = (self.overlap_global_clip([g for *_, g, _ in reduced])
+                if self.overlap_needs_global_norm else None)
+        new_leaves_by_seg = [list(jax.tree_util.tree_leaves(p))
+                             for p in p_segs]
+        new_buckets = [[None] * len(seg_plan)
+                       for seg_plan in plan]
+        for k, bi, bucket, g_shard, new_residual in reduced:
+            p_leaves = new_leaves_by_seg[k]
+            bstate = state["buckets"][k][bi]
+            new_leaves, nb = self.bucket_update_gather(
+                g_shard, bstate, bucket,
+                [p_leaves[i] for i in bucket.leaf_idx],
+                lr=lr, step=step, noop=noop, clip=clip,
+                new_residual=new_residual)
+            for i, leaf in zip(bucket.leaf_idx, new_leaves):
+                p_leaves[i] = leaf
+            new_buckets[k][bi] = nb
+        new_params = [
+            jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(p_segs[k]),
+                new_leaves_by_seg[k])
+            for k in range(len(p_segs))]
+        new_state = {"step": step,
+                     "buckets": tuple(tuple(seg) for seg in new_buckets)}
+        out_params = new_params if was_list else new_params[0]
+        if self.numerics:
+            stats = {}
+            depth = (_numerics.default_prefix_depth()
+                     if self.numerics is True else int(self.numerics))
+            for grads_k in g_segs:
+                stats.update(_numerics.tree_stats(
+                    grads_k, prefix_depth=depth, prefix="grads"))
+            return out_params, new_state, stats
+        return out_params, new_state
 
     def _grad_stats(self, grads):
         depth = (_numerics.default_prefix_depth() if self.numerics is True
@@ -106,6 +318,12 @@ class DistributedFusedLAMB:
 
     def state_dict_full(self, state, params, *, world):
         """See :meth:`DistributedFusedAdam.state_dict_full`."""
+        if isinstance(state, dict) and "buckets" in state:
+            raise NotImplementedError(
+                "state_dict_full: elastic re-sharding is not supported "
+                "for the overlap=True bucket-partitioned state; "
+                "checkpoint with overlap=False (same training "
+                "semantics) when a topology change is expected")
         return consolidate_zero_state(
             state, params, world=world, grad_compress=self.grad_compress,
             param_compress=self.param_compress,
@@ -137,6 +355,8 @@ class DistributedFusedLAMB:
         return seg.reshape(world, padded // world)
 
     def init(self, params):
+        if self.overlap:
+            return self._init_overlapped(params)
         n, padded, world, T, seg = self._layout(params)
         flat = jnp.pad(_flatten_f32(params), (0, padded - n))
         if world > 1:
@@ -170,6 +390,10 @@ class DistributedFusedLAMB:
 
     def step(self, grads, state, params, *, lr: Optional[float] = None,
              found_inf=None, scale: float = 1.0):
+        if self.overlap:
+            return self._step_overlapped(grads, state, params, lr=lr,
+                                         found_inf=found_inf,
+                                         scale=scale)
         lr = self.lr if lr is None else lr
         stats = self._grad_stats(grads) if self.numerics else None
         n, padded, world, T, seg = self._layout(params)

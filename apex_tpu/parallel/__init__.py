@@ -25,6 +25,12 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     unflatten,
 )
 from apex_tpu.parallel import multiproc  # noqa: F401
+from apex_tpu.parallel import overlap  # noqa: F401
+from apex_tpu.parallel.overlap import (  # noqa: F401
+    OverlappedDataParallel,
+    overlapped_zero_step,
+    plan_overlap,
+)
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, sync_batch_norm  # noqa: F401
 from apex_tpu.parallel.LARC import LARC  # noqa: F401
 

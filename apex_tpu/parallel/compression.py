@@ -280,6 +280,41 @@ def psum_compressed(flat, axis_name, *, mode="int8", residual=None,
     return out, err
 
 
+def psum_compressed_blocks(x2d, axis_name, *, scale_mult=None):
+    """AllReduce-sum of an ALREADY block-shaped ``[nblocks, block]``
+    fp32 buffer with the int8 payload — the bucket-domain primitive the
+    overlapped step (parallel/overlap.py) is built on.
+
+    The flat :func:`psum_compressed` re-marshals its error-feedback
+    residual through ``flatten``/``unflatten`` every step; a step that
+    keeps its residual in this 2-D block layout adds it with one
+    elementwise add and skips that traffic entirely. ``x2d`` is the
+    effective gradient (residual already added by the caller).
+
+    ``scale_mult`` folds a constant post-psum multiply (e.g. the
+    ``1/world`` gradient averaging) into the dequantization scales — a
+    ``[nblocks, 1]`` multiply instead of a full-length pass over the
+    payload. Folding changes the result by at most one fp32 rounding
+    per element vs dividing afterwards; pass ``None`` for the
+    bit-exact-to-:func:`psum_compressed` order of operations.
+
+    Returns ``(summed fp32 [nblocks * block] flat, err2d)`` where
+    ``err2d`` is the local quantization error in the SAME 2-D block
+    layout (the next step's residual, zero pad tail included)."""
+    scales = _shared_scales(x2d, axis_name)
+    q = (_quantize_pallas(x2d, scales) if _gate().enabled()
+         else _quantize_jnp(x2d, scales))
+    _telemetry_comm.record_collective(
+        "psum", elements=q.size, dtype=jnp.int8, axis_name=axis_name,
+        mode="int8", emulated=True)
+    total = lax.psum(q.astype(jnp.int32), axis_name)
+    out_scales = scales if scale_mult is None \
+        else scales * jnp.float32(scale_mult)
+    out = dequantize_blockwise(total, out_scales)
+    err = x2d - _dequantize_jnp(q, scales)
+    return out, err
+
+
 def psum_scatter_compressed(flat, axis_name, *, mode="int8", residual=None,
                             block_size: int = BLOCK_SIZE):
     """ZeRO grad sync: reduce-scatter with a compressed payload.

@@ -1,0 +1,476 @@
+"""Overlapped backward/collective training step.
+
+Why: every DP sync path in this repo runs the FULL backward and then
+reduces every gradient bucket (``all_reduce_gradients_bucketed``), so
+communication time adds serially to compute time. T3 (arXiv 2401.16677)
+and the fused computation-collective work (arXiv 2305.06942) show that
+launching each bucket's reduction as soon as its gradients are ready
+hides most of the comm latency behind the rest of the backward; the
+cross-replica weight-update sharding scheme (arXiv 2004.13336) extends
+the same idea to ZeRO — interleave each bucket's sharded optimizer
+update with its reduce-scatter.
+
+Design: the caller splits the model into K layer-group *segments* —
+``segments[k](params_k, carry) -> carry`` with the LAST segment
+returning the scalar loss — and :class:`OverlappedDataParallel` runs
+the forward through the chain capturing per-segment ``jax.vjp``
+closures, then walks the backward segment-by-segment IN REVERSE,
+emitting each ready bucket's collective (int8 / bf16 / fp32, planned
+with the same dtype-segregated ``plan_buckets`` the bucketed allreduce
+uses) before the earlier segments' backward is even traced. The
+resulting dataflow has an explicit dependency structure with NO barrier
+between buckets: bucket *i*'s psum depends only on segment *i*'s
+cotangents, never on segments that run after it, so XLA's
+latency-hiding scheduler is free to interleave the collectives with the
+remaining backward compute. The bucketed baseline cannot offer that:
+its ``message_size`` buckets span layer boundaries in FORWARD order, so
+a bucket only becomes ready when its earliest layer's gradient — the
+LAST one the backward produces — lands, which degenerates to "all
+collectives in one trailing block" (the ``overlap-serialization`` lint
+rule in apex_tpu.analysis is the static check that the overlapped step
+never regresses to that shape).
+
+Two perf mechanisms, stated honestly (docs/parallelism.md has the
+measured numbers):
+
+- on real multi-core/TPU backends the win is latency hiding — the
+  collectives execute concurrently with the backward;
+- on the 1-core CPU mesh this repo measures on, nothing runs
+  concurrently, so the win comes from eliminated work: the
+  error-feedback residual lives in the quantization block domain
+  (``[nblocks, block]``) as persistent carry state — no per-step
+  ``flatten``/``unflatten`` marshalling of a full-model fp32 tree — and
+  ``fold_average=True`` folds the ``1/world`` gradient averaging into
+  the per-block dequant scales (a ``[nblocks, 1]`` multiply instead of
+  a full-length divide pass).
+
+Telemetry: each backward segment opens a ``ddp_overlap_segment_<k>``
+span and each emitted bucket a ``ddp_overlap_bucket_<n>`` span, so the
+JSONL event stream shows the interleaved emission order (segment K-1,
+its buckets, segment K-2, ...) — ``tools/telemetry_report.py``'s
+``overlap`` kind renders it as a timeline. Spans around traced code
+measure trace time by design (telemetry/trace.py); the measured
+``comm_hidden_pct`` comes from the bench's step-time decomposition, not
+from the spans.
+
+Composition: the guard (``resilience.guarded_update``) keeps working —
+pass ``guard_flag=True`` and the LOCAL pre-compression non-finite flag
+is returned for the one scalar psum; the bucket-domain residual reverts
+wholesale on a skipped step like any other state pytree. ``numerics=``
+appends the same ``grads/*`` + ``synced/*`` stats dict the DDP knob
+produces. The step stays one compile under ``assert_no_recompiles`` —
+planning is host-side and deterministic in the shapes.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel import compression
+from apex_tpu.parallel.distributed import flatten, plan_buckets, unflatten
+from apex_tpu.telemetry import comm as _telemetry_comm
+from apex_tpu.telemetry import numerics as _numerics
+from apex_tpu.telemetry import trace as _telemetry_trace
+from apex_tpu.telemetry.registry import get_registry
+
+
+class Bucket(NamedTuple):
+    """One planned bucket: which leaves of its segment it coalesces
+    (``plan_buckets`` indices), the flat element count, and the int8
+    block-grid row count."""
+
+    leaf_idx: tuple
+    n: int
+    nblocks: int
+
+
+def plan_overlap(segment_params: Sequence[Any], *,
+                 message_size: int = 10000000,
+                 block_size: int = compression.BLOCK_SIZE):
+    """Host-side bucket plan: per segment, the same dtype-segregated
+    ``message_size``-capped grouping ``all_reduce_gradients_bucketed``
+    uses — but never spanning a segment boundary, so every bucket is
+    ready the moment its own segment's backward finishes. Returns a
+    tuple (per segment) of tuples of :class:`Bucket`."""
+    plan = []
+    for params in segment_params:
+        leaves = jax.tree_util.tree_leaves(params)
+        buckets = []
+        if leaves:
+            for idxs in plan_buckets(leaves, message_size):
+                n = int(sum(int(leaves[i].size) for i in idxs))
+                buckets.append(Bucket(tuple(idxs), n,
+                                      compression.num_blocks(n, block_size)))
+        plan.append(tuple(buckets))
+    return tuple(plan)
+
+
+class OverlappedDataParallel:
+    """DDP gradient sync restructured for backward/collective overlap.
+
+    Mirrors :class:`~apex_tpu.parallel.DistributedDataParallel`'s
+    reduction policy knobs (``gradient_average``,
+    ``gradient_predivide_factor``, ``compress``, ``message_size``,
+    ``numerics``) but consumes a SEGMENTED model instead of a grad
+    pytree: ``value_and_sync`` runs forward + backward itself so it can
+    emit each bucket's collective mid-backward.
+
+    ``fold_average=True`` (default) folds the ``1/world`` averaging into
+    the int8 dequant scales — fastest, one fp32 rounding per element vs
+    the baseline's divide-after order. Pass ``False`` for results
+    bit-identical to ``all_reduce_gradients_bucketed`` whenever the
+    bucket boundaries land on quantization-block boundaries (leaf sizes
+    multiples of ``compress_block_size``); ragged boundaries shift the
+    block grid, bounded by the documented per-block quantization error
+    either way.
+
+    ``guard_flag=True`` additionally returns the non-finite flag of the
+    LOCAL pre-compression gradients (an int8 psum can launder a
+    replica's NaN into finite wire garbage — same reasoning as
+    ``resilience.guarded_update``), ready for the guard's scalar psum.
+    """
+
+    def __init__(self, axis_name="dp", message_size: int = 10000000,
+                 compress: Optional[str] = None,
+                 compress_block_size: int = compression.BLOCK_SIZE,
+                 gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 fold_average: bool = True,
+                 guard_flag: bool = False,
+                 numerics=None):
+        if compress not in (None, "bf16", "int8"):
+            raise ValueError(f"unknown compression mode {compress!r}")
+        self.axis_name = axis_name
+        self.message_size = message_size
+        self.compress = compress
+        self.compress_block_size = compress_block_size
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.fold_average = fold_average
+        self.guard_flag = guard_flag
+        self.numerics = numerics
+
+    # -- planning / state ------------------------------------------------
+
+    def plan(self, segment_params):
+        return plan_overlap(segment_params,
+                            message_size=self.message_size,
+                            block_size=self.compress_block_size)
+
+    def init_residual(self, segment_params):
+        """Zero error-feedback state for ``compress="int8"`` — a tuple
+        (per segment) of tuples of ``[nblocks, block]`` fp32 zeros, the
+        PERSISTENT bucket-domain layout (donate it through the step;
+        no per-step flatten/unflatten of a leaf-shaped tree)."""
+        bs = self.compress_block_size
+        return tuple(
+            tuple(jnp.zeros((b.nblocks, bs), jnp.float32) for b in seg)
+            for seg in self.plan(segment_params))
+
+    def residual_to_tree(self, segment_params, residual):
+        """Bucket-domain residual -> leaf-shaped pytrees (one per
+        segment), zero pad tails stripped — the layout the non-overlap
+        paths carry, for parity checks and post-mortems."""
+        plan = self.plan(segment_params)
+        out = []
+        for params, seg_plan, seg_res in zip(segment_params, plan,
+                                             residual):
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            res_leaves = [None] * len(leaves)
+            for bucket, r2d in zip(seg_plan, seg_res):
+                flat = r2d.reshape(-1)[:bucket.n]
+                for i, piece in zip(
+                        bucket.leaf_idx,
+                        unflatten(flat, [leaves[i]
+                                         for i in bucket.leaf_idx])):
+                    res_leaves[i] = piece
+            out.append(jax.tree_util.tree_unflatten(treedef, res_leaves))
+        return out
+
+    # -- the per-bucket collective --------------------------------------
+
+    def _avg_divisor(self):
+        if not self.gradient_average:
+            return None
+        world = lax.axis_size(self.axis_name) \
+            if not isinstance(self.axis_name, (tuple, list)) else None
+        if world is None:
+            world = 1
+            for a in self.axis_name:
+                world *= lax.axis_size(a)
+        return world / self.gradient_predivide_factor
+
+    def _sync_flat(self, flat, res2d):
+        """One bucket's collective. Returns ``(synced flat fp32,
+        new_residual2d or None)`` — averaging policy applied, matching
+        ``_psum_with_policy``'s order of operations unless
+        ``fold_average`` moved the divide into the scales."""
+        orig_dtype = flat.dtype
+        if self.gradient_predivide_factor != 1.0:
+            flat = flat / self.gradient_predivide_factor
+        divisor = self._avg_divisor()
+        if self.compress == "int8":
+            x2d = compression.pad_to_blocks(flat, self.compress_block_size)
+            if res2d is not None:
+                x2d = x2d + res2d
+            if self.fold_average and divisor is not None:
+                out, err = compression.psum_compressed_blocks(
+                    x2d, self.axis_name, scale_mult=1.0 / divisor)
+                out = out[:flat.shape[0]]
+            else:
+                out, err = compression.psum_compressed_blocks(
+                    x2d, self.axis_name)
+                out = out[:flat.shape[0]]
+                if divisor is not None:
+                    out = out / divisor
+            return out.astype(orig_dtype), err
+        if self.compress == "bf16":
+            _telemetry_comm.record_collective(
+                "psum", elements=flat.size, dtype=jnp.bfloat16,
+                axis_name=self.axis_name, mode="bf16")
+            out = lax.psum(flat.astype(jnp.bfloat16),
+                           self.axis_name).astype(flat.dtype)
+        else:
+            _telemetry_comm.record_collective(
+                "psum", elements=flat.size, dtype=flat.dtype,
+                axis_name=self.axis_name)
+            out = lax.psum(flat, self.axis_name)
+        if divisor is not None:
+            out = out / divisor
+        return out.astype(orig_dtype), None
+
+    # -- the overlapped step --------------------------------------------
+
+    def value_and_sync(self, segments: Sequence[Callable],
+                       segment_params: Sequence[Any], x,
+                       residual=None):
+        """Forward through the segment chain, then segment-by-segment
+        backward with each ready bucket's collective emitted before the
+        earlier segments' backward.
+
+        ``segments[k](params_k, carry) -> carry``; the last segment
+        must return the scalar loss (close over labels/targets — they
+        are part of the same trace). Top-level leaf names should be
+        unique ACROSS segments when ``numerics`` grouping is on.
+
+        Returns, in order: ``loss``, ``synced`` (list of per-segment
+        grad pytrees, averaging policy applied), then ``new_residual``
+        (bucket-domain, iff ``compress="int8"``), then the local
+        non-finite ``flag`` (iff ``guard_flag``), then the ``stats``
+        dict (iff ``numerics``).
+        """
+        if len(segments) != len(segment_params):
+            raise ValueError(
+                f"{len(segments)} segment fns vs {len(segment_params)} "
+                f"param groups")
+        K = len(segments)
+        plan = self.plan(segment_params)
+        reg = get_registry()
+        if reg.enabled:
+            reg.event("overlap", "plan", segments=K,
+                      buckets=[len(s) for s in plan],
+                      compress=self.compress or "none",
+                      fold_average=bool(self.fold_average))
+        is_int8 = self.compress == "int8"
+        if is_int8 and residual is None:
+            residual = self.init_residual(segment_params)
+
+        carry = x
+        vjps = []
+        for k in range(K):
+            carry, vjp = jax.vjp(segments[k], segment_params[k], carry)
+            vjps.append(vjp)
+        loss = carry
+        if jnp.shape(loss) != ():
+            raise ValueError(
+                f"the last segment must return a scalar loss, got shape "
+                f"{jnp.shape(loss)}")
+
+        synced = [None] * K
+        new_res = [None] * K
+        local = [None] * K
+        ct = jnp.ones_like(loss)
+        seq = 0
+        bucket_no = sum(len(s) for s in plan)
+        for k in reversed(range(K)):
+            with _telemetry_trace.span(f"ddp_overlap_segment_{k}",
+                                       role="segment", segment=k,
+                                       seq=seq):
+                gk, ct = vjps[k](ct)
+            seq += 1
+            local[k] = gk
+            leaves, treedef = jax.tree_util.tree_flatten(gk)
+            out_leaves = list(leaves)
+            seg_res = []
+            # buckets numbered in EMISSION order: the last segment's
+            # buckets launch first, so walk the global counter backwards
+            bucket_no -= len(plan[k])
+            for bi, bucket in enumerate(plan[k]):
+                n = bucket_no + bi
+                with _telemetry_trace.span(f"ddp_overlap_bucket_{n}",
+                                           role="bucket", segment=k,
+                                           seq=seq,
+                                           elements=bucket.n):
+                    flat = flatten([leaves[i] for i in bucket.leaf_idx])
+                    r2d = residual[k][bi] if is_int8 else None
+                    out, err2d = self._sync_flat(flat, r2d)
+                    for i, piece in zip(
+                            bucket.leaf_idx,
+                            unflatten(out, [leaves[i]
+                                            for i in bucket.leaf_idx])):
+                        out_leaves[i] = piece
+                    seg_res.append(err2d)
+                seq += 1
+            synced[k] = jax.tree_util.tree_unflatten(treedef, out_leaves)
+            new_res[k] = tuple(seg_res)
+
+        outs = (loss, synced)
+        if is_int8:
+            outs = outs + (tuple(new_res),)
+        if self.guard_flag:
+            from apex_tpu.resilience.guard import nonfinite_flag
+
+            outs = outs + (nonfinite_flag(local),)
+        if self.numerics:
+            depth = (_numerics.default_prefix_depth()
+                     if self.numerics is True else int(self.numerics))
+            stats = {}
+            for k in range(K):
+                stats.update(_numerics.tree_stats(
+                    local[k], prefix_depth=depth, prefix="grads"))
+                stats.update(_numerics.tree_stats(
+                    synced[k], prefix_depth=depth, prefix="synced"))
+            outs = outs + (stats,)
+        return outs
+
+
+# ---------------------------------------------------------------------------
+# ZeRO: per-bucket reduce-scatter interleaved with the shard update
+# ---------------------------------------------------------------------------
+
+def overlapped_zero_step(segments: Sequence[Callable],
+                         segment_params: Sequence[Any], opt, state, x, *,
+                         lr=None, found_inf=None, scale: float = 1.0):
+    """The ZeRO analog of :meth:`OverlappedDataParallel.value_and_sync`:
+    segmented backward with each bucket's reduce-scatter AND its sharded
+    optimizer update (the cross-replica weight-update sharding of arXiv
+    2004.13336) emitted as soon as the segment's gradients are ready.
+
+    ``opt`` is a ``DistributedFusedAdam``/``DistributedFusedLAMB``
+    constructed with ``overlap=True``; ``state`` comes from
+    ``opt.init(segment_params)`` (the bucket plan is derived from the
+    same segment boundaries, so bucket *i*'s shard update is
+    data-dependent only on bucket *i*'s scattered grads). LAMB with
+    ``max_grad_norm > 0`` needs the GLOBAL grad norm before any update
+    — its scatters still interleave with the backward, but the (cheap,
+    scalar-joined) updates run after the walk; see
+    docs/parallelism.md's composition matrix.
+
+    Returns ``(loss, new_segment_params, new_state)`` (plus the stats
+    dict last when ``opt.numerics`` is set).
+    """
+    if not getattr(opt, "overlap", False):
+        raise ValueError("overlapped_zero_step needs an optimizer "
+                         "constructed with overlap=True")
+    K = len(segments)
+    if K != len(segment_params):
+        raise ValueError(
+            f"{K} segment fns vs {len(segment_params)} param groups")
+    plan = opt.overlap_plan(segment_params)
+    reg = get_registry()
+    if reg.enabled:
+        reg.event("overlap", "plan", segments=K,
+                  buckets=[len(s) for s in plan], zero=True,
+                  compress=opt.grad_compress or "none")
+
+    carry = x
+    vjps = []
+    for k in range(K):
+        carry, vjp = jax.vjp(segments[k], segment_params[k], carry)
+        vjps.append(vjp)
+    loss = carry
+    if jnp.shape(loss) != ():
+        raise ValueError(
+            f"the last segment must return a scalar loss, got shape "
+            f"{jnp.shape(loss)}")
+
+    noop = (jnp.zeros((), jnp.float32) if found_inf is None
+            else jnp.asarray(found_inf, jnp.float32))
+    step = state["step"] + jnp.where(noop > 0, 0, 1).astype(jnp.int32)
+    two_phase = opt.overlap_needs_global_norm
+    deferred = []          # (k, bi, n, g_shard, new_residual)
+    new_params = [None] * K
+    new_buckets = [list(seg) for seg in state["buckets"]]
+    stats = {} if opt.numerics else None
+
+    ct = jnp.ones_like(loss)
+    seq = 0
+    bucket_no = sum(len(s) for s in plan)
+    leaves_by_seg = [None] * K
+    treedefs = [None] * K
+    for k in reversed(range(K)):
+        with _telemetry_trace.span(f"ddp_overlap_segment_{k}",
+                                   role="segment", segment=k, seq=seq):
+            gk, ct = vjps[k](ct)
+        seq += 1
+        if stats is not None:
+            depth = (_numerics.default_prefix_depth()
+                     if opt.numerics is True else int(opt.numerics))
+            stats.update(_numerics.tree_stats(gk, prefix_depth=depth,
+                                              prefix="grads"))
+        g_leaves, treedef = jax.tree_util.tree_flatten(gk)
+        p_leaves = jax.tree_util.tree_leaves(segment_params[k])
+        leaves_by_seg[k] = p_leaves
+        treedefs[k] = treedef
+        bucket_no -= len(plan[k])
+        for bi, bucket in enumerate(plan[k]):
+            n = bucket_no + bi
+            bstate = state["buckets"][k][bi]
+            with _telemetry_trace.span(f"ddp_overlap_bucket_{n}",
+                                       role="bucket", segment=k,
+                                       seq=seq, elements=bucket.n,
+                                       zero=True):
+                flat_g = jnp.concatenate(
+                    [g_leaves[i].reshape(-1).astype(jnp.float32)
+                     for i in bucket.leaf_idx]) / scale
+                flat_g = jnp.pad(flat_g, (0, bucket.padded - bucket.n))
+                g_shard, new_residual = opt.bucket_reduce(flat_g, bstate)
+                if not two_phase:
+                    new_leaves, nb = opt.bucket_update_gather(
+                        g_shard, bstate, bucket,
+                        [p_leaves[i] for i in bucket.leaf_idx],
+                        lr=lr, step=step, noop=noop,
+                        new_residual=new_residual)
+                    for i, leaf in zip(bucket.leaf_idx, new_leaves):
+                        p_leaves[i] = leaf
+                    new_buckets[k][bi] = nb
+                else:
+                    deferred.append((k, bi, bucket, g_shard,
+                                     new_residual))
+            seq += 1
+
+    if two_phase:
+        clip = opt.overlap_global_clip(
+            [g for (_, _, _, g, _) in deferred])
+        for k, bi, bucket, g_shard, new_residual in deferred:
+            p_leaves = leaves_by_seg[k]
+            bstate = state["buckets"][k][bi]
+            new_leaves, nb = opt.bucket_update_gather(
+                g_shard, bstate, bucket,
+                [p_leaves[i] for i in bucket.leaf_idx],
+                lr=lr, step=step, noop=noop, clip=clip,
+                new_residual=new_residual)
+            for i, leaf in zip(bucket.leaf_idx, new_leaves):
+                p_leaves[i] = leaf
+            new_buckets[k][bi] = nb
+
+    for k in range(K):
+        new_params[k] = jax.tree_util.tree_unflatten(
+            treedefs[k], leaves_by_seg[k])
+    new_state = {"step": step,
+                 "buckets": tuple(tuple(seg) for seg in new_buckets)}
+    if stats is not None:
+        return loss, new_params, new_state, stats
+    return loss, new_params, new_state

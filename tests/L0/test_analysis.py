@@ -193,6 +193,65 @@ class TestSeededViolations:
         assert "while" in report.findings[0].message
 
     @pytest.mark.multi_device
+    def test_overlap_serialization_chained_collectives(self, dp_mesh):
+        """Bucket 2's psum artificially data-dependent on bucket 1's
+        result — the serialized chain the overlapped step must never
+        emit (ISSUE 10 satellite)."""
+        mesh = dp_mesh(8)
+
+        def chained(a, b):
+            s1 = jax.lax.psum(a, "dp")
+            s2 = jax.lax.psum(b + 0.0 * s1[0], "dp")
+            return s1, s2
+
+        sm = jax.shard_map(chained, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=(P(), P()), check_vma=False)
+        big = jnp.ones((1 << 18,), jnp.float32)  # 1 MiB payloads
+        report = lint_fn(jax.jit(sm), big, big,
+                         rules="overlap-serialization")
+        assert _rules_fired(report) == ["overlap-serialization"]
+        f = report.findings[0]
+        assert "depends on the result" in f.message
+        assert f.extra["upstream"] == 1
+
+    @pytest.mark.multi_device
+    def test_overlap_serialization_independent_buckets_clean(
+            self, dp_mesh):
+        mesh = dp_mesh(8)
+
+        def indep(a, b):
+            return jax.lax.psum(a, "dp"), jax.lax.psum(b, "dp")
+
+        sm = jax.shard_map(indep, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=(P(), P()), check_vma=False)
+        big = jnp.ones((1 << 18,), jnp.float32)
+        report = lint_fn(jax.jit(sm), big, big,
+                         rules="overlap-serialization")
+        assert report.ok, report.render()
+
+    @pytest.mark.multi_device
+    def test_overlap_serialization_threshold_gates_small_chains(
+            self, dp_mesh):
+        """The scalar guard-flag psum / per-block scale pmax pattern:
+        small collectives neither taint nor trip; dropping
+        ``overlap_min_bytes`` below them flips the verdict."""
+        mesh = dp_mesh(8)
+
+        def chained(a, b):
+            s1 = jax.lax.psum(a, "dp")
+            return s1, jax.lax.psum(b + 0.0 * s1[0], "dp")
+
+        sm = jax.shard_map(chained, mesh=mesh, in_specs=(P(), P()),
+                           out_specs=(P(), P()), check_vma=False)
+        small = jnp.ones((64,), jnp.float32)
+        assert lint_fn(jax.jit(sm), small, small,
+                       rules="overlap-serialization").ok
+        report = lint_fn(jax.jit(sm), small, small,
+                         rules="overlap-serialization",
+                         config=LintConfig(overlap_min_bytes=16))
+        assert _rules_fired(report) == ["overlap-serialization"]
+
+    @pytest.mark.multi_device
     def test_replication_blowup_output(self, dp_mesh):
         mesh = dp_mesh(8)
 

@@ -191,6 +191,58 @@ def test_lint_violations_gated_at_round14():
         assert any("non-negative integer" in m for m in msgs)
 
 
+def test_overlap_and_backend_fields_gated_at_round15():
+    """ISSUE 10 satellite: the overlap contract (overlap_segments /
+    comm_hidden_pct / baseline_step_ms on ddp_overlapped lines) and
+    the one-shot backend probe verdict are defined from round 15 —
+    overlap fields on older records are flagged, `backend` follows the
+    tolerate-on-live-lines discipline."""
+    base = {"metric": "gpt2_345m_tokens_per_sec_per_chip", "value": 1.0,
+            "unit": "tokens/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 10,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": None, "lint_violations": None}
+    # round 14: backend not yet required (tolerated when present with a
+    # sane value), overlap fields did not exist
+    assert schema.check_metric_line(dict(base), round_n=14,
+                                    errors=[]) == []
+    assert schema.check_metric_line(dict(base, backend="cpu-mesh"),
+                                    round_n=14, errors=[]) == []
+    msgs = schema.check_metric_line(dict(base, backend="gpu"),
+                                    round_n=14, errors=[])
+    assert any("backend" in m for m in msgs)
+    msgs = schema.check_metric_line(dict(base, comm_hidden_pct=40.0),
+                                    round_n=14, errors=[])
+    assert any("only defined" in m for m in msgs)
+    # round 15: backend required on every successful line
+    msgs = schema.check_metric_line(dict(base), round_n=15, errors=[])
+    assert any("backend" in m for m in msgs)
+    base15 = dict(base, backend="cpu-mesh")
+    assert schema.check_metric_line(dict(base15), round_n=15,
+                                    errors=[]) == []
+    for bogus in ("gpu", 3, True):
+        msgs = schema.check_metric_line(dict(base15, backend=bogus),
+                                        round_n=15, errors=[])
+        assert any("backend" in m for m in msgs)
+    # ddp_overlapped lines additionally need the overlap contract
+    ovl = dict(base15, metric="ddp_overlapped_int8_steps_per_sec")
+    msgs = schema.check_metric_line(dict(ovl), round_n=15, errors=[])
+    assert sum("ddp_overlapped line missing" in m for m in msgs) == 3
+    ovl.update(overlap_segments=4, comm_hidden_pct=47.7,
+               baseline_step_ms=690.0)
+    assert schema.check_metric_line(dict(ovl), round_n=15,
+                                    errors=[]) == []
+    # comm_hidden_pct is nullable (degenerate decomposition)
+    assert schema.check_metric_line(dict(ovl, comm_hidden_pct=None),
+                                    round_n=15, errors=[]) == []
+    # non-overlapped lines never need the overlap fields
+    assert schema.check_metric_line(dict(base15), round_n=15,
+                                    errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint
@@ -204,6 +256,8 @@ def test_live_emit_passes_current_schema(capsys):
     assert schema.check_metric_line(line, round_n=7, errors=[]) == []
     assert schema.check_metric_line(line, round_n=10, errors=[]) == []
     assert schema.check_metric_line(line, round_n=14, errors=[]) == []
+    assert schema.check_metric_line(line, round_n=15, errors=[]) == []
+    assert line["backend"] == "cpu-mesh"  # the tests' virtual mesh
     assert line["measured_comm_bytes_per_step"] is None  # none staged
     assert line["peak_hbm_bytes"] is None                # none staged
     assert line["compile_count"] is None                 # none staged

@@ -37,6 +37,12 @@ number ``n`` (old checked-in records stay valid):
   (the static HLO lint's finding count over the lowered step —
   apex_tpu.analysis; null means the bench ran without
   ``APEX_TPU_HLO_LINT=1``).
+- ``n >= 15``: successful metric lines must carry ``backend`` (the
+  one-shot probe verdict, ``"cpu-mesh"`` or ``"tpu"`` — which perf
+  series the line belongs to), and ``ddp_overlapped`` metric lines
+  must carry the overlap contract — ``overlap_segments``,
+  ``comm_hidden_pct`` and ``baseline_step_ms`` — next to their
+  steps/sec value.
 
 Usage::
 
@@ -108,6 +114,21 @@ RECOVERY_REQUIRED_FIELDS = ("restarts", "mttr_steps",
 # discipline as the memwatch fields (bench._emit always writes the
 # key, so older-round checks of live lines must tolerate it)
 LINT_FIELDS_SINCE_ROUND = 14
+# the overlapped-step capture contract (parallel/overlap.py, round 15):
+# a ddp_overlapped metric line must carry the measured overlap
+# accounting — segment count, the in-invocation bucketed-baseline step
+# time, and the % of baseline comm cost hidden — and EVERY successful
+# line must carry the one-shot backend probe verdict ("cpu-mesh" |
+# "tpu"), the field that makes the CPU-mesh numbers a first-class
+# tracked series; pre-round-15 records carrying the overlap fields are
+# flagged (they did not exist yet), while `backend` follows the
+# lint_violations discipline (bench._emit always writes it, so
+# older-round checks of live lines must tolerate it)
+OVERLAP_FIELDS_SINCE_ROUND = 15
+OVERLAP_METRIC_PREFIX = "ddp_overlapped"
+OVERLAP_REQUIRED_FIELDS = ("overlap_segments", "comm_hidden_pct",
+                           "baseline_step_ms")
+BACKEND_VERDICTS = ("cpu-mesh", "tpu")
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -241,6 +262,36 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"recovery field {key!r} must be numeric or "
                         f"null")
+        is_overlap = str(obj.get("metric", "")).startswith(
+            OVERLAP_METRIC_PREFIX)
+        present_overlap = [k for k in OVERLAP_REQUIRED_FIELDS
+                           if k in obj]
+        if present_overlap and (round_n is not None
+                                and round_n < OVERLAP_FIELDS_SINCE_ROUND):
+            bad(f"overlap fields {present_overlap} are only defined "
+                f"from round {OVERLAP_FIELDS_SINCE_ROUND}")
+        elif is_overlap and (round_n is None
+                             or round_n >= OVERLAP_FIELDS_SINCE_ROUND):
+            for key in OVERLAP_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"ddp_overlapped line missing {key!r} (required "
+                        f"since round {OVERLAP_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"overlap field {key!r} must be numeric or "
+                        f"null")
+        if round_n is None or round_n >= OVERLAP_FIELDS_SINCE_ROUND:
+            if "backend" not in obj:
+                bad(f"missing backend verdict (required since round "
+                    f"{OVERLAP_FIELDS_SINCE_ROUND})")
+            elif not (obj["backend"] is None
+                      or obj["backend"] in BACKEND_VERDICTS):
+                bad(f"backend verdict {obj['backend']!r} not in "
+                    f"{BACKEND_VERDICTS} (or null)")
+        elif "backend" in obj and not (
+                obj["backend"] is None
+                or obj["backend"] in BACKEND_VERDICTS):
+            bad(f"backend verdict {obj['backend']!r} not in "
+                f"{BACKEND_VERDICTS} (or null)")
         if round_n is None or round_n >= LINT_FIELDS_SINCE_ROUND:
             if "lint_violations" not in obj:
                 bad(f"missing lint field 'lint_violations' (required "

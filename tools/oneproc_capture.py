@@ -579,6 +579,86 @@ def _lint_smoke(bench):
             "lint_events": len(lint_events)}
 
 
+def _overlap_smoke(bench):
+    """Overlapped-step smoke (round 15): run ``ddp_overlapped`` at a
+    small size and assert (a) the overlapped step's measured time is
+    <= the bucketed int8 baseline measured in the same invocation (the
+    whole point of the config), with ``comm_hidden_pct`` present and
+    > 0, (b) the step stayed at exactly one compile, (c) the backend
+    verdict landed in the emitted JSON, and (d) the telemetry JSONL
+    carries INTERLEAVED ``ddp_overlap_segment_<k>`` /
+    ``ddp_overlap_bucket_<n>`` spans — at least one bucket span
+    strictly between two segment spans in stream order — plus the
+    ``overlap`` plan + summary events. Raises on any missing piece so
+    the stage shows up as ERROR rather than silently passing."""
+    import glob
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_overlap_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            ret = bench.bench_ddp_overlapped(8, 3)
+    finally:
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    if ret["overlapped_step_ms"] > ret["baseline_step_ms"]:
+        raise RuntimeError(
+            f"overlap smoke: overlapped step "
+            f"({ret['overlapped_step_ms']} ms) did not beat the "
+            f"bucketed baseline ({ret['baseline_step_ms']} ms)")
+    if not ret["comm_hidden_pct"] or ret["comm_hidden_pct"] <= 0:
+        raise RuntimeError(
+            f"overlap smoke: comm_hidden_pct == "
+            f"{ret['comm_hidden_pct']!r}, wanted > 0")
+    if parsed.get("compile_count") != 1:
+        raise RuntimeError(
+            f"overlap smoke: compile_count == "
+            f"{parsed.get('compile_count')!r}, wanted exactly 1")
+    if parsed.get("backend") not in ("cpu-mesh", "tpu"):
+        raise RuntimeError(
+            f"overlap smoke: backend verdict missing/bogus "
+            f"({parsed.get('backend')!r})")
+    events = []
+    for path in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(path) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    roles = [e.get("role") for e in events
+             if e["kind"] == "span"
+             and str(e.get("name", "")).startswith("ddp_overlap_")]
+    seg_pos = [i for i, r in enumerate(roles) if r == "segment"]
+    buckets_between = [i for i, r in enumerate(roles)
+                       if r == "bucket" and seg_pos
+                       and seg_pos[0] < i < seg_pos[-1]]
+    if len(seg_pos) < 2 or not buckets_between:
+        raise RuntimeError(
+            f"overlap smoke: segment/bucket spans not interleaved in "
+            f"the JSONL (roles: {roles})")
+    ov = [e for e in events if e["kind"] == "overlap"]
+    if not [e for e in ov if e.get("name") == "plan"]:
+        raise RuntimeError("overlap smoke: no overlap/plan event")
+    summaries = [e for e in ov if e.get("name") == "summary"]
+    if not summaries or summaries[-1].get("comm_hidden_pct") is None:
+        raise RuntimeError("overlap smoke: no overlap/summary event "
+                           "with comm_hidden_pct")
+    return {"telemetry_dir": tel_dir,
+            "baseline_step_ms": ret["baseline_step_ms"],
+            "overlapped_step_ms": ret["overlapped_step_ms"],
+            "comm_hidden_pct": ret["comm_hidden_pct"],
+            "overlap_segments": ret["overlap_segments"],
+            "backend": parsed.get("backend"),
+            "interleaved_bucket_spans": len(buckets_between)}
+
+
 def _recovery_smoke(bench):
     """Supervised-recovery smoke (round 13): run ``ddp_recovery`` (the
     all-in-one chaos acceptance — NaN escalation + synthetic OOM +
@@ -670,6 +750,7 @@ def _stages(smoke):
             ("serve_chaos", None, lambda: _serve_chaos_smoke(bench)),
             ("recovery", None, lambda: _recovery_smoke(bench)),
             ("lint", None, lambda: _lint_smoke(bench)),
+            ("overlap", None, lambda: _overlap_smoke(bench)),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -759,6 +840,14 @@ def _stages(smoke):
         # structured finding) — the hot-path invariants as a checkable
         # pass rather than string greps
         ("lint", None, lambda: _lint_smoke(bench)),
+        # round-15 overlapped-step captures: the ddp_overlapped config
+        # at bench size (baseline_step_ms vs overlapped step time at
+        # identical comm bytes, comm_hidden_pct, compile_count == 1,
+        # backend verdict) and the smoke proving the interleaved
+        # segment/bucket spans land in the JSONL with the overlapped
+        # step actually beating the bucketed baseline
+        ("ddp_overlapped", None, spec("ddp_overlapped")),
+        ("overlap", None, lambda: _overlap_smoke(bench)),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

@@ -40,8 +40,12 @@ def load_events(paths):
 KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
-    "compile", "memory", "serve", "recovery", "lint",
+    "compile", "memory", "serve", "recovery", "lint", "overlap",
 })
+
+# timeline rows kept per report — enough for dozens of segments/buckets
+# without letting a long capture balloon the aggregate
+_OVERLAP_TIMELINE_CAP = 256
 
 
 def aggregate(events):
@@ -69,6 +73,8 @@ def aggregate(events):
                 "last_run": None}
     lint = {"programs": {}, "violations": 0, "by_rule": {},
             "errors": 0}
+    overlap = {"plans": [], "summaries": [], "timeline": [],
+               "timeline_truncated": 0}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -78,12 +84,27 @@ def aggregate(events):
         kind = ev.get("kind")
         try:
             if kind == "span":
-                s = spans.setdefault(ev.get("name", "?"), {
+                name = ev.get("name", "?")
+                s = spans.setdefault(name, {
                     "count": 0, "total_s": 0.0, "max_s": 0.0})
                 d = float(ev.get("duration_s") or 0.0)
                 s["count"] += 1
                 s["total_s"] += d
                 s["max_s"] = max(s["max_s"], d)
+                if str(name).startswith("ddp_overlap_"):
+                    # the interleaved emission order IS the signal —
+                    # keep these spans as a stream-ordered timeline
+                    if len(overlap["timeline"]) < _OVERLAP_TIMELINE_CAP:
+                        overlap["timeline"].append({
+                            "name": name,
+                            "role": ev.get("role"),
+                            "segment": ev.get("segment"),
+                            "seq": ev.get("seq"),
+                            "elements": ev.get("elements"),
+                            "duration_s": d,
+                        })
+                    else:
+                        overlap["timeline_truncated"] += 1
             elif kind == "collective":
                 key = (ev.get("name", "?"), ev.get("dtype", "?"))
                 c = collectives.setdefault(key, {
@@ -241,6 +262,20 @@ def aggregate(events):
                     rule = str(ev.get("rule"))
                     lint["by_rule"][rule] = \
                         lint["by_rule"].get(rule, 0) + 1
+            elif kind == "overlap":
+                if ev.get("name") == "plan":
+                    overlap["plans"].append({
+                        "segments": ev.get("segments"),
+                        "buckets": ev.get("buckets"),
+                        "compress": ev.get("compress"),
+                        "zero": bool(ev.get("zero")),
+                    })
+                elif ev.get("name") == "summary":
+                    overlap["summaries"].append({
+                        k: ev.get(k) for k in (
+                            "segments", "buckets", "baseline_step_ms",
+                            "overlapped_step_ms", "compute_step_ms",
+                            "comm_hidden_pct")})
             elif kind in KNOWN_KINDS:
                 pass  # known but needs no aggregation (checkpoint, ...)
             else:
@@ -264,6 +299,7 @@ def aggregate(events):
         "serve": serve,
         "recovery": recovery,
         "lint": lint,
+        "overlap": overlap,
         "unknown_kinds": unknown,
         "malformed_events": malformed,
         "counters": (last_summary or {}).get("counters", {}),
@@ -469,6 +505,49 @@ def print_report(report, out=sys.stdout):
         if lint.get("errors"):
             w(f"  lint errors (pass crashed, not findings): "
               f"{lint['errors']}\n")
+    overlap = report.get("overlap") or {}
+    if overlap.get("timeline") or overlap.get("summaries") \
+            or overlap.get("plans"):
+        w("\noverlapped step (parallel/overlap.py):\n")
+        plans = overlap.get("plans") or []
+        if plans:
+            p = plans[-1]
+            w(f"  plan: {p.get('segments')} segment(s), buckets per "
+              f"segment {p.get('buckets')}, compress "
+              f"{p.get('compress')}"
+              + (" (zero)" if p.get("zero") else "") + "\n")
+        timeline = overlap.get("timeline") or []
+        if timeline:
+            w("  emission timeline (trace order — buckets between "
+              "segments = overlapped dependency structure):\n")
+            w(f"    {'#':>3} {'span':<28} {'role':<8} {'seg':>4} "
+              f"{'elements':>10} {'trace ms':>9}\n")
+            for i, row in enumerate(timeline):
+                w(f"    {i:>3} {row['name']:<28} "
+                  f"{str(row.get('role') or '?'):<8} "
+                  f"{str(row.get('segment') if row.get('segment') is not None else '?'):>4} "
+                  f"{str(row.get('elements') or ''):>10} "
+                  f"{row['duration_s']*1e3:>9.2f}\n")
+            if overlap.get("timeline_truncated"):
+                w(f"    ... {overlap['timeline_truncated']} more "
+                  f"row(s) truncated\n")
+            roles = [r.get("role") for r in timeline]
+            seg_pos = [i for i, r in enumerate(roles) if r == "segment"]
+            interleaved = any(
+                r == "bucket" and seg_pos and i > seg_pos[0]
+                and i < seg_pos[-1]
+                for i, r in enumerate(roles))
+            w(f"  interleaved: {'yes' if interleaved else 'NO'} "
+              f"(a bucket span between two segment spans)\n")
+        summaries = overlap.get("summaries") or []
+        if summaries:
+            s = summaries[-1]
+            hidden = s.get("comm_hidden_pct")
+            w(f"  measured: baseline {s.get('baseline_step_ms')} ms, "
+              f"overlapped {s.get('overlapped_step_ms')} ms, "
+              f"compute-only {s.get('compute_step_ms')} ms -> "
+              f"{hidden if hidden is not None else '?'}% of baseline "
+              f"comm cost hidden\n")
     unknown = report.get("unknown_kinds") or {}
     skipped = sum(unknown.values()) + report.get("malformed_events", 0)
     if skipped:
