@@ -66,6 +66,17 @@ keyed on the engine's lifetime decode-call counter):
 - :func:`request_storm` — a burst trace (every request arriving at
   the same tick) for admission-control drills: with a bounded pending
   queue the overflow must shed, not grow without bound.
+
+Fleet injectors (the ISSUE-11 chaos surface):
+
+- :func:`inject_replica_loss` — kill ONE serving replica at one fleet
+  step (``APEX_TPU_FAULT_PLAN="replica_loss@N:R"``): the fleet's
+  router polls :func:`replica_loss_for` each tick, drops the named
+  replica's engine, and must migrate its unfinished requests to
+  survivors (re-prefill from prompt + emitted tokens) — the
+  replica-level sibling of :func:`inject_device_loss`, keyed on the
+  fleet's lifetime step counter the way the serving injectors key on
+  the decode-call counter. One-shot: the respawned replica is clean.
 """
 
 import contextlib
@@ -97,6 +108,7 @@ PLAN_KINDS = {
     "slot_nan": "slot id to poison (default 0)",
     "ckpt_torn": None,
     "ckpt_fail": None,  # step field = number of failing writes
+    "replica_loss": "fleet replica index to kill (default 0)",
 }
 
 
@@ -605,6 +617,59 @@ def maybe_fail_decode(decode_step):
         f"decode failure at decode call {int(decode_step)} "
         f"(attempt {st['fired']}; faults.inject_decode_failure)",
         transient=st["transient"])
+
+
+_replica_loss_state = None   # {"replica", "step", "fired"}
+
+
+def arm_replica_loss(replica, step):
+    """Arm a one-shot replica loss: at fleet step ``step`` (the
+    fleet's lifetime step counter, 0-based) replica ``replica`` drops
+    dead — its engine becomes unusable and every unfinished request
+    must finish on a survivor. Returns the armed-state dict
+    (``"fired"`` counts firings). Overwrites any previous arming."""
+    global _replica_loss_state
+    _replica_loss_state = {"replica": int(replica), "step": int(step),
+                           "fired": 0}
+    return _replica_loss_state
+
+
+def disarm_replica_loss():
+    global _replica_loss_state
+    _replica_loss_state = None
+
+
+@contextlib.contextmanager
+def inject_replica_loss(replica, step):
+    """Context-manager form of :func:`arm_replica_loss`; disarms on
+    exit. Yields the state dict so tests can assert
+    ``state["fired"] == 1``."""
+    state = arm_replica_loss(replica, step)
+    try:
+        yield state
+    finally:
+        disarm_replica_loss()
+
+
+def replica_loss_for(fleet_step):
+    """The replica index to kill at fleet step ``fleet_step``, or None.
+
+    Polled by ``serving.fleet.ServeFleet.step`` every tick — the
+    replica-loss sibling of :func:`poison_slot_for`, keyed on the
+    fleet's lifetime step counter. One-shot: a matching call marks the
+    arming fired so the respawned replica comes up clean. Env arming
+    (``APEX_TPU_FAULT_PLAN="replica_loss@N:R"``) is read lazily on
+    first consult and follows the same one-shot contract."""
+    global _replica_loss_state
+    if _replica_loss_state is None and fault_plan().get("replica_loss"):
+        e = fault_plan().get("replica_loss")
+        _replica_loss_state = {"replica": int(e["arg"] or 0),
+                               "step": e["step"], "fired": 0}
+    st = _replica_loss_state
+    if not st or st["fired"] or int(fleet_step) != st["step"]:
+        return None
+    st["fired"] += 1
+    return st["replica"]
 
 
 def request_storm(n_requests, *, at_tick=0.0, seed=0,

@@ -21,6 +21,14 @@ implicated requests, and PreemptionGuard-driven graceful drain — all
 host-side policy, so every failure path holds
 ``assert_no_recompiles``.
 
+Fleet (:mod:`~apex_tpu.serving.fleet`): a host-side router over N
+engines on distinct mesh slices — load-aware dispatch, per-tier SLOs
+(``Request.tier`` -> tier-default deadlines), a replica health state
+machine (healthy -> degraded -> quarantined -> respawning) with
+drain + request migration (re-prefill from prompt + emitted tokens;
+greedy continuations are token-identical), and elastic
+scale-up/down driven by sustained pending depth.
+
 Quickstart (docs/serving.md has the full tour)::
 
     from apex_tpu.serving import (RobustConfig, ServeConfig,
@@ -34,6 +42,15 @@ Quickstart (docs/serving.md has the full tour)::
 """
 
 from apex_tpu.serving.engine import ServeConfig, ServeEngine  # noqa: F401
+from apex_tpu.serving.fleet import (  # noqa: F401
+    DEFAULT_TIERS,
+    FleetConfig,
+    Replica,
+    ServeFleet,
+    TierConfig,
+    TIERS,
+    diurnal_trace,
+)
 from apex_tpu.serving.kv_cache import (  # noqa: F401
     KVCacheSpec,
     row_template,

@@ -89,7 +89,7 @@ class ServeEngine:
     """
 
     def __init__(self, model, params, config: ServeConfig = None, *,
-                 mesh=None, watcher=None, registry=None):
+                 mesh=None, watcher=None, registry=None, name=None):
         from apex_tpu.transformer.parallel_state import (
             get_tensor_model_parallel_world_size,
         )
@@ -122,6 +122,14 @@ class ServeEngine:
         self.model = model
         self.config = dataclasses.replace(config, batch_buckets=bb,
                                           prefill_buckets=sb)
+        # ``name`` prefixes every AOT registration with the compile
+        # watcher: two fleet replicas compile the same ladder with
+        # DIFFERENT NamedShardings (distinct device slices), so without
+        # distinct names the second registration would be flagged as a
+        # signature-diffed recompile — and a respawned replica must use
+        # a fresh name for the same reason (serving.fleet appends the
+        # generation).
+        self.name = name
         self.mesh = mesh
         self.max_len = limit
         self._watcher = watcher if watcher is not None \
@@ -161,6 +169,7 @@ class ServeEngine:
         self._prefill_exec = {}
         self.aot_compile_seconds = 0.0
         decode_lowered = None
+        aot = f"{name}/serve" if name else "serve"
         with tmemory.oom_guard(registry=registry, labels=labels):
             for b in self.config.batch_buckets:
                 args = (self._store, self._params,
@@ -171,7 +180,7 @@ class ServeEngine:
                     donate_argnums=(0,) if config.donate else ()
                 ).lower(*args)
                 self._decode_exec[b] = self._compile(
-                    lowered, f"serve/{config.cache_mode}/decode_b{b}", args)
+                    lowered, f"{aot}/{config.cache_mode}/decode_b{b}", args)
                 decode_lowered = lowered
                 for s in self.config.prefill_buckets:
                     pargs = (self._store, self._params,
@@ -183,7 +192,7 @@ class ServeEngine:
                         donate_argnums=(0,) if config.donate else ()
                     ).lower(*pargs)
                     self._prefill_exec[(b, s)] = self._compile(
-                        plow, f"serve/{config.cache_mode}/prefill_b{b}_s{s}", pargs)
+                        plow, f"{aot}/{config.cache_mode}/prefill_b{b}_s{s}", pargs)
         if config.temperature:
             # warm the host-side PRNG fold so the first sampled step
             # inside an assert_no_recompiles window compiles nothing
@@ -213,6 +222,7 @@ class ServeEngine:
             reg.gauge("serve/kv_cache_bytes").set(self.kv_cache_bytes())
             reg.counter("serve/aot_compiles").inc(self.compile_count)
             reg.event("serve", "engine_start",
+                      engine=name,
                       batch_buckets=list(self.config.batch_buckets),
                       prefill_buckets=list(self.config.prefill_buckets),
                       num_slots=config.num_slots,

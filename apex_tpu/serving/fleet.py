@@ -1,0 +1,1025 @@
+"""Multi-replica serving fleet: router, SLO tiers, quarantine/respawn,
+elastic autoscaling.
+
+One :class:`~apex_tpu.serving.engine.ServeEngine` is one replica; the
+ROADMAP's "millions of users" need a fleet. :class:`ServeFleet` is a
+host-side router over N engines on distinct mesh slices
+(``jax.devices()`` partitioned ``max_replicas`` ways, e.g. 2 replicas
+x 4 devices, each with its own ``NamedSharding`` over its own slice)
+— the PR-7/8 survive-by-moving-state discipline lifted one level up:
+requests and their emitted tokens must outlive any single replica.
+
+**Dispatch** is load-aware and host-side: each fleet tick routes every
+eligible queued request to the serving replica with the most free
+slots (ties broken toward the shortest backlog), bounded by a
+per-replica queue-depth cap so one replica never hoards the backlog
+while another idles. Migrated requests and ``interactive``-tier
+requests jump the dispatch order.
+
+**SLO tiers** (:class:`TierConfig`): ``Request.tier`` in
+``{"interactive", "batch"}`` maps to tier-default TTFT/total-deadline
+budgets — the PR-7 per-request deadline machinery, filled in at fleet
+admission — with per-tier p50/p99 TTFT accounting
+(``fleet/ttft_<tier>`` histograms + the per-tier rollup in
+:meth:`ServeFleet.stats`).
+
+**Replica health** is a per-replica state machine::
+
+    healthy --bad counters--> degraded --more--> quarantined
+       ^                                             |
+       |                                      drain + migrate
+       +----------- respawning <---------------------+
+
+driven by the replica scheduler's existing
+:class:`~apex_tpu.serving.robust.ServeHealth` counters (quarantined
+slots, failed requests, decode failures; an ``all_slots_nonfinite``
+or a raised NonFiniteError quarantines immediately) plus the
+:func:`~apex_tpu.resilience.faults.inject_replica_loss` fault (the
+hard-loss drill: the engine drops dead mid-trace). A soft-quarantined
+replica is drained via ``Scheduler.drain()`` — its queue migrates
+immediately, in-flight slots finish inside the drain window — while a
+lost replica migrates everything at once. **Migration** re-admits each
+unfinished request as a continuation: re-prefill from the original
+prompt plus the tokens already emitted; because the engine's
+``cache_index`` rollback makes a right-padded prefill equivalent to
+having decoded the same prefix, resumed greedy decode is
+token-identical to an unkilled run (the e2e acceptance pins it; for
+sampled decode the RNG stream differs — see docs/serving.md).
+A respawned replica builds a fresh engine on the same device slice and
+re-registers its AOT ladder with the CompileWatcher under a fresh
+generation name (same ladder + new name = zero false recompiles).
+
+**Elastic scale**: total pending depth (fleet queue + replica
+backlogs) sustained above ``scale_up_pending`` for
+``scale_sustain_ticks`` spawns a replica into an idle slot; sustained
+at/below ``scale_down_pending`` retires the least-loaded replica with
+a graceful drain (queue re-routed, in-flight finishes, then the
+engine is dropped).
+
+Everything here is host-side policy over the PR-6/7 machinery —
+nothing traces or compiles outside engine (re)spawns, so per-replica
+``assert_no_recompiles`` holds across any traffic and any fault.
+Telemetry lands under ``fleet/*`` (docs/serving.md has the glossary);
+``bench.py serve_fleet`` is the packaged chaos proof.
+"""
+
+import dataclasses
+import time
+import warnings
+from typing import List, Mapping, Optional
+
+import numpy as np
+
+from apex_tpu.serving import robust as robust_mod
+from apex_tpu.serving.scheduler import CompletedRequest, Request, Scheduler
+from apex_tpu.telemetry.registry import get_registry
+
+TIERS = ("interactive", "batch")
+
+#: replica lifecycle states (the FleetHealth state machine; "idle" is a
+#: slot with no engine — never spawned, retired, or awaiting respawn)
+REPLICA_STATES = ("idle", "healthy", "degraded", "quarantined",
+                  "respawning", "retiring")
+
+
+@dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """Per-tier SLO defaults, filled into a request's
+    ``ttft_deadline_s`` / ``total_deadline_s`` at fleet admission
+    unless the request already carries its own override (the PR-7
+    deadline machinery does the enforcement)."""
+
+    ttft_deadline_s: Optional[float] = None
+    total_deadline_s: Optional[float] = None
+
+
+#: the default tier table: interactive traffic carries tight budgets,
+#: batch tolerates queueing (no TTFT budget) but not unbounded total
+DEFAULT_TIERS = {
+    "interactive": TierConfig(ttft_deadline_s=30.0,
+                              total_deadline_s=120.0),
+    "batch": TierConfig(ttft_deadline_s=None, total_deadline_s=600.0),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet topology, health thresholds, and elastic-scale policy.
+
+    ``num_replicas`` spawn at startup; ``max_replicas`` (0 = same)
+    bounds scale-up — device slices are partitioned for the max up
+    front, so a scale-up never re-shards a serving replica.
+    ``devices_per_replica=0`` auto-partitions ``jax.devices()`` evenly
+    over ``max_replicas`` (a host with too few devices falls back to
+    meshless replicas sharing the default device — the 1-core CPU
+    smoke path)."""
+
+    num_replicas: int = 2
+    max_replicas: int = 0               # 0 = num_replicas
+    min_replicas: int = 1
+    devices_per_replica: int = 0        # 0 = auto-partition
+    tiers: Optional[Mapping[str, TierConfig]] = None
+    default_tier: str = "interactive"
+    robust: Optional[robust_mod.RobustConfig] = None
+    replica_queue_depth: int = 0        # 0 = the engine's num_slots
+    # health: bad-counter score thresholds (quarantined + failed +
+    # decode_failures deltas accumulate; all_slots_nonfinite or a
+    # NonFiniteError quarantines immediately)
+    degraded_after: int = 1
+    quarantine_after: int = 3
+    recover_after_ticks: int = 5        # clean ticks: degraded -> healthy
+    respawn: bool = True
+    respawn_delay_ticks: int = 1
+    drain_deadline_s: float = 30.0      # soft-quarantine / retire grace
+    # elastic scale (None disables the direction)
+    scale_up_pending: Optional[int] = None
+    scale_down_pending: Optional[int] = None
+    scale_sustain_ticks: int = 3
+    data_axis: str = "data"
+
+    def __post_init__(self):
+        if self.num_replicas < 1:
+            raise ValueError(
+                f"num_replicas ({self.num_replicas}) must be >= 1")
+        maxr = self.max_replicas or self.num_replicas
+        if maxr < self.num_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) < num_replicas "
+                f"({self.num_replicas})")
+        if not (1 <= self.min_replicas <= self.num_replicas):
+            raise ValueError(
+                f"min_replicas ({self.min_replicas}) must be in "
+                f"[1, num_replicas]")
+        for tier in (self.tiers or {}):
+            if tier not in TIERS:
+                raise ValueError(f"unknown tier {tier!r}; tiers are "
+                                 f"{TIERS}")
+        tiers = dict(DEFAULT_TIERS, **(self.tiers or {}))
+        if self.default_tier not in tiers:
+            raise ValueError(
+                f"default_tier {self.default_tier!r} not in "
+                f"{tuple(tiers)}")
+        if self.degraded_after < 1 or self.quarantine_after < 1:
+            raise ValueError("health thresholds must be >= 1")
+        if self.quarantine_after < self.degraded_after:
+            raise ValueError(
+                f"quarantine_after ({self.quarantine_after}) < "
+                f"degraded_after ({self.degraded_after})")
+        if (self.scale_up_pending is not None
+                and self.scale_down_pending is not None
+                and self.scale_down_pending >= self.scale_up_pending):
+            raise ValueError(
+                f"scale_down_pending ({self.scale_down_pending}) must "
+                f"be < scale_up_pending ({self.scale_up_pending}) — "
+                f"overlapping thresholds oscillate")
+        if self.scale_sustain_ticks < 1:
+            raise ValueError("scale_sustain_ticks must be >= 1")
+
+    @property
+    def resolved_max_replicas(self):
+        return self.max_replicas or self.num_replicas
+
+
+def diurnal_trace(n_requests=32, *, seed=0, period=16.0,
+                  base_interarrival=1.0, amplitude=0.6,
+                  burst_at=None, burst_n=0, batch_every=4,
+                  prompt_lens=(4, 8, 12), max_new=(6, 10),
+                  vocab_size=256):
+    """Deterministic diurnal + burst many-user trace: inter-arrival
+    gaps are exponential with a sinusoidally modulated rate (virtual
+    decode ticks — period ``period`` ticks, peak rate ``1+amplitude``
+    times the trough's), optionally with ``burst_n`` extra requests
+    all arriving at tick ``burst_at`` (the flash-crowd leg). Every
+    ``batch_every``-th request is ``tier="batch"``, the rest
+    ``"interactive"`` — the ``serve_fleet`` bench workload. Same seed,
+    same trace, byte for byte."""
+    rs = np.random.RandomState(seed)
+    out = []
+    t = 0.0
+    for i in range(int(n_requests)):
+        rate = 1.0 + float(amplitude) * np.sin(
+            2.0 * np.pi * t / float(period))
+        t += float(rs.exponential(
+            float(base_interarrival) / max(rate, 1e-3)))
+        plen = int(rs.choice(prompt_lens))
+        out.append(Request(
+            rid=i,
+            prompt=rs.randint(0, vocab_size, size=plen).astype(np.int32),
+            max_new_tokens=int(rs.choice(max_new)),
+            arrival=t,
+            tier="batch" if batch_every and (i % batch_every
+                                             == batch_every - 1)
+            else "interactive"))
+    if burst_at is not None and burst_n:
+        for j in range(int(burst_n)):
+            plen = int(rs.choice(prompt_lens))
+            out.append(Request(
+                rid=int(n_requests) + j,
+                prompt=rs.randint(0, vocab_size,
+                                  size=plen).astype(np.int32),
+                max_new_tokens=int(rs.choice(max_new)),
+                arrival=float(burst_at), tier="interactive"))
+    out.sort(key=lambda r: (r.arrival, r.rid))
+    if out:
+        first = out[0].arrival
+        for r in out:
+            r.arrival -= first
+    return out
+
+
+class Replica:
+    """One fleet slot: a device slice plus (when spawned) an engine
+    and its scheduler. The fleet owns the state transitions; the
+    replica just carries the bookkeeping."""
+
+    def __init__(self, idx, devices=None, mesh=None):
+        self.idx = int(idx)
+        self.devices = devices
+        self.mesh = mesh
+        self.state = "idle"
+        self.engine = None
+        self.sched = None
+        self.generation = 0          # spawn count -> fresh AOT names
+        self.dispatched = 0
+        self.completed = 0
+        self.evicted = 0             # poisoned-slot evictions observed
+        self.respawns = 0
+        self.respawn_at = None       # fleet step to respawn at
+        self.spawn_seconds = 0.0
+        self._health_seen = {}
+        self._bad_score = 0
+        self._clean_ticks = 0
+        self._drain_started_wall = None
+
+    def serving(self):
+        return self.state in ("healthy", "degraded")
+
+    def busy(self):
+        return self.sched is not None and (self.sched.pending
+                                           or self.sched.active)
+
+    def table_row(self):
+        return {
+            "replica": self.idx,
+            "state": self.state,
+            "generation": self.generation,
+            "dispatched": self.dispatched,
+            "completed": self.completed,
+            "evicted": self.evicted,
+            "respawns": self.respawns,
+            "compile_count": getattr(self.engine, "compile_count", None),
+        }
+
+
+class ServeFleet:
+    """Host-side router over N :class:`ServeEngine` replicas.
+
+    ``engine_factory(replica_idx, mesh, name) -> engine`` overrides
+    engine construction (stub engines in the policy tests; the default
+    builds a :class:`~apex_tpu.serving.engine.ServeEngine` from
+    ``model``/``params``/``serve_config`` on the replica's mesh slice,
+    AOT ladder registered with the shared ``watcher`` under ``name``).
+
+    Time follows the scheduler's two-face convention: arrivals are
+    virtual (fleet ticks), latencies wall-clock. Fleet-level TTFT is
+    honest end-to-end — time queued at the fleet router counts on top
+    of the replica-level eligible->first-token measurement.
+    """
+
+    def __init__(self, model=None, params=None, serve_config=None,
+                 config: FleetConfig = None, *, engine_factory=None,
+                 registry=None, watcher=None,
+                 clock=time.perf_counter):
+        self.config = config or FleetConfig()
+        if engine_factory is None and (model is None or params is None):
+            raise ValueError("ServeFleet needs model+params (default "
+                             "engine factory) or an engine_factory")
+        self._model = model
+        self._params = params
+        self._serve_config = serve_config
+        self._factory = engine_factory or self._default_factory
+        self._registry = registry
+        self._watcher = watcher
+        self._clock = clock
+        self.tiers = dict(DEFAULT_TIERS, **(self.config.tiers or {}))
+        self._robust = self.config.robust or robust_mod.RobustConfig()
+        self.max_replicas = self.config.resolved_max_replicas
+
+        self.replicas: List[Replica] = [
+            Replica(i, devs, mesh) for i, (devs, mesh) in
+            enumerate(self._partition_devices(self.max_replicas))]
+        self.pending: List[Request] = []
+        self.completed: List[CompletedRequest] = []
+        self.rejected = []           # fleet-level RejectedRequest list
+        self.tick = 0.0
+        self.step_count = 0          # lifetime counter (fault keying)
+        self.quarantine_count = 0
+        self.respawn_count = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.lost_requests = 0
+        self.migrated_rids = set()
+        self.rebalance_ms: List[float] = []
+        self._rebalance = None       # {"t0": wall, "rids": set}
+        self._rid_info = {}
+        self._tier_stats = {
+            t: {"requests": 0, "ok": 0, "goodput_tokens": 0,
+                "ttft_ms": []} for t in self.tiers}
+        self._above = 0
+        self._below = 0
+        self._t_start = None
+        self._t_end = None
+
+        for i in range(self.config.num_replicas):
+            self._spawn(self.replicas[i], reason="startup")
+        reg = self._reg()
+        if reg.enabled:
+            reg.event(
+                "fleet", "fleet_start",
+                replicas=self.config.num_replicas,
+                max_replicas=self.max_replicas,
+                devices_per_replica=(
+                    len(self.replicas[0].devices)
+                    if self.replicas[0].devices else 0),
+                tiers={t: dataclasses.asdict(tc)
+                       for t, tc in self.tiers.items()})
+
+    # -- construction -------------------------------------------------------
+
+    def _reg(self):
+        return self._registry or get_registry()
+
+    def _default_factory(self, idx, mesh, name):
+        from apex_tpu.serving.engine import ServeEngine
+
+        return ServeEngine(self._model, self._params,
+                           self._serve_config, mesh=mesh,
+                           watcher=self._watcher,
+                           registry=self._registry, name=name)
+
+    def _partition_devices(self, n_replicas):
+        """Slice ``jax.devices()`` into ``n_replicas`` distinct mesh
+        slices (each replica's data axis spans only its own devices).
+        Falls back to meshless shared-device replicas when the host
+        has too few devices — the CPU smoke path."""
+        import jax
+
+        devices = jax.devices()
+        dpr = self.config.devices_per_replica
+        if dpr == 0 and len(devices) >= n_replicas:
+            dpr = len(devices) // n_replicas
+        if dpr < 1 or len(devices) < n_replicas * dpr:
+            return [(None, None)] * n_replicas
+        from jax.sharding import Mesh
+
+        slices = []
+        for i in range(n_replicas):
+            devs = tuple(devices[i * dpr:(i + 1) * dpr])
+            slices.append((devs, Mesh(np.asarray(devs),
+                                      (self.config.data_axis,))))
+        return slices
+
+    def _spawn(self, rep, reason):
+        """Build a fresh engine + scheduler into a replica slot. A
+        respawn gets a new generation suffix so its AOT ladder
+        re-registers under fresh watcher names (same signatures under
+        the old names would be flagged as recompiles)."""
+        t0 = self._clock()
+        name = (f"replica{rep.idx}" if rep.generation == 0
+                else f"replica{rep.idx}.g{rep.generation}")
+        rep.engine = self._factory(rep.idx, rep.mesh, name)
+        rep.sched = Scheduler(rep.engine, registry=self._registry,
+                              robust=self._robust, clock=self._clock)
+        rep.generation += 1
+        rep.respawn_at = None
+        rep.spawn_seconds = self._clock() - t0
+        rep._health_seen = dict(rep.sched.health.snapshot())
+        rep._bad_score = 0
+        rep._clean_ticks = 0
+        rep._drain_started_wall = None
+        if reason == "respawn":
+            rep.respawns += 1
+            self.respawn_count += 1
+            self._reg().counter("fleet/respawns").inc()
+            self._reg().event(
+                "fleet", "respawn", replica=rep.idx,
+                generation=rep.generation,
+                spawn_s=round(rep.spawn_seconds, 4),
+                compile_count=getattr(rep.engine, "compile_count",
+                                      None),
+                tick=self.tick)
+        self._set_state(rep, "healthy", reason)
+
+    def _set_state(self, rep, state, reason):
+        if state == rep.state:
+            return
+        old = rep.state
+        rep.state = state
+        reg = self._reg()
+        reg.event("fleet", "replica_state", replica=rep.idx,
+                  old=old, new=state, reason=reason, tick=self.tick)
+        if state == "quarantined":
+            self.quarantine_count += 1
+            reg.counter("fleet/replicas_quarantined").inc()
+
+    # -- admission ----------------------------------------------------------
+
+    def _fleet_reject(self, request, reason, detail=""):
+        rec = robust_mod.RejectedRequest(
+            rid=request.rid, reason=reason, tick=self.tick,
+            prompt_len=len(request.prompt), detail=detail)
+        self.rejected.append(rec)
+        reg = self._reg()
+        reg.counter("fleet/rejected").inc()
+        reg.event("fleet", "rejected", rid=request.rid, reason=reason,
+                  tick=self.tick, detail=detail)
+        return False
+
+    def submit(self, request: Request):
+        """Queue a request at the fleet router. Resolves the tier into
+        the PR-7 deadline fields (request-level overrides win) and
+        records the tier for the per-tier SLO rollup. Returns False —
+        with a ``fleet``/``rejected`` event — on an unknown tier or a
+        duplicate rid; replica-level shape rejections surface later at
+        dispatch."""
+        tier = request.tier or self.config.default_tier
+        if tier not in self.tiers:
+            return self._fleet_reject(
+                request, "unknown_tier",
+                f"tier {tier!r} not in {tuple(self.tiers)}")
+        if request.rid in self._rid_info:
+            return self._fleet_reject(
+                request, "duplicate_rid",
+                f"rid {request.rid} is already tracked by this fleet")
+        tc = self.tiers[tier]
+        req = dataclasses.replace(
+            request, tier=tier,
+            ttft_deadline_s=(request.ttft_deadline_s
+                             if request.ttft_deadline_s is not None
+                             else tc.ttft_deadline_s),
+            total_deadline_s=(request.total_deadline_s
+                              if request.total_deadline_s is not None
+                              else tc.total_deadline_s))
+        self._rid_info[req.rid] = {
+            "tier": tier, "orig": req, "base_tokens": [],
+            "base_ttft": float("nan"), "base_latencies": [],
+            "eligible_wall": None, "wait_s": 0.0, "migrations": 0,
+            "replica": None, "done": False,
+        }
+        self.pending.append(req)
+        self.pending.sort(key=lambda r: (r.arrival, r.rid))
+        self._reg().counter("fleet/submitted").inc()
+        return True
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _queue_cap(self, rep):
+        if self.config.replica_queue_depth:
+            return self.config.replica_queue_depth
+        return getattr(rep.engine.config, "num_slots", 8)
+
+    def _pick_replica(self):
+        """Load-aware choice: the serving replica with the most free
+        slots, ties toward the shortest backlog, healthy before
+        degraded; None when every replica is at capacity (its free
+        slots plus the queue-depth cap) — the backlog then waits at
+        the fleet, where the autoscale thresholds can see it."""
+        best, best_score = None, None
+        for rep in self.replicas:
+            if not rep.serving():
+                continue
+            backlog = len(rep.sched.pending)
+            if backlog >= self._queue_cap(rep) + len(rep.sched.free):
+                continue
+            score = (len(rep.sched.free), -backlog,
+                     rep.state == "healthy", -rep.dispatched)
+            if best is None or score > best_score:
+                best, best_score = rep, score
+        return best
+
+    def _dispatch(self):
+        now = self._clock()
+        eligible = [r for r in self.pending if r.arrival <= self.tick]
+        for r in eligible:
+            info = self._rid_info[r.rid]
+            if info["eligible_wall"] is None:
+                info["eligible_wall"] = now
+
+        def prio(r):
+            info = self._rid_info[r.rid]
+            return (0 if info["migrations"] else 1,
+                    0 if info["tier"] == "interactive" else 1,
+                    r.arrival, r.rid)
+
+        for r in sorted(eligible, key=prio):
+            rep = self._pick_replica()
+            if rep is None:
+                break                # no capacity; autoscale sees it
+            ok = rep.sched.submit(
+                dataclasses.replace(r, arrival=rep.sched.tick))
+            if not ok:
+                reason = (rep.sched.rejected[-1].reason
+                          if rep.sched.rejected else "rejected")
+                if reason in ("prompt_too_long", "budget_too_long"):
+                    # an impossible shape is impossible everywhere:
+                    # reject at the fleet, don't retry forever
+                    self.pending.remove(r)
+                    self._rid_info[r.rid]["done"] = True
+                    self._fleet_reject(r, reason)
+                continue             # transient (full queue): next tick
+            self.pending.remove(r)
+            info = self._rid_info[r.rid]
+            info["wait_s"] += now - info["eligible_wall"]
+            info["eligible_wall"] = None
+            info["replica"] = rep.idx
+            rep.dispatched += 1
+            self._reg().counter("fleet/dispatched").inc()
+            if self._rebalance and r.rid in self._rebalance["rids"]:
+                self._rebalance["rids"].discard(r.rid)
+                if not self._rebalance["rids"]:
+                    self._finish_rebalance()
+
+    def _finish_rebalance(self):
+        dt_ms = (self._clock() - self._rebalance["t0"]) * 1e3
+        self.rebalance_ms.append(dt_ms)
+        reg = self._reg()
+        reg.event("fleet", "rebalance", latency_ms=round(dt_ms, 3),
+                  tick=self.tick)
+        reg.gauge("fleet/rebalance_latency_ms").set(dt_ms)
+        self._rebalance = None
+
+    # -- completion & tier accounting ---------------------------------------
+
+    def _collect(self, rep):
+        while rep.sched.completed:
+            c = rep.sched.completed.pop(0)
+            rep.completed += 1
+            if c.finish_reason == "poisoned":
+                rep.evicted += 1
+            info = self._rid_info.get(c.rid)
+            if info is None:         # a request the fleet never routed
+                self.completed.append(c)
+                continue
+            tokens = info["base_tokens"] + list(c.tokens)
+            if np.isfinite(info["base_ttft"]):
+                ttft = info["base_ttft"]
+            elif np.isfinite(c.ttft_s):
+                ttft = info["wait_s"] + c.ttft_s
+            else:
+                ttft = float("nan")
+            self._complete(c.rid, tokens=tokens,
+                           reason=c.finish_reason, ttft_s=ttft,
+                           mean_lat=c.mean_tok_latency_s)
+
+    def _complete(self, rid, *, tokens, reason, ttft_s,
+                  mean_lat=0.0):
+        info = self._rid_info[rid]
+        info["done"] = True
+        rec = CompletedRequest(
+            rid=rid, tokens=np.asarray(list(tokens), np.int32),
+            ttft_s=float(ttft_s),
+            mean_tok_latency_s=float(mean_lat), finish_reason=reason)
+        self.completed.append(rec)
+        ts = self._tier_stats[info["tier"]]
+        ts["requests"] += 1
+        if reason in robust_mod.OK_STATUSES:
+            ts["ok"] += 1
+            ts["goodput_tokens"] += len(rec.tokens)
+        reg = self._reg()
+        if np.isfinite(rec.ttft_s):
+            ts["ttft_ms"].append(rec.ttft_s * 1e3)
+            reg.histogram(f"fleet/ttft_{info['tier']}").observe(
+                rec.ttft_s * 1e3)
+        return rec
+
+    # -- quarantine, loss & migration ---------------------------------------
+
+    def _lose_replica(self, rep, reason="replica_loss"):
+        """Hard loss: the engine is gone — migrate EVERYTHING now,
+        then count down to respawn."""
+        self._collect(rep)
+        t0 = self._clock()
+        records = rep.sched.extract_unfinished(reason=reason)
+        self._set_state(rep, "quarantined", reason)
+        self._migrate(rep, records, t0, reason=reason)
+        self._drop_engine(rep)
+        self._schedule_respawn(rep, reason)
+
+    def _begin_quarantine(self, rep, reason, hard=False):
+        """Soft quarantine: the engine still answers, so drain — close
+        admissions, migrate the queue immediately, let in-flight slots
+        finish inside ``drain_deadline_s`` (stragglers migrate at the
+        deadline)."""
+        if hard:
+            self._lose_replica(rep, reason)
+            return
+        self._set_state(rep, "quarantined", reason)
+        rep.sched.drain(reason)
+        rep._drain_started_wall = self._clock()
+        t0 = self._clock()
+        records = rep.sched.extract_unfinished(reason=reason,
+                                               which="pending")
+        self._migrate(rep, records, t0, reason=reason)
+
+    def _finish_quarantine(self, rep):
+        """Drain complete (or deadline blown): migrate whatever is
+        left, drop the engine, schedule the respawn."""
+        self._collect(rep)
+        t0 = self._clock()
+        records = rep.sched.extract_unfinished(reason="quarantine_drain")
+        if records:
+            self._migrate(rep, records, t0, reason="quarantine_drain")
+        self._drop_engine(rep)
+        self._schedule_respawn(rep, "quarantine_drain")
+
+    def _drain_deadline_passed(self, rep):
+        return (rep._drain_started_wall is not None
+                and self._clock() - rep._drain_started_wall
+                > self.config.drain_deadline_s)
+
+    def _drop_engine(self, rep):
+        rep.engine = None
+        rep.sched = None
+        rep._drain_started_wall = None
+
+    def _schedule_respawn(self, rep, reason):
+        if not self.config.respawn:
+            return
+        rep.respawn_at = self.step_count + self.config.respawn_delay_ticks
+        self._set_state(rep, "respawning", reason)
+
+    def _max_prefill(self):
+        """The widest prefill bucket any replica (serving or
+        spawnable) offers — the migration-continuation admission
+        bound."""
+        widest = 0
+        for rep in self.replicas:
+            if rep.engine is not None:
+                widest = max(widest,
+                             rep.engine.config.prefill_buckets[-1])
+        if widest == 0 and self._serve_config is not None:
+            widest = max(self._serve_config.prefill_buckets)
+        return widest or 10 ** 9
+
+    def _migrate(self, rep, records, t0, reason):
+        """Re-admit a dead/draining replica's unfinished requests as
+        continuations: prompt + emitted tokens, remaining token
+        budget, same tier/deadlines. Greedy continuations are
+        token-identical to an unkilled run (the cache_index-rollback
+        prefill equivalence); a continuation too long for every
+        prefill ladder is a non-silent loss (terminal ``failed`` +
+        ``fleet/lost_requests``)."""
+        migrated, tokens_carried = 0, 0
+        readmitted = []
+        max_prefill = self._max_prefill()
+        for r in records:
+            rid = r["request"].rid
+            info = self._rid_info.get(rid)
+            if info is None:
+                continue
+            emitted = info["base_tokens"] + list(r["tokens"])
+            if r["tokens"] and not np.isfinite(info["base_ttft"]):
+                info["base_ttft"] = info["wait_s"] + r["ttft_s"]
+            info["base_latencies"] += list(r["latencies"])
+            orig = info["orig"]
+            remaining = orig.max_new_tokens - len(emitted)
+            if remaining <= 0:
+                # the replica died on the final token's doorstep
+                self._complete(rid, tokens=emitted, reason="length",
+                               ttft_s=info["base_ttft"])
+                continue
+            prompt = np.asarray(orig.prompt, np.int32)
+            if emitted:
+                prompt = np.concatenate(
+                    [prompt, np.asarray(emitted, np.int32)])
+            if len(prompt) > max_prefill:
+                self.lost_requests += 1
+                reg = self._reg()
+                reg.counter("fleet/lost_requests").inc()
+                reg.event("fleet", "migration_failed", rid=rid,
+                          replica=rep.idx,
+                          prompt_len=int(len(prompt)),
+                          max_prefill=int(max_prefill), tick=self.tick)
+                self._complete(rid, tokens=emitted, reason="failed",
+                               ttft_s=info["base_ttft"])
+                continue
+            info["base_tokens"] = list(emitted)
+            info["migrations"] += 1
+            self.migrated_rids.add(rid)
+            info["eligible_wall"] = self._clock()
+            cont = dataclasses.replace(
+                orig, prompt=prompt, max_new_tokens=remaining,
+                arrival=self.tick)
+            self.pending.append(cont)
+            readmitted.append(rid)
+            migrated += 1
+            tokens_carried += len(emitted)
+        self.pending.sort(key=lambda r: (r.arrival, r.rid))
+        reg = self._reg()
+        reg.counter("fleet/migrated").inc(migrated)
+        reg.event("fleet", "migration", replica=rep.idx,
+                  requests=migrated, tokens_carried=tokens_carried,
+                  reason=reason, tick=self.tick,
+                  extract_ms=round((self._clock() - t0) * 1e3, 3))
+        if readmitted:
+            if self._rebalance is None:
+                self._rebalance = {"t0": t0, "rids": set()}
+            self._rebalance["rids"].update(readmitted)
+
+    # -- health -------------------------------------------------------------
+
+    def _health_check(self, rep):
+        """Drive the state machine off the replica scheduler's
+        ServeHealth counter deltas: poisoned slots, failed requests
+        and exhausted-retry decode failures accumulate a bad score;
+        ``all_slots_nonfinite`` (model-level poison) quarantines
+        immediately."""
+        h = rep.sched.health.snapshot()
+        seen = rep._health_seen
+        rep._health_seen = dict(h)
+        bad = sum(h.get(k, 0) - seen.get(k, 0)
+                  for k in ("quarantined", "failed", "decode_failures"))
+        if h.get("all_slots_nonfinite", 0) > seen.get(
+                "all_slots_nonfinite", 0):
+            bad += self.config.quarantine_after
+        if bad == 0:
+            if rep.state == "degraded":
+                rep._clean_ticks += 1
+                if rep._clean_ticks >= self.config.recover_after_ticks:
+                    rep._bad_score = 0
+                    rep._clean_ticks = 0
+                    self._set_state(rep, "healthy", "recovered")
+            return
+        rep._clean_ticks = 0
+        rep._bad_score += bad
+        if rep._bad_score >= self.config.quarantine_after:
+            self._begin_quarantine(rep, "unhealthy")
+        elif rep._bad_score >= self.config.degraded_after \
+                and rep.state == "healthy":
+            self._set_state(rep, "degraded", "health_counters")
+
+    # -- elastic scale ------------------------------------------------------
+
+    def pending_depth(self):
+        """Total backlog: the fleet queue plus every replica queue —
+        the autoscale signal (and the ``fleet/pending_depth`` gauge)."""
+        return len(self.pending) + sum(
+            len(rep.sched.pending) for rep in self.replicas
+            if rep.sched is not None)
+
+    def _serving_count(self):
+        return sum(1 for rep in self.replicas if rep.serving())
+
+    def _autoscale(self):
+        cfg = self.config
+        depth = self.pending_depth()
+        if cfg.scale_up_pending is not None \
+                and depth > cfg.scale_up_pending:
+            self._above += 1
+        else:
+            self._above = 0
+        if cfg.scale_down_pending is not None \
+                and depth <= cfg.scale_down_pending:
+            self._below += 1
+        else:
+            self._below = 0
+        reg = self._reg()
+        if self._above >= cfg.scale_sustain_ticks \
+                and self._serving_count() < self.max_replicas:
+            idle = next((r for r in self.replicas
+                         if r.state == "idle"), None)
+            if idle is not None:
+                self._spawn(idle, reason="scale_up")
+                self.scale_ups += 1
+                self._above = 0
+                reg.counter("fleet/scale_ups").inc()
+                reg.event("fleet", "scale_up", replica=idle.idx,
+                          pending_depth=depth, tick=self.tick)
+        if self._below >= cfg.scale_sustain_ticks \
+                and self._serving_count() > cfg.min_replicas \
+                and not self.pending:
+            serving = [r for r in self.replicas if r.serving()]
+            victim = min(serving, key=lambda r: (
+                len(r.sched.active), len(r.sched.pending), r.idx))
+            self._begin_retire(victim, depth)
+            self._below = 0
+
+    def _begin_retire(self, rep, depth):
+        """Graceful scale-down: stop routing to the replica, migrate
+        its queue, let in-flight work finish, then drop the engine
+        back to an idle slot."""
+        self.scale_downs += 1
+        reg = self._reg()
+        reg.counter("fleet/scale_downs").inc()
+        reg.event("fleet", "scale_down", replica=rep.idx,
+                  pending_depth=depth, tick=self.tick)
+        self._set_state(rep, "retiring", "scale_down")
+        rep.sched.drain("scale_down")
+        rep._drain_started_wall = self._clock()
+        t0 = self._clock()
+        records = rep.sched.extract_unfinished(reason="scale_down",
+                                               which="pending")
+        if records:
+            self._migrate(rep, records, t0, reason="scale_down")
+
+    def _finish_retire(self, rep):
+        self._collect(rep)
+        t0 = self._clock()
+        records = rep.sched.extract_unfinished(reason="scale_down")
+        if records:
+            self._migrate(rep, records, t0, reason="scale_down")
+        self._drop_engine(rep)
+        self._set_state(rep, "idle", "retired")
+
+    # -- driving ------------------------------------------------------------
+
+    def step(self):
+        """One fleet tick: fire any armed replica-loss fault, dispatch
+        eligible requests, step every live replica scheduler (health
+        transitions ride on the counters), respawn what is due, and
+        evaluate the autoscale thresholds."""
+        from apex_tpu.resilience import NonFiniteError, faults
+
+        if self._t_start is None:
+            self._t_start = self._clock()
+        victim = faults.replica_loss_for(self.step_count)
+        if victim is not None and 0 <= victim < len(self.replicas) \
+                and self.replicas[victim].serving():
+            self._lose_replica(self.replicas[victim])
+        self._dispatch()
+        for rep in self.replicas:
+            if rep.sched is None:
+                continue
+            if rep.busy():
+                try:
+                    rep.sched.step()
+                except NonFiniteError:
+                    # the whole-batch guard fired: model-level poison
+                    # on THIS replica — the implicated requests were
+                    # already terminal'd ``poisoned``; everything else
+                    # migrates and the replica respawns with fresh
+                    # state (the fleet-level restore)
+                    self._collect(rep)
+                    self._begin_quarantine(rep, "model_poison",
+                                           hard=True)
+                    continue
+                self._collect(rep)
+            if rep.state == "quarantined" and (
+                    not rep.sched or not rep.sched.active
+                    or self._drain_deadline_passed(rep)):
+                if rep.sched is not None:
+                    self._finish_quarantine(rep)
+            elif rep.state == "retiring" and (
+                    not rep.busy() or self._drain_deadline_passed(rep)):
+                self._finish_retire(rep)
+            elif rep.serving():
+                self._health_check(rep)
+        for rep in self.replicas:
+            if rep.state == "respawning" and rep.respawn_at is not None \
+                    and self.step_count >= rep.respawn_at:
+                self._spawn(rep, reason="respawn")
+        self._autoscale()
+        reg = self._reg()
+        reg.gauge("fleet/pending_depth").set(self.pending_depth())
+        reg.gauge("fleet/replicas_serving").set(self._serving_count())
+        self.tick += 1.0
+        self.step_count += 1
+
+    def _work_remaining(self):
+        if self.pending:
+            return True
+        if any(rep.busy() for rep in self.replicas):
+            return True
+        return False
+
+    def run(self, requests=None, *, max_steps=100_000):
+        """Drive ``requests`` (plus anything already submitted) to a
+        terminal state across the fleet; returns the completed list in
+        finish order. Mirrors ``Scheduler.run``: idle gaps fast-forward
+        the virtual clock, ``max_steps`` exhaustion cancels loudly."""
+        for r in requests or ():
+            self.submit(r)
+        steps = 0
+        while self._work_remaining():
+            if not any(rep.sched is not None and rep.sched.active
+                       for rep in self.replicas) \
+                    and self.pending \
+                    and min(r.arrival for r in self.pending) > self.tick:
+                self.tick = min(r.arrival for r in self.pending)
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                self._exhaust_max_steps(max_steps)
+                break
+        self._t_end = self._clock()
+        self._fleet_report()
+        return self.completed
+
+    def _exhaust_max_steps(self, max_steps):
+        stranded = 0
+        for rep in self.replicas:
+            if rep.sched is None:
+                continue
+            for rec in rep.sched.extract_unfinished(reason="max_steps"):
+                info = self._rid_info.get(rec["request"].rid)
+                if info is None:
+                    continue
+                self._complete(
+                    rec["request"].rid,
+                    tokens=info["base_tokens"] + list(rec["tokens"]),
+                    reason="max_steps", ttft_s=info["base_ttft"])
+                stranded += 1
+        for r in list(self.pending):
+            self.pending.remove(r)
+            info = self._rid_info[r.rid]
+            self._complete(r.rid, tokens=info["base_tokens"],
+                           reason="max_steps",
+                           ttft_s=info["base_ttft"])
+            stranded += 1
+        self._reg().event("fleet", "max_steps_exhausted",
+                          max_steps=max_steps, cancelled=stranded,
+                          tick=self.tick)
+        warnings.warn(
+            f"fleet exhausted max_steps ({max_steps}) with {stranded} "
+            f"request(s) left — all cancelled with terminal status "
+            f"'max_steps'", stacklevel=3)
+
+    # -- accounting ---------------------------------------------------------
+
+    @staticmethod
+    def _pct(samples, q):
+        return float(np.percentile(samples, q)) if samples else None
+
+    def _tier_rollup(self):
+        out = {}
+        for tier, ts in self._tier_stats.items():
+            out[tier] = {
+                "requests": ts["requests"],
+                "ok": ts["ok"],
+                "goodput_tokens": ts["goodput_tokens"],
+                "ttft_p50_ms": self._pct(ts["ttft_ms"], 50),
+                "ttft_p99_ms": self._pct(ts["ttft_ms"], 99),
+            }
+        return out
+
+    def stats(self):
+        """Host-side fleet summary — the ``serve_fleet`` bench's
+        emission source: aggregate + per-tier goodput and tail
+        latency, migration/rebalance accounting, per-replica table."""
+        now = self._clock()
+        wall = (self._t_end or now) - (self._t_start or now)
+        by_reason = {}
+        goodput_tokens = 0
+        total_tokens = 0
+        for c in self.completed:
+            by_reason[c.finish_reason] = \
+                by_reason.get(c.finish_reason, 0) + 1
+            total_tokens += len(c.tokens)
+            if c.finish_reason in robust_mod.OK_STATUSES:
+                goodput_tokens += len(c.tokens)
+        tiers = self._tier_rollup()
+        return {
+            "requests_completed": len(self.completed),
+            "requests_ok": sum(by_reason.get(r, 0)
+                               for r in robust_mod.OK_STATUSES),
+            "requests_by_reason": by_reason,
+            "requests_rejected": len(self.rejected),
+            "tokens_generated": total_tokens,
+            "goodput_tokens": goodput_tokens,
+            "wall_s": wall,
+            "tokens_per_sec": (total_tokens / wall) if wall > 0
+            else None,
+            "goodput_tokens_per_sec": (goodput_tokens / wall)
+            if wall > 0 else None,
+            "by_tier": tiers,
+            "ttft_p99_ms_interactive":
+                tiers.get("interactive", {}).get("ttft_p99_ms"),
+            "ttft_p99_ms_batch":
+                tiers.get("batch", {}).get("ttft_p99_ms"),
+            "migrated_requests": len(self.migrated_rids),
+            "lost_requests": self.lost_requests,
+            "rebalance_latency_ms": (round(self.rebalance_ms[-1], 3)
+                                     if self.rebalance_ms else None),
+            "replicas_quarantined": self.quarantine_count,
+            "replicas_respawned": self.respawn_count,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "dispatched": sum(rep.dispatched for rep in self.replicas),
+            "pending_depth_last": self.pending_depth(),
+            "replicas": [rep.table_row() for rep in self.replicas],
+        }
+
+    def _fleet_report(self):
+        reg = self._reg()
+        if not reg.enabled:
+            return
+        s = self.stats()
+        reg.event("fleet", "fleet_report",
+                  **{k: s[k] for k in (
+                      "requests_completed", "requests_ok",
+                      "goodput_tokens", "migrated_requests",
+                      "lost_requests", "rebalance_latency_ms",
+                      "replicas_quarantined", "replicas_respawned",
+                      "scale_ups", "scale_downs", "dispatched")},
+                  by_tier=s["by_tier"], replicas=s["replicas"],
+                  tick=self.tick)

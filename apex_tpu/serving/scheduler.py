@@ -66,7 +66,13 @@ class Request:
     (eos, when the engine's config defines one, may end it earlier).
     ``ttft_deadline_s`` / ``total_deadline_s`` override the scheduler's
     :class:`~apex_tpu.serving.robust.RobustConfig` defaults for this
-    request (None = inherit)."""
+    request (None = inherit).
+
+    ``tier`` is the SLO class (``"interactive"`` | ``"batch"``; None =
+    the fleet's default tier). The scheduler itself is tier-blind —
+    :class:`~apex_tpu.serving.fleet.ServeFleet` resolves a tier into
+    the per-request deadline fields above at admission and keeps the
+    per-tier latency accounting."""
 
     rid: int
     prompt: np.ndarray
@@ -74,6 +80,7 @@ class Request:
     arrival: float = 0.0
     ttft_deadline_s: Optional[float] = None
     total_deadline_s: Optional[float] = None
+    tier: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -449,6 +456,52 @@ class Scheduler:
             else "length"
         self._terminal(st.req, reason, tokens=st.tokens,
                        ttft_s=st.ttft_s, latencies=st.latencies)
+
+    # -- migration seam (serving.fleet) ------------------------------------
+
+    def extract_unfinished(self, reason="migrated", which="all"):
+        """Remove in-flight and/or queued requests WITHOUT landing a
+        terminal status — the fleet's migration seam: a quarantined or
+        lost replica's unfinished work is re-admitted to survivors, so
+        the requests must leave this scheduler accounted-for but not
+        finished. Returns one record per request — ``{"request",
+        "tokens" (emitted so far), "ttft_s", "latencies", "where"}`` —
+        everything the fleet needs to build the re-prefill
+        continuation (prompt + emitted tokens; greedy decode resumes
+        token-identically). Each extraction ticks ``serve/extracted``
+        and lands a ``serve``/``extracted`` JSONL event; ``which``
+        scopes the sweep (``"all"`` | ``"active"`` | ``"pending"`` —
+        a draining replica migrates its queue immediately but lets
+        active slots finish inside the drain window)."""
+        if which not in ("all", "active", "pending"):
+            raise ValueError(f"which ({which!r}) not in "
+                             f"('all', 'active', 'pending')")
+        out = []
+        if which in ("all", "active"):
+            for slot in sorted(self.active):
+                st = self.active.pop(slot)
+                self._release(slot)
+                out.append({"request": st.req,
+                            "tokens": list(st.tokens),
+                            "ttft_s": st.ttft_s,
+                            "latencies": list(st.latencies),
+                            "where": "active"})
+        if which in ("all", "pending"):
+            for r in list(self.pending):
+                self.pending.remove(r)
+                out.append({"request": r, "tokens": [],
+                            "ttft_s": float("nan"), "latencies": [],
+                            "where": "pending"})
+        reg = self._reg()
+        for rec in out:
+            rid = rec["request"].rid
+            self._known_rids.discard(rid)
+            self._eligible_wall.pop(rid, None)
+            reg.counter("serve/extracted").inc()
+            reg.event("serve", "extracted", rid=rid, reason=reason,
+                      where=rec["where"], tokens=len(rec["tokens"]),
+                      tick=self.tick)
+        return out
 
     # -- drain -------------------------------------------------------------
 

@@ -30,6 +30,7 @@
                                            kv_cache_bytes (bf16 + int8)
                                            + flat compile_count
     python bench.py serve_chaos [reqs] [len]  serving fault-tolerance
+    python bench.py serve_fleet [reqs] [len]  multi-replica fleet chaos
                                            chaos: injected slot-NaN +
                                            transient decode failure +
                                            request storm through one
@@ -2234,6 +2235,123 @@ def bench_serve_chaos(requests, steps):
     return ret
 
 
+def bench_serve_fleet(requests, steps):
+    """Multi-replica serving-fleet chaos bench (apex_tpu.serving.fleet):
+    a 2-replica fleet (distinct mesh slices when the host has the
+    devices; meshless shared-device replicas on the 1-core CPU smoke
+    host) serves (a) a clean diurnal+burst trace — the goodput and
+    token-stream baseline — and (b) the SAME trace with
+    ``inject_replica_loss`` killing replica 0 mid-trace: every
+    in-flight request of the dead replica must finish on the survivor
+    (re-prefill from prompt + emitted tokens; greedy outputs
+    token-identical to the clean leg), the dead replica respawns and
+    re-registers its AOT ladder under a fresh generation name, and the
+    rebalance latency (loss detection -> last migrated request
+    re-dispatched) is measured.
+
+    Headline value is the chaos-leg fleet tokens/sec; the emitted line
+    carries the round-16 contract — per-tier p99 TTFT
+    (``ttft_p99_ms_interactive`` / ``ttft_p99_ms_batch``),
+    ``rebalance_latency_ms``, ``replicas_respawned`` — next to
+    ``goodput_ratio`` (chaos goodput tokens / clean; the acceptance
+    floor is 0.9), ``migrated_requests``, ``lost_requests`` (must be
+    0), ``token_identical``, and ``compile_count`` == the PER-REPLICA
+    ladder size with ``recompiles_chaos == 0`` (the respawned ladder
+    registers under fresh watcher names, so any counted recompile is a
+    real signature drift).
+    """
+    from apex_tpu.resilience import faults
+    from apex_tpu.serving import (FleetConfig, ServeConfig, ServeFleet,
+                                  diurnal_trace)
+    from apex_tpu.telemetry import CompileWatcher
+
+    smoke, cfg, model, params, _, _ = _serve_bench_setup()
+    serve_cfg = ServeConfig(
+        batch_buckets=(2, 4),
+        prefill_buckets=(16, 32) if smoke else (32, 64, 128),
+        num_slots=4, cache_mode="bf16",
+        eos_token_id=None, temperature=0.0)
+    fleet_cfg = FleetConfig(num_replicas=2, respawn_delay_ticks=1)
+    # migration bound: the continuation prompt (orig + emitted) must
+    # fit the widest prefill bucket, so cap max_new accordingly
+    plens = (4, 8, 12) if smoke else (8, 24, 48)
+    widest = serve_cfg.prefill_buckets[-1]
+    max_new = tuple(min(m, widest - max(plens))
+                    for m in (max(steps // 2, 2), steps, steps * 2))
+
+    def trace():
+        return diurnal_trace(
+            requests, seed=0, prompt_lens=plens, max_new=max_new,
+            vocab_size=cfg.vocab_size, base_interarrival=0.6,
+            burst_at=1.0, burst_n=max(requests // 4, 2),
+            batch_every=4)
+
+    watcher = CompileWatcher(enabled=True)
+
+    def build():
+        return ServeFleet(model, params, serve_cfg, fleet_cfg,
+                          watcher=watcher)
+
+    # (a) clean leg: goodput + token-stream baseline
+    fleet_a = build()
+    clean_done = fleet_a.run(trace())
+    clean = fleet_a.stats()
+    clean_tokens = {c.rid: np.asarray(c.tokens).tolist()
+                    for c in clean_done}
+
+    # (b) chaos leg: kill replica 0 mid-trace
+    fleet_b = build()
+    recompiles_before = watcher.recompile_count()
+    t0 = time.perf_counter()
+    with faults.inject_replica_loss(0, 3):
+        chaos_done = fleet_b.run(trace())
+    dt = time.perf_counter() - t0
+    chaos = fleet_b.stats()
+    recompiles = watcher.recompile_count() - recompiles_before
+    chaos_tokens = {c.rid: np.asarray(c.tokens).tolist()
+                    for c in chaos_done}
+    identical = chaos_tokens == clean_tokens
+
+    ladder = (len(serve_cfg.batch_buckets)
+              * len(serve_cfg.prefill_buckets)
+              + len(serve_cfg.batch_buckets))
+    _stage_aot_compile_count(ladder)
+    tokens_per_sec = chaos["tokens_per_sec"] or 0.0
+    avg_len = float(np.mean(plens)) + float(np.mean(max_new))
+    flops = chaos["tokens_generated"] * _transformer_fwd_flops_per_token(
+        cfg, int(avg_len))
+    ret = {
+        "tokens_per_sec": round(tokens_per_sec, 2),
+        "goodput_ratio": round(
+            chaos["goodput_tokens"] / clean["goodput_tokens"], 4)
+        if clean["goodput_tokens"] else None,
+        "ttft_p99_ms_interactive": round(
+            chaos["ttft_p99_ms_interactive"], 3)
+        if chaos["ttft_p99_ms_interactive"] is not None else None,
+        "ttft_p99_ms_batch": round(chaos["ttft_p99_ms_batch"], 3)
+        if chaos["ttft_p99_ms_batch"] is not None else None,
+        "rebalance_latency_ms": chaos["rebalance_latency_ms"],
+        "replicas_respawned": chaos["replicas_respawned"],
+        "migrated_requests": chaos["migrated_requests"],
+        "lost_requests": chaos["lost_requests"],
+        "token_identical": bool(identical),
+        "compile_count": ladder,
+        "recompiles_chaos": int(recompiles),
+    }
+    _emit("serve_fleet_tokens_per_sec", tokens_per_sec,
+          "tokens/sec", flops, 1, dt,
+          requests=len(trace()), replicas=2,
+          num_slots_per_replica=serve_cfg.num_slots,
+          clean_goodput_tokens=clean["goodput_tokens"],
+          chaos_goodput_tokens=chaos["goodput_tokens"],
+          requests_ok=chaos["requests_ok"],
+          replicas_quarantined=chaos["replicas_quarantined"],
+          **{k: v for k, v in ret.items()
+             if k not in ("tokens_per_sec", "compile_count")},
+          **_comm_fields(training=False))
+    return ret
+
+
 # The canonical (size, steps) per bench — the ONLY place these defaults
 # live; both the CLI dispatch below and the one-process capture plan
 # (tools/oneproc_capture.py) read them, so a tuning change (like resnet
@@ -2254,6 +2372,7 @@ BENCH_SPECS = {
     "decode": ((8, 128), bench_decode),
     "serve_decode": ((24, 16), bench_serve_decode),
     "serve_chaos": ((24, 16), bench_serve_chaos),
+    "serve_fleet": ((16, 8), bench_serve_fleet),
     "resnet": ((256, 50), bench_resnet),
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
     "ddp_overlapped": ((64, 30), bench_ddp_overlapped),

@@ -243,6 +243,41 @@ def test_overlap_and_backend_fields_gated_at_round15():
                                     errors=[]) == []
 
 
+def test_fleet_fields_gated_at_round16():
+    """ISSUE 11 satellite: the serve_fleet contract (per-tier p99
+    TTFT, rebalance_latency_ms, replicas_respawned) is required on
+    serve_fleet lines from round 16; pre-16 records carrying the
+    fields are flagged, other configs never need them."""
+    base = {"metric": "serve_fleet_tokens_per_sec", "value": 1.0,
+            "unit": "tokens/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 0,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": 4, "lint_violations": None,
+            "backend": "cpu-mesh"}
+    msgs = schema.check_metric_line(dict(base), round_n=16, errors=[])
+    for key in ("ttft_p99_ms_interactive", "ttft_p99_ms_batch",
+                "rebalance_latency_ms", "replicas_respawned"):
+        assert any(key in m for m in msgs)
+    full = dict(base, ttft_p99_ms_interactive=2.0, ttft_p99_ms_batch=8.0,
+                rebalance_latency_ms=1.2, replicas_respawned=1)
+    assert schema.check_metric_line(dict(full), round_n=16,
+                                    errors=[]) == []
+    # nullable: a clean leg with no migration has no rebalance latency
+    assert schema.check_metric_line(
+        dict(full, rebalance_latency_ms=None), round_n=16,
+        errors=[]) == []
+    msgs = schema.check_metric_line(dict(full), round_n=15, errors=[])
+    assert any("only defined from round 16" in m for m in msgs)
+    msgs = schema.check_metric_line(
+        dict(full, replicas_respawned="one"), round_n=16, errors=[])
+    assert any("must be numeric or null" in m for m in msgs)
+    other = dict(base, metric="gpt2_345m_tokens_per_sec_per_chip")
+    assert schema.check_metric_line(other, round_n=16, errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint

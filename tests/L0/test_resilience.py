@@ -417,6 +417,36 @@ def test_legacy_fault_vars_win_with_deprecation(monkeypatch):
     assert faults.nan_step_from_env() == 7
 
 
+def test_replica_loss_plan_grammar_and_injector(monkeypatch):
+    """ISSUE 11 satellite: the ``replica_loss@N:R`` plan entry and the
+    one-shot fleet injector (the replica-level sibling of
+    device_loss, keyed on the fleet's lifetime step counter)."""
+    monkeypatch.delenv(faults.ENV_FAULT_PLAN, raising=False)
+    faults.disarm_replica_loss()
+    # unarmed: no step fires
+    assert faults.replica_loss_for(0) is None
+    # grammar: kind@step:replica parses next to the other kinds
+    plan = faults.parse_fault_plan("replica_loss@5:1;nan@3")
+    assert plan.get("replica_loss") == {"kind": "replica_loss",
+                                        "step": 5, "arg": "1"}
+    with pytest.raises(ValueError, match="duplicate entry"):
+        faults.parse_fault_plan("replica_loss@1;replica_loss@2")
+    # API arming: fires exactly once at the named fleet step
+    with faults.inject_replica_loss(2, 7) as st:
+        assert faults.replica_loss_for(6) is None
+        assert faults.replica_loss_for(7) == 2
+        assert st["fired"] == 1
+        assert faults.replica_loss_for(7) is None   # one-shot
+    assert faults.replica_loss_for(7) is None       # disarmed on exit
+    # env arming via the plan; arg defaults to replica 0
+    monkeypatch.setenv(faults.ENV_FAULT_PLAN, "replica_loss@3")
+    faults.disarm_replica_loss()
+    assert faults.replica_loss_for(2) is None
+    assert faults.replica_loss_for(3) == 0
+    assert faults.replica_loss_for(3) is None
+    faults.disarm_replica_loss()
+
+
 def test_inject_device_loss(monkeypatch):
     monkeypatch.delenv(faults.ENV_FAULT_PLAN, raising=False)
     faults.inject_device_loss(3)  # unarmed: no-op

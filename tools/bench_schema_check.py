@@ -43,6 +43,10 @@ number ``n`` (old checked-in records stay valid):
   must carry the overlap contract — ``overlap_segments``,
   ``comm_hidden_pct`` and ``baseline_step_ms`` — next to their
   steps/sec value.
+- ``n >= 16``: ``serve_fleet`` metric lines must carry the fleet
+  contract — per-tier p99 TTFT (``ttft_p99_ms_interactive`` /
+  ``ttft_p99_ms_batch``), ``rebalance_latency_ms`` and
+  ``replicas_respawned`` — next to their fleet tokens/sec value.
 
 Usage::
 
@@ -129,6 +133,16 @@ OVERLAP_METRIC_PREFIX = "ddp_overlapped"
 OVERLAP_REQUIRED_FIELDS = ("overlap_segments", "comm_hidden_pct",
                            "baseline_step_ms")
 BACKEND_VERDICTS = ("cpu-mesh", "tpu")
+# the serving-fleet capture contract (apex_tpu.serving.fleet, round
+# 16): a serve_fleet metric line must carry the per-tier tail
+# latencies, the quarantine->re-dispatch rebalance latency (null when
+# the chaos leg never migrated), and the respawn count next to its
+# fleet tokens/sec value; pre-round-16 records carrying them are
+# flagged — the fields did not exist yet
+FLEET_FIELDS_SINCE_ROUND = 16
+FLEET_METRIC_PREFIX = "serve_fleet"
+FLEET_REQUIRED_FIELDS = ("ttft_p99_ms_interactive", "ttft_p99_ms_batch",
+                         "rebalance_latency_ms", "replicas_respawned")
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -261,6 +275,22 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                         f"since round {RECOVERY_FIELDS_SINCE_ROUND})")
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"recovery field {key!r} must be numeric or "
+                        f"null")
+        is_fleet = str(obj.get("metric", "")).startswith(
+            FLEET_METRIC_PREFIX)
+        present_fleet = [k for k in FLEET_REQUIRED_FIELDS if k in obj]
+        if present_fleet and (round_n is not None
+                              and round_n < FLEET_FIELDS_SINCE_ROUND):
+            bad(f"serve_fleet fields {present_fleet} are only defined "
+                f"from round {FLEET_FIELDS_SINCE_ROUND}")
+        elif is_fleet and (round_n is None
+                           or round_n >= FLEET_FIELDS_SINCE_ROUND):
+            for key in FLEET_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"serve_fleet line missing {key!r} (required "
+                        f"since round {FLEET_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"serve_fleet field {key!r} must be numeric or "
                         f"null")
         is_overlap = str(obj.get("metric", "")).startswith(
             OVERLAP_METRIC_PREFIX)

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Cross-round bench regression gate (ROADMAP item 5, trend slice).
+
+``bench_schema_check`` proves each BENCH_rNN.json record is
+*well-formed*; this tool proves the series is *monotone enough*: it
+groups the parsed metric lines by config, sorts by round, and compares
+every CONSECUTIVE captured pair of the same config. Three checks per
+pair:
+
+- the headline rate (``value`` — steps/sec, tokens/sec, ...) dropping
+  more than the config's noise band;
+- ``comm_bytes_per_step`` growing more than the band (a comm-bytes
+  regression is a compression/overlap regression);
+- ``compile_count`` growing AT ALL (compile counts are exact — the
+  whole shape-discipline story is that they never drift).
+
+``bench_error`` rounds, records without a parsed line, and
+cross-backend pairs (``cpu-mesh`` and ``tpu`` are different perf
+series) are *skipped*, never compared — the comparison resumes at the
+next same-backend success.
+
+The default band is ±25%: the capture host's load swing (±80 s on a
+~730 s suite, PERF.md) makes a tighter fixed band dishonest. Bands are
+config-calibrated, not global — override one config in
+:data:`PER_METRIC_BAND` (serving latencies swing more than training
+step rates) or all of them with ``--band``.
+
+Exit code 0 = no regressions; 1 = regressions (one ``TREND
+REGRESSION`` line each — the loud failure ROADMAP item 5 asks for).
+``tools/telemetry_report.py --trend DIR`` renders the same table
+inside a telemetry report.
+
+    python tools/bench_trend.py                # repo root BENCH_*.json
+    python tools/bench_trend.py DIR --band 0.15
+    python tools/bench_trend.py --json
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# the default noise band (fraction): value drops / comm-bytes growth
+# within the band are host noise, beyond it a named regression
+DEFAULT_BAND = 0.25
+
+# per-config overrides — serving numbers ride wall-clock TTFT/queueing
+# and swing harder than compute-bound training step rates
+PER_METRIC_BAND = {
+    "serve_decode_tokens_per_sec_per_chip": 0.40,
+    "serve_chaos_goodput_tokens_per_sec": 0.40,
+    "serve_fleet_tokens_per_sec": 0.40,
+}
+
+
+def band_for(metric, default_band=DEFAULT_BAND, bands=None):
+    table = dict(PER_METRIC_BAND)
+    table.update(bands or {})
+    return table.get(metric, default_band)
+
+
+def load_rounds(args):
+    """Read BENCH_*.json capture wrappers (dirs are globbed, explicit
+    files taken as-is) into per-round records: ``{"file", "n",
+    "parsed"}`` for successful rounds, ``parsed=None`` for
+    bench_error / unparseable rounds (kept so the trend table can show
+    the gap). Sorted by round number."""
+    paths = []
+    for a in args:
+        if os.path.isdir(a):
+            paths.extend(sorted(glob.glob(os.path.join(a,
+                                                       "BENCH_*.json"))))
+        else:
+            paths.append(a)
+    records = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(obj, dict) or "n" not in obj:
+            continue
+        parsed = obj.get("parsed")
+        if not isinstance(parsed, dict) \
+                or parsed.get("metric") in (None, "bench_error"):
+            parsed = None
+        records.append({"file": os.path.basename(path),
+                        "n": obj["n"], "parsed": parsed})
+    records.sort(key=lambda r: r["n"])
+    return records
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def compare_pair(prev, cur, band):
+    """Regressions between two consecutive same-config rounds (both
+    successful, same backend — the caller filters)."""
+    out = []
+    metric = cur["parsed"]["metric"]
+
+    def reg(field, old, new, kind):
+        out.append({
+            "metric": metric, "field": field,
+            "round_a": prev["n"], "round_b": cur["n"],
+            "old": old, "new": new, "kind": kind,
+            "delta_pct": round((new - old) / old * 100.0, 2)
+            if old else None,
+        })
+
+    old_v, new_v = _num(prev["parsed"].get("value")), \
+        _num(cur["parsed"].get("value"))
+    if old_v is not None and new_v is not None and old_v > 0 \
+            and new_v < old_v * (1.0 - band):
+        reg("value", old_v, new_v, f"rate dropped beyond the "
+            f"{band * 100:.0f}% band")
+    old_c = _num(prev["parsed"].get("comm_bytes_per_step"))
+    new_c = _num(cur["parsed"].get("comm_bytes_per_step"))
+    if old_c is not None and new_c is not None and old_c > 0 \
+            and new_c > old_c * (1.0 + band):
+        reg("comm_bytes_per_step", old_c, new_c,
+            f"comm bytes grew beyond the {band * 100:.0f}% band")
+    old_cc = _num(prev["parsed"].get("compile_count"))
+    new_cc = _num(cur["parsed"].get("compile_count"))
+    if old_cc is not None and new_cc is not None and new_cc > old_cc:
+        reg("compile_count", old_cc, new_cc,
+            "compile count grew (exact check — no band)")
+    return out
+
+
+def build_trend(records, *, default_band=DEFAULT_BAND, bands=None):
+    """Fold per-round records into the trend report: per-config round
+    series, per-pair comparisons, and the flat regression list."""
+    configs = {}
+    for rec in records:
+        if rec["parsed"] is None:
+            continue
+        metric = rec["parsed"]["metric"]
+        configs.setdefault(metric, []).append(rec)
+    report = {"configs": {}, "regressions": [],
+              "rounds_seen": len(records),
+              "rounds_successful": sum(
+                  1 for r in records if r["parsed"] is not None)}
+    for metric, recs in sorted(configs.items()):
+        band = band_for(metric, default_band, bands)
+        rounds = [{
+            "n": r["n"],
+            "value": _num(r["parsed"].get("value")),
+            "unit": r["parsed"].get("unit"),
+            "comm_bytes_per_step":
+                _num(r["parsed"].get("comm_bytes_per_step")),
+            "compile_count": _num(r["parsed"].get("compile_count")),
+            "backend": r["parsed"].get("backend"),
+        } for r in recs]
+        regressions, skipped = [], []
+        for prev, cur in zip(recs, recs[1:]):
+            pb = prev["parsed"].get("backend")
+            cb = cur["parsed"].get("backend")
+            if pb != cb:
+                skipped.append({
+                    "round_a": prev["n"], "round_b": cur["n"],
+                    "reason": f"backend switch ({pb} -> {cb}): "
+                              f"different perf series"})
+                continue
+            regressions.extend(compare_pair(prev, cur, band))
+        report["configs"][metric] = {
+            "band": band, "rounds": rounds,
+            "regressions": regressions, "skipped": skipped}
+        report["regressions"].extend(regressions)
+    return report
+
+
+def render(report, out=None):
+    w = (out or sys.stdout).write
+    w(f"bench trend — {report['rounds_successful']}/"
+      f"{report['rounds_seen']} round(s) with a parsed metric line\n")
+    if not report["configs"]:
+        w("  no successful rounds to compare (bench_error rounds are "
+          "skipped)\n")
+    for metric in sorted(report["configs"]):
+        c = report["configs"][metric]
+        w(f"\n{metric} (band ±{c['band'] * 100:.0f}%):\n")
+        w(f"  {'round':>6} {'value':>14} {'comm bytes':>12} "
+          f"{'compiles':>9}  backend\n")
+        for r in c["rounds"]:
+            w(f"  {r['n']:>6} "
+              f"{r['value'] if r['value'] is not None else '-':>14} "
+              f"{r['comm_bytes_per_step'] if r['comm_bytes_per_step'] is not None else '-':>12} "
+              f"{r['compile_count'] if r['compile_count'] is not None else '-':>9}  "
+              f"{r['backend'] or '?'}\n")
+        for s in c["skipped"]:
+            w(f"  skipped r{s['round_a']}->r{s['round_b']}: "
+              f"{s['reason']}\n")
+        for g in c["regressions"]:
+            w(f"  REGRESSION r{g['round_a']}->r{g['round_b']} "
+              f"{g['field']}: {g['old']} -> {g['new']} "
+              f"({g['delta_pct']}%): {g['kind']}\n")
+    w(f"\n{len(report['regressions'])} regression(s)\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="dirs (globbed for BENCH_*.json) or files; "
+                         "default: the repo root")
+    ap.add_argument("--band", type=float, default=DEFAULT_BAND,
+                    help=f"default noise band fraction "
+                         f"(default {DEFAULT_BAND})")
+    ap.add_argument("--band-for", action="append", default=[],
+                    metavar="METRIC=FRACTION",
+                    help="per-config band override (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the trend report as JSON")
+    args = ap.parse_args(argv)
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    bands = {}
+    for spec in args.band_for:
+        metric, _, frac = spec.partition("=")
+        try:
+            bands[metric] = float(frac)
+        except ValueError:
+            ap.error(f"--band-for {spec!r}: want METRIC=FRACTION")
+    report = build_trend(load_rounds(paths), default_band=args.band,
+                         bands=bands)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        render(report)
+        for g in report["regressions"]:
+            print(f"TREND REGRESSION {g['metric']} "
+                  f"r{g['round_a']}->r{g['round_b']} {g['field']}: "
+                  f"{g['old']} -> {g['new']} ({g['kind']})")
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -496,6 +496,90 @@ def _serve_chaos_smoke(bench):
             "decode_retries": ret["decode_retries"]}
 
 
+def _fleet_smoke(bench):
+    """Serving-fleet smoke (round 16): drive ``serve_fleet`` on the
+    tiny model (APEX_TPU_SERVE_SMOKE=1) — a 2-replica fleet with one
+    replica killed mid-diurnal-trace — and assert (a) ZERO lost
+    requests with the chaos leg's greedy token streams identical to
+    the clean leg (every in-flight request of the dead replica
+    finished on the survivor), (b) goodput stayed positive with the
+    chaos/clean ratio >= 0.9, (c) the dead replica respawned (its AOT
+    ladder re-registered) with a measured rebalance latency, (d) the
+    compile accounting stayed honest (per-replica compile_count == the
+    ladder, zero signature-diffed recompiles), and (e) the ``fleet``
+    events (replica_state, migration, respawn, fleet_report) landed in
+    the JSONL. Raises on any missing piece so the stage shows up as
+    ERROR rather than silently passing."""
+    import glob
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_fleet_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    prev_smoke = os.environ.get("APEX_TPU_SERVE_SMOKE")
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    os.environ["APEX_TPU_SERVE_SMOKE"] = "1"
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        ret = bench.bench_serve_fleet(8, 3)
+    finally:
+        for var, old in ((telemetry.registry.ENV_DIR, prev),
+                         ("APEX_TPU_SERVE_SMOKE", prev_smoke)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+    if ret["lost_requests"] != 0:
+        raise RuntimeError(
+            f"fleet smoke: {ret['lost_requests']} request(s) LOST in "
+            f"the replica kill — migration must carry every in-flight "
+            f"request to a survivor")
+    if not ret["token_identical"]:
+        raise RuntimeError(
+            "fleet smoke: the chaos leg's greedy token streams differ "
+            "from the clean leg — migrated continuations are not "
+            "resuming token-identically")
+    if not ret["goodput_ratio"] or ret["goodput_ratio"] < 0.9:
+        raise RuntimeError(
+            f"fleet smoke: goodput ratio {ret['goodput_ratio']!r} "
+            f"under the 0.9 floor")
+    if ret["replicas_respawned"] < 1:
+        raise RuntimeError("fleet smoke: the killed replica never "
+                           "respawned")
+    if ret["rebalance_latency_ms"] is None:
+        raise RuntimeError("fleet smoke: no rebalance latency was "
+                           "measured for the migration")
+    if ret["recompiles_chaos"] != 0:
+        raise RuntimeError(
+            f"fleet smoke: {ret['recompiles_chaos']} signature-diffed "
+            f"recompile(s) under chaos — replica respawn leaked into "
+            f"a watched signature")
+    events = []
+    for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(p) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    fleet_events = [e for e in events if e["kind"] == "fleet"]
+    for name in ("fleet_start", "replica_state", "migration",
+                 "respawn", "fleet_report"):
+        if not [e for e in fleet_events if e.get("name") == name]:
+            raise RuntimeError(
+                f"fleet smoke: no fleet/{name} event landed")
+    reports = [e for e in fleet_events
+               if e.get("name") == "fleet_report"]
+    if reports[-1].get("lost_requests") != 0:
+        raise RuntimeError("fleet smoke: the fleet_report event "
+                           "disagrees about lost requests")
+    return {"telemetry_dir": tel_dir,
+            "goodput_ratio": ret["goodput_ratio"],
+            "migrated_requests": ret["migrated_requests"],
+            "replicas_respawned": ret["replicas_respawned"],
+            "rebalance_latency_ms": ret["rebalance_latency_ms"],
+            "ttft_p99_ms_interactive": ret["ttft_p99_ms_interactive"],
+            "ttft_p99_ms_batch": ret["ttft_p99_ms_batch"],
+            "fleet_events": len(fleet_events)}
+
+
 def _lint_smoke(bench):
     """Static-analysis smoke (round 14): (a) run a clean DDP config
     under APEX_TPU_HLO_LINT=1 and assert its emitted JSON carries
@@ -748,6 +832,7 @@ def _stages(smoke):
             ("memwatch", None, lambda: _memwatch_smoke(bench)),
             ("serve", None, lambda: _serve_smoke(bench)),
             ("serve_chaos", None, lambda: _serve_chaos_smoke(bench)),
+            ("fleet", None, lambda: _fleet_smoke(bench)),
             ("recovery", None, lambda: _recovery_smoke(bench)),
             ("lint", None, lambda: _lint_smoke(bench)),
             ("overlap", None, lambda: _overlap_smoke(bench)),
@@ -826,6 +911,14 @@ def _stages(smoke):
         # and a flat compile count
         ("serve_chaos", None, spec("serve_chaos")),
         ("serve_chaos_smoke", None, lambda: _serve_chaos_smoke(bench)),
+        # round-16 serving-fleet captures: the 2-replica fleet chaos
+        # config at bench size (fleet tokens/sec, per-tier p99 TTFT,
+        # rebalance latency, respawn count, token-identity + zero-loss
+        # invariants under a mid-trace replica kill) and the smoke
+        # proving the migration/respawn machinery end to end with the
+        # fleet events landing in the JSONL
+        ("serve_fleet", None, spec("serve_fleet")),
+        ("fleet", None, lambda: _fleet_smoke(bench)),
         # round-13 training-recovery captures: the supervised chaos
         # campaign at bench size (restarts / mttr_steps /
         # snapshot_restores / goodput_step_ratio / final_loss_delta in

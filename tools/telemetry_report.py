@@ -41,7 +41,12 @@ KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
     "compile", "memory", "serve", "recovery", "lint", "overlap",
+    "fleet",
 })
+
+# fleet timeline rows kept per report (replica state transitions +
+# migrations + rebalances + scale events, stream order)
+_FLEET_TIMELINE_CAP = 128
 
 # timeline rows kept per report — enough for dozens of segments/buckets
 # without letting a long capture balloon the aggregate
@@ -75,6 +80,10 @@ def aggregate(events):
             "errors": 0}
     overlap = {"plans": [], "summaries": [], "timeline": [],
                "timeline_truncated": 0}
+    fleet = {"starts": [], "migrations": 0, "migrated_requests": 0,
+             "lost_requests": 0, "respawns": 0, "rebalances": [],
+             "scale_ups": 0, "scale_downs": 0, "timeline": [],
+             "timeline_truncated": 0, "last_report": None}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -276,6 +285,54 @@ def aggregate(events):
                             "segments", "buckets", "baseline_step_ms",
                             "overlapped_step_ms", "compute_step_ms",
                             "comm_hidden_pct")})
+            elif kind == "fleet":
+                fname = ev.get("name")
+                if fname == "fleet_start":
+                    fleet["starts"].append({
+                        k: ev.get(k) for k in (
+                            "replicas", "max_replicas",
+                            "devices_per_replica", "tiers")})
+                elif fname == "migration":
+                    fleet["migrations"] += 1
+                    fleet["migrated_requests"] += int(
+                        ev.get("requests") or 0)
+                elif fname == "migration_failed":
+                    fleet["lost_requests"] += 1
+                elif fname == "respawn":
+                    fleet["respawns"] += 1
+                elif fname == "rebalance":
+                    fleet["rebalances"].append(
+                        float(ev.get("latency_ms") or 0.0))
+                elif fname == "scale_up":
+                    fleet["scale_ups"] += 1
+                elif fname == "scale_down":
+                    fleet["scale_downs"] += 1
+                elif fname == "fleet_report":
+                    fleet["last_report"] = {
+                        k: ev.get(k) for k in (
+                            "requests_completed", "requests_ok",
+                            "goodput_tokens", "migrated_requests",
+                            "lost_requests", "rebalance_latency_ms",
+                            "replicas_quarantined",
+                            "replicas_respawned", "scale_ups",
+                            "scale_downs", "dispatched", "by_tier",
+                            "replicas")}
+                if fname in ("replica_state", "migration",
+                             "migration_failed", "rebalance",
+                             "respawn", "scale_up", "scale_down"):
+                    if len(fleet["timeline"]) < _FLEET_TIMELINE_CAP:
+                        fleet["timeline"].append({
+                            "event": fname,
+                            "tick": ev.get("tick"),
+                            "replica": ev.get("replica"),
+                            "detail": {k: ev.get(k) for k in (
+                                "old", "new", "reason", "requests",
+                                "tokens_carried", "latency_ms", "rid",
+                                "pending_depth")
+                                if ev.get(k) is not None},
+                        })
+                    else:
+                        fleet["timeline_truncated"] += 1
             elif kind in KNOWN_KINDS:
                 pass  # known but needs no aggregation (checkpoint, ...)
             else:
@@ -297,6 +354,7 @@ def aggregate(events):
         "compiles": compiles,
         "memory": memory,
         "serve": serve,
+        "fleet": fleet,
         "recovery": recovery,
         "lint": lint,
         "overlap": overlap,
@@ -316,8 +374,11 @@ def _fmt_bytes(n):
     return f"{n:.1f} GiB"
 
 
-def print_report(report, out=sys.stdout):
-    w = out.write
+def print_report(report, out=None):
+    # resolve sys.stdout at CALL time — a def-time default would pin
+    # whatever stdout object was installed when this module was first
+    # imported (observed: a pytest capture file from another test)
+    w = (out if out is not None else sys.stdout).write
     w(f"telemetry report — {report['events']} events\n")
     if report["spans"]:
         w("\nspans (host wall-clock):\n")
@@ -458,6 +519,62 @@ def print_report(report, out=sys.stdout):
               f"{kv.get('slots_total')} slots used, "
               f"{_fmt_bytes(kv.get('bytes_per_slot') or 0)}/slot "
               f"({kv.get('cache_dtype')})\n")
+    fleet = report.get("fleet") or {}
+    if fleet.get("starts") or fleet.get("last_report") \
+            or fleet.get("timeline"):
+        w("\nserving fleet (apex_tpu.serving.fleet):\n")
+        for st in fleet.get("starts", []):
+            w(f"  fleet: {st.get('replicas')} replica(s) (max "
+              f"{st.get('max_replicas')}), "
+              f"{st.get('devices_per_replica')} device(s)/replica\n")
+        last = fleet.get("last_report")
+        if last:
+            w(f"  {last.get('requests_completed')} request(s) done "
+              f"({last.get('requests_ok')} ok, "
+              f"{last.get('lost_requests')} lost), "
+              f"{last.get('migrated_requests')} migrated, "
+              f"{last.get('replicas_quarantined')} replica "
+              f"quarantine(s), {last.get('replicas_respawned')} "
+              f"respawn(s), {last.get('scale_ups')} up / "
+              f"{last.get('scale_downs')} down\n")
+            replicas = last.get("replicas") or []
+            if replicas:
+                w(f"  {'replica':>8} {'state':<12} {'disp':>6} "
+                  f"{'done':>6} {'evicted':>8} {'respawns':>9} "
+                  f"{'compiles':>9}\n")
+                for r in replicas:
+                    w(f"  {str(r.get('replica')):>8} "
+                      f"{str(r.get('state')):<12} "
+                      f"{str(r.get('dispatched')):>6} "
+                      f"{str(r.get('completed')):>6} "
+                      f"{str(r.get('evicted')):>8} "
+                      f"{str(r.get('respawns')):>9} "
+                      f"{str(r.get('compile_count')):>9}\n")
+            by_tier = last.get("by_tier") or {}
+            for tier in sorted(by_tier):
+                t = by_tier[tier]
+                p99 = t.get("ttft_p99_ms")
+                w(f"  tier {tier}: {t.get('requests')} request(s), "
+                  f"{t.get('ok')} ok, ttft p99 "
+                  f"{f'{p99:.2f}ms' if p99 is not None else '-'}\n")
+        rebalances = fleet.get("rebalances") or []
+        if rebalances:
+            w(f"  rebalance latency: last {rebalances[-1]:.2f}ms over "
+              f"{len(rebalances)} rebalance(s)\n")
+        timeline = fleet.get("timeline") or []
+        if timeline:
+            w("  event timeline (stream order):\n")
+            for i, row in enumerate(timeline):
+                detail = ", ".join(f"{k}={v}" for k, v in
+                                   sorted(row.get("detail",
+                                                  {}).items()))
+                w(f"    {i:>3} tick "
+                  f"{str(row.get('tick') if row.get('tick') is not None else '?'):>6} "
+                  f"replica {str(row.get('replica')):>3} "
+                  f"{row['event']:<18} {detail}\n")
+            if fleet.get("timeline_truncated"):
+                w(f"    ... {fleet['timeline_truncated']} more row(s) "
+                  f"truncated\n")
     recovery = report.get("recovery") or {}
     if recovery.get("failures") or recovery.get("snapshots") \
             or recovery.get("preempted_exits"):
@@ -576,17 +693,33 @@ def main(argv=None):
                     help="telemetry dirs or .jsonl files")
     ap.add_argument("--json", action="store_true",
                     help="emit the aggregate as JSON")
+    ap.add_argument("--trend", metavar="DIR", default=None,
+                    help="also summarize the cross-round BENCH_*.json "
+                         "trend from DIR (tools/bench_trend.py)")
     args = ap.parse_args(argv)
     paths = collect_paths(args.paths)
     if not paths:
         print("telemetry_report: no .jsonl files found", file=sys.stderr)
         return 1
     report = aggregate(load_events(paths))
+    trend = None
+    if args.trend is not None:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import bench_trend
+
+        trend = bench_trend.build_trend(
+            bench_trend.load_rounds([args.trend]))
+        report["trend"] = trend
     if args.json:
         json.dump(report, sys.stdout, indent=2, default=str)
         print()
     else:
         print_report(report)
+        if trend is not None:
+            import bench_trend
+
+            sys.stdout.write("\n")
+            bench_trend.render(trend)
     return 0
 
 
