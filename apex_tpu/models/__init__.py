@@ -23,6 +23,7 @@ from apex_tpu.models.generation import (  # noqa: F401
     speculative_generate,
     tensor_parallel_beam_search,
     tensor_parallel_generate,
+    verify_step,
 )
 from apex_tpu.models.tp_split import (  # noqa: F401
     split_mla_params_for_tp,

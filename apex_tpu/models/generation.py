@@ -443,6 +443,27 @@ def generate(model, params, prompt_tokens, max_new_tokens: int, *,
     return jnp.concatenate([prompt_tokens, out], axis=1)
 
 
+def verify_step(model, params, cache, chunk, positions):
+    """One speculative-verification forward (pure, trace-friendly).
+
+    ``chunk`` is ``[b, k+1]`` — the last emitted token followed by the
+    draft's k proposals — and ``positions`` the matching absolute
+    positions. The target runs the whole window in ONE chunked forward
+    over its KV cache; the returned greedy verdicts ``v`` are ``[b,
+    k+1] i32`` with ``v[:, i]`` the target argmax for the position
+    after ``chunk[:, i]`` — the acceptance comparison's right-hand
+    side. Returns ``(new_cache, v, logits)`` (full-vocabulary logits
+    ``[b, k+1, vocab]``, the fused sampling/quarantine epilogue's
+    input). The split exists so :func:`speculative_generate` and the
+    serving engine's in-graph speculative decode run the SAME
+    verification body (tests pin both against plain greedy)."""
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              chunk, positions, mutable=["cache"])
+    full = _full_vocab(logits)
+    v = jnp.argmax(full, axis=-1).astype(jnp.int32)
+    return mut["cache"], v, full
+
+
 def _set_cache_index(cache, value):
     """Roll every layer's scalar ``cache_index`` to ``value`` (leaves
     beyond the index stay resident but masked — the decode attention
@@ -512,15 +533,14 @@ def _compiled_speculative(target, draft, plen, max_new, k, eos_token_id,
                                            jnp.arange(k + 1))
             d = ds[:k].T  # [b, k]; ds[k] is the completion feed's output
 
-            # target verifies the whole window in one chunk: logits[i]
-            # predicts the position after chunk[:, i]
+            # target verifies the whole window in one chunk: v[:, i]
+            # predicts the position after chunk[:, i] (the shared
+            # verification body — the serving engine runs the same one)
             chunk = jnp.concatenate([last[:, None], d], axis=1)
             cpos = jnp.broadcast_to((t0 + jnp.arange(k + 1))[None, :],
                                     (b, k + 1))
-            tlg, tmut = target.apply({"params": tparams, "cache": tcache},
-                                     chunk, cpos, mutable=["cache"])
-            tcache = tmut["cache"]
-            v = jnp.argmax(_full_vocab(tlg), -1).astype(jnp.int32)
+            tcache, v, _ = verify_step(target, tparams, tcache, chunk,
+                                       cpos)
 
             match = (d == v[:, :k]).astype(jnp.int32)
             a = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))

@@ -21,6 +21,16 @@ implicated requests, and PreemptionGuard-driven graceful drain — all
 host-side policy, so every failure path holds
 ``assert_no_recompiles``.
 
+Latency multipliers: ``ServeConfig(draft_model=..., draft_params=...)``
+turns every decode dispatch into one speculative draft-k -> verify ->
+rollback round inside the SAME bucket ladder (per-slot mixed
+acceptance, greedy token-identical to the plain engine), and
+``ServeConfig(prefix_cache=True)`` keeps a per-engine host-side
+:class:`~apex_tpu.serving.prefix_cache.PrefixStore` so prompts sharing
+a system prefix seed their KV rows from the cached copy and prefill
+only the suffix bucket. Both leave the AOT compile count exactly at
+the ladder size.
+
 Fleet (:mod:`~apex_tpu.serving.fleet`): a host-side router over N
 engines on distinct mesh slices — load-aware dispatch, per-tier SLOs
 (``Request.tier`` -> tier-default deadlines), a replica health state
@@ -56,6 +66,10 @@ from apex_tpu.serving.kv_cache import (  # noqa: F401
     row_template,
     store_lengths,
     zero_row,
+)
+from apex_tpu.serving.prefix_cache import (  # noqa: F401
+    PrefixEntry,
+    PrefixStore,
 )
 from apex_tpu.serving.robust import (  # noqa: F401
     DecodeFailedError,

@@ -33,7 +33,7 @@ bare traceback. See docs/serving.md for the operational tour.
 
 import dataclasses
 import time
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,7 @@ import numpy as np
 from apex_tpu.models import generation
 from apex_tpu.parallel import compression
 from apex_tpu.serving import kv_cache as kvc
+from apex_tpu.serving.prefix_cache import PrefixStore
 from apex_tpu.telemetry import compile_watch
 from apex_tpu.telemetry import memory as tmemory
 from apex_tpu.telemetry.registry import get_registry
@@ -56,7 +57,23 @@ class ServeConfig:
     length ladder (prompts right-pad up to a bucket, the pad positions
     stay masked by the cache's absolute-position attention). The AOT
     compile count is ``len(batch_buckets) * len(prefill_buckets) +
-    len(batch_buckets)`` — fixed at startup, flat under any traffic.
+    len(batch_buckets)`` — fixed at startup, flat under any traffic,
+    and UNCHANGED by the two serving multipliers below (each swaps an
+    executable's body, never grows the ladder).
+
+    ``draft_model`` (+ ``draft_params``) turns every decode dispatch
+    into one speculative round: the draft proposes
+    ``num_draft_tokens`` greedily, the target verifies the whole
+    window in ONE chunked forward with a fused in-graph sampling /
+    acceptance / rollback epilogue (no host round-trip between draft
+    and verify), and each slot emits its own accepted prefix plus one
+    target token — greedy-only (``temperature`` must stay 0.0; the
+    token-exactness contract of ``speculative_generate``).
+
+    ``prefix_cache`` keeps a per-engine host-side
+    :class:`~apex_tpu.serving.prefix_cache.PrefixStore`: a prompt
+    whose prefix was prefilled before seeds its slot's KV rows from
+    the cached copy and prefills only the suffix bucket.
     """
 
     batch_buckets: Tuple[int, ...] = (1, 2, 4, 8)
@@ -73,6 +90,16 @@ class ServeConfig:
     donate: bool = True                 # donate the store through the step
     preflight: bool = True
     preflight_strict: bool = False
+    # speculative decode (None = plain one-token decode)
+    draft_model: Any = dataclasses.field(default=None, repr=False,
+                                         compare=False)
+    draft_params: Any = dataclasses.field(default=None, repr=False,
+                                          compare=False)
+    num_draft_tokens: int = 4
+    # cross-request prefix cache (host-side, per engine/replica)
+    prefix_cache: bool = False
+    prefix_min_len: int = 4
+    prefix_max_entries: int = 8
 
 
 class ServeEngine:
@@ -119,9 +146,41 @@ class ServeEngine:
             raise ValueError(
                 f"num_slots ({config.num_slots}) must divide evenly "
                 f"over the {mesh.devices.size}-device mesh")
+        self._spec_decode = config.draft_model is not None
+        if self._spec_decode:
+            draft = config.draft_model
+            if config.draft_params is None:
+                raise ValueError("draft_model needs draft_params")
+            if not getattr(draft, "decode", False):
+                raise ValueError("draft_model must be built with "
+                                 "decode=True")
+            if draft.config.vocab_size != model.config.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({draft.config.vocab_size}) != target "
+                    f"vocab ({model.config.vocab_size}): the models "
+                    f"must share a tokenizer")
+            if config.temperature:
+                raise ValueError(
+                    "speculative serving is greedy-only (temperature "
+                    "must be 0.0): verification proves token-exactness "
+                    "against target argmax, which sampling breaks")
+            if config.num_draft_tokens < 1:
+                raise ValueError(
+                    f"num_draft_tokens ({config.num_draft_tokens}) "
+                    f"must be >= 1")
+            limit = min(limit, draft.config.max_position_embeddings)
+            if sb[-1] > limit:
+                raise ValueError(
+                    f"largest prefill bucket ({sb[-1]}) exceeds the "
+                    f"draft model's position budget ({limit})")
         self.model = model
         self.config = dataclasses.replace(config, batch_buckets=bb,
                                           prefill_buckets=sb)
+        self._prefix = bool(config.prefix_cache)
+        self.prefix_store = PrefixStore(
+            max_entries=config.prefix_max_entries,
+            min_len=config.prefix_min_len) if self._prefix else None
+        self.last_prefill_hits = []
         # ``name`` prefixes every AOT registration with the compile
         # watcher: two fleet replicas compile the same ladder with
         # DIFFERENT NamedShardings (distinct device slices), so without
@@ -138,12 +197,20 @@ class ServeEngine:
         self.spec = kvc.KVCacheSpec(model, config.num_slots,
                                     mode=config.cache_mode,
                                     block_size=config.block_size)
+        self.draft_spec = kvc.KVCacheSpec(
+            config.draft_model, config.num_slots,
+            mode=config.cache_mode, block_size=config.block_size) \
+            if self._spec_decode else None
 
-        # --- allocate the store (THE serving HBM cost) under the OOM
+        # --- allocate the store(s) (THE serving HBM cost) under the OOM
         # post-mortem handler, then commit shardings ---------------------
         labels = {"params": params}
+        dstore = dparams = None
         with tmemory.oom_guard(registry=registry, labels=labels):
             store = self.spec.allocate()
+            if self._spec_decode:
+                dstore = self.draft_spec.allocate()
+                dparams = config.draft_params
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -152,47 +219,69 @@ class ServeEngine:
                 self._replicated = NamedSharding(mesh, PartitionSpec())
                 store = jax.device_put(store, self._sharded)
                 params = jax.device_put(params, self._replicated)
+                if self._spec_decode:
+                    dstore = jax.device_put(dstore, self._sharded)
+                    dparams = jax.device_put(dparams, self._replicated)
             else:
                 self._sharded = self._replicated = None
         self._store = store
+        self._draft_store = dstore
         self._params = params
+        self._draft_params = dparams
         self._key0 = jax.random.PRNGKey(0)
         self._step_counter = 0
         self._decode_calls = 0
         self.decode_retries_total = 0
+        self._zero_rows_np = {}      # (bucket, which) -> host zero stack
+        self._zero_rows_dev = {}     # same, pre-device-put (miss fast path)
         # census attribution for every OOM post-mortem from here on:
-        # a serve-time death names KV-cache slots, not anonymous buffers
-        labels["kv_cache"] = self._store
+        # a serve-time death names KV-cache slots (and the draft
+        # model's, when speculating), not anonymous buffers
+        labels.update(self.census_labels())
 
         # --- AOT compile the whole ladder, registered with the watcher --
+        # The ladder SIZE is invariant to the serving multipliers: a
+        # draft model swaps each decode executable's body for the
+        # fused draft-k -> verify -> rollback round, the prefix cache
+        # swaps each prefill's for the seeded suffix form — every
+        # draft/verify executable registers under the engine's
+        # ``name=`` prefix like the rest of the ladder, so fleet
+        # respawn recompile accounting stays exact.
         self._decode_exec = {}
         self._prefill_exec = {}
         self.aot_compile_seconds = 0.0
         decode_lowered = None
         aot = f"{name}/serve" if name else "serve"
+        decode_body = self._spec_decode_fn if self._spec_decode \
+            else self._decode_fn
+        decode_tag = "spec_decode" if self._spec_decode else "decode"
+        prefill_tag = "seeded_prefill" if self._prefix else "prefill"
+        donate = ((0, 1) if self._spec_decode else (0,)) \
+            if config.donate else ()
         with tmemory.oom_guard(registry=registry, labels=labels):
             for b in self.config.batch_buckets:
-                args = (self._store, self._params,
-                        self._ids_aval(b), self._ids_aval(b),
-                        self._key0, self._put(np.int32(-1)))
+                args = self._decode_args(
+                    self._ids_aval(b), self._ids_aval(b), self._key0,
+                    self._put(np.int32(-1)))
                 lowered = jax.jit(
-                    self._decode_fn,
-                    donate_argnums=(0,) if config.donate else ()
-                ).lower(*args)
+                    decode_body, donate_argnums=donate).lower(*args)
                 self._decode_exec[b] = self._compile(
-                    lowered, f"{aot}/{config.cache_mode}/decode_b{b}", args)
+                    lowered,
+                    f"{aot}/{config.cache_mode}/{decode_tag}_b{b}", args)
                 decode_lowered = lowered
                 for s in self.config.prefill_buckets:
-                    pargs = (self._store, self._params,
-                             self._ids_aval(b),
-                             self._tokens_aval(b, s),
-                             self._ids_aval(b), self._key0)
+                    pargs = self._prefill_args(
+                        self._ids_aval(b), self._tokens_aval(b, s),
+                        self._ids_aval(b), self._ids_aval(b),
+                        self._seed_rows_dev(b, "target"),
+                        self._seed_rows_dev(b, "draft"), self._key0)
                     plow = jax.jit(
-                        self._prefill_fn,
-                        donate_argnums=(0,) if config.donate else ()
+                        self._prefill_fn, donate_argnums=donate
                     ).lower(*pargs)
                     self._prefill_exec[(b, s)] = self._compile(
-                        plow, f"{aot}/{config.cache_mode}/prefill_b{b}_s{s}", pargs)
+                        plow,
+                        f"{aot}/{config.cache_mode}/{prefill_tag}"
+                        f"_b{b}_s{s}", pargs)
         if config.temperature:
             # warm the host-side PRNG fold so the first sampled step
             # inside an assert_no_recompiles window compiles nothing
@@ -229,6 +318,13 @@ class ServeEngine:
                       cache_dtype=self.spec.cache_dtype_name(),
                       kv_cache_bytes=self.kv_cache_bytes(),
                       compile_count=self.compile_count,
+                      speculative=self._spec_decode,
+                      num_draft_tokens=(config.num_draft_tokens
+                                        if self._spec_decode else None),
+                      draft_kv_cache_bytes=(self.draft_kv_cache_bytes()
+                                            if self._spec_decode
+                                            else None),
+                      prefix_cache=self._prefix,
                       aot_compile_seconds=round(
                           self.aot_compile_seconds, 4))
 
@@ -265,21 +361,111 @@ class ServeEngine:
         self._step_counter += 1
         return jax.random.fold_in(self._key0, self._step_counter)
 
+    # -- argument assembly (AOT lowering and host dispatch share it) -------
+
+    def _decode_args(self, slot_ids, tokens, key, poison):
+        if self._spec_decode:
+            return (self._store, self._draft_store, self._params,
+                    self._draft_params, slot_ids, tokens, key, poison)
+        return (self._store, self._params, slot_ids, tokens, key,
+                poison)
+
+    def _prefill_args(self, slot_ids, tokens, true_len, start,
+                      prefix_rows, draft_prefix_rows, key):
+        args = [self._store]
+        if self._spec_decode:
+            args.append(self._draft_store)
+        args.append(self._params)
+        if self._spec_decode:
+            args.append(self._draft_params)
+        args += [slot_ids, tokens, true_len]
+        if self._prefix:
+            args += [start, prefix_rows]
+            if self._spec_decode:
+                args.append(draft_prefix_rows)
+        args.append(key)
+        return tuple(args)
+
+    def _host_zero_rows(self, b, which):
+        """Host zero seed stack ``[b, ...]`` in store layout, cached
+        per bucket — the prefix-cache miss filler (and the template
+        the hit path stacks entries into)."""
+        if not self._prefix or (which == "draft"
+                                and not self._spec_decode):
+            return None
+        key = (b, which)
+        if key not in self._zero_rows_np:
+            spec = self.spec if which == "target" else self.draft_spec
+            zero = spec.host_zero_row()
+            self._zero_rows_np[key] = jax.tree_util.tree_map(
+                lambda l: np.zeros((b,) + l.shape, l.dtype), zero)
+        return self._zero_rows_np[key]
+
+    def _seed_rows_dev(self, b, which):
+        """Pre-placed all-miss seed stack (device arrays are
+        immutable, so one placement serves every miss-only prefill)."""
+        rows = self._host_zero_rows(b, which)
+        if rows is None:
+            return None
+        key = (b, which)
+        if key not in self._zero_rows_dev:
+            self._zero_rows_dev[key] = jax.tree_util.tree_map(
+                self._put, rows)
+        return self._zero_rows_dev[key]
+
     @property
     def compile_count(self):
         """AOT executables compiled at startup — the serving compile
-        budget, by construction flat under any traffic shape."""
+        budget, by construction flat under any traffic shape (the
+        speculative and seeded executables REPLACE ladder entries,
+        they never add any)."""
         return len(self._decode_exec) + len(self._prefill_exec)
+
+    @property
+    def spec_enabled(self):
+        """True when decode dispatches run the speculative round
+        (multi-token results — the scheduler branches on this)."""
+        return self._spec_decode
+
+    @property
+    def decode_headroom(self):
+        """Cache positions a decode dispatch may write BEYOND the
+        emitted tokens: the speculative window overshoots by up to
+        ``num_draft_tokens``, so admission must keep ``prompt +
+        max_new + headroom`` inside the position budget."""
+        return self.config.num_draft_tokens if self._spec_decode else 0
+
+    @property
+    def prefix_hits(self):
+        return self.prefix_store.hits if self._prefix else 0
+
+    @property
+    def prefix_lookups(self):
+        return self.prefix_store.lookups if self._prefix else 0
+
+    @property
+    def prefix_hit_tokens(self):
+        return self.prefix_store.hit_tokens if self._prefix else 0
 
     def kv_cache_bytes(self):
         return self.spec.total_bytes()
+
+    def draft_kv_cache_bytes(self):
+        return self.draft_spec.total_bytes() if self._spec_decode else 0
 
     def census_labels(self):
         """OOM post-mortem attribution (`live_buffer_census` matches
         leaves by identity): rebuilt per call because donation replaces
         the store arrays on every dispatch — a serve-time census must
-        name the CURRENT KV-cache slots, not dead buffers."""
-        return {"params": self._params, "kv_cache": self._store}
+        name the CURRENT KV-cache slots, not dead buffers. The draft
+        ladder's buffers are first-class here: a speculative engine's
+        OOM names the draft store and draft weights next to the
+        target's."""
+        labels = {"params": self._params, "kv_cache": self._store}
+        if self._spec_decode:
+            labels["draft_params"] = self._draft_params
+            labels["kv_cache_draft"] = self._draft_store
+        return labels
 
     def slot_lengths(self):
         """Host copy of the per-slot fill levels (one tiny fetch)."""
@@ -300,29 +486,101 @@ class ServeEngine:
             logits, key, cfg.temperature, cfg.top_k, cfg.top_p
         ).astype(jnp.int32)
 
-    def _prefill_fn(self, store, params, slot_ids, tokens, true_len,
-                    key):
-        """Admit a bucket: fresh per-slot prefill at padded length S,
-        cache_index rolled back to each row's true length (pad
-        positions stay resident but masked — the speculative-decode
-        rollback trick), first token sampled from the true last
-        position's logits."""
+    def _unpack_prefill(self, args):
+        it = iter(args)
+        store = next(it)
+        dstore = next(it) if self._spec_decode else None
+        params = next(it)
+        dparams = next(it) if self._spec_decode else None
+        slot_ids, tokens, true_len = next(it), next(it), next(it)
+        start = prefix_rows = dprefix_rows = None
+        if self._prefix:
+            start, prefix_rows = next(it), next(it)
+            if self._spec_decode:
+                dprefix_rows = next(it)
+        return (store, dstore, params, dparams, slot_ids, tokens,
+                true_len, start, prefix_rows, dprefix_rows, next(it))
+
+    def _prefill_one_model(self, model, params, spec, tokens, true_len,
+                           start, prefix_rows):
+        """vmapped per-slot prefill for one model (target or draft):
+        seeds from the passed FULL-PRECISION prefix rows (prefix mode
+        — the row's ``cache_index`` rolls to the cut, so a shorter
+        cached prefix is just a smaller index; positions past it stay
+        resident but masked) or from a zero row, prefills the (suffix)
+        tokens at offset positions, and rolls ``cache_index`` to the
+        true end.
+
+        Exactness hinges on the seeds being raw (model-layout, never
+        dequantized): the suffix forward then attends over EXACTLY the
+        prefix K/V a cold full prefill would have computed, and
+        re-quantizing the raw prefix reproduces the cold store's int8
+        blocks bit-for-bit (same values, same deterministic grid).
+        Seeding from dequantized int8 instead would perturb every
+        suffix K/V through the lossy prefix — enough to flip a
+        near-tie argmax many tokens later (caught by the 8-device
+        verify probe).
+
+        Returns ``(store_rows, raw_rows, last_logits)`` — the
+        quantized rows for the store scatter and the raw merged rows
+        the host caches for future hits."""
         s = tokens.shape[1]
 
-        def one(tok_row, n):
+        def one(tok_row, n, st, prow):
+            if self._prefix:
+                base = generation._set_cache_index(prow, st)
+                pos = (st + jnp.arange(s))[None, :]
+                end = st + n
+            else:
+                base = kvc.zero_row(spec.template)
+                pos = jnp.arange(s)[None, :]
+                end = n
             cache, logits = generation.prefill(
-                self.model, params, kvc.zero_row(self.spec.template),
-                tok_row[None, :], jnp.arange(s)[None, :],
+                model, params, base, tok_row[None, :], pos,
                 full_logits=True)
             last = logits[0, n - 1]                  # [vocab], true last
-            return generation._set_cache_index(cache, n), last
+            return generation._set_cache_index(cache, end), last
 
-        rows, last_logits = jax.vmap(one)(tokens, true_len)
+        if self._prefix:
+            raw, last_logits = jax.vmap(one)(tokens, true_len, start,
+                                             prefix_rows)
+        else:
+            raw, last_logits = jax.vmap(
+                lambda t, n: one(t, n, None, None))(tokens, true_len)
+        return spec.quantize_rows(raw), raw, last_logits
+
+    def _prefill_fn(self, *args):
+        """Admit a bucket: per-slot prefill at padded length S,
+        cache_index rolled back to each row's true end (pad positions
+        stay resident but masked — the speculative-decode rollback
+        trick), first token sampled from the true last position's
+        TARGET logits. With a draft model the draft cache prefills the
+        same tokens in the same executable (lockstep fill levels);
+        with the prefix cache the merged store-layout rows ride out as
+        extra outputs so the host can cache them for future hits."""
+        (store, dstore, params, dparams, slot_ids, tokens, true_len,
+         start, prefix_rows, dprefix_rows, key) = \
+            self._unpack_prefill(args)
+        rows, raw, last_logits = self._prefill_one_model(
+            self.model, params, self.spec, tokens, true_len, start,
+            prefix_rows)
         first = self._sample(last_logits, key)
-        rows = self.spec.quantize_rows(rows)
         store = jax.tree_util.tree_map(
             lambda st, r: st.at[slot_ids].set(r), store, rows)
-        return store, first
+        out = [store]
+        if self._spec_decode:
+            drows, draw, _ = self._prefill_one_model(
+                self.config.draft_model, dparams, self.draft_spec,
+                tokens, true_len, start, dprefix_rows)
+            dstore = jax.tree_util.tree_map(
+                lambda st, r: st.at[slot_ids].set(r), dstore, drows)
+            out.append(dstore)
+        out.append(first)
+        if self._prefix:
+            out.append(raw)
+            if self._spec_decode:
+                out.append(draw)
+        return tuple(out)
 
     def _decode_fn(self, store, params, slot_ids, tokens, key,
                    poison_slot):
@@ -371,6 +629,103 @@ class ServeEngine:
             lambda st, r: st.at[slot_ids].set(r), store, updated)
         return store, nxt, finite
 
+    def _spec_decode_fn(self, store, dstore, params, dparams, slot_ids,
+                        tokens, key, poison_slot):
+        """One speculative continuous-batching round over a slot
+        bucket — the fused draft -> verify -> accept -> rollback
+        epilogue in ONE executable (no host round-trip between draft
+        and verification):
+
+        per slot (vmapped, each at its own fill level ``n``): the
+        draft greedily proposes ``k`` tokens through its own cache
+        (plus the completion feed, so a full accept leaves no hole);
+        the target verifies the whole ``[last, d_1..d_k]`` window in
+        one chunked forward (:func:`generation.verify_step` — the same
+        body ``speculative_generate`` runs); the slot emits its
+        longest matching prefix plus one target token (correction on
+        mismatch, bonus on full accept) — per-slot MIXED acceptance,
+        no batch minimum — and both caches roll their ``cache_index``
+        back to ``n + accepted + 1``: rejected positions stay resident
+        but masked (the trick this engine's prefill was built on)
+        until the next round overwrites them. int8 stores re-quantize
+        exactly the ``k + 1``-position window; untouched blocks pass
+        through bit-identical.
+
+        Per-slot quarantine rides along unchanged: non-finite
+        verification logits (or the ``poison_slot`` injection handle)
+        zero the slot's rows in BOTH stores and emit one pad token.
+
+        Returns ``(store, dstore, emitted [b, k+1], counts [b],
+        finite [b])`` — ``emitted[i, :counts[i]]`` are slot i's
+        verified tokens, every one a target argmax over its own
+        prefix (token-identical to the plain decode engine)."""
+        k = int(self.config.num_draft_tokens)
+        draft = self.config.draft_model
+        rows = jax.tree_util.tree_map(lambda l: l[slot_ids], store)
+        drows = jax.tree_util.tree_map(lambda l: l[slot_ids], dstore)
+        model_rows = self.spec.materialize_rows(rows)
+        draft_rows = self.draft_spec.materialize_rows(drows)
+        lengths = kvc.store_lengths(model_rows)
+        poisoned = slot_ids == poison_slot
+        pad = jnp.asarray(self.config.pad_token_id, jnp.int32)
+
+        def one(trow, drow, tok, n, bad):
+            trow = generation._set_cache_index(trow, n)
+            drow = generation._set_cache_index(drow, n)
+
+            def dstep(carry, i):
+                dc, t = carry
+                dc, lg = generation.decode_step(
+                    draft, dparams, dc, t[None, None],
+                    jnp.full((1, 1), n + i, jnp.int32))
+                nxt = jnp.argmax(
+                    lg[0].astype(jnp.float32), -1).astype(jnp.int32)
+                return (dc, nxt), nxt
+
+            # k proposals + one completion feed of d_k (the draft
+            # cache must hold every position before the next round's
+            # feed, full accept included)
+            (drow, _), ds = jax.lax.scan(dstep, (drow, tok),
+                                         jnp.arange(k + 1))
+            d = ds[:k]                                     # [k]
+            chunk = jnp.concatenate([tok[None], d])[None, :]
+            cpos = (n + jnp.arange(k + 1))[None, :]
+            trow, v, logits = generation.verify_step(
+                self.model, params, trow, chunk, cpos)
+            v, logits = v[0], logits[0]          # [k+1], [k+1, vocab]
+            logits = jnp.where(bad, jnp.asarray(jnp.nan, logits.dtype),
+                               logits)
+            finite = jnp.all(jnp.isfinite(logits.astype(jnp.float32)))
+            match = (d == v[:k]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(match))      # accepted draft count
+            emit = jnp.where(jnp.arange(k + 1) == a, jnp.take(v, a),
+                             jnp.concatenate([d, d[-1:]]))
+            emit = jnp.where(finite, emit, pad).astype(jnp.int32)
+            count = jnp.where(finite, a + 1, 1).astype(jnp.int32)
+            trow = generation._set_cache_index(trow, n + count)
+            drow = generation._set_cache_index(drow, n + count)
+            return trow, drow, emit, count, finite
+
+        new_rows, new_drows, emit, counts, finite = jax.vmap(one)(
+            model_rows, draft_rows, tokens, lengths, poisoned)
+        updated = self.spec.update_rows_span(rows, new_rows, lengths,
+                                             k + 1)
+        dupdated = self.draft_spec.update_rows_span(
+            drows, new_drows, lengths, k + 1)
+        b = finite.shape[0]
+
+        def keep(u):
+            f = finite.reshape((b,) + (1,) * (u.ndim - 1))
+            return jnp.where(f, u, jnp.zeros_like(u))
+
+        updated = jax.tree_util.tree_map(keep, updated)
+        dupdated = jax.tree_util.tree_map(keep, dupdated)
+        store = jax.tree_util.tree_map(
+            lambda st, r: st.at[slot_ids].set(r), store, updated)
+        dstore = jax.tree_util.tree_map(
+            lambda st, r: st.at[slot_ids].set(r), dstore, dupdated)
+        return store, dstore, emit, counts, finite
+
     # -- host API (the scheduler's surface) --------------------------------
 
     def _padded_ids(self, slot_ids, pad_slot_ids, bucket):
@@ -395,29 +750,148 @@ class ServeEngine:
         ``slot_ids[i]`` and return the first generated token per
         prompt, ``np.ndarray [len(prompts)]``. Pads the call up to the
         smallest (batch, seq) bucket pair; TTFT is this call's wall
-        clock (it blocks on the sampled tokens)."""
+        clock (it blocks on the sampled tokens).
+
+        With the prefix cache on, each prompt first consults the
+        host-side :class:`PrefixStore`: a hit seeds the slot's KV rows
+        from the cached copy and only the SUFFIX picks the seq bucket
+        — so a long shared system prompt costs its bucket once,
+        ever — and every prefilled prompt's merged rows are cached for
+        future hits. ``last_prefill_hits`` records the per-prompt cut
+        (0 = miss) for the scheduler's hit accounting."""
         if len(slot_ids) != len(prompts):
             raise ValueError("slot_ids and prompts disagree")
         n = len(prompts)
         plens = [len(p) for p in prompts]
         if min(plens) < 1:
             raise ValueError("empty prompt")
-        sbucket = self._pick_bucket(self.config.prefill_buckets,
-                                    max(plens), "prompt length")
         bbucket = self._pick_bucket(self.config.batch_buckets, n,
                                     "prefill batch")
         ids = self._padded_ids(slot_ids, pad_slot_ids, bbucket)
+        if not self._prefix:
+            self.last_prefill_hits = [0] * n
+            sbucket = self._pick_bucket(self.config.prefill_buckets,
+                                        max(plens), "prompt length")
+            toks = np.full((bbucket, sbucket),
+                           self.config.pad_token_id, np.int32)
+            lens = np.ones((bbucket,), np.int32)
+            for i, p in enumerate(prompts):
+                toks[i, :plens[i]] = np.asarray(p, np.int32)
+                lens[i] = plens[i]
+            args = self._prefill_args(
+                self._put(np.asarray(ids, np.int32)), self._put(toks),
+                self._put(lens), None, None, None, self._key())
+            out = self._prefill_exec[(bbucket, sbucket)](*args)
+            if self._spec_decode:
+                self._store, self._draft_store, first = out
+            else:
+                self._store, first = out
+            return np.asarray(first)[:n]
+        return self._prefill_seeded(ids, prompts, plens, n, bbucket)
+
+    def _prefill_seeded(self, ids, prompts, plens, n, bbucket):
+        """The prefix-cache admission path: look up cuts, assemble the
+        per-slot seed stack (cached entry rows on a hit, zeros on a
+        miss), prefill only the suffix bucket, then cache the merged
+        rows of every newly-seen prompt."""
+        lookups = [self.prefix_store.lookup(p) for p in prompts]
+        cuts = [c for c, _ in lookups]
+        suffix_lens = [plen - c for plen, c in zip(plens, cuts)]
+        sbucket = self._pick_bucket(self.config.prefill_buckets,
+                                    max(suffix_lens),
+                                    "prompt suffix length")
         toks = np.full((bbucket, sbucket), self.config.pad_token_id,
                        np.int32)
         lens = np.ones((bbucket,), np.int32)
-        for i, p in enumerate(prompts):
-            toks[i, :plens[i]] = np.asarray(p, np.int32)
-            lens[i] = plens[i]
-        self._store, first = self._prefill_exec[(bbucket, sbucket)](
-            self._store, self._params, self._put(np.asarray(ids,
-                                                            np.int32)),
-            self._put(toks), self._put(lens), self._key())
+        starts = np.zeros((bbucket,), np.int32)
+        for i, (p, (cut, _)) in enumerate(zip(prompts, lookups)):
+            suffix = np.asarray(p, np.int32)[cut:]
+            toks[i, :suffix.shape[0]] = suffix
+            lens[i] = suffix.shape[0]
+            starts[i] = cut
+        hits = sum(1 for c in cuts if c)
+        if hits:
+            # assemble per-slot: entry rows on hit, zeros elsewhere
+            prows = self._stack_seed_rows(lookups, bbucket, "rows")
+            dprows = self._stack_seed_rows(lookups, bbucket,
+                                           "draft_rows") \
+                if self._spec_decode else None
+        else:
+            # miss-only groups reuse the pre-placed zero stack — no
+            # host assembly, no fresh transfer
+            prows = self._seed_rows_dev(bbucket, "target")
+            dprows = self._seed_rows_dev(bbucket, "draft")
+        args = self._prefill_args(
+            self._put(np.asarray(ids, np.int32)), self._put(toks),
+            self._put(lens), self._put(starts), prows, dprows,
+            self._key())
+        out = list(self._prefill_exec[(bbucket, sbucket)](*args))
+        self._store = out.pop(0)
+        if self._spec_decode:
+            self._draft_store = out.pop(0)
+        first = out.pop(0)
+        rows = out.pop(0)
+        drows = out.pop(0) if self._spec_decode else None
+        self.last_prefill_hits = cuts
+        self._record_prefix(prompts, plens, cuts, hits, sbucket, rows,
+                            drows)
         return np.asarray(first)[:n]
+
+    def _host_zero_row(self, attr):
+        key = ("zero_row", attr)
+        if key not in self._zero_rows_np:
+            spec = self.spec if attr == "rows" else self.draft_spec
+            self._zero_rows_np[key] = spec.host_zero_row()
+        return self._zero_rows_np[key]
+
+    def _stack_seed_rows(self, lookups, bbucket, attr):
+        """[bbucket]-stacked host seed rows: cached entry rows where a
+        lookup hit, zeros elsewhere (pads included). Entry rows and
+        the zero row share the raw model-layout treedef, so one
+        tree_map stacks them leaf-wise."""
+        zero = self._host_zero_row(attr)
+        picks = [getattr(e, attr) if (c and e is not None) else zero
+                 for c, e in lookups]
+        picks += [zero] * (bbucket - len(picks))
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: np.stack(leaves), *picks)
+        return jax.tree_util.tree_map(self._put, stacked)
+
+    def _record_prefix(self, prompts, plens, cuts, hits, sbucket, rows,
+                       drows):
+        """Hit accounting + insertion of newly-seen prompts (host
+        copies of the RAW merged rows — full precision, so a future
+        hit's suffix forward sees exactly what this cold prefill
+        saw)."""
+        n = len(prompts)
+        reg = self._reg()
+        if reg.enabled:
+            reg.counter("serve/prefix_hits").inc(hits)
+            reg.counter("serve/prefix_misses").inc(n - hits)
+            hit_toks = sum(cuts)
+            if hit_toks:
+                reg.counter("serve/prefix_hit_tokens").inc(hit_toks)
+            reg.event("serve", "prefix_lookup", prompts=n, hits=hits,
+                      hit_tokens=hit_toks, suffix_bucket=sbucket,
+                      entries=len(self.prefix_store),
+                      store_bytes=self.prefix_store.total_bytes())
+        inserts = [i for i in range(n)
+                   if plens[i] > self.prefix_store.min_len
+                   and not self.prefix_store.covers(prompts[i])]
+        if not inserts:
+            return
+        host_rows = jax.tree_util.tree_map(np.asarray, rows)
+        host_drows = jax.tree_util.tree_map(np.asarray, drows) \
+            if drows is not None else None
+        for i in inserts:
+            # np.copy (not ascontiguousarray — that promotes 0-d
+            # scalars like cache_index to 1-d) detaches the slice
+            row_i = jax.tree_util.tree_map(
+                lambda l: np.copy(l[i]), host_rows)
+            drow_i = jax.tree_util.tree_map(
+                lambda l: np.copy(l[i]), host_drows) \
+                if host_drows is not None else None
+            self.prefix_store.insert(prompts[i], row_i, drow_i)
 
     def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
                guarded=True, retries=0, backoff_s=0.05,
@@ -427,7 +901,11 @@ class ServeEngine:
         ``np.ndarray [len(slot_ids)]``, ``finite[i]`` False iff slot
         ``i``'s logits went non-finite this step (its KV rows are
         already reset in-graph; the scheduler evicts it as
-        ``poisoned``).
+        ``poisoned``). A speculative engine (``spec_enabled``)
+        dispatches one fused draft-verify round instead and returns
+        ``(emitted [n, k+1], counts [n], finite [n])`` — slot i's
+        verified tokens are ``emitted[i, :counts[i]]``; everything
+        below (guarding, retries, injection) is identical.
 
         Dispatch runs under ``resilience.guarded_call``
         (``guarded=False`` opts out): an HBM exhaustion mid-traffic
@@ -457,17 +935,16 @@ class ServeEngine:
         for attempt in range(int(retries) + 1):
             try:
                 faults.maybe_fail_decode(step_idx)
-                args = (self._store, self._params,
-                        self._put(np.asarray(ids, np.int32)),
-                        self._put(toks), key,
-                        self._put(np.int32(poison)))
+                args = self._decode_args(
+                    self._put(np.asarray(ids, np.int32)),
+                    self._put(toks), key, self._put(np.int32(poison)))
                 if guarded:
-                    store, nxt, finite = resilience.guarded_call(
+                    out = resilience.guarded_call(
                         self._decode_exec[bbucket], *args,
                         registry=self._registry,
                         labels=self.census_labels())
                 else:
-                    store, nxt, finite = self._decode_exec[bbucket](*args)
+                    out = self._decode_exec[bbucket](*args)
                 break
             except Exception as e:  # noqa: BLE001 — classified below
                 if not robust.is_retryable_decode_error(e):
@@ -486,7 +963,11 @@ class ServeEngine:
                           attempt=attempt, error=type(e).__name__)
                 time.sleep(robust.retry_backoff_s(
                     attempt, backoff_s, backoff_cap_s))
-        self._store = store
+        if self._spec_decode:
+            self._store, self._draft_store, emit, counts, finite = out
+            return (np.asarray(emit)[:n], np.asarray(counts)[:n],
+                    np.asarray(finite)[:n])
+        self._store, nxt, finite = out
         return np.asarray(nxt)[:n], np.asarray(finite)[:n]
 
     def serve(self, requests, *, robust=None, guard=None, **kw):

@@ -268,6 +268,14 @@ class Replica:
             "evicted": self.evicted,
             "respawns": self.respawns,
             "compile_count": getattr(self.engine, "compile_count", None),
+            # the serving multipliers are per-replica state: each
+            # replica keeps its own prefix store (a migrated
+            # continuation re-prefills on the survivor and hits
+            # whatever the SURVIVOR's traffic already cached) and its
+            # own acceptance counters
+            "prefix_hits": getattr(self.engine, "prefix_hits", 0),
+            "spec_accepted": getattr(self.sched, "spec_accepted", 0),
+            "spec_proposed": getattr(self.sched, "spec_proposed", 0),
         }
 
 
@@ -318,6 +326,11 @@ class ServeFleet:
         self.scale_ups = 0
         self.scale_downs = 0
         self.lost_requests = 0
+        # lifetime prefix/spec totals folded in when an engine drops
+        # (quarantine/retire) so respawns never erase the accounting
+        self._multiplier_totals = {"prefix_lookups": 0, "prefix_hits": 0,
+                                   "spec_accepted": 0,
+                                   "spec_proposed": 0}
         self.migrated_rids = set()
         self.rebalance_ms: List[float] = []
         self._rebalance = None       # {"t0": wall, "rids": set}
@@ -638,6 +651,11 @@ class ServeFleet:
                 > self.config.drain_deadline_s)
 
     def _drop_engine(self, rep):
+        t = self._multiplier_totals
+        t["prefix_lookups"] += getattr(rep.engine, "prefix_lookups", 0)
+        t["prefix_hits"] += getattr(rep.engine, "prefix_hits", 0)
+        t["spec_accepted"] += getattr(rep.sched, "spec_accepted", 0)
+        t["spec_proposed"] += getattr(rep.sched, "spec_proposed", 0)
         rep.engine = None
         rep.sched = None
         rep._drain_started_wall = None
@@ -978,7 +996,23 @@ class ServeFleet:
             if c.finish_reason in robust_mod.OK_STATUSES:
                 goodput_tokens += len(c.tokens)
         tiers = self._tier_rollup()
+        mult = dict(self._multiplier_totals)
+        for rep in self.replicas:
+            mult["prefix_lookups"] += getattr(rep.engine,
+                                              "prefix_lookups", 0)
+            mult["prefix_hits"] += getattr(rep.engine, "prefix_hits", 0)
+            mult["spec_accepted"] += getattr(rep.sched,
+                                             "spec_accepted", 0)
+            mult["spec_proposed"] += getattr(rep.sched,
+                                             "spec_proposed", 0)
         return {
+            "prefix_hits": mult["prefix_hits"],
+            "prefix_hit_rate": round(
+                mult["prefix_hits"] / mult["prefix_lookups"], 4)
+            if mult["prefix_lookups"] else None,
+            "spec_acceptance_rate": round(
+                mult["spec_accepted"] / mult["spec_proposed"], 4)
+            if mult["spec_proposed"] else None,
             "requests_completed": len(self.completed),
             "requests_ok": sum(by_reason.get(r, 0)
                                for r in robust_mod.OK_STATUSES),

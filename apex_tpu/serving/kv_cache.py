@@ -152,6 +152,20 @@ class KVCacheSpec:
 
         return jax.tree_util.tree_map_with_path(leaf, self.template)
 
+    def host_zero_row(self):
+        """Host numpy zero row in MODEL layout (one slot, no leading
+        axis, full-precision K/V even in int8 mode) — the
+        prefix-cache's seed template: cached entries are RAW rows (a
+        hit's suffix forward must attend over exactly the
+        full-precision prefix K/V a cold prefill computed — seeding
+        dequantized int8 would perturb every suffix K/V), and a miss
+        seeds from these zeros (``cache_index`` 0 masks every
+        position, so the content is never attended)."""
+        # sd.dtype is numpy-compatible (ml_dtypes registers bf16)
+        return jax.tree_util.tree_map(
+            lambda sd: np.zeros(tuple(sd.shape), sd.dtype),
+            self.template)
+
     # -- bytes accounting --------------------------------------------------
 
     def _leaf_bytes(self, sd, *, kv_itemsize=None):
@@ -273,6 +287,61 @@ class KVCacheSpec:
             out.append({
                 "q": jnp.where(mask, q_new, q_old),
                 "scale": jnp.where(mask, s_new, s_old),
+            })
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def update_rows_span(self, store_rows, new_rows, start, span):
+        """Merge a ``span``-position K/V append back into quantized
+        rows — the multi-position sibling of :meth:`update_rows_at`.
+
+        ``start`` is ``[B] i32`` (each row's first written position),
+        ``span`` a STATIC int: positions ``[start[i], start[i] +
+        span)`` are (re)quantized per row, every other block's int8
+        payload and scale pass through bit-identical — the same
+        no-drift invariant, widened for the speculative-decode window
+        (one draft-k round appends ``k + 1`` positions) and the
+        prefix-cache suffix prefill (a seeded slot re-quantizes only
+        its suffix bucket; the inherited prefix blocks copy
+        bit-identically). ``span == 1`` degenerates to
+        :meth:`update_rows_at`. bf16 mode returns ``new_rows``
+        unchanged."""
+        if self.mode != "int8":
+            return new_rows
+        span = int(span)
+        # quantize every position of the fresh rows (the positions axis
+        # is preserved, so each position's blocks are independent), then
+        # select per position: inside the span the fresh blocks land,
+        # outside the OLD int8 payload + scale pass through bit-exactly
+        # — the jnp.where never touches their bits. The extra quantize
+        # work outside the span is discarded by the select; the span
+        # paths (speculative window, suffix prefill) are not the
+        # per-token hot loop, which keeps update_rows_at's 1-position
+        # form.
+        fresh = self.quantize_rows(new_rows)
+        flat_store, treedef = jax.tree_util.tree_flatten_with_path(
+            store_rows,
+            is_leaf=lambda l: isinstance(l, dict) and "q" in l)
+        fresh_by_path = {
+            _names(p): leaf for p, leaf in
+            jax.tree_util.tree_flatten_with_path(
+                fresh,
+                is_leaf=lambda l: isinstance(l, dict) and "q" in l)[0]}
+        b = start.shape[0]
+        out = []
+        for path, leaf in flat_store:
+            names = _names(path)
+            new_leaf = fresh_by_path[names]
+            if not (isinstance(leaf, dict) and "q" in leaf):
+                out.append(new_leaf)
+                continue
+            q_old, s_old = leaf["q"], leaf["scale"]
+            t = q_old.shape[-3]
+            pos = jnp.arange(t).reshape((t, 1, 1))
+            lo = start.reshape((b,) + (1,) * (q_old.ndim - 1))
+            mask = (pos >= lo) & (pos < lo + span)
+            out.append({
+                "q": jnp.where(mask, new_leaf["q"], q_old),
+                "scale": jnp.where(mask, new_leaf["scale"], s_old),
             })
         return jax.tree_util.tree_unflatten(treedef, out)
 

@@ -1,0 +1,195 @@
+"""Cross-request prefix cache: shared system prompts prefill once.
+
+The realistic millions-of-users trace is prefix-heavy — most prompts
+open with one of a handful of system prompts — so the engine keeps a
+**host-side** store of previously prefilled prompts keyed by prompt
+prefix hash. On admission the engine looks up the longest cached
+prefix of the new prompt; on a hit the slot's KV rows are *seeded*
+from the cached entry (the rows ride into the AOT prefill executable
+as an argument) and the prefill runs only the *suffix* bucket at
+offset positions, so TTFT drops roughly with the shared fraction.
+
+Two properties make this safe without any new executables:
+
+- **rollback generality**: a cached entry holds one slot's FULL row
+  buffers with every prefilled position resident; reusing a *shorter*
+  prefix of the same entry is just a smaller ``cache_index`` at seed
+  time (positions past the cut stay resident but masked — the same
+  trick speculative rejection uses), so one entry serves every prompt
+  sharing any prefix of its tokens;
+- **raw-value exactness**: entries are host numpy copies of the RAW
+  (model-layout, full-precision) rows the prefill computed — never
+  the quantized store form. A hit's suffix forward therefore attends
+  over exactly the prefix K/V a cold full prefill would have
+  computed, and re-quantizing the raw prefix inside the seeded
+  prefill reproduces the cold store's int8 blocks bit-for-bit (same
+  values through the same deterministic grid). Seeding dequantized
+  int8 instead perturbs every suffix K/V through the lossy prefix —
+  enough to flip a near-tie argmax many tokens later — which is why
+  the entries deliberately pay full-precision host bytes.
+
+Everything here is plain numpy + dict bookkeeping: nothing traces,
+nothing compiles, so the engine's flat-compile invariant is untouched.
+The store is per-engine — in a fleet that means per-replica (a
+migrated continuation re-prefills on the survivor and hits whatever
+the *survivor's* traffic already cached). Memory is bounded by
+``max_entries`` x bytes-per-entry (one slot row, plus the draft row
+when speculative decode is on) with LRU eviction; docs/serving.md has
+the accounting worked example.
+"""
+
+import hashlib
+
+import jax
+import numpy as np
+
+
+def _tok_bytes(tokens):
+    return np.ascontiguousarray(np.asarray(tokens, np.int32)).tobytes()
+
+
+def _common_prefix_len(a, b):
+    n = min(a.shape[0], b.shape[0])
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if neq.size else n
+
+
+def _tree_bytes(tree):
+    return int(sum(np.asarray(leaf).nbytes
+                   for leaf in jax.tree_util.tree_leaves(tree)))
+
+
+class PrefixEntry:
+    """One cached prompt: its tokens plus host copies of the RAW
+    (model-layout, full-precision) slot row buffers the prefill
+    produced — and the draft-model row when the engine decodes
+    speculatively."""
+
+    __slots__ = ("tokens", "rows", "draft_rows", "hits", "bytes")
+
+    def __init__(self, tokens, rows, draft_rows=None):
+        self.tokens = np.asarray(tokens, np.int32)
+        self.rows = rows
+        self.draft_rows = draft_rows
+        self.hits = 0
+        self.bytes = _tree_bytes(rows) + (
+            _tree_bytes(draft_rows) if draft_rows is not None else 0)
+
+
+class PrefixStore:
+    """Bounded LRU store of prefilled prompts keyed by prefix hash.
+
+    ``min_len`` is both the keying width (entries index under the hash
+    of their first ``min_len`` tokens, so lookup only scans candidates
+    that share at least that much) and the floor below which hits are
+    not worth seeding. Lookup returns the longest common prefix with
+    any candidate, capped at ``len(prompt) - 1`` — the suffix prefill
+    needs at least one real token to sample the first output from.
+    """
+
+    def __init__(self, *, max_entries=8, min_len=4):
+        if max_entries < 1:
+            raise ValueError(f"max_entries ({max_entries}) must be >= 1")
+        if min_len < 1:
+            raise ValueError(f"min_len ({min_len}) must be >= 1")
+        self.max_entries = int(max_entries)
+        self.min_len = int(min_len)
+        self._order = []             # LRU order: index 0 = oldest
+        self._index = {}             # prefix-hash key -> [entries]
+        self.lookups = 0
+        self.hits = 0
+        self.hit_tokens = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def _key(self, tokens):
+        return hashlib.sha1(
+            _tok_bytes(tokens[:self.min_len])).hexdigest()
+
+    def __len__(self):
+        return len(self._order)
+
+    def total_bytes(self):
+        return sum(e.bytes for e in self._order)
+
+    def _touch(self, entry):
+        self._order.remove(entry)
+        self._order.append(entry)
+
+    def _drop(self, entry):
+        self._order.remove(entry)
+        bucket = self._index[self._key(entry.tokens)]
+        bucket.remove(entry)
+        if not bucket:
+            del self._index[self._key(entry.tokens)]
+
+    def lookup(self, prompt):
+        """Longest usable cached prefix of ``prompt``: returns
+        ``(cut, entry)`` with ``cut`` the number of prefix tokens the
+        entry covers (``0, None`` on a miss). ``cut`` never exceeds
+        ``len(prompt) - 1`` and never undershoots ``min_len``."""
+        prompt = np.asarray(prompt, np.int32)
+        self.lookups += 1
+        if prompt.shape[0] <= self.min_len:
+            return 0, None
+        best_cut, best = 0, None
+        for entry in self._index.get(self._key(prompt), ()):
+            cut = min(_common_prefix_len(entry.tokens, prompt),
+                      prompt.shape[0] - 1)
+            if cut >= self.min_len and cut > best_cut:
+                best_cut, best = cut, entry
+        if best is None:
+            return 0, None
+        self._touch(best)
+        best.hits += 1
+        self.hits += 1
+        self.hit_tokens += best_cut
+        return best_cut, best
+
+    def covers(self, prompt):
+        """True when some entry already shares ``prompt`` entirely —
+        inserting it again would add bytes but no new reusable
+        prefix."""
+        prompt = np.asarray(prompt, np.int32)
+        return any(
+            _common_prefix_len(e.tokens, prompt) >= prompt.shape[0]
+            for e in self._index.get(self._key(prompt), ()))
+
+    def insert(self, prompt, rows, draft_rows=None):
+        """Cache one prefilled prompt (host numpy copies of the raw
+        model-layout rows). Refuses prompts shorter than ``min_len`` + 1 (nothing
+        to key on plus a suffix) and exact re-covers; an entry whose
+        prompt is a strict prefix of the new one is replaced (the
+        longer entry serves every shorter cut); evicts LRU past
+        ``max_entries``. Returns the entry or None."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.shape[0] <= self.min_len or self.covers(prompt):
+            return None
+        key = self._key(prompt)
+        for old in list(self._index.get(key, ())):
+            if (_common_prefix_len(old.tokens, prompt)
+                    >= old.tokens.shape[0]):
+                self._drop(old)
+        entry = PrefixEntry(prompt, rows, draft_rows)
+        self._order.append(entry)
+        self._index.setdefault(key, []).append(entry)
+        self.insertions += 1
+        while len(self._order) > self.max_entries:
+            self._drop(self._order[0])
+            self.evictions += 1
+        return entry
+
+    def stats(self):
+        return {
+            "entries": len(self._order),
+            "bytes": self.total_bytes(),
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "hit_rate": (self.hits / self.lookups) if self.lookups
+            else 0.0,
+            "hit_tokens": self.hit_tokens,
+            "insertions": self.insertions,
+            "evictions": self.evictions,
+        }

@@ -94,20 +94,34 @@ class CompletedRequest:
 
 def synthetic_trace(n_requests=16, *, seed=0, mean_interarrival=0.5,
                     prompt_lens=(4, 8, 12, 24), max_new=(8, 16, 24),
-                    vocab_size=256):
+                    vocab_size=256, shared_prefix_len=0,
+                    shared_frac=0.8):
     """Deterministic many-user trace: Poisson arrivals (exponential
     inter-arrival gaps in decode ticks) with mixed prompt/output
     lengths — the bench.py ``serve_decode`` workload. Same seed, same
-    trace, byte for byte."""
+    trace, byte for byte.
+
+    ``shared_prefix_len > 0`` makes the trace prefix-heavy (the
+    realistic millions-of-users shape): one ``shared_prefix_len``-token
+    system prompt is drawn once, and each request opens with it with
+    probability ``shared_frac`` (its ``prompt_lens`` draw then sizes
+    the UNIQUE tail). The default (0) leaves the legacy byte stream
+    untouched — no extra RNG draws happen."""
     rs = np.random.RandomState(seed)
     gaps = rs.exponential(mean_interarrival, size=n_requests)
     arrivals = np.cumsum(gaps) - gaps[0]        # first request at t=0
+    shared = (rs.randint(0, vocab_size,
+                         size=int(shared_prefix_len)).astype(np.int32)
+              if shared_prefix_len else None)
     out = []
     for i in range(n_requests):
         plen = int(rs.choice(prompt_lens))
+        prompt = rs.randint(0, vocab_size, size=plen).astype(np.int32)
+        if shared is not None and rs.random_sample() < shared_frac:
+            prompt = np.concatenate([shared, prompt])
         out.append(Request(
             rid=i,
-            prompt=rs.randint(0, vocab_size, size=plen).astype(np.int32),
+            prompt=prompt,
             max_new_tokens=int(rs.choice(max_new)),
             arrival=float(arrivals[i])))
     return out
@@ -164,6 +178,13 @@ class Scheduler:
         self._eligible_wall = {}
         self._ttft_ms = []
         self._tok_latency_ms = []
+        # prefix-cache hit accounting (engine-fed): TTFT split by
+        # whether the request's admission prefill hit the store
+        self._ttft_hit_ms = []
+        self._ttft_miss_ms = []
+        # speculative-decode acceptance accounting
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self._t_start = None
         self._t_end = None
         self._retries_before = engine.decode_retries_total
@@ -211,12 +232,15 @@ class Scheduler:
                 request, "prompt_too_long",
                 f"prompt ({plen}) exceeds the largest prefill bucket "
                 f"({eng.config.prefill_buckets[-1]})")
-        if plen + request.max_new_tokens > eng.max_len:
+        headroom = getattr(eng, "decode_headroom", 0)
+        if plen + request.max_new_tokens + headroom > eng.max_len:
             return self._reject(
                 request, "budget_too_long",
                 f"prompt ({plen}) + max_new_tokens "
-                f"({request.max_new_tokens}) exceeds "
-                f"max_position_embeddings ({eng.max_len})")
+                f"({request.max_new_tokens})"
+                + (f" + speculative window ({headroom})" if headroom
+                   else "")
+                + f" exceeds max_position_embeddings ({eng.max_len})")
         if rc.max_pending is not None and len(self.pending) >= rc.max_pending:
             if rc.admission_policy == "reject_newest":
                 return self._reject(
@@ -340,11 +364,18 @@ class Scheduler:
                 pad_slot_ids=self.free)
             t1 = self._clock()
             self.prefill_calls += 1
+            cuts = list(getattr(self.engine, "last_prefill_hits",
+                                ()) or [0] * len(group))
             reg = self._reg()
-            for slot, r, tok in zip(slots, group, first):
+            for slot, r, tok, cut in zip(slots, group, first, cuts):
                 ttft = t1 - self._eligible_wall[r.rid]
                 self._ttft_ms.append(ttft * 1e3)
+                (self._ttft_hit_ms if cut
+                 else self._ttft_miss_ms).append(ttft * 1e3)
                 reg.histogram("serve/ttft").observe(ttft * 1e3)
+                if cut:
+                    reg.histogram("serve/ttft_prefix_hit").observe(
+                        ttft * 1e3)
                 reg.counter("serve/requests_admitted").inc()
                 self.tokens_generated += 1
                 st = _Active(r, tok, ttft)
@@ -363,6 +394,7 @@ class Scheduler:
         if not self.active:
             return
         rc = self.robust
+        spec = bool(getattr(self.engine, "spec_enabled", False))
         max_bucket = self.engine.config.batch_buckets[-1]
         slots = sorted(self.active)
         for i in range(0, len(slots), max_bucket):
@@ -370,11 +402,15 @@ class Scheduler:
             toks = [self.active[s].last for s in chunk]
             t0 = self._clock()
             try:
-                nxt, finite = self.engine.decode(
+                out = self.engine.decode(
                     chunk, toks, pad_slot_ids=self.free,
                     retries=rc.decode_retries,
                     backoff_s=rc.retry_backoff_s,
                     backoff_cap_s=rc.retry_backoff_cap_s)
+                if spec:
+                    emitted, counts, finite = out
+                else:
+                    nxt, finite = out
             except robust_mod.DecodeFailedError as e:
                 # persistent dispatch failure: fail ONLY this chunk's
                 # requests; other chunks (and future traffic) continue
@@ -417,19 +453,49 @@ class Scheduler:
                     f"this is model-level poison (weights/activations), "
                     f"not a per-request fault; restore from the last "
                     f"verified checkpoint")
-            for s, tok, ok in zip(chunk, nxt, finite):
+            if spec:
+                # acceptance bookkeeping: proposed = k per real slot,
+                # accepted = counts - 1 (the +1 is the target's own
+                # correction/bonus token, not a draft acceptance)
+                k = int(self.engine.config.num_draft_tokens)
+                proposed = k * len(chunk)
+                accepted = int(sum(int(c) - 1
+                                   for c, ok in zip(counts, finite)
+                                   if ok))
+                self.spec_proposed += proposed
+                self.spec_accepted += accepted
+                reg.counter("serve/spec_proposed").inc(proposed)
+                if accepted:
+                    reg.counter("serve/spec_accepted").inc(accepted)
+                blocks = [list(emitted[j][:int(counts[j])])
+                          for j in range(len(chunk))]
+            else:
+                blocks = [[tok] for tok in nxt]
+            for s, block, ok in zip(chunk, blocks, finite):
                 st = self.active[s]
                 if rc.quarantine and not ok:
                     del self.active[s]
                     self._quarantine(s, st)
                     continue
-                st.tokens.append(int(tok))
-                st.last = int(tok)
-                st.latencies.append(dt)
-                self._tok_latency_ms.append(dt * 1e3)
-                reg.histogram("serve/tok_latency").observe(dt * 1e3)
-                self.tokens_generated += 1
-                if self._finished(st):
+                # one dispatch may emit several verified tokens (the
+                # speculative round's accepted prefix + bonus); the
+                # per-token latency is the dispatch amortized over
+                # them, and eos / max_new truncate the block exactly
+                # where a one-token engine would have stopped
+                per_tok = dt / max(len(block), 1)
+                done = False
+                for tok in block:
+                    st.tokens.append(int(tok))
+                    st.last = int(tok)
+                    st.latencies.append(per_tok)
+                    self._tok_latency_ms.append(per_tok * 1e3)
+                    reg.histogram("serve/tok_latency").observe(
+                        per_tok * 1e3)
+                    self.tokens_generated += 1
+                    if self._finished(st):
+                        done = True
+                        break
+                if done:
                     del self.active[s]
                     self._evict(s, st)
 
@@ -612,6 +678,7 @@ class Scheduler:
             self._finish_drain()
         self._t_end = self._clock()
         self._census_event()
+        self._spec_prefix_events()
         self._health_event()
         return self.completed
 
@@ -655,6 +722,30 @@ class Scheduler:
                   cache_dtype=eng.spec.cache_dtype_name(),
                   kv_cache_bytes=eng.kv_cache_bytes())
 
+    def _spec_prefix_events(self):
+        """End-of-run rollups for the two serving multipliers (only
+        when the engine runs them): acceptance accounting for the
+        speculative ladder, hit/miss accounting for the prefix store
+        (tools/telemetry_report.py renders both)."""
+        reg = self._reg()
+        if getattr(self.engine, "spec_enabled", False):
+            rate = (self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0)
+            reg.gauge("serve/spec_acceptance_rate").set(rate)
+            reg.event("serve", "spec_report",
+                      proposed=self.spec_proposed,
+                      accepted=self.spec_accepted,
+                      acceptance_rate=round(rate, 4),
+                      num_draft_tokens=int(
+                          self.engine.config.num_draft_tokens),
+                      decode_steps=self.decode_steps,
+                      tokens_generated=self.tokens_generated)
+        store = getattr(self.engine, "prefix_store", None)
+        if store is not None:
+            s = store.stats()
+            reg.gauge("serve/prefix_hit_rate").set(s["hit_rate"])
+            reg.event("serve", "prefix_report", **s)
+
     def _health_event(self):
         self.health.decode_retries = (self.engine.decode_retries_total
                                       - self._retries_before)
@@ -687,7 +778,37 @@ class Scheduler:
             if c.finish_reason in robust_mod.OK_STATUSES:
                 goodput_tokens += len(c.tokens)
         h = self.health
+        extra = {}
+        if getattr(self.engine, "spec_enabled", False):
+            tps = (self.tokens_generated / wall) if wall > 0 else None
+            extra.update({
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "acceptance_rate": round(
+                    self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else 0.0,
+                # every emitted token is target-verified, so the
+                # accepted-tokens rate IS the engine's tokens/sec —
+                # named explicitly for the serve_spec bench contract
+                "accepted_tokens_per_sec": tps,
+            })
+        store = getattr(self.engine, "prefix_store", None)
+        if store is not None:
+            ps = store.stats()
+            extra.update({
+                "prefix_lookups": ps["lookups"],
+                "prefix_hits": ps["hits"],
+                "prefix_hit_rate": round(ps["hit_rate"], 4),
+                "prefix_hit_tokens": ps["hit_tokens"],
+                "prefix_store_bytes": ps["bytes"],
+                "prefix_store_entries": ps["entries"],
+                "ttft_p50_prefix_hit_ms": self._pct(
+                    self._ttft_hit_ms, 50),
+                "ttft_p50_prefix_miss_ms": self._pct(
+                    self._ttft_miss_ms, 50),
+            })
         return {
+            **extra,
             "requests_completed": len(self.completed),
             "requests_ok": sum(by_reason.get(r, 0)
                                for r in robust_mod.OK_STATUSES),

@@ -23,6 +23,7 @@
     python bench.py decode [batch] [new]   KV-cache decode throughput
                                            (serving) tokens/sec/chip
     python bench.py serve_decode [reqs] [len]  continuous-batching serve
+    python bench.py serve_spec [reqs] [len]  speculative + prefix-cached serve
                                            engine (apex_tpu.serving):
                                            AOT bucket ladder, two
                                            Poisson traces, tokens/sec +
@@ -2107,6 +2108,185 @@ def bench_serve_decode(requests, steps, *, cache_mode="bf16",
     return ret
 
 
+def _serve_spec_setup():
+    """Model pair for the speculative serving bench: a deeper target
+    whose layers beyond the first are DAMPED (output contributions
+    scaled by 0.25 — the residual stream stays backbone-dominated, the
+    stand-in for a well-distilled draft/target pair; an undamped
+    random-init deep stack gives ~0 draft agreement, which measures
+    nothing) and a 1-layer draft sharing the target's embedding, first
+    layer, and head. ``max_position_embeddings`` is larger than the
+    serve_decode shape on purpose: speculative verification amortizes
+    the per-step KV-cache read, so its win GROWS with context length.
+    Returns ``(smoke, cfg, model, params, draft, dparams)``."""
+    import dataclasses as _dc
+
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    smoke = os.environ.get("APEX_TPU_SERVE_SMOKE") == "1"
+    cfg = TransformerConfig(
+        hidden_size=128 if smoke else 1024,
+        num_layers=6 if smoke else 16,
+        num_attention_heads=4 if smoke else 16,
+        vocab_size=512 if smoke else 32000,
+        max_position_embeddings=256 if smoke else 2048,
+        compute_dtype=jnp.bfloat16, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=4,
+        ffn_hidden_size=256 if smoke else 2816)
+    model = GPTModel(cfg, decode=True)
+    rng = np.random.RandomState(0)
+    params = dict(GPTModel(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))["params"])
+    params["transformer"] = {
+        name: jax.tree_util.tree_map(
+            lambda l: l * (1.0 if name == "layer_0" else 0.25), layer)
+        for name, layer in params["transformer"].items()}
+    dcfg = _dc.replace(cfg, num_layers=1)
+    draft = GPTModel(dcfg, decode=True)
+    dparams = {
+        "word_embeddings": params["word_embeddings"],
+        "final_layernorm": params["final_layernorm"],
+        "lm_head": params["lm_head"],
+        "transformer": {"layer_0": params["transformer"]["layer_0"]},
+    }
+    return smoke, cfg, model, params, draft, dparams
+
+
+def bench_serve_spec(requests, steps):
+    """Speculative + prefix-cached serving bench (ROADMAP item 1): ONE
+    target model served two ways over the SAME shared-prefix Poisson
+    trace (~80% of requests open with one system prompt — the
+    realistic millions-of-users shape):
+
+    (a) the plain continuous-batching engine — the ``serve_decode``
+    baseline, measured in-invocation so the comparison shares the
+    trace, the host, and the load; (b) a ``ServeConfig(draft_model=,
+    prefix_cache=True)`` engine: every decode dispatch drafts
+    ``num_draft_tokens`` greedily with the cheap draft, verifies the
+    window in ONE chunked target forward (fused in-graph acceptance /
+    rollback epilogue, per-slot mixed acceptance), and shared prefixes
+    seed KV rows from the host-side prefix store so only the suffix
+    bucket prefills.
+
+    The headline value is the speculative engine's
+    ``accepted_tokens_per_sec`` — every emitted token is a target
+    argmax over its own prefix, so the streams are TOKEN-IDENTICAL to
+    the baseline engine (emitted as ``token_identical``; the ISSUE-12
+    acceptance asks >= 1.5x the baseline with ``compile_count`` still
+    == the ladder size and zero warm-trace recompiles). The round-17
+    contract fields ride along: ``acceptance_rate``,
+    ``prefix_hit_rate``, ``ttft_p50_prefix_hit_ms``.
+    """
+    import dataclasses as _dc
+
+    from apex_tpu.serving import ServeConfig, ServeEngine, synthetic_trace
+    from apex_tpu.telemetry import CompileWatcher, compile_watch
+
+    smoke, cfg, model, params, draft, dparams = _serve_spec_setup()
+    num_slots = 8
+    devices = jax.devices()
+    from jax.sharding import Mesh
+
+    mesh = (Mesh(np.asarray(devices), ("data",))
+            if len(devices) > 1 and num_slots % len(devices) == 0
+            else None)
+    base_cfg = ServeConfig(
+        batch_buckets=(2, 4, 8),
+        prefill_buckets=(16, 32) if smoke else (64, 128),
+        num_slots=num_slots, cache_mode="bf16",
+        eos_token_id=None, temperature=0.0)
+    shared_len = 12 if smoke else 40
+    plens = (4, 8, 12) if smoke else (8, 16, 24)
+    max_new = (steps * 4, steps * 6)
+
+    def trace(seed):
+        return synthetic_trace(
+            requests, seed=seed, mean_interarrival=0.1,
+            prompt_lens=plens, max_new=max_new,
+            vocab_size=cfg.vocab_size, shared_prefix_len=shared_len,
+            shared_frac=0.8)
+
+    watcher = CompileWatcher(enabled=True)
+    # (a) baseline: the plain engine (= serve_decode semantics)
+    base_eng = ServeEngine(model, params, base_cfg, mesh=mesh,
+                           watcher=watcher)
+    base_eng.serve(trace(0))                      # warm-up trace
+    done_base, stats_base = base_eng.serve(trace(1))
+    base_tps = stats_base["tokens_per_sec"] or 0.0
+
+    # (b) speculative + prefix-cached engine, same ladder shape
+    spec_cfg = _dc.replace(
+        base_cfg, draft_model=draft, draft_params=dparams,
+        num_draft_tokens=4, prefix_cache=True, prefix_min_len=6,
+        prefix_max_entries=16)
+    spec_eng = ServeEngine(model, params, spec_cfg, mesh=mesh,
+                           watcher=watcher)
+    spec_eng.serve(trace(0))                      # warm-up trace
+    compiles_before = compile_watch.backend_compiles()[0]
+    t0 = time.perf_counter()
+    done_spec, stats_spec = spec_eng.serve(trace(1))
+    dt = time.perf_counter() - t0
+    recompiles = compile_watch.backend_compiles()[0] - compiles_before
+
+    base_tokens = {c.rid: np.asarray(c.tokens).tolist()
+                   for c in done_base}
+    spec_tokens = {c.rid: np.asarray(c.tokens).tolist()
+                   for c in done_spec}
+    identical = base_tokens == spec_tokens
+
+    if spec_eng.memory_report is not None:
+        rep = spec_eng.memory_report
+        _PENDING_MEASURED["peak_hbm_bytes"] = rep["peak_bytes"]
+        if rep.get("headroom_frac") is not None:
+            _PENDING_MEASURED["hbm_headroom_pct"] = round(
+                rep["headroom_frac"] * 100.0, 2)
+    _stage_aot_compile_count(spec_eng.compile_count)
+
+    accepted_tps = stats_spec["accepted_tokens_per_sec"] or 0.0
+    avg_len = float(np.mean(plens)) + shared_len + float(
+        np.mean(max_new))
+    flops = stats_spec["tokens_generated"] * \
+        _transformer_fwd_flops_per_token(cfg, int(avg_len))
+    ret = {
+        "accepted_tokens_per_sec": round(accepted_tps, 2),
+        "baseline_tokens_per_sec": round(base_tps, 2),
+        "speedup_vs_decode": round(accepted_tps / base_tps, 3)
+        if base_tps else None,
+        "acceptance_rate": stats_spec["acceptance_rate"],
+        "spec_proposed": stats_spec["spec_proposed"],
+        "spec_accepted": stats_spec["spec_accepted"],
+        "num_draft_tokens": spec_cfg.num_draft_tokens,
+        "prefix_hit_rate": stats_spec["prefix_hit_rate"],
+        "prefix_hits": stats_spec["prefix_hits"],
+        "prefix_store_bytes": stats_spec["prefix_store_bytes"],
+        "ttft_p50_prefix_hit_ms": round(
+            stats_spec["ttft_p50_prefix_hit_ms"], 3)
+        if stats_spec["ttft_p50_prefix_hit_ms"] is not None else None,
+        "ttft_p50_prefix_miss_ms": round(
+            stats_spec["ttft_p50_prefix_miss_ms"], 3)
+        if stats_spec["ttft_p50_prefix_miss_ms"] is not None else None,
+        "token_identical": bool(identical),
+        "kv_cache_bytes_draft": spec_eng.draft_kv_cache_bytes(),
+        "compile_count": spec_eng.compile_count,
+        "recompiles_spec": int(recompiles),
+    }
+    _emit("serve_spec_accepted_tokens_per_sec", accepted_tps,
+          "tokens/sec", flops, 1, dt,
+          requests=requests, num_slots=num_slots,
+          data_devices=int(mesh.devices.size) if mesh is not None else 1,
+          shared_prefix_len=shared_len,
+          decode_steps=stats_spec["decode_steps"],
+          prefill_calls=stats_spec["prefill_calls"],
+          **{k: v for k, v in ret.items()
+             if k not in ("accepted_tokens_per_sec", "compile_count")},
+          **_comm_fields(training=False))
+    return ret
+
+
 def bench_serve_chaos(requests, steps):
     """Serving fault-tolerance chaos bench (apex_tpu.serving.robust):
     ONE engine serves (a) a clean Poisson trace — the goodput
@@ -2371,6 +2551,7 @@ BENCH_SPECS = {
     "llama": ((4, 15), bench_llama),
     "decode": ((8, 128), bench_decode),
     "serve_decode": ((24, 16), bench_serve_decode),
+    "serve_spec": ((16, 16), bench_serve_spec),
     "serve_chaos": ((24, 16), bench_serve_chaos),
     "serve_fleet": ((16, 8), bench_serve_fleet),
     "resnet": ((256, 50), bench_resnet),

@@ -278,6 +278,46 @@ def test_fleet_fields_gated_at_round16():
     assert schema.check_metric_line(other, round_n=16, errors=[]) == []
 
 
+def test_serve_spec_fields_gated_at_round17():
+    """ISSUE 12 satellite: the serve_spec contract
+    (accepted_tokens_per_sec, acceptance_rate, prefix_hit_rate,
+    ttft_p50_prefix_hit_ms) is required on serve_spec lines from round
+    17; pre-17 records carrying the fields are flagged, other configs
+    never need them."""
+    base = {"metric": "serve_spec_accepted_tokens_per_sec",
+            "value": 1200.0, "unit": "tokens/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 0,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": 9, "lint_violations": None,
+            "backend": "cpu-mesh"}
+    msgs = schema.check_metric_line(dict(base), round_n=17, errors=[])
+    for key in ("accepted_tokens_per_sec", "acceptance_rate",
+                "prefix_hit_rate", "ttft_p50_prefix_hit_ms"):
+        assert any(key in m for m in msgs)
+    full = dict(base, accepted_tokens_per_sec=1200.0,
+                acceptance_rate=0.88, prefix_hit_rate=0.62,
+                ttft_p50_prefix_hit_ms=44.3)
+    assert schema.check_metric_line(dict(full), round_n=17,
+                                    errors=[]) == []
+    # nullable: a trace that never hit the store has no hit-TTFT p50
+    assert schema.check_metric_line(
+        dict(full, ttft_p50_prefix_hit_ms=None), round_n=17,
+        errors=[]) == []
+    msgs = schema.check_metric_line(dict(full), round_n=16, errors=[])
+    assert any("only defined from round 17" in m for m in msgs)
+    msgs = schema.check_metric_line(
+        dict(full, acceptance_rate="high"), round_n=17, errors=[])
+    assert any("must be numeric or null" in m for m in msgs)
+    other = dict(base, metric="serve_decode_tokens_per_sec_per_chip",
+                 ttft_p50_ms=1.0, ttft_p99_ms=2.0,
+                 tok_latency_p50_ms=0.5, tok_latency_p99_ms=1.0,
+                 kv_cache_bytes=1024)
+    assert schema.check_metric_line(other, round_n=17, errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint

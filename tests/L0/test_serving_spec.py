@@ -1,0 +1,433 @@
+"""Speculative + prefix-cached serving (ISSUE 12): the two serving
+multipliers promoted into ServeEngine.
+
+The contract under test:
+
+- **token identity** — the speculative engine (draft-k proposals, one
+  fused chunked verification per dispatch, per-slot MIXED acceptance)
+  and the prefix-cached engine (KV rows seeded from the host-side
+  store, suffix-bucket prefill) each emit EXACTLY the plain engine's
+  greedy streams, in bf16 and int8 cache modes, composed or alone;
+- **flat ladder** — both multipliers swap executable bodies, never add
+  ladder entries: ``compile_count`` == the bucket-ladder size and a
+  warm trace compiles nothing (``assert_no_recompiles``);
+- **fault-path composition** — a poisoned slot mid-speculative-round
+  quarantines exactly that slot (healthy slots' streams untouched),
+  and a transient decode failure retries through the PR-7 machinery
+  unchanged;
+- **store/span primitives** — ``KVCacheSpec.update_rows_span`` keeps
+  untouched int8 blocks bit-identical, ``PrefixStore`` LRU/covers
+  semantics, shared-prefix ``synthetic_trace`` determinism.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models import GPTModel, TransformerConfig
+from apex_tpu.resilience import faults
+from apex_tpu.serving import (
+    PrefixStore,
+    Request,
+    Scheduler,
+    ServeConfig,
+    ServeEngine,
+    synthetic_trace,
+)
+from apex_tpu.telemetry import assert_no_recompiles
+from apex_tpu.telemetry.registry import MetricsRegistry, use_registry
+from apex_tpu.transformer import parallel_state
+
+VOCAB = 96
+
+
+def _cfg(layers=2, hidden=48, **kw):
+    base = dict(
+        hidden_size=hidden, num_layers=layers, num_attention_heads=4,
+        vocab_size=VOCAB, max_position_embeddings=64,
+        compute_dtype=jnp.float32, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=2)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _single_device():
+    parallel_state.destroy_model_parallel()
+
+
+@pytest.fixture(scope="module")
+def models():
+    parallel_state.destroy_model_parallel()
+    rng = np.random.RandomState(0)
+    tcfg = _cfg()
+    target = GPTModel(tcfg, decode=True)
+    tparams = GPTModel(tcfg).init(
+        jax.random.PRNGKey(1),
+        jnp.asarray(rng.randint(0, VOCAB, (1, 8))))["params"]
+    dcfg = _cfg(layers=1, hidden=32)
+    draft = GPTModel(dcfg, decode=True)
+    dparams = GPTModel(dcfg).init(
+        jax.random.PRNGKey(7),
+        jnp.asarray(rng.randint(0, VOCAB, (1, 8))))["params"]
+    return target, tparams, draft, dparams
+
+
+def _serve_cfg(**kw):
+    base = dict(batch_buckets=(2, 4), prefill_buckets=(8, 16),
+                num_slots=4, eos_token_id=None, temperature=0.0)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _trace(n=6, seed=5, max_new=(5, 8)):
+    return synthetic_trace(
+        n, seed=seed, mean_interarrival=0.4, prompt_lens=(3, 5),
+        max_new=max_new, vocab_size=VOCAB, shared_prefix_len=7,
+        shared_frac=0.8)
+
+
+def _streams(completed):
+    return {c.rid: np.asarray(c.tokens).tolist() for c in completed}
+
+
+@pytest.fixture(scope="module")
+def plain_engine(models):
+    target, tparams, _, _ = models
+    return ServeEngine(target, tparams, _serve_cfg())
+
+
+@pytest.fixture(scope="module")
+def spec_engine(models):
+    """Speculative + prefix-cached engine (the composed configuration
+    the serve_spec bench ships) shared across the module — engine AOT
+    compile is the dominant test cost."""
+    target, tparams, draft, dparams = models
+    return ServeEngine(target, tparams, _serve_cfg(
+        draft_model=draft, draft_params=dparams, num_draft_tokens=3,
+        prefix_cache=True, prefix_min_len=4))
+
+
+@pytest.fixture(scope="module")
+def plain_streams(plain_engine):
+    done, _ = plain_engine.serve(_trace())
+    return _streams(done)
+
+
+def test_spec_engine_token_identical_and_flat(spec_engine,
+                                              plain_streams):
+    """The flagship acceptance: a mixed-length continuous-batching
+    trace through the speculative + prefix-cached engine is
+    token-identical to the plain engine, with the ladder flat and a
+    warm trace compiling NOTHING (the fused draft/verify epilogue and
+    the seeded prefill are the same executables traffic already
+    used)."""
+    # ladder size is invariant: 2 batch-buckets x 2 prefill-buckets
+    # + 2 decode = 6 executables, draft or not
+    assert spec_engine.compile_count == 2 * 2 + 2
+    assert spec_engine.spec_enabled
+    assert spec_engine.decode_headroom == 3
+    done, stats = spec_engine.serve(_trace())       # warm trace
+    assert _streams(done) == plain_streams
+    with assert_no_recompiles():
+        done2, stats2 = spec_engine.serve(_trace())
+    assert _streams(done2) == plain_streams
+    # the draft is independent (partial agreement) — acceptance must
+    # be real but NOT vacuous, and every token is target-verified
+    assert stats2["spec_proposed"] > 0
+    assert 0 <= stats2["acceptance_rate"] <= 1
+    assert stats2["accepted_tokens_per_sec"] > 0
+    # the shared-prefix trace must actually hit the store by now
+    assert stats2["prefix_hits"] > 0
+    assert stats2["prefix_hit_rate"] > 0
+
+
+def test_prefix_cache_alone_token_identical(models, plain_streams):
+    """Prefix cache without speculation: seeded suffix prefills are
+    token-exact, hits accumulate across requests, and the TTFT split
+    lands in stats."""
+    target, tparams, _, _ = models
+    eng = ServeEngine(target, tparams, _serve_cfg(
+        prefix_cache=True, prefix_min_len=4))
+    done, stats = eng.serve(_trace())
+    assert _streams(done) == plain_streams
+    assert stats["prefix_lookups"] > 0
+    assert stats["prefix_hits"] > 0
+    assert stats["prefix_store_entries"] > 0
+    assert stats["prefix_store_bytes"] > 0
+    assert stats["ttft_p50_prefix_hit_ms"] is not None
+    # a fresh identical trace hits harder (every prompt already cached)
+    done2, stats2 = eng.serve(_trace())
+    assert _streams(done2) == plain_streams
+    assert stats2["prefix_hits"] > stats["prefix_hits"]
+
+
+@pytest.mark.slow
+def test_int8_spec_prefix_token_identical(models):
+    """int8 store composition: the speculative window re-quantizes
+    only its k+1 positions, and a prefix hit seeds the RAW
+    full-precision rows (so the suffix forward sees what a cold
+    prefill saw and re-quantization reproduces the cold bits), so the
+    composed int8 engine matches the plain int8 engine
+    token-for-token."""
+    target, tparams, draft, dparams = models
+    base = ServeEngine(target, tparams, _serve_cfg(cache_mode="int8"))
+    done_a, _ = base.serve(_trace())
+    eng = ServeEngine(target, tparams, _serve_cfg(
+        cache_mode="int8", draft_model=draft, draft_params=dparams,
+        num_draft_tokens=3, prefix_cache=True, prefix_min_len=4))
+    done_b, stats = eng.serve(_trace())
+    assert _streams(done_b) == _streams(done_a)
+    assert stats["prefix_hits"] > 0
+
+
+def test_spec_quarantine_poisons_only_one_slot(models, spec_engine,
+                                               plain_streams):
+    """PR-7 composition: a slot-NaN injected mid-speculative-round
+    evicts exactly that request as ``poisoned`` (KV rows of BOTH
+    stores reset in-graph) while the other slots keep their exact
+    greedy streams, and a transient decode failure is absorbed by one
+    retry."""
+    sched = Scheduler(spec_engine)
+    for r in _trace():
+        sched.submit(r)
+    nan_armed = fail_armed = False
+    try:
+        while sched.pending or sched.active:
+            if not nan_armed and len(sched.active) >= 2:
+                faults.arm_slot_nan(sorted(sched.active)[0],
+                                    spec_engine._decode_calls)
+                nan_armed = True
+            elif nan_armed and not fail_armed and sched.active:
+                faults.arm_decode_failure(spec_engine._decode_calls,
+                                          transient=True)
+                fail_armed = True
+            if not sched.active and sched.pending and \
+                    min(r.arrival for r in sched.pending) > sched.tick:
+                sched.tick = min(r.arrival for r in sched.pending)
+            sched.step()
+    finally:
+        faults.disarm_slot_nan()
+        faults.disarm_decode_failure()
+    stats = sched.stats()
+    assert nan_armed and fail_armed
+    assert stats["requests_quarantined"] == 1
+    assert stats["requests_failed"] == 0
+    assert stats["decode_retries"] >= 1
+    # every non-poisoned request still matches the plain engine
+    got = _streams(sched.completed)
+    poisoned = [c.rid for c in sched.completed
+                if c.finish_reason == "poisoned"]
+    assert len(poisoned) == 1
+    for rid, toks in got.items():
+        if rid not in poisoned:
+            assert toks == plain_streams[rid], f"rid {rid} diverged"
+
+
+def test_spec_budget_headroom_rejected(spec_engine):
+    """Admission accounts for the speculative window: a request whose
+    prompt + budget would let the draft overshoot the position buffer
+    is rejected ``budget_too_long`` instead of corrupting the cache
+    tail."""
+    sched = Scheduler(spec_engine)
+    max_len = spec_engine.max_len
+    k = spec_engine.decode_headroom
+    prompt = np.zeros((5,), np.int32)
+    ok = sched.submit(Request(rid=901, prompt=prompt,
+                              max_new_tokens=max_len - 5 - k + 1))
+    assert not ok
+    assert sched.rejected[-1].reason == "budget_too_long"
+    assert sched.submit(Request(rid=902, prompt=prompt,
+                                max_new_tokens=max_len - 5 - k))
+
+
+def test_spec_prefix_telemetry_events(spec_engine, tmp_path):
+    """The acceptance and prefix rollups land: serve/spec_proposed /
+    serve/spec_accepted / serve/prefix_* counters plus the spec_report
+    and prefix_report events tools/telemetry_report.py renders. The
+    module engine is reused — its instruments resolve the ACTIVE
+    registry per call, so scoping is the registry context, not the
+    engine (and an extra AOT build would be pure tier-1 cost)."""
+    import json
+
+    with use_registry(MetricsRegistry(jsonl_dir=str(tmp_path))) as reg:
+        spec_engine.serve(_trace(n=4))
+        assert reg.counter_value("serve/spec_proposed") > 0
+        # the module engine's store is warm by now: hits, not misses
+        assert reg.counter_value("serve/prefix_hits") > 0
+        reg.flush()
+    events = []
+    for p in tmp_path.glob("telemetry-rank*.jsonl"):
+        events += [json.loads(ln) for ln in p.read_text().splitlines()]
+    names = [(e.get("kind"), e.get("name")) for e in events]
+    assert ("serve", "spec_report") in names
+    assert ("serve", "prefix_report") in names
+    assert ("serve", "prefix_lookup") in names
+    spec_ev = [e for e in events
+               if e.get("name") == "spec_report"][-1]
+    assert spec_ev["proposed"] >= spec_ev["accepted"] >= 0
+
+
+def test_spec_engine_validation(models):
+    target, tparams, draft, dparams = models
+    with pytest.raises(ValueError, match="draft_params"):
+        ServeEngine(target, tparams, _serve_cfg(draft_model=draft))
+    with pytest.raises(ValueError, match="greedy-only"):
+        ServeEngine(target, tparams, _serve_cfg(
+            draft_model=draft, draft_params=dparams, temperature=0.7))
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        ServeEngine(target, tparams, _serve_cfg(
+            draft_model=draft, draft_params=dparams,
+            num_draft_tokens=0))
+    with pytest.raises(ValueError, match="vocab"):
+        small = GPTModel(dataclasses.replace(_cfg(layers=1, hidden=32),
+                                             vocab_size=48),
+                         decode=True)
+        ServeEngine(target, tparams, _serve_cfg(
+            draft_model=small, draft_params=dparams))
+    with pytest.raises(ValueError, match="decode=True"):
+        ServeEngine(target, tparams, _serve_cfg(
+            draft_model=GPTModel(_cfg(layers=1, hidden=32)),
+            draft_params=dparams))
+
+
+def test_census_labels_cover_draft(spec_engine, plain_engine):
+    """The bugfix satellite: OOM census labels must name the draft
+    ladder's buffers, and every AOT registration (draft/verify
+    included) must sit under the engine's name prefix so fleet respawn
+    recompile accounting stays exact."""
+    labels = spec_engine.census_labels()
+    assert set(labels) == {"params", "kv_cache", "draft_params",
+                           "kv_cache_draft"}
+    assert set(plain_engine.census_labels()) == {"params", "kv_cache"}
+    assert spec_engine.draft_kv_cache_bytes() > 0
+    assert plain_engine.draft_kv_cache_bytes() == 0
+    # named engine: every ladder entry registers under the prefix
+    target, tparams, draft, dparams = (
+        spec_engine.model, spec_engine._params,
+        spec_engine.config.draft_model, spec_engine._draft_params)
+    from apex_tpu.telemetry import CompileWatcher
+
+    watcher = CompileWatcher(enabled=True)
+    eng = ServeEngine(target, tparams, _serve_cfg(
+        batch_buckets=(2,), prefill_buckets=(8,),
+        draft_model=draft, draft_params=dparams, num_draft_tokens=2),
+        watcher=watcher, name="replica9.g1")
+    names = [n for n in watcher.functions
+             if "spec_decode" in n or "prefill" in n]
+    assert names, "no AOT registrations observed"
+    assert all(n.startswith("replica9.g1/serve/") for n in names)
+
+
+def test_update_rows_span_no_drift(models):
+    """int8 span update: positions outside [start, start+span) keep
+    their exact int8 payload + scales; span=1 matches update_rows_at
+    bit-for-bit."""
+    target, _, _, _ = models
+    from apex_tpu.serving import KVCacheSpec
+
+    spec = KVCacheSpec(target, 2, mode="int8")
+    rng = np.random.RandomState(3)
+
+    def rand_rows(b):
+        def leaf(sd):
+            return jnp.asarray(
+                rng.randn(*((b,) + tuple(sd.shape))).astype(
+                    np.float32)).astype(sd.dtype)
+        return jax.tree_util.tree_map(leaf, spec.template)
+
+    base = rand_rows(2)
+    store_rows = spec.quantize_rows(base)
+    fresh = rand_rows(2)
+    start = jnp.asarray([4, 9], jnp.int32)
+    span = 3
+    merged = spec.update_rows_span(store_rows, fresh, start, span)
+
+    def kv_leaves(tree):
+        return [(p, l) for p, l in
+                jax.tree_util.tree_flatten_with_path(
+                    tree, is_leaf=lambda x: isinstance(x, dict)
+                    and "q" in x)[0] if isinstance(l, dict)]
+
+    for (_, old), (_, new) in zip(kv_leaves(store_rows),
+                                  kv_leaves(merged)):
+        t = old["q"].shape[-3]
+        for b in range(2):
+            lo = int(start[b])
+            for pos in range(t):
+                inside = lo <= pos < lo + span
+                same_q = np.array_equal(np.asarray(old["q"][b, pos]),
+                                        np.asarray(new["q"][b, pos]))
+                same_s = np.array_equal(
+                    np.asarray(old["scale"][b, pos]),
+                    np.asarray(new["scale"][b, pos]))
+                if not inside:
+                    assert same_q and same_s, \
+                        f"untouched position {pos} drifted"
+    # span=1 == update_rows_at
+    pos1 = jnp.asarray([4, 9], jnp.int32)
+    a = spec.update_rows_at(store_rows, fresh, pos1)
+    b = spec.update_rows_span(store_rows, fresh, pos1, 1)
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_prefix_store_semantics():
+    """Host-side store unit contract: hash-keyed lookup returns the
+    longest usable cut, covers() blocks redundant insertions, strict
+    prefixes are superseded, LRU bounds entries."""
+    store = PrefixStore(max_entries=2, min_len=3)
+    rows = {"x": np.ones((4,), np.float32)}
+    p1 = np.asarray([1, 2, 3, 4, 5], np.int32)
+    assert store.insert(p1, rows) is not None
+    # full-coverage re-insert refused
+    assert store.covers(p1)
+    assert store.insert(p1, rows) is None
+    # longer prompt sharing the prefix: lookup cut caps at len-1
+    p2 = np.asarray([1, 2, 3, 4, 5, 6, 7], np.int32)
+    cut, entry = store.lookup(p2)
+    assert cut == 5 and entry is not None
+    # a longer entry supersedes its strict prefix (still 1 keyed slot)
+    assert store.insert(p2, rows) is not None
+    assert len(store) == 1
+    cut, _ = store.lookup(np.asarray([1, 2, 3, 9], np.int32))
+    assert cut == 3
+    # too-short prompts neither hit nor insert
+    assert store.lookup(np.asarray([1, 2, 3], np.int32)) == (0, None)
+    assert store.insert(np.asarray([1, 2, 3], np.int32), rows) is None
+    # LRU eviction at capacity
+    assert store.insert(np.asarray([9, 9, 9, 9], np.int32), rows)
+    assert store.insert(np.asarray([8, 8, 8, 8], np.int32), rows)
+    assert len(store) == 2
+    s = store.stats()
+    assert s["evictions"] == 1 and s["entries"] == 2
+    assert s["lookups"] >= 3 and s["hits"] >= 2
+
+
+def test_shared_prefix_trace_determinism():
+    """shared_prefix_len=0 leaves the legacy byte stream untouched;
+    > 0 makes ~shared_frac of prompts open with ONE shared block,
+    deterministically per seed."""
+    legacy_a = synthetic_trace(8, seed=11)
+    legacy_b = synthetic_trace(8, seed=11, shared_prefix_len=0)
+    for ra, rb in zip(legacy_a, legacy_b):
+        np.testing.assert_array_equal(ra.prompt, rb.prompt)
+        assert ra.arrival == rb.arrival
+        assert ra.max_new_tokens == rb.max_new_tokens
+    shared_a = synthetic_trace(16, seed=11, shared_prefix_len=6)
+    shared_b = synthetic_trace(16, seed=11, shared_prefix_len=6)
+    for ra, rb in zip(shared_a, shared_b):
+        np.testing.assert_array_equal(ra.prompt, rb.prompt)
+    blocks = {tuple(r.prompt[:6].tolist()) for r in shared_a
+              if len(r.prompt) > 6}
+    counts = {}
+    for r in shared_a:
+        counts[tuple(r.prompt[:6].tolist())] = \
+            counts.get(tuple(r.prompt[:6].tolist()), 0) + 1
+    # one dominant shared block covering most requests
+    assert max(counts.values()) >= 16 * 0.5

@@ -47,6 +47,11 @@ number ``n`` (old checked-in records stay valid):
   contract — per-tier p99 TTFT (``ttft_p99_ms_interactive`` /
   ``ttft_p99_ms_batch``), ``rebalance_latency_ms`` and
   ``replicas_respawned`` — next to their fleet tokens/sec value.
+- ``n >= 17``: ``serve_spec`` metric lines must carry the speculative
+  + prefix-cache contract — ``accepted_tokens_per_sec``,
+  ``acceptance_rate``, ``prefix_hit_rate`` and
+  ``ttft_p50_prefix_hit_ms`` (null when the trace never hit) — next
+  to their accepted tokens/sec value.
 
 Usage::
 
@@ -143,6 +148,16 @@ FLEET_FIELDS_SINCE_ROUND = 16
 FLEET_METRIC_PREFIX = "serve_fleet"
 FLEET_REQUIRED_FIELDS = ("ttft_p99_ms_interactive", "ttft_p99_ms_batch",
                          "rebalance_latency_ms", "replicas_respawned")
+# the speculative + prefix-cached serving contract (ServeConfig
+# draft_model / prefix_cache, round 17): a serve_spec metric line must
+# carry the acceptance and prefix-reuse accounting next to its
+# accepted tokens/sec value; pre-round-17 records carrying them are
+# flagged — the fields did not exist yet
+SERVE_SPEC_FIELDS_SINCE_ROUND = 17
+SERVE_SPEC_METRIC_PREFIX = "serve_spec"
+SERVE_SPEC_REQUIRED_FIELDS = ("accepted_tokens_per_sec",
+                              "acceptance_rate", "prefix_hit_rate",
+                              "ttft_p50_prefix_hit_ms")
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -291,6 +306,23 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                         f"since round {FLEET_FIELDS_SINCE_ROUND})")
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"serve_fleet field {key!r} must be numeric or "
+                        f"null")
+        is_spec = str(obj.get("metric", "")).startswith(
+            SERVE_SPEC_METRIC_PREFIX)
+        present_spec = [k for k in SERVE_SPEC_REQUIRED_FIELDS
+                        if k in obj]
+        if present_spec and (round_n is not None
+                             and round_n < SERVE_SPEC_FIELDS_SINCE_ROUND):
+            bad(f"serve_spec fields {present_spec} are only defined "
+                f"from round {SERVE_SPEC_FIELDS_SINCE_ROUND}")
+        elif is_spec and (round_n is None
+                          or round_n >= SERVE_SPEC_FIELDS_SINCE_ROUND):
+            for key in SERVE_SPEC_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"serve_spec line missing {key!r} (required "
+                        f"since round {SERVE_SPEC_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"serve_spec field {key!r} must be numeric or "
                         f"null")
         is_overlap = str(obj.get("metric", "")).startswith(
             OVERLAP_METRIC_PREFIX)

@@ -51,6 +51,7 @@ PER_METRIC_BAND = {
     "serve_decode_tokens_per_sec_per_chip": 0.40,
     "serve_chaos_goodput_tokens_per_sec": 0.40,
     "serve_fleet_tokens_per_sec": 0.40,
+    "serve_spec_accepted_tokens_per_sec": 0.40,
 }
 
 

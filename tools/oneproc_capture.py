@@ -496,6 +496,116 @@ def _serve_chaos_smoke(bench):
             "decode_retries": ret["decode_retries"]}
 
 
+def _spec_smoke(bench):
+    """Speculative + prefix-cache smoke (round 17): drive
+    ``serve_spec`` on the tiny model (APEX_TPU_SERVE_SMOKE=1) over a
+    shared-prefix trace and assert (a) the draft actually got accepted
+    (``acceptance_rate > 0``) and the prefix store actually got hit
+    (``prefix_hits > 0``), (b) the speculative engine's greedy token
+    streams are IDENTICAL to the plain baseline engine's (every
+    emitted token is a target argmax — the whole speculative
+    contract), (c) the ladder stayed flat — ``compile_count`` equals
+    the bucket-ladder size with zero warm-trace recompiles (the
+    draft/verify executables replace ladder entries, never add any),
+    and (d) the ``spec_report`` / ``prefix_report`` rollups landed in
+    the telemetry JSONL. Raises on any missing piece so the stage
+    shows up as ERROR rather than silently passing."""
+    import glob
+    import tempfile
+
+    from apex_tpu import telemetry
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_spec_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    prev_smoke = os.environ.get("APEX_TPU_SERVE_SMOKE")
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    os.environ["APEX_TPU_SERVE_SMOKE"] = "1"
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        ret = bench.bench_serve_spec(8, 6)
+    finally:
+        for var, old in ((telemetry.registry.ENV_DIR, prev),
+                         ("APEX_TPU_SERVE_SMOKE", prev_smoke)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+    expected = 3 * 2 + 3      # the smoke ServeConfig bucket ladder
+    if ret["compile_count"] != expected:
+        raise RuntimeError(
+            f"spec smoke: compile_count == {ret['compile_count']}, "
+            f"wanted the bucket-ladder size ({expected}) — the "
+            f"draft/verify executables must REPLACE ladder entries")
+    if ret["recompiles_spec"] != 0:
+        raise RuntimeError(
+            f"spec smoke: {ret['recompiles_spec']} backend compile(s) "
+            f"during the warm trace — speculation leaked into "
+            f"compiled code")
+    if not ret["acceptance_rate"] or ret["acceptance_rate"] <= 0:
+        raise RuntimeError(
+            f"spec smoke: acceptance_rate == "
+            f"{ret['acceptance_rate']!r}, wanted > 0 (the draft never "
+            f"got a token accepted)")
+    if not ret["prefix_hits"] or ret["prefix_hits"] <= 0:
+        raise RuntimeError(
+            "spec smoke: zero prefix-store hits on a shared-prefix "
+            "trace")
+    if not ret["token_identical"]:
+        raise RuntimeError(
+            "spec smoke: the speculative engine's greedy streams "
+            "differ from the plain engine's — verification is not "
+            "token-exact")
+    events = []
+    for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(p) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    serve_events = [e for e in events if e["kind"] == "serve"]
+    for name in ("spec_report", "prefix_report", "prefix_lookup"):
+        if not [e for e in serve_events if e.get("name") == name]:
+            raise RuntimeError(
+                f"spec smoke: no serve/{name} event landed")
+    return {"telemetry_dir": tel_dir,
+            "acceptance_rate": ret["acceptance_rate"],
+            "prefix_hits": ret["prefix_hits"],
+            "prefix_hit_rate": ret["prefix_hit_rate"],
+            "speedup_vs_decode": ret["speedup_vs_decode"],
+            "accepted_tokens_per_sec": ret["accepted_tokens_per_sec"],
+            "ttft_p50_prefix_hit_ms": ret["ttft_p50_prefix_hit_ms"],
+            "compile_count": ret["compile_count"]}
+
+
+def _trend_gate():
+    """Capture-time regression gate (ROADMAP item 5, final slice): run
+    tools/bench_trend.py over the repo's BENCH_*.json series right
+    inside the capture process, so a regressing round fails THIS
+    capture instead of waiting for a human to diff rounds later.
+    Returns the report dict; raises RuntimeError (-> the driver's
+    non-zero exit) when any cross-round regression fires. Scope via
+    $APEX_TPU_TREND_DIR (default: repo root); disable with
+    APEX_TPU_TREND_GATE=0."""
+    if os.environ.get("APEX_TPU_TREND_GATE", "1") == "0":
+        return {"skipped": "APEX_TPU_TREND_GATE=0"}
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import bench_trend
+
+    trend_dir = os.environ.get("APEX_TPU_TREND_DIR", ROOT)
+    report = bench_trend.build_trend(bench_trend.load_rounds([trend_dir]))
+    for g in report["regressions"]:
+        _log(f"{TAG} TREND REGRESSION {g['metric']} "
+             f"r{g['round_a']}->r{g['round_b']} {g['field']}: "
+             f"{g['old']} -> {g['new']} ({g['kind']})")
+    if report["regressions"]:
+        raise RuntimeError(
+            f"{len(report['regressions'])} cross-round regression(s) "
+            f"in {trend_dir} — see TREND REGRESSION lines")
+    return {"rounds_seen": report["rounds_seen"],
+            "rounds_successful": report["rounds_successful"],
+            "configs": len(report["configs"]),
+            "regressions": 0}
+
+
 def _fleet_smoke(bench):
     """Serving-fleet smoke (round 16): drive ``serve_fleet`` on the
     tiny model (APEX_TPU_SERVE_SMOKE=1) — a 2-replica fleet with one
@@ -832,10 +942,12 @@ def _stages(smoke):
             ("memwatch", None, lambda: _memwatch_smoke(bench)),
             ("serve", None, lambda: _serve_smoke(bench)),
             ("serve_chaos", None, lambda: _serve_chaos_smoke(bench)),
+            ("spec", None, lambda: _spec_smoke(bench)),
             ("fleet", None, lambda: _fleet_smoke(bench)),
             ("recovery", None, lambda: _recovery_smoke(bench)),
             ("lint", None, lambda: _lint_smoke(bench)),
             ("overlap", None, lambda: _overlap_smoke(bench)),
+            ("trend", None, _trend_gate),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
         ]
@@ -911,6 +1023,15 @@ def _stages(smoke):
         # and a flat compile count
         ("serve_chaos", None, spec("serve_chaos")),
         ("serve_chaos_smoke", None, lambda: _serve_chaos_smoke(bench)),
+        # round-17 speculative + prefix-cache captures: the serve_spec
+        # config at bench size (accepted tokens/sec vs the in-invocation
+        # plain-engine baseline on the same shared-prefix trace,
+        # acceptance rate, prefix hit rate, hit-vs-miss TTFT split,
+        # token-identity, flat ladder) and the smoke proving acceptance
+        # > 0, prefix hits > 0, and the spec/prefix rollup events in
+        # the JSONL
+        ("serve_spec", None, spec("serve_spec")),
+        ("spec", None, lambda: _spec_smoke(bench)),
         # round-16 serving-fleet captures: the 2-replica fleet chaos
         # config at bench size (fleet tokens/sec, per-tier p99 TTFT,
         # rebalance latency, respawn count, token-identity + zero-loss
@@ -957,6 +1078,11 @@ def _stages(smoke):
         ("vit", None, spec("vit")),
         ("whisper", None, spec("whisper")),
         ("gpt_long", None, spec("gpt")),
+        # capture-time regression gate (ROADMAP item 5, final slice):
+        # compare this round's BENCH_*.json series cross-round and
+        # fail the capture on any regression — last so every stage's
+        # number is already on disk when it runs
+        ("trend", None, _trend_gate),
     ]
 
 

@@ -71,7 +71,8 @@ def aggregate(events):
     serve = {"engines": [], "requests_done": 0, "tokens": 0,
              "ttft_ms": [], "kv_cache": None,
              "by_reason": {}, "rejected": {}, "decode_retries": 0,
-             "decode_failures": 0, "drains": [], "last_health": None}
+             "decode_failures": 0, "drains": [], "last_health": None,
+             "spec": None, "prefix": None, "prefix_lookup_events": 0}
     recovery = {"failures": 0, "recovered": 0, "gave_up": 0,
                 "by_cause": {}, "by_action": {}, "snapshots": 0,
                 "steps_lost": 0, "preempted_exits": 0,
@@ -230,6 +231,20 @@ def aggregate(events):
                             "slots_total", "slots_used", "slots_free",
                             "bytes_per_slot", "cache_dtype",
                             "kv_cache_bytes")}
+                elif sname == "spec_report":
+                    serve["spec"] = {
+                        k: ev.get(k) for k in (
+                            "proposed", "accepted", "acceptance_rate",
+                            "num_draft_tokens", "decode_steps",
+                            "tokens_generated")}
+                elif sname == "prefix_report":
+                    serve["prefix"] = {
+                        k: ev.get(k) for k in (
+                            "entries", "bytes", "lookups", "hits",
+                            "hit_rate", "hit_tokens", "insertions",
+                            "evictions")}
+                elif sname == "prefix_lookup":
+                    serve["prefix_lookup_events"] += 1
             elif kind == "recovery":
                 rname = ev.get("name")
                 if rname == "failure":
@@ -519,6 +534,24 @@ def print_report(report, out=None):
               f"{kv.get('slots_total')} slots used, "
               f"{_fmt_bytes(kv.get('bytes_per_slot') or 0)}/slot "
               f"({kv.get('cache_dtype')})\n")
+        spec = serve.get("spec")
+        if spec:
+            w(f"  speculative decode: acceptance "
+              f"{(spec.get('acceptance_rate') or 0) * 100:.1f}% "
+              f"({spec.get('accepted')}/{spec.get('proposed')} draft "
+              f"token(s), k={spec.get('num_draft_tokens')}), "
+              f"{spec.get('tokens_generated')} token(s) over "
+              f"{spec.get('decode_steps')} dispatch(es)\n")
+        prefix = serve.get("prefix")
+        if prefix:
+            lookups = prefix.get("lookups") or 0
+            hits = prefix.get("hits") or 0
+            w(f"  prefix cache: {hits}/{lookups} hit(s) "
+              f"({(prefix.get('hit_rate') or 0) * 100:.1f}%), "
+              f"{prefix.get('hit_tokens')} prefix token(s) reused, "
+              f"{prefix.get('entries')} entr(ies) "
+              f"({_fmt_bytes(prefix.get('bytes') or 0)}), "
+              f"{prefix.get('evictions')} eviction(s)\n")
     fleet = report.get("fleet") or {}
     if fleet.get("starts") or fleet.get("last_report") \
             or fleet.get("timeline"):
