@@ -29,6 +29,7 @@ from apex_tpu.analysis.lint import (  # noqa: F401
     LintContext,
     LintReport,
     assert_clean_hlo,
+    build_context,
     lint_fn,
     lint_lowered,
     run_rules,
@@ -38,6 +39,13 @@ from apex_tpu.analysis.rules import (  # noqa: F401
     RULES,
     Finding,
     LintConfig,
+)
+from apex_tpu.analysis.sharding import (  # noqa: F401
+    CollectiveGraph,
+    CollectiveOp,
+    audit_spmd,
+    collective_graph,
+    static_comm_bytes,
 )
 
 

@@ -217,6 +217,26 @@ def sharding_custom_calls(text):
     return out
 
 
+_ARG_SHARDING_RE = re.compile(
+    r"(%arg\d+):\s*tensor<[^>]*>\s*\{[^}]*mhlo\.sharding\s*=\s*"
+    r'"([^"]*)"')
+
+
+def arg_shardings(text):
+    """``[(lineno, arg_name, sharding_str)]`` for every entry-function
+    argument carrying an ``mhlo.sharding`` annotation — the sharded
+    roots the collective dataflow analysis walks from (the entry
+    signature spans multiple lines on wide programs, so this scans
+    every line rather than reparsing the balanced signature)."""
+    out = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if "mhlo.sharding" not in line:
+            continue
+        for m in _ARG_SHARDING_RE.finditer(line):
+            out.append((i, m.group(1), m.group(2)))
+    return out
+
+
 _INTERLEAVE_COLLECTIVE_RE = re.compile(
     r"stablehlo\.(all_reduce|reduce_scatter|all_gather|all_to_all)\b")
 _INTERLEAVE_COMPUTE_RE = re.compile(

@@ -143,11 +143,13 @@ def _flat_out_info(staged):
             if hasattr(o, "shape") and hasattr(o, "dtype")] or None
 
 
-def lint_fn(fn, *args, rules=None, waive=(), config=None, name=None,
-            **kwargs):
+def build_context(fn, *args, name=None, **kwargs):
     """Trace ``fn`` (jitted or plain; plain functions are wrapped in
-    ``jax.jit``) on ``args``/``kwargs`` and lint with full context.
-    Returns a :class:`LintReport`. Trace-only — nothing compiles."""
+    ``jax.jit``) on ``args``/``kwargs`` and return the full
+    :class:`LintContext` — the shared front half of :func:`lint_fn`,
+    exposed so a caller that needs both the rule report AND the
+    collective table (``tools/hlo_lint.py --comm``) traces once.
+    Trace-only — nothing compiles."""
     # a watched function (CompileWatcher) delegates trace/lower to the
     # wrapped pjit, so it counts as staged; only plain callables get a
     # fresh jit wrapper here (never unwrap: jit sets __wrapped__ to the
@@ -165,7 +167,7 @@ def lint_fn(fn, *args, rules=None, waive=(), config=None, name=None,
     # path-keyed subset rather than crashing the lint)
     val_by_path = dict(flat_vals)
     flat_args = [(p, val_by_path.get(p)) for p, _ in flat_info]
-    ctx = LintContext(
+    return LintContext(
         hlo_text=lowered.as_text(),
         name=name or getattr(fn, "__name__", "") or "<fn>",
         closed_jaxpr=traced.jaxpr,
@@ -173,6 +175,13 @@ def lint_fn(fn, *args, rules=None, waive=(), config=None, name=None,
         flat_args=flat_args,
         out_avals=_flat_out_info(traced),
     )
+
+
+def lint_fn(fn, *args, rules=None, waive=(), config=None, name=None,
+            **kwargs):
+    """Trace ``fn`` and lint with full context. Returns a
+    :class:`LintReport`. Trace-only — nothing compiles."""
+    ctx = build_context(fn, *args, name=name, **kwargs)
     return run_rules(ctx, rules=rules, waive=waive, config=config)
 
 

@@ -14,6 +14,11 @@ Checks (ruff rule codes for cross-reference):
 - ``F401`` unused import (module and function scope; names re-exported
   via ``__all__`` count as used; ``__init__.py`` files are exempt per
   the ruff per-file-ignores)
+- ``F841`` unused local variable (function scope only, mirroring
+  pyflakes: simple ``name = ...`` / annotated assignments and
+  ``except ... as name`` bindings never read again; tuple-unpacking,
+  augmented-assignment and loop targets are exempt, as are
+  underscore-prefixed names)
 - ``E711`` comparison to ``None`` with ``==`` / ``!=``
 - ``E722`` bare ``except:``
 - ``B006`` mutable default argument (list/dict/set literals or
@@ -194,6 +199,71 @@ def _collect_f401(tree, source_lines, path, findings, ignored):
     scope_check(tree.body, True)
 
 
+def _collect_f841(tree, source_lines, path, findings, ignored):
+    """Unused-local detection, function scope only (a module-level name
+    is API surface, not a local). Conservative exactly where ruff's
+    pyflakes engine is: only simple ``name = value`` / annotated
+    assignments and ``except ... as name`` count as flaggable bindings
+    — tuple unpacking, ``for`` targets, ``with ... as``, walrus and
+    augmented assignments never fire — and ANY load of the name
+    anywhere in the function (nested scopes included) counts as a
+    use."""
+    if "F841" in ignored:
+        return
+
+    def check_function(fn_node):
+        declared_elsewhere = set()  # global / nonlocal names
+        bindings = {}               # name -> first binding lineno
+        loads = set()
+
+        def collect_bindings(node, top):
+            """Own-scope bindings only — nested function/class bodies
+            are their own scopes and get their own check."""
+            if node is not fn_node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared_elsewhere.update(node.names)
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                bindings.setdefault(node.targets[0].id, node.lineno)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                bindings.setdefault(node.target.id, node.lineno)
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                bindings.setdefault(node.name, node.lineno)
+            for child in ast.iter_child_nodes(node):
+                collect_bindings(child, False)
+
+        collect_bindings(fn_node, True)
+        # loads from ANYWHERE inside the function (closures over our
+        # locals included) count as uses — conservative like F401.
+        # ``del name`` also counts (pyflakes parity: an explicit
+        # delete is a deliberate end-of-life, not an unused binding).
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, (ast.Load, ast.Del)):
+                loads.add(node.id)
+        for name, lineno in sorted(bindings.items(),
+                                   key=lambda kv: kv[1]):
+            if name in loads or name in declared_elsewhere \
+                    or name.startswith("_"):
+                continue
+            if _suppressed(source_lines, lineno, "F841"):
+                continue
+            findings.append(PyFinding(
+                path, lineno, "F841",
+                f"local variable '{name}' is assigned to but never "
+                f"used"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            check_function(node)
+
+
 _MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict",
                   "Counter", "deque"}
 
@@ -227,6 +297,7 @@ def check_source(source, path="<string>", per_file_ignores=None):
                                   f"syntax error: {e.msg}"))
         return findings
     _collect_f401(tree, source_lines, path, findings, ignored)
+    _collect_f841(tree, source_lines, path, findings, ignored)
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             if "E722" not in ignored and not _suppressed(

@@ -43,6 +43,22 @@ rule                        catches
                             latency-hiding scheduler cannot overlap (the
                             static check that an overlapped step's buckets
                             stay independent)
+``implicit-reshard``        a collective-permute / all-to-all in the HLO
+                            with no corresponding collective in the source
+                            jaxpr — GSPMD resharded behind our back (named
+                            by operand and wire bytes)
+``replica-group-consistency``  collectives whose replica groups cannot be
+                            executed by one SPMD schedule — overlapping
+                            groups, groups that miss part of the device
+                            set every device is forced through, or unequal
+                            group sizes (deadlock shapes on real meshes)
+``comm-budget``             static per-program wire bytes exceed the
+                            declared budget (``LintConfig.comm_budget_bytes``
+                            / ``APEX_TPU_HLO_LINT_COMM_BUDGET``; 0 = off)
+``sharding-propagation-loss``  a large intermediate pinned replicated
+                            BETWEEN two sharded values — propagation lost
+                            the sharding mid-program (the per-edge
+                            generalization of ``replication-blowup``)
 ==========================  ================================================
 """
 
@@ -92,6 +108,9 @@ class LintConfig:
     const_min_bytes: int = 1 << 20
     replicated_min_bytes: int = 1 << 20
     overlap_min_bytes: int = 1 << 20
+    # static per-program wire-byte budget for the comm-budget rule;
+    # 0 = no budget declared (the rule runs and is vacuously clean)
+    comm_budget_bytes: int = 0
     max_findings_per_rule: int = 16
 
     def __post_init__(self):
@@ -104,6 +123,8 @@ class LintConfig:
             self.replicated_min_bytes)
         self.overlap_min_bytes = _env_bytes(
             "APEX_TPU_HLO_LINT_OVERLAP_BYTES", self.overlap_min_bytes)
+        self.comm_budget_bytes = _env_bytes(
+            "APEX_TPU_HLO_LINT_COMM_BUDGET", self.comm_budget_bytes)
 
 
 # custom_call targets that ARE host round-trips. Matched against parsed
@@ -599,6 +620,229 @@ def rule_overlap_serialization(ctx, cfg):
     return findings
 
 
+# ---------------------------------------------------------------------------
+# the SPMD communication rules (analysis/sharding.py — the collective
+# dataflow graph is parsed once per context and shared)
+# ---------------------------------------------------------------------------
+
+def _op_where(op):
+    return f"{op.kind}@line {op.lineno}"
+
+
+def rule_implicit_reshard(ctx, cfg):
+    """A collective-permute / all-to-all in the HLO with no
+    corresponding collective in the source jaxpr: GSPMD (or the SPMD
+    partitioner) inserted a reshard the author never wrote. On the
+    trace-only ``lint_fn`` path text and jaxpr agree 1:1, so this is
+    clean by construction; the finding fires on contexts built from
+    post-partitioning HLO (``sharding.audit_spmd``) or hand-supplied
+    text — exactly where the silent insertion is visible."""
+    if ctx.closed_jaxpr is None:
+        return None  # nothing to compare against — skipped, not passed
+    from apex_tpu.analysis import sharding
+
+    graph = sharding.graph_for_context(ctx)
+    authored = sharding.jaxpr_collective_counts(ctx.closed_jaxpr.jaxpr)
+    findings = []
+    for kind in ("collective_permute", "all_to_all"):
+        emitted = [op for op in graph.ops if op.kind == kind]
+        extra = len(emitted) - authored.get(kind, 0)
+        if extra <= 0:
+            continue
+        # the ops beyond the authored count, in module order, are the
+        # insertions — name each by its operand and wire bytes
+        for op in emitted[len(emitted) - extra:]:
+            operand = op.operands[0] if op.operands else "<?>"
+            shape, dtype, _ = (op.operand_specs[0] if op.operand_specs
+                               else (None, "?", 0))
+            findings.append(Finding(
+                "implicit-reshard",
+                f"{kind} over operand {operand} "
+                f"({dtype}{list(shape) if shape else '?'}, "
+                f"{_fmt_bytes(op.wire_bytes)} on the wire) has no "
+                f"corresponding collective in the source jaxpr — the "
+                f"partitioner resharded behind your back; make the "
+                f"layout transition explicit (with_sharding_constraint "
+                f"/ shard_map) or fix the producer/consumer shardings "
+                f"to agree",
+                where=_op_where(op),
+                extra={"nbytes": op.wire_bytes, "operand": operand}))
+    return findings
+
+
+def rule_replica_group_consistency(ctx, cfg):
+    """Replica-group partitions every device can actually execute in
+    one SPMD schedule: in SPMD every device runs every collective in
+    program order, so each op's groups must tile the SAME device set —
+    a device appearing in two groups, a device the groups miss, or
+    unequal group sizes is a shape XLA either rejects at runtime or,
+    worse, deadlocks on across hosts."""
+    from apex_tpu.analysis import sharding
+
+    graph = sharding.graph_for_context(ctx)
+    if not graph.ops:
+        return []
+    universe = graph.device_set()
+    findings = []
+    for op in graph.ops:
+        if op.replica_groups is not None:
+            flat = [d for g in op.replica_groups for d in g]
+            dupes = sorted({d for d in flat if flat.count(d) > 1})
+            if dupes:
+                findings.append(Finding(
+                    "replica-group-consistency",
+                    f"{op.kind} replica groups list device(s) {dupes} "
+                    f"in more than one group — not a partition; no "
+                    f"SPMD schedule can execute it",
+                    where=_op_where(op)))
+                continue
+            missing = sorted(universe - set(flat))
+            if missing:
+                findings.append(Finding(
+                    "replica-group-consistency",
+                    f"{op.kind} replica groups cover only "
+                    f"{sorted(set(flat))} of the program's device set "
+                    f"— device(s) {missing} execute the op with no "
+                    f"group to join (deadlock on real multi-host)",
+                    where=_op_where(op),
+                    extra={"missing": missing}))
+            sizes = {len(g) for g in op.replica_groups}
+            if len(sizes) > 1:
+                findings.append(Finding(
+                    "replica-group-consistency",
+                    f"{op.kind} replica groups have unequal sizes "
+                    f"{sorted(sizes)} — XLA requires a uniform "
+                    f"partition of the device set",
+                    where=_op_where(op)))
+        if op.source_target_pairs is not None:
+            targets = [p[-1] for p in op.source_target_pairs if p]
+            dup_t = sorted({t for t in targets if targets.count(t) > 1})
+            if dup_t:
+                findings.append(Finding(
+                    "replica-group-consistency",
+                    f"{op.kind} source_target_pairs send to device(s) "
+                    f"{dup_t} more than once — conflicting writes, "
+                    f"rejected at execution",
+                    where=_op_where(op)))
+            out_of_range = sorted({d for p in op.source_target_pairs
+                                   for d in p if d not in universe})
+            if out_of_range and universe:
+                findings.append(Finding(
+                    "replica-group-consistency",
+                    f"{op.kind} source_target_pairs reference "
+                    f"device(s) {out_of_range} outside the program's "
+                    f"device set {sorted(universe)}",
+                    where=_op_where(op)))
+    return findings
+
+
+def rule_comm_budget(ctx, cfg):
+    """Static per-program wire bytes vs the declared budget. With no
+    budget declared (``comm_budget_bytes == 0``) the rule runs and is
+    vacuously clean — declare one per capture via
+    ``APEX_TPU_HLO_LINT_COMM_BUDGET`` or ``LintConfig``."""
+    if cfg.comm_budget_bytes <= 0:
+        return []
+    from apex_tpu.analysis import sharding
+
+    graph = sharding.graph_for_context(ctx)
+    total = graph.total_wire_bytes
+    if total <= cfg.comm_budget_bytes:
+        return []
+    top = max(graph.ops, key=lambda op: op.wire_bytes)
+    return [Finding(
+        "comm-budget",
+        f"static program wire bytes {_fmt_bytes(total)} exceed the "
+        f"declared budget {_fmt_bytes(cfg.comm_budget_bytes)} "
+        f"(largest contributor: {top.kind} at line {top.lineno}, "
+        f"{_fmt_bytes(top.wire_bytes)}) — compress the payload, shard "
+        f"the state, or raise the budget deliberately",
+        where=_op_where(top),
+        extra={"nbytes": total,
+               "budget_bytes": cfg.comm_budget_bytes})]
+
+
+def rule_sharding_propagation_loss(ctx, cfg):
+    """A large intermediate pinned ``{replicated}`` between two sharded
+    values: sharding propagation lost the layout mid-program, so every
+    device holds (and every boundary moves) the full buffer on an edge
+    whose endpoints are sharded — the per-edge generalization of
+    ``replication-blowup`` (which flags the replicated tensor itself;
+    this rule fires only when the surrounding dataflow proves the
+    replication is a LOSS, naming both sharded endpoints)."""
+    text = ctx.hlo_text
+    if hlo.num_partitions(text) <= 1:
+        return []
+    from apex_tpu.analysis import sharding
+
+    graph = sharding.graph_for_context(ctx).value_graph
+    lines = text.splitlines()
+    # sharded evidence: entry args and @Sharding constraints whose
+    # annotation is a real partition (not replicated / manual)
+    def _is_sharded(annot):
+        return annot is not None and "replicated" not in annot \
+            and "manual" not in annot
+
+    sharded_vars = {}
+    # entry args with a real partition annotation are sharded roots;
+    # the arg lines sit inside @main in every lowering jax produces
+    for i, arg, annot in hlo.arg_shardings(text):
+        if _is_sharded(annot):
+            sharded_vars[sharding._qual("main", arg)] = \
+                f"{arg} (entry arg, line {i})"
+    func = ""
+    for i, line in enumerate(lines, 1):
+        fm = sharding._FUNC_RE.search(line)
+        if fm:
+            func = fm.group(1)
+        if "custom_call @Sharding" in line:
+            om = hlo._SHARDING_OP_RE.search(line)
+            sm = hlo._SHARDING_ATTR_RE.search(line)
+            if om is not None and sm is not None \
+                    and _is_sharded(sm.group(1)):
+                sharded_vars[sharding._qual(func, om.group(1))] = \
+                    f"sharding constraint at line {i}"
+    if not sharded_vars:
+        return []
+    findings = []
+    constraint_lines = {i for i, _, _ in hlo.sharding_custom_calls(text)}
+    func = ""
+    for i, line in enumerate(lines, 1):
+        fm = sharding._FUNC_RE.search(line)
+        if fm:
+            func = fm.group(1)
+        if i not in constraint_lines:
+            continue
+        sm = hlo._SHARDING_ATTR_RE.search(line)
+        if sm is None or sm.group(1) != "{replicated}":
+            continue
+        tensors = hlo._TENSOR_RE.findall(line)
+        if not tensors:
+            continue
+        _, _, nbytes = hlo.parse_tensor_type(tensors[-1])
+        if nbytes < cfg.replicated_min_bytes:
+            continue
+        om = hlo._SHARDING_OP_RE.search(line)
+        if om is None:
+            continue
+        result = sharding._qual(func, om.group(1))
+        up = graph.ancestors(result) & set(sharded_vars)
+        down = graph.descendants(result) & set(sharded_vars)
+        if up and down:
+            src = sharded_vars[sorted(up)[0]]
+            dst = sharded_vars[sorted(down)[0]]
+            findings.append(Finding(
+                "sharding-propagation-loss",
+                f"tensor<{tensors[-1]}> ({_fmt_bytes(nbytes)}) is "
+                f"carried fully replicated at line {i} between sharded "
+                f"values (upstream: {src}; downstream: {dst}) — "
+                f"propagation lost the sharding mid-program; constrain "
+                f"this intermediate to a sharded layout",
+                where=f"line {i}",
+                extra={"nbytes": nbytes}))
+    return findings
+
+
 # rule registry: name -> (fn, what it needs beyond the HLO text).
 # Order is the report order.
 RULES = {
@@ -611,4 +855,8 @@ RULES = {
     "collective-consistency": (rule_collective_consistency, ("jaxpr",)),
     "overlap-serialization": (rule_overlap_serialization, ("jaxpr",)),
     "replication-blowup": (rule_replication_blowup, ()),
+    "implicit-reshard": (rule_implicit_reshard, ("jaxpr",)),
+    "replica-group-consistency": (rule_replica_group_consistency, ()),
+    "comm-budget": (rule_comm_budget, ()),
+    "sharding-propagation-loss": (rule_sharding_propagation_loss, ()),
 }

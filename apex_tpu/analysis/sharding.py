@@ -1,0 +1,607 @@
+"""Whole-program collective dataflow analysis over lowered HLO.
+
+PR 9's rules look at one op or one argument at a time. The SPMD
+questions that actually bite on a 2-D mesh are *relational*: which
+collectives does the program execute, over which partitions of the
+device set, moving how many wire bytes, and do those match what the
+source jaxpr authored? GSPMD inserts resharding collective-permutes /
+all-to-alls silently, replica groups can diverge into shapes no single
+SPMD schedule can execute, and the compressed-collective byte win only
+exists if the compiler actually emitted the quantized payload we think
+it did.
+
+This module parses every collective op out of HLO text into a
+:class:`CollectiveGraph` — nodes carry ``replica_groups`` / channel
+ids / operand shapes+dtypes, edges are def-use reachability between
+collectives — and computes static per-op and per-program wire bytes
+with the same ring model as
+:func:`apex_tpu.parallel.compression.estimate_allreduce_bytes` and
+:func:`apex_tpu.telemetry.comm.wire_bytes`. Two HLO dialects are
+understood:
+
+- **lowered StableHLO** (``jitted.lower(...).as_text()``) — the
+  trace-only artifact every lint entrypoint already has. shard_map
+  programs carry their collectives explicitly here.
+- **post-optimization HLO** (``lowered.compile().as_text()``) — the
+  only artifact where GSPMD's *inserted* collectives are visible.
+  :func:`audit_spmd` is the explicitly-compiling entrypoint for that
+  comparison; everything else in ``apex_tpu.analysis`` stays
+  trace-only.
+
+The int8 psum emulation (``parallel/compression.py``) ships int32
+partials through XLA today; the *semantic* wire format is int8 +
+scales. A reduction collective whose operand is a
+``convert(i8 -> i32)`` is therefore counted at 1 byte/element and
+tagged ``emulated`` — the same convention ``record_collective`` uses,
+so the static total is directly comparable to the bench's
+``measured_comm_bytes_per_step`` (the 25% consistency gate in
+``bench.py`` depends on the two models staying aligned).
+"""
+
+import dataclasses
+import re
+from typing import Optional
+
+from apex_tpu.analysis import hlo
+
+COLLECTIVE_KINDS = ("all_reduce", "reduce_scatter", "all_gather",
+                    "all_to_all", "collective_permute")
+
+# jaxpr collective primitive -> the HLO op kind it lowers to
+JAXPR_TO_HLO_KIND = {
+    "psum": "all_reduce", "pmax": "all_reduce", "pmin": "all_reduce",
+    "reduce_precision_psum": "all_reduce",
+    "psum_scatter": "reduce_scatter", "reduce_scatter": "reduce_scatter",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "ppermute": "collective_permute", "pbroadcast": "collective_permute",
+}
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One collective parsed out of HLO text."""
+
+    kind: str                 # one of COLLECTIVE_KINDS
+    func: str                 # enclosing function name
+    lineno: int               # 1-based module line
+    result: str               # result var (base name)
+    operands: tuple           # operand var base names
+    operand_specs: tuple      # (shape, dtype, nbytes) per operand
+    replica_groups: Optional[tuple] = None   # tuple of device tuples
+    source_target_pairs: Optional[tuple] = None
+    channel_id: Optional[int] = None
+    group_size: int = 1
+    payload_bytes: int = 0    # semantic payload (emulation-aware)
+    wire_bytes: int = 0       # ring-model bytes each device transmits
+    emulated: bool = False    # int8-emulation payload detected
+    wire_dtype: str = ""      # semantic wire dtype
+    axis_names: Optional[tuple] = None  # best-effort, from the jaxpr
+    line: str = ""
+
+    def to_row(self):
+        groups = None
+        if self.replica_groups is not None:
+            groups = [list(g) for g in self.replica_groups]
+        elif self.source_target_pairs is not None:
+            groups = [list(p) for p in self.source_target_pairs]
+        shape, dtype, _ = (self.operand_specs[0] if self.operand_specs
+                           else (None, None, 0))
+        return {
+            "op": self.kind, "line": self.lineno,
+            "dtype": self.wire_dtype or dtype,
+            "shape": list(shape) if shape else None,
+            "replica_groups": groups,
+            "group_size": self.group_size,
+            "channel_id": self.channel_id,
+            "payload_bytes": self.payload_bytes,
+            "wire_bytes": self.wire_bytes,
+            "emulated": self.emulated,
+            "axes": list(self.axis_names) if self.axis_names else None,
+        }
+
+
+# ---------------------------------------------------------------------------
+# text-level def-use graph (per function — var names reset per func)
+# ---------------------------------------------------------------------------
+
+_FUNC_RE = re.compile(r"func\.func\s+(?:public\s+|private\s+)?@([\w$.\-]+)")
+_DEF_RE = re.compile(r"^\s*(%[\w.\-]+)(?::\d+)?\s*=\s*(.*)$")
+_VAR_RE = re.compile(r"%[\w.\-]+")
+
+
+def _qual(func, var):
+    return f"{func}:{var}"
+
+
+class ValueGraph:
+    """Def-use over HLO text: qualified var -> (op line text, lineno,
+    operand vars); plus consumers for forward walks. Cross-function
+    ``call`` edges are not followed — the analyses below only need
+    intra-function reachability (collectives and their feeds live in
+    one function in every lowering jax produces)."""
+
+    def __init__(self):
+        self.defs = {}        # qvar -> (lineno, op_text, operand qvars)
+        self.consumers = {}   # qvar -> [result qvar, ...]
+
+    def add(self, func, lineno, result, op_text, operands):
+        q = _qual(func, result)
+        qops = tuple(_qual(func, o) for o in operands)
+        self.defs[q] = (lineno, op_text, qops)
+        for o in qops:
+            self.consumers.setdefault(o, []).append(q)
+
+    def ancestors(self, qvar):
+        seen, stack = set(), [qvar]
+        while stack:
+            v = stack.pop()
+            for o in self.defs.get(v, (0, "", ()))[2]:
+                if o not in seen:
+                    seen.add(o)
+                    stack.append(o)
+        return seen
+
+    def descendants(self, qvar):
+        seen, stack = set(), [qvar]
+        while stack:
+            v = stack.pop()
+            for c in self.consumers.get(v, ()):
+                if c not in seen:
+                    seen.add(c)
+                    stack.append(c)
+        return seen
+
+
+def _base_var(tok):
+    return tok.split("#", 1)[0]
+
+
+def build_value_graph(text):
+    graph = ValueGraph()
+    func = ""
+    for i, line in enumerate(text.splitlines(), 1):
+        fm = _FUNC_RE.search(line)
+        if fm:
+            func = fm.group(1)
+            continue
+        dm = _DEF_RE.match(line)
+        if dm is None:
+            continue
+        result, rest = dm.group(1), dm.group(2)
+        operands = tuple({_base_var(v) for v in _VAR_RE.findall(rest)}
+                        - {result})
+        graph.add(func, i, result, rest, operands)
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# replica-group parsing (both dialects)
+# ---------------------------------------------------------------------------
+
+_DENSE_GROUPS_RE = re.compile(
+    r"replica_groups\s*=\s*dense<([^>]*)>\s*:\s*tensor<([\dx]+)xi64>")
+_DENSE_PAIRS_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<([^>]*)>\s*:\s*tensor<([\dx]+)xi64>")
+_CHANNEL_STABLE_RE = re.compile(r"channel_handle\s*=\s*#stablehlo\."
+                                r"channel_handle<handle\s*=\s*(\d+)")
+_CHANNEL_HLO_RE = re.compile(r"channel_id=(\d+)")
+_HLO_GROUPS_BRACE_RE = re.compile(r"replica_groups=\{(\{[^}]*\}"
+                                  r"(?:,\s*\{[^}]*\})*)\}")
+_HLO_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_HLO_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}"
+                           r"(?:,\s*\{[^}]*\})*)\}")
+
+
+def _parse_dense_matrix(payload, shape_spec):
+    """``dense<[[0, 1], [2, 3]]>`` (or a splat) with its declared
+    ``GxSxi64`` shape -> tuple of row tuples, or None if unparseable."""
+    dims = [int(d) for d in shape_spec.split("x") if d]
+    nums = [int(n) for n in re.findall(r"-?\d+", payload)]
+    total = 1
+    for d in dims:
+        total *= d
+    if len(nums) != total or len(dims) != 2:
+        return None  # splat over >1 element carries no partition info
+    rows, cols = dims
+    return tuple(tuple(nums[r * cols:(r + 1) * cols])
+                 for r in range(rows))
+
+
+def _parse_iota_groups(g, s, dims_s, perm_s):
+    """Post-opt HLO iota form ``[G,S]<=[d0,d1]T(p0,p1)``: iota over the
+    dims, transposed by the perm, reshaped to G groups of S."""
+    try:
+        import numpy as np
+
+        dims = [int(d) for d in dims_s.split(",") if d]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if perm_s:
+            arr = arr.transpose([int(p) for p in perm_s.split(",")])
+        flat = arr.reshape(-1)
+        g, s = int(g), int(s)
+        if g * s != flat.size:
+            return None
+        return tuple(tuple(int(x) for x in flat[r * s:(r + 1) * s])
+                     for r in range(g))
+    except Exception:
+        return None
+
+
+def _parse_brace_groups(payload):
+    return tuple(tuple(int(n) for n in re.findall(r"-?\d+", grp))
+                 for grp in re.findall(r"\{([^}]*)\}", payload))
+
+
+# ---------------------------------------------------------------------------
+# collective parsing
+# ---------------------------------------------------------------------------
+
+_STABLE_OP_RE = re.compile(
+    r"(%[\w.\-]+)(?::\d+)?\s*=\s*\"?stablehlo\.(" +
+    "|".join(COLLECTIVE_KINDS) + r")\"?\s*\(([^)]*)\)")
+_SIG_RE = re.compile(r":\s*\(([^)]*)\)\s*->\s*(.+?)\s*$")
+_HLO_OP_RE = re.compile(
+    r"(%[\w.\-]+)\s*=\s*\(?\s*((?:[a-z0-9]+\[[^\]]*\][^)]*?|\s|,)*?)\)?\s*"
+    r"(all-reduce|reduce-scatter|all-gather|all-to-all|collective-permute)"
+    r"(?:-start)?\(")
+_HLO_TYPE_RE = re.compile(r"([a-z]+\d*(?:e\d+m\d+\w*)?)\[([\d,]*)\]")
+
+
+def _spec_from_tensor(spec):
+    shape, dtype, nbytes = hlo.parse_tensor_type(spec)
+    return (shape, dtype, nbytes)
+
+
+def _region_signature(lines, start):
+    """The ``}) : (types) -> types`` closing line of a region op whose
+    opening line is ``lines[start]``. Returns (operand_specs,
+    close_lineno) or (None, start)."""
+    depth = lines[start].count("({") - lines[start].count("})")
+    i = start
+    while depth > 0 and i + 1 < len(lines):
+        i += 1
+        depth += lines[i].count("({") - lines[i].count("})")
+    m = _SIG_RE.search(lines[i])
+    if m is None:
+        return None, start
+    specs = tuple(_spec_from_tensor(t)
+                  for t in hlo._TENSOR_RE.findall(m.group(1)))
+    return specs, i
+
+
+def _stablehlo_collectives(text, graph):
+    lines = text.splitlines()
+    func = ""
+    ops = []
+    for idx, line in enumerate(lines):
+        fm = _FUNC_RE.search(line)
+        if fm:
+            func = fm.group(1)
+        m = _STABLE_OP_RE.search(line)
+        if m is None:
+            continue
+        result, kind, operands_raw = m.group(1), m.group(2), m.group(3)
+        operands = tuple(_base_var(v)
+                         for v in _VAR_RE.findall(operands_raw))
+        sig = _SIG_RE.search(line)
+        if sig is not None:
+            specs = tuple(_spec_from_tensor(t)
+                          for t in hlo._TENSOR_RE.findall(sig.group(1)))
+        else:
+            specs, _ = _region_signature(lines, idx)
+            specs = specs or ()
+        groups = None
+        gm = _DENSE_GROUPS_RE.search(line)
+        if gm:
+            groups = _parse_dense_matrix(gm.group(1), gm.group(2))
+        pairs = None
+        pm = _DENSE_PAIRS_RE.search(line)
+        if pm:
+            pairs = _parse_dense_matrix(pm.group(1), pm.group(2))
+        cm = _CHANNEL_STABLE_RE.search(line)
+        ops.append(CollectiveOp(
+            kind=kind, func=func, lineno=idx + 1, result=result,
+            operands=operands, operand_specs=specs,
+            replica_groups=groups, source_target_pairs=pairs,
+            channel_id=int(cm.group(1)) if cm else None,
+            line=line.strip()))
+    return ops
+
+
+def _hlo_collectives(text, graph):
+    """Post-optimization HLO text (``compiled.as_text()``) — the
+    dialect where GSPMD's inserted collectives are visible."""
+    # post-opt HLO instruction names are unique module-wide, so every
+    # var stays qualified under the one "" scope the value graph used
+    func = ""
+    ops = []
+    for idx, line in enumerate(text.splitlines()):
+        s = line.strip()
+        m = _HLO_OP_RE.search(s)
+        if m is None:
+            continue
+        result, kind = m.group(1), m.group(3).replace("-", "_")
+        paren = s[m.end() - 1:]
+        inner = paren[1:hlo._balanced_span(paren, 0) - 1]
+        operands = tuple(_base_var(v) for v in _VAR_RE.findall(inner)
+                         if _base_var(v) != result)
+        specs = tuple((tuple(int(d) for d in dims.split(",") if d),
+                       dt,
+                       _nbytes_hlo(dims, dt))
+                      for dt, dims in _HLO_TYPE_RE.findall(inner))
+        groups = None
+        gb = _HLO_GROUPS_BRACE_RE.search(s)
+        if gb:
+            groups = _parse_brace_groups(gb.group(1))
+        else:
+            gi = _HLO_GROUPS_IOTA_RE.search(s)
+            if gi:
+                groups = _parse_iota_groups(*gi.groups())
+        pairs = None
+        pp = _HLO_PAIRS_RE.search(s)
+        if pp:
+            pairs = _parse_brace_groups(pp.group(1))
+        cm = _CHANNEL_HLO_RE.search(s)
+        ops.append(CollectiveOp(
+            kind=kind, func=func, lineno=idx + 1, result=result,
+            operands=operands, operand_specs=specs,
+            replica_groups=groups, source_target_pairs=pairs,
+            channel_id=int(cm.group(1)) if cm else None,
+            line=s))
+    return ops
+
+
+def _nbytes_hlo(dims_s, dtype):
+    n = 1
+    for d in dims_s.split(","):
+        if d:
+            n *= int(d)
+    return n * hlo._DTYPE_BYTES.get(dtype, 4)
+
+
+# ---------------------------------------------------------------------------
+# the ring wire model (shared convention with telemetry.comm)
+# ---------------------------------------------------------------------------
+
+_EMU_CONVERT_RE = re.compile(r"stablehlo\.convert\b.*"
+                             r"tensor<[\dx]*x?i8>\)?\s*->")
+
+
+def _semantic_payload(op, graph):
+    """(payload_bytes, wire_dtype, emulated): the semantic wire payload
+    of a collective. A reduction whose operand comes from a
+    ``convert(i8 -> i32)`` is the int8 psum emulation — count it at 1
+    byte/element (the wire format a production quantized collective
+    ships; same convention as ``record_collective``)."""
+    total = 0
+    dtype = ""
+    emulated = False
+    for var, spec in zip(op.operands, op.operand_specs):
+        shape, dt, nbytes = spec
+        elements = 1
+        for d in (shape or ()):
+            elements *= d
+        if dt in ("i32", "ui32") and op.kind in ("all_reduce",
+                                                 "reduce_scatter"):
+            src = graph.defs.get(_qual(op.func, var))
+            if src is not None and _EMU_CONVERT_RE.search(src[1]):
+                nbytes = elements  # 1 byte/elem — the semantic payload
+                dt = "i8"
+                emulated = True
+        total += nbytes
+        dtype = dtype or dt
+    return total, dtype, emulated
+
+
+def wire_bytes_for(kind, payload_bytes, group_size, *, n_pairs=0):
+    """Ring-model bytes each device transmits — the same per-op
+    convention as ``telemetry.comm.wire_bytes`` (all_gather payloads
+    are per-shard operands, so the factor is ``g-1`` not
+    ``(g-1)/g``)."""
+    g = group_size
+    if kind == "collective_permute":
+        return float(payload_bytes) if n_pairs else 0.0
+    if g <= 1:
+        return 0.0
+    if kind == "all_reduce":
+        return 2.0 * (g - 1) / g * payload_bytes
+    if kind == "all_gather":
+        return float((g - 1) * payload_bytes)
+    # reduce_scatter / all_to_all: one ring phase over the full payload
+    return (g - 1) / g * payload_bytes
+
+
+class CollectiveGraph:
+    """The program's collectives plus def-use reachability edges
+    between them — node ``i`` feeds node ``j`` iff some dataflow path
+    connects them without passing through a third collective."""
+
+    def __init__(self, ops, graph, num_partitions=1):
+        self.ops = list(ops)
+        self.value_graph = graph
+        self.num_partitions = num_partitions
+        by_result = {_qual(op.func, op.result): i
+                     for i, op in enumerate(self.ops)}
+        self.edges = []
+        for i, op in enumerate(self.ops):
+            seen = set()
+            stack = [_qual(op.func, op.result)]
+            while stack:
+                v = stack.pop()
+                for c in graph.consumers.get(v, ()):
+                    if c in seen:
+                        continue
+                    seen.add(c)
+                    j = by_result.get(c)
+                    if j is not None:
+                        self.edges.append((i, j))
+                    else:
+                        stack.append(c)
+
+    @property
+    def total_wire_bytes(self):
+        return int(round(sum(op.wire_bytes for op in self.ops)))
+
+    def device_set(self):
+        devices = set()
+        for op in self.ops:
+            for grp in op.replica_groups or ():
+                devices.update(grp)
+            for pair in op.source_target_pairs or ():
+                devices.update(pair)
+        if self.num_partitions > 1:
+            devices.update(range(self.num_partitions))
+        return devices
+
+    def to_rows(self):
+        return [op.to_row() for op in self.ops]
+
+
+def collective_graph(text):
+    """Parse ``text`` (lowered StableHLO or post-opt HLO) into a
+    :class:`CollectiveGraph` with per-op semantic payloads and ring
+    wire bytes filled in. Unknown constructs degrade to "not matched"
+    — same contract as the rest of the text parsers."""
+    graph = build_value_graph(text)
+    is_stablehlo = "stablehlo" in text or "func.func" in text
+    ops = (_stablehlo_collectives(text, graph) if is_stablehlo
+           else _hlo_collectives(text, graph))
+    for op in ops:
+        if op.replica_groups:
+            op.group_size = max((len(g) for g in op.replica_groups),
+                                default=1)
+        elif op.kind == "collective_permute":
+            op.group_size = len({d for p in (op.source_target_pairs
+                                             or ()) for d in p}) or 1
+        payload, dtype, emulated = _semantic_payload(op, graph)
+        op.payload_bytes = int(payload)
+        op.wire_dtype = dtype
+        op.emulated = emulated
+        op.wire_bytes = int(round(wire_bytes_for(
+            op.kind, payload, op.group_size,
+            n_pairs=len([p for p in (op.source_target_pairs or ())
+                         if p and p[0] != p[-1]]))))
+    return CollectiveGraph(ops, graph,
+                           num_partitions=hlo.num_partitions(text))
+
+
+def static_comm_bytes(text):
+    """Static per-program wire bytes (each device transmits) for one
+    execution of the lowered program — the number ``bench.py`` stamps
+    as ``static_comm_bytes_per_step`` next to the trace-measured
+    ``measured_comm_bytes_per_step``."""
+    return collective_graph(text).total_wire_bytes
+
+
+# ---------------------------------------------------------------------------
+# jaxpr side: what the source program authored
+# ---------------------------------------------------------------------------
+
+def jaxpr_collective_counts(jaxpr):
+    """``{hlo_kind: count}`` of the collectives the source jaxpr
+    authored (recursing into sub-jaxprs) — the baseline the
+    implicit-reshard rule compares the HLO text against."""
+    from apex_tpu.analysis.rules import _iter_subjaxprs
+
+    counts = {}
+
+    def walk(j):
+        for eqn in j.eqns:
+            kind = JAXPR_TO_HLO_KIND.get(eqn.primitive.name)
+            if kind is not None:
+                counts[kind] = counts.get(kind, 0) + 1
+            for sub in _iter_subjaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return counts
+
+
+def jaxpr_collective_axes(jaxpr):
+    """Ordered ``[(hlo_kind, axes)]`` for best-effort axis labeling of
+    parsed text collectives (matched by order within kind)."""
+    from apex_tpu.analysis.rules import _collective_axes, _iter_subjaxprs
+
+    out = []
+
+    def walk(j):
+        for eqn in j.eqns:
+            kind = JAXPR_TO_HLO_KIND.get(eqn.primitive.name)
+            if kind is not None:
+                out.append((kind, _collective_axes(eqn)))
+            for sub in _iter_subjaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return out
+
+
+def annotate_axes(graph, closed_jaxpr):
+    """Attach jaxpr axis names to the graph's ops by order within each
+    kind (1:1 when the text and jaxpr agree — which is exactly what
+    the implicit-reshard rule verifies)."""
+    if closed_jaxpr is None:
+        return graph
+    per_kind = {}
+    for kind, axes in jaxpr_collective_axes(closed_jaxpr.jaxpr):
+        per_kind.setdefault(kind, []).append(axes)
+    cursor = {k: 0 for k in per_kind}
+    for op in graph.ops:
+        lst = per_kind.get(op.kind)
+        if lst and cursor[op.kind] < len(lst):
+            op.axis_names = tuple(str(a) for a in lst[cursor[op.kind]])
+            cursor[op.kind] += 1
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# the explicitly-compiling audit (GSPMD's insertions are only visible
+# post-partitioning)
+# ---------------------------------------------------------------------------
+
+def audit_spmd(fn, *args, rules=("implicit-reshard",
+                                 "replica-group-consistency",
+                                 "comm-budget"),
+               config=None, name=None, **kwargs):
+    """Compile ``fn`` and lint its POST-SPMD-partitioning HLO against
+    the source jaxpr: the one place a GSPMD-inserted resharding
+    collective-permute / all-to-all is visible as an op. This is the
+    deliberate exception to the package's trace-only contract — it
+    calls ``.compile()`` (use tiny shapes; the partitioner's insertions
+    are shape-independent) — so it lives here behind an explicit name
+    rather than inside ``lint_fn``. Returns a
+    :class:`~apex_tpu.analysis.lint.LintReport`."""
+    import jax
+
+    from apex_tpu.analysis.lint import LintContext, run_rules
+
+    jitted = fn if hasattr(fn, "trace") else jax.jit(fn)
+    traced = jitted.trace(*args, **kwargs)
+    compiled = traced.lower().compile()
+    ctx = LintContext(
+        hlo_text=compiled.as_text(),
+        name=name or getattr(fn, "__name__", "") or "<fn>",
+        closed_jaxpr=traced.jaxpr)
+    return run_rules(ctx, rules=list(rules), config=config)
+
+
+def comm_table(ctx):
+    """Per-program collective table rows (dicts) for a prepared
+    :class:`~apex_tpu.analysis.lint.LintContext` — what
+    ``tools/hlo_lint.py --comm`` renders. Cached on the context so the
+    rules and the table share one parse."""
+    graph = graph_for_context(ctx)
+    annotate_axes(graph, ctx.closed_jaxpr)
+    return graph.to_rows()
+
+
+def graph_for_context(ctx):
+    """The context's :class:`CollectiveGraph`, parsed once and cached —
+    all four sharding rules and :func:`comm_table` share it."""
+    graph = getattr(ctx, "_collective_graph", None)
+    if graph is None:
+        graph = collective_graph(ctx.hlo_text)
+        ctx._collective_graph = graph
+    return graph

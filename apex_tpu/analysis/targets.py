@@ -212,6 +212,80 @@ def guarded_step():
                         jnp.zeros((), jnp.int32), x, y), {}
 
 
+def tp_dp_step():
+    """The 2-D mesh prototype (ROADMAP item 4): a 2x4 ``(data, model)``
+    mesh running one column/row-parallel block — W1 split by output
+    column across ``model``, W2 split by input row, one TP psum over
+    ``model`` joining the partials (the SNIPPETS GSPMD pattern, spelled
+    manually through shard_map) — with int8 DP gradient compression
+    scoped to the ``data`` axis only. Params and the EF residual are
+    carry state, donated; the batch enters sharded over ``data``. The
+    point of the target: every rule — including the four SPMD
+    communication rules — must hold on a mesh where two collective
+    families with DIFFERENT replica-group partitions of the same 8
+    devices coexist in one program."""
+    from apex_tpu.parallel import DistributedDataParallel
+
+    devices = jax.devices()
+    if len(devices) % 2 != 0:
+        raise RuntimeError(
+            f"tp_dp target needs an even device count, got "
+            f"{len(devices)} (run under the virtual 8-device mesh)")
+    tp = len(devices) // 2
+    mesh = Mesh(np.asarray(devices).reshape(2, tp), ("data", "model"))
+    hidden, ffn, batch = 32, 64, 4
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(hidden, ffn).astype(np.float32)
+                          / np.sqrt(hidden)),
+        "b1": jnp.zeros((ffn,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(ffn, hidden).astype(np.float32)
+                          / np.sqrt(ffn)),
+        "b2": jnp.zeros((hidden,), jnp.float32),
+    }
+    n = batch * 2  # batch rows per data-parallel replica row
+    x = jnp.asarray(rng.randn(n, hidden).astype(np.float32))
+    y = jnp.asarray(rng.randn(n, hidden).astype(np.float32))
+    # int8 gradient compression scoped to the DATA axis — the TP psum
+    # over "model" stays exact (activations, not gradients)
+    ddp = DistributedDataParallel(axis_name="data", compress="int8")
+
+    def local_shapes(p):
+        # per-device shards under the param specs below
+        return {"w1": p["w1"][:, :ffn // tp],
+                "b1": p["b1"][:ffn // tp],
+                "w2": p["w2"][:ffn // tp, :],
+                "b2": p["b2"]}
+
+    residual = ddp.init_residual(local_shapes(params))
+
+    def loss_fn(p, xb, yb):
+        # column-parallel: each model rank holds ffn/tp output columns
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        # row-parallel: partial products joined by ONE TP psum
+        partial = h @ p["w2"]
+        out = jax.lax.psum(partial, "model") + p["b2"]
+        return jnp.mean((out - yb) ** 2)
+
+    def step_fn(p, res, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        # DP sync across the data axis only; model-axis shards keep
+        # their own gradient slices
+        grads, res = ddp.sync(grads, res)
+        p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, p, grads)
+        return p, res, loss
+
+    pspec = {"w1": P(None, "model"), "b1": P("model"),
+             "w2": P("model", None), "b2": P()}
+    rspec = jax.tree_util.tree_map(lambda _: P(), residual)
+    sharded = jax.shard_map(
+        step_fn, mesh=mesh,
+        in_specs=(pspec, rspec, P("data"), P("data")),
+        out_specs=(pspec, rspec, P()), check_vma=False)
+    train_step = jax.jit(sharded, donate_argnums=(0, 1))
+    return train_step, (params, residual, x, y), {}
+
+
 @functools.lru_cache(maxsize=2)
 def _tiny_engine(cache_mode="bf16"):
     from apex_tpu.models import GPTModel, TransformerConfig
@@ -263,5 +337,6 @@ TARGETS = {
     "ddp_overlapped": ddp_overlapped_step,
     "zero": zero_step,
     "guarded": guarded_step,
+    "tp_dp": tp_dp_step,
     "serve_decode": serve_decode_step,
 }

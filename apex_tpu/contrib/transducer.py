@@ -61,8 +61,6 @@ def transducer_loss(log_probs, labels, f_len, y_len, blank_idx=0):
     lbl_lp = jnp.pad(lbl_lp, ((0, 0), (0, 0), (0, 1)),
                      constant_values=-jnp.inf)  # [B, T, U+1]
 
-    NEG = -1e30
-
     def scan_t(alpha_prev, t):
         # emit from the previous time step: alpha_prev[u] + blank[t-1, u]
         from_blank = alpha_prev + blank_lp[:, t - 1, :]

@@ -274,7 +274,6 @@ class KVCacheSpec:
                 continue
             sd = self._by_path[names]
             x = new_by_path[names]                       # [B, *mid, T,1,g,d]
-            n = self._kv_feature_width(sd)
             flat = x.reshape(x.shape[:-3] + (-1,))       # [B, *mid, T, F]
             idx = positions.reshape((b,) + (1,) * (flat.ndim - 1))
             sel = jnp.take_along_axis(flat, idx, axis=-2)  # [B, *mid, 1, F]

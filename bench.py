@@ -79,7 +79,12 @@ APEX_TPU_PEAK_TFLOPS.
 
 Prints ONE JSON line:
 {"metric", "value", "unit", "vs_baseline", "tflops_per_sec", "mfu",
-"measured_comm_bytes_per_step", "model_flops_per_step_xla"}.
+"measured_comm_bytes_per_step", "static_comm_bytes_per_step",
+"model_flops_per_step_xla"} — static is the collective-dataflow-graph
+wire-byte total parsed from the lowered step
+(apex_tpu.analysis.sharding); when the step's collectives are
+instrumented the bench FAILS on >25% static-vs-measured disagreement
+(APEX_TPU_COMM_GATE=0 disables).
 
 Telemetry (apex_tpu.telemetry, docs/observability.md): the bench opts
 the registry in so every line carries the measured per-step collective
@@ -269,6 +274,40 @@ def _measure_step_cost(jitted, args):
     if lowered is not None and \
             os.environ.get("APEX_TPU_BENCH_MEMWATCH", "1") != "0":
         mem = telemetry.memory.report_from_lowered(lowered)
+    static_comm = None
+    if lowered is not None and \
+            os.environ.get("APEX_TPU_STATIC_COMM", "1") != "0":
+        # the round-18 capture contract: parse the SAME lowering's
+        # StableHLO into the collective dataflow graph
+        # (apex_tpu.analysis.sharding) and stamp the static ring-model
+        # wire bytes next to the trace-measured counter delta — the
+        # static-vs-dynamic cross-validation no single layer provides.
+        # Parser crash -> null (an analyzer bug must not kill a bench);
+        # a real DISAGREEMENT fails loudly below.
+        try:
+            from apex_tpu.analysis import sharding as _sharding
+
+            static_comm = _sharding.static_comm_bytes(lowered.as_text())
+        except Exception:
+            static_comm = None
+    if static_comm is not None and measured > 0 and \
+            os.environ.get("APEX_TPU_COMM_GATE", "1") != "0":
+        # static and measured model the same semantic wire format
+        # (int8 emulation counted at 1 byte/elem on both sides), so
+        # divergence beyond the band means one of them is lying —
+        # fail the bench rather than emit a number nobody can trust.
+        # Gate only when collectives were instrumented (measured > 0):
+        # un-instrumented TP/MoE psums legitimately show static-only
+        # bytes, and that asymmetry is the lint's job, not this gate's.
+        tol = float(os.environ.get("APEX_TPU_COMM_GATE_TOL", "0.25"))
+        rel = abs(static_comm - measured) / measured
+        if rel > tol:
+            raise RuntimeError(
+                f"static/measured comm-bytes disagreement: static "
+                f"{static_comm} vs measured {int(round(measured))} "
+                f"({rel * 100.0:.1f}% > {tol * 100.0:.0f}% band) — "
+                f"the collective structure of the lowered step is not "
+                f"what the instrumentation thinks it is")
     lint_count = None
     if lowered is not None and \
             os.environ.get("APEX_TPU_HLO_LINT", "") not in ("", "0"):
@@ -295,6 +334,7 @@ def _measure_step_cost(jitted, args):
         "hbm_headroom_pct": round(mem["headroom_frac"] * 100.0, 2)
         if mem and mem.get("headroom_frac") is not None else None,
         "lint_violations": lint_count,
+        "static_comm_bytes_per_step": static_comm,
     })
     return cost, measured
 
@@ -328,6 +368,8 @@ def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
     headroom_pct = _PENDING_MEASURED.pop("hbm_headroom_pct", None)
     compile_count = _PENDING_MEASURED.pop("compile_count", None)
     lint_violations = _PENDING_MEASURED.pop("lint_violations", None)
+    static_comm = _PENDING_MEASURED.pop("static_comm_bytes_per_step",
+                                        None)
     _PENDING_MEASURED.clear()
     reg = telemetry.get_registry()
     if reg.enabled:
@@ -368,6 +410,11 @@ def _emit(metric, value, unit, flops_per_step, steps, dt, **extra):
         # static HLO lint (round-14 capture contract; apex_tpu.analysis):
         # null unless the bench ran with APEX_TPU_HLO_LINT=1
         "lint_violations": lint_violations,
+        # static collective-graph wire bytes for the lowered step
+        # (round-18 capture contract; apex_tpu.analysis.sharding) —
+        # cross-validated in-bench against measured_comm_bytes_per_step
+        # within 25%; null when the config measured no step
+        "static_comm_bytes_per_step": static_comm,
         **extra,
     }))
 

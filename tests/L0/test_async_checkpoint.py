@@ -42,7 +42,7 @@ def test_save_returns_before_write_and_steps_overlap(tmp_path):
     x = jnp.ones((8, 64))
 
     t0 = time.perf_counter()
-    path = ck.save(str(tmp_path), 0, state)
+    ck.save(str(tmp_path), 0, state)
     t_save = time.perf_counter() - t0
     # returned without writing (the gate is still closed)
     assert not (tmp_path / "step_0000000000" / "state.pkl").exists()
@@ -58,7 +58,6 @@ def test_save_returns_before_write_and_steps_overlap(tmp_path):
     restored = restore(str(tmp_path))
     assert int(restored["step"]) == 0  # snapshot at save time, not 5
     ck.close()
-    del path
 
 
 def test_snapshot_isolated_from_donation(tmp_path):

@@ -318,6 +318,56 @@ def test_serve_spec_fields_gated_at_round17():
     assert schema.check_metric_line(other, round_n=17, errors=[]) == []
 
 
+def test_static_comm_gated_at_round18():
+    """ISSUE 13 satellite: static_comm_bytes_per_step (the collective
+    dataflow graph's ring-model wire bytes parsed from the lowered
+    step — apex_tpu.analysis.sharding) is required, nullable, on every
+    successful metric line from round 18; a pre-round-18 record
+    carrying a measured value is flagged (the field did not exist
+    yet), while the always-written null key on live lines is
+    tolerated, same as lint_violations."""
+    base = {"metric": "gpt2_345m_tokens_per_sec_per_chip", "value": 1.0,
+            "unit": "tokens/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 1.0, "mfu": 0.1,
+            "comm_bytes_per_step": 10,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": None, "lint_violations": None,
+            "backend": "cpu-mesh"}
+    # round 17: absent is valid and the always-written key is
+    # tolerated on LIVE lines (lint_violations discipline)
+    assert schema.check_metric_line(dict(base), round_n=17,
+                                    errors=[]) == []
+    assert schema.check_metric_line(
+        dict(base, static_comm_bytes_per_step=None), round_n=17,
+        errors=[]) == []
+    # ... but a CHECKED-IN pre-18 record carrying a measured value is
+    # flagged — the field did not exist at capture time
+    wrapper = {"n": 17, "cmd": "python bench.py", "rc": 0, "tail": "",
+               "parsed": dict(base, static_comm_bytes_per_step=1820)}
+    msgs = schema.check_wrapper(wrapper, errors=[])
+    assert any("only defined from round 18" in m for m in msgs)
+    assert schema.check_wrapper(
+        {"n": 18, "cmd": "c", "rc": 0, "tail": "",
+         "parsed": dict(base, static_comm_bytes_per_step=1820)},
+        errors=[]) == []
+    # from 18 the key is required
+    msgs = schema.check_metric_line(dict(base), round_n=18, errors=[])
+    assert any("static_comm_bytes_per_step" in m for m in msgs)
+    # nullable (no step measured) and measured values both ok
+    for val in (None, 0, 1820, 58695.0):
+        assert schema.check_metric_line(
+            dict(base, static_comm_bytes_per_step=val), round_n=18,
+            errors=[]) == []
+    # typed: negative or non-numeric rejected
+    for bad in (-1, "many", True):
+        msgs = schema.check_metric_line(
+            dict(base, static_comm_bytes_per_step=bad), round_n=18,
+            errors=[])
+        assert any("non-negative number" in m for m in msgs)
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint
@@ -332,11 +382,13 @@ def test_live_emit_passes_current_schema(capsys):
     assert schema.check_metric_line(line, round_n=10, errors=[]) == []
     assert schema.check_metric_line(line, round_n=14, errors=[]) == []
     assert schema.check_metric_line(line, round_n=15, errors=[]) == []
+    assert schema.check_metric_line(line, round_n=18, errors=[]) == []
     assert line["backend"] == "cpu-mesh"  # the tests' virtual mesh
     assert line["measured_comm_bytes_per_step"] is None  # none staged
     assert line["peak_hbm_bytes"] is None                # none staged
     assert line["compile_count"] is None                 # none staged
     assert line["lint_violations"] is None               # none staged
+    assert line["static_comm_bytes_per_step"] is None    # none staged
     assert "comm_bytes_per_step" in line
 
 

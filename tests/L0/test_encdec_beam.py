@@ -51,8 +51,13 @@ def _t5_pair(seed=0):
 
 
 class TestT5Beam:
-    @pytest.mark.parametrize("beams,new,lp", [(3, 8, 1.0), (4, 10, 2.0),
-                                              (2, 6, 0.5)])
+    # round 18: one beam shape stays in tier-1; the HF-match
+    # mechanism is identical per (beams, new, lp)
+    @pytest.mark.parametrize("beams,new,lp", [
+        pytest.param(3, 8, 1.0, marks=pytest.mark.slow),
+        pytest.param(4, 10, 2.0, marks=pytest.mark.slow),
+        (2, 6, 0.5),
+    ])
     def test_matches_hf_beam(self, beams, new, lp):
         from apex_tpu.models import t5_beam_generate
 

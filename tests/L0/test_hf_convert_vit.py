@@ -96,6 +96,9 @@ def test_vit_refuses_causal_config():
             jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
 
 
+@pytest.mark.slow  # tier-1 budget (round 18): tp2-vs-tp1 parity is
+# covered by the generation TP tests; the ViT variant rides the
+# full suite
 def test_vit_tp2_logits_match_tp1():
     """The whole vision family under tensor parallelism: split with the
     standard GPT rules (embed/classifier replicate), logits identical."""

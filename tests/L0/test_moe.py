@@ -133,6 +133,9 @@ class TestExpertChoiceRouting:
         assert float(jnp.abs(g["router"]["gate_weight"]).sum()) > 0
         assert float(jnp.abs(g["experts"]["w1"]).sum()) > 0
 
+    @pytest.mark.slow  # tier-1 budget (round 18): EP-vs-local parity
+    # is covered by test_ep4_matches_local and the expert-choice
+    # routing by test_switch_mlp_expert_choice_grads
     def test_expert_choice_ep_matches_local(self):
         E, ep = 4, 4
         rng = np.random.RandomState(7)

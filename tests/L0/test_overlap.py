@@ -605,8 +605,18 @@ class TestBenchContract:
         ret = bench.bench_ddp_overlapped(2, 1, hidden=128, depth=2)
         line = json.loads(
             capsys.readouterr().out.strip().splitlines()[-1])
-        assert schema.check_metric_line(line, round_n=15,
+        # round 18: the line now carries a MEASURED
+        # static_comm_bytes_per_step (defined from round 18, so the
+        # live line is checked against the current contract), agreeing
+        # with the trace-measured bytes — the in-bench 25% gate would
+        # have crashed the bench otherwise
+        assert schema.check_metric_line(line, round_n=18,
                                         errors=[]) == []
+        assert line["static_comm_bytes_per_step"] is not None
+        assert line["measured_comm_bytes_per_step"] > 0
+        assert abs(line["static_comm_bytes_per_step"]
+                   - line["measured_comm_bytes_per_step"]) \
+            <= 0.25 * line["measured_comm_bytes_per_step"]
         assert line["backend"] == "cpu-mesh"
         assert line["compile_count"] == 1
         assert line["overlap_segments"] == 2

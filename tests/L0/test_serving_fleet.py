@@ -422,7 +422,7 @@ class TestAutoscale:
                         scale_up_pending=3, scale_down_pending=0,
                         scale_sustain_ticks=2),
             num_slots=2)
-        done = fleet.run([_req(i, max_new=6) for i in range(16)])
+        fleet.run([_req(i, max_new=6) for i in range(16)])
         s = fleet.stats()
         assert s["scale_ups"] >= 1
         assert s["requests_ok"] == 16

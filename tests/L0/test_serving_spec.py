@@ -313,7 +313,7 @@ def test_census_labels_cover_draft(spec_engine, plain_engine):
     from apex_tpu.telemetry import CompileWatcher
 
     watcher = CompileWatcher(enabled=True)
-    eng = ServeEngine(target, tparams, _serve_cfg(
+    ServeEngine(target, tparams, _serve_cfg(
         batch_buckets=(2,), prefill_buckets=(8,),
         draft_model=draft, draft_params=dparams, num_draft_tokens=2),
         watcher=watcher, name="replica9.g1")
@@ -423,8 +423,6 @@ def test_shared_prefix_trace_determinism():
     shared_b = synthetic_trace(16, seed=11, shared_prefix_len=6)
     for ra, rb in zip(shared_a, shared_b):
         np.testing.assert_array_equal(ra.prompt, rb.prompt)
-    blocks = {tuple(r.prompt[:6].tolist()) for r in shared_a
-              if len(r.prompt) > 6}
     counts = {}
     for r in shared_a:
         counts[tuple(r.prompt[:6].tolist())] = \

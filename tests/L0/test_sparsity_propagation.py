@@ -144,11 +144,8 @@ def test_mask_retention_improves_end_to_end():
         ASP.init_model_for_pruning(vars_["params"])
         masks = ASP.compute_sparse_masks(vars_["params"])
         pruned = ASP.apply_masks(vars_["params"], masks)
-        w = vars_["params"]["transformer"]["layer_0"]["mlp"][
-            "dense_h_to_4h"]["weight"]
         pw = pruned["transformer"]["layer_0"]["mlp"][
             "dense_h_to_4h"]["weight"]
-        del w
         return float(jnp.sum(jnp.abs(pw)))
 
     base = kept(variables)

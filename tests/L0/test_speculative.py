@@ -98,6 +98,8 @@ def test_speculative_adversarial_draft_still_exact():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.slow  # tier-1 budget (round 18): eos truncation is
+# engine-covered by the test_serving_spec acceptance
 def test_speculative_eos_padding_matches_generate():
     """Positions after the first eos pad exactly as generate() pads
     them (the buffer may transiently hold recomputed tokens past eos —

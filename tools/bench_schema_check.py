@@ -52,6 +52,12 @@ number ``n`` (old checked-in records stay valid):
   ``acceptance_rate``, ``prefix_hit_rate`` and
   ``ttft_p50_prefix_hit_ms`` (null when the trace never hit) — next
   to their accepted tokens/sec value.
+- ``n >= 18``: successful metric lines must carry
+  ``static_comm_bytes_per_step`` (the collective-dataflow-graph wire
+  bytes parsed out of the lowered step — apex_tpu.analysis.sharding;
+  null means the config measured no step or ran with
+  ``APEX_TPU_STATIC_COMM=0``); pre-round-18 records carrying it are
+  flagged — the field did not exist yet.
 
 Usage::
 
@@ -158,6 +164,14 @@ SERVE_SPEC_METRIC_PREFIX = "serve_spec"
 SERVE_SPEC_REQUIRED_FIELDS = ("accepted_tokens_per_sec",
                               "acceptance_rate", "prefix_hit_rate",
                               "ttft_p50_prefix_hit_ms")
+# the SPMD communication-audit contract (apex_tpu.analysis.sharding,
+# round 18): static_comm_bytes_per_step (ring-model wire bytes of the
+# collective dataflow graph parsed from the lowered step; null = the
+# config measured no step) is REQUIRED (nullable) on successful metric
+# lines from round 18, cross-validated in-bench against
+# measured_comm_bytes_per_step within 25%; a pre-round-18 record
+# carrying it is flagged — the field did not exist yet
+STATIC_COMM_FIELDS_SINCE_ROUND = 18
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -363,6 +377,23 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                           and obj["lint_violations"] >= 0)):
                 bad("lint_violations must be a non-negative integer "
                     "or null")
+        # bench._emit always writes the key (null when unmeasured), so
+        # LIVE lines checked against older rounds tolerate it — same
+        # discipline as lint_violations/backend; the presence flag for
+        # pre-18 CHECKED-IN records lives in check_wrapper, where the
+        # capture round is authoritative
+        if round_n is None or \
+                round_n >= STATIC_COMM_FIELDS_SINCE_ROUND:
+            if "static_comm_bytes_per_step" not in obj:
+                bad(f"missing static comm field "
+                    f"'static_comm_bytes_per_step' (required since "
+                    f"round {STATIC_COMM_FIELDS_SINCE_ROUND})")
+            elif not (obj["static_comm_bytes_per_step"] is None
+                      or (_type_ok(obj["static_comm_bytes_per_step"],
+                                   _NUM)
+                          and obj["static_comm_bytes_per_step"] >= 0)):
+                bad("static_comm_bytes_per_step must be a non-negative "
+                    "number or null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):
@@ -395,7 +426,19 @@ def check_wrapper(obj, *, errors=None, where=""):
         if not isinstance(parsed, dict):
             bad("'parsed' must be a dict when present")
         else:
-            check_metric_line(parsed, round_n=obj.get("n"), errors=own,
+            n = obj.get("n")
+            # a record CAPTURED before round 18 cannot carry a measured
+            # static_comm_bytes_per_step — the field did not exist yet
+            # (live lines are exempt: bench._emit always writes the
+            # key, null when unmeasured)
+            if isinstance(n, int) \
+                    and n < STATIC_COMM_FIELDS_SINCE_ROUND \
+                    and parsed.get("static_comm_bytes_per_step") \
+                    is not None:
+                bad(f"parsed: static_comm_bytes_per_step is only "
+                    f"defined from round "
+                    f"{STATIC_COMM_FIELDS_SINCE_ROUND}")
+            check_metric_line(parsed, round_n=n, errors=own,
                               where=where + "parsed: ")
     elif obj.get("rc") == 0:
         bad("rc == 0 but no parsed metric line")

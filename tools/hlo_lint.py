@@ -38,10 +38,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def run_lint(configs=None, rules=None):
+def run_lint(configs=None, rules=None, comm=False):
     """Build + lint the selected targets. Returns
-    ``{config: LintReport}`` (insertion-ordered)."""
-    from apex_tpu.analysis import lint_fn
+    ``{config: LintReport}`` (insertion-ordered); with ``comm=True``
+    returns ``({config: LintReport}, {config: [row, ...]})`` where the
+    rows are the collective table (one trace per target serves both)."""
+    from apex_tpu.analysis import build_context, run_rules
+    from apex_tpu.analysis import sharding as _sharding
     from apex_tpu.analysis.targets import TARGETS
 
     names = list(configs) if configs else list(TARGETS)
@@ -49,12 +52,38 @@ def run_lint(configs=None, rules=None):
     if unknown:
         raise SystemExit(f"unknown config(s) {unknown}; "
                          f"known: {list(TARGETS)}")
-    reports = {}
+    reports, tables = {}, {}
     for name in names:
         fn, args, kwargs = TARGETS[name]()
-        reports[name] = lint_fn(fn, *args, rules=rules, name=name,
-                                **kwargs)
-    return reports
+        ctx = build_context(fn, *args, name=name, **kwargs)
+        reports[name] = run_rules(ctx, rules=rules)
+        if comm:
+            tables[name] = _sharding.comm_table(ctx)
+    return (reports, tables) if comm else reports
+
+
+def render_comm_table(tables):
+    """The per-target collective table: op, wire dtype, shape, replica
+    groups, static ring-model bytes, best-effort mesh axes."""
+    lines = []
+    for name, rows in tables.items():
+        total = sum(r["wire_bytes"] for r in rows)
+        lines.append(f"{name}: {len(rows)} collective(s), "
+                     f"{total} static wire byte(s)/step")
+        for r in rows:
+            groups = r["replica_groups"]
+            gtxt = "-" if groups is None else \
+                "|".join(",".join(str(d) for d in g) for g in groups)
+            if len(gtxt) > 28:
+                gtxt = gtxt[:25] + "..."
+            shape = "x".join(str(d) for d in (r["shape"] or ())) or "-"
+            axes = ",".join(r["axes"]) if r["axes"] else "-"
+            emu = " (emulated int8)" if r["emulated"] else ""
+            lines.append(
+                f"  {r['op']:<19} {str(r['dtype']) + emu:<22} "
+                f"{shape:<12} groups[{gtxt}] g={r['group_size']} "
+                f"axes={axes:<10} {r['wire_bytes']} B")
+    return "\n".join(lines)
 
 
 def render_table(reports):
@@ -89,6 +118,10 @@ def main(argv=None):
                     help="run only this rule (repeatable)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON object instead of the table")
+    ap.add_argument("--comm", action="store_true",
+                    help="also print the per-target collective table "
+                         "(op, dtype, shape, replica groups, static "
+                         "ring-model bytes, mesh axes)")
     args = ap.parse_args(argv)
 
     import jax
@@ -96,15 +129,24 @@ def main(argv=None):
     if all(d.platform == "cpu" for d in jax.devices()):
         jax.config.update("jax_platforms", "cpu")
 
-    reports = run_lint(args.config, args.rule)
+    if args.comm:
+        reports, tables = run_lint(args.config, args.rule, comm=True)
+    else:
+        reports, tables = run_lint(args.config, args.rule), None
     total = sum(len(r.findings) for r in reports.values())
     if args.json:
-        print(json.dumps({
+        out = {
             "violations": total,
             "configs": {n: r.to_dict() for n, r in reports.items()},
-        }, indent=2))
+        }
+        if tables is not None:
+            out["comm"] = tables
+        print(json.dumps(out, indent=2))
     else:
         print(render_table(reports))
+        if tables is not None:
+            print()
+            print(render_comm_table(tables))
         for name, rep in reports.items():
             for f in rep.findings:
                 print(f"VIOLATION [{name}] {f}")

@@ -773,6 +773,123 @@ def _lint_smoke(bench):
             "lint_events": len(lint_events)}
 
 
+def _sharding_smoke(bench):
+    """SPMD communication-audit smoke (round 18): (a) a seeded
+    implicit-reshard program — HLO text carrying a collective_permute
+    the source jaxpr never authored — trips the ``implicit-reshard``
+    rule with a structured finding (named op + wire bytes) landing in
+    the lint JSONL; on a multi-device host the same is proven on a
+    REAL GSPMD program through ``analysis.sharding.audit_spmd`` (the
+    partitioner's inserted collective is visible post-compile); (b) a
+    clean ``ddp_compressed`` run emits ``static_comm_bytes_per_step``
+    agreeing with ``measured_comm_bytes_per_step`` within the 25%
+    in-bench gate (the gate itself would have crashed the bench on
+    disagreement — this stage asserts the field actually landed).
+    Raises on any missing piece so the stage shows up as ERROR rather
+    than silently passing."""
+    import glob
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import analysis, telemetry
+    from apex_tpu.analysis import sharding as _sharding
+    from apex_tpu.analysis.lint import LintContext, run_rules
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_sharding_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    buf = io.StringIO()
+    try:
+        # (a) the seeded fault: a collective_permute in the HLO with no
+        # ppermute in the jaxpr = the partitioner resharded silently
+        traced = jax.jit(lambda x: x * 2).trace(jnp.ones((8,)))
+        seeded_text = (
+            'module @m attributes {mhlo.num_partitions = 2 : i32} {\n'
+            '  func.func public @main(%arg0: tensor<128xf32>) -> '
+            '(tensor<128xf32>) {\n'
+            '    %0 = "stablehlo.collective_permute"(%arg0) '
+            '<{channel_handle = #stablehlo.channel_handle<handle = 1, '
+            'type = 1>, source_target_pairs = dense<[[0, 1], [1, 0]]> '
+            ': tensor<2x2xi64>}> : (tensor<128xf32>) -> '
+            'tensor<128xf32>\n'
+            '    return %0 : tensor<128xf32>\n  }\n}\n')
+        seeded = analysis.report_to_registry(run_rules(
+            LintContext(hlo_text=seeded_text, name="seeded_reshard",
+                        closed_jaxpr=traced.jaxpr),
+            rules="implicit-reshard"))
+        audit = None
+        if len(jax.devices()) > 1:
+            # the real thing: mismatched in/out shardings force GSPMD
+            # to insert a resharding collective post-partitioning
+            import functools
+
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            mesh = Mesh(np.asarray(jax.devices()), ("x",))
+            resharded = functools.partial(
+                jax.jit,
+                in_shardings=NamedSharding(mesh, P("x", None)),
+                out_shardings=NamedSharding(mesh, P(None, "x")))(
+                    lambda v: v * 2)
+            audit = analysis.report_to_registry(_sharding.audit_spmd(
+                resharded,
+                jnp.ones((len(jax.devices()), len(jax.devices()))),
+                name="gspmd_reshard"))
+        # (b) the clean config: static == measured (in-bench gate) and
+        # the field lands in the emitted JSON
+        with contextlib.redirect_stdout(buf):
+            bench.bench_ddp_compressed(8, 2)
+    finally:
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+    if not seeded.findings \
+            or seeded.findings[0].rule != "implicit-reshard":
+        raise RuntimeError("sharding smoke: the seeded "
+                           "collective_permute never tripped "
+                           "implicit-reshard")
+    if "collective_permute" not in seeded.findings[0].where:
+        raise RuntimeError(
+            "sharding smoke: the seeded finding names no offending op "
+            f"({seeded.findings[0].where!r})")
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    parsed = json.loads(lines[-1])
+    if "static_comm_bytes_per_step" not in parsed:
+        raise RuntimeError("sharding smoke: ddp_compressed emitted no "
+                           "static_comm_bytes_per_step")
+    static = parsed["static_comm_bytes_per_step"]
+    measured = parsed.get("measured_comm_bytes_per_step")
+    if static is not None and measured and measured > 0:
+        rel = abs(static - measured) / measured
+        if rel > 0.25:
+            raise RuntimeError(
+                f"sharding smoke: static {static} vs measured "
+                f"{measured} disagree by {rel * 100.0:.1f}% > 25%")
+    events = []
+    for path in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(path) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    lint_events = [e for e in events if e["kind"] == "lint"]
+    if not any(e.get("rule") == "implicit-reshard"
+               for e in lint_events):
+        raise RuntimeError("sharding smoke: the implicit-reshard "
+                           "finding never landed as a lint event")
+    return {"telemetry_dir": tel_dir,
+            "seeded_rule": seeded.findings[0].rule,
+            "seeded_where": seeded.findings[0].where,
+            "audit_findings": (len(audit.findings)
+                               if audit is not None else None),
+            "static_comm_bytes_per_step": static,
+            "measured_comm_bytes_per_step": measured,
+            "lint_events": len(lint_events)}
+
+
 def _overlap_smoke(bench):
     """Overlapped-step smoke (round 15): run ``ddp_overlapped`` at a
     small size and assert (a) the overlapped step's measured time is
@@ -946,6 +1063,7 @@ def _stages(smoke):
             ("fleet", None, lambda: _fleet_smoke(bench)),
             ("recovery", None, lambda: _recovery_smoke(bench)),
             ("lint", None, lambda: _lint_smoke(bench)),
+            ("sharding", None, lambda: _sharding_smoke(bench)),
             ("overlap", None, lambda: _overlap_smoke(bench)),
             ("trend", None, _trend_gate),
             ("boom", None, lambda: (_ for _ in ()).throw(
@@ -1054,6 +1172,12 @@ def _stages(smoke):
         # structured finding) — the hot-path invariants as a checkable
         # pass rather than string greps
         ("lint", None, lambda: _lint_smoke(bench)),
+        # round-18 SPMD communication-audit captures: the sharding
+        # smoke (a seeded implicit-reshard program trips the rule with
+        # the finding named in the lint JSONL; clean ddp_compressed
+        # emits static_comm_bytes_per_step agreeing with the measured
+        # counter within the 25% in-bench gate at flat compile count)
+        ("sharding", None, lambda: _sharding_smoke(bench)),
         # round-15 overlapped-step captures: the ddp_overlapped config
         # at bench size (baseline_step_ms vs overlapped step time at
         # identical comm bytes, comm_hidden_pct, compile_count == 1,
