@@ -140,11 +140,37 @@ HOST_CALLBACK_TARGETS = frozenset({
 })
 _CALLBACK_MARKERS = ("callback", "io_callback")
 
+# custom_call targets that are COMPILED Pallas kernels, not host
+# round-trips: a pallas_call lowers to a custom_call whose payload runs
+# entirely on-device (Mosaic on TPU/CPU, Triton on GPU). With the
+# apex_tpu.kernels layer these now appear in kernel-backed hot paths,
+# and the no-host-callback rule must never flag them — this allowlist
+# wins over both the exact host-target set and the substring markers.
+# Extendable without a code change via APEX_TPU_HLO_LINT_PALLAS_TARGETS
+# (comma-separated target names) for new backends/runtime versions.
+PALLAS_CUSTOM_CALL_TARGETS = frozenset({
+    "tpu_custom_call",            # Pallas TPU (Mosaic)
+    "mosaic_cpu",                 # Pallas CPU
+    "mosaic_gpu",
+    "triton_kernel_call",         # Pallas GPU (Triton)
+    "__gpu$xla.gpu.triton",
+})
+
+
+def _pallas_targets():
+    extra = os.environ.get("APEX_TPU_HLO_LINT_PALLAS_TARGETS", "")
+    allowed = set(PALLAS_CUSTOM_CALL_TARGETS)
+    allowed.update(t.strip() for t in extra.split(",") if t.strip())
+    return allowed
+
 
 def rule_no_host_callback(ctx, cfg):
     findings = []
+    pallas = _pallas_targets()
     for target, count in sorted(
             hlo.custom_call_targets(ctx.hlo_text).items()):
+        if target in pallas:
+            continue  # compiled Pallas kernel — on-device custom_call
         if target in HOST_CALLBACK_TARGETS or any(
                 m in target.lower() for m in _CALLBACK_MARKERS):
             findings.append(Finding(

@@ -1,45 +1,8 @@
-"""Shared gating for the Pallas decode kernels (mla_decode, gqa_decode).
+"""Compat shim: the shared Pallas gating moved to
+:mod:`apex_tpu.kernels.registry` (the kernel registry — one code path
+deciding pallas-vs-oracle-vs-interpret for every kernel, master switch
+``APEX_TPU_KERNELS`` with per-kernel overrides). Import ``PallasGate``
+and ``choose_block`` from there; this module re-exports them for the
+existing decode-kernel call sites."""
 
-One place for the backend/interpret decision and the block-size ladder,
-so a fix to backend detection or the divisibility fallback applies to
-every kernel at once (the two modules previously carried verbatim
-copies differing only in the env-var name)."""
-
-import jax
-
-
-class PallasGate:
-    """Per-kernel enable switch: ``env_var=0`` opts out; interpreter
-    mode (tests) wins over backend detection; otherwise TPU-only."""
-
-    def __init__(self, env_var: str):
-        self.env_var = env_var
-        self.interpret = False
-
-    def force_interpret(self, on: bool):
-        self.interpret = bool(on)
-
-    def enabled(self) -> bool:
-        import os
-
-        if os.environ.get(self.env_var, "1") == "0":
-            return False
-        if self.interpret:
-            return True
-        try:
-            return jax.default_backend() == "tpu"
-        except Exception:
-            return False
-
-
-def choose_block(cache_len: int, preferred: int):
-    """Largest tile size that divides the cache buffer: the preferred
-    size, then the 256/128 rungs (a 1280-long buffer should stream in
-    256-tiles, not silently lose the kernel), then the whole buffer for
-    short caches. None -> no dividing block; caller falls back."""
-    if cache_len <= preferred:
-        return cache_len
-    for b in (preferred, 256, 128):
-        if b <= cache_len and cache_len % b == 0:
-            return b
-    return None
+from apex_tpu.kernels.registry import PallasGate, choose_block  # noqa: F401

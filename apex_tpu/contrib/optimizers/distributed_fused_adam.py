@@ -70,7 +70,7 @@ def _padded_size(n, world, grad_compress, param_compress, block_size):
     equal-length, and int8 modes additionally need every rank's shard
     to cover whole quantization blocks."""
     align = world
-    if "int8" in (grad_compress, param_compress):
+    if any(m in ("int8", "int4") for m in (grad_compress, param_compress)):
         align *= block_size
     return ((n + align - 1) // align) * align
 
@@ -206,7 +206,7 @@ def reshard_zero_state(full, params, *, world, grad_compress=None,
         "exp_avg_sq_shard": jnp.asarray(pad(full["exp_avg_sq"])),
     }
     written_residual = full.get("grad_residual")
-    if grad_compress == "int8":
+    if compression.needs_residual(grad_compress):
         if written_residual is None:
             # written without EF (fp32/bf16 grads): start a fresh,
             # zeroed residual — correct, just loses nothing real
@@ -260,7 +260,7 @@ def zero_state_bytes(params, *, world, grad_compress=None,
     f32 = 4
     unsharded = 3 * padded * f32
     sharded = 3 * (padded // world) * f32
-    residual = padded * f32 if grad_compress == "int8" else 0
+    residual = padded * f32 if compression.needs_residual(grad_compress) else 0
     params_bytes = int(sum(
         int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
         for l in jax.tree_util.tree_leaves(params)))
@@ -436,7 +436,7 @@ class DistributedFusedAdam:
             "exp_avg_shard": jnp.zeros_like(shard),
             "exp_avg_sq_shard": jnp.zeros_like(shard),
         }
-        if self.grad_compress == "int8":
+        if compression.needs_residual(self.grad_compress):
             bstate["grad_residual"] = jnp.zeros((bucket.padded,),
                                                 jnp.float32)
         return bstate
@@ -465,24 +465,24 @@ class DistributedFusedAdam:
             return g_shard / world, residual
 
     def _shard_adam_math(self, g_shard, bstate, *, lr, step):
-        """The fused Adam update on one local fp32 shard — byte-for-byte
-        the math :meth:`step` runs on the monolithic shard."""
+        """The fused Adam update on one local fp32 shard — ONE
+        multi-tensor kernel call per shard/bucket
+        (:func:`apex_tpu.kernels.optim.fused_adam_update`; the jnp
+        oracle is byte-for-byte the math this method used to inline,
+        and :meth:`step` runs the same call on the monolithic shard)."""
+        from apex_tpu.kernels import optim as _koptim
+
         b1, b2 = self.betas
         if self.bias_correction:
             bc1 = 1.0 - b1 ** step
             bc2 = 1.0 - b2 ** step
         else:
             bc1 = bc2 = 1.0
-        p = bstate["master_shard"]
-        if self.adam_w_mode == 0 or not self.adam_w_mode:
-            g_shard = g_shard + self.weight_decay * p
-        m = b1 * bstate["exp_avg_shard"] + (1 - b1) * g_shard
-        v = b2 * bstate["exp_avg_sq_shard"] \
-            + (1 - b2) * jnp.square(g_shard)
-        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.adam_w_mode and self.weight_decay != 0:
-            update = update + self.weight_decay * p
-        return p - lr * update, m, v
+        return _koptim.fused_adam_update(
+            g_shard, bstate["master_shard"], bstate["exp_avg_shard"],
+            bstate["exp_avg_sq_shard"], lr=lr, bc1=bc1, bc2=bc2,
+            b1=b1, b2=b2, eps=self.eps, weight_decay=self.weight_decay,
+            adam_w=not (self.adam_w_mode == 0 or not self.adam_w_mode))
 
     def bucket_update_gather(self, g_shard, bstate, bucket, p_leaves, *,
                              lr=None, step, noop, clip=None,
@@ -506,7 +506,7 @@ class DistributedFusedAdam:
         flat_p = self._gather_params(p_new, world)
         new_bstate = {"master_shard": p_new, "exp_avg_shard": m,
                       "exp_avg_sq_shard": v}
-        if self.grad_compress == "int8":
+        if compression.needs_residual(self.grad_compress):
             new_bstate["grad_residual"] = jnp.where(
                 keep, bstate["grad_residual"], new_residual)
         from apex_tpu.parallel.distributed import unflatten
@@ -669,7 +669,7 @@ class DistributedFusedAdam:
             "exp_avg_shard": jnp.zeros_like(shard),
             "exp_avg_sq_shard": jnp.zeros_like(shard),
         }
-        if self.grad_compress == "int8":
+        if compression.needs_residual(self.grad_compress):
             state["grad_residual"] = jnp.zeros((padded,), jnp.float32)
         return state
 
@@ -727,21 +727,9 @@ class DistributedFusedAdam:
         g_shard, grad_residual = self._sync_grads(flat_g, state, world)
 
         step = state["step"] + jnp.where(noop > 0, 0, 1).astype(jnp.int32)
-        b1, b2 = self.betas
-        if self.bias_correction:
-            bc1 = 1.0 - b1 ** step
-            bc2 = 1.0 - b2 ** step
-        else:
-            bc1 = bc2 = 1.0
         p = state["master_shard"]
-        if self.adam_w_mode == 0 or not self.adam_w_mode:
-            g_shard = g_shard + self.weight_decay * p
-        m = b1 * state["exp_avg_shard"] + (1 - b1) * g_shard
-        v = b2 * state["exp_avg_sq_shard"] + (1 - b2) * jnp.square(g_shard)
-        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.adam_w_mode and self.weight_decay != 0:
-            update = update + self.weight_decay * p
-        p_new = p - lr * update
+        p_new, m, v = self._shard_adam_math(g_shard, state, lr=lr,
+                                            step=step)
 
         keep = noop > 0
         p_new = jnp.where(keep, p, p_new)
@@ -756,7 +744,7 @@ class DistributedFusedAdam:
             "exp_avg_shard": m,
             "exp_avg_sq_shard": v,
         }
-        if self.grad_compress == "int8":
+        if compression.needs_residual(self.grad_compress):
             # an overflow-skipped step consumed a bogus gradient — drop
             # its quantization error instead of feeding it back
             new_state["grad_residual"] = jnp.where(

@@ -138,6 +138,25 @@ class DistributedFusedLAMB:
             return jnp.maximum(gnorm / self.max_grad_norm, 1.0)
         return jnp.asarray(1.0, jnp.float32)
 
+    def _lamb_mvu(self, g_shard, p, lstate, *, step):
+        """The fused LAMB moment + raw-update pass — ONE multi-tensor
+        kernel call per shard/bucket
+        (:func:`apex_tpu.kernels.optim.fused_lamb_mvu`; the jnp oracle
+        is byte-for-byte the math this class used to inline). The
+        per-tensor trust ratio stays with the caller: it couples the
+        whole shard through the segment-norm scalar join."""
+        from apex_tpu.kernels import optim as _koptim
+
+        b1, b2 = self.betas
+        beta3 = (1 - b1) if self.grad_averaging else 1.0
+        bc1 = 1.0 - b1 ** step if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** step if self.bias_correction else 1.0
+        return _koptim.fused_lamb_mvu(
+            g_shard, p, lstate["exp_avg_shard"],
+            lstate["exp_avg_sq_shard"], bc1=bc1, bc2=bc2, b1=b1, b2=b2,
+            beta3=beta3, eps=self.eps, weight_decay=self.weight_decay,
+            adam_w=bool(self.adam_w_mode))
+
     def _bucket_segments(self, bucket, p_leaves):
         """Static per-tensor segment ids for one bucket's padded flat
         vector, shard-major — the bucket-local analog of
@@ -161,19 +180,8 @@ class DistributedFusedLAMB:
         seg_shards, T = self._bucket_segments(bucket, p_leaves)
         if clip is not None:
             g_shard = g_shard / clip
-        b1, b2 = self.betas
-        beta3 = (1 - b1) if self.grad_averaging else 1.0
-        bc1 = 1.0 - b1 ** step if self.bias_correction else 1.0
-        bc2 = 1.0 - b2 ** step if self.bias_correction else 1.0
         p = bstate["master_shard"]
-        if not self.adam_w_mode and self.weight_decay != 0:
-            g_shard = g_shard + self.weight_decay * p
-        m = b1 * bstate["exp_avg_shard"] + beta3 * g_shard
-        v = b2 * bstate["exp_avg_sq_shard"] \
-            + (1 - b2) * jnp.square(g_shard)
-        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.adam_w_mode and self.weight_decay != 0:
-            update = update + self.weight_decay * p
+        m, v, update = self._lamb_mvu(g_shard, p, bstate, step=step)
 
         w_sq = self._per_tensor_sq(p, seg_shards, world, T)
         u_sq = self._per_tensor_sq(update, seg_shards, world, T)
@@ -216,7 +224,7 @@ class DistributedFusedLAMB:
             flat_p = p_new
         new_bstate = {"master_shard": p_new, "exp_avg_shard": m,
                       "exp_avg_sq_shard": v}
-        if self.grad_compress == "int8":
+        if compression.needs_residual(self.grad_compress):
             new_bstate["grad_residual"] = jnp.where(
                 keep, bstate["grad_residual"], new_residual)
         from apex_tpu.parallel.distributed import unflatten
@@ -371,7 +379,7 @@ class DistributedFusedLAMB:
             "exp_avg_shard": jnp.zeros_like(shard),
             "exp_avg_sq_shard": jnp.zeros_like(shard),
         }
-        if self.grad_compress == "int8":
+        if compression.needs_residual(self.grad_compress):
             state["grad_residual"] = jnp.zeros((padded,), jnp.float32)
         return state
 
@@ -439,19 +447,8 @@ class DistributedFusedLAMB:
         g_shard = g_shard / clip
 
         step = state["step"] + jnp.where(noop > 0, 0, 1).astype(jnp.int32)
-        b1, b2 = self.betas
-        beta3 = (1 - b1) if self.grad_averaging else 1.0
-        bc1 = 1.0 - b1 ** step if self.bias_correction else 1.0
-        bc2 = 1.0 - b2 ** step if self.bias_correction else 1.0
-
         p = state["master_shard"]
-        if not self.adam_w_mode and self.weight_decay != 0:
-            g_shard = g_shard + self.weight_decay * p
-        m = b1 * state["exp_avg_shard"] + beta3 * g_shard
-        v = b2 * state["exp_avg_sq_shard"] + (1 - b2) * jnp.square(g_shard)
-        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.adam_w_mode and self.weight_decay != 0:
-            update = update + self.weight_decay * p
+        m, v, update = self._lamb_mvu(g_shard, p, state, step=step)
 
         # per-tensor trust ratios from sharded norms
         w_sq = self._per_tensor_sq(p, seg_shards, world, T)
@@ -500,7 +497,7 @@ class DistributedFusedLAMB:
             "exp_avg_shard": m,
             "exp_avg_sq_shard": v,
         }
-        if self.grad_compress == "int8":
+        if compression.needs_residual(self.grad_compress):
             # overflow-skipped steps drop the bogus quantization error
             new_state["grad_residual"] = jnp.where(
                 keep, state["grad_residual"], grad_residual)

@@ -1,0 +1,29 @@
+"""apex_tpu.kernels — the Pallas fused-kernel layer (csrc parity).
+
+One registry (:mod:`apex_tpu.kernels.registry`: ``APEX_TPU_KERNELS``
+master switch, per-kernel env overrides, jnp oracle fallback always
+available, interpreter mode for CPU tests) gating four kernel families
+behind their existing Python entry points:
+
+- :mod:`apex_tpu.kernels.norm` — RMSNorm/LayerNorm fwd + bwd-dx
+  (entry: ``apex_tpu.normalization`` via ``apex_tpu.ops.layer_norm``)
+- :mod:`apex_tpu.kernels.softmax` — scaled-masked / upper-triangular
+  softmax fwd + fused bwd (entry:
+  ``apex_tpu.transformer.functional.fused_softmax``)
+- :mod:`apex_tpu.kernels.optim` — fused multi-tensor Adam/LAMB updates
+  over the bucket-domain ZeRO state (entry: the
+  ``apex_tpu.contrib.optimizers`` ZeRO classes)
+- :mod:`apex_tpu.kernels.quant4` — int4 dual-quantization pack/unpack
+  (entry: ``apex_tpu.parallel.compression`` ``compress="int4"``)
+
+See docs/kernels.md for env vars, parity bounds, and wire formats.
+"""
+
+from apex_tpu.kernels import norm, optim, quant4, softmax  # noqa: F401
+from apex_tpu.kernels.registry import (  # noqa: F401
+    KernelRegistry,
+    PallasGate,
+    choose_block,
+    get_kernel_registry,
+    kernel_gate,
+)

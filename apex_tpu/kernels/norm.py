@@ -1,0 +1,188 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels (forward + backward-dx).
+
+Parity: reference csrc/layer_norm_cuda_kernel.cu — the fused row-stat
+kernels behind ``fused_layer_norm_cuda.forward[_affine]`` /
+``backward[_affine]`` / ``rms_*``. The public entry points stay in
+:mod:`apex_tpu.ops.layer_norm` (custom VJP + shape handling); this
+module owns the kernel bodies and their registry gates so the
+pallas-vs-oracle decision rides the one ladder in
+:mod:`apex_tpu.kernels.registry`.
+
+Kernel design: one kernel per (fwd, bwd-dx) pass, gridded over row
+blocks with the full hidden dim resident in VMEM; per-row statistics
+are computed in fp32 on the VPU, mirroring the jnp oracle's operation
+order exactly — in interpreter mode the kernels are bit-identical to
+the oracle (the parity tests assert equality, not closeness). The
+backward *recomputes* the row stats from the stashed input instead of
+round-tripping them through HBM (stats are VPU-cheap; HBM bandwidth is
+the bottleneck). Weight/bias grads are column-sum reductions XLA
+already does optimally, so they stay jnp in the VJP.
+
+Gates: ``layernorm`` / ``rmsnorm``, registered ``default=False`` — on
+a real chip (BERT-large, hidden 1024) the jnp lowering measured ~14%
+faster end-to-end because XLA's own LN fusion matches the kernel's
+bandwidth while the custom-call is a fusion barrier. The kernels stay
+available for shapes XLA handles poorly (``APEX_TPU_KERNEL_LAYERNORM=1``
+/ ``APEX_TPU_KERNEL_RMSNORM=1``, or the legacy ``APEX_TPU_PALLAS_LN=1``
+both honor) and are kept correct by the interpret-mode test suite.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.kernels.registry import kernel_gate
+
+GATE_LN = kernel_gate("layernorm", default=False,
+                      legacy_env="APEX_TPU_PALLAS_LN")
+GATE_RMS = kernel_gate("rmsnorm", default=False,
+                       legacy_env="APEX_TPU_PALLAS_LN")
+
+
+def _row_block(n_rows: int, hidden: int) -> int:
+    # Keep x, y and temps for a block within a few MB of VMEM.
+    budget = 4 * 1024 * 1024
+    rows = max(8, budget // max(1, 4 * hidden * 4))
+    rows = min(rows, 512)
+    rows = max(8, (rows // 8) * 8)
+    return rows
+
+
+def _ln_stats(x):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    return mean, var
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, *, eps, affine):
+    x = x_ref[...].astype(jnp.float32)
+    mean, var = _ln_stats(x)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if affine:
+        y = y * w_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _ln_bwd_kernel(dy_ref, x_ref, w_ref, dx_ref, *, eps, affine):
+    dy = dy_ref[...].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
+    mean, var = _ln_stats(x)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    wdy = dy * w_ref[...].astype(jnp.float32) if affine else dy
+    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _rms_fwd_kernel(x_ref, w_ref, y_ref, *, eps, affine):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps)
+    if affine:
+        y = y * w_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _rms_bwd_kernel(dy_ref, x_ref, w_ref, dx_ref, *, eps, affine):
+    dy = dy_ref[...].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    xhat = x * rstd
+    wdy = dy * w_ref[...].astype(jnp.float32) if affine else dy
+    c = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - xhat * c) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def pallas_rowwise(kernel, outs_dtype, x2d, *vectors, interpret=False):
+    """Launch a row-blocked kernel: x2d [n, h] gridded over rows, each
+    vector arg [h] broadcast to every block (a same-shape [n, h] arg —
+    the backward's dy — rides the row grid instead)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h = x2d.shape
+    rb = _row_block(n, h)
+    grid = (pl.cdiv(n, rb),)
+    in_specs = [pl.BlockSpec((rb, h), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)]
+    args = [x2d]
+    for v in vectors:
+        if v.ndim == 2 and v.shape[0] == n:
+            in_specs.append(pl.BlockSpec((rb, h), lambda i: (i, 0),
+                                         memory_space=pltpu.VMEM))
+        else:
+            in_specs.append(pl.BlockSpec((h,), lambda i: (0,),
+                                         memory_space=pltpu.VMEM))
+        args.append(v)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rb, h), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, h), outs_dtype),
+        interpret=interpret,
+    )(*args)
+
+
+def _ones(h):
+    return jnp.ones((h,), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# launchers (consumed by apex_tpu.ops.layer_norm)
+# ---------------------------------------------------------------------------
+
+def ln_fwd(x2d, weight, bias, eps, *, interpret=False):
+    h = x2d.shape[1]
+    affine = weight is not None
+    w = weight if affine else _ones(h)
+    b = bias if bias is not None else jnp.zeros((h,), jnp.float32)
+    kernel = functools.partial(_ln_fwd_kernel, eps=eps, affine=affine)
+    return pallas_rowwise(kernel, x2d.dtype, x2d, w, b,
+                          interpret=interpret)
+
+
+def ln_bwd_dx(dy2d, x2d, weight, eps, *, interpret=False):
+    h = x2d.shape[1]
+    affine = weight is not None
+    w = weight if affine else _ones(h)
+    kernel = functools.partial(_ln_bwd_kernel, eps=eps, affine=affine)
+
+    def k(x_ref, dy_ref, w_ref, dx_ref):
+        kernel(dy_ref, x_ref, w_ref, dx_ref)
+    return pallas_rowwise(k, x2d.dtype, x2d, dy2d, w,
+                          interpret=interpret)
+
+
+def rms_fwd(x2d, weight, eps, *, interpret=False):
+    h = x2d.shape[1]
+    affine = weight is not None
+    w = weight if affine else _ones(h)
+    kernel = functools.partial(_rms_fwd_kernel, eps=eps, affine=affine)
+
+    def k(x_ref, w_ref, y_ref):
+        kernel(x_ref, w_ref, y_ref)
+    return pallas_rowwise(k, x2d.dtype, x2d, w, interpret=interpret)
+
+
+def rms_bwd_dx(dy2d, x2d, weight, eps, *, interpret=False):
+    h = x2d.shape[1]
+    affine = weight is not None
+    w = weight if affine else _ones(h)
+    kernel = functools.partial(_rms_bwd_kernel, eps=eps, affine=affine)
+
+    def k(x_ref, dy_ref, w_ref, dx_ref):
+        kernel(dy_ref, x_ref, w_ref, dx_ref)
+    return pallas_rowwise(k, x2d.dtype, x2d, dy2d, w,
+                          interpret=interpret)

@@ -7,7 +7,10 @@ Parity: reference apex/normalization/fused_layer_norm.py —
 ``manual_rms_norm`` (16-29).
 
 TPU design: modules are flax.linen Modules; the math lives in
-:mod:`apex_tpu.ops.layer_norm` (Pallas kernels on TPU, jnp elsewhere).
+:mod:`apex_tpu.ops.layer_norm` — Pallas kernels from
+:mod:`apex_tpu.kernels.norm` behind the kernel registry's
+``layernorm``/``rmsnorm`` gates (docs/kernels.md), the jnp oracle
+everywhere else.
 "Mixed" variants compute in fp32 but return the *parameter* dtype, matching
 the reference's mixed-dtype kernels (layer_norm_cuda.cpp
 ``forward_affine_mixed_dtypes``).

@@ -5,16 +5,21 @@ Parity: reference csrc/layer_norm_cuda.cpp (442) + layer_norm_cuda_kernel.cu
 ``rms_forward*``, ``rms_backward*`` — consumed by
 apex/normalization/fused_layer_norm.py:32-165.
 
-TPU design: one Pallas kernel per (fwd, bwd-dx) pass, gridded over row
-blocks with the full hidden dim resident in VMEM; per-row statistics are
-computed in fp32 on the VPU. The backward *recomputes* the row stats from
-the stashed input instead of round-tripping them through HBM (stats are
-VPU-cheap; HBM bandwidth is the bottleneck). Weight/bias grads are
-column-sum reductions that XLA already does optimally, so they stay as jnp
-reductions in the VJP. On non-TPU backends (CPU tests) a pure-jnp path
-with identical math is used — the same strategy as the reference's CPU
-fallback (fused_layer_norm.py:411-413 "CPU path is here mainly for
-unittest sake").
+TPU design: the kernel bodies live in :mod:`apex_tpu.kernels.norm`
+(one Pallas kernel per (fwd, bwd-dx) pass, row-blocked, fp32 row stats
+on the VPU; backward recomputes stats from the stashed input instead of
+round-tripping them through HBM) behind the ``layernorm`` / ``rmsnorm``
+gates of the kernel registry (:mod:`apex_tpu.kernels.registry` —
+``APEX_TPU_KERNELS`` master switch, per-kernel overrides, legacy
+``APEX_TPU_PALLAS_LN=1`` still honored). This module keeps the public
+entry points, the custom VJP wiring, and the pure-jnp oracle — the
+math XLA fuses itself, which is both the non-TPU fallback (CPU tests;
+the reference's own CPU path exists "mainly for unittest sake",
+fused_layer_norm.py:411-413) and the kernels' parity reference. The
+kernels default OFF even on TPU: measured on a real chip (BERT-large,
+hidden 1024) the jnp path is ~14% faster end-to-end because XLA's own
+LN fusion matches the kernel's bandwidth and the custom-call is a
+fusion barrier.
 """
 
 import functools
@@ -22,118 +27,49 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.kernels import norm as _kernels
+from apex_tpu.kernels.registry import PallasGate, get_kernel_registry
+
 _INTERPRET = False  # flipped by tests to debug kernels
 
 
-def _use_pallas(*arrays) -> bool:
+def _record(name, use, gate):
+    """kernels/dispatch telemetry (trace-time; no-op when the metrics
+    registry is disabled)."""
+    path = ("interpret" if (use and _interp(gate))
+            else "pallas" if use else "oracle")
+    get_kernel_registry().dispatch(name, path)
+
+
+def _use_pallas(*arrays_and_gate) -> bool:
     """Whether to run the hand-written Pallas kernel instead of the jnp
-    lowering XLA fuses itself.
-
-    Default: OFF. Measured on a real chip (BERT-large, hidden 1024), the
-    jnp path is ~14% faster end-to-end: XLA's own LN fusion matches the
-    kernel's bandwidth, and the custom-call is a fusion barrier that adds
-    layout copies around every layer. The kernel remains available for
-    shapes XLA handles poorly (APEX_TPU_PALLAS_LN=1 forces it) and is kept
-    correct by the test suite.
-    """
-    import os
-
-    if os.environ.get("APEX_TPU_DISABLE_PALLAS", "0") == "1":
-        return False
-    if os.environ.get("APEX_TPU_PALLAS_LN", "0") != "1":
-        return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    lowering XLA fuses itself — the registry gate's decision (tests
+    monkeypatch this to force the kernel on CPU). An optional
+    :class:`PallasGate` positional selects the rmsnorm gate; default is
+    the layernorm gate."""
+    gate = next((a for a in arrays_and_gate if isinstance(a, PallasGate)),
+                _kernels.GATE_LN)
+    return gate.enabled()
 
 
-def _row_block(n_rows: int, hidden: int) -> int:
-    # Keep x, y and temps for a block within a few MB of VMEM.
-    budget = 4 * 1024 * 1024
-    rows = max(8, budget // max(1, 4 * hidden * 4))
-    rows = min(rows, 512)
-    rows = max(8, (rows // 8) * 8)
-    return rows
+def _interp(gate):
+    return _INTERPRET or gate.interpret
 
 
 def _ln_stats(x):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    xc = x - mean
-    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
-    return mean, var
+    return _kernels._ln_stats(x)
 
 
 # ---------------------------------------------------------------------------
-# LayerNorm kernels
+# LayerNorm
 # ---------------------------------------------------------------------------
-
-def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, *, eps, affine):
-    x = x_ref[...].astype(jnp.float32)
-    mean, var = _ln_stats(x)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
-    if affine:
-        y = y * w_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
-    y_ref[...] = y.astype(y_ref.dtype)
-
-
-def _ln_bwd_kernel(dy_ref, x_ref, w_ref, dx_ref, *, eps, affine):
-    dy = dy_ref[...].astype(jnp.float32)
-    x = x_ref[...].astype(jnp.float32)
-    mean, var = _ln_stats(x)
-    rstd = jax.lax.rsqrt(var + eps)
-    xhat = (x - mean) * rstd
-    wdy = dy * w_ref[...].astype(jnp.float32) if affine else dy
-    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
-    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
-    dx = (wdy - c1 - xhat * c2) * rstd
-    dx_ref[...] = dx.astype(dx_ref.dtype)
-
-
-def _pallas_rowwise(kernel, outs_dtype, x2d, *vectors):
-    """Launch a row-blocked kernel: x2d [n, h] gridded over rows, each
-    vector arg [h] broadcast to every block."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    n, h = x2d.shape
-    rb = _row_block(n, h)
-    grid = (pl.cdiv(n, rb),)
-    in_specs = [pl.BlockSpec((rb, h), lambda i: (i, 0), memory_space=pltpu.VMEM)]
-    args = [x2d]
-    for v in vectors:
-        if v.ndim == 2 and v.shape[0] == n:
-            in_specs.append(pl.BlockSpec((rb, h), lambda i: (i, 0),
-                                         memory_space=pltpu.VMEM))
-        else:
-            in_specs.append(pl.BlockSpec((h,), lambda i: (0,),
-                                         memory_space=pltpu.VMEM))
-        args.append(v)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((rb, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n, h), outs_dtype),
-        interpret=_INTERPRET,
-    )(*args)
-
-
-def _ones(h):
-    return jnp.ones((h,), jnp.float32)
-
 
 def _ln_fwd(x2d, weight, bias, eps):
-    if _use_pallas(x2d):
-        h = x2d.shape[1]
-        affine = weight is not None
-        kernel = functools.partial(_ln_fwd_kernel, eps=eps, affine=affine)
-        w = weight if affine else _ones(h)
-        b = bias if bias is not None else jnp.zeros((h,), jnp.float32)
-        # kernel signature: (x, w, b, y)
-        def k(x_ref, w_ref, b_ref, y_ref):
-            kernel(x_ref, w_ref, b_ref, y_ref)
-        return _pallas_rowwise(k, x2d.dtype, x2d, w, b)
+    use = _use_pallas(x2d)
+    _record("layernorm", use, _kernels.GATE_LN)
+    if use:
+        return _kernels.ln_fwd(x2d, weight, bias, eps,
+                               interpret=_interp(_kernels.GATE_LN))
     x = x2d.astype(jnp.float32)
     mean, var = _ln_stats(x)
     y = (x - mean) * jax.lax.rsqrt(var + eps)
@@ -146,14 +82,8 @@ def _ln_fwd(x2d, weight, bias, eps):
 
 def _ln_bwd_dx(dy2d, x2d, weight, eps):
     if _use_pallas(x2d):
-        h = x2d.shape[1]
-        affine = weight is not None
-        w = weight if affine else _ones(h)
-        kernel = functools.partial(_ln_bwd_kernel, eps=eps, affine=affine)
-
-        def k(x_ref, dy_ref, w_ref, dx_ref):
-            kernel(dy_ref, x_ref, w_ref, dx_ref)
-        return _pallas_rowwise(k, x2d.dtype, x2d, dy2d, w)
+        return _kernels.ln_bwd_dx(dy2d, x2d, weight, eps,
+                                  interpret=_interp(_kernels.GATE_LN))
     dy = dy2d.astype(jnp.float32)
     x = x2d.astype(jnp.float32)
     mean, var = _ln_stats(x)
@@ -219,40 +149,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5,
 
 
 # ---------------------------------------------------------------------------
-# RMSNorm kernels
+# RMSNorm
 # ---------------------------------------------------------------------------
 
-def _rms_fwd_kernel(x_ref, w_ref, y_ref, *, eps, affine):
-    x = x_ref[...].astype(jnp.float32)
-    ms = jnp.mean(x * x, axis=-1, keepdims=True)
-    y = x * jax.lax.rsqrt(ms + eps)
-    if affine:
-        y = y * w_ref[...].astype(jnp.float32)
-    y_ref[...] = y.astype(y_ref.dtype)
-
-
-def _rms_bwd_kernel(dy_ref, x_ref, w_ref, dx_ref, *, eps, affine):
-    dy = dy_ref[...].astype(jnp.float32)
-    x = x_ref[...].astype(jnp.float32)
-    ms = jnp.mean(x * x, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(ms + eps)
-    xhat = x * rstd
-    wdy = dy * w_ref[...].astype(jnp.float32) if affine else dy
-    c = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
-    dx = (wdy - xhat * c) * rstd
-    dx_ref[...] = dx.astype(dx_ref.dtype)
-
-
 def _rms_fwd(x2d, weight, eps):
-    if _use_pallas(x2d):
-        h = x2d.shape[1]
-        affine = weight is not None
-        w = weight if affine else _ones(h)
-        kernel = functools.partial(_rms_fwd_kernel, eps=eps, affine=affine)
-
-        def k(x_ref, w_ref, y_ref):
-            kernel(x_ref, w_ref, y_ref)
-        return _pallas_rowwise(k, x2d.dtype, x2d, w)
+    use = _use_pallas(x2d, _kernels.GATE_RMS)
+    _record("rmsnorm", use, _kernels.GATE_RMS)
+    if use:
+        return _kernels.rms_fwd(x2d, weight, eps,
+                                interpret=_interp(_kernels.GATE_RMS))
     x = x2d.astype(jnp.float32)
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
     y = x * jax.lax.rsqrt(ms + eps)
@@ -262,15 +167,9 @@ def _rms_fwd(x2d, weight, eps):
 
 
 def _rms_bwd_dx(dy2d, x2d, weight, eps):
-    if _use_pallas(x2d):
-        h = x2d.shape[1]
-        affine = weight is not None
-        w = weight if affine else _ones(h)
-        kernel = functools.partial(_rms_bwd_kernel, eps=eps, affine=affine)
-
-        def k(x_ref, dy_ref, w_ref, dx_ref):
-            kernel(dy_ref, x_ref, w_ref, dx_ref)
-        return _pallas_rowwise(k, x2d.dtype, x2d, dy2d, w)
+    if _use_pallas(x2d, _kernels.GATE_RMS):
+        return _kernels.rms_bwd_dx(dy2d, x2d, weight, eps,
+                                   interpret=_interp(_kernels.GATE_RMS))
     dy = dy2d.astype(jnp.float32)
     x = x2d.astype(jnp.float32)
     ms = jnp.mean(x * x, axis=-1, keepdims=True)

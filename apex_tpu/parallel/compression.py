@@ -27,17 +27,30 @@ explicit pytree/array so it composes with jit and buffer donation.
 wire (2x fewer bytes, no residual needed — and exact when the gradients
 are already bf16).
 
-A Pallas quantize/dequantize kernel rides behind the shared
-``contrib._pallas_gate`` pattern (``APEX_TPU_COMPRESS_PALLAS=0`` opts
-out; :func:`force_interpret` runs it in interpreter mode for CPU tests);
-off TPU the pure-``jnp`` formulation below is both the fallback and the
-kernel's parity oracle.
+``mode="int4"`` pushes the same machinery to 4 bits with EQuARX-style
+DUAL quantization (apex_tpu.kernels.quant4): symmetric int4 values in
+[-7, 7] against per-block scales that are THEMSELVES uint8-quantized
+relative to one fp32 per-bucket scale, so the modeled wire is ~0.53
+bytes/element at block 256 (values 0.5 + scales 1/256 + one fp32). The
+error-feedback residual machinery is shared verbatim with int8 — only
+the per-step quantization error is larger (EF absorbs it; the 200-step
+convergence test holds the same 2% bound).
+
+The quantize/dequantize kernels ride the kernel registry
+(:mod:`apex_tpu.kernels.registry`): gates ``quant`` (int8) and
+``quant4``, master switch ``APEX_TPU_KERNELS``, the legacy
+``APEX_TPU_COMPRESS_PALLAS`` still honored with a DeprecationWarning;
+:func:`force_interpret` runs them in interpreter mode for CPU tests.
+Off TPU the pure-``jnp`` formulations below are both the fallback and
+the kernels' parity oracles.
 """
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.kernels import quant4 as _quant4
+from apex_tpu.kernels.registry import kernel_gate
 from apex_tpu.telemetry import comm as _telemetry_comm
 
 # ~256 lanes per scale: 2 TPU lane-groups wide, 0.4% scale overhead.
@@ -47,26 +60,28 @@ BLOCK_SIZE = 256
 # dequantization is a pure scale multiply.
 _QMAX = 127.0
 
-_GATE = None
+# compression modes whose collectives return an error-feedback residual
+RESIDUAL_MODES = ("int8", "int4")
+
+_GATE = kernel_gate("quant", legacy_env="APEX_TPU_COMPRESS_PALLAS")
+
+
+def needs_residual(mode) -> bool:
+    """Whether ``mode`` makes the compressed collectives stateful —
+    returning ``(result, new_residual)`` for error feedback."""
+    return mode in RESIDUAL_MODES
 
 
 def _gate():
-    """The shared PallasGate, created lazily: importing it at module
-    scope runs contrib/__init__, which imports the ZeRO optimizers,
-    which import this module — a cycle."""
-    global _GATE
-    if _GATE is None:
-        from apex_tpu.contrib._pallas_gate import PallasGate
-
-        _GATE = PallasGate("APEX_TPU_COMPRESS_PALLAS")
     return _GATE
 
 
 def force_interpret(on: bool):
-    """Run the Pallas quantize/dequantize kernels in interpreter mode
-    regardless of backend (tests: exercises the kernel dataflow on the
-    CPU mesh)."""
-    _gate().force_interpret(on)
+    """Run the Pallas quantize/dequantize kernels (int8 AND int4) in
+    interpreter mode regardless of backend (tests: exercises the kernel
+    dataflow on the CPU mesh)."""
+    _GATE.force_interpret(on)
+    _quant4.GATE.force_interpret(on)
 
 
 def num_blocks(n: int, block_size: int = BLOCK_SIZE) -> int:
@@ -243,15 +258,56 @@ def _shared_scales(x2d, axis_name):
     return lax.pmax(scales, axis_name)
 
 
+def _shared_int4_scales(x2d, axis_name):
+    """The int4 scale agreement: pmax the raw fp32 block absmaxes (one
+    tiny collective, same as int8), then derive the two-level
+    ``(sq uint8, gmax fp32)`` pair DETERMINISTICALLY from the shared
+    absmaxes — every replica lands on the identical effective grid, so
+    the int32-partial psum stays exact. Returns the effective
+    ``[nblocks, 1]`` fp32 scales."""
+    absmax = jnp.maximum(jnp.max(jnp.abs(x2d), axis=-1, keepdims=True),
+                         1e-12)
+    _telemetry_comm.record_collective(
+        "pmax", elements=absmax.size, dtype=jnp.float32,
+        axis_name=axis_name, mode="int4")
+    absmax = lax.pmax(absmax, axis_name)
+    sq, gmax = _quant4.int4_block_scales(absmax)
+    return _quant4.effective_scales(sq, gmax)
+
+
+def _psum_int4(flat, axis_name, *, residual, block_size=BLOCK_SIZE):
+    """int4 body shared by :func:`psum_compressed`: quantize on the
+    shared two-level grid, sum int32 partials (semantic wire: 4-bit
+    lanes — ``bits=4`` in the accounting), dequantize, return the EF
+    residual in the flat domain."""
+    n = flat.shape[0]
+    g = flat.astype(jnp.float32)
+    if residual is not None:
+        g = g + residual.astype(jnp.float32)
+    x2d = pad_to_blocks(g, block_size)
+    scales = _shared_int4_scales(x2d, axis_name)
+    _quant4.record()
+    q = _quant4.quantize_int4(x2d, scales)
+    _telemetry_comm.record_collective(
+        "psum", elements=q.size, dtype=jnp.int8, bits=4,
+        axis_name=axis_name, mode="int4", emulated=True)
+    total = lax.psum(q.astype(jnp.int32), axis_name)
+    out = dequantize_blockwise(total, scales, n=n)
+    err = (x2d - _quant4._dequantize_jnp(q, scales)).reshape(-1)[:n]
+    return out, err
+
+
 def psum_compressed(flat, axis_name, *, mode="int8", residual=None,
                     block_size: int = BLOCK_SIZE):
     """AllReduce-sum of a flat buffer with a compressed payload.
 
     Returns ``(summed flat, new_residual)``. int8: the sum is fp32 and
     ``new_residual`` is the fp32 local quantization error to feed back
-    next step (``residual=None`` starts from zeros). bf16: payload is a
-    bf16 cast, result is cast back to ``flat.dtype``, residual is
-    passed through unchanged (None stays None).
+    next step (``residual=None`` starts from zeros). int4 works like
+    int8 at half the wire width (dual-quantized scales; see module
+    docstring). bf16: payload is a bf16 cast, result is cast back to
+    ``flat.dtype``, residual is passed through unchanged (None stays
+    None).
     """
     if mode == "bf16":
         _telemetry_comm.record_collective(
@@ -259,6 +315,9 @@ def psum_compressed(flat, axis_name, *, mode="int8", residual=None,
             axis_name=axis_name, mode="bf16")
         out = lax.psum(flat.astype(jnp.bfloat16), axis_name)
         return out.astype(flat.dtype), residual
+    if mode == "int4":
+        return _psum_int4(flat, axis_name, residual=residual,
+                          block_size=block_size)
     if mode != "int8":
         raise ValueError(f"unknown compression mode {mode!r}")
     n = flat.shape[0]
@@ -332,7 +391,7 @@ def psum_scatter_compressed(flat, axis_name, *, mode="int8", residual=None,
         shard = lax.psum_scatter(flat.astype(jnp.bfloat16), axis_name,
                                  tiled=True)
         return shard.astype(jnp.float32), residual
-    if mode != "int8":
+    if mode not in ("int8", "int4"):
         raise ValueError(f"unknown compression mode {mode!r}")
     world = lax.axis_size(axis_name)
     g = flat.astype(jnp.float32)
@@ -340,18 +399,28 @@ def psum_scatter_compressed(flat, axis_name, *, mode="int8", residual=None,
         g = g + residual.astype(jnp.float32)
     x2d = pad_to_blocks(g, block_size)
     nb = x2d.shape[0]
-    scales = _shared_scales(x2d, axis_name)
-    q = _quantize_pallas(x2d, scales) if _gate().enabled() \
-        else _quantize_jnp(x2d, scales)
-    _telemetry_comm.record_collective(
-        "psum_scatter", elements=q.size, dtype=jnp.int8,
-        axis_name=axis_name, mode="int8", emulated=True)
+    if mode == "int4":
+        scales = _shared_int4_scales(x2d, axis_name)
+        _quant4.record()
+        q = _quant4.quantize_int4(x2d, scales)
+        _telemetry_comm.record_collective(
+            "psum_scatter", elements=q.size, dtype=jnp.int8, bits=4,
+            axis_name=axis_name, mode="int4", emulated=True)
+        dq = _quant4._dequantize_jnp(q, scales)
+    else:
+        scales = _shared_scales(x2d, axis_name)
+        q = _quantize_pallas(x2d, scales) if _gate().enabled() \
+            else _quantize_jnp(x2d, scales)
+        _telemetry_comm.record_collective(
+            "psum_scatter", elements=q.size, dtype=jnp.int8,
+            axis_name=axis_name, mode="int8", emulated=True)
+        dq = _dequantize_jnp(q, scales)
     total = lax.psum_scatter(q.astype(jnp.int32), axis_name, tiled=True)
     rank = lax.axis_index(axis_name)
     my_scales = lax.dynamic_slice_in_dim(scales, rank * (nb // world),
                                          nb // world)
     shard = dequantize_blockwise(total, my_scales)
-    err = (x2d - _dequantize_jnp(q, scales)).reshape(-1)
+    err = (x2d - dq).reshape(-1)
     return shard, err
 
 
@@ -372,6 +441,8 @@ def all_gather_compressed(shard, axis_name, *, mode="bf16",
         full = lax.all_gather(shard.astype(jnp.bfloat16), axis_name,
                               tiled=True)
         return full.astype(jnp.float32)
+    if mode == "int4":
+        return _all_gather_int4(shard, axis_name, block_size=block_size)
     if mode != "int8":
         raise ValueError(f"unknown compression mode {mode!r}")
     q, scales = quantize_blockwise(shard, block_size)
@@ -383,6 +454,36 @@ def all_gather_compressed(shard, axis_name, *, mode="bf16",
         axis_name=axis_name, mode="int8")
     q_full = lax.all_gather(q, axis_name, tiled=True)
     s_full = lax.all_gather(scales, axis_name, tiled=True)
+    return dequantize_blockwise(q_full, s_full)
+
+
+def _all_gather_int4(shard, axis_name, *, block_size=BLOCK_SIZE):
+    """The genuinely-int4 gather: each rank quantizes its own shard on
+    LOCAL two-level scales (nothing is summed, so no pmax), PACKS the
+    nibbles (apex_tpu.kernels.quant4 split-half format), and ships
+    uint8 half-bytes + uint8 block scales + one fp32 per rank — real
+    4-bit wire traffic through XLA today, like the int8 gather."""
+    x2d = pad_to_blocks(shard.astype(jnp.float32), block_size)
+    nb = x2d.shape[0]
+    absmax = jnp.maximum(jnp.max(jnp.abs(x2d), axis=-1, keepdims=True),
+                         1e-12)
+    sq, gmax = _quant4.int4_block_scales(absmax)
+    scales = _quant4.effective_scales(sq, gmax)
+    _quant4.record()
+    q = _quant4.quantize_int4(x2d, scales)
+    packed = _quant4.pack_int4(q)
+    for elems, dt in ((packed.size, jnp.uint8), (sq.size, jnp.uint8),
+                      (1, jnp.float32)):
+        _telemetry_comm.record_collective(
+            "all_gather", elements=elems, dtype=dt,
+            axis_name=axis_name, mode="int4")
+    p_full = lax.all_gather(packed, axis_name, tiled=True)
+    sq_full = lax.all_gather(sq, axis_name, tiled=True)
+    gmax_full = lax.all_gather(gmax.reshape(1), axis_name, tiled=True)
+    q_full = _quant4.unpack_int4(p_full)
+    s_full = sq_full.astype(jnp.float32) * (
+        jnp.repeat(gmax_full, nb).reshape(-1, 1)
+        / jnp.float32(255.0 * _quant4.QMAX4))
     return dequantize_blockwise(q_full, s_full)
 
 
@@ -413,6 +514,11 @@ def estimate_allreduce_bytes(n, *, world=8, compress=None,
         nb = num_blocks(n, block_size)
         payload = n * 1 + nb * 4          # int8 lanes + shared fp32 scales
         payload += nb * 4                 # the scale pmax exchange
+    elif compress == "int4":
+        nb = num_blocks(n, block_size)
+        payload = n * 0.5 + nb * 1 + 4    # packed nibbles + uint8 block
+        #                                   scales + the fp32 bucket scale
+        payload += nb * 4                 # the absmax pmax exchange (fp32)
     else:
         raise ValueError(f"unknown compression mode {compress!r}")
     return int(round(ring * payload))

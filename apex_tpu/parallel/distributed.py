@@ -137,10 +137,12 @@ def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
     ``(g, new_residual)`` and ``residual`` (fp32, same shape as ``g``,
     zeros on step 0) is added into the payload before quantization; the
     residual lives in the pre-psum, predivided gradient domain, so keep
-    ``gradient_predivide_factor`` fixed across steps."""
-    is_int8 = compress == "int8"
+    ``gradient_predivide_factor`` fixed across steps. ``compress="int4"``
+    (dual-quantized half-byte payload) behaves exactly like int8 — same
+    residual contract at half the wire width."""
+    stateful = compression.needs_residual(compress)
     if isinstance(axis_name, (tuple, list)) and len(axis_name) == 0:
-        return (g, residual) if is_int8 else g
+        return (g, residual) if stateful else g
     orig_dtype = g.dtype
     if compress is None and allreduce_always_fp32:
         g = g.astype(jnp.float32)
@@ -161,7 +163,7 @@ def _psum_with_policy(g, axis_name, allreduce_always_fp32, gradient_average,
         n = _axis_size_total(axis_name)
         g = g / (n / gradient_predivide_factor)
     g = g.astype(orig_dtype)
-    return (g, new_residual.reshape(g.shape)) if is_int8 else g
+    return (g, new_residual.reshape(g.shape)) if stateful else g
 
 
 def _leaf_path_str(path) -> str:
@@ -185,10 +187,10 @@ def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
     replica set. Reducing an MoE model over 'dp' alone silently diverges
     the dense params across ep.
 
-    ``compress=None|"bf16"|"int8"`` selects the comm payload (see
-    parallel/compression.py). With ``"int8"`` the return becomes
-    ``(grads, residual)`` — carry the residual pytree to the next call
-    (``residual=None`` starts from zeros).
+    ``compress=None|"bf16"|"int8"|"int4"`` selects the comm payload (see
+    parallel/compression.py). With ``"int8"``/``"int4"`` the return
+    becomes ``(grads, residual)`` — carry the residual pytree to the
+    next call (``residual=None`` starts from zeros).
 
     ``numerics=True`` (or an int grouping depth) appends a per-module
     stats dict as the LAST return element — ``grads/<prefix>`` rows
@@ -207,13 +209,13 @@ def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
             expert_param_predicate=expert_param_predicate,
             expert_axis_name=expert_axis_name, compress=compress,
             compress_block_size=compress_block_size, residual=residual)
-        if compress == "int8":
+        if compression.needs_residual(compress):
             synced, new_residual = out
             return synced, new_residual, _grad_sync_stats(grads, synced,
                                                           numerics)
         return out, _grad_sync_stats(grads, out, numerics)
 
-    if compress == "int8":
+    if compression.needs_residual(compress):
         if residual is None:
             residual = init_residual(grads)
         paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(grads)
@@ -226,7 +228,7 @@ def all_reduce_gradients(grads, axis_name="dp", *, allreduce_always_fp32=False,
                 ax = expert_axis_name
             g2, r2 = _psum_with_policy(
                 g, ax, allreduce_always_fp32, gradient_average,
-                gradient_predivide_factor, compress="int8",
+                gradient_predivide_factor, compress=compress,
                 compress_block_size=compress_block_size, residual=r)
             new_g.append(g2)
             new_r.append(r2)
@@ -298,14 +300,15 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
     reduce over ``expert_axis_name``.
 
     ``compress`` works per BUCKET (one quantization grid per flat
-    buffer — fewer ragged tails than per-leaf); with ``"int8"`` the
-    return is ``(grads, residual)`` and the residual pytree stays
-    leaf-shaped (it is flattened into the bucket alongside the grads),
-    so the same residual state works for either sync path."""
-    is_int8 = compress == "int8"
+    buffer — fewer ragged tails than per-leaf); with ``"int8"`` or
+    ``"int4"`` the return is ``(grads, residual)`` and the residual
+    pytree stays leaf-shaped (it is flattened into the bucket alongside
+    the grads), so the same residual state works for either sync
+    path."""
+    stateful = compression.needs_residual(compress)
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(grads)
     leaves = [l for _, l in paths_leaves]
-    if is_int8:
+    if stateful:
         if residual is None:
             residual = init_residual(grads)
         res_leaves = jax.tree_util.tree_leaves(residual)
@@ -329,11 +332,11 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
             # around allreduce_bucket (distributed.py:429, prof flag)
             with jax.named_scope(f"ddp_allreduce_bucket_{n}"):
                 flat = flatten([leaves[i] for i in bucket])
-                if is_int8:
+                if stateful:
                     flat_r = flatten([res_leaves[i] for i in bucket])
                     flat, flat_r = _psum_with_policy(
                         flat, ax, allreduce_always_fp32, gradient_average,
-                        gradient_predivide_factor, compress="int8",
+                        gradient_predivide_factor, compress=compress,
                         compress_block_size=compress_block_size,
                         residual=flat_r)
                     for i, piece in zip(
@@ -352,7 +355,7 @@ def all_reduce_gradients_bucketed(grads, axis_name="dp", *,
                         bucket, unflatten(flat, [leaves[i] for i in bucket])):
                     out[i] = piece
             n += 1
-    if is_int8:
+    if stateful:
         return (jax.tree_util.tree_unflatten(treedef, out),
                 jax.tree_util.tree_unflatten(treedef, out_res))
     return jax.tree_util.tree_unflatten(treedef, out)
@@ -424,9 +427,10 @@ class DistributedDataParallel:
         self.expert_param_predicate = expert_param_predicate
         self.expert_axis_name = expert_axis_name
         # Compressed gradient collectives (parallel/compression.py):
-        # None | "bf16" | "int8". int8 makes .sync stateful — it returns
-        # (grads, residual) and the caller threads the residual pytree
-        # through the jitted step (donate it like optimizer state).
+        # None | "bf16" | "int8" | "int4". The int modes make .sync
+        # stateful — it returns (grads, residual) and the caller
+        # threads the residual pytree through the jitted step (donate
+        # it like optimizer state).
         self.compress = compress
         self.compress_block_size = compress_block_size
         # In-graph numerics (telemetry/numerics.py): True / an int
@@ -436,8 +440,9 @@ class DistributedDataParallel:
         self.numerics = numerics
 
     def init_residual(self, grads_or_params):
-        """Zero error-feedback state for ``compress="int8"`` (a pytree
-        shaped like the grads; donate it through the train step)."""
+        """Zero error-feedback state for ``compress="int8"``/``"int4"``
+        (a pytree shaped like the grads; donate it through the train
+        step)."""
         return init_residual(grads_or_params)
 
     def memory_report(self, jitted_step, *args, **kwargs):
@@ -462,9 +467,9 @@ class DistributedDataParallel:
         create_hooks bucketing); pass ``message_size=None`` at construction
         for the per-leaf path.
 
-        With ``compress="int8"`` returns ``(grads, residual)``; pass the
-        previous step's residual in (``None`` starts from zeros — step 0
-        of error feedback). With ``numerics=`` set at construction, a
+        With ``compress="int8"`` or ``"int4"`` returns
+        ``(grads, residual)``; pass the previous step's residual in
+        (``None`` starts from zeros — step 0 of error feedback). With ``numerics=`` set at construction, a
         per-module stats dict (``grads/*`` pre-compression local,
         ``synced/*`` post-collective — see ``_grad_sync_stats``) is
         appended as the last return element, for either sync path."""
@@ -472,7 +477,7 @@ class DistributedDataParallel:
         if self.compress is not None:
             kw = dict(compress=self.compress,
                       compress_block_size=self.compress_block_size)
-            if self.compress == "int8":
+            if compression.needs_residual(self.compress):
                 kw["residual"] = residual
         # host-side span (trace-time when called inside jit); the comm
         # byte counters accumulate underneath via _psum_with_policy
@@ -498,7 +503,7 @@ class DistributedDataParallel:
                     expert_axis_name=self.expert_axis_name, **kw)
             if not self.numerics:
                 return out
-            if self.compress == "int8":
+            if compression.needs_residual(self.compress):
                 synced, new_residual = out
                 return synced, new_residual, _grad_sync_stats(
                     grads, synced, self.numerics)

@@ -140,7 +140,7 @@ class OverlappedDataParallel:
                  fold_average: bool = True,
                  guard_flag: bool = False,
                  numerics=None):
-        if compress not in (None, "bf16", "int8"):
+        if compress not in (None, "bf16", "int8", "int4"):
             raise ValueError(f"unknown compression mode {compress!r}")
         self.axis_name = axis_name
         self.message_size = message_size
@@ -160,7 +160,7 @@ class OverlappedDataParallel:
                             block_size=self.compress_block_size)
 
     def init_residual(self, segment_params):
-        """Zero error-feedback state for ``compress="int8"`` — a tuple
+        """Zero error-feedback state for ``compress="int8"``/``"int4"`` — a tuple
         (per segment) of tuples of ``[nblocks, block]`` fp32 zeros, the
         PERSISTENT bucket-domain layout (donate it through the step;
         no per-step flatten/unflatten of a leaf-shaped tree)."""
@@ -211,7 +211,7 @@ class OverlappedDataParallel:
         if self.gradient_predivide_factor != 1.0:
             flat = flat / self.gradient_predivide_factor
         divisor = self._avg_divisor()
-        if self.compress == "int8":
+        if compression.needs_residual(self.compress):
             x2d = compression.pad_to_blocks(flat, self.compress_block_size)
             if res2d is not None:
                 x2d = x2d + res2d
@@ -257,7 +257,7 @@ class OverlappedDataParallel:
 
         Returns, in order: ``loss``, ``synced`` (list of per-segment
         grad pytrees, averaging policy applied), then ``new_residual``
-        (bucket-domain, iff ``compress="int8"``), then the local
+        (bucket-domain, iff the compress mode carries a residual), then the local
         non-finite ``flag`` (iff ``guard_flag``), then the ``stats``
         dict (iff ``numerics``).
         """
@@ -273,7 +273,7 @@ class OverlappedDataParallel:
                       buckets=[len(s) for s in plan],
                       compress=self.compress or "none",
                       fold_average=bool(self.fold_average))
-        is_int8 = self.compress == "int8"
+        is_int8 = compression.needs_residual(self.compress)
         if is_int8 and residual is None:
             residual = self.init_residual(segment_params)
 

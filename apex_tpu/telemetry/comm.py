@@ -63,21 +63,25 @@ def wire_bytes(op, payload_bytes, world):
 
 
 def record_collective(op, *, elements, dtype, axis_name=None, world=None,
-                      mode=None, emulated=False, registry=None):
+                      mode=None, emulated=False, registry=None,
+                      bits=None):
     """Account one collective call (host-side, trace-time).
 
     ``elements``/``dtype`` describe the semantic wire payload;
     ``world`` may be passed when the caller already resolved the axis
     size (the ZeRO optimizers), else it is read from ``axis_name`` via
-    ``lax.axis_size`` (static under tracing). No-op when the registry
-    is disabled or no axis spans more than one device.
+    ``lax.axis_size`` (static under tracing). ``bits`` overrides the
+    dtype's width for sub-byte wire formats (the int4 psum emulation
+    records its int8-valued codes at 4 bits/element — the width a
+    production packed collective ships). No-op when the registry is
+    disabled or no axis spans more than one device.
     """
     reg = registry or get_registry()
     if not reg.enabled:
         return 0.0
     if world is None:
         world = axis_world(axis_name)
-    itemsize = np.dtype(dtype).itemsize
+    itemsize = bits / 8.0 if bits else np.dtype(dtype).itemsize
     payload = float(elements) * itemsize
     wire = wire_bytes(op, payload, world)
     reg.counter("comm/calls").inc()
@@ -87,5 +91,6 @@ def record_collective(op, *, elements, dtype, axis_name=None, world=None,
     reg.event("collective", op, elements=int(elements),
               dtype=np.dtype(dtype).name, world=int(world),
               payload_bytes=int(payload), wire_bytes=int(round(wire)),
-              mode=mode, emulated=bool(emulated) or None)
+              mode=mode, emulated=bool(emulated) or None,
+              bits=int(bits) if bits else None)
     return wire

@@ -9,22 +9,34 @@ divisibility by 4 / batch-per-block), plus ``GenericFusedScaleMaskSoftmax``
 (276).
 
 TPU design: scale+mask+softmax is a pure VPU chain that XLA fuses into one
-loop; the functional forms below are the "kernel". The availability
-heuristic is kept (``is_kernel_available``) for API parity and returns
-True under the same shape conditions so callers exercising the reference's
-dispatch logic behave identically. Numerics: subtract-max in fp32,
-optionally compute in bf16 input dtype (``attn_mask_type`` semantics
-preserved).
+loop; the pure-jnp forms below are both the default lowering and the
+parity oracle for the hand-written Pallas kernels in
+:mod:`apex_tpu.kernels.softmax` (fused fwd + one-pass custom-VJP bwd,
+causal mask derived in-kernel). Dispatch rides the kernel registry's
+``softmax`` gate (:mod:`apex_tpu.kernels.registry`): gate off — the
+default everywhere but TPU — reproduces today's jnp path bit-identically
+*including autodiff gradients*; gate on routes through the kernels. The
+availability heuristic is kept (``is_kernel_available``) for API parity
+and returns True under the same shape conditions so callers exercising
+the reference's dispatch logic behave identically. Numerics:
+subtract-max in fp32, optionally compute in bf16 input dtype
+(``attn_mask_type`` semantics preserved).
 """
 
 import jax.numpy as jnp
 
+from apex_tpu.kernels import softmax as _kernels
 from apex_tpu.transformer.enums import AttnMaskType
 
 
 def scaled_upper_triang_masked_softmax(x, scale):
     """Causal-masked scaled softmax over [b, sq, sk] or [b, np, sq, sk]
     (reference scaled_upper_triang_masked_softmax_cuda)."""
+    if _kernels.usable(scale) and x.ndim == 3:
+        _kernels.record("interpret" if _kernels.GATE.interpret
+                        else "pallas")
+        return _kernels.scaled_upper_triang_masked_softmax(x, float(scale))
+    _kernels.record("oracle")
     xf = x.astype(jnp.float32) * scale
     sq, sk = x.shape[-2], x.shape[-1]
     causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
@@ -38,18 +50,30 @@ def scaled_upper_triang_masked_softmax(x, scale):
 def scaled_masked_softmax(x, mask, scale):
     """Arbitrary-mask scaled softmax; mask is 1/True where masked OUT
     (reference scaled_masked_softmax_cuda)."""
+    if mask is None:
+        return scaled_softmax(x, scale)
+    if _kernels.usable(scale):
+        _kernels.record("interpret" if _kernels.GATE.interpret
+                        else "pallas")
+        maskf = jnp.broadcast_to(mask.astype(bool), x.shape) \
+            .astype(jnp.float32)
+        return _kernels.scaled_masked_softmax(x, maskf, float(scale))
+    _kernels.record("oracle")
     xf = x.astype(jnp.float32) * scale
-    if mask is not None:
-        xf = jnp.where(mask.astype(bool), -10000.0, xf)
+    xf = jnp.where(mask.astype(bool), -10000.0, xf)
     xf = xf - jnp.max(xf, axis=-1, keepdims=True)
     e = jnp.exp(xf)
-    if mask is not None:
-        e = jnp.where(mask.astype(bool), 0.0, e)
+    e = jnp.where(mask.astype(bool), 0.0, e)
     return (e / jnp.sum(e, axis=-1, keepdims=True)).astype(x.dtype)
 
 
 def scaled_softmax(x, scale):
     """No-mask scaled softmax (reference scaled_softmax_cuda)."""
+    if _kernels.usable(scale):
+        _kernels.record("interpret" if _kernels.GATE.interpret
+                        else "pallas")
+        return _kernels.scaled_softmax(x, float(scale))
+    _kernels.record("oracle")
     xf = x.astype(jnp.float32) * scale
     xf = xf - jnp.max(xf, axis=-1, keepdims=True)
     e = jnp.exp(xf)

@@ -1268,6 +1268,157 @@ def bench_resnet(batch, steps):
           **_comm_fields(params))
 
 
+def bench_kernels(size, steps):
+    """Per-kernel-family microbench for the apex_tpu.kernels layer
+    (round-19 capture contract): each family runs the SAME jitted
+    computation twice — once with the Pallas kernel forced on (compiled
+    on TPU; interpreter mode on this CPU container, which measures the
+    kernel *dataflow* lowered through XLA's loop machinery — honest,
+    and expected slower than the fused jnp path here) and once on the
+    jnp oracle at identical semantics — and emits
+    ``<family>_kernel_ms`` / ``<family>_xla_ms`` / ``<family>_speedup``
+    plus a ``kernel`` telemetry event per family. ``size`` scales the
+    row count; the headline value is the geomean speedup (on cpu-mesh
+    this tracks interpreter overhead, the TPU series is the real one —
+    the ``backend`` field disambiguates, same convention as every
+    other config)."""
+    import math
+
+    from apex_tpu.kernels import optim as _koptim
+    from apex_tpu.kernels import quant4 as _quant4
+    from apex_tpu.kernels.registry import get_kernel_registry
+    from apex_tpu.ops import layer_norm as _ln_ops
+    from apex_tpu.parallel import compression
+    from apex_tpu.transformer.functional import fused_softmax as _fsm
+
+    kreg = get_kernel_registry()
+    rng = np.random.RandomState(0)
+    h = 512
+    rows = int(size)
+    x2d = jnp.asarray(rng.randn(rows, h).astype(np.float32))
+    w = jnp.asarray(rng.randn(h).astype(np.float32))
+    b = jnp.asarray(rng.randn(h).astype(np.float32))
+    x3d = jnp.asarray(rng.randn(8, 128, 128).astype(np.float32))
+    nflat = rows * h
+    g, p, m, v = (jnp.asarray(rng.randn(nflat).astype(np.float32))
+                  for _ in range(4))
+    x_blocks = jnp.asarray(
+        rng.randn(nflat // 256, 256).astype(np.float32))
+
+    on_tpu = _backend_verdict() == "tpu"
+
+    def time_leg(make_fn, args, names, kernel_on):
+        env_keys = [f"APEX_TPU_KERNEL_{n.upper()}" for n in names]
+        old = {k: os.environ.get(k) for k in env_keys}
+        try:
+            for k in env_keys:
+                os.environ[k] = "1" if kernel_on else "0"
+            if kernel_on and not on_tpu:
+                kreg.force_interpret(True, names)
+            fn = jax.jit(make_fn())
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / steps * 1e3
+        finally:
+            for k, val in old.items():
+                if val is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = val
+            kreg.force_interpret(False, names)
+
+    def rms_make():
+        def f(x, wv):
+            return jax.value_and_grad(
+                lambda xx: jnp.sum(_ln_ops.rms_norm(xx, h, wv) ** 2))(x)
+        return f
+
+    def ln_make():
+        def f(x, wv, bv):
+            return jax.value_and_grad(
+                lambda xx: jnp.sum(
+                    _ln_ops.layer_norm(xx, h, wv, bv) ** 2))(x)
+        return f
+
+    def sm_make():
+        def f(x):
+            return jax.value_and_grad(
+                lambda xx: jnp.sum(
+                    _fsm.scaled_upper_triang_masked_softmax(xx, 1.0)
+                    ** 2))(x)
+        return f
+
+    def adam_make():
+        def f(gv, pv, mv, vv):
+            return _koptim.fused_adam_update(
+                gv, pv, mv, vv, lr=1e-3, bc1=0.9, bc2=0.99, b1=0.9,
+                b2=0.999, eps=1e-8, weight_decay=0.01, adam_w=True)
+        return f
+
+    def lamb_make():
+        def f(gv, pv, mv, vv):
+            return _koptim.fused_lamb_mvu(
+                gv, pv, mv, vv, bc1=0.9, bc2=0.99, b1=0.9, b2=0.999,
+                beta3=0.1, eps=1e-6, weight_decay=0.01, adam_w=True)
+        return f
+
+    def int4_make():
+        def f(x):
+            absmax = jnp.maximum(
+                jnp.max(jnp.abs(x), axis=-1, keepdims=True), 1e-12)
+            sq, gmax = _quant4.int4_block_scales(absmax)
+            scales = _quant4.effective_scales(sq, gmax)
+            q = _quant4.quantize_int4(x, scales)
+            packed = _quant4.pack_int4(q)
+            return _quant4.dequantize_int4(
+                _quant4.unpack_int4(packed), scales)
+        return f
+
+    families = [
+        ("rmsnorm", rms_make, (x2d, w), ["rmsnorm"]),
+        ("layernorm", ln_make, (x2d, w, b), ["layernorm"]),
+        ("softmax", sm_make, (x3d,), ["softmax"]),
+        ("adam", adam_make, (g, p, m, v), ["adam"]),
+        ("lamb", lamb_make, (g, p, m, v), ["lamb"]),
+        ("int4", int4_make, (x_blocks,), ["quant4"]),
+    ]
+    from apex_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    fields = {}
+    speedups = []
+    t_total0 = time.perf_counter()
+    for fam, make, args, names in families:
+        xla_ms = time_leg(make, args, names, kernel_on=False)
+        kernel_ms = time_leg(make, args, names, kernel_on=True)
+        speedup = xla_ms / kernel_ms if kernel_ms > 0 else None
+        fields[f"{fam}_kernel_ms"] = round(kernel_ms, 3)
+        fields[f"{fam}_xla_ms"] = round(xla_ms, 3)
+        fields[f"{fam}_speedup"] = (round(speedup, 3)
+                                    if speedup is not None else None)
+        if speedup:
+            speedups.append(speedup)
+        if reg.enabled:
+            reg.event("kernel", "bench", kernel=fam,
+                      kernel_ms=round(kernel_ms, 3),
+                      xla_ms=round(xla_ms, 3))
+    dt = time.perf_counter() - t_total0
+    geomean = math.exp(sum(math.log(s) for s in speedups)
+                       / len(speedups)) if speedups else 0.0
+    # the int4 wire model next to int8/fp32 at a representative size
+    n_model = 25_600_000
+    world = int(os.environ.get("APEX_TPU_COMM_WORLD", "8"))
+    fields["int4_comm_bytes_model"] = compression.estimate_allreduce_bytes(
+        n_model, world=world, compress="int4")
+    _emit("kernels_speedup_geomean", geomean, "x", 0, steps, dt,
+          kernel_mode="pallas" if on_tpu else "interpret",
+          **_comm_fields(training=False), **fields)
+
+
 def bench_ddp_compressed(batch, steps, *, hidden=1024, depth=4):
     """DDP training step with block-quantized int8 gradient collectives
     + error feedback (parallel/compression.py) over ALL visible devices
@@ -1328,8 +1479,12 @@ def bench_ddp_compressed(batch, steps, *, hidden=1024, depth=4):
                         loss_index=2)
     n = _tree_size(params)
     fields = _comm_fields(params, compress="int8")
-    fp32_bytes = compression.estimate_allreduce_bytes(
-        n, world=int(os.environ.get("APEX_TPU_COMM_WORLD", "8")))
+    world_model = int(os.environ.get("APEX_TPU_COMM_WORLD", "8"))
+    fp32_bytes = compression.estimate_allreduce_bytes(n, world=world_model)
+    # the round-19 int4 dual-quantization model (0.5 byte/elem + two-
+    # level scales) next to the int8 payload this config actually runs
+    int4_bytes = compression.estimate_allreduce_bytes(
+        n, world=world_model, compress="int4")
     # fwd 2 flops/param-touch, train = 3x fwd
     flops = 6 * batch * world * depth * hidden * hidden
     _emit("ddp_compressed_int8_steps_per_sec",
@@ -1338,6 +1493,9 @@ def bench_ddp_compressed(batch, steps, *, hidden=1024, depth=4):
           comm_bytes_per_step_fp32=fp32_bytes,
           comm_bytes_reduction=round(
               fp32_bytes / max(fields["comm_bytes_per_step"], 1), 2),
+          comm_bytes_per_step_int4=int4_bytes,
+          comm_bytes_reduction_int4=round(
+              fp32_bytes / max(int4_bytes, 1), 2),
           **fields)
 
 
@@ -2602,6 +2760,7 @@ BENCH_SPECS = {
     "serve_chaos": ((24, 16), bench_serve_chaos),
     "serve_fleet": ((16, 8), bench_serve_fleet),
     "resnet": ((256, 50), bench_resnet),
+    "kernels": ((1024, 5), bench_kernels),
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
     "ddp_overlapped": ((64, 30), bench_ddp_overlapped),
     "ddp_resilience": ((32, 12), bench_ddp_resilience),

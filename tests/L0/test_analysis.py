@@ -77,6 +77,62 @@ class TestSeededViolations:
                            rules="no-host-callback")
         assert report.ok
 
+    @staticmethod
+    def _custom_call_module(target):
+        return ('module @jit_f {\n'
+                '  func.func public @main(%arg0: tensor<8x128xf32>) -> '
+                '(tensor<8x128xf32>) {\n'
+                f'    %0 = stablehlo.custom_call @{target}(%arg0) : '
+                '(tensor<8x128xf32>) -> tensor<8x128xf32>\n'
+                '    return %0 : tensor<8x128xf32>\n  }\n}\n')
+
+    def test_pallas_targets_allowlisted(self):
+        """ISSUE 14 satellite: a compiled pallas_call lowers to a
+        custom_call (tpu_custom_call / mosaic_cpu / ...) that runs
+        on-device — kernel-backed hot paths must lint clean."""
+        from apex_tpu.analysis.lint import LintContext, run_rules
+        from apex_tpu.analysis.rules import PALLAS_CUSTOM_CALL_TARGETS
+
+        for target in sorted(PALLAS_CUSTOM_CALL_TARGETS):
+            report = run_rules(
+                LintContext(hlo_text=self._custom_call_module(target)),
+                rules="no-host-callback")
+            assert report.ok, f"{target} false-positived: " \
+                f"{[str(f) for f in report.findings]}"
+
+    def test_pallas_allowlist_env_extendable(self, monkeypatch):
+        """A marker-matching target (hypothetical new Pallas runtime
+        name containing 'callback') trips by default and is waivable
+        via APEX_TPU_HLO_LINT_PALLAS_TARGETS without a code change."""
+        from apex_tpu.analysis.lint import LintContext, run_rules
+
+        text = self._custom_call_module("my_pallas_kernel_callback")
+        report = run_rules(LintContext(hlo_text=text),
+                           rules="no-host-callback")
+        assert not report.ok
+        monkeypatch.setenv("APEX_TPU_HLO_LINT_PALLAS_TARGETS",
+                           "other_target, my_pallas_kernel_callback")
+        report = run_rules(LintContext(hlo_text=text),
+                           rules="no-host-callback")
+        assert report.ok
+
+    def test_real_callback_trips_despite_allowlist(self, monkeypatch):
+        """The seeded proof the allowlist cannot hide a REAL host
+        callback: a jax.pure_callback program still trips the rule
+        even with extra pallas targets allowlisted."""
+        monkeypatch.setenv("APEX_TPU_HLO_LINT_PALLAS_TARGETS",
+                           "tpu_custom_call,mosaic_cpu")
+
+        def poisoned(x):
+            y = jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            return y * 2
+
+        report = lint_fn(poisoned, jnp.ones((4,)))
+        assert _rules_fired(report) == ["no-host-callback"]
+        assert "custom_call @" in report.findings[0].where
+
     def test_no_f64(self):
         from jax.experimental import enable_x64
 

@@ -368,6 +368,58 @@ def test_static_comm_gated_at_round18():
         assert any("non-negative number" in m for m in msgs)
 
 
+def test_kernels_fields_gated_at_round19():
+    """ISSUE 14 satellite: the kernels capture contract — per-family
+    kernel-vs-XLA timings on kernels lines, the int4 dual-quantization
+    wire model on ddp_compressed lines — is required from round 19;
+    pre-19 records carrying the fields are flagged, other configs
+    never need them."""
+    base = {"metric": "kernels_speedup_geomean", "value": 1.0,
+            "unit": "x", "vs_baseline": 1.0,
+            "tflops_per_sec": 0.0, "mfu": 0.0,
+            "comm_bytes_per_step": 0,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": None, "lint_violations": None,
+            "static_comm_bytes_per_step": None,
+            "backend": "cpu-mesh"}
+    # round 19: every per-family timing pair is required
+    msgs = schema.check_metric_line(dict(base), round_n=19, errors=[])
+    for key in schema.KERNELS_REQUIRED_FIELDS:
+        assert any(key in m for m in msgs)
+    full = dict(base, **{k: 1.5 for k in
+                         schema.KERNELS_REQUIRED_FIELDS})
+    assert schema.check_metric_line(dict(full), round_n=19,
+                                    errors=[]) == []
+    # nullable (a family whose leg crashed records null)
+    assert schema.check_metric_line(
+        dict(full, lamb_kernel_ms=None), round_n=19, errors=[]) == []
+    # pre-19 records carrying them are flagged
+    msgs = schema.check_metric_line(dict(full), round_n=18, errors=[])
+    assert any("only defined from round 19" in m for m in msgs)
+    # typed
+    msgs = schema.check_metric_line(
+        dict(full, adam_xla_ms="fast"), round_n=19, errors=[])
+    assert any("must be numeric or null" in m for m in msgs)
+
+    # ddp_compressed: comm_bytes_per_step_int4 required from 19
+    ddp = dict(base, metric="ddp_compressed_int8_steps_per_sec",
+               value=1.1, unit="steps/sec")
+    msgs = schema.check_metric_line(dict(ddp), round_n=19, errors=[])
+    assert any("comm_bytes_per_step_int4" in m for m in msgs)
+    assert schema.check_metric_line(
+        dict(ddp, comm_bytes_per_step_int4=23275007), round_n=19,
+        errors=[]) == []
+    msgs = schema.check_metric_line(
+        dict(ddp, comm_bytes_per_step_int4=23275007), round_n=18,
+        errors=[])
+    assert any("only defined from round 19" in m for m in msgs)
+    # other configs never need the kernels fields at round 19
+    assert schema.check_metric_line(dict(base, metric="resnet50_amp_o2"),
+                                    round_n=19, errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint

@@ -57,6 +57,12 @@ number ``n`` (old checked-in records stay valid):
   bytes parsed out of the lowered step — apex_tpu.analysis.sharding;
   null means the config measured no step or ran with
   ``APEX_TPU_STATIC_COMM=0``); pre-round-18 records carrying it are
+  flagged.
+- ``n >= 19``: ``kernels`` metric lines must carry the per-family
+  kernel-vs-XLA timings (``<family>_kernel_ms`` / ``<family>_xla_ms``,
+  nullable) and ``ddp_compressed`` lines the int4 dual-quantization
+  wire model (``comm_bytes_per_step_int4``); pre-round-19 records
+  carrying any of them are
   flagged — the field did not exist yet.
 
 Usage::
@@ -172,6 +178,22 @@ SERVE_SPEC_REQUIRED_FIELDS = ("accepted_tokens_per_sec",
 # measured_comm_bytes_per_step within 25%; a pre-round-18 record
 # carrying it is flagged — the field did not exist yet
 STATIC_COMM_FIELDS_SINCE_ROUND = 18
+# the Pallas kernel-layer contract (apex_tpu.kernels, round 19): a
+# kernels metric line carries per-family kernel-vs-XLA timings, and
+# ddp_compressed lines carry the int4 dual-quantization wire model
+# (comm_bytes_per_step_int4) next to the int8 payload; pre-round-19
+# records carrying any of them are flagged — the fields did not exist
+KERNELS_FIELDS_SINCE_ROUND = 19
+KERNELS_METRIC_PREFIX = "kernels_"
+KERNELS_REQUIRED_FIELDS = (
+    "rmsnorm_kernel_ms", "rmsnorm_xla_ms",
+    "layernorm_kernel_ms", "layernorm_xla_ms",
+    "softmax_kernel_ms", "softmax_xla_ms",
+    "adam_kernel_ms", "adam_xla_ms",
+    "lamb_kernel_ms", "lamb_xla_ms",
+    "int4_kernel_ms", "int4_xla_ms")
+INT4_COMM_FIELD = "comm_bytes_per_step_int4"
+DDP_COMPRESSED_METRIC_PREFIX = "ddp_compressed"
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -394,6 +416,39 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                           and obj["static_comm_bytes_per_step"] >= 0)):
                 bad("static_comm_bytes_per_step must be a non-negative "
                     "number or null")
+        is_kernels = str(obj.get("metric", "")).startswith(
+            KERNELS_METRIC_PREFIX)
+        present_kernels = [k for k in KERNELS_REQUIRED_FIELDS if k in obj]
+        if present_kernels and (round_n is not None
+                                and round_n < KERNELS_FIELDS_SINCE_ROUND):
+            bad(f"kernels fields {present_kernels} are only defined "
+                f"from round {KERNELS_FIELDS_SINCE_ROUND}")
+        elif is_kernels and (round_n is None
+                             or round_n >= KERNELS_FIELDS_SINCE_ROUND):
+            for key in KERNELS_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"kernels line missing {key!r} (required since "
+                        f"round {KERNELS_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"kernels field {key!r} must be numeric or "
+                        f"null")
+        is_ddp_compressed = str(obj.get("metric", "")).startswith(
+            DDP_COMPRESSED_METRIC_PREFIX)
+        if INT4_COMM_FIELD in obj and (
+                round_n is not None
+                and round_n < KERNELS_FIELDS_SINCE_ROUND):
+            bad(f"{INT4_COMM_FIELD} is only defined from round "
+                f"{KERNELS_FIELDS_SINCE_ROUND}")
+        elif is_ddp_compressed and (
+                round_n is None
+                or round_n >= KERNELS_FIELDS_SINCE_ROUND):
+            if INT4_COMM_FIELD not in obj:
+                bad(f"ddp_compressed line missing {INT4_COMM_FIELD!r} "
+                    f"(required since round "
+                    f"{KERNELS_FIELDS_SINCE_ROUND})")
+            elif not (obj[INT4_COMM_FIELD] is None
+                      or _type_ok(obj[INT4_COMM_FIELD], _NUM)):
+                bad(f"{INT4_COMM_FIELD} must be numeric or null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

@@ -773,6 +773,119 @@ def _lint_smoke(bench):
             "lint_events": len(lint_events)}
 
 
+def _kernels_smoke(bench):
+    """Pallas kernel-layer smoke (round 19): (a) interpret-mode parity
+    — each kernel family against its jnp oracle on the same inputs
+    (norm/optimizer bit-exact, softmax bwd within the documented
+    bound); (b) gate-off oracle equivalence — APEX_TPU_KERNELS=0
+    reproduces the oracle bit-identically through the public entry
+    points; (c) the norm entry point lints clean (trace-only) and the
+    registry's kernel dispatch events land in the JSONL. Raises on any
+    missing piece."""
+    import glob
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import analysis, telemetry
+    from apex_tpu.kernels import optim as koptim
+    from apex_tpu.kernels import quant4
+    from apex_tpu.kernels.registry import get_kernel_registry
+    from apex_tpu.ops import layer_norm as ln_ops
+
+    kreg = get_kernel_registry()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 128).astype(np.float32))
+    w = jnp.asarray(rng.randn(128).astype(np.float32))
+    flat = [jnp.asarray(rng.randn(700).astype(np.float32))
+            for _ in range(3)]
+    flat.append(jnp.asarray(np.abs(rng.randn(700)).astype(np.float32)))
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_kernels_smoke_")
+    prev_dir = os.environ.get(telemetry.registry.ENV_DIR)
+    prev_master = os.environ.get("APEX_TPU_KERNELS")
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        # (b) gate-off equivalence first: the master switch off must be
+        # the oracle, bit for bit
+        os.environ["APEX_TPU_KERNELS"] = "0"
+        y_off = np.asarray(ln_ops.rms_norm(x, 128, w))
+        os.environ.pop("APEX_TPU_KERNELS", None)
+        y_oracle = np.asarray(ln_ops.rms_norm(x, 128, w))
+        if not (y_off == y_oracle).all():
+            raise RuntimeError("kernels smoke: APEX_TPU_KERNELS=0 is "
+                               "not the oracle path")
+        # (a) interpret-mode parity per family
+        kreg.force_interpret(True)
+        try:
+            y_kernel = np.asarray(ln_ops.rms_norm(x, 128, w))
+            adam_k = koptim.fused_adam_update(
+                *flat, lr=1e-3, bc1=0.9, bc2=0.99, b1=0.9, b2=0.999,
+                eps=1e-8, weight_decay=0.01, adam_w=True)
+            xb = x.reshape(-1, 256)
+            absmax = jnp.maximum(
+                jnp.max(jnp.abs(xb), axis=-1, keepdims=True), 1e-12)
+            sq, gmax = quant4.int4_block_scales(absmax)
+            scales = quant4.effective_scales(sq, gmax)
+            q_k = np.asarray(quant4.quantize_int4(xb, scales))
+            rt_k = np.asarray(quant4.unpack_int4(quant4.pack_int4(
+                jnp.asarray(q_k))))
+        finally:
+            kreg.force_interpret(False)
+        adam_o = koptim.fused_adam_update(
+            *flat, lr=1e-3, bc1=0.9, bc2=0.99, b1=0.9, b2=0.999,
+            eps=1e-8, weight_decay=0.01, adam_w=True)
+        if not (y_kernel == y_oracle).all():
+            raise RuntimeError("kernels smoke: rmsnorm interpret "
+                               "parity failed")
+        for a, b in zip(adam_k, adam_o):
+            # documented bound: <= a few ulp of FMA association inside
+            # the fused pass (docs/kernels.md)
+            if not np.allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-6):
+                raise RuntimeError("kernels smoke: adam interpret "
+                                   "parity outside the documented "
+                                   "bound")
+        q_o = np.asarray(quant4._quantize_jnp(xb, scales))
+        if not (q_k == q_o).all() or not (rt_k == q_k).all():
+            raise RuntimeError("kernels smoke: int4 quantize/pack "
+                               "round-trip failed")
+        # (c) the kernel-backed entry point stays lint-clean
+        report = analysis.lint_fn(
+            lambda xx: ln_ops.rms_norm(xx, 128, w), x,
+            name="kernels_smoke_rmsnorm")
+        if report.findings:
+            raise RuntimeError(
+                f"kernels smoke: rms_norm lints dirty: "
+                f"{[str(f) for f in report.findings]}")
+        telemetry.get_registry().flush()
+    finally:
+        if prev_dir is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev_dir
+        if prev_master is None:
+            os.environ.pop("APEX_TPU_KERNELS", None)
+        else:
+            os.environ["APEX_TPU_KERNELS"] = prev_master
+    events = []
+    for path in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+        with open(path) as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    dispatches = [e for e in events if e.get("kind") == "kernel"
+                  and e.get("name") == "dispatch"]
+    paths = {e.get("kernel"): e.get("path") for e in dispatches}
+    if "rmsnorm" not in paths or "adam" not in paths:
+        raise RuntimeError(
+            f"kernels smoke: kernel dispatch events missing from the "
+            f"JSONL (saw {sorted(paths)})")
+    return {"telemetry_dir": tel_dir,
+            "dispatch_events": len(dispatches),
+            "kernels_seen": sorted(paths)}
+
+
 def _sharding_smoke(bench):
     """SPMD communication-audit smoke (round 18): (a) a seeded
     implicit-reshard program — HLO text carrying a collective_permute
@@ -1065,6 +1178,7 @@ def _stages(smoke):
             ("lint", None, lambda: _lint_smoke(bench)),
             ("sharding", None, lambda: _sharding_smoke(bench)),
             ("overlap", None, lambda: _overlap_smoke(bench)),
+            ("kernels", None, lambda: _kernels_smoke(bench)),
             ("trend", None, _trend_gate),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
@@ -1186,6 +1300,13 @@ def _stages(smoke):
         # step actually beating the bucketed baseline
         ("ddp_overlapped", None, spec("ddp_overlapped")),
         ("overlap", None, lambda: _overlap_smoke(bench)),
+        # round-19 kernel-layer captures: the per-family kernel-vs-XLA
+        # timing config (interpret-mode dataflow numbers on cpu-mesh,
+        # the real series on TPU) and the smoke proving interpret-mode
+        # parity, gate-off oracle equivalence, lint cleanliness of a
+        # kernel-backed entry point, and kernel dispatch telemetry
+        ("kernels", None, spec("kernels")),
+        ("kernels_smoke", None, lambda: _kernels_smoke(bench)),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

@@ -41,7 +41,7 @@ KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
     "compile", "memory", "serve", "recovery", "lint", "overlap",
-    "fleet",
+    "fleet", "kernel",
 })
 
 # fleet timeline rows kept per report (replica state transitions +
@@ -79,6 +79,7 @@ def aggregate(events):
                 "last_run": None}
     lint = {"programs": {}, "violations": 0, "by_rule": {},
             "errors": 0}
+    kernels = {}
     overlap = {"plans": [], "summaries": [], "timeline": [],
                "timeline_truncated": 0}
     fleet = {"starts": [], "migrations": 0, "migrated_requests": 0,
@@ -286,6 +287,20 @@ def aggregate(events):
                     rule = str(ev.get("rule"))
                     lint["by_rule"][rule] = \
                         lint["by_rule"].get(rule, 0) + 1
+            elif kind == "kernel":
+                k = kernels.setdefault(str(ev.get("kernel")), {
+                    "pallas": 0, "interpret": 0, "oracle": 0,
+                    "kernel_ms": None, "xla_ms": None})
+                if ev.get("name") == "dispatch":
+                    path = str(ev.get("path"))
+                    if path in k:
+                        k[path] += 1
+                elif ev.get("name") == "bench":
+                    # latest bench timing wins (one pair per capture)
+                    if ev.get("kernel_ms") is not None:
+                        k["kernel_ms"] = float(ev["kernel_ms"])
+                    if ev.get("xla_ms") is not None:
+                        k["xla_ms"] = float(ev["xla_ms"])
             elif kind == "overlap":
                 if ev.get("name") == "plan":
                     overlap["plans"].append({
@@ -372,6 +387,7 @@ def aggregate(events):
         "fleet": fleet,
         "recovery": recovery,
         "lint": lint,
+        "kernels": kernels,
         "overlap": overlap,
         "unknown_kinds": unknown,
         "malformed_events": malformed,
@@ -655,6 +671,19 @@ def print_report(report, out=None):
         if lint.get("errors"):
             w(f"  lint errors (pass crashed, not findings): "
               f"{lint['errors']}\n")
+    kernels = report.get("kernels") or {}
+    if kernels:
+        w("\nkernels (apex_tpu.kernels):\n")
+        w(f"  {'kernel':<12} {'pallas':>7} {'interp':>7} {'oracle':>7} "
+          f"{'kernel ms':>10} {'xla ms':>8} {'speedup':>8}\n")
+        for name in sorted(kernels):
+            k = kernels[name]
+            km, xm = k.get("kernel_ms"), k.get("xla_ms")
+            speed = (f"{xm / km:>8.2f}" if km and xm else f"{'':>8}")
+            w(f"  {name:<12} {k.get('pallas', 0):>7} "
+              f"{k.get('interpret', 0):>7} {k.get('oracle', 0):>7} "
+              f"{km if km is not None else '':>10} "
+              f"{xm if xm is not None else '':>8} {speed}\n")
     overlap = report.get("overlap") or {}
     if overlap.get("timeline") or overlap.get("summaries") \
             or overlap.get("plans"):
