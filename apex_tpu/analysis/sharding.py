@@ -495,6 +495,25 @@ def static_comm_bytes(text):
     return collective_graph(text).total_wire_bytes
 
 
+def static_comm_bytes_by_axis(text, closed_jaxpr=None):
+    """Static ring-model wire bytes grouped by the mesh axis name(s)
+    each collective reduces over (axes attached from the source jaxpr
+    via :func:`annotate_axes`; ops the best-effort labeling cannot
+    match land under ``"?"``). On a 2-D ``(data, model)`` mesh this is
+    the static side of the per-axis comm accounting — compressed DP
+    grad bytes vs fp32 TP activation bytes — that ``bench.py``'s
+    ``tp_dp`` config cross-validates against the trace-measured
+    ``comm/axis/<name>_bytes`` counters."""
+    graph = collective_graph(text)
+    if closed_jaxpr is not None:
+        annotate_axes(graph, closed_jaxpr)
+    out = {}
+    for op in graph.ops:
+        key = ",".join(op.axis_names) if op.axis_names else "?"
+        out[key] = out.get(key, 0.0) + op.wire_bytes
+    return {k: int(round(v)) for k, v in sorted(out.items())}
+
+
 # ---------------------------------------------------------------------------
 # jaxpr side: what the source program authored
 # ---------------------------------------------------------------------------

@@ -212,78 +212,73 @@ def guarded_step():
                         jnp.zeros((), jnp.int32), x, y), {}
 
 
-def tp_dp_step():
-    """The 2-D mesh prototype (ROADMAP item 4): a 2x4 ``(data, model)``
-    mesh running one column/row-parallel block — W1 split by output
-    column across ``model``, W2 split by input row, one TP psum over
-    ``model`` joining the partials (the SNIPPETS GSPMD pattern, spelled
-    manually through shard_map) — with int8 DP gradient compression
-    scoped to the ``data`` axis only. Params and the EF residual are
-    carry state, donated; the batch enters sharded over ``data``. The
-    point of the target: every rule — including the four SPMD
-    communication rules — must hold on a mesh where two collective
-    families with DIFFERENT replica-group partitions of the same 8
-    devices coexist in one program."""
-    from apex_tpu.parallel import DistributedDataParallel
+def _tp_dp_pieces(mode):
+    """Shared tp_dp target construction: a 2x4 ``(data, model)`` mesh
+    running the real GPT-2 column/row-parallel block stack
+    (apex_tpu.parallel.mesh2d — the tensor_parallel.mappings region
+    ops), int8 DP gradient compression + EF residual scoped to the
+    ``data`` axis, carry state donated, batch sharded over ``data``."""
+    from apex_tpu.parallel import mesh2d
 
     devices = jax.devices()
     if len(devices) % 2 != 0:
         raise RuntimeError(
             f"tp_dp target needs an even device count, got "
             f"{len(devices)} (run under the virtual 8-device mesh)")
-    tp = len(devices) // 2
-    mesh = Mesh(np.asarray(devices).reshape(2, tp), ("data", "model"))
-    hidden, ffn, batch = 32, 64, 4
-    rng = np.random.RandomState(0)
-    params = {
-        "w1": jnp.asarray(rng.randn(hidden, ffn).astype(np.float32)
-                          / np.sqrt(hidden)),
-        "b1": jnp.zeros((ffn,), jnp.float32),
-        "w2": jnp.asarray(rng.randn(ffn, hidden).astype(np.float32)
-                          / np.sqrt(ffn)),
-        "b2": jnp.zeros((hidden,), jnp.float32),
-    }
-    n = batch * 2  # batch rows per data-parallel replica row
-    x = jnp.asarray(rng.randn(n, hidden).astype(np.float32))
-    y = jnp.asarray(rng.randn(n, hidden).astype(np.float32))
-    # int8 gradient compression scoped to the DATA axis — the TP psum
-    # over "model" stays exact (activations, not gradients)
-    ddp = DistributedDataParallel(axis_name="data", compress="int8")
+    mesh = mesh2d.mesh_2d(2)
+    hidden, heads, vocab, seq = 32, 4, 64, 8
+    seg_params = mesh2d.gpt2_init(hidden=hidden, layers=2, heads=heads,
+                                  vocab=vocab, max_seq=seq)
+    step, state = mesh2d.build_train_step(
+        mesh, seg_params, hidden=hidden, heads=heads, mode=mode)
+    tokens, labels = mesh2d.make_batch(mesh, batch_per_replica=2,
+                                       seq=seq, vocab=vocab)
+    return step, state + (tokens, labels), {}
 
-    def local_shapes(p):
-        # per-device shards under the param specs below
-        return {"w1": p["w1"][:, :ffn // tp],
-                "b1": p["b1"][:ffn // tp],
-                "w2": p["w2"][:ffn // tp, :],
-                "b2": p["b2"]}
 
-    residual = ddp.init_residual(local_shapes(params))
+def tp_dp_overlap_min_bytes():
+    """The MEANINGFUL ``overlap-serialization`` threshold for the
+    tp_dp targets: the smallest DP bucket's int32-partial payload —
+    above the TP activation psum payload (so the inherent
+    backward-chain TP psums neither taint nor trip) and exactly at the
+    bucket floor (so every DP bucket is checked for serialization)."""
+    from apex_tpu.parallel import mesh2d
 
-    def loss_fn(p, xb, yb):
-        # column-parallel: each model rank holds ffn/tp output columns
-        h = jnp.tanh(xb @ p["w1"] + p["b1"])
-        # row-parallel: partial products joined by ONE TP psum
-        partial = h @ p["w2"]
-        out = jax.lax.psum(partial, "model") + p["b2"]
-        return jnp.mean((out - yb) ** 2)
+    seg_params = mesh2d.gpt2_init(hidden=32, layers=2, heads=4,
+                                  vocab=64, max_seq=8)
+    tp = max(1, len(jax.devices()) // 2)
+    min_bucket = 4 * min(
+        int(sum(l.size for l in jax.tree_util.tree_leaves(seg)))
+        for seg in mesh2d.local_template(seg_params, tp))
+    tp_psum = 2 * 8 * 32 * 4  # batch_local x seq x hidden fp32
+    if tp_psum >= min_bucket:
+        raise RuntimeError(
+            f"tp_dp target sizing breaks the separation: TP psum "
+            f"{tp_psum} B >= smallest bucket {min_bucket} B")
+    return min_bucket
 
-    def step_fn(p, res, xb, yb):
-        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
-        # DP sync across the data axis only; model-axis shards keep
-        # their own gradient slices
-        grads, res = ddp.sync(grads, res)
-        p = jax.tree_util.tree_map(lambda w, g: w - 0.05 * g, p, grads)
-        return p, res, loss
 
-    pspec = {"w1": P(None, "model"), "b1": P("model"),
-             "w2": P("model", None), "b2": P()}
-    rspec = jax.tree_util.tree_map(lambda _: P(), residual)
-    sharded = jax.shard_map(
-        step_fn, mesh=mesh,
-        in_specs=(pspec, rspec, P("data"), P("data")),
-        out_specs=(pspec, rspec, P()), check_vma=False)
-    train_step = jax.jit(sharded, donate_argnums=(0, 1))
-    return train_step, (params, residual, x, y), {}
+def tp_dp_step():
+    """The 2-D mesh baseline (ROADMAP item 4): GPT-2 column/row-parallel
+    attention + MLP blocks on a 2x4 ``(data, model)`` mesh — TP psums
+    over ``model`` joining row-parallel partials (fp32 activations),
+    full backward then the bucketed int8 DP grad sync over ``data``.
+    The point of the target: every rule — including the four SPMD
+    communication rules — must hold on a mesh where two collective
+    families with DIFFERENT replica-group partitions of the same 8
+    devices coexist in one program."""
+    return _tp_dp_pieces("baseline")
+
+
+def tp_dp_overlapped_step():
+    """The overlapped 2-D step (the tentpole composition): per-layer
+    segments whose backward emits each DP bucket's compressed psum
+    mid-backward, interleaving with the remaining segments' TP psums —
+    the ``overlap-serialization`` rule is the static proof obligation
+    that no DP bucket chains behind another large reduction (TP
+    activation psums sit below the threshold; see
+    docs/parallelism.md "2-D mesh composition")."""
+    return _tp_dp_pieces("overlapped")
 
 
 @functools.lru_cache(maxsize=2)
@@ -338,5 +333,6 @@ TARGETS = {
     "zero": zero_step,
     "guarded": guarded_step,
     "tp_dp": tp_dp_step,
+    "tp_dp_overlapped": tp_dp_overlapped_step,
     "serve_decode": serve_decode_step,
 }

@@ -135,32 +135,37 @@ def consolidate_zero_state(state, params, *, world, grad_compress=None,
                      ("exp_avg_sq_shard", "exp_avg_sq")):
         full[dst] = _global_flat(state[src], padded, world, src)[:n]
     if state.get("grad_residual") is not None:
-        # The EF residual is full-length and PER-RANK (each rank's own
-        # local quantization error), so the host-global carry stacks it
-        # on a leading world axis. The canonical consolidated form is
-        # the SUM over ranks — the total pending correction the replica
-        # set owes the gradients: each rank adds its residual before
-        # the psum, so only the sum is topology-invariant.
-        res = np.asarray(state["grad_residual"])
-        if res.ndim == 2:
-            if res.shape != (world, padded):
-                raise ValueError(
-                    f"grad_residual: stacked shape {res.shape}, wanted "
-                    f"({world}, {padded})")
-            res = res.sum(axis=0)
-        elif res.shape == (padded,):
-            if world != 1:
-                raise ValueError(
-                    f"grad_residual: got one ({padded},) vector for "
-                    f"world={world} — the per-rank residuals must be "
-                    f"stacked ({world}, {padded}); a single vector is "
-                    "only unambiguous at world=1")
-        else:
-            raise ValueError(
-                f"grad_residual: shape {res.shape}, wanted "
-                f"({world}, {padded}) or ({padded},) at world=1")
-        full["grad_residual"] = res[:n]
+        full["grad_residual"] = _consolidated_residual(
+            state["grad_residual"], padded, world)[:n]
     return full
+
+
+def _consolidated_residual(res, padded, world):
+    """The EF residual is full-length and PER-RANK (each rank's own
+    local quantization error), so the host-global carry stacks it on a
+    leading world axis. The canonical consolidated form is the SUM over
+    ranks — the total pending correction the replica set owes the
+    gradients: each rank adds its residual before the psum, so only the
+    sum is topology-invariant. Returns the summed ``(padded,)``
+    vector."""
+    res = np.asarray(res)
+    if res.ndim == 2:
+        if res.shape != (world, padded):
+            raise ValueError(
+                f"grad_residual: stacked shape {res.shape}, wanted "
+                f"({world}, {padded})")
+        return res.sum(axis=0)
+    if res.shape == (padded,):
+        if world != 1:
+            raise ValueError(
+                f"grad_residual: got one ({padded},) vector for "
+                f"world={world} — the per-rank residuals must be "
+                f"stacked ({world}, {padded}); a single vector is "
+                "only unambiguous at world=1")
+        return res
+    raise ValueError(
+        f"grad_residual: shape {res.shape}, wanted "
+        f"({world}, {padded}) or ({padded},) at world=1")
 
 
 def reshard_zero_state(full, params, *, world, grad_compress=None,
@@ -230,6 +235,370 @@ def reshard_zero_state(full, params, *, world, grad_compress=None,
             "will re-enter the gradients once, bounded by one "
             "quantization step)")
     return state
+
+
+# ---------------------------------------------------------------------------
+# overlap=True bucket-partitioned state: consolidation + re-sharding
+# ---------------------------------------------------------------------------
+
+def _leaf_arrays_from_flat(flat, leaves):
+    """Split a flat vector into arrays shaped like ``leaves`` (host
+    numpy; exact byte copies)."""
+    outs, off = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape))
+        outs.append(np.asarray(flat[off:off + n]).reshape(l.shape))
+        off += n
+    return outs
+
+
+def _check_bucket_layout(state, plan):
+    if not (isinstance(state, dict) and "buckets" in state):
+        raise ValueError(
+            "expected an overlap=True bucket-partitioned state "
+            "({'step', 'buckets': ...})")
+    buckets = state["buckets"]
+    if len(buckets) != len(plan) or any(
+            len(seg_state) != len(seg_plan)
+            for seg_state, seg_plan in zip(buckets, plan)):
+        raise ValueError(
+            f"bucket state layout {[len(s) for s in buckets]} does not "
+            f"match the plan {[len(s) for s in plan]} derived from the "
+            f"params — wrong params/segments, message_size, or world "
+            f"for this state")
+
+
+def consolidate_zero_overlap_state(state, params, *, world,
+                                   grad_compress=None,
+                                   param_compress=None,
+                                   block_size=compression.BLOCK_SIZE,
+                                   message_size=10000000,
+                                   optimizer="zero"):
+    """Host-side: an ``overlap=True`` bucket-partitioned ZeRO state ->
+    the SAME full, unpadded format-1 state_dict
+    :func:`consolidate_zero_state` produces — so a checkpoint written
+    by an overlapped run re-partitions onto any topology (and into
+    either step mode) through the one canonical form.
+
+    ``state["buckets"][k][bi]`` leaves follow the host-global carry
+    idiom per bucket: each ``*_shard`` the ``(bucket.padded,)``
+    concatenation over ranks (or a ``(world, padded // world)`` stack),
+    the EF residual the per-rank ``(world, bucket.padded)`` stack
+    consolidated by SUM. The bucket plan is recomputed from ``params``
+    (may be a list of per-segment pytrees) + ``world`` +
+    ``message_size`` — deterministic host math, validated against the
+    state's layout. Bit-exact: values are copied, never re-rounded."""
+    segs, _ = _as_segments(params)
+    plan = plan_zero_overlap(segs, world=world,
+                             grad_compress=grad_compress,
+                             param_compress=param_compress,
+                             block_size=block_size,
+                             message_size=message_size)
+    _check_bucket_layout(state, plan)
+    n = _flat_size(params)
+    slots = {key: [] for key in ("master", "exp_avg", "exp_avg_sq",
+                                 "grad_residual")}
+    has_residual = False
+    for k, (params_k, seg_plan) in enumerate(zip(segs, plan)):
+        leaves = jax.tree_util.tree_leaves(params_k)
+        seg_slots = {key: [None] * len(leaves) for key in slots}
+        for bi, bucket in enumerate(seg_plan):
+            bst = state["buckets"][k][bi]
+            b_leaves = [leaves[i] for i in bucket.leaf_idx]
+            for src, dst in (("master_shard", "master"),
+                             ("exp_avg_shard", "exp_avg"),
+                             ("exp_avg_sq_shard", "exp_avg_sq")):
+                flat = _global_flat(bst[src], bucket.padded, world,
+                                    f"buckets[{k}][{bi}].{src}")
+                for i, piece in zip(bucket.leaf_idx,
+                                    _leaf_arrays_from_flat(
+                                        flat[:bucket.n], b_leaves)):
+                    seg_slots[dst][i] = piece
+            if bst.get("grad_residual") is not None:
+                has_residual = True
+                res = _consolidated_residual(
+                    bst["grad_residual"], bucket.padded, world)
+                for i, piece in zip(bucket.leaf_idx,
+                                    _leaf_arrays_from_flat(
+                                        res[:bucket.n], b_leaves)):
+                    seg_slots["grad_residual"][i] = piece
+        for key in slots:
+            slots[key].extend(seg_slots[key])
+    full = {
+        "format": 1,
+        "optimizer": optimizer,
+        "world": int(world),
+        "n_elements": n,
+        "block_size": int(block_size),
+        "grad_compress": grad_compress,
+        "param_compress": param_compress,
+        "step": np.asarray(state["step"], np.int32).reshape(()),
+    }
+    for key in ("master", "exp_avg", "exp_avg_sq"):
+        full[key] = np.concatenate(
+            [p.reshape(-1) for p in slots[key]])
+    if has_residual:
+        full["grad_residual"] = np.concatenate(
+            [p.reshape(-1) for p in slots["grad_residual"]])
+    return full
+
+
+def reshard_zero_overlap_state(full, params, *, world,
+                               grad_compress=None, param_compress=None,
+                               block_size=compression.BLOCK_SIZE,
+                               message_size=10000000):
+    """Host-side inverse: one full format-1 state_dict (written by
+    EITHER step mode, at any world) -> the ``overlap=True``
+    bucket-partitioned state for a ``world``-way mesh, every bucket
+    independently re-padded (int8 block alignment included). Each
+    bucket's ``*_shard`` leaves come back as the ``(padded,)``
+    concatenation — the ``in_specs=P(axis)`` feed layout — and its EF
+    residual as the ``(world, padded)`` stack with rank 0 carrying the
+    whole summed correction (same invariant as
+    :func:`reshard_zero_state`)."""
+    segs, _ = _as_segments(params)
+    plan = plan_zero_overlap(segs, world=world,
+                             grad_compress=grad_compress,
+                             param_compress=param_compress,
+                             block_size=block_size,
+                             message_size=message_size)
+    n = _flat_size(params)
+    if full.get("n_elements") not in (None, n):
+        raise ValueError(
+            f"state_dict is for {full['n_elements']} elements, params "
+            f"flatten to {n} — wrong model for this checkpoint")
+    stateful = compression.needs_residual(grad_compress)
+    written_residual = full.get("grad_residual")
+    if written_residual is not None and not stateful:
+        warnings.warn(
+            "reshard_zero_overlap_state: the checkpoint carries an "
+            "int8 error-feedback residual but the target optimizer is "
+            "not compressed — dropping the residual (its error will "
+            "re-enter the gradients once, bounded by one quantization "
+            "step)")
+    off = 0
+    flats = {}
+    for key in ("master", "exp_avg", "exp_avg_sq"):
+        v = np.asarray(full[key], np.float32)
+        if v.shape != (n,):
+            raise ValueError(f"full state buffer {key} has shape "
+                             f"{v.shape}, wanted ({n},)")
+        flats[key] = v
+    if stateful:
+        flats["grad_residual"] = (
+            np.asarray(written_residual, np.float32)
+            if written_residual is not None
+            else np.zeros((n,), np.float32))
+    buckets = []
+    for params_k, seg_plan in zip(segs, plan):
+        leaves = jax.tree_util.tree_leaves(params_k)
+        sizes = [int(np.prod(l.shape)) for l in leaves]
+        starts = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
+        seg_states = []
+        for bucket in seg_plan:
+            bst = {}
+            for src, key in (("master_shard", "master"),
+                             ("exp_avg_shard", "exp_avg"),
+                             ("exp_avg_sq_shard", "exp_avg_sq")):
+                flat = np.concatenate(
+                    [flats[key][off + starts[i]:off + starts[i + 1]]
+                     for i in bucket.leaf_idx])
+                bst[src] = jnp.asarray(
+                    np.pad(flat, (0, bucket.padded - bucket.n)))
+            if stateful:
+                flat = np.concatenate(
+                    [flats["grad_residual"]
+                     [off + starts[i]:off + starts[i + 1]]
+                     for i in bucket.leaf_idx])
+                rows = np.zeros((world, bucket.padded), np.float32)
+                rows[0, :bucket.n] = flat
+                bst["grad_residual"] = jnp.asarray(rows)
+            seg_states.append(bst)
+        buckets.append(tuple(seg_states))
+        off += int(sum(sizes))
+    return {"step": jnp.asarray(np.asarray(full["step"], np.int32)
+                                .reshape(())),
+            "buckets": tuple(buckets)}
+
+
+# ---------------------------------------------------------------------------
+# 2-D (data, model) topologies: the shard table gains the TP dimension
+# ---------------------------------------------------------------------------
+
+def _partition_dim_leaves(params, partition_dims):
+    """Per-leaf partition dims aligned with ``params``' flattened
+    leaves (``None`` = replicated over the model axis). The dims tree
+    may use ``None`` values, so it is flattened AGAINST the params
+    treedef rather than on its own."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    dims = treedef.flatten_up_to(partition_dims)
+    for leaf, dim in zip(leaves, dims):
+        if dim is not None and not (
+                isinstance(dim, int) and 0 <= dim < len(leaf.shape)):
+            raise ValueError(
+                f"partition dim {dim!r} invalid for a leaf of shape "
+                f"{leaf.shape}")
+    return leaves, treedef, dims
+
+
+def split_params_for_model_axis(params, partition_dims, tp_world):
+    """FULL param tree -> list (len ``tp_world``) of per-model-rank
+    LOCAL trees, each leaf sliced along its partition dim (replicated
+    leaves shared). The host-side view of what ``shard_map`` hands
+    each model rank."""
+    leaves, treedef, dims = _partition_dim_leaves(params, partition_dims)
+    per_rank = []
+    for t in range(tp_world):
+        local = []
+        for leaf, dim in zip(leaves, dims):
+            if dim is None:
+                local.append(np.asarray(leaf))
+                continue
+            a = np.asarray(leaf)
+            if a.shape[dim] % tp_world:
+                raise ValueError(
+                    f"leaf dim {dim} of shape {a.shape} does not split "
+                    f"{tp_world} ways over the model axis")
+            local.append(np.split(a, tp_world, axis=dim)[t])
+        per_rank.append(jax.tree_util.tree_unflatten(treedef, local))
+    return per_rank
+
+
+def consolidate_zero_state_2d(states, params, partition_dims, *,
+                              dp_world, tp_world, grad_compress=None,
+                              param_compress=None,
+                              block_size=compression.BLOCK_SIZE,
+                              message_size=10000000, optimizer="zero"):
+    """Host-side: per-``(data, model)``-coordinate ZeRO shards -> one
+    full 2-D state_dict in the FULL (TP-unsharded) parameter domain —
+    the topology-invariant canonical form an elastic 2x4 -> 2x2 -> 2x4
+    reshard round-trips through bit-identically.
+
+    ``states`` is a list (len ``tp_world``, model-rank order) of the
+    per-model-rank host-global 1-D states — each either the monolithic
+    ``*_shard`` layout or the ``overlap=True`` bucket layout, each
+    consolidated over its OWN dp replica set first. ``params`` is the
+    FULL param tree (or list of segments) and ``partition_dims`` the
+    matching tree of model-axis partition dims (``None`` = replicated
+    — e.g. :func:`apex_tpu.parallel.mesh2d.gpt2_partition_dims`).
+
+    Merging over the model axis: split leaves concatenate their local
+    slices along the partition dim; replicated leaves must be
+    BIT-IDENTICAL across model ranks (their grads — and hence masters,
+    moments, and EF residuals — are model-invariant by construction on
+    a correct 2-D program; a mismatch means the program diverged and
+    raises rather than silently averaging)."""
+    if len(states) != tp_world:
+        raise ValueError(f"got {len(states)} per-model-rank states for "
+                         f"tp_world={tp_world}")
+    local_params = split_params_for_model_axis(params, partition_dims,
+                                               tp_world)
+    fulls = []
+    for t, st in enumerate(states):
+        kw = dict(world=dp_world, grad_compress=grad_compress,
+                  param_compress=param_compress, block_size=block_size,
+                  optimizer=optimizer)
+        if isinstance(st, dict) and "buckets" in st:
+            fulls.append(consolidate_zero_overlap_state(
+                st, local_params[t], message_size=message_size, **kw))
+        else:
+            fulls.append(consolidate_zero_state(st, local_params[t],
+                                                **kw))
+    steps = {int(np.asarray(f["step"])) for f in fulls}
+    if len(steps) != 1:
+        raise ValueError(f"model ranks disagree on the step: {steps} — "
+                         "states from different checkpoints?")
+    leaves, treedef, dims = _partition_dim_leaves(params, partition_dims)
+    full = {
+        "format": 2,
+        "optimizer": optimizer,
+        "dp_world": int(dp_world),
+        "tp_world": int(tp_world),
+        "n_elements": _flat_size(params),
+        "block_size": int(block_size),
+        "grad_compress": grad_compress,
+        "param_compress": param_compress,
+        "step": fulls[0]["step"],
+    }
+    keys = ["master", "exp_avg", "exp_avg_sq"]
+    if all("grad_residual" in f for f in fulls):
+        keys.append("grad_residual")
+    for key in keys:
+        per_rank_leaves = []
+        for t in range(tp_world):
+            local_leaves = jax.tree_util.tree_leaves(local_params[t])
+            per_rank_leaves.append(_leaf_arrays_from_flat(
+                np.asarray(fulls[t][key], np.float32), local_leaves))
+        merged = []
+        for li, (leaf, dim) in enumerate(zip(leaves, dims)):
+            pieces = [per_rank_leaves[t][li] for t in range(tp_world)]
+            if dim is None:
+                for t in range(1, tp_world):
+                    if not np.array_equal(pieces[0], pieces[t]):
+                        raise ValueError(
+                            f"{key}: replicated leaf {li} differs "
+                            f"between model ranks 0 and {t} — the 2-D "
+                            f"program's model-invariance broke; "
+                            f"refusing to consolidate")
+                merged.append(pieces[0])
+            else:
+                merged.append(np.concatenate(pieces, axis=dim))
+        full[key] = np.concatenate([p.reshape(-1) for p in merged])
+    return full
+
+
+def reshard_zero_state_2d(full, params, partition_dims, *, dp_world,
+                          tp_world, grad_compress=None,
+                          param_compress=None,
+                          block_size=compression.BLOCK_SIZE,
+                          message_size=10000000, overlap=False):
+    """Host-side inverse of :func:`consolidate_zero_state_2d`: one full
+    2-D state_dict -> the list (len ``tp_world``) of per-model-rank
+    1-D states for a NEW ``(dp_world, tp_world)`` topology — monolithic
+    ``*_shard`` layout, or bucket-partitioned when ``overlap=True``.
+    Both the TP slicing and the dp-shard padding are recomputed for the
+    new topology; master/moment values restore bit-identically, the EF
+    residual re-enters as each model column's dp-rank-0 carry (the
+    dp-summed, model-merged total — topology-invariant to the bit)."""
+    if full.get("format") not in (1, 2):
+        raise ValueError(f"unknown state_dict format "
+                         f"{full.get('format')!r}")
+    n = _flat_size(params)
+    if full.get("n_elements") not in (None, n):
+        raise ValueError(
+            f"state_dict is for {full['n_elements']} elements, params "
+            f"flatten to {n} — wrong model for this checkpoint")
+    leaves, treedef, dims = _partition_dim_leaves(params, partition_dims)
+    local_params = split_params_for_model_axis(params, partition_dims,
+                                               tp_world)
+    keys = ["master", "exp_avg", "exp_avg_sq"]
+    if full.get("grad_residual") is not None:
+        keys.append("grad_residual")
+    # full flat (whole-model leaf order) -> per-leaf arrays, sliced per
+    # new model rank, re-flattened in local leaf order
+    states = []
+    for t in range(tp_world):
+        sub = {"format": 1, "optimizer": full.get("optimizer"),
+               "n_elements": _flat_size(local_params[t]),
+               "step": full["step"]}
+        for key in keys:
+            full_leaves = _leaf_arrays_from_flat(
+                np.asarray(full[key], np.float32), leaves)
+            local = []
+            for leaf_arr, dim in zip(full_leaves, dims):
+                local.append(
+                    leaf_arr if dim is None
+                    else np.split(leaf_arr, tp_world, axis=dim)[t])
+            sub[key] = np.concatenate([p.reshape(-1) for p in local])
+        kw = dict(world=dp_world, grad_compress=grad_compress,
+                  param_compress=param_compress, block_size=block_size)
+        if overlap:
+            states.append(reshard_zero_overlap_state(
+                sub, local_params[t], message_size=message_size, **kw))
+        else:
+            states.append(reshard_zero_state(sub, local_params[t],
+                                             **kw))
+    return states
 
 
 def zero_state_bytes(params, *, world, grad_compress=None,
@@ -454,7 +823,8 @@ class DistributedFusedAdam:
             if self.grad_compress is None:
                 _telemetry_comm.record_collective(
                     "psum_scatter", elements=flat_g.size,
-                    dtype=flat_g.dtype, world=world)
+                    dtype=flat_g.dtype, axis_name=self.axis_name,
+                    world=world)
                 g_shard = lax.psum_scatter(flat_g, self.axis_name,
                                            tiled=True)
                 return g_shard / world, None
@@ -597,44 +967,92 @@ class DistributedFusedAdam:
         """The writing-topology record for
         ``checkpoint.save_training_state(topology=...)`` — what
         :meth:`load_state_dict_resharded` needs to re-partition this
-        state onto a different world size."""
-        return {"optimizer": type(self).__name__, "world": int(world),
+        state onto a different world size. ``world`` is the dp replica
+        count, or a ``(dp, tp)`` pair for a 2-D ``(data, model)``
+        mesh."""
+        if isinstance(world, (tuple, list)):
+            world = [int(w) for w in world]
+        else:
+            world = int(world)
+        return {"optimizer": type(self).__name__, "world": world,
                 "axis_name": str(self.axis_name),
                 "grad_compress": self.grad_compress,
                 "param_compress": self.param_compress,
                 "block_size": int(self.compress_block_size)}
 
-    def state_dict_full(self, state, params, *, world):
-        """Host-side: the run's ZeRO state (each ``*_shard`` leaf the
-        ``(padded,)`` concatenation of the per-rank shards — the
-        ``out_specs=P(axis)`` carry idiom — or a ``(world, shard)``
-        stack) -> one full UNPADDED state_dict that
-        :meth:`load_state_dict_resharded` can re-partition onto any
-        world size. ``world`` is explicit because the axis is unbound
-        on the host. See :func:`consolidate_zero_state`."""
-        if isinstance(state, dict) and "buckets" in state:
-            raise NotImplementedError(
-                "state_dict_full: elastic re-sharding is not supported "
-                "for the overlap=True bucket-partitioned state; "
-                "checkpoint with overlap=False (same training "
-                "semantics) when a topology change is expected")
-        return consolidate_zero_state(
-            state, params, world=world, grad_compress=self.grad_compress,
-            param_compress=self.param_compress,
-            block_size=self.compress_block_size,
-            optimizer=type(self).__name__)
+    def state_dict_full(self, state, params, *, world,
+                        partition_dims=None):
+        """Host-side: the run's ZeRO state -> one full UNPADDED
+        state_dict that :meth:`load_state_dict_resharded` can
+        re-partition onto any topology. ``world`` is explicit because
+        the axis is unbound on the host.
 
-    def load_state_dict_resharded(self, full, params, *, world):
+        Three layouts are understood:
+
+        - monolithic (``world`` an int): each ``*_shard`` leaf the
+          ``(padded,)`` concatenation of the per-rank shards — the
+          ``out_specs=P(axis)`` carry idiom — or a ``(world, shard)``
+          stack (:func:`consolidate_zero_state`);
+        - ``overlap=True`` bucket-partitioned state (detected by its
+          ``"buckets"`` key): consolidated bucket-by-bucket into the
+          SAME format-1 dict (:func:`consolidate_zero_overlap_state`);
+        - 2-D ``(data, model)`` (``world`` a ``(dp, tp)`` pair):
+          ``state`` is a LIST of per-model-rank states (either layout)
+          and ``partition_dims`` names each leaf's model-axis split dim
+          (:func:`consolidate_zero_state_2d`).
+        """
+        kw = dict(grad_compress=self.grad_compress,
+                  param_compress=self.param_compress,
+                  block_size=self.compress_block_size,
+                  optimizer=type(self).__name__)
+        if isinstance(world, (tuple, list)):
+            dp, tp = world
+            if partition_dims is None:
+                raise ValueError(
+                    "state_dict_full: a 2-D world needs partition_dims "
+                    "(the per-leaf model-axis split table)")
+            return consolidate_zero_state_2d(
+                state, params, partition_dims, dp_world=dp, tp_world=tp,
+                message_size=self.message_size, **kw)
+        if isinstance(state, dict) and "buckets" in state:
+            return consolidate_zero_overlap_state(
+                state, params, world=world,
+                message_size=self.message_size, **kw)
+        return consolidate_zero_state(state, params, world=world, **kw)
+
+    def load_state_dict_resharded(self, full, params, *, world,
+                                  partition_dims=None):
         """Host-side: a :meth:`state_dict_full` dict (written at ANY
-        world size) -> this optimizer's state re-partitioned for a
-        ``world``-way mesh, shard padding recomputed (int8 block
-        alignment included). fp32 masters/moments and the EF residual
-        restore bit-exactly; only the zero pad tail changes length.
-        See :func:`reshard_zero_state`."""
-        return reshard_zero_state(
-            full, params, world=world, grad_compress=self.grad_compress,
-            param_compress=self.param_compress,
-            block_size=self.compress_block_size)
+        topology, by either step mode) -> this optimizer's state
+        re-partitioned for the target topology, shard padding
+        recomputed (int8 block alignment included). fp32
+        masters/moments and the EF residual restore bit-exactly; only
+        the zero pad tail changes length. ``world`` an int restores the
+        1-D layout (bucket-partitioned iff this optimizer runs
+        ``overlap=True``); a ``(dp, tp)`` pair restores the list of
+        per-model-rank states for a 2-D mesh (``partition_dims``
+        required). See :func:`reshard_zero_state`,
+        :func:`reshard_zero_overlap_state`,
+        :func:`reshard_zero_state_2d`."""
+        kw = dict(grad_compress=self.grad_compress,
+                  param_compress=self.param_compress,
+                  block_size=self.compress_block_size)
+        if isinstance(world, (tuple, list)):
+            dp, tp = world
+            if partition_dims is None:
+                raise ValueError(
+                    "load_state_dict_resharded: a 2-D world needs "
+                    "partition_dims (the per-leaf model-axis split "
+                    "table)")
+            return reshard_zero_state_2d(
+                full, params, partition_dims, dp_world=dp, tp_world=tp,
+                message_size=self.message_size,
+                overlap=bool(self.overlap), **kw)
+        if self.overlap:
+            return reshard_zero_overlap_state(
+                full, params, world=world,
+                message_size=self.message_size, **kw)
+        return reshard_zero_state(full, params, world=world, **kw)
 
     def _shard_info(self, params):
         n = _flat_size(params)
@@ -686,7 +1104,8 @@ class DistributedFusedAdam:
                 # pipeline); compressed paths record their own bytes
                 _telemetry_comm.record_collective(
                     "psum_scatter", elements=flat_g.size,
-                    dtype=flat_g.dtype, world=world)
+                    dtype=flat_g.dtype, axis_name=self.axis_name,
+                    world=world)
                 g_shard = lax.psum_scatter(flat_g, self.axis_name,
                                            tiled=True)
                 return g_shard / world, None
@@ -704,7 +1123,7 @@ class DistributedFusedAdam:
             if self.param_compress is None:
                 _telemetry_comm.record_collective(
                     "all_gather", elements=p_new.size, dtype=p_new.dtype,
-                    world=world)
+                    axis_name=self.axis_name, world=world)
                 return lax.all_gather(p_new, self.axis_name, tiled=True)
             return compression.all_gather_compressed(
                 p_new, self.axis_name, mode=self.param_compress,

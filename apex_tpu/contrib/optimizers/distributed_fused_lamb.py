@@ -20,12 +20,9 @@ from jax import lax
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     DistributedFusedAdam as _Adam,
     _as_segments,
-    _flat_size,
     _flatten_f32,
     _padded_size,
     _unflatten_like,
-    consolidate_zero_state,
-    reshard_zero_state,
     zero_state_bytes,
 )
 from apex_tpu.parallel import compression
@@ -109,7 +106,8 @@ class DistributedFusedLAMB:
             if self.grad_compress is None:
                 _telemetry_comm.record_collective(
                     "psum_scatter", elements=flat_g.size,
-                    dtype=flat_g.dtype, world=world)
+                    dtype=flat_g.dtype, axis_name=self.axis_name,
+                    world=world)
                 g_shard = lax.psum_scatter(flat_g, self.axis_name,
                                            tiled=True)
                 residual = None
@@ -213,7 +211,8 @@ class DistributedFusedLAMB:
                 if self.param_compress is None:
                     _telemetry_comm.record_collective(
                         "all_gather", elements=p_new.size,
-                        dtype=p_new.dtype, world=world)
+                        dtype=p_new.dtype, axis_name=self.axis_name,
+                        world=world)
                     flat_p = lax.all_gather(p_new, self.axis_name,
                                             tiled=True)
                 else:
@@ -316,34 +315,12 @@ class DistributedFusedLAMB:
     # (master/moment shards + optional full-length EF residual), so the
     # same consolidate/reshard math applies verbatim
 
-    def topology(self, world):
-        """See :meth:`DistributedFusedAdam.topology`."""
-        return {"optimizer": type(self).__name__, "world": int(world),
-                "axis_name": str(self.axis_name),
-                "grad_compress": self.grad_compress,
-                "param_compress": self.param_compress,
-                "block_size": int(self.compress_block_size)}
-
-    def state_dict_full(self, state, params, *, world):
-        """See :meth:`DistributedFusedAdam.state_dict_full`."""
-        if isinstance(state, dict) and "buckets" in state:
-            raise NotImplementedError(
-                "state_dict_full: elastic re-sharding is not supported "
-                "for the overlap=True bucket-partitioned state; "
-                "checkpoint with overlap=False (same training "
-                "semantics) when a topology change is expected")
-        return consolidate_zero_state(
-            state, params, world=world, grad_compress=self.grad_compress,
-            param_compress=self.param_compress,
-            block_size=self.compress_block_size,
-            optimizer=type(self).__name__)
-
-    def load_state_dict_resharded(self, full, params, *, world):
-        """See :meth:`DistributedFusedAdam.load_state_dict_resharded`."""
-        return reshard_zero_state(
-            full, params, world=world, grad_compress=self.grad_compress,
-            param_compress=self.param_compress,
-            block_size=self.compress_block_size)
+    # topology / consolidation / re-sharding dispatch shared verbatim
+    # with DistributedFusedAdam (same flat + bucket + 2-D layouts;
+    # ``type(self).__name__`` stamps the right optimizer name)
+    topology = _Adam.topology
+    state_dict_full = _Adam.state_dict_full
+    load_state_dict_resharded = _Adam.load_state_dict_resharded
 
     def _layout(self, params):
         leaves = jax.tree_util.tree_leaves(params)
@@ -419,7 +396,8 @@ class DistributedFusedLAMB:
                 if self.grad_compress is None:
                     _telemetry_comm.record_collective(
                         "psum_scatter", elements=flat_g.size,
-                        dtype=flat_g.dtype, world=world)
+                        dtype=flat_g.dtype, axis_name=self.axis_name,
+                        world=world)
                     g_shard = lax.psum_scatter(flat_g, self.axis_name,
                                                tiled=True)
                 else:
@@ -481,7 +459,8 @@ class DistributedFusedLAMB:
                 if self.param_compress is None:
                     _telemetry_comm.record_collective(
                         "all_gather", elements=p_new.size,
-                        dtype=p_new.dtype, world=world)
+                        dtype=p_new.dtype, axis_name=self.axis_name,
+                        world=world)
                     flat_p = lax.all_gather(p_new, self.axis_name,
                                             tiled=True)
                 else:

@@ -24,6 +24,7 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     plan_buckets,
     unflatten,
 )
+from apex_tpu.parallel import mesh2d  # noqa: F401
 from apex_tpu.parallel import multiproc  # noqa: F401
 from apex_tpu.parallel import overlap  # noqa: F401
 from apex_tpu.parallel.overlap import (  # noqa: F401

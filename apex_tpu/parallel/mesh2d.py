@@ -1,0 +1,470 @@
+"""2-D ``(data, model)`` mesh composition — the substrate under TP x DP.
+
+Why: every production mechanism in this repo — int8/int4 compressed
+collectives with error feedback, the overlapped per-bucket step, the
+guard, numerics, the supervisor, elastic ZeRO — grew up on a 1-D data
+mesh, while the Megatron-style ``apex.transformer`` trees pass their
+parity tests in isolation. This module is their composition (ROADMAP
+item 4): a GPT-2-shaped transformer expressed with column/row-parallel
+shards over the ``model`` axis of a named 2-D mesh (the GSPMD pattern
+of arXiv 2004.13336's weight-update sharding scoped to the DP axis),
+trained with the SAME ``DistributedDataParallel`` /
+``OverlappedDataParallel`` / ZeRO machinery — gradient compression and
+EF residuals scoped to the ``data`` axis only, TP activation psums
+staying full precision.
+
+Axis-scoping rules (docs/parallelism.md "2-D mesh composition"):
+
+- **TP collectives move activations** (the ``copy_to`` backward psum of
+  dx, the ``reduce_from`` forward psum of row-parallel partials) and
+  stay fp32/bf16 — quantizing them would inject error into the forward
+  value itself, not into a gradient that error feedback can absorb.
+- **DP collectives move gradients** and compress: ``axis_name="data"``
+  threads through ``psum_compressed*`` so the per-block scale pmax and
+  the int8/int4 payload psum reduce over the ``data`` axis only. Each
+  ``(data, model)`` coordinate keeps its OWN error-feedback residual
+  (split params have per-model-rank grads; replicated params carry
+  model-identical grads, so their residuals stay model-identical too —
+  the invariant the 2-D ZeRO consolidation verifies).
+- **Overlap legality**: per-bucket DP psums must not chain behind one
+  another (the ``overlap-serialization`` rule, with
+  ``overlap_min_bytes`` set between the TP activation-psum payload and
+  the per-bucket gradient payload — the regime where the rule separates
+  the inherent backward-chain TP psums from an actual bucket
+  serialization bug).
+
+The TP math is the ``tensor_parallel.mappings`` region ops themselves
+(``copy_to_tensor_model_parallel_region`` /
+``reduce_from_tensor_model_parallel_region`` bound to the ``model``
+axis) — same custom-vjp collectives the Megatron layer tree uses, so
+the lint targets exercise the real forward/backward pairing, not a
+reimplementation.
+
+Everything here runs inside ``jax.shard_map`` over a ``Mesh`` built by
+:func:`mesh_2d`; parameters live as FULL host arrays placed with
+``NamedSharding`` over :func:`gpt2_pspecs` (split leaves sharded over
+``model``, everything replicated over ``data``), and shard_map's
+in_specs hand each device its local shard.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region as _copy_to,
+    reduce_from_tensor_model_parallel_region as _reduce_from,
+)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+# leaf-name -> (partition dim of the FULL array, PartitionSpec) —
+# column-parallel weights split their output dim, their biases ride
+# along; row-parallel weights split their input dim and their bias is
+# added AFTER the psum (replicated). Everything else replicates.
+_COL_W = frozenset({"wq", "wk", "wv", "wi"})
+_COL_B = frozenset({"bq", "bk", "bv", "bi"})
+_ROW_W = frozenset({"wo"})
+
+
+def mesh_2d(data=2, model=None, devices=None):
+    """The named 2-D ``(data, model)`` mesh: ``data`` rows of ``model``
+    columns over the first ``data * model`` devices (default: all of
+    them, ``model = len(devices) // data``)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if model is None:
+        if len(devices) % data != 0:
+            raise ValueError(
+                f"mesh_2d: {len(devices)} devices do not split into "
+                f"data={data} rows")
+        model = len(devices) // data
+    need = data * model
+    if len(devices) < need:
+        raise ValueError(f"mesh_2d: need {need} devices "
+                         f"(data={data} x model={model}), have "
+                         f"{len(devices)}")
+    return Mesh(np.asarray(devices[:need]).reshape(data, model),
+                (DATA_AXIS, MODEL_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 params: a stack of pre-LN transformer blocks, segment-shaped
+# ---------------------------------------------------------------------------
+
+def gpt2_init(hidden=64, layers=2, heads=4, vocab=64, max_seq=32, *,
+              bias=True, seed=0):
+    """FULL (unsharded) GPT-2-style params as a tuple of per-layer
+    SEGMENT dicts — the container every step mode consumes: segment 0
+    carries the (replicated) embedding tables, the last segment the
+    final layer norm and the untied LM head. Leaves are fp32; column
+    dims must divide by the mesh's ``model`` size."""
+    if hidden % heads:
+        raise ValueError(f"hidden {hidden} not divisible by heads {heads}")
+    rng = np.random.RandomState(seed)
+
+    def w(*shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * scale)
+
+    def layer():
+        p = {
+            "ln1": {"g": jnp.ones((hidden,), jnp.float32),
+                    "b": jnp.zeros((hidden,), jnp.float32)},
+            "attn": {"wq": w(hidden, hidden), "wk": w(hidden, hidden),
+                     "wv": w(hidden, hidden), "wo": w(hidden, hidden)},
+            "ln2": {"g": jnp.ones((hidden,), jnp.float32),
+                    "b": jnp.zeros((hidden,), jnp.float32)},
+            "mlp": {"wi": w(hidden, 4 * hidden),
+                    "wo": w(4 * hidden, hidden)},
+        }
+        if bias:
+            for name, width in (("bq", hidden), ("bk", hidden),
+                                ("bv", hidden), ("bo", hidden)):
+                p["attn"][name] = jnp.zeros((width,), jnp.float32)
+            p["mlp"]["bi"] = jnp.zeros((4 * hidden,), jnp.float32)
+            p["mlp"]["bo"] = jnp.zeros((hidden,), jnp.float32)
+        return p
+
+    segments = []
+    for i in range(layers):
+        seg = {"layer": layer()}
+        if i == 0:
+            seg["embed"] = {"wte": w(vocab, hidden, scale=0.02),
+                            "wpe": w(max_seq, hidden, scale=0.02)}
+        if i == layers - 1:
+            seg["ln_f"] = {"g": jnp.ones((hidden,), jnp.float32),
+                           "b": jnp.zeros((hidden,), jnp.float32)}
+            seg["head"] = {"w": w(hidden, vocab)}
+        segments.append(seg)
+    return tuple(segments)
+
+
+def _leaf_name(path):
+    return str(getattr(path[-1], "key", path[-1]))
+
+
+def gpt2_partition_dims(seg_params):
+    """Pytree (matching ``seg_params``) of the dim each leaf splits
+    over the ``model`` axis — ``None`` for replicated leaves. The shard
+    table the 2-D ZeRO consolidation
+    (:func:`~apex_tpu.contrib.optimizers.distributed_fused_adam.
+    consolidate_zero_state_2d`) re-partitions along."""
+
+    def dim(path, leaf):
+        name = _leaf_name(path)
+        if name in _COL_W:
+            return 1
+        if name in _COL_B:
+            return 0
+        if name in _ROW_W:
+            return 0
+        return None
+
+    return jax.tree_util.tree_map_with_path(dim, seg_params)
+
+
+def gpt2_pspecs(seg_params):
+    """Pytree of ``PartitionSpec`` placing every leaf on the 2-D mesh:
+    split leaves shard their partition dim over ``model``; everything
+    is replicated over ``data`` (gradients sync there instead)."""
+
+    def spec(path, leaf):
+        name = _leaf_name(path)
+        if name in _COL_W:
+            return P(None, MODEL_AXIS)
+        if name in _COL_B:
+            return P(MODEL_AXIS)
+        if name in _ROW_W:
+            # NO trailing None: jit normalizes P("model", None) to
+            # P("model") on outputs, and the signature mismatch would
+            # cost a second compile on the first carry feedback
+            return P(MODEL_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, seg_params)
+
+
+def local_template(seg_params, tp):
+    """Zeros shaped like each leaf's LOCAL (per-model-rank) shard — what
+    ``init_residual`` needs to size the DP error-feedback state on the
+    2-D mesh."""
+    dims = gpt2_partition_dims(seg_params)
+
+    def shrink(leaf, dim):
+        if dim is None:
+            return jnp.zeros(leaf.shape, leaf.dtype)
+        if leaf.shape[dim] % tp:
+            raise ValueError(
+                f"leaf dim {dim} of shape {leaf.shape} does not split "
+                f"{tp} ways over '{MODEL_AXIS}'")
+        shape = list(leaf.shape)
+        shape[dim] //= tp
+        return jnp.zeros(tuple(shape), leaf.dtype)
+
+    return jax.tree_util.tree_map(shrink, seg_params, dims)
+
+
+# ---------------------------------------------------------------------------
+# the forward math (runs on LOCAL shards inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _ln(p, x, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * p["g"] + p["b"]
+
+
+def _attn(p, x, head_dim):
+    """Column-parallel QKV (local heads) -> causal attention ->
+    row-parallel output projection joined by ONE TP psum."""
+    xp = _copy_to(x, MODEL_AXIS)       # identity fwd / psum(dx) bwd
+    q = xp @ p["wq"] + p.get("bq", 0.0)
+    k = xp @ p["wk"] + p.get("bk", 0.0)
+    v = xp @ p["wv"] + p.get("bv", 0.0)
+    b, s, local = q.shape
+    nh = local // head_dim
+    q = q.reshape(b, s, nh, head_dim)
+    k = k.reshape(b, s, nh, head_dim)
+    v = v.reshape(b, s, nh, head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+    causal = np.tril(np.ones((s, s), np.bool_))
+    scores = jnp.where(causal, scores, -1e9)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
+                     v).reshape(b, s, local)
+    partial = ctx @ p["wo"]            # [.., h/tp] @ [h/tp, h]
+    out = _reduce_from(partial, MODEL_AXIS)   # psum fwd / identity bwd
+    return out + p.get("bo", 0.0)
+
+
+def _mlp(p, x):
+    xp = _copy_to(x, MODEL_AXIS)
+    h = jax.nn.gelu(xp @ p["wi"] + p.get("bi", 0.0))
+    out = _reduce_from(h @ p["wo"], MODEL_AXIS)
+    return out + p.get("bo", 0.0)
+
+
+def _block(p, x, head_dim):
+    x = x + _attn(p["attn"], _ln(p["ln1"], x), head_dim)
+    x = x + _mlp(p["mlp"], _ln(p["ln2"], x))
+    return x
+
+
+def _xent(logits, labels):
+    ls = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(ls, labels[..., None], axis=-1)
+    return -jnp.mean(picked)
+
+
+def gpt2_segments(labels, layers, head_dim, *, poison=None):
+    """The per-layer segment chain ``segments[k](params_k, carry) ->
+    carry`` for :class:`~apex_tpu.parallel.overlap.
+    OverlappedDataParallel`: segment 0 embeds the token batch, the last
+    segment closes over ``labels`` and returns the scalar loss.
+    ``poison`` (an additive scalar, e.g. ``faults.inject_nan`` output)
+    enters at the embedding output so a NaN reaches every gradient."""
+
+    def seg0(p, tokens):
+        emb = p["embed"]
+        x = emb["wte"][tokens] + emb["wpe"][:tokens.shape[1]]
+        if poison is not None:
+            x = x + poison
+        return _block(p["layer"], x, head_dim)
+
+    def seg_mid(p, x):
+        return _block(p["layer"], x, head_dim)
+
+    def seg_last(p, x):
+        if "layer" in p:
+            x = _block(p["layer"], x, head_dim)
+        x = _ln(p["ln_f"], x)
+        return _xent(x @ p["head"]["w"], labels)
+
+    if layers == 1:
+        # segment 0 both embeds and closes the loss
+        def only(p, tokens):
+            x = seg0({"embed": p["embed"], "layer": p["layer"]}, tokens)
+            x = _ln(p["ln_f"], x)
+            return _xent(x @ p["head"]["w"], labels)
+
+        return [only]
+    return ([seg0] + [seg_mid] * (layers - 2) + [seg_last])
+
+
+def gpt2_loss(seg_params, tokens, labels, head_dim, *, poison=None):
+    """The whole-model loss (the un-segmented view the baseline step
+    differentiates): run the segment chain sequentially."""
+    segs = gpt2_segments(labels, len(seg_params), head_dim,
+                         poison=poison)
+    carry = tokens
+    for fn, p in zip(segs, seg_params):
+        carry = fn(p, carry)
+    return carry
+
+
+# ---------------------------------------------------------------------------
+# step builders (targets / bench / tests share these)
+# ---------------------------------------------------------------------------
+
+def _sgd(sp, grads, lr):
+    return tuple(
+        jax.tree_util.tree_map(lambda w, g: w - lr * g, pk, gk)
+        for pk, gk in zip(sp, grads))
+
+
+def _norm_spec(spec, mesh):
+    """Drop mesh axes of size 1 from a placement spec: jit normalizes
+    them away on OUTPUT shardings, so placing inputs with the full spec
+    would make the first carry feedback a second compiled signature on
+    a degenerate (e.g. 1x1) mesh."""
+    parts = [None if (p in mesh.shape and mesh.shape[p] == 1) else p
+             for p in spec]
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def place_state(mesh, seg_params, *extra):
+    """Commit params to their ``NamedSharding`` placement (split leaves
+    over ``model``) and every extra carry tree to the replicated
+    sharding — so the first call and the steady state share ONE
+    compiled signature (compile_count == 1)."""
+    pspecs = jax.tree_util.tree_map(lambda s: _norm_spec(s, mesh),
+                                    gpt2_pspecs(seg_params))
+    # device_put of an already-committed array can ALIAS its buffer on
+    # the overlapping device; a later donation would then delete the
+    # caller's original — copy first so every build owns its state
+    fresh = jax.tree_util.tree_map(jnp.copy, seg_params)
+    placed = jax.device_put(
+        fresh,
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), pspecs))
+    rep = NamedSharding(mesh, P())
+    return (placed,) + tuple(
+        jax.device_put(jax.tree_util.tree_map(jnp.copy, t), rep)
+        for t in extra)
+
+
+def build_train_step(mesh, seg_params, *, hidden, heads,
+                     mode="overlapped", compress="int8", lr=0.05,
+                     fold_average=True, message_size=10000000,
+                     guard_nan_step=None, donate=True):
+    """One jitted 2-D train step.
+
+    ``mode="baseline"``: full backward, then the bucketed DP sync
+    (exactly the 1-D ``ddp_compressed`` shape, on the 2-D mesh) —
+    ``step(sp, res, tokens, labels) -> (sp, res, loss)``.
+
+    ``mode="overlapped"``: segmented backward with per-bucket DP psums
+    emitted mid-backward (``parallel/overlap.py``), interleaving with
+    the remaining segments' TP psums — same signature.
+
+    ``mode="guarded"``: the overlapped step under
+    ``resilience.guarded_update`` with the non-finite flag OR'd over
+    BOTH axes (every ``(data, model)`` coordinate must agree to skip) —
+    ``step(sp, res, gst, step_idx, tokens, labels) -> (sp, res, gst,
+    loss)``; ``guard_nan_step`` arms ``faults.inject_nan`` at the
+    embedding output.
+
+    Returns ``(jitted_step, state)`` where ``state`` is the placed
+    carry tuple (params, residual[, guard state]).
+    """
+    from apex_tpu import resilience
+    from apex_tpu.parallel import compression
+    from apex_tpu.parallel.distributed import DistributedDataParallel
+    from apex_tpu.parallel.overlap import OverlappedDataParallel
+    from apex_tpu.resilience import faults
+
+    head_dim = hidden // heads
+    layers = len(seg_params)
+    tp = mesh.shape[MODEL_AXIS]
+    local = local_template(seg_params, tp)
+    stateful = compression.needs_residual(compress)
+    pspecs = gpt2_pspecs(seg_params)
+
+    if mode == "baseline":
+        ddp = DistributedDataParallel(axis_name=DATA_AXIS,
+                                      compress=compress,
+                                      message_size=message_size)
+        residual = (ddp.init_residual(local) if stateful
+                    else jnp.zeros(()))
+
+        def fn(sp, res, tokens, labels):
+            loss, grads = jax.value_and_grad(
+                lambda q: gpt2_loss(q, tokens, labels, head_dim))(
+                    tuple(sp))
+            if stateful:
+                grads, res = ddp.sync(grads, res)
+            else:
+                grads = ddp.sync(grads)
+            return _sgd(sp, grads, lr), res, loss
+
+    elif mode in ("overlapped", "guarded"):
+        odp = OverlappedDataParallel(axis_name=DATA_AXIS,
+                                     compress=compress,
+                                     fold_average=fold_average,
+                                     message_size=message_size,
+                                     guard_flag=(mode == "guarded"))
+        residual = (odp.init_residual(local) if stateful
+                    else jnp.zeros(()))
+
+        if mode == "overlapped":
+            def fn(sp, res, tokens, labels):
+                segs = gpt2_segments(labels, layers, head_dim)
+                if stateful:
+                    loss, synced, res = odp.value_and_sync(
+                        segs, list(sp), tokens, residual=res)
+                else:
+                    loss, synced = odp.value_and_sync(segs, list(sp),
+                                                      tokens)
+                return _sgd(sp, synced, lr), res, loss
+        else:
+            def fn(sp, res, gst, step_idx, tokens, labels):
+                poison = faults.inject_nan(
+                    jnp.zeros((), jnp.float32), step_idx,
+                    nan_step=guard_nan_step)
+                segs = gpt2_segments(labels, layers, head_dim,
+                                     poison=poison)
+                loss, synced, new_res, flag = odp.value_and_sync(
+                    segs, list(sp), tokens, residual=res)
+
+                def commit(g, st):
+                    prev_sp, _ = st
+                    return (_sgd(prev_sp, g, lr), new_res)
+
+                (sp, res), gst = resilience.guarded_update(
+                    synced, commit, (tuple(sp), res), gst,
+                    axis_name=(DATA_AXIS, MODEL_AXIS), flag=flag)
+                return sp, res, gst, loss
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    rspec = jax.tree_util.tree_map(lambda _: P(), residual)
+    if mode == "guarded":
+        in_specs = (pspecs, rspec, P(), P(), P(DATA_AXIS), P(DATA_AXIS))
+        out_specs = (pspecs, rspec, P(), P())
+        donate_argnums = (0, 1, 2) if donate else ()
+        state = place_state(mesh, seg_params, residual,
+                            resilience.init_guard_state())
+    else:
+        in_specs = (pspecs, rspec, P(DATA_AXIS), P(DATA_AXIS))
+        out_specs = (pspecs, rspec, P())
+        donate_argnums = (0, 1) if donate else ()
+        state = place_state(mesh, seg_params, residual)
+
+    step = jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False),
+        donate_argnums=donate_argnums)
+    return step, state
+
+
+def make_batch(mesh, *, batch_per_replica=2, seq=16, vocab=64, seed=1):
+    """A token/label batch sharded over the ``data`` axis (replicated
+    over ``model`` — every model rank sees the same rows)."""
+    rng = np.random.RandomState(seed)
+    rows = batch_per_replica * mesh.shape[DATA_AXIS]
+    tokens = jnp.asarray(rng.randint(0, vocab, (rows, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, vocab, (rows, seq)), jnp.int32)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.device_put((tokens, labels), sharding)

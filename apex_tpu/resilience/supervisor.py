@@ -352,6 +352,50 @@ class HotSnapshots:
         return jax.tree_util.tree_map(lambda x: x, snap.state)
 
 
+# -- world-size helpers (2-D (data, model) meshes carry tuple worlds) -------
+
+
+def _canon_world(world):
+    """An int dp world stays an int; a ``(dp, tp)`` (or deeper) mesh
+    shape becomes a tuple of ints — the form the ``rebuild`` hook and
+    the elastic 2-D ZeRO reshard consume."""
+    if isinstance(world, (tuple, list)):
+        return tuple(int(w) for w in world)
+    return int(world)
+
+
+def _world_size(world):
+    """Total replica count of an int or tuple world (telemetry gauge)."""
+    if isinstance(world, (tuple, list)):
+        n = 1
+        for w in world:
+            n *= int(w)
+        return n
+    return int(world)
+
+
+def _half_world(world):
+    """The default shrink target when neither the error nor the policy
+    pins one: halve an int world; on a tuple world halve the LAST axis
+    whose size exceeds 1 (the model axis of a ``(data, model)`` mesh —
+    ``(2, 4) -> (2, 2)``), falling back to the data axis."""
+    if isinstance(world, (tuple, list)):
+        axes = [int(w) for w in world]
+        for i in reversed(range(len(axes))):
+            if axes[i] > 1:
+                axes[i] = max(1, axes[i] // 2)
+                return tuple(axes)
+        return tuple(axes)
+    return max(1, (world or 2) // 2)
+
+
+def _world_json(world):
+    """Tuples -> lists so the topology record stays JSON-serializable."""
+    if isinstance(world, (tuple, list)):
+        return [int(w) for w in world]
+    return int(world)
+
+
 # -- the supervisor ----------------------------------------------------------
 
 
@@ -412,7 +456,9 @@ class Supervisor:
         self.max_restarts_total = int(max_restarts_total)
         self.preemption = preemption_guard
         self.rebuild = rebuild
-        self.world = world
+        # int for a 1-D dp mesh, a (data, model) tuple for a 2-D one —
+        # the shrink default halves the model axis first (_half_world)
+        self.world = None if world is None else _canon_world(world)
         self.topology = dict(topology) if topology else None
         self.step = int(start_step)
         self.ledger = StepLedger(start_step)
@@ -683,27 +729,29 @@ class Supervisor:
                 raise RecoveryExhaustedError(
                     f"{cls} failure at step {self.step} but no hot "
                     "snapshot to rebuild from") from exc
-            new_world = (getattr(exc, "shrink_to", None)
-                         or policy.shrink_to
-                         or max(1, (self.world or 2) // 2))
+            new_world = _canon_world(
+                getattr(exc, "shrink_to", None)
+                or policy.shrink_to
+                or _half_world(self.world))
             host_state = HotSnapshots.copy_state(snap)
             if policy.adjust is not None:
                 host_state = policy.adjust(host_state, exc)
             self._step_fn, self.state = self.rebuild(
-                int(new_world), host_state, snap.step)
+                new_world, host_state, snap.step)
             lost = self.ledger.record_rollback(snap.step, cause=cls)
             self.step = snap.step
             self.steps_lost += lost
-            self.world = int(new_world)
+            self.world = new_world
             if self.topology is not None:
-                self.topology = dict(self.topology, world=int(new_world))
+                self.topology = dict(self.topology,
+                                     world=_world_json(new_world))
             self.snapshots.clear()  # old-world layouts must not restore
             self.mesh_shrinks += 1
             self._count("recovery/mesh_shrinks")
             self._count("recovery/steps_lost", lost)
             reg = self._reg()
             if reg.enabled:
-                reg.gauge("recovery/world").set(int(new_world))
+                reg.gauge("recovery/world").set(_world_size(new_world))
             self._event("recovered", cls=cls, action="mesh_shrink",
                         resume_step=snap.step, steps_lost=lost,
-                        world=int(new_world), attempt=attempt)
+                        world=_world_json(new_world), attempt=attempt)

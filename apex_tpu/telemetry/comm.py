@@ -34,6 +34,20 @@ _SHARD_OPS = {"all_gather"}
 _TWO_PHASE_OPS = {"psum", "pmax", "pmin", "all_reduce"}
 
 
+def axis_label(axis_name):
+    """Canonical event tag for a (possibly tuple) mesh axis name —
+    ``"data"``, ``"data,model"``, ``None`` when no axis was named. The
+    per-axis rollup (``comm/axis/<label>_bytes`` counters, the
+    ``telemetry_report`` comm table) keys on this, which is what makes
+    DP compression savings and TP psum volume separable in one report
+    on a 2-D mesh."""
+    if axis_name is None:
+        return None
+    if isinstance(axis_name, (tuple, list)):
+        return ",".join(str(a) for a in axis_name) or None
+    return str(axis_name)
+
+
 def axis_world(axis_name):
     """Concrete size of a (possibly tuple) mesh axis, resolved at trace
     time; 1 when no axis is bound (single-device fallback paths)."""
@@ -84,13 +98,19 @@ def record_collective(op, *, elements, dtype, axis_name=None, world=None,
     itemsize = bits / 8.0 if bits else np.dtype(dtype).itemsize
     payload = float(elements) * itemsize
     wire = wire_bytes(op, payload, world)
+    label = axis_label(axis_name)
     reg.counter("comm/calls").inc()
     reg.counter("comm/bytes").inc(wire)
     reg.counter(f"comm/{op}_bytes").inc(wire)
     reg.counter(f"comm/dtype/{np.dtype(dtype).name}_bytes").inc(wire)
+    if label is not None:
+        # per-mesh-axis rollup: on a 2-D (data, model) mesh this is
+        # what separates compressed DP grad bytes from fp32 TP
+        # activation bytes in one report
+        reg.counter(f"comm/axis/{label}_bytes").inc(wire)
     reg.event("collective", op, elements=int(elements),
               dtype=np.dtype(dtype).name, world=int(world),
               payload_bytes=int(payload), wire_bytes=int(round(wire)),
               mode=mode, emulated=bool(emulated) or None,
-              bits=int(bits) if bits else None)
+              bits=int(bits) if bits else None, axis=label)
     return wire

@@ -17,6 +17,7 @@ import functools
 import jax
 from jax import lax
 
+from apex_tpu.telemetry import comm as _telemetry_comm
 from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
 
 
@@ -32,9 +33,17 @@ def _axis_size(axis_name) -> int:
         return 1
 
 
+# TP collectives are accounted like every DP collective
+# (telemetry/comm.py record_collective, trace-time): full-width
+# activation payloads tagged with the model axis name, so a 2-D
+# (data, model) report separates compressed DP grad bytes from fp32
+# TP psum volume per axis.
+
 def _reduce(x, axis_name=TENSOR_PARALLEL_AXIS):
     if _axis_size(axis_name) == 1:
         return x
+    _telemetry_comm.record_collective(
+        "psum", elements=x.size, dtype=x.dtype, axis_name=axis_name)
     return lax.psum(x, axis_name)
 
 
@@ -51,6 +60,9 @@ def _gather(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
     size = _axis_size(axis_name)
     if size == 1:
         return x
+    _telemetry_comm.record_collective(
+        "all_gather", elements=x.size, dtype=x.dtype,
+        axis_name=axis_name)
     return lax.all_gather(x, axis_name, axis=dim, tiled=True)
 
 
@@ -58,6 +70,9 @@ def _reduce_scatter(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
     size = _axis_size(axis_name)
     if size == 1:
         return x
+    _telemetry_comm.record_collective(
+        "psum_scatter", elements=x.size, dtype=x.dtype,
+        axis_name=axis_name)
     return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
 
 

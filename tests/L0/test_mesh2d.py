@@ -1,0 +1,611 @@
+"""2-D (data, model) mesh composition (ISSUE 15, ROADMAP item 4).
+
+Evidence layers:
+
+- **TP math**: the column/row-parallel GPT-2 block on the 2x4 mesh
+  reproduces the single-device forward (the mappings region ops carry
+  the psums; replicated grads stay model-invariant).
+- **Axis scoping**: int8 DP compression + EF residual reduce over the
+  ``data`` axis only — the overlapped step's per-axis comm bytes match
+  the static collective graph EXACTLY, axis by axis, and the lint
+  rules (overlap-serialization at a meaningful threshold included) run
+  clean with zero skips.
+- **Guard**: a poisoned 2-D step skips and reverts params AND the
+  DP-scoped bucket-domain residual bit-exactly, the flag OR'd over
+  BOTH axes.
+- **Elastic 2-D ZeRO**: the shard table gains the model dimension —
+  2x4 -> 2x2 -> 2x4 round-trips bit-identically through the canonical
+  full-parameter form (monolithic AND overlap bucket layouts), the
+  model-invariance of replicated leaves is verified not assumed, and a
+  2x4-written state STEPS on a 2x2 mesh bit-identically to a native
+  2x2 init (slow).
+- **Supervisor**: tuple worlds route through mesh-shrink — a device
+  loss on (2, 4) rebuilds at (2, 2) by default.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh2d
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+for _p in (_ROOT, os.path.join(_ROOT, "tools")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+HID, HEADS, VOCAB, SEQ = 32, 4, 64, 8
+
+
+def _model(hidden=HID, layers=2, **kw):
+    return mesh2d.gpt2_init(hidden=hidden, layers=layers, heads=HEADS,
+                            vocab=VOCAB, max_seq=SEQ, **kw)
+
+
+# ---------------------------------------------------------------------------
+# host-side: specs, partition dims, local templates
+# ---------------------------------------------------------------------------
+
+class TestShardTable:
+    def test_specs_and_dims_cover_the_layout(self):
+        sp = _model()
+        specs = mesh2d.gpt2_pspecs(sp)
+        dims = mesh2d.gpt2_partition_dims(sp)
+        attn = sp[0]["layer"]["attn"]
+        s_attn = specs[0]["layer"]["attn"]
+        d_attn = dims[0]["layer"]["attn"]
+        assert s_attn["wq"] == P(None, "model") and d_attn["wq"] == 1
+        assert s_attn["bq"] == P("model") and d_attn["bq"] == 0
+        assert s_attn["wo"] == P("model") and d_attn["wo"] == 0
+        assert s_attn["bo"] == P() and d_attn["bo"] is None
+        assert specs[0]["embed"]["wte"] == P()
+        assert dims[-1]["head"]["w"] is None
+        assert attn["wq"].shape == (HID, HID)
+
+    def test_local_template_divides_split_dims(self):
+        sp = _model()
+        local = mesh2d.local_template(sp, 4)
+        assert local[0]["layer"]["attn"]["wq"].shape == (HID, HID // 4)
+        assert local[0]["layer"]["attn"]["wo"].shape == (HID // 4, HID)
+        assert local[0]["layer"]["ln1"]["g"].shape == (HID,)
+        with pytest.raises(ValueError, match="does not split"):
+            mesh2d.local_template(sp, 5)
+
+    def test_mesh_validates_device_budget(self):
+        with pytest.raises(ValueError, match="need"):
+            mesh2d.mesh_2d(4, 4, devices=jax.devices()[:1])
+
+
+# ---------------------------------------------------------------------------
+# host-side: the 2-D ZeRO shard table (consolidate/reshard)
+# ---------------------------------------------------------------------------
+
+class TestZero2D:
+    def _full_dict(self, rng, n, dp, tp):
+        return {"format": 2, "optimizer": "DistributedFusedAdam",
+                "dp_world": dp, "tp_world": tp, "n_elements": n,
+                "block_size": 256, "grad_compress": "int8",
+                "param_compress": "bf16", "step": np.int32(7),
+                "master": rng.randn(n).astype(np.float32),
+                "exp_avg": rng.randn(n).astype(np.float32),
+                "exp_avg_sq": np.abs(rng.randn(n)).astype(np.float32),
+                "grad_residual": (rng.randn(n) * 1e-3)
+                .astype(np.float32)}
+
+    def test_roundtrip_2x4_2x2_2x4_bit_identical(self):
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _flat_size,
+        )
+
+        sp = _model()
+        pdims = mesh2d.gpt2_partition_dims(sp)
+        rng = np.random.RandomState(0)
+        full0 = self._full_dict(rng, _flat_size(sp), 2, 4)
+        for overlap in (False, True):
+            opt = DistributedFusedAdam(compress=True, overlap=overlap)
+            full = full0
+            for world in ((2, 2), (2, 4)):
+                st = opt.load_state_dict_resharded(
+                    full, sp, world=world, partition_dims=pdims)
+                assert len(st) == world[1]
+                if overlap:
+                    assert "buckets" in st[0]
+                full = opt.state_dict_full(st, sp, world=world,
+                                           partition_dims=pdims)
+            for k in ("master", "exp_avg", "exp_avg_sq",
+                      "grad_residual"):
+                np.testing.assert_array_equal(full[k], full0[k]), \
+                    (overlap, k)
+            assert int(full["step"]) == 7
+
+    def test_residual_consolidates_by_dp_sum_per_model_rank(self):
+        """Each model column's residual is the sum over ITS dp ranks;
+        on reshard, each new model column's dp-rank-0 carries the
+        merged total."""
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _flat_size,
+        )
+
+        sp = _model()
+        pdims = mesh2d.gpt2_partition_dims(sp)
+        rng = np.random.RandomState(1)
+        opt = DistributedFusedAdam(compress=True)
+        full0 = self._full_dict(rng, _flat_size(sp), 2, 4)
+        sts = opt.load_state_dict_resharded(full0, sp, world=(2, 4),
+                                            partition_dims=pdims)
+        for st in sts:
+            res = np.asarray(st["grad_residual"])
+            assert res.shape[0] == 2          # per-dp-rank stack
+            assert np.abs(res[1]).max() == 0  # rank 0 carries the sum
+        back = opt.state_dict_full(sts, sp, world=(2, 4),
+                                   partition_dims=pdims)
+        np.testing.assert_array_equal(back["grad_residual"],
+                                      full0["grad_residual"])
+
+    def test_replicated_leaf_divergence_refuses(self):
+        """Model-invariance of replicated state is VERIFIED: a model
+        rank whose replicated leaf diverged must fail consolidation,
+        not silently average."""
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _flat_size,
+        )
+
+        sp = _model()
+        pdims = mesh2d.gpt2_partition_dims(sp)
+        rng = np.random.RandomState(2)
+        opt = DistributedFusedAdam(compress=True)
+        full0 = self._full_dict(rng, _flat_size(sp), 2, 4)
+        sts = opt.load_state_dict_resharded(full0, sp, world=(2, 4),
+                                            partition_dims=pdims)
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            split_params_for_model_axis,
+        )
+
+        # poison the LAST logical element (the replicated head's tail;
+        # the zero pad tail beyond n_t is not consolidated)
+        n_t = sum(l.size for l in jax.tree_util.tree_leaves(
+            split_params_for_model_axis(sp, pdims, 4)[2]))
+        bad = dict(sts[2])
+        m = np.asarray(bad["master_shard"]).copy()
+        m[n_t - 1] += 1.0
+        bad["master_shard"] = m
+        with pytest.raises(ValueError, match="replicated leaf"):
+            opt.state_dict_full([sts[0], sts[1], bad, sts[3]], sp,
+                                world=(2, 4), partition_dims=pdims)
+
+    def test_2d_world_requires_partition_dims(self):
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+        opt = DistributedFusedAdam()
+        with pytest.raises(ValueError, match="partition_dims"):
+            opt.state_dict_full([], _model(), world=(2, 4))
+        assert opt.topology((2, 4))["world"] == [2, 4]
+
+
+# ---------------------------------------------------------------------------
+# host-side: supervisor 2-D worlds
+# ---------------------------------------------------------------------------
+
+class TestSupervisor2D:
+    def test_half_world_prefers_the_model_axis(self):
+        from apex_tpu.resilience.supervisor import _half_world
+
+        assert _half_world((2, 4)) == (2, 2)
+        assert _half_world((2, 1)) == (1, 1)
+        assert _half_world((1, 1)) == (1, 1)
+        assert _half_world(8) == 4
+
+    def test_mesh_shrink_on_tuple_world(self):
+        from apex_tpu.resilience.faults import DeviceLostError
+        from apex_tpu.resilience.supervisor import Supervisor
+
+        def make_step(world):
+            def step(state, i):
+                if world == (2, 4) and i == 3:
+                    raise DeviceLostError("chip 5 fell over")
+                return {"x": state["x"] + 1}
+            return step
+
+        rebuilds = []
+
+        def rebuild(world, host_state, step):
+            rebuilds.append((world, step))
+            return make_step(world), host_state
+
+        sup = Supervisor(make_step((2, 4)), {"x": np.zeros(())},
+                         rebuild=rebuild, world=(2, 4),
+                         topology={"world": [2, 4]}, snapshot_every=2,
+                         sleep=lambda s: None)
+        rep = sup.run(6)
+        assert rep["exit"] == "completed"
+        assert sup.world == (2, 2)
+        assert rebuilds == [((2, 2), 2)]
+        assert sup.topology["world"] == [2, 2]
+
+
+# ---------------------------------------------------------------------------
+# host-side: per-axis comm telemetry + tools contracts
+# ---------------------------------------------------------------------------
+
+class TestPerAxisAccounting:
+    def test_axis_label(self):
+        from apex_tpu.telemetry.comm import axis_label
+
+        assert axis_label("data") == "data"
+        assert axis_label(("data", "model")) == "data,model"
+        assert axis_label(None) is None
+        assert axis_label(()) is None
+
+    def test_record_collective_rolls_up_per_axis(self):
+        from apex_tpu.telemetry import comm
+        from apex_tpu.telemetry.registry import (MetricsRegistry,
+                                                 use_registry)
+
+        reg = MetricsRegistry(enabled=True)
+        reg.enable()
+        with use_registry(reg):
+            comm.record_collective("psum", elements=1000,
+                                   dtype=jnp.float32,
+                                   axis_name="model", world=4)
+            comm.record_collective("psum", elements=1000,
+                                   dtype=jnp.int8, axis_name="data",
+                                   world=2, mode="int8")
+        model = reg.counter_value("comm/axis/model_bytes")
+        data = reg.counter_value("comm/axis/data_bytes")
+        assert model == 2.0 * 3 / 4 * 4000
+        assert data == 2.0 * 1 / 2 * 1000
+        assert reg.counter_value("comm/bytes") == model + data
+
+    def test_report_renders_per_axis_table(self, capsys):
+        import telemetry_report as tr
+
+        events = [("f", {"kind": "collective", "name": "psum",
+                         "dtype": "float32", "axis": "model",
+                         "wire_bytes": 4096, "elements": 1024}),
+                  ("f", {"kind": "collective", "name": "psum",
+                         "dtype": "int8", "axis": "data",
+                         "wire_bytes": 512, "elements": 512})]
+        report = tr.aggregate(iter(events))
+        assert report["collectives_by_axis"]["model"]["wire_bytes"] \
+            == 4096
+        assert report["collectives_by_axis"]["data"]["calls"] == 1
+        tr.print_report(report)
+        out = capsys.readouterr().out
+        assert "per mesh axis" in out
+        assert "axis data" in out and "axis model" in out
+
+    def test_schema_gates_tp_dp_fields_at_round_20(self):
+        import bench_schema_check as schema
+
+        line = {"metric": "tp_dp_steps_per_sec", "value": 1.0,
+                "unit": "steps/sec", "vs_baseline": 1.0,
+                "tflops_per_sec": 0.1, "mfu": 0.01,
+                "comm_bytes_per_step": 100,
+                "measured_comm_bytes_per_step": 100,
+                "model_flops_per_step_xla": 1.0,
+                "peak_hbm_bytes": 1, "hbm_headroom_pct": 50.0,
+                "compile_count": 1, "lint_violations": 0,
+                "backend": "cpu-mesh",
+                "static_comm_bytes_per_step": 100,
+                "baseline_step_ms": 2.0, "overlapped_step_ms": 1.5,
+                "measured_comm_bytes_per_axis": {"data": 60,
+                                                 "model": 40},
+                "static_comm_bytes_per_axis": {"data": 60,
+                                               "model": 40},
+                "reshard_bitexact": True}
+        assert schema.check_metric_line(dict(line), round_n=20,
+                                        errors=[]) == []
+        # pre-round-20 records must not carry the per-axis dicts
+        errs = schema.check_metric_line(dict(line), round_n=19,
+                                        errors=[])
+        assert any("only defined from round 20" in e for e in errs)
+        # a round-20 tp_dp line missing the contract is flagged
+        short = {k: v for k, v in line.items()
+                 if k != "reshard_bitexact"}
+        errs = schema.check_metric_line(short, round_n=20, errors=[])
+        assert any("reshard_bitexact" in e for e in errs)
+        bad = dict(line, measured_comm_bytes_per_axis=[1, 2])
+        errs = schema.check_metric_line(bad, round_n=20, errors=[])
+        assert any("axis-name" in e for e in errs)
+
+    def test_trend_band_names_tp_dp(self):
+        import bench_trend
+
+        assert bench_trend.band_for("tp_dp_steps_per_sec") == 0.25
+
+
+# ---------------------------------------------------------------------------
+# on-mesh: forward parity, axis scoping, guard, overlap, ZeRO
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multi_device
+class TestMesh2D:
+    def _setup(self, mode, layers=2, **kw):
+        mesh = mesh2d.mesh_2d(2)
+        sp = _model(layers=layers)
+        step, state = mesh2d.build_train_step(
+            mesh, sp, hidden=HID, heads=HEADS, mode=mode, **kw)
+        tokens, labels = mesh2d.make_batch(mesh, batch_per_replica=2,
+                                           seq=SEQ, vocab=VOCAB)
+        return mesh, sp, step, state, tokens, labels
+
+    def test_forward_matches_single_device_oracle(self):
+        """Device (0, 0)'s loss == the un-meshed model on its local
+        rows: the column/row split + TP psum reproduce the dense
+        math."""
+        mesh, sp, step, state, tokens, labels = self._setup("baseline")
+        out = step(*state, tokens, labels)
+        oracle = mesh2d.gpt2_loss(list(sp), tokens[:2], labels[:2],
+                                  HID // HEADS)
+        np.testing.assert_allclose(float(out[2]), float(oracle),
+                                   rtol=2e-5)
+
+    def test_overlapped_tracks_baseline(self):
+        """Same mesh, same int8-over-data payload: the overlapped
+        step's FIRST loss is bit-identical (identical forward) and the
+        params stay within the per-block quantization bound of the
+        baseline over 3 steps (ragged buckets shift the block grid —
+        the 1-D suite pins the aligned case bit-exactly)."""
+        mesh, sp, base, bstate, tokens, labels = self._setup("baseline")
+        _, _, ovl, ostate, _, _ = self._setup("overlapped",
+                                              fold_average=False)
+        b, o = bstate, ostate
+        for i in range(3):
+            b = base(*b[:2], tokens, labels)
+            o = ovl(*o[:2], tokens, labels)
+            if i == 0:
+                assert float(b[2]) == float(o[2])
+        for pb, po in zip(jax.tree_util.tree_leaves(b[0]),
+                          jax.tree_util.tree_leaves(o[0])):
+            np.testing.assert_allclose(np.asarray(pb), np.asarray(po),
+                                       atol=5e-4, rtol=1e-4)
+
+    def test_guard_skip_reverts_bit_exact_on_2d_mesh(self):
+        """Acceptance: guard skip-revert bit-exact under the 2-D mesh —
+        params AND the DP-scoped bucket-domain residual — with the
+        non-finite flag OR'd over BOTH axes."""
+        mesh, sp, step, state, tokens, labels = self._setup(
+            "guarded", guard_nan_step=1)
+        out = step(*state, jnp.zeros((), jnp.int32), tokens, labels)
+        assert int(out[2].total_skips) == 0
+        before = jax.tree_util.tree_map(np.asarray, (out[0], out[1]))
+        out = step(out[0], out[1], out[2], jnp.ones((), jnp.int32),
+                   tokens, labels)
+        assert int(out[2].total_skips) == 1
+        for b_leaf, a_leaf in zip(
+                jax.tree_util.tree_leaves(before),
+                jax.tree_util.tree_leaves((out[0], out[1]))):
+            assert np.array_equal(b_leaf, np.asarray(a_leaf))
+        # a clean step after the skip moves again
+        out = step(out[0], out[1], out[2],
+                   2 * jnp.ones((), jnp.int32), tokens, labels)
+        assert int(out[2].consecutive_skips) == 0
+        assert not np.array_equal(
+            np.asarray(jax.tree_util.tree_leaves(out[0])[0]),
+            jax.tree_util.tree_leaves(before)[0])
+
+    def test_zero_overlap_composes_on_2d_mesh(self):
+        """overlapped_zero_step (per-bucket DP reduce-scatter -> shard
+        update -> gather, scoped to 'data') drives the 2-D GPT block:
+        the loss decreases and the step counter advances."""
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        from apex_tpu.parallel.overlap import overlapped_zero_step
+
+        mesh = mesh2d.mesh_2d(2)
+        sp = _model()
+        tokens, labels = mesh2d.make_batch(mesh, batch_per_replica=2,
+                                           seq=SEQ, vocab=VOCAB)
+        opt = DistributedFusedAdam(lr=1e-2, axis_name="data",
+                                   compress=True, overlap=True)
+        head_dim = HID // HEADS
+        pspecs = mesh2d.gpt2_pspecs(sp)
+
+        def drv(sp_, state, tokens_, labels_):
+            segs = mesh2d.gpt2_segments(labels_, len(sp_), head_dim)
+            loss, sp_, state = overlapped_zero_step(
+                segs, list(sp_), opt, state, tokens_)
+            return tuple(sp_), state, loss
+
+        step = jax.jit(jax.shard_map(
+            drv, mesh=mesh,
+            in_specs=(pspecs, P(), P("data"), P("data")),
+            out_specs=(pspecs, P(), P()), check_vma=False))
+        with mesh:
+            state = jax.jit(lambda p: jax.shard_map(
+                lambda q: opt.init(list(q)), mesh=mesh,
+                in_specs=(pspecs,), out_specs=P(),
+                check_vma=False)(p))(sp)
+        losses = []
+        cur = sp
+        for _ in range(3):
+            cur, state, loss = step(cur, state, tokens, labels)
+            losses.append(float(loss))
+        assert int(np.asarray(state["step"])) == 3
+        assert losses[-1] < losses[0]
+
+    def test_per_axis_static_matches_measured_exactly(self):
+        """The tp_dp_overlapped target: trace-measured per-axis comm
+        counters == the collective graph's static ring bytes, axis by
+        axis (data carries the compressed grads, model the fp32
+        activation psums)."""
+        from apex_tpu.analysis import sharding
+        from apex_tpu.analysis.targets import TARGETS
+        from apex_tpu.telemetry.registry import (MetricsRegistry,
+                                                 use_registry)
+
+        fn, args, _ = TARGETS["tp_dp_overlapped"]()
+        reg = MetricsRegistry(enabled=True)
+        reg.enable()
+        with use_registry(reg):
+            lowered = fn.lower(*args)
+        measured = {a: reg.counter_value(f"comm/axis/{a}_bytes")
+                    for a in ("data", "model")}
+        traced = fn.trace(*args)
+        static = sharding.static_comm_bytes_by_axis(
+            lowered.as_text(), traced.jaxpr)
+        assert measured["data"] > 0 and measured["model"] > 0
+        assert static["data"] == int(round(measured["data"]))
+        assert static["model"] == int(round(measured["model"]))
+        assert "?" not in static  # every op got an axis label
+
+    def test_overlap_serialization_meaningfully_clean_on_2d(self):
+        """The proof obligation: with the threshold BELOW the per-
+        bucket DP payload (but above the TP activation psums), no DP
+        bucket chains behind another large reduction — the rule is
+        checked in the regime where it can actually fire."""
+        from apex_tpu.analysis import LintConfig, assert_clean_hlo
+        from apex_tpu.analysis.targets import (TARGETS,
+                                               tp_dp_overlap_min_bytes)
+
+        fn, args, _ = TARGETS["tp_dp_overlapped"]()
+        report = assert_clean_hlo(
+            fn, *args, rules="overlap-serialization",
+            config=LintConfig(
+                overlap_min_bytes=tp_dp_overlap_min_bytes()))
+        assert report.rules_run == ("overlap-serialization",)
+
+    def test_e2e_no_recompiles(self):
+        from apex_tpu.analysis.targets import tp_dp_overlapped_step
+        from apex_tpu.telemetry.compile_watch import assert_no_recompiles
+
+        fn, args, _ = tp_dp_overlapped_step()
+        out = fn(*args)
+        out = fn(out[0], out[1], *args[2:])
+        with assert_no_recompiles():
+            for _ in range(2):
+                out = fn(out[0], out[1], *args[2:])
+        float(out[2])
+
+
+# ---------------------------------------------------------------------------
+# slow: the on-mesh elastic step equivalence + the live bench contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multi_device
+@pytest.mark.slow
+class TestElastic2DE2E:
+    def test_resharded_2x4_state_steps_on_2x2_bit_identical(self):
+        """The supervisor's elastic story on REAL meshes: a 2x4-written
+        ZeRO master table resharded to 2x2 steps bit-identically to a
+        native 2x2 init (fp32 sync — exact psum), proving the 2-D
+        reshard changed nothing but the partition."""
+        import functools
+
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _padded_size,
+        )
+
+        sp = _model()
+        pdims = mesh2d.gpt2_partition_dims(sp)
+        opt = DistributedFusedAdam(lr=1e-2, axis_name="data")
+        head_dim = HID // HEADS
+        pspecs = mesh2d.gpt2_pspecs(sp)
+
+        def one_step(tp, masters_host):
+            """masters_host: [tp, padded_t] per-model-rank masters."""
+            mesh = mesh2d.mesh_2d(2, tp)
+            tokens, labels = mesh2d.make_batch(
+                mesh, batch_per_replica=2, seq=SEQ, vocab=VOCAB)
+
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(pspecs, P("model", "data"), P("data"),
+                          P("data")),
+                out_specs=P("model", "data"), check_vma=False)
+            def go(params, master_local, tokens_, labels_):
+                loss, grads = jax.value_and_grad(
+                    lambda q: mesh2d.gpt2_loss(q, tokens_, labels_,
+                                               head_dim))(tuple(params))
+                state = dict(opt.init(list(params)),
+                             master_shard=master_local.reshape(-1))
+                _, new_state = opt.step(list(grads), state,
+                                        list(params))
+                return new_state["master_shard"][None, :]
+
+            return np.asarray(jax.jit(go)(
+                sp, jnp.asarray(masters_host), tokens, labels))
+
+        def masters_for(tp):
+            from apex_tpu.contrib.optimizers.distributed_fused_adam import (  # noqa: E501
+                split_params_for_model_axis,
+            )
+
+            per_rank = split_params_for_model_axis(sp, pdims, tp)
+            rows = []
+            for lp in per_rank:
+                flat = np.concatenate(
+                    [np.asarray(l).reshape(-1)
+                     for l in jax.tree_util.tree_leaves(lp)])
+                padded = _padded_size(flat.size, 2, None, None, 256)
+                rows.append(np.pad(flat, (0, padded - flat.size)))
+            return np.stack(rows)
+
+        out4 = one_step(4, masters_for(4))
+
+        # write at 2x4 (zero moments: the fresh-run shape), reshard to
+        # 2x2 through the canonical form, step on the 2x2 mesh
+        st4 = []
+        m4 = masters_for(4)
+        for t in range(4):
+            st4.append({"step": jnp.zeros((), jnp.int32),
+                        "master_shard": m4[t],
+                        "exp_avg_shard": np.zeros_like(m4[t]),
+                        "exp_avg_sq_shard": np.zeros_like(m4[t])})
+        full = opt.state_dict_full(st4, sp, world=(2, 4),
+                                   partition_dims=pdims)
+        st2 = opt.load_state_dict_resharded(full, sp, world=(2, 2),
+                                            partition_dims=pdims)
+        resharded = np.stack([np.asarray(s["master_shard"])
+                              for s in st2])
+        out2 = one_step(2, resharded)
+        native2 = one_step(2, masters_for(2))
+        # the production claim: the re-shard changed NOTHING but the
+        # partition — the resharded masters step bit-identically to a
+        # native 2x2 init. (No cross-topology float comparison: Adam's
+        # first step is sign-like, so the tp=4 psum association makes
+        # near-zero grads flip update signs — out4 only proves the
+        # 2x4 step runs.)
+        np.testing.assert_array_equal(out2, native2)
+        assert np.isfinite(out4).all()
+
+    def test_tp_dp_bench_contract(self, capsys):
+        """The live round-20 contract: bench_tp_dp at tiny size emits a
+        schema-valid line with one compile, clean lint, per-axis
+        agreement, and reshard_bitexact."""
+        import json as _json
+        import os as _os
+        import sys as _sys
+
+        root = _os.path.dirname(_os.path.dirname(
+            _os.path.dirname(_os.path.abspath(__file__))))
+        for p in (root, _os.path.join(root, "tools")):
+            if p not in _sys.path:
+                _sys.path.insert(0, p)
+        import bench
+        import bench_schema_check as schema
+
+        ret = bench.bench_tp_dp(2, 1, hidden=64, layers=2, heads=4,
+                                vocab=64, seq=16)
+        line = _json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert schema.check_metric_line(line, round_n=20,
+                                        errors=[]) == []
+        assert line["compile_count"] == 1
+        assert line["lint_violations"] == 0
+        assert line["reshard_bitexact"] is True
+        assert line["backend"] == "cpu-mesh"
+        assert line["measured_comm_bytes_per_axis"]["data"] > 0
+        assert line["measured_comm_bytes_per_axis"]["model"] > 0
+        assert line["static_comm_bytes_per_axis"] == \
+            line["measured_comm_bytes_per_axis"]
+        assert ret["baseline_step_ms"] > 0

@@ -114,6 +114,8 @@ class TestEndToEnd:
         np.testing.assert_array_equal(np.asarray(with_kernel),
                                       np.asarray(without))
 
+    @pytest.mark.slow  # duplicate coverage: the token-exact kernel-vs-
+    # einsum test above walks the same cached-decode path (tier-1 budget)
     def test_cached_decode_matches_full_rerun(self, interpret_kernel):
         m, params, prompt = self._model()
         seq = self._greedy_cached(m, params, prompt, 5)

@@ -40,6 +40,8 @@ def test_discriminator_on_generator_output(gan):
     assert bool(jnp.isfinite(out).all())
 
 
+@pytest.mark.slow  # duplicate coverage: the dcgan/resnet amp-step tests
+# compile the same conv stacks (tier-1 budget, 10s)
 def test_resnet18_forward_shape():
     from apex_tpu.models import ResNet18
 

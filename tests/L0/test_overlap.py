@@ -570,17 +570,40 @@ class TestZeroOverlap:
                 [lambda p, h: h], [{}],
                 DistributedFusedAdam(), {"step": 0}, None)
 
-    def test_state_dict_full_rejects_overlap_state(self):
+    def test_state_dict_full_consolidates_overlap_state(self):
+        """The bucket-partitioned state consolidates into the SAME
+        format-1 dict the monolithic layout writes (PR-15 bugfix: this
+        used to raise NotImplementedError, stranding overlap=True runs
+        without an elastic checkpoint tier), and a state whose bucket
+        layout does not match the plan refuses loudly."""
         from apex_tpu.contrib.optimizers import (DistributedFusedAdam,
                                                  DistributedFusedLAMB)
 
-        params = {"w": jnp.ones((8,))}
-        state = {"step": jnp.zeros((), jnp.int32), "buckets": ()}
-        for opt in (DistributedFusedAdam(overlap=True),
-                    DistributedFusedLAMB(overlap=True)):
-            with pytest.raises(NotImplementedError,
-                               match="bucket-partitioned"):
-                opt.state_dict_full(state, params, world=8)
+        rng = np.random.RandomState(3)
+        params = {"w": jnp.asarray(rng.randn(512, 2)
+                                   .astype(np.float32)),
+                  "b": jnp.asarray(rng.randn(24).astype(np.float32))}
+        n = 512 * 2 + 24
+        full0 = {"format": 1, "n_elements": n, "step": np.int32(9),
+                 "master": rng.randn(n).astype(np.float32),
+                 "exp_avg": rng.randn(n).astype(np.float32),
+                 "exp_avg_sq": np.abs(rng.randn(n)).astype(np.float32),
+                 "grad_residual": (rng.randn(n) * 1e-3)
+                 .astype(np.float32)}
+        for cls in (DistributedFusedAdam, DistributedFusedLAMB):
+            opt = cls(overlap=True, compress=True, message_size=512)
+            st = opt.load_state_dict_resharded(full0, params, world=8)
+            assert "buckets" in st
+            back = opt.state_dict_full(st, params, world=8)
+            assert back["optimizer"] == cls.__name__
+            for k in ("master", "exp_avg", "exp_avg_sq",
+                      "grad_residual"):
+                np.testing.assert_array_equal(back[k], full0[k])
+            assert int(back["step"]) == 9
+            with pytest.raises(ValueError, match="bucket state layout"):
+                opt.state_dict_full(
+                    {"step": jnp.zeros((), jnp.int32), "buckets": ()},
+                    params, world=8)
 
 
 # ---------------------------------------------------------------------------

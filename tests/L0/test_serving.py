@@ -179,6 +179,8 @@ class TestKVCache:
 # ---------------------------------------------------------------------------
 
 class TestEngineParity:
+    @pytest.mark.slow  # duplicate coverage: the int8 64-token decode
+    # parity below pins the same greedy stream (tier-1 budget, 14s)
     def test_greedy_token_identity_vs_generate(self, tiny):
         """bf16(-mode) engine greedy output == generate() greedy, per
         request, across mixed prompt lengths sharing one batch."""

@@ -64,6 +64,13 @@ number ``n`` (old checked-in records stay valid):
   wire model (``comm_bytes_per_step_int4``); pre-round-19 records
   carrying any of them are
   flagged — the field did not exist yet.
+- ``n >= 20``: ``tp_dp`` metric lines (the 2-D (data, model) mesh
+  composition) must carry ``baseline_step_ms`` /
+  ``overlapped_step_ms``, the per-mesh-axis comm-byte split
+  (``measured_comm_bytes_per_axis`` / ``static_comm_bytes_per_axis``,
+  axis-name -> bytes dicts) and the elastic 2-D reshard verdict
+  ``reshard_bitexact``; pre-round-20 records carrying any of them are
+  flagged.
 
 Usage::
 
@@ -194,6 +201,20 @@ KERNELS_REQUIRED_FIELDS = (
     "int4_kernel_ms", "int4_xla_ms")
 INT4_COMM_FIELD = "comm_bytes_per_step_int4"
 DDP_COMPRESSED_METRIC_PREFIX = "ddp_compressed"
+# the 2-D mesh composition contract (apex_tpu.parallel.mesh2d, round
+# 20): a tp_dp metric line must carry the baseline-vs-overlapped 2-D
+# step times, the per-mesh-axis comm-byte split (measured counter
+# deltas AND the static collective-graph model, both keyed by axis
+# name), and the elastic 2-D ZeRO reshard verdict; pre-round-20
+# records carrying any of them are flagged — the fields did not exist
+TP_DP_FIELDS_SINCE_ROUND = 20
+TP_DP_METRIC_PREFIX = "tp_dp"
+TP_DP_NUM_FIELDS = ("baseline_step_ms", "overlapped_step_ms")
+TP_DP_AXIS_FIELDS = ("measured_comm_bytes_per_axis",
+                     "static_comm_bytes_per_axis")
+TP_DP_BOOL_FIELD = "reshard_bitexact"
+TP_DP_REQUIRED_FIELDS = (TP_DP_NUM_FIELDS + TP_DP_AXIS_FIELDS
+                         + (TP_DP_BOOL_FIELD,))
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -449,6 +470,41 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
             elif not (obj[INT4_COMM_FIELD] is None
                       or _type_ok(obj[INT4_COMM_FIELD], _NUM)):
                 bad(f"{INT4_COMM_FIELD} must be numeric or null")
+        is_tp_dp = str(obj.get("metric", "")).startswith(
+            TP_DP_METRIC_PREFIX)
+        # presence-gate only the round-20-new per-axis dicts:
+        # baseline/overlapped_step_ms ride ddp_overlapped lines since
+        # round 15 and reshard_bitexact rides ddp_recovery since 13
+        present_tp_dp = [k for k in TP_DP_AXIS_FIELDS if k in obj]
+        if present_tp_dp and (round_n is not None
+                              and round_n < TP_DP_FIELDS_SINCE_ROUND):
+            bad(f"tp_dp fields {present_tp_dp} are only defined from "
+                f"round {TP_DP_FIELDS_SINCE_ROUND}")
+        elif is_tp_dp and (round_n is None
+                           or round_n >= TP_DP_FIELDS_SINCE_ROUND):
+            for key in TP_DP_NUM_FIELDS:
+                if key not in obj:
+                    bad(f"tp_dp line missing {key!r} (required since "
+                        f"round {TP_DP_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"tp_dp field {key!r} must be numeric or null")
+            for key in TP_DP_AXIS_FIELDS:
+                if key not in obj:
+                    bad(f"tp_dp line missing {key!r} (required since "
+                        f"round {TP_DP_FIELDS_SINCE_ROUND})")
+                elif obj[key] is not None and not (
+                        isinstance(obj[key], dict)
+                        and all(isinstance(k, str)
+                                and (v is None or _type_ok(v, _NUM))
+                                for k, v in obj[key].items())):
+                    bad(f"tp_dp field {key!r} must be an axis-name -> "
+                        f"bytes dict or null")
+            if TP_DP_BOOL_FIELD not in obj:
+                bad(f"tp_dp line missing {TP_DP_BOOL_FIELD!r} "
+                    f"(required since round {TP_DP_FIELDS_SINCE_ROUND})")
+            elif not (obj[TP_DP_BOOL_FIELD] is None
+                      or isinstance(obj[TP_DP_BOOL_FIELD], bool)):
+                bad(f"{TP_DP_BOOL_FIELD} must be a boolean or null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

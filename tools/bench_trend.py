@@ -52,6 +52,10 @@ PER_METRIC_BAND = {
     "serve_chaos_goodput_tokens_per_sec": 0.40,
     "serve_fleet_tokens_per_sec": 0.40,
     "serve_spec_accepted_tokens_per_sec": 0.40,
+    # 2-D (data, model) mesh composition: a compute-bound training
+    # step rate — the default training band, named here so the config
+    # is explicitly calibrated rather than silently defaulted
+    "tp_dp_steps_per_sec": 0.25,
 }
 
 

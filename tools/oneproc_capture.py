@@ -1083,6 +1083,110 @@ def _overlap_smoke(bench):
             "interleaved_bucket_spans": len(buckets_between)}
 
 
+def _tp_dp_smoke(bench):
+    """2-D mesh composition smoke (round 20): run ``tp_dp`` at a small
+    size and assert (a) exactly ONE compile for the overlapped 2-D
+    step, (b) the overlapped step beat (or matched) the baseline 2-D
+    step at identical comm bytes, (c) the elastic 2-D ZeRO reshard
+    round-trip was bit-exact, and — on a multi-device host — (d) all
+    13 lint rules came back clean (the bench raises on any finding or
+    skipped rule, so 0 here is load-bearing) and (e) the telemetry
+    JSONL carries per-axis collective events for BOTH mesh axes (the
+    DP/TP separability the per-axis rollup exists for). Then (f) a
+    guarded 2-D step with a NaN injected at step 1 skips and reverts
+    params + the DP-scoped EF residual bit-exactly. Raises on any
+    missing piece so the stage shows up as ERROR rather than silently
+    passing."""
+    import glob
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import mesh2d
+
+    multi = len(jax.devices()) >= 2 and len(jax.devices()) % 2 == 0
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_tp_dp_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            ret = bench.bench_tp_dp(2, 2, hidden=64, layers=2, heads=4,
+                                    vocab=64, seq=16)
+        telemetry.get_registry().flush()
+    finally:
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+    if ret["compile_count"] != 1:
+        raise RuntimeError(
+            f"tp_dp smoke: compile_count == {ret['compile_count']!r}, "
+            f"wanted exactly 1")
+    if ret["overlapped_step_ms"] > ret["baseline_step_ms"]:
+        raise RuntimeError(
+            f"tp_dp smoke: overlapped 2-D step "
+            f"({ret['overlapped_step_ms']} ms) did not beat the "
+            f"baseline 2-D step ({ret['baseline_step_ms']} ms)")
+    if not ret["reshard_bitexact"]:
+        raise RuntimeError("tp_dp smoke: elastic 2-D reshard "
+                           "round-trip not bit-exact")
+    if multi and ret["lint_violations"] != 0:
+        raise RuntimeError(
+            f"tp_dp smoke: lint_violations == "
+            f"{ret['lint_violations']!r}, wanted 0")
+    if multi:
+        events = []
+        for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+            with open(p) as f:
+                events.extend(json.loads(line) for line in f
+                              if line.strip())
+        axes = {e.get("axis") for e in events
+                if e.get("kind") == "collective"}
+        if not {"data", "model"} <= axes:
+            raise RuntimeError(
+                f"tp_dp smoke: per-axis collective events missing "
+                f"from the JSONL (saw axes {sorted(a for a in axes if a)})")
+    # (f) guard skip-revert on the 2-D mesh: step 1 is poisoned at the
+    # embedding output; params AND the bucket-domain DP residual must
+    # come back bit-identical
+    mesh = mesh2d.mesh_2d(2 if multi else 1, None if multi else 1)
+    sp = mesh2d.gpt2_init(hidden=32, layers=2, heads=4, vocab=32,
+                          max_seq=8)
+    tokens, labels = mesh2d.make_batch(mesh, batch_per_replica=2,
+                                       seq=8, vocab=32)
+    step, state = mesh2d.build_train_step(
+        mesh, sp, hidden=32, heads=4, mode="guarded", guard_nan_step=1)
+    out = step(*state, jnp.zeros((), jnp.int32), tokens, labels)
+    if int(out[2].total_skips) != 0:
+        raise RuntimeError("tp_dp smoke: clean 2-D step was skipped")
+    before = jax.tree_util.tree_map(np.asarray, (out[0], out[1]))
+    out2 = step(out[0], out[1], out[2], jnp.ones((), jnp.int32),
+                tokens, labels)
+    if int(out2[2].total_skips) != 1:
+        raise RuntimeError("tp_dp smoke: the poisoned 2-D step was "
+                           "not skipped")
+    for b_leaf, a_leaf in zip(
+            jax.tree_util.tree_leaves(before),
+            jax.tree_util.tree_leaves((out2[0], out2[1]))):
+        if not np.array_equal(b_leaf, np.asarray(a_leaf)):
+            raise RuntimeError("tp_dp smoke: guard skip did not revert "
+                               "bit-exactly on the 2-D mesh")
+    return {"telemetry_dir": tel_dir,
+            "compile_count": ret["compile_count"],
+            "baseline_step_ms": ret["baseline_step_ms"],
+            "overlapped_step_ms": ret["overlapped_step_ms"],
+            "lint_violations": ret["lint_violations"],
+            "reshard_bitexact": ret["reshard_bitexact"],
+            "measured_comm_bytes_per_axis":
+                ret["measured_comm_bytes_per_axis"],
+            "guard_skip_revert": "bit-exact"}
+
+
 def _recovery_smoke(bench):
     """Supervised-recovery smoke (round 13): run ``ddp_recovery`` (the
     all-in-one chaos acceptance — NaN escalation + synthetic OOM +
@@ -1178,6 +1282,7 @@ def _stages(smoke):
             ("lint", None, lambda: _lint_smoke(bench)),
             ("sharding", None, lambda: _sharding_smoke(bench)),
             ("overlap", None, lambda: _overlap_smoke(bench)),
+            ("tp_dp", None, lambda: _tp_dp_smoke(bench)),
             ("kernels", None, lambda: _kernels_smoke(bench)),
             ("trend", None, _trend_gate),
             ("boom", None, lambda: (_ for _ in ()).throw(
@@ -1300,6 +1405,13 @@ def _stages(smoke):
         # step actually beating the bucketed baseline
         ("ddp_overlapped", None, spec("ddp_overlapped")),
         ("overlap", None, lambda: _overlap_smoke(bench)),
+        # round-20 2-D mesh composition captures: the tp_dp config at
+        # bench size (baseline vs overlapped 2-D step at identical comm
+        # bytes, per-axis static-vs-measured within the 25% gate, all
+        # 13 rules clean, one compile, reshard_bitexact) and the smoke
+        # proving the per-axis events + the guarded 2-D skip-revert
+        ("tp_dp", None, spec("tp_dp")),
+        ("tp_dp_smoke", None, lambda: _tp_dp_smoke(bench)),
         # round-19 kernel-layer captures: the per-family kernel-vs-XLA
         # timing config (interpret-mode dataflow numbers on cpu-mesh,
         # the real series on TPU) and the smoke proving interpret-mode

@@ -59,6 +59,7 @@ def aggregate(events):
     and counted, never fatal."""
     spans = {}
     collectives = {}
+    collectives_by_axis = {}
     benches = []
     profiler = []
     numerics = {"events": 0, "postmortems": []}
@@ -123,6 +124,13 @@ def aggregate(events):
                 c["calls"] += 1
                 c["wire_bytes"] += int(ev.get("wire_bytes") or 0)
                 c["elements"] += int(ev.get("elements") or 0)
+                # per-mesh-axis rollup (the 2-D mesh composition view:
+                # DP compression savings vs TP psum volume, separable)
+                ax = collectives_by_axis.setdefault(
+                    str(ev.get("axis") or "?"),
+                    {"calls": 0, "wire_bytes": 0})
+                ax["calls"] += 1
+                ax["wire_bytes"] += int(ev.get("wire_bytes") or 0)
             elif kind == "bench":
                 benches.append({k: ev.get(k)
                                 for k in ("name", "value", "unit", "steps",
@@ -376,6 +384,7 @@ def aggregate(events):
                   for name, s in spans.items()},
         "collectives": {f"{op}/{dtype}": c
                         for (op, dtype), c in collectives.items()},
+        "collectives_by_axis": collectives_by_axis,
         "benches": benches,
         "profiler": profiler,
         "numerics": numerics,
@@ -427,6 +436,14 @@ def print_report(report, out=None):
             c = report["collectives"][key]
             w(f"  {key:<28} {c['calls']:>6} {c['elements']:>12} "
               f"{_fmt_bytes(c['wire_bytes']):>12}\n")
+        by_axis = report.get("collectives_by_axis") or {}
+        named = {k: v for k, v in by_axis.items() if k != "?"}
+        if named:
+            w("  per mesh axis:\n")
+            for axis in sorted(by_axis):
+                a = by_axis[axis]
+                w(f"    axis {axis:<24} {a['calls']:>6} call(s) "
+                  f"{_fmt_bytes(a['wire_bytes']):>12}\n")
     if report["benches"]:
         w("\nbench results:\n")
         for b in report["benches"]:
