@@ -78,6 +78,12 @@ class CollectiveOp:
     wire_dtype: str = ""      # semantic wire dtype
     axis_names: Optional[tuple] = None  # best-effort, from the jaxpr
     line: str = ""
+    # fused computation-collective custom_call provenance
+    # (kernels/fused_cc.py): the target name, and the payload/group
+    # the op's frontend attributes declare (0 = not declared)
+    custom_target: Optional[str] = None
+    attr_payload_bytes: int = 0
+    attr_group_size: int = 0
 
     def to_row(self):
         groups = None
@@ -87,7 +93,7 @@ class CollectiveOp:
             groups = [list(p) for p in self.source_target_pairs]
         shape, dtype, _ = (self.operand_specs[0] if self.operand_specs
                            else (None, None, 0))
-        return {
+        row = {
             "op": self.kind, "line": self.lineno,
             "dtype": self.wire_dtype or dtype,
             "shape": list(shape) if shape else None,
@@ -99,6 +105,9 @@ class CollectiveOp:
             "emulated": self.emulated,
             "axes": list(self.axis_names) if self.axis_names else None,
         }
+        if self.custom_target:
+            row["custom_target"] = self.custom_target
+        return row
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +257,35 @@ _HLO_OP_RE = re.compile(
     r"(?:-start)?\(")
 _HLO_TYPE_RE = re.compile(r"([a-z]+\d*(?:e\d+m\d+\w*)?)\[([\d,]*)\]")
 
+# --- fused computation-collective custom_calls (kernels/fused_cc) ---
+# A TPU-lowered fused op subsumes its collective into one custom_call;
+# the target name says WHICH collective, and the op's frontend
+# attributes (``apex_payload_bytes`` / ``apex_group_size``) declare
+# the wire payload and ring size the fused kernel moves.  The auditor
+# prices these exactly like the named collective — a fused program's
+# static_comm_bytes equals its unfused equivalent's, never 0.
+# Mirror of kernels/fused_cc.FUSED_CC_CUSTOM_CALL_TARGETS (kept
+# textual here: the analysis layer parses HLO, it does not import the
+# kernel layer).
+FUSED_CC_TARGETS = {
+    "apex_fused_cc_matmul_all_reduce": "all_reduce",
+    "apex_fused_cc_matmul_reduce_scatter": "reduce_scatter",
+    "apex_fused_cc_all_gather_matmul": "all_gather",
+    "apex_fused_cc_quant4_all_gather": "all_gather",
+}
+_STABLE_CUSTOM_RE = re.compile(
+    r"(%[\w.\-]+)(?::\d+)?\s*=\s*\"?stablehlo\.custom_call\"?\s*"
+    r"(?:@([\w$.\-]+))?\s*\(([^)]*)\)")
+_CUSTOM_TARGET_ATTR_RE = re.compile(
+    r"call_target_name\s*=\s*\"([^\"]+)\"")
+_HLO_CUSTOM_RE = re.compile(
+    r"(%[\w.\-]+)\s*=\s*((?:[a-z0-9]+\[[^\]]*\][^(]*?|\s|,)*?)"
+    r"custom-call\(")
+_HLO_CUSTOM_TARGET_RE = re.compile(
+    r"custom_call_target=\"([^\"]+)\"")
+_ATTR_PAYLOAD_RE = re.compile(r"apex_payload_bytes\s*=\s*\"?(\d+)\"?")
+_ATTR_GROUP_RE = re.compile(r"apex_group_size\s*=\s*\"?(\d+)\"?")
+
 
 def _spec_from_tensor(spec):
     shape, dtype, nbytes = hlo.parse_tensor_type(spec)
@@ -271,6 +309,70 @@ def _region_signature(lines, start):
     return specs, i
 
 
+def _fused_custom_call_stable(line, idx, func):
+    """A stablehlo custom_call whose target is a fused
+    computation-collective kernel, as a priceable CollectiveOp; None
+    for every other line (unknown custom_calls stay unpriced)."""
+    m = _STABLE_CUSTOM_RE.search(line)
+    if m is None:
+        return None
+    target = m.group(2)
+    if target is None:
+        tm = _CUSTOM_TARGET_ATTR_RE.search(line)
+        target = tm.group(1) if tm else None
+    kind = FUSED_CC_TARGETS.get(target or "")
+    if kind is None:
+        return None
+    operands = tuple(_base_var(v) for v in _VAR_RE.findall(m.group(3)))
+    sig = _SIG_RE.search(line)
+    specs = tuple(_spec_from_tensor(t) for t in
+                  hlo._TENSOR_RE.findall(sig.group(1))) if sig else ()
+    groups = None
+    gm = _DENSE_GROUPS_RE.search(line)
+    if gm:
+        groups = _parse_dense_matrix(gm.group(1), gm.group(2))
+    pb = _ATTR_PAYLOAD_RE.search(line)
+    gs = _ATTR_GROUP_RE.search(line)
+    return CollectiveOp(
+        kind=kind, func=func, lineno=idx + 1, result=m.group(1),
+        operands=operands, operand_specs=specs, replica_groups=groups,
+        custom_target=target,
+        attr_payload_bytes=int(pb.group(1)) if pb else 0,
+        attr_group_size=int(gs.group(1)) if gs else 0,
+        line=line.strip())
+
+
+def _fused_custom_call_hlo(s, idx):
+    m = _HLO_CUSTOM_RE.search(s)
+    if m is None:
+        return None
+    tm = _HLO_CUSTOM_TARGET_RE.search(s)
+    kind = FUSED_CC_TARGETS.get(tm.group(1)) if tm else None
+    if kind is None:
+        return None
+    result = m.group(1)
+    paren = s[m.end() - 1:]
+    inner = paren[1:hlo._balanced_span(paren, 0) - 1]
+    operands = tuple(_base_var(v) for v in _VAR_RE.findall(inner)
+                     if _base_var(v) != result)
+    specs = tuple((tuple(int(d) for d in dims.split(",") if d), dt,
+                   _nbytes_hlo(dims, dt))
+                  for dt, dims in _HLO_TYPE_RE.findall(inner))
+    groups = None
+    gb = _HLO_GROUPS_BRACE_RE.search(s)
+    if gb:
+        groups = _parse_brace_groups(gb.group(1))
+    pb = _ATTR_PAYLOAD_RE.search(s)
+    gs = _ATTR_GROUP_RE.search(s)
+    return CollectiveOp(
+        kind=kind, func="", lineno=idx + 1, result=result,
+        operands=operands, operand_specs=specs, replica_groups=groups,
+        custom_target=tm.group(1),
+        attr_payload_bytes=int(pb.group(1)) if pb else 0,
+        attr_group_size=int(gs.group(1)) if gs else 0,
+        line=s)
+
+
 def _stablehlo_collectives(text, graph):
     lines = text.splitlines()
     func = ""
@@ -281,6 +383,9 @@ def _stablehlo_collectives(text, graph):
             func = fm.group(1)
         m = _STABLE_OP_RE.search(line)
         if m is None:
+            fused = _fused_custom_call_stable(line, idx, func)
+            if fused is not None:
+                ops.append(fused)
             continue
         result, kind, operands_raw = m.group(1), m.group(2), m.group(3)
         operands = tuple(_base_var(v)
@@ -321,6 +426,9 @@ def _hlo_collectives(text, graph):
         s = line.strip()
         m = _HLO_OP_RE.search(s)
         if m is None:
+            fused = _fused_custom_call_hlo(s, idx)
+            if fused is not None:
+                ops.append(fused)
             continue
         result, kind = m.group(1), m.group(3).replace("-", "_")
         paren = s[m.end() - 1:]
@@ -472,10 +580,19 @@ def collective_graph(text):
         if op.replica_groups:
             op.group_size = max((len(g) for g in op.replica_groups),
                                 default=1)
+        elif op.attr_group_size:
+            # fused custom_call: the ring size its frontend attribute
+            # declares (no replica_groups on a custom_call)
+            op.group_size = op.attr_group_size
         elif op.kind == "collective_permute":
             op.group_size = len({d for p in (op.source_target_pairs
                                              or ()) for d in p}) or 1
         payload, dtype, emulated = _semantic_payload(op, graph)
+        if op.attr_payload_bytes:
+            # fused custom_call: the declared wire payload wins over
+            # the operand bytes (the op's operands include the
+            # non-collective GEMM inputs)
+            payload = op.attr_payload_bytes
         op.payload_bytes = int(payload)
         op.wire_dtype = dtype
         op.emulated = emulated

@@ -84,18 +84,29 @@ def _dequantize_jnp(q2d, scales):
     return q2d.astype(jnp.float32) * scales
 
 
+def _pad_even_lanes(q2d):
+    """A ragged tail block (lane count not a multiple of the pack
+    width) pads ONE zero lane so the split-half nibble layout stays
+    well-formed; ``unpack(..., n=)`` drops it on the way back."""
+    if q2d.shape[1] % 2:
+        q2d = jnp.pad(q2d, ((0, 0), (0, 1)))
+    return q2d
+
+
 def _pack_jnp(q2d):
+    q2d = _pad_even_lanes(q2d)
     h = q2d.shape[1] // 2
     lo = q2d[:, :h].astype(jnp.int32) & 0xF
     hi = q2d[:, h:].astype(jnp.int32) & 0xF
     return (lo | (hi << 4)).astype(jnp.uint8)
 
 
-def _unpack_jnp(p2d):
+def _unpack_jnp(p2d, n=None):
     p = p2d.astype(jnp.int32)
     lo = ((p & 0xF) ^ 8) - 8
     hi = (((p >> 4) & 0xF) ^ 8) - 8
-    return jnp.concatenate([lo, hi], axis=1).astype(jnp.int8)
+    out = jnp.concatenate([lo, hi], axis=1).astype(jnp.int8)
+    return out if n is None else out[:, :n]
 
 
 # ---------------------------------------------------------------------------
@@ -185,18 +196,23 @@ def dequantize_int4(q2d, scales):
 
 
 def pack_int4(q2d):
-    """[nb, B] int4 codes -> [nb, B/2] uint8 split-half nibbles."""
+    """[nb, B] int4 codes -> [nb, ceil(B/2)] uint8 split-half nibbles
+    (a ragged odd-B tail pads one zero lane)."""
     if GATE.enabled():
+        q2d = _pad_even_lanes(q2d)
+
         def k(q_ref, p_ref):
             _pack_kernel(q_ref, p_ref)
         return _cellwise(k, jnp.uint8, q2d.shape[1] // 2, q2d)
     return _pack_jnp(q2d)
 
 
-def unpack_int4(p2d):
-    """[nb, B/2] uint8 nibbles -> [nb, B] int4-valued int8 codes."""
+def unpack_int4(p2d, n=None):
+    """[nb, B/2] uint8 nibbles -> [nb, B] int4-valued int8 codes;
+    ``n`` truncates a ragged tail's pad lane back off."""
     if GATE.enabled():
         def k(p_ref, q_ref):
             _unpack_kernel(p_ref, q_ref)
-        return _cellwise(k, jnp.int8, p2d.shape[1] * 2, p2d)
-    return _unpack_jnp(p2d)
+        out = _cellwise(k, jnp.int8, p2d.shape[1] * 2, p2d)
+        return out if n is None else out[:, :n]
+    return _unpack_jnp(p2d, n)

@@ -169,6 +169,11 @@ class KernelRegistry:
             return
         reg.counter("kernels/dispatch").inc()
         reg.counter(f"kernels/{name}/{path}").inc()
+        # flat per-(kernel, path) counter: lands in every summary's
+        # ``counters`` dict, so bench JSONs prove which path actually
+        # ran — a silent oracle fallback shows up as
+        # ``kernels/dispatch/<name>_oracle`` instead of vanishing
+        reg.counter(f"kernels/dispatch/{name}_{path}").inc()
         reg.event("kernel", "dispatch", kernel=name, path=path, **fields)
 
 

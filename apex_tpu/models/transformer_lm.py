@@ -867,6 +867,24 @@ class ParallelAttention(nn.Module):
                     softcap=cfg.attn_logit_softcapping)
                 ctx = ctx.reshape(1, b, np_local * kv)
                 return self._output_proj(cfg, ctx)
+        if (s > 1 and initialized
+                and cfg.position_embedding_type != "alibi"):
+            # speculative verify window (and any multi-token decode
+            # chunk): one flash kernel over the s-position window
+            # instead of materializing [b, g, rep, s, T] scores
+            # (kernels/fused_cc, family b)
+            from apex_tpu.kernels import fused_cc
+
+            if fused_cc.use_window(kv_len):
+                import math
+
+                sm = 1.0 / math.sqrt(cfg.query_pre_attn_scalar or kv)
+                ctx = fused_cc.window_attention(
+                    qg, kt, vt, offset, sm,
+                    window=self._layer_window(),
+                    softcap=cfg.attn_logit_softcapping)
+                ctx = ctx.reshape(s, b, np_local * kv)
+                return self._output_proj(cfg, ctx)
         scores = jnp.einsum("sbgrd,tbgd->bgrst", qg, kt,
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(

@@ -462,16 +462,28 @@ def _all_gather_int4(shard, axis_name, *, block_size=BLOCK_SIZE):
     LOCAL two-level scales (nothing is summed, so no pmax), PACKS the
     nibbles (apex_tpu.kernels.quant4 split-half format), and ships
     uint8 half-bytes + uint8 block scales + one fp32 per rank — real
-    4-bit wire traffic through XLA today, like the int8 gather."""
+    4-bit wire traffic through XLA today, like the int8 gather.
+
+    When the ``fused_cc`` gate is live, quantize+pack runs as ONE
+    kernel into the collective send and unpack+dequant as one kernel
+    out of the receive (kernels/fused_cc family c): the int4 code
+    tensor never round-trips HBM on either side of the ring.  Wire
+    payloads, scales, and telemetry are identical either way."""
+    from apex_tpu.kernels import fused_cc as _fused_cc
+
     x2d = pad_to_blocks(shard.astype(jnp.float32), block_size)
     nb = x2d.shape[0]
     absmax = jnp.maximum(jnp.max(jnp.abs(x2d), axis=-1, keepdims=True),
                          1e-12)
     sq, gmax = _quant4.int4_block_scales(absmax)
     scales = _quant4.effective_scales(sq, gmax)
-    _quant4.record()
-    q = _quant4.quantize_int4(x2d, scales)
-    packed = _quant4.pack_int4(q)
+    fused = _fused_cc.GATE.enabled()
+    if fused:
+        packed = _fused_cc.quantize_pack_int4(x2d, scales)
+    else:
+        _quant4.record()
+        q = _quant4.quantize_int4(x2d, scales)
+        packed = _quant4.pack_int4(q)
     for elems, dt in ((packed.size, jnp.uint8), (sq.size, jnp.uint8),
                       (1, jnp.float32)):
         _telemetry_comm.record_collective(
@@ -480,10 +492,13 @@ def _all_gather_int4(shard, axis_name, *, block_size=BLOCK_SIZE):
     p_full = lax.all_gather(packed, axis_name, tiled=True)
     sq_full = lax.all_gather(sq, axis_name, tiled=True)
     gmax_full = lax.all_gather(gmax.reshape(1), axis_name, tiled=True)
-    q_full = _quant4.unpack_int4(p_full)
     s_full = sq_full.astype(jnp.float32) * (
         jnp.repeat(gmax_full, nb).reshape(-1, 1)
         / jnp.float32(255.0 * _quant4.QMAX4))
+    if fused:
+        return _fused_cc.unpack_dequantize_int4(p_full,
+                                                s_full).reshape(-1)
+    q_full = _quant4.unpack_int4(p_full)
     return dequantize_blockwise(q_full, s_full)
 
 

@@ -216,9 +216,13 @@ def _ln(p, x, eps=1e-5):
     return (x - mu) / jnp.sqrt(var + eps) * p["g"] + p["b"]
 
 
-def _attn(p, x, head_dim):
+def _attn(p, x, head_dim, fused=False):
     """Column-parallel QKV (local heads) -> causal attention ->
-    row-parallel output projection joined by ONE TP psum."""
+    row-parallel output projection joined by ONE TP psum.  With
+    ``fused`` the projection+psum runs as the fused
+    computation-collective kernel (``kernels/fused_cc.py``): the GEMM
+    is tiled and each tile's psum fires as it completes, so the full
+    fp32 partial never materializes — same wire bytes, same grads."""
     xp = _copy_to(x, MODEL_AXIS)       # identity fwd / psum(dx) bwd
     q = xp @ p["wq"] + p.get("bq", 0.0)
     k = xp @ p["wk"] + p.get("bk", 0.0)
@@ -233,21 +237,29 @@ def _attn(p, x, head_dim):
     scores = jnp.where(causal, scores, -1e9)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
                      v).reshape(b, s, local)
-    partial = ctx @ p["wo"]            # [.., h/tp] @ [h/tp, h]
-    out = _reduce_from(partial, MODEL_AXIS)   # psum fwd / identity bwd
+    if fused:
+        from apex_tpu.kernels import fused_cc
+        out = fused_cc.matmul_reduce_from(ctx, p["wo"], MODEL_AXIS)
+    else:
+        partial = ctx @ p["wo"]        # [.., h/tp] @ [h/tp, h]
+        out = _reduce_from(partial, MODEL_AXIS)  # psum fwd / id bwd
     return out + p.get("bo", 0.0)
 
 
-def _mlp(p, x):
+def _mlp(p, x, fused=False):
     xp = _copy_to(x, MODEL_AXIS)
     h = jax.nn.gelu(xp @ p["wi"] + p.get("bi", 0.0))
-    out = _reduce_from(h @ p["wo"], MODEL_AXIS)
+    if fused:
+        from apex_tpu.kernels import fused_cc
+        out = fused_cc.matmul_reduce_from(h, p["wo"], MODEL_AXIS)
+    else:
+        out = _reduce_from(h @ p["wo"], MODEL_AXIS)
     return out + p.get("bo", 0.0)
 
 
-def _block(p, x, head_dim):
-    x = x + _attn(p["attn"], _ln(p["ln1"], x), head_dim)
-    x = x + _mlp(p["mlp"], _ln(p["ln2"], x))
+def _block(p, x, head_dim, fused=False):
+    x = x + _attn(p["attn"], _ln(p["ln1"], x), head_dim, fused=fused)
+    x = x + _mlp(p["mlp"], _ln(p["ln2"], x), fused=fused)
     return x
 
 
@@ -257,7 +269,8 @@ def _xent(logits, labels):
     return -jnp.mean(picked)
 
 
-def gpt2_segments(labels, layers, head_dim, *, poison=None):
+def gpt2_segments(labels, layers, head_dim, *, poison=None,
+                  fused=False):
     """The per-layer segment chain ``segments[k](params_k, carry) ->
     carry`` for :class:`~apex_tpu.parallel.overlap.
     OverlappedDataParallel`: segment 0 embeds the token batch, the last
@@ -270,14 +283,14 @@ def gpt2_segments(labels, layers, head_dim, *, poison=None):
         x = emb["wte"][tokens] + emb["wpe"][:tokens.shape[1]]
         if poison is not None:
             x = x + poison
-        return _block(p["layer"], x, head_dim)
+        return _block(p["layer"], x, head_dim, fused=fused)
 
     def seg_mid(p, x):
-        return _block(p["layer"], x, head_dim)
+        return _block(p["layer"], x, head_dim, fused=fused)
 
     def seg_last(p, x):
         if "layer" in p:
-            x = _block(p["layer"], x, head_dim)
+            x = _block(p["layer"], x, head_dim, fused=fused)
         x = _ln(p["ln_f"], x)
         return _xent(x @ p["head"]["w"], labels)
 
@@ -292,11 +305,12 @@ def gpt2_segments(labels, layers, head_dim, *, poison=None):
     return ([seg0] + [seg_mid] * (layers - 2) + [seg_last])
 
 
-def gpt2_loss(seg_params, tokens, labels, head_dim, *, poison=None):
+def gpt2_loss(seg_params, tokens, labels, head_dim, *, poison=None,
+              fused=False):
     """The whole-model loss (the un-segmented view the baseline step
     differentiates): run the segment chain sequentially."""
     segs = gpt2_segments(labels, len(seg_params), head_dim,
-                         poison=poison)
+                         poison=poison, fused=fused)
     carry = tokens
     for fn, p in zip(segs, seg_params):
         carry = fn(p, carry)
@@ -348,7 +362,7 @@ def place_state(mesh, seg_params, *extra):
 def build_train_step(mesh, seg_params, *, hidden, heads,
                      mode="overlapped", compress="int8", lr=0.05,
                      fold_average=True, message_size=10000000,
-                     guard_nan_step=None, donate=True):
+                     guard_nan_step=None, donate=True, fused=False):
     """One jitted 2-D train step.
 
     ``mode="baseline"``: full backward, then the bucketed DP sync
@@ -365,6 +379,11 @@ def build_train_step(mesh, seg_params, *, hidden, heads,
     ``step(sp, res, gst, step_idx, tokens, labels) -> (sp, res, gst,
     loss)``; ``guard_nan_step`` arms ``faults.inject_nan`` at the
     embedding output.
+
+    ``fused=True`` routes the TP row-parallel projections through
+    ``kernels/fused_cc.matmul_reduce_from`` (tiled GEMM+psum, no fp32
+    partial in HBM) — identical wire bytes and gradients, gated by the
+    ``fused_cc`` kernel registry entry.
 
     Returns ``(jitted_step, state)`` where ``state`` is the placed
     carry tuple (params, residual[, guard state]).
@@ -391,8 +410,8 @@ def build_train_step(mesh, seg_params, *, hidden, heads,
 
         def fn(sp, res, tokens, labels):
             loss, grads = jax.value_and_grad(
-                lambda q: gpt2_loss(q, tokens, labels, head_dim))(
-                    tuple(sp))
+                lambda q: gpt2_loss(q, tokens, labels, head_dim,
+                                    fused=fused))(tuple(sp))
             if stateful:
                 grads, res = ddp.sync(grads, res)
             else:
@@ -410,7 +429,8 @@ def build_train_step(mesh, seg_params, *, hidden, heads,
 
         if mode == "overlapped":
             def fn(sp, res, tokens, labels):
-                segs = gpt2_segments(labels, layers, head_dim)
+                segs = gpt2_segments(labels, layers, head_dim,
+                                     fused=fused)
                 if stateful:
                     loss, synced, res = odp.value_and_sync(
                         segs, list(sp), tokens, residual=res)
@@ -424,7 +444,7 @@ def build_train_step(mesh, seg_params, *, hidden, heads,
                     jnp.zeros((), jnp.float32), step_idx,
                     nan_step=guard_nan_step)
                 segs = gpt2_segments(labels, layers, head_dim,
-                                     poison=poison)
+                                     poison=poison, fused=fused)
                 loss, synced, new_res, flag = odp.value_and_sync(
                     segs, list(sp), tokens, residual=res)
 

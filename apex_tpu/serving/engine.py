@@ -100,6 +100,11 @@ class ServeConfig:
     prefix_cache: bool = False
     prefix_min_len: int = 4
     prefix_max_entries: int = 8
+    # route the k+1-position verify attention through the fused
+    # flash-window kernel (kernels/fused_cc.window_attention) when the
+    # fused_cc gate is live; False pins the einsum formulation for
+    # this engine's traced executables regardless of the gate
+    fused_verify: bool = True
 
 
 class ServeEngine:
@@ -258,7 +263,10 @@ class ServeEngine:
         prefill_tag = "seeded_prefill" if self._prefix else "prefill"
         donate = ((0, 1) if self._spec_decode else (0,)) \
             if config.donate else ()
-        with tmemory.oom_guard(registry=registry, labels=labels):
+        from apex_tpu.kernels import fused_cc as _fused_cc
+
+        with tmemory.oom_guard(registry=registry, labels=labels), \
+                _fused_cc.verify_scope(config.fused_verify):
             for b in self.config.batch_buckets:
                 args = self._decode_args(
                     self._ids_aval(b), self._ids_aval(b), self._key0,

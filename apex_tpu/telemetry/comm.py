@@ -32,6 +32,11 @@ from apex_tpu.telemetry.registry import get_registry
 _SHARD_OPS = {"all_gather"}
 # allreduce-shaped ops: two ring phases
 _TWO_PHASE_OPS = {"psum", "pmax", "pmin", "all_reduce"}
+# point-to-point shifts: every rank ships its whole payload once
+# (the ring steps inside kernels/fused_cc price per-step, so g-1
+# recorded permutes of payload/g == one reduce-scatter of payload —
+# same convention as analysis/sharding.wire_bytes_for)
+_FULL_OPS = {"ppermute", "collective_permute"}
 
 
 def axis_label(axis_name):
@@ -72,6 +77,8 @@ def wire_bytes(op, payload_bytes, world):
         return 2.0 * (world - 1) / world * payload_bytes
     if op in _SHARD_OPS:
         return float((world - 1) * payload_bytes)
+    if op in _FULL_OPS:
+        return float(payload_bytes)
     # psum_scatter and anything one-phase
     return (world - 1) / world * payload_bytes
 

@@ -1428,6 +1428,182 @@ def bench_kernels(size, steps):
           **_comm_fields(training=False), **fields)
 
 
+def bench_fused_cc(size, steps):
+    """Fused computation-collective kernels (apex_tpu.kernels.fused_cc,
+    round-20 capture contract): each family runs the SAME computation
+    twice — fused gate on (Pallas; interpreter on this CPU container,
+    same honesty caveat as the ``kernels`` config) and gate off (the
+    unfused compute-then-collective oracle) — and emits
+    ``fused_cc_<family>_fused_ms`` / ``_unfused_ms`` / ``_speedup``
+    plus the headline geomean. Two invariants are ENFORCED, not just
+    reported: the static auditor's wire bytes over the fused lowering
+    must EQUAL the unfused lowering's (a fused op is priced, never
+    dropped — the run raises otherwise), and the traced-jaxpr count of
+    the eliminated HBM intermediates (pre-psum fp32 partial,
+    dequantized KV tensor, int4 code tensor) must strictly drop
+    (emitted as ``hbm_intermediates_{unfused,fused}_<family>``)."""
+    import math
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import telemetry
+    from apex_tpu.analysis.sharding import static_comm_bytes
+    from apex_tpu.kernels import fused_cc
+    from apex_tpu.kernels.registry import get_kernel_registry
+    from apex_tpu.parallel import compression
+
+    kreg = get_kernel_registry()
+    on_tpu = _backend_verdict() == "tpu"
+    devices = jax.devices()
+    g = len(devices)
+    mesh = Mesh(np.asarray(devices), ("model",))
+
+    def sm(fn, in_specs, out_specs):
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+    rng = np.random.RandomState(0)
+    rows, kdim, n = int(size), 128, 256
+    x = jnp.asarray(rng.randn(rows, kdim).astype(np.float32))
+    wfull = jnp.asarray(rng.randn(g * kdim, n).astype(np.float32))
+
+    # family a: row-parallel matmul + TP psum (the mesh2d projection)
+    def mm_make():
+        def inner(xs, ws):
+            return fused_cc.matmul_reduce_from(xs, ws, "model")
+        return sm(inner, (P(), P("model")), P())
+    mm_args = (x, wfull)
+
+    # family b: int8-KV verify window (the speculative engine layout)
+    T, wwin, gq, rep, d = 256, 5, 4, 2, 64
+    feat = gq * d
+    kq, ks = compression.quantize_rows_blockwise(
+        jnp.asarray(rng.randn(T, feat).astype(np.float32)))
+    vq, vs = compression.quantize_rows_blockwise(
+        jnp.asarray(rng.randn(T, feat).astype(np.float32)))
+    qwin = jnp.asarray(
+        rng.randn(wwin, gq, rep, d).astype(np.float32))
+    sm_scale = 1.0 / math.sqrt(d)
+
+    def verify_make():
+        def f(q, kq_, ks_, vq_, vs_):
+            return fused_cc.spec_verify_attention(
+                q, kq_, ks_, vq_, vs_, T - wwin, sm_scale, block_t=64)
+        return f
+    verify_args = (qwin, kq, ks, vq, vs)
+
+    # family c: quantize-into-ring int4 gather (the ZeRO wire format)
+    nflat = max(rows, 256) // 256 * 256 * 4
+    gather_full = jnp.asarray(
+        rng.randn(g * nflat).astype(np.float32))
+
+    def ring_make():
+        def inner(sh):
+            return compression._all_gather_int4(sh, "model")
+        return sm(inner, (P("model"),), P())
+    ring_args = (gather_full,)
+
+    def leg_env(fused_on):
+        key = "APEX_TPU_KERNEL_FUSED_CC"
+        old = os.environ.get(key)
+        os.environ[key] = "1" if fused_on else "0"
+        if fused_on and not on_tpu:
+            kreg.force_interpret(True, ["fused_cc"])
+        return old
+
+    def leg_restore(old):
+        key = "APEX_TPU_KERNEL_FUSED_CC"
+        if old is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = old
+        kreg.force_interpret(False, ["fused_cc"])
+
+    def time_leg(make_fn, args, fused_on):
+        old = leg_env(fused_on)
+        try:
+            fn = jax.jit(make_fn())
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / steps * 1e3
+        finally:
+            leg_restore(old)
+
+    def static_leg(make_fn, args, fused_on):
+        old = leg_env(fused_on)
+        try:
+            text = jax.jit(make_fn()).lower(*args).as_text()
+            return static_comm_bytes(text)
+        finally:
+            leg_restore(old)
+
+    def count_leg(make_fn, args, fused_on, predicate):
+        old = leg_env(fused_on)
+        try:
+            closed = jax.make_jaxpr(make_fn())(*args)
+            return fused_cc.count_jaxpr_avals(closed, predicate)
+        finally:
+            leg_restore(old)
+
+    families = [
+        ("matmul_psum", mm_make, mm_args, True,
+         fused_cc.shape_predicate((rows, n), jnp.float32)),
+        ("verify", verify_make, verify_args, False,
+         fused_cc.shape_predicate((T, gq, d), jnp.float32)),
+        ("int4_ring", ring_make, ring_args, True,
+         fused_cc.dtype_predicate(jnp.int8)),
+    ]
+    reg = telemetry.get_registry()
+    fields = {}
+    speedups = []
+    comm_fused_total = 0
+    t_total0 = time.perf_counter()
+    for fam, make, args, has_comm, pred in families:
+        unfused_ms = time_leg(make, args, fused_on=False)
+        fused_ms = time_leg(make, args, fused_on=True)
+        speedup = unfused_ms / fused_ms if fused_ms > 0 else None
+        fields[f"fused_cc_{fam}_fused_ms"] = round(fused_ms, 3)
+        fields[f"fused_cc_{fam}_unfused_ms"] = round(unfused_ms, 3)
+        fields[f"fused_cc_{fam}_speedup"] = (
+            round(speedup, 3) if speedup is not None else None)
+        if speedup:
+            speedups.append(speedup)
+        if has_comm:
+            cb_unfused = static_leg(make, args, fused_on=False)
+            cb_fused = static_leg(make, args, fused_on=True)
+            if cb_fused != cb_unfused:
+                raise RuntimeError(
+                    f"fused_cc/{fam}: static comm bytes diverged — "
+                    f"fused {cb_fused} vs unfused {cb_unfused} (a "
+                    f"fused collective was mispriced or dropped)")
+            fields[f"fused_cc_{fam}_comm_bytes"] = cb_fused
+            comm_fused_total += cb_fused
+        n_unfused = count_leg(make, args, False, pred)
+        n_fused = count_leg(make, args, True, pred)
+        if n_fused >= n_unfused:
+            raise RuntimeError(
+                f"fused_cc/{fam}: HBM intermediates not reduced "
+                f"(fused {n_fused} vs unfused {n_unfused})")
+        fields[f"hbm_intermediates_unfused_{fam}"] = n_unfused
+        fields[f"hbm_intermediates_fused_{fam}"] = n_fused
+        if reg.enabled:
+            reg.event("kernel", "bench", kernel=f"fused_cc_{fam}",
+                      kernel_ms=round(fused_ms, 3),
+                      xla_ms=round(unfused_ms, 3))
+    dt = time.perf_counter() - t_total0
+    geomean = math.exp(sum(math.log(s) for s in speedups)
+                       / len(speedups)) if speedups else 0.0
+    fields["comm_bytes_per_step"] = comm_fused_total
+    _emit("fused_cc_speedup_geomean", geomean, "x", 0, steps, dt,
+          kernel_mode="pallas" if on_tpu else "interpret",
+          world=g, **fields)
+
+
 def bench_ddp_compressed(batch, steps, *, hidden=1024, depth=4):
     """DDP training step with block-quantized int8 gradient collectives
     + error feedback (parallel/compression.py) over ALL visible devices
@@ -2977,6 +3153,7 @@ BENCH_SPECS = {
     "serve_fleet": ((16, 8), bench_serve_fleet),
     "resnet": ((256, 50), bench_resnet),
     "kernels": ((1024, 5), bench_kernels),
+    "fused_cc": ((512, 5), bench_fused_cc),
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
     "ddp_overlapped": ((64, 30), bench_ddp_overlapped),
     "tp_dp": ((4, 10), bench_tp_dp),

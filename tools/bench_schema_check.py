@@ -71,6 +71,12 @@ number ``n`` (old checked-in records stay valid):
   axis-name -> bytes dicts) and the elastic 2-D reshard verdict
   ``reshard_bitexact``; pre-round-20 records carrying any of them are
   flagged.
+- ``n >= 21``: ``fused_cc`` metric lines (the fused
+  computation-collective kernels) must carry the per-family
+  fused-vs-unfused timings (``fused_cc_<family>_{fused,unfused}_ms``)
+  and the HBM-intermediate counts
+  (``hbm_intermediates_{unfused,fused}_<family>``); pre-round-21
+  records carrying any of them are flagged.
 
 Usage::
 
@@ -215,6 +221,23 @@ TP_DP_AXIS_FIELDS = ("measured_comm_bytes_per_axis",
 TP_DP_BOOL_FIELD = "reshard_bitexact"
 TP_DP_REQUIRED_FIELDS = (TP_DP_NUM_FIELDS + TP_DP_AXIS_FIELDS
                          + (TP_DP_BOOL_FIELD,))
+# the fused computation-collective contract (apex_tpu.kernels
+# .fused_cc, round 21): a fused_cc metric line carries per-family
+# fused-vs-unfused timings plus the traced-jaxpr HBM-intermediate
+# counts the bench's strictly-reduced invariant was checked against;
+# pre-round-21 records carrying any of them are flagged
+FUSED_CC_FIELDS_SINCE_ROUND = 21
+FUSED_CC_METRIC_PREFIX = "fused_cc_"
+FUSED_CC_REQUIRED_FIELDS = (
+    "fused_cc_matmul_psum_fused_ms", "fused_cc_matmul_psum_unfused_ms",
+    "fused_cc_verify_fused_ms", "fused_cc_verify_unfused_ms",
+    "fused_cc_int4_ring_fused_ms", "fused_cc_int4_ring_unfused_ms",
+    "hbm_intermediates_unfused_matmul_psum",
+    "hbm_intermediates_fused_matmul_psum",
+    "hbm_intermediates_unfused_verify",
+    "hbm_intermediates_fused_verify",
+    "hbm_intermediates_unfused_int4_ring",
+    "hbm_intermediates_fused_int4_ring")
 COMM_BYTES_SINCE_ROUND = 6
 # bench_error lines grew the wedge/crash discriminator in round 3
 ERROR_KIND_SINCE_ROUND = 3
@@ -452,6 +475,22 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                         f"round {KERNELS_FIELDS_SINCE_ROUND})")
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"kernels field {key!r} must be numeric or "
+                        f"null")
+        is_fused_cc = str(obj.get("metric", "")).startswith(
+            FUSED_CC_METRIC_PREFIX)
+        present_fused = [k for k in FUSED_CC_REQUIRED_FIELDS if k in obj]
+        if present_fused and (round_n is not None
+                              and round_n < FUSED_CC_FIELDS_SINCE_ROUND):
+            bad(f"fused_cc fields {present_fused} are only defined "
+                f"from round {FUSED_CC_FIELDS_SINCE_ROUND}")
+        elif is_fused_cc and (round_n is None
+                              or round_n >= FUSED_CC_FIELDS_SINCE_ROUND):
+            for key in FUSED_CC_REQUIRED_FIELDS:
+                if key not in obj:
+                    bad(f"fused_cc line missing {key!r} (required "
+                        f"since round {FUSED_CC_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"fused_cc field {key!r} must be numeric or "
                         f"null")
         is_ddp_compressed = str(obj.get("metric", "")).startswith(
             DDP_COMPRESSED_METRIC_PREFIX)

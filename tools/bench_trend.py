@@ -56,6 +56,23 @@ PER_METRIC_BAND = {
     # step rate — the default training band, named here so the config
     # is explicitly calibrated rather than silently defaulted
     "tp_dp_steps_per_sec": 0.25,
+    # fused computation-collective geomean: a ratio of two timings of
+    # the same computation, so host noise enters twice — and on
+    # cpu-mesh captures the fused leg runs the Pallas interpreter,
+    # whose constant overhead swings with load
+    "fused_cc_speedup_geomean": 0.40,
+}
+
+# per-config extra timing fields tracked cross-round (lower is
+# better): growth beyond the config's band is a named regression, so
+# a single family can't quietly slow down while the geomean headline
+# is propped up by the other two
+PER_METRIC_TIMING_FIELDS = {
+    "fused_cc_speedup_geomean": (
+        "fused_cc_matmul_psum_fused_ms",
+        "fused_cc_verify_fused_ms",
+        "fused_cc_int4_ring_fused_ms",
+    ),
 }
 
 
@@ -134,6 +151,14 @@ def compare_pair(prev, cur, band):
     if old_cc is not None and new_cc is not None and new_cc > old_cc:
         reg("compile_count", old_cc, new_cc,
             "compile count grew (exact check — no band)")
+    for field in PER_METRIC_TIMING_FIELDS.get(metric, ()):
+        old_t = _num(prev["parsed"].get(field))
+        new_t = _num(cur["parsed"].get(field))
+        if old_t is not None and new_t is not None and old_t > 0 \
+                and new_t > old_t * (1.0 + band):
+            reg(field, old_t, new_t,
+                f"per-family timing grew beyond the "
+                f"{band * 100:.0f}% band")
     return out
 
 

@@ -1284,6 +1284,7 @@ def _stages(smoke):
             ("overlap", None, lambda: _overlap_smoke(bench)),
             ("tp_dp", None, lambda: _tp_dp_smoke(bench)),
             ("kernels", None, lambda: _kernels_smoke(bench)),
+            ("fused_cc", None, lambda: bench.bench_fused_cc(128, 2)),
             ("trend", None, _trend_gate),
             ("boom", None, lambda: (_ for _ in ()).throw(
                 RuntimeError("intentional smoke failure"))),
@@ -1419,6 +1420,10 @@ def _stages(smoke):
         # kernel-backed entry point, and kernel dispatch telemetry
         ("kernels", None, spec("kernels")),
         ("kernels_smoke", None, lambda: _kernels_smoke(bench)),
+        # round-21 fused computation-collective captures: per-family
+        # fused-vs-unfused timings with the static comm-byte parity and
+        # HBM-intermediate reduction invariants enforced in-run
+        ("fused_cc", None, spec("fused_cc")),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

@@ -377,6 +377,21 @@ def aggregate(events):
                 unknown[str(kind)] = unknown.get(str(kind), 0) + 1
         except (TypeError, ValueError, KeyError):
             malformed += 1
+    # the flat kernels/dispatch/<name>_<path> counters
+    # (kernels/registry.py) stay authoritative even when the event
+    # stream dropped dispatch events — fold them into the kernels
+    # table so a silent oracle fallback is visible in every report
+    for cname, val in ((last_summary or {}).get("counters")
+                       or {}).items():
+        if not str(cname).startswith("kernels/dispatch/"):
+            continue
+        base, _, path = cname[len("kernels/dispatch/"):].rpartition("_")
+        if not base or path not in ("pallas", "interpret", "oracle"):
+            continue
+        k = kernels.setdefault(base, {
+            "pallas": 0, "interpret": 0, "oracle": 0,
+            "kernel_ms": None, "xla_ms": None})
+        k[path] = max(k[path], int(val))
     return {
         "events": n_events,
         "spans": {name: dict(s, mean_s=(s["total_s"] / s["count"])
