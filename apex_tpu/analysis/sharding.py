@@ -674,21 +674,73 @@ def jaxpr_collective_axes(jaxpr):
     return out
 
 
+def _jaxpr_collective_operands(jaxpr):
+    """Ordered ``[(hlo_kind, axes, raw_operand_nbytes)]`` with one entry
+    per *operand* of each collective eqn. A multi-leaf ``lax.psum``
+    (e.g. a whole gradient tree in one call) is a single jaxpr eqn but
+    jax lowers it to one single-operand ``all_reduce`` per leaf — the
+    per-operand expansion is what lines the two sides up."""
+    from apex_tpu.analysis.rules import _collective_axes, _iter_subjaxprs
+
+    out = []
+
+    def walk(j):
+        for eqn in j.eqns:
+            kind = JAXPR_TO_HLO_KIND.get(eqn.primitive.name)
+            if kind is not None:
+                axes = _collective_axes(eqn)
+                for v in eqn.invars:
+                    aval = getattr(v, "aval", None)
+                    nbytes = 0
+                    if aval is not None and hasattr(aval, "shape"):
+                        nbytes = 1
+                        for d in aval.shape:
+                            nbytes *= int(d)
+                        nbytes *= int(
+                            getattr(aval.dtype, "itemsize", 4))
+                    out.append((kind, axes, nbytes))
+            for sub in _iter_subjaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return out
+
+
 def annotate_axes(graph, closed_jaxpr):
-    """Attach jaxpr axis names to the graph's ops by order within each
-    kind (1:1 when the text and jaxpr agree — which is exactly what
-    the implicit-reshard rule verifies)."""
+    """Attach jaxpr axis names to the graph's ops by a size-aware
+    subsequence alignment within each kind: jaxpr eqns expand to one
+    entry per operand (a multi-leaf ``psum`` lowers to per-leaf
+    ``all_reduce`` ops), and the text ops are matched in order against
+    the first entry of equal raw operand bytes — skipping jaxpr
+    entries the lowering deduplicated (identical recomputed psums CSE
+    away between jaxpr and StableHLO). Falls back to plain in-order
+    assignment when sizes never line up."""
     if closed_jaxpr is None:
         return graph
     per_kind = {}
-    for kind, axes in jaxpr_collective_axes(closed_jaxpr.jaxpr):
-        per_kind.setdefault(kind, []).append(axes)
+    for kind, axes, nbytes in _jaxpr_collective_operands(
+            closed_jaxpr.jaxpr):
+        per_kind.setdefault(kind, []).append((axes, nbytes))
     cursor = {k: 0 for k in per_kind}
     for op in graph.ops:
         lst = per_kind.get(op.kind)
-        if lst and cursor[op.kind] < len(lst):
-            op.axis_names = tuple(str(a) for a in lst[cursor[op.kind]])
-            cursor[op.kind] += 1
+        if not lst:
+            continue
+        i = cursor[op.kind]
+        if i >= len(lst):
+            continue
+        raw = sum(spec[2] for spec in op.operand_specs)
+        j = i
+        while j < len(lst) and raw and lst[j][1] != raw:
+            j += 1
+        if j < len(lst) and raw and lst[j][1] == raw:
+            op.axis_names = tuple(str(a) for a in lst[j][0])
+            cursor[op.kind] = j + 1
+        else:
+            # sizes never line up from here (reshaped/fused payloads):
+            # degrade to the old in-order pairing for this op
+            op.axis_names = tuple(str(a) for a in lst[i][0])
+            cursor[op.kind] = i + 1
     return graph
 
 

@@ -281,6 +281,34 @@ def tp_dp_overlapped_step():
     return _tp_dp_pieces("overlapped")
 
 
+def pp_tp_dp_step():
+    """The 3-D mesh composition (ISSUE 17): stage-partitioned GPT-2 on
+    a 2x2x2 ``(data, model, pipe)`` mesh under the host-unrolled 1F1B
+    schedule — per-tick ``collective_permute`` stage transfers over
+    ``pipe``, TP activation psums over ``model`` inside every stage,
+    the tied-edge pipe psum, and the bucketed int8 DP grad sync over
+    ``data`` traced into the cooldown tail. THREE collective families
+    with three different replica-group partitions of the same 8
+    devices coexist in one program; every rule must still hold."""
+    from apex_tpu.parallel import mesh2d, pipeline
+
+    devices = jax.devices()
+    if len(devices) % 8:
+        raise RuntimeError(
+            f"pp_tp_dp target needs an 8-divisible device count, got "
+            f"{len(devices)} (run under the virtual 8-device mesh)")
+    mesh = pipeline.mesh_3d(2, 2, 2)
+    hidden, heads, vocab, seq = 32, 4, 64, 8
+    seg_params = mesh2d.gpt2_init(hidden=hidden, layers=2, heads=heads,
+                                  vocab=vocab, max_seq=seq)
+    step, state = pipeline.build_pipeline_step(
+        mesh, seg_params, hidden=hidden, heads=heads, microbatches=2,
+        mode="overlapped")
+    tokens, labels = pipeline.make_batch_3d(mesh, microbatches=2,
+                                            seq=seq, vocab=vocab)
+    return step, state + (tokens, labels), {}
+
+
 @functools.lru_cache(maxsize=2)
 def _tiny_engine(cache_mode="bf16"):
     from apex_tpu.models import GPTModel, TransformerConfig
@@ -334,5 +362,6 @@ TARGETS = {
     "guarded": guarded_step,
     "tp_dp": tp_dp_step,
     "tp_dp_overlapped": tp_dp_overlapped_step,
+    "pp_tp_dp": pp_tp_dp_step,
     "serve_decode": serve_decode_step,
 }

@@ -601,6 +601,166 @@ def reshard_zero_state_2d(full, params, partition_dims, *, dp_world,
     return states
 
 
+def split_params_for_pipe_axis(params, pp_world, *, shared_tail=1):
+    """List of segments (model order; the trailing ``shared_tail``
+    segments are the pipe-REPLICATED tied edge — embeddings / final
+    norm / head) -> list (len ``pp_world``) of per-stage segment
+    lists, each stage's contiguous layer slice plus the shared tail.
+    The host-side view of :func:`apex_tpu.parallel.pipeline.split_stages`
+    composed with the tied-edge replication."""
+    segs = list(params)
+    if shared_tail < 0 or shared_tail > len(segs):
+        raise ValueError(f"shared_tail={shared_tail} out of range for "
+                         f"{len(segs)} segments")
+    owned = segs[:len(segs) - shared_tail]
+    tail = segs[len(segs) - shared_tail:]
+    if pp_world <= 0 or len(owned) % pp_world:
+        raise ValueError(
+            f"{len(owned)} owned segments do not split into "
+            f"pp_world={pp_world} equal stages")
+    per = len(owned) // pp_world
+    return [owned[p * per:(p + 1) * per] + tail
+            for p in range(pp_world)]
+
+
+def consolidate_zero_state_3d(states, params, partition_dims, *,
+                              dp_world, tp_world, pp_world,
+                              shared_tail=1, grad_compress=None,
+                              param_compress=None,
+                              block_size=compression.BLOCK_SIZE,
+                              message_size=10000000, optimizer="zero"):
+    """Host-side: per-``(data, model, pipe)``-coordinate ZeRO shards ->
+    one full 3-D state_dict in the whole-model parameter domain.
+
+    ``states`` is a list (len ``pp_world``, stage order) of the 2-D
+    per-stage inputs :func:`consolidate_zero_state_2d` takes (a list of
+    per-model-rank states). ``params`` is the whole model as a list of
+    segments in model order whose trailing ``shared_tail`` segments are
+    the pipe-replicated tied edge; ``partition_dims`` is the matching
+    segment list of model-axis split tables.
+
+    The canonical flat layout is ``[stage-owned segments in model
+    order] + [shared tail once]`` — independent of ``pp_world``, which
+    is what makes a 2x2x2 run restore bit-identically to 2x2x1 and
+    1x2x2. The shared tail must be BIT-IDENTICAL across stages (its
+    grads are pipe-psummed before the DP sync, so masters, moments and
+    EF residuals stay stage-invariant on a correct program; a mismatch
+    raises rather than silently averaging)."""
+    if len(states) != pp_world:
+        raise ValueError(f"got {len(states)} per-stage states for "
+                         f"pp_world={pp_world}")
+    stage_params = split_params_for_pipe_axis(
+        params, pp_world, shared_tail=shared_tail)
+    stage_dims = split_params_for_pipe_axis(
+        partition_dims, pp_world, shared_tail=shared_tail)
+    kw = dict(dp_world=dp_world, tp_world=tp_world,
+              grad_compress=grad_compress, param_compress=param_compress,
+              block_size=block_size, message_size=message_size,
+              optimizer=optimizer)
+    fulls = [consolidate_zero_state_2d(states[p], stage_params[p],
+                                       stage_dims[p], **kw)
+             for p in range(pp_world)]
+    steps = {int(np.asarray(f["step"])) for f in fulls}
+    if len(steps) != 1:
+        raise ValueError(f"pipeline stages disagree on the step: "
+                         f"{steps} — states from different checkpoints?")
+    tail_n = _flat_size(params[len(params) - shared_tail:]) \
+        if shared_tail else 0
+    full = {
+        "format": 3,
+        "optimizer": optimizer,
+        "dp_world": int(dp_world),
+        "tp_world": int(tp_world),
+        "pp_world": int(pp_world),
+        "shared_tail_elements": int(tail_n),
+        "n_elements": _flat_size(params),
+        "block_size": int(block_size),
+        "grad_compress": grad_compress,
+        "param_compress": param_compress,
+        "step": fulls[0]["step"],
+    }
+    keys = ["master", "exp_avg", "exp_avg_sq"]
+    if all("grad_residual" in f for f in fulls):
+        keys.append("grad_residual")
+    for key in keys:
+        owned_parts, tails = [], []
+        for p in range(pp_world):
+            arr = np.asarray(fulls[p][key], np.float32)
+            if tail_n:
+                owned_parts.append(arr[:arr.size - tail_n])
+                tails.append(arr[arr.size - tail_n:])
+            else:
+                owned_parts.append(arr)
+        for p in range(1, pp_world):
+            if tails and not np.array_equal(tails[0], tails[p]):
+                raise ValueError(
+                    f"{key}: pipe-replicated tail differs between "
+                    f"stages 0 and {p} — the tied-edge pipe-invariance "
+                    f"broke; refusing to consolidate")
+        full[key] = np.concatenate(
+            owned_parts + (tails[:1] if tail_n else []))
+    return full
+
+
+def reshard_zero_state_3d(full, params, partition_dims, *, dp_world,
+                          tp_world, pp_world, shared_tail=1,
+                          grad_compress=None, param_compress=None,
+                          block_size=compression.BLOCK_SIZE,
+                          message_size=10000000, overlap=False):
+    """Host-side inverse of :func:`consolidate_zero_state_3d`: one full
+    state_dict (format 3, or a format-1/2 dict written at ``pp == 1`` —
+    the canonical flat layout is identical) -> the list (len
+    ``pp_world``, stage order) of 2-D per-stage restore inputs, each a
+    list (len ``tp_world``) of per-model-rank 1-D states for the NEW
+    ``(dp, tp, pp)`` topology. Stage slicing, TP slicing and dp-shard
+    padding are all recomputed; masters, moments and the EF residual
+    restore bit-identically."""
+    if full.get("format") not in (1, 2, 3):
+        raise ValueError(f"unknown state_dict format "
+                         f"{full.get('format')!r}")
+    n = _flat_size(params)
+    if full.get("n_elements") not in (None, n):
+        raise ValueError(
+            f"state_dict is for {full['n_elements']} elements, params "
+            f"flatten to {n} — wrong model for this checkpoint")
+    tail_n = _flat_size(params[len(params) - shared_tail:]) \
+        if shared_tail else 0
+    want = full.get("shared_tail_elements")
+    if want is not None and int(want) != tail_n:
+        raise ValueError(
+            f"state_dict's shared tail is {want} elements, params' is "
+            f"{tail_n} — differing tied-edge convention")
+    stage_params = split_params_for_pipe_axis(
+        params, pp_world, shared_tail=shared_tail)
+    stage_dims = split_params_for_pipe_axis(
+        partition_dims, pp_world, shared_tail=shared_tail)
+    owned_sizes = [_flat_size(sp[:len(sp) - shared_tail]
+                              if shared_tail else sp)
+                   for sp in stage_params]
+    keys = ["master", "exp_avg", "exp_avg_sq"]
+    if full.get("grad_residual") is not None:
+        keys.append("grad_residual")
+    out = []
+    off = 0
+    for p in range(pp_world):
+        sub = {"format": 2, "optimizer": full.get("optimizer"),
+               "dp_world": int(dp_world), "tp_world": int(tp_world),
+               "n_elements": _flat_size(stage_params[p]),
+               "step": full["step"]}
+        for key in keys:
+            arr = np.asarray(full[key], np.float32)
+            owned = arr[off:off + owned_sizes[p]]
+            tail = arr[arr.size - tail_n:] if tail_n else arr[:0]
+            sub[key] = np.concatenate([owned, tail])
+        off += owned_sizes[p]
+        out.append(reshard_zero_state_2d(
+            sub, stage_params[p], stage_dims[p], dp_world=dp_world,
+            tp_world=tp_world, grad_compress=grad_compress,
+            param_compress=param_compress, block_size=block_size,
+            message_size=message_size, overlap=overlap))
+    return out
+
+
 def zero_state_bytes(params, *, world, grad_compress=None,
                      param_compress=None,
                      block_size=compression.BLOCK_SIZE, axis_name="dp",
@@ -1006,11 +1166,18 @@ class DistributedFusedAdam:
                   block_size=self.compress_block_size,
                   optimizer=type(self).__name__)
         if isinstance(world, (tuple, list)):
-            dp, tp = world
             if partition_dims is None:
                 raise ValueError(
-                    "state_dict_full: a 2-D world needs partition_dims "
-                    "(the per-leaf model-axis split table)")
+                    "state_dict_full: a 2-D/3-D world needs "
+                    "partition_dims (the per-leaf model-axis split "
+                    "table)")
+            if len(world) == 3:
+                dp, tp, pp = world
+                return consolidate_zero_state_3d(
+                    state, params, partition_dims, dp_world=dp,
+                    tp_world=tp, pp_world=pp,
+                    message_size=self.message_size, **kw)
+            dp, tp = world
             return consolidate_zero_state_2d(
                 state, params, partition_dims, dp_world=dp, tp_world=tp,
                 message_size=self.message_size, **kw)
@@ -1038,12 +1205,19 @@ class DistributedFusedAdam:
                   param_compress=self.param_compress,
                   block_size=self.compress_block_size)
         if isinstance(world, (tuple, list)):
-            dp, tp = world
             if partition_dims is None:
                 raise ValueError(
-                    "load_state_dict_resharded: a 2-D world needs "
+                    "load_state_dict_resharded: a 2-D/3-D world needs "
                     "partition_dims (the per-leaf model-axis split "
                     "table)")
+            if len(world) == 3:
+                dp, tp, pp = world
+                return reshard_zero_state_3d(
+                    full, params, partition_dims, dp_world=dp,
+                    tp_world=tp, pp_world=pp,
+                    message_size=self.message_size,
+                    overlap=bool(self.overlap), **kw)
+            dp, tp = world
             return reshard_zero_state_2d(
                 full, params, partition_dims, dp_world=dp, tp_world=tp,
                 message_size=self.message_size,

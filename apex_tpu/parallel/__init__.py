@@ -27,6 +27,7 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
 from apex_tpu.parallel import mesh2d  # noqa: F401
 from apex_tpu.parallel import multiproc  # noqa: F401
 from apex_tpu.parallel import overlap  # noqa: F401
+from apex_tpu.parallel import pipeline  # noqa: F401
 from apex_tpu.parallel.overlap import (  # noqa: F401
     OverlappedDataParallel,
     overlapped_zero_step,

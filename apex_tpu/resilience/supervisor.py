@@ -377,8 +377,15 @@ def _world_size(world):
 def _half_world(world):
     """The default shrink target when neither the error nor the policy
     pins one: halve an int world; on a tuple world halve the LAST axis
-    whose size exceeds 1 (the model axis of a ``(data, model)`` mesh —
-    ``(2, 4) -> (2, 2)``), falling back to the data axis."""
+    whose size exceeds 1, falling back leftward. Axis order in the
+    tuple therefore IS the give-up policy: ``(data, model)`` halves
+    the model axis first (``(2, 4) -> (2, 2)``); a 3-D
+    ``(data, model, pipe)`` world gives up the pipe axis first
+    (``(2, 2, 2) -> (2, 2, 1)``), the model axis second — pipeline
+    bubbles are the cheapest capability to lose, and the elastic 3-D
+    ZeRO table (:func:`~apex_tpu.contrib.optimizers.
+    distributed_fused_adam.reshard_zero_state_3d`) restores onto the
+    shrunk topology bit-identically."""
     if isinstance(world, (tuple, list)):
         axes = [int(w) for w in world]
         for i in reversed(range(len(axes))):

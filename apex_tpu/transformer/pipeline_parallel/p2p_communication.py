@@ -1,108 +1,21 @@
-"""Pipeline point-to-point communication.
+"""Compat shim: the pipeline p2p helpers moved to
+``apex_tpu.parallel.pipeline`` — the ppermute shift helpers and the
+reference wrapper aliases are re-exported here unchanged (one
+DeprecationWarning per process, shared with the ``schedules`` shim)."""
 
-Parity: reference apex/transformer/pipeline_parallel/p2p_communication.py —
-``_communicate`` (117-~400) with batched isend/irecv, ``send_forward`` /
-``recv_forward`` / ``send_forward_recv_backward`` / ... wrappers, optional
-scatter-gather tensor compression over TP chunks, fp32-or-params dtype.
-
-TPU design: stage-to-stage transfer is ``lax.ppermute`` along the 'pp'
-mesh axis inside one jitted step — XLA lowers it to an ICI
-collective-permute, which is asynchronous and overlapped by the
-latency-hiding scheduler (the role of the reference's batch_isend_irecv +
-FutureTensor). "Scatter-gather optimization" (chunking over the TP group)
-is subsumed by giving the communicated tensor a tp-sharded layout.
-
-All helpers must be called inside ``shard_map`` with the 'pp' axis bound.
-By default boundary ranks receive zeros (non-circular permutes), which
-schedules mask; ``circular=True`` wraps the ring (rank P-1 -> rank 0 and
-back) — the interleaved schedule rides chunk handoffs on the wrap edge.
-
-Payloads may be arbitrary pytrees of arrays (the reference's
-encoder-decoder dual-shape p2p — a (encoder, decoder) activation pair per
-boundary, get_tensor_shapes at ...without_interleaving.py:29-86 — is a
-two-leaf pytree here); each leaf rides its own collective-permute and XLA
-schedules them together.
-"""
-
-from typing import Optional
-
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-from apex_tpu.transformer.parallel_state import (
+from apex_tpu.parallel.pipeline import (  # noqa: F401
     PIPELINE_PARALLEL_AXIS,
-    get_pipeline_model_parallel_world_size,
+    _perm_bwd,
+    _perm_fwd,
+    _warn_moved,
+    recv_backward,
+    recv_forward,
+    send_backward,
+    send_backward_recv_backward,
+    send_backward_recv_forward,
+    send_forward,
+    send_forward_recv_backward,
+    send_forward_recv_forward,
 )
 
-
-def _perm_fwd(world, circular=False):
-    if circular:
-        return [(i, (i + 1) % world) for i in range(world)]
-    return [(i, i + 1) for i in range(world - 1)]
-
-
-def _perm_bwd(world, circular=False):
-    if circular:
-        return [(i, (i - 1) % world) for i in range(world)]
-    return [(i + 1, i) for i in range(world - 1)]
-
-
-def send_forward_recv_forward(output_tensor, axis_name=PIPELINE_PARALLEL_AXIS,
-                              world: Optional[int] = None,
-                              circular: bool = False):
-    """Shift activations one stage forward: rank r's value arrives at r+1;
-    rank 0 receives zeros (or rank P-1's value when ``circular``).
-    (reference recv_forward + send_forward pair)"""
-    world = world or get_pipeline_model_parallel_world_size()
-    if world == 1:
-        return (output_tensor if circular
-                else jax.tree_util.tree_map(jnp.zeros_like, output_tensor))
-    perm = _perm_fwd(world, circular)
-    return jax.tree_util.tree_map(
-        lambda a: lax.ppermute(a, axis_name, perm), output_tensor)
-
-
-def send_backward_recv_backward(input_tensor_grad,
-                                axis_name=PIPELINE_PARALLEL_AXIS,
-                                world: Optional[int] = None,
-                                circular: bool = False):
-    """Shift gradients one stage backward: rank r's value arrives at r-1;
-    the last rank receives zeros (or rank 0's value when ``circular``)."""
-    world = world or get_pipeline_model_parallel_world_size()
-    if world == 1:
-        return (input_tensor_grad if circular
-                else jax.tree_util.tree_map(jnp.zeros_like, input_tensor_grad))
-    perm = _perm_bwd(world, circular)
-    return jax.tree_util.tree_map(
-        lambda a: lax.ppermute(a, axis_name, perm), input_tensor_grad)
-
-
-# Aliases matching the reference wrapper names
-# (fwd_bwd_pipelining_without_interleaving.py:87-240). Under SPMD every
-# rank runs the same ppermute, so send and recv are one op.
-
-def recv_forward(output_tensor, **kw):
-    return send_forward_recv_forward(output_tensor, **kw)
-
-
-def send_forward(output_tensor, **kw):
-    return send_forward_recv_forward(output_tensor, **kw)
-
-
-def recv_backward(input_tensor_grad, **kw):
-    return send_backward_recv_backward(input_tensor_grad, **kw)
-
-
-def send_backward(input_tensor_grad, **kw):
-    return send_backward_recv_backward(input_tensor_grad, **kw)
-
-
-def send_forward_recv_backward(output_tensor, input_tensor_grad, **kw):
-    return (send_forward_recv_forward(output_tensor, **kw),
-            send_backward_recv_backward(input_tensor_grad, **kw))
-
-
-def send_backward_recv_forward(input_tensor_grad, output_tensor, **kw):
-    return (send_backward_recv_backward(input_tensor_grad, **kw),
-            send_forward_recv_forward(output_tensor, **kw))
+_warn_moved("apex_tpu.transformer.pipeline_parallel.p2p_communication")

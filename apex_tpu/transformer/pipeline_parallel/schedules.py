@@ -1,528 +1,24 @@
-"""Pipeline-parallel forward-backward schedules.
+"""Compat shim: the pipeline schedules moved to
+``apex_tpu.parallel.pipeline`` (the 3-D mesh subsystem), which hosts
+the reference-parity schedule machinery unchanged — this module
+re-exports it so the ``apex.transformer.pipeline_parallel.schedules``
+API surface keeps resolving here (one DeprecationWarning per process,
+shared with the ``p2p_communication`` shim; the ``contrib._pallas_gate``
+retirement pattern)."""
 
-Parity: reference apex/transformer/pipeline_parallel/schedules/ —
-``get_forward_backward_func`` (schedules/__init__.py:22-35) selecting
-(a) no-pipelining with grad sync on last microbatch
-    (fwd_bwd_no_pipelining.py:23-124),
-(b) 1F1B non-interleaved (fwd_bwd_pipelining_without_interleaving.py:241-597,
-    warmup math at :345-349),
-(c) interleaved 1F1B with virtual chunks
-    (fwd_bwd_pipelining_with_interleaving.py, get_model_chunk_id scheduling).
-
-TPU design: the reference schedules are eager Python loops over blocking
-NCCL p2p calls. Here both pipelined schedules are ONE jitted SPMD program
-sharing one core (``_pipelined_fwd_bwd`` — non-interleaved is the V=1
-case): a ``lax.fori_loop`` over *global schedule ticks* with
-``lax.ppermute`` moving activations/grads along the 'pp' mesh axis. Three
-phases — a forward-only warmup, a steady state in which every tick
-performs one forward unit AND one backward unit (true 1F1B alternation),
-and a backward-only cooldown — so the executed compute per rank is
-(M + P - 1) * (t_fwd + t_bwd) at V=1, the same pipeline total as the
-reference's 1F1B, instead of the 2*(M + P - 1) full-ticks of a
-phase-split schedule.
-
-Memory is bounded like the reference's 1F1B: only each in-flight
-microbatch's *stage input* is stashed, in a ring buffer whose size is the
-in-flight bound (min(M, 2P-1) at V=1; min(MV, 2VP) interleaved) — O(P·V),
-not O(M) — and the forward is rematerialized inside the backward tick
-(``jax.vjp`` over the stage fn), the TPU-idiomatic activation-recompute
-tradeoff (reference random.py:237-311 makes the same trade when
-activation checkpointing is on).
-
-The loss (for GPT: the full vocab projection) is computed under a
-``lax.cond`` on ``is_last_stage``, so non-last ranks skip it at runtime in
-both the primal and the transpose (reference computes loss_func only on
-the last stage, common.py:305-310).
-
-Stage-fn contract (replaces the reference's forward_step_func protocol,
-common.py:253-324):
-
-    forward_step_func(params, input_tensor, microbatch, is_first_stage)
-        -> output_tensor
-    loss_func(params, output_tensor, microbatch) -> scalar loss
-
-``input_tensor`` is None under the no-pipelining schedule (one stage owns
-the whole model — build the input from the microbatch unconditionally).
-
-Every pp rank holds ``params`` with the same pytree structure (its own
-stage's weights; stacked [V, ...] leaves under interleaving).
-``is_first_stage`` is a traced bool that is True only on the *global*
-first stage (chunk 0 of rank 0 under virtual pipelining) — the stage fn
-builds its input from the microbatch there (embedding) via
-``jnp.where(is_first_stage, embed(mb), input_tensor)``. ``loss_func`` is
-evaluated on the last global stage only.
-"""
-
-from typing import Callable, Optional
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
-
-from apex_tpu.transformer.parallel_state import (
+from apex_tpu.parallel.pipeline import (  # noqa: F401
     PIPELINE_PARALLEL_AXIS,
-    get_pipeline_model_parallel_split_rank,
-    get_pipeline_model_parallel_world_size,
-    get_virtual_pipeline_model_parallel_world_size,
+    _payload_spec,
+    _pipelined_fwd_bwd,
+    _warn_moved,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_with_split,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    listify_model,
+    make_encoder_decoder_step,
+    pipeline_schedule_plan,
 )
-from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
-    send_backward_recv_backward,
-    send_forward_recv_forward,
-)
 
-
-def listify_model(model):
-    if isinstance(model, list):
-        return model
-    return [model]
-
-
-def pipeline_schedule_plan(pp_size: int, num_microbatches: int,
-                           num_model_chunks: int = 1) -> dict:
-    """Static tick/memory plan of the pipelined schedules (pure Python).
-
-    The schedules below derive their loop bounds and stash sizes from this
-    function, so its numbers are the numbers — tests assert on them.
-
-    Forward unit k = round*P*V + c*P + j of (chunk c, microbatch
-    i = round*P + j) runs on rank r at tick k + r — microbatch groups of
-    size P cycling through chunks, the reference's get_model_chunk_id
-    order (V=1 degenerates to k = i) — and its backward mirrors it from
-    tick V*P - 1 (the last global stage's backward shares its forward's
-    tick). Chunk handoffs ride a circular ppermute with exactly-one-tick
-    latency, so rank 0's warmup before its first backward is
-    2(P-1) + (V-1)*P units, the reference's warmup formula
-    (fwd_bwd_pipelining_with_interleaving.py num_warmup_microbatches).
-    """
-    P, M, V = pp_size, num_microbatches, num_model_chunks
-    if V == 1:
-        return {
-            "warmup": P - 1,            # fwd-only ticks
-            "steady": M,                # fwd+bwd ticks
-            "cooldown": P - 1,          # bwd-only ticks
-            "total": M + 2 * P - 2,
-            "fwd_ticks": M + P - 1,     # ticks executing a fwd unit
-            "bwd_ticks": M + P - 1,
-            "stash": min(M, 2 * P - 1),  # in-flight stage inputs: O(P)
-        }
-    return {
-        "warmup": V * P - 1,
-        "steady": M * V,
-        "cooldown": P - 1,
-        "total": M * V + V * P + P - 2,
-        "fwd_ticks": M * V + V * P - 1,
-        "bwd_ticks": M * V + P - 1,
-        "stash": min(M * V, 2 * V * P),  # O(P*V) chunk-stage inputs
-    }
-
-
-def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
-                              pipeline_model_parallel_size=None):
-    """Select a schedule (reference schedules/__init__.py:22-35).
-
-    A pipeline split rank installed via ``initialize_model_parallel``
-    selects the encoder-decoder schedule (the reference routes
-    ``ModelType.encoder_and_decoder`` through the same selector; its
-    interleaved schedule is encoder_or_decoder-only, and so is ours)."""
-    if pipeline_model_parallel_size is None:
-        pipeline_model_parallel_size = get_pipeline_model_parallel_world_size()
-    if virtual_pipeline_model_parallel_size is None:
-        virtual_pipeline_model_parallel_size = (
-            get_virtual_pipeline_model_parallel_world_size())
-    if pipeline_model_parallel_size > 1:
-        if get_pipeline_model_parallel_split_rank() is not None:
-            if virtual_pipeline_model_parallel_size is not None:
-                raise ValueError(
-                    "interleaved (virtual-pipeline) scheduling does not "
-                    "compose with an encoder-decoder split rank")
-            return forward_backward_pipelining_with_split
-        if virtual_pipeline_model_parallel_size is not None:
-            return forward_backward_pipelining_with_interleaving
-        return forward_backward_pipelining_without_interleaving
-    return forward_backward_no_pipelining
-
-
-def forward_backward_no_pipelining(forward_step_func, loss_func, params,
-                                   microbatches, *, num_microbatches,
-                                   grad_scale=1.0, **unused):
-    """Accumulate grads over microbatches without pipelining
-    (reference fwd_bwd_no_pipelining.py:23-124; grad sync deferral to the
-    last microbatch is automatic — sync happens once on the returned
-    accumulated grads)."""
-
-    def one_microbatch(params, mb):
-        def full(p):
-            y = forward_step_func(p, None, mb, jnp.asarray(True))
-            return loss_func(p, y, mb)
-
-        loss, grads = jax.value_and_grad(full)(params)
-        return loss, grads
-
-    def scan_body(carry, mb):
-        loss_sum, grads_acc = carry
-        loss, grads = one_microbatch(params, mb)
-        grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-        return (loss_sum + loss, grads_acc), loss
-
-    zero_grads = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    (loss_sum, grads), losses = lax.scan(
-        scan_body, (jnp.zeros((), jnp.float32), zero_grads), microbatches)
-    n = jnp.asarray(num_microbatches, jnp.float32)
-    grads = jax.tree_util.tree_map(lambda g: g * (grad_scale / n), grads)
-    return losses, grads
-
-
-def _payload_spec(tensor_shape, dtype):
-    """Normalize the boundary-payload description to a pytree of
-    ``jax.ShapeDtypeStruct``. A plain tuple/list of ints (the common
-    single-activation case) becomes one leaf of ``dtype``; anything else
-    is taken as an already-built spec pytree — the encoder-decoder
-    schedule passes a two-leaf dict (reference dual shapes,
-    ...without_interleaving.py:29-86)."""
-    if (isinstance(tensor_shape, (tuple, list))
-            and all(isinstance(d, (int, np.integer)) for d in tensor_shape)):
-        return jax.ShapeDtypeStruct(
-            tuple(int(d) for d in tensor_shape), dtype)
-    return jax.tree_util.tree_map(
-        lambda s: jax.ShapeDtypeStruct(tuple(s.shape), s.dtype),
-        tensor_shape)
-
-
-def _pipelined_fwd_bwd(forward_step_func, loss_func, params, microbatches,
-                       *, M, V, P, tensor_shape, dtype, axis_name,
-                       grad_scale, aux_loss=False):
-    """Shared 3-phase tick machine for both pipelined schedules
-    (see pipeline_schedule_plan for the tick/unit mapping).
-
-    The stage-boundary payload is a pytree (single activation array for
-    GPT-style stacks; an {encoder, decoder} pair for split-rank models);
-    every payload op below — stash, ppermute shift, masking, dtype cast —
-    is tree-mapped over its leaves.
-
-    ``aux_loss=True`` changes the stage contract to
-    ``forward_step_func(...) -> (output_tensor, aux_scalar)``: each
-    unit's backward injects its own stage's auxiliary loss (e.g. MoE
-    router load-balancing, scaled by grad_scale like the main loss)
-    alongside the downstream activation cotangent — total loss =
-    last-stage loss_func + sum of per-unit aux, with aux gradients
-    flowing to earlier stages through the regular backward wave. The
-    reported per-microbatch losses remain the last stage's (loss_func +
-    its own aux) only.
-    """
-    plan = pipeline_schedule_plan(P, M, V)
-    S = plan["stash"]
-    PV, MV = P * V, M * V
-    T0 = V * P - 1  # first backward tick (mb 0 has crossed all V*P stages)
-    rank = lax.axis_index(axis_name)
-    interleaved = V > 1
-    tmap = jax.tree_util.tree_map
-    spec = _payload_spec(tensor_shape, dtype)
-
-    def _mask(pred, tree):
-        return tmap(lambda a: jnp.where(pred, a, jnp.zeros_like(a)), tree)
-
-    def _select(pred, tree_a, tree_b):
-        return tmap(lambda a, b: jnp.where(pred, a, b), tree_a, tree_b)
-
-    def _cast(tree):
-        return tmap(lambda a, s: a.astype(s.dtype), tree, spec)
-
-    def take_mb(i):
-        return jax.tree_util.tree_map(lambda a: a[i], microbatches)
-
-    if interleaved:
-        def take_params(c):
-            return jax.tree_util.tree_map(
-                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
-                params)
-
-        def add_grads(grads, dp, c, active):
-            return jax.tree_util.tree_map(
-                lambda a, d: a.at[c].add(
-                    jnp.where(active, d.astype(jnp.float32), 0.0)),
-                grads, dp)
-    else:
-        def take_params(c):
-            return params
-
-        def add_grads(grads, dp, c, active):
-            return jax.tree_util.tree_map(
-                lambda a, d: a + jnp.where(active, d.astype(jnp.float32),
-                                           0.0),
-                grads, dp)
-
-    def fwd_unit(k):
-        rnd, rem = k // PV, k % PV
-        c, j = rem // P, rem % P
-        return c, rnd * P + j, k % S
-
-    def bwd_unit(kb):
-        rnd, rem = kb // PV, kb % PV
-        c, j = (V - 1) - rem // P, rem % P
-        kf = rnd * PV + c * P + j
-        return c, rnd * P + j, kf % S
-
-    zero_h = tmap(lambda s: jnp.zeros(s.shape, s.dtype), spec)
-
-    def run_stage(p, h, mb, is_first_u):
-        if aux_loss:
-            return forward_step_func(p, h, mb, is_first_u)
-        return (forward_step_func(p, h, mb, is_first_u),
-                jnp.zeros((), jnp.float32))
-
-    def stage_and_maybe_loss(p, h, mb, is_first_u, is_last_u):
-        y, aux = run_stage(p, h, mb, is_first_u)
-        # Only the last global stage pays for loss_func (for GPT: the
-        # vocab projection) — lax.cond skips it at runtime elsewhere, in
-        # both the primal and the transpose. Per-unit aux (module doc)
-        # rides the same loss output.
-        loss = lax.cond(
-            is_last_u,
-            lambda op: loss_func(*op).astype(jnp.float32),
-            lambda op: jnp.zeros((), jnp.float32),
-            (p, y, mb))
-        return y, loss + aux.astype(jnp.float32)
-
-    # state = (stash, y_prev, dx_prev, losses, grads)
-    def fwd_half(t, state):
-        with jax.named_scope("pp_fwd_unit"):
-            xs, y_prev, dx_prev, losses, grads = state
-            recv = send_forward_recv_forward(
-                y_prev, axis_name, world=P, circular=interleaved)
-            k = t - rank
-            active = (k >= 0) & (k < MV)
-            c, i, slot = fwd_unit(jnp.clip(k, 0, MV - 1))
-            mb = take_mb(i)
-            p_c = take_params(c)
-            is_first_u = (rank == 0) & (c == 0)
-            h_in = _cast(_select(is_first_u, zero_h, recv))
-            y, _ = run_stage(p_c, h_in, mb, is_first_u)
-            xs = tmap(
-                lambda buf, h: lax.dynamic_update_index_in_dim(
-                    buf, jnp.where(active, h, buf[slot]), slot, 0),
-                xs, h_in)
-            y_prev = _mask(active, y)
-            return xs, y_prev, dx_prev, losses, grads
-
-    def bwd_half(t, state):
-        with jax.named_scope("pp_bwd_unit"):
-            xs, y_prev, dx_prev, losses, grads = state
-            dy_recv = send_backward_recv_backward(
-                dx_prev, axis_name, world=P, circular=interleaved)
-            kb = t - T0 - (P - 1 - rank)
-            active = (kb >= 0) & (kb < MV)
-            c, i, slot = bwd_unit(jnp.clip(kb, 0, MV - 1))
-            mb = take_mb(i)
-            p_c = take_params(c)
-            is_first_u = (rank == 0) & (c == 0)
-            is_last_u = (rank == P - 1) & (c == V - 1)
-            # the last global stage's backward shares its forward's tick,
-            # and fwd_half runs first in a steady tick, so the slot read
-            # here is the input stashed moments ago; other reads never
-            # collide with this tick's write (ring size >= in-flight).
-            h_in = tmap(lambda buf: buf[slot], xs)
-            (_, loss), pullback = jax.vjp(
-                lambda p, h: stage_and_maybe_loss(p, h, mb, is_first_u,
-                                                  is_last_u), p_c, h_in)
-            dy_cot = _cast(_mask(active & ~is_last_u, dy_recv))
-            # every active unit gets a loss cotangent: the main loss is
-            # cond-gated to the last stage (zero transpose elsewhere),
-            # while per-unit aux losses (if any) pick it up on their
-            # own stage
-            loss_cot = jnp.where(active,
-                                 jnp.asarray(grad_scale, jnp.float32), 0.0)
-            dp_c, dh = pullback((dy_cot, loss_cot))
-            grads = add_grads(grads, dp_c, c, active)
-            losses = losses.at[i].add(
-                jnp.where(active & is_last_u, loss, 0.0))
-            dx_prev = _cast(_mask(active, dh))
-            return xs, y_prev, dx_prev, losses, grads
-
-    zero_grads = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    stash0 = tmap(lambda s: jnp.zeros((S,) + tuple(s.shape), s.dtype), spec)
-    state = (stash0, zero_h, zero_h,
-             jnp.zeros((M,), jnp.float32), zero_grads)
-    w, s = plan["warmup"], plan["steady"]
-    state = lax.fori_loop(0, w, fwd_half, state)
-    state = lax.fori_loop(w, w + s,
-                          lambda t, st: bwd_half(t, fwd_half(t, st)), state)
-    state = lax.fori_loop(w + s, plan["total"], bwd_half, state)
-    _, _, _, losses, grads = state
-    n = jnp.asarray(M, jnp.float32)
-    grads = jax.tree_util.tree_map(lambda g: g / n, grads)
-    return losses, grads
-
-
-def forward_backward_pipelining_without_interleaving(
-        forward_step_func: Callable, loss_func: Callable, params,
-        microbatches, *, num_microbatches: int,
-        tensor_shape, dtype=jnp.float32,
-        axis_name: str = PIPELINE_PARALLEL_AXIS,
-        grad_scale: float = 1.0,
-        pp_size: Optional[int] = None,
-        aux_loss: bool = False,
-        **unused):
-    """True 1F1B over the 'pp' axis in one jitted program (see module doc).
-
-    Parity target: fwd_bwd_pipelining_without_interleaving.py:241-597.
-    Returns (per-microbatch losses [M] — nonzero on the last stage only,
-    grads pytree scaled by grad_scale / num_microbatches).
-
-    Must run inside shard_map with the 'pp' axis bound; ``tensor_shape``
-    is the (seq, microbatch, hidden) activation shape crossing stage
-    boundaries (reference get_tensor_shapes,
-    ...without_interleaving.py:29-86).
-    """
-    P = pp_size or get_pipeline_model_parallel_world_size()
-    return _pipelined_fwd_bwd(
-        forward_step_func, loss_func, params, microbatches,
-        M=num_microbatches, V=1, P=P, tensor_shape=tensor_shape,
-        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
-        aux_loss=aux_loss)
-
-
-def forward_backward_pipelining_with_interleaving(
-        forward_step_func: Callable, loss_func: Callable, params,
-        microbatches, *, num_microbatches: int, tensor_shape,
-        dtype=jnp.float32, axis_name: str = PIPELINE_PARALLEL_AXIS,
-        grad_scale: float = 1.0, pp_size: Optional[int] = None,
-        num_model_chunks: Optional[int] = None, aux_loss: bool = False,
-        **unused):
-    """Interleaved (virtual-pipeline) 1F1B in one steady state.
-
-    Parity target: fwd_bwd_pipelining_with_interleaving.py (516 LoC).
-    ``params`` is a pytree whose leaves carry a leading ``num_model_chunks``
-    dim (stacked virtual chunks per rank); chunk c on rank r is global
-    stage c * P + r. Unlike a sequential-passes scheme (bubble V*(P-1)
-    full passes), all chunks share ONE steady state: each global tick maps
-    to a (chunk, microbatch) unit per rank via the reference's
-    get_model_chunk_id order, so the forward wave fills in V*P - 1 ticks
-    and drains in P - 1 — per-rank overhead (V*P-1) fwd units + (P-1) bwd
-    units over the M*V useful ticks, matching the reference's rank-0
-    warmup of 2(P-1) + (V-1)P forward units. Chunk handoffs (rank P-1's
-    chunk-c output -> rank 0's chunk c+1 input, and the reverse for
-    grads) have exactly-one-tick latency under this order, so they ride
-    the same *circular* ppermute as the intra-chunk shifts — no boundary
-    buffers.
-    """
-    P = pp_size or get_pipeline_model_parallel_world_size()
-    V = num_model_chunks or get_virtual_pipeline_model_parallel_world_size() or 1
-    if V == 1:
-        return forward_backward_pipelining_without_interleaving(
-            forward_step_func, loss_func, params, microbatches,
-            num_microbatches=num_microbatches, tensor_shape=tensor_shape,
-            dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
-            pp_size=P, aux_loss=aux_loss)
-    if num_microbatches % P != 0:
-        # reference fwd_bwd_pipelining_with_interleaving.py asserts
-        # num_microbatches % pipeline_parallel_size == 0
-        raise ValueError(
-            f"interleaved schedule requires num_microbatches "
-            f"({num_microbatches}) to be a multiple of "
-            f"pipeline_model_parallel_size ({P})")
-    return _pipelined_fwd_bwd(
-        forward_step_func, loss_func, params, microbatches,
-        M=num_microbatches, V=V, P=P, tensor_shape=tensor_shape,
-        dtype=dtype, axis_name=axis_name, grad_scale=grad_scale,
-        aux_loss=aux_loss)
-
-
-def forward_backward_pipelining_with_split(
-        forward_step_func: Callable, loss_func: Callable, params,
-        microbatches, *, num_microbatches: int,
-        encoder_tensor_shape, decoder_tensor_shape,
-        dtype=jnp.float32, axis_name: str = PIPELINE_PARALLEL_AXIS,
-        grad_scale: float = 1.0, pp_size: Optional[int] = None,
-        split_rank: Optional[int] = None, aux_loss: bool = False,
-        **unused):
-    """Encoder-decoder (split-rank) 1F1B.
-
-    Parity target: the reference's ``ModelType.encoder_and_decoder`` path —
-    dual p2p tensor shapes computed from ``decoder_seq_length``
-    (fwd_bwd_pipelining_without_interleaving.py:29-86's get_tensor_shapes)
-    with the encoder on ranks ``< split_rank`` and the decoder at/after it
-    (parallel_state.py:243-331 places embedding groups around the same
-    split). The reference moves *two* tensors across decoder-side stage
-    boundaries (encoder memory + decoder stream); here the boundary
-    payload is the two-leaf pytree
-    ``{"encoder": (enc_seq, mb, h), "decoder": (dec_seq, mb, h)}`` riding
-    the same tick machine — encoder ranks advance the encoder leaf and
-    pass the decoder leaf through untouched; decoder ranks advance the
-    decoder leaf with the encoder leaf as cross-attention memory,
-    forwarding it unchanged so every decoder stage sees the final encoder
-    output. Interleaving is not supported with a split (matches the
-    reference's encoder_or_decoder-only interleaved schedule).
-
-    Stage contract (build with :func:`make_encoder_decoder_step`):
-
-        forward_step_func(params, payload_dict, mb, is_first_stage)
-            -> payload_dict
-        loss_func(params, payload_dict, mb) -> scalar   # reads "decoder"
-
-    Returns (per-microbatch losses [M] — nonzero on the last stage only,
-    grads pytree scaled by grad_scale / num_microbatches).
-    """
-    P = pp_size or get_pipeline_model_parallel_world_size()
-    split = (split_rank if split_rank is not None
-             else get_pipeline_model_parallel_split_rank())
-    if split is None or not 0 < split < P:
-        raise ValueError(
-            f"encoder-decoder pipelining needs 0 < split_rank < pp_size; "
-            f"got split_rank={split}, pp_size={P} (set it via "
-            f"initialize_model_parallel(..., "
-            f"pipeline_model_parallel_split_rank=...) or pass split_rank=)")
-    spec = {
-        "encoder": jax.ShapeDtypeStruct(tuple(encoder_tensor_shape), dtype),
-        "decoder": jax.ShapeDtypeStruct(tuple(decoder_tensor_shape), dtype),
-    }
-    return _pipelined_fwd_bwd(
-        forward_step_func, loss_func, params, microbatches,
-        M=num_microbatches, V=1, P=P, tensor_shape=spec, dtype=dtype,
-        axis_name=axis_name, grad_scale=grad_scale, aux_loss=aux_loss)
-
-
-def make_encoder_decoder_step(encoder_step: Callable, decoder_step: Callable,
-                              *, split_rank: Optional[int] = None,
-                              axis_name: str = PIPELINE_PARALLEL_AXIS):
-    """Build the stage fn for :func:`forward_backward_pipelining_with_split`
-    from per-side step functions:
-
-        encoder_step(params, enc_h, mb, is_first_stage) -> enc_h
-            (build enc_h from the microbatch when is_first_stage)
-        decoder_step(params, dec_h, enc_memory, mb, is_split_stage) -> dec_h
-            (build dec_h from the microbatch when is_split_stage — the
-            first decoder stage, where the upstream decoder leaf is zeros)
-
-    Rank-side selection is a runtime ``lax.cond`` on the pp mesh position
-    vs the split rank — one SPMD program, each rank executes only its own
-    side (consuming the split-rank bookkeeping the reference keeps in
-    parallel_state.py:469-486 / is_pipeline_stage_before_split).
-    ``params`` must carry both sides' weights in a uniform pytree on every
-    rank (each rank's unused side receives zero grads).
-    """
-    split = (split_rank if split_rank is not None
-             else get_pipeline_model_parallel_split_rank())
-    if split is None:
-        raise ValueError("make_encoder_decoder_step needs a split rank")
-
-    def step(params, payload, mb, is_first_stage):
-        rank = lax.axis_index(axis_name)
-
-        def enc_branch(op):
-            p, pl, mb_, first = op
-            return {"encoder": encoder_step(p, pl["encoder"], mb_, first),
-                    "decoder": pl["decoder"]}
-
-        def dec_branch(op):
-            p, pl, mb_, _ = op
-            return {"encoder": pl["encoder"],
-                    "decoder": decoder_step(p, pl["decoder"], pl["encoder"],
-                                            mb_, rank == split)}
-
-        return lax.cond(rank >= split, dec_branch, enc_branch,
-                        (params, payload, mb, is_first_stage))
-
-    return step
+_warn_moved("apex_tpu.transformer.pipeline_parallel.schedules")

@@ -63,6 +63,16 @@
                                            identical comm bytes; emits
                                            per-axis comm bytes +
                                            reshard_bitexact
+    python bench.py pp_tp_dp [batch] [steps]  3-D (data, model, pipe)
+                                           mesh: stage-partitioned
+                                           GPT-2 under the host-driven
+                                           1F1B schedule, DP bucket
+                                           psums in the cooldown
+                                           bubbles; emits
+                                           bubble_fraction (vs the
+                                           (pp-1)/(m+pp-1) model),
+                                           per-axis comm bytes incl.
+                                           pipe, 3-D reshard_bitexact
     python bench.py ddp_numerics [batch] [steps]  guarded DDP step with
                                            in-graph per-layer stats +
                                            flight-recorder ring; emits
@@ -2080,6 +2090,228 @@ def bench_tp_dp(batch, steps, *, hidden=256, layers=4, heads=8,
     return ret
 
 
+def bench_pp_tp_dp(batch, steps, *, hidden=64, layers=2, heads=4,
+                   vocab=64, seq=16, microbatches=4):
+    """3-D ``(data, model, pipe)`` mesh composition (ISSUE 17): the
+    stage-partitioned GPT-2 block stack under the host-unrolled 1F1B
+    schedule (apex_tpu.parallel.pipeline) — per-tick
+    ``collective_permute`` stage transfers over ``pipe``, TP activation
+    psums over ``model``, the bucketed int8 DP grad sync over ``data``
+    traced into the cooldown tail — measured against the substrate's
+    proof obligations in one invocation:
+
+    - **bubble fraction**: per-1F1B-slot cost from the M -> 2M
+      microbatch delta (fixed dispatch overhead cancels), measured
+      bubble ``1 - c*M/t(M)`` vs the analytic ``(pp-1)/(m+pp-1)``;
+    - **overlapped vs baseline** step ms at IDENTICAL per-axis wire
+      bytes (the baseline marshals the EF residual through the leaf
+      domain; the buckets on the wire are the same);
+    - per-axis static == measured comm bytes (``pipe`` included),
+      all 13 lint rules clean with zero skips, ``compile_count == 1``,
+      and the elastic 3-D ZeRO reshard 2x2x2 -> 2x2x1 -> back
+      round-tripping bit-identically.
+    """
+    from apex_tpu import analysis, telemetry
+    from apex_tpu.analysis import sharding as _sharding
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+        _flat_size as _zero_flat_size,
+    )
+    from apex_tpu.parallel import compression, mesh2d, pipeline
+    from apex_tpu.telemetry import span
+
+    devices = jax.devices()
+    multi = len(devices) >= 8 and len(devices) % 8 == 0
+    mesh = (pipeline.mesh_3d(2, 2, 2) if multi
+            else pipeline.mesh_3d(1, 1, 1, devices=devices[:1]))
+    dp_world = mesh.shape[pipeline.DATA_AXIS]
+    tp_world = mesh.shape[pipeline.MODEL_AXIS]
+    pp_world = mesh.shape[pipeline.PIPE_AXIS]
+    M = int(microbatches)
+    seg_params = mesh2d.gpt2_init(hidden=hidden, layers=layers,
+                                  heads=heads, vocab=vocab, max_seq=seq)
+    zsegs, zdims = pipeline.pipeline_zero_segments(seg_params)
+    lp = layers // pp_world
+    seg_locals = [mesh2d.local_template(seg_params[:1], tp_world)[0]
+                  ["layer"]] * lp
+    edge_local = {"embed": seg_params[0]["embed"],
+                  "ln_f": seg_params[-1]["ln_f"],
+                  "head": seg_params[-1]["head"]}
+    n_local = sum(_tree_size(t) for t in seg_locals + [edge_local])
+
+    def build(mode, m):
+        step, state = pipeline.build_pipeline_step(
+            mesh, seg_params, hidden=hidden, heads=heads,
+            microbatches=m, mode=mode)
+        tokens, labels = pipeline.make_batch_3d(
+            mesh, microbatches=m, batch_per_replica=batch, seq=seq,
+            vocab=vocab)
+        return step, state, tokens, labels
+
+    ovl_step, ovl_state, tokens, labels = build("overlapped", M)
+    ovl_args = ovl_state + (tokens, labels)
+
+    # per-axis static vs measured around the FIRST trace (the tp_dp
+    # counter-delta idiom, with the pipe axis now in the set)
+    _enable_bench_telemetry()
+    reg = telemetry.get_registry()
+    axes = (pipeline.DATA_AXIS, pipeline.MODEL_AXIS, pipeline.PIPE_AXIS)
+    before = {a: reg.counter_value(f"comm/axis/{a}_bytes")
+              for a in axes}
+    _measure_step_cost(ovl_step, ovl_args)
+    measured_by_axis = {
+        a: int(round(reg.counter_value(f"comm/axis/{a}_bytes")
+                     - before[a]))
+        for a in axes}
+    traced = ovl_step.trace(*ovl_args)
+    static_by_axis = _sharding.static_comm_bytes_by_axis(
+        traced.lower().as_text(), traced.jaxpr)
+    # all three axes always priced (the round-22 schema contract),
+    # even when a size-1 axis lowers to no collectives
+    static_by_axis = {a: int(static_by_axis.get(a, 0)) for a in axes}
+    if multi and os.environ.get("APEX_TPU_COMM_GATE", "1") != "0":
+        tol = float(os.environ.get("APEX_TPU_COMM_GATE_TOL", "0.25"))
+        for a in axes:
+            m_, s_ = measured_by_axis[a], static_by_axis.get(a, 0)
+            if m_ > 0 and abs(s_ - m_) / m_ > tol:
+                raise RuntimeError(
+                    f"pp_tp_dp axis '{a}' static/measured comm-bytes "
+                    f"disagreement: static {s_} vs measured {m_} "
+                    f"(> {tol * 100:.0f}% band)")
+
+    # all 13 rules, zero skips: the threshold sits between the stage
+    # transfer payload (= the TP activation psum payload) and the
+    # smallest DP bucket, so the inherent pipeline/TP chains stay
+    # below "big" while every DP bucket is checked
+    lint_violations = None
+    if multi:
+        xfer_bytes = batch * seq * hidden * 4
+        min_bucket_bytes = 4 * min(
+            int(sum(l.size for l in jax.tree_util.tree_leaves(t)))
+            for t in seg_locals + [edge_local])
+        if xfer_bytes >= min_bucket_bytes:
+            raise RuntimeError(
+                f"pp_tp_dp sizing breaks the overlap-serialization "
+                f"separation: stage transfer payload {xfer_bytes} B >= "
+                f"smallest DP bucket {min_bucket_bytes} B")
+        cfg = analysis.LintConfig(overlap_min_bytes=min_bucket_bytes)
+        report = analysis.lint_fn(ovl_step, *ovl_args,
+                                  name="pp_tp_dp/overlapped",
+                                  config=cfg)
+        if report.rules_skipped:
+            raise RuntimeError(
+                f"pp_tp_dp lint skipped rules: {report.rules_skipped}")
+        lint_violations = len(report.findings)
+        if lint_violations:
+            raise RuntimeError(
+                f"pp_tp_dp overlapped step lints dirty: "
+                f"{[str(f) for f in report.findings]}")
+
+    # elastic 3-D ZeRO: synthetic canonical state round-trips
+    # 2x2x2 -> 2x2x1 -> 2x2x2 bit-identically (host math)
+    opt = DistributedFusedAdam(compress=True)
+    rng = np.random.RandomState(17)
+    n_full = _zero_flat_size(zsegs)
+    full0 = {"format": 3, "optimizer": "DistributedFusedAdam",
+             "dp_world": dp_world, "tp_world": tp_world,
+             "pp_world": pp_world, "n_elements": n_full,
+             "shared_tail_elements": _zero_flat_size(zsegs[-1:]),
+             "block_size": 256, "grad_compress": "int8",
+             "param_compress": "bf16", "step": np.int32(13),
+             "master": rng.randn(n_full).astype(np.float32),
+             "exp_avg": rng.randn(n_full).astype(np.float32),
+             "exp_avg_sq": np.abs(rng.randn(n_full)).astype(np.float32),
+             "grad_residual": (rng.randn(n_full) * 1e-3)
+             .astype(np.float32)}
+    shrunk = (dp_world, tp_world, 1)
+    grown = (dp_world, tp_world, pp_world)
+    st_mid = opt.load_state_dict_resharded(
+        full0, zsegs, world=shrunk, partition_dims=zdims)
+    mid = opt.state_dict_full(st_mid, zsegs, world=shrunk,
+                              partition_dims=zdims)
+    st_back = opt.load_state_dict_resharded(
+        mid, zsegs, world=grown, partition_dims=zdims)
+    back = opt.state_dict_full(st_back, zsegs, world=grown,
+                               partition_dims=zdims)
+    reshard_bitexact = all(
+        np.array_equal(np.asarray(back[k]), np.asarray(full0[k]))
+        for k in ("master", "exp_avg", "exp_avg_sq", "grad_residual"))
+    if not reshard_bitexact:
+        raise RuntimeError(
+            "pp_tp_dp elastic 3-D reshard round-trip is not bit-exact")
+
+    def timed(step, state, tok, lab):
+        out = step(*state, tok, lab)
+        float(out[3])                   # compile + first step
+        out = step(*out[:3], tok, lab)
+        float(out[3])                   # one steady warmup
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step(*out[:3], tok, lab)
+        float(out[3])                   # completion barrier
+        return (time.perf_counter() - t0) / steps
+
+    with span("bench/timed_loop", steps=steps, variant="overlapped"):
+        t_ovl = timed(ovl_step, ovl_state, tokens, labels)
+    _stage_compile_count(ovl_step)
+    compile_count = _PENDING_MEASURED.get("compile_count")
+    _PENDING_MEASURED["lint_violations"] = lint_violations
+    base_step, base_state, btok, blab = build("baseline", M)
+    with span("bench/timed_loop", steps=steps, variant="baseline"):
+        t_base = timed(base_step, base_state, btok, blab)
+    # the M -> 2M delta prices one 1F1B slot; the fixed dispatch
+    # overhead and the warmup/cooldown bubble cost cancel out of c
+    ovl2_step, ovl2_state, tok2, lab2 = build("overlapped", 2 * M)
+    with span("bench/timed_loop", steps=steps, variant="2m"):
+        t_2m = timed(ovl2_step, ovl2_state, tok2, lab2)
+    c = max((t_2m - t_ovl) / M, 1e-12)
+    bubble_fraction = max(0.0, 1.0 - (c * M) / t_ovl)
+    bubble_model = pipeline.analytic_bubble_fraction(pp_world, M)
+    if multi and os.environ.get("APEX_TPU_BUBBLE_GATE", "1") != "0":
+        tol = float(os.environ.get("APEX_TPU_BUBBLE_TOL", "0.35"))
+        if abs(bubble_fraction - bubble_model) > tol:
+            raise RuntimeError(
+                f"pp_tp_dp measured bubble fraction "
+                f"{bubble_fraction:.3f} is outside the +-{tol} band "
+                f"around the 1F1B model {bubble_model:.3f}")
+
+    fields = _comm_fields(n_elements=n_local, compress="int8")
+    fields["comm_bytes_per_step"] = compression.estimate_allreduce_bytes(
+        n_local, world=max(dp_world, 2), compress="int8")
+    fields["comm_model"] = (f"ring allreduce, data={dp_world} x "
+                            f"model={tp_world} x pipe={pp_world}, "
+                            f"payload=int8 on the data axis only")
+    if reg.enabled:
+        reg.event("pipeline", "summary", stages=pp_world,
+                  microbatches=M,
+                  baseline_step_ms=round(t_base * 1e3, 3),
+                  overlapped_step_ms=round(t_ovl * 1e3, 3),
+                  bubble_fraction=round(bubble_fraction, 4),
+                  bubble_fraction_model=round(bubble_model, 4))
+    n_params = _tree_size(seg_params)
+    tokens_per_step = batch * M * dp_world * seq
+    flops = 6 * tokens_per_step * n_params
+    ret = {
+        "dp_world": dp_world, "tp_world": tp_world,
+        "pp_world": pp_world, "pipeline_stages": pp_world,
+        "microbatches": M, "layers": layers,
+        "grad_elements_local": n_local,
+        "baseline_step_ms": round(t_base * 1e3, 3),
+        "overlapped_step_ms": round(t_ovl * 1e3, 3),
+        "bubble_fraction": round(bubble_fraction, 4),
+        "bubble_fraction_model": round(bubble_model, 4),
+        "measured_comm_bytes_per_axis": measured_by_axis,
+        "static_comm_bytes_per_axis": static_by_axis,
+        "reshard_bitexact": bool(reshard_bitexact),
+    }
+    _emit("pp_tp_dp_steps_per_sec", 1.0 / t_ovl, "steps/sec", flops,
+          steps, t_ovl * steps, **ret, **fields)
+    ret.update(fields)
+    ret["lint_violations"] = lint_violations
+    ret["compile_count"] = compile_count
+    return ret
+
+
 def bench_ddp_resilience(batch, steps, *, hidden=256, depth=2,
                          nan_step=None):
     """DDP training under the full resilience spine: int8-compressed
@@ -3157,6 +3389,7 @@ BENCH_SPECS = {
     "ddp_compressed": ((64, 30), bench_ddp_compressed),
     "ddp_overlapped": ((64, 30), bench_ddp_overlapped),
     "tp_dp": ((4, 10), bench_tp_dp),
+    "pp_tp_dp": ((2, 10), bench_pp_tp_dp),
     "ddp_resilience": ((32, 12), bench_ddp_resilience),
     "ddp_numerics": ((32, 12), bench_ddp_numerics),
     "ddp_memwatch": ((32, 12), bench_ddp_memwatch),

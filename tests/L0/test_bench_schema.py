@@ -420,6 +420,76 @@ def test_kernels_fields_gated_at_round19():
                                     round_n=19, errors=[]) == []
 
 
+def test_pp_tp_dp_fields_gated_at_round22():
+    """ISSUE 17 satellite: a pp_tp_dp metric line must carry the 1F1B
+    bubble fraction next to its analytic model, the schedule shape,
+    the baseline-vs-overlapped step times, the per-axis comm dicts
+    WITH the pipe axis priced, and the 3-D reshard verdict from round
+    22; pre-22 records carrying the pipeline-only fields are flagged,
+    other configs never need them."""
+    base = {"metric": "pp_tp_dp_steps_per_sec", "value": 46.0,
+            "unit": "steps/sec", "vs_baseline": 1.0,
+            "tflops_per_sec": 0.0, "mfu": 0.0,
+            "comm_bytes_per_step": 35608,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": None, "lint_violations": None,
+            "static_comm_bytes_per_step": None,
+            "backend": "cpu-mesh"}
+    axis = {"data": 35364, "model": 245760, "pipe": 102928}
+    full = dict(base, bubble_fraction=0.13, bubble_fraction_model=0.2,
+                pipeline_stages=2, microbatches=4,
+                baseline_step_ms=24.1, overlapped_step_ms=21.5,
+                measured_comm_bytes_per_axis=dict(axis),
+                static_comm_bytes_per_axis=dict(axis),
+                reshard_bitexact=True)
+    assert schema.check_metric_line(dict(full), round_n=22,
+                                    errors=[]) == []
+    # round 22: every pipeline field is required on pp_tp_dp lines
+    msgs = schema.check_metric_line(dict(base), round_n=22, errors=[])
+    for key in schema.PP_TP_DP_REQUIRED_FIELDS:
+        assert any(key in m for m in msgs)
+    # the per-axis dicts must price the pipe axis
+    two_axis = {"data": 1, "model": 2}
+    msgs = schema.check_metric_line(
+        dict(full, measured_comm_bytes_per_axis=two_axis),
+        round_n=22, errors=[])
+    assert any("must price the 'pipe' axis" in m for m in msgs)
+    # nullable (single-device run measures nothing) and typed
+    assert schema.check_metric_line(
+        dict(full, bubble_fraction=None,
+             measured_comm_bytes_per_axis=None), round_n=22,
+        errors=[]) == []
+    msgs = schema.check_metric_line(
+        dict(full, bubble_fraction="small"), round_n=22, errors=[])
+    assert any("must be numeric" in m for m in msgs)
+    msgs = schema.check_metric_line(
+        dict(full, static_comm_bytes_per_axis={"pipe": "many"}),
+        round_n=22, errors=[])
+    assert any("axis-name" in m for m in msgs)
+    # pre-22 checked-in records carrying the pipeline-only fields are
+    # flagged — the fields did not exist at capture time
+    wrapper = {"n": 21, "cmd": "python bench.py pp_tp_dp", "rc": 0,
+               "tail": "", "parsed": dict(full)}
+    msgs = schema.check_wrapper(wrapper, errors=[])
+    assert any("only defined from round 22" in m for m in msgs)
+    assert schema.check_wrapper(
+        {"n": 22, "cmd": "c", "rc": 0, "tail": "",
+         "parsed": dict(full)}, errors=[]) == []
+    # other configs never need the pipeline fields at round 22, and
+    # tp_dp lines keep their own (round-20) contract untouched
+    assert schema.check_metric_line(dict(base, metric="resnet50_amp_o2"),
+                                    round_n=22, errors=[]) == []
+    tp = dict(base, metric="tp_dp_steps_per_sec",
+              baseline_step_ms=1.0, overlapped_step_ms=0.9,
+              measured_comm_bytes_per_axis={"data": 1, "model": 2},
+              static_comm_bytes_per_axis={"data": 1, "model": 2},
+              reshard_bitexact=True)
+    assert schema.check_metric_line(dict(tp), round_n=22,
+                                    errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint

@@ -1,0 +1,365 @@
+"""3-D (data, model, pipe) pipeline parallelism (ISSUE 17).
+
+Evidence layers:
+
+- **Schedule**: the host-side 1F1B tick table executes every
+  (rank, microbatch) forward exactly once, its backward after it, and
+  never stashes more than the plan's ``min(M, 2P-1)`` bound; the
+  analytic bubble model matches its idle-slot count.
+- **Training math**: the stage-partitioned step on the 2x2x2 mesh
+  reproduces the pp=1 (2x2x1) losses — the ppermute chain and the
+  pipe-psummed tied-edge grads are exact, not approximations.
+- **Guard**: a NaN injected at one (stage, microbatch) coordinate
+  skips the step on EVERY rank (the flag ORs over all three axes) and
+  reverts params AND the DP-scoped EF residual bit-exactly.
+- **Elastic 3-D ZeRO**: the canonical flat ([stage-owned layers in
+  model order] + [tied edge once]) is pp-invariant — 2x2x2 restores
+  bit-identically to 2x2x1 and 1x2x2 and back, the pipe-replicated
+  tail's stage-invariance is verified not assumed.
+- **Supervisor**: the shrink policy gives up the pipe axis first,
+  the model axis second.
+- **Compat**: the retired ``transformer.pipeline_parallel`` modules
+  re-export the new subsystem with ONE DeprecationWarning per process.
+"""
+
+import os
+import sys
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.parallel import mesh2d, pipeline
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+HID, HEADS, VOCAB, SEQ, M = 32, 4, 32, 8, 4
+
+multi8 = pytest.mark.skipif(
+    len(jax.devices()) < 8 or len(jax.devices()) % 8,
+    reason="needs 8 devices (2x2x2 mesh)")
+
+
+def _model(hidden=HID, layers=2, **kw):
+    return mesh2d.gpt2_init(hidden=hidden, layers=layers, heads=HEADS,
+                            vocab=VOCAB, max_seq=SEQ, **kw)
+
+
+# ---------------------------------------------------------------------------
+# host-side: the 1F1B schedule table
+# ---------------------------------------------------------------------------
+
+class TestSchedule:
+    @pytest.mark.parametrize("pp,m", [(2, 4), (4, 8), (4, 2), (1, 3)])
+    def test_ticks_cover_every_unit_once_in_order(self, pp, m):
+        plan = pipeline.pipeline_schedule_plan(pp, m)
+        ticks = pipeline.schedule_ticks(pp, m)
+        assert len(ticks) == plan["total"] == m + 2 * pp - 2
+        fwd_at, bwd_at = {}, {}
+        for tk in ticks:
+            for r, i in tk["fwd"]:
+                assert (r, i) not in fwd_at
+                fwd_at[(r, i)] = tk["tick"]
+            for r, i in tk["bwd"]:
+                assert (r, i) not in bwd_at
+                bwd_at[(r, i)] = tk["tick"]
+        units = {(r, i) for r in range(pp) for i in range(m)}
+        assert set(fwd_at) == units and set(bwd_at) == units
+        for r in range(pp):
+            for i in range(m):
+                # bwd of (r, i) strictly after its fwd, and after the
+                # DOWNSTREAM stage's fwd of the same microbatch
+                assert bwd_at[(r, i)] >= fwd_at[(r, i)] + (r < pp - 1)
+                if r + 1 < pp:
+                    # the ppermute chain: stage r+1 consumes (r, i)'s
+                    # activation exactly one tick later
+                    assert fwd_at[(r + 1, i)] == fwd_at[(r, i)] + 1
+
+    @pytest.mark.parametrize("pp,m", [(2, 4), (4, 8), (4, 2)])
+    def test_stash_bound_holds(self, pp, m):
+        plan = pipeline.pipeline_schedule_plan(pp, m)
+        ticks = pipeline.schedule_ticks(pp, m)
+        in_flight = {r: 0 for r in range(pp)}
+        peak = 0
+        for tk in ticks:
+            for r, _ in tk["fwd"]:
+                in_flight[r] += 1
+            peak = max(peak, max(in_flight.values()))
+            for r, _ in tk["bwd"]:
+                in_flight[r] -= 1
+        assert peak <= plan["stash"]
+        assert all(v == 0 for v in in_flight.values())
+
+    def test_analytic_bubble_fraction(self):
+        assert pipeline.analytic_bubble_fraction(1, 7) == 0.0
+        assert pipeline.analytic_bubble_fraction(2, 4) == \
+            pytest.approx(1 / 5)
+        assert pipeline.analytic_bubble_fraction(4, 12) == \
+            pytest.approx(3 / 15)
+        # the schedule's own idle-slot count IS the model: per phase
+        # half (fwd, bwd), pp-1 of m+pp-1 slots run no unit
+        pp, m = 4, 12
+        ticks = pipeline.schedule_ticks(pp, m)
+        fwd_slots = sum(1 for tk in ticks for r in range(pp)
+                        if any(u[0] == r for u in tk["fwd"]))
+        idle = (m + pp - 1) * pp - fwd_slots
+        assert idle / ((m + pp - 1) * pp) == \
+            pytest.approx(pipeline.analytic_bubble_fraction(pp, m))
+
+
+# ---------------------------------------------------------------------------
+# host-side: the elastic 3-D ZeRO shard table
+# ---------------------------------------------------------------------------
+
+class TestZero3D:
+    def _segments(self):
+        sp = _model()
+        return pipeline.pipeline_zero_segments(sp)
+
+    def _full_dict(self, rng, segs, dp, tp, pp):
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _flat_size,
+        )
+
+        n = _flat_size(segs)
+        return {"format": 3, "optimizer": "DistributedFusedAdam",
+                "dp_world": dp, "tp_world": tp, "pp_world": pp,
+                "shared_tail_elements": _flat_size(segs[-1:]),
+                "n_elements": n, "block_size": 256,
+                "grad_compress": "int8", "param_compress": "bf16",
+                "step": np.int32(7),
+                "master": rng.randn(n).astype(np.float32),
+                "exp_avg": rng.randn(n).astype(np.float32),
+                "exp_avg_sq": np.abs(rng.randn(n))
+                .astype(np.float32),
+                "grad_residual": (rng.randn(n) * 1e-3)
+                .astype(np.float32)}
+
+    @pytest.mark.parametrize("mid_world", [(2, 2, 1), (1, 2, 2)])
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_roundtrip_222_via_shrunk_world_bit_identical(
+            self, mid_world, overlap):
+        """2x2x2 -> (2x2x1 | 1x2x2) -> 2x2x2: the supervisor's two
+        shrink choices, both restoring bit-identically through the
+        pp-invariant canonical flat."""
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            consolidate_zero_state_3d, reshard_zero_state_3d,
+        )
+
+        segs, dims = self._segments()
+        rng = np.random.RandomState(3)
+        full0 = self._full_dict(rng, segs, 2, 2, 2)
+        dp, tp, pp = mid_world
+        mid_states = reshard_zero_state_3d(
+            full0, segs, dims, dp_world=dp, tp_world=tp, pp_world=pp,
+            grad_compress="int8", param_compress="bf16",
+            block_size=256, overlap=overlap)
+        assert len(mid_states) == pp
+        mid = consolidate_zero_state_3d(
+            mid_states, segs, dims, dp_world=dp, tp_world=tp,
+            pp_world=pp, grad_compress="int8", param_compress="bf16",
+            block_size=256, optimizer="DistributedFusedAdam")
+        back_states = reshard_zero_state_3d(
+            mid, segs, dims, dp_world=2, tp_world=2, pp_world=2,
+            grad_compress="int8", param_compress="bf16",
+            block_size=256, overlap=overlap)
+        back = consolidate_zero_state_3d(
+            back_states, segs, dims, dp_world=2, tp_world=2,
+            pp_world=2, grad_compress="int8", param_compress="bf16",
+            block_size=256, optimizer="DistributedFusedAdam")
+        for key in ("master", "exp_avg", "exp_avg_sq",
+                    "grad_residual"):
+            np.testing.assert_array_equal(back[key], full0[key])
+        assert int(back["step"]) == 7
+        opt = DistributedFusedAdam(compress=True)
+        assert opt  # the method route, same math
+        st = opt.load_state_dict_resharded(full0, segs,
+                                           world=mid_world,
+                                           partition_dims=dims)
+        again = opt.state_dict_full(st, segs, world=mid_world,
+                                    partition_dims=dims)
+        np.testing.assert_array_equal(again["master"], full0["master"])
+
+    def test_pp1_format2_dict_restores_on_222(self):
+        """A checkpoint written at pp == 1 (format 2, no pipe fields)
+        restores onto the 3-D world — the canonical flat layouts are
+        identical by construction."""
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            _flat_size, consolidate_zero_state_3d,
+            reshard_zero_state_3d,
+        )
+
+        segs, dims = self._segments()
+        rng = np.random.RandomState(4)
+        full0 = self._full_dict(rng, segs, 2, 2, 1)
+        full0["format"] = 2
+        del full0["pp_world"], full0["shared_tail_elements"]
+        sts = reshard_zero_state_3d(
+            full0, segs, dims, dp_world=2, tp_world=2, pp_world=2,
+            grad_compress="int8", block_size=256)
+        back = consolidate_zero_state_3d(
+            sts, segs, dims, dp_world=2, tp_world=2, pp_world=2,
+            grad_compress="int8", block_size=256)
+        np.testing.assert_array_equal(back["master"], full0["master"])
+        assert back["format"] == 3
+        assert back["shared_tail_elements"] == _flat_size(segs[-1:])
+
+    def test_pipe_tail_divergence_refuses(self):
+        """Stage-invariance of the tied edge is VERIFIED: a stage
+        whose pipe-replicated tail diverged must fail consolidation,
+        not silently pick one."""
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            consolidate_zero_state_3d, reshard_zero_state_3d,
+            split_params_for_model_axis, split_params_for_pipe_axis,
+        )
+
+        segs, dims = self._segments()
+        rng = np.random.RandomState(5)
+        full0 = self._full_dict(rng, segs, 2, 2, 2)
+        sts = reshard_zero_state_3d(
+            full0, segs, dims, dp_world=2, tp_world=2, pp_world=2,
+            grad_compress="int8", block_size=256)
+        stage_p = split_params_for_pipe_axis(segs, 2)
+        stage_d = split_params_for_pipe_axis(dims, 2)
+        # poison the last LOGICAL element (the tied edge's tail) on
+        # BOTH model ranks of stage 1 — the stage's own 2-D
+        # replicated-leaf check must pass so the pipe check is what
+        # fires
+        for t in range(2):
+            n_t = sum(l.size for l in jax.tree_util.tree_leaves(
+                split_params_for_model_axis(stage_p[1], stage_d[1],
+                                            2)[t]))
+            bad = dict(sts[1][t])
+            m = np.asarray(bad["master_shard"]).copy()
+            m[n_t - 1] += 1.0
+            bad["master_shard"] = m
+            sts[1][t] = bad
+        with pytest.raises(ValueError, match="pipe-replicated tail"):
+            consolidate_zero_state_3d(
+                sts, segs, dims, dp_world=2, tp_world=2, pp_world=2,
+                grad_compress="int8", block_size=256)
+
+    def test_segments_and_dims_cover_the_layout(self):
+        segs, dims = self._segments()
+        # [per-layer segments in model order] + [the tied edge once]
+        assert len(segs) == 3
+        assert set(segs[-1]) == {"embed", "ln_f", "head"}
+        assert dims[0]["attn"]["wq"] == 1
+        assert dims[-1]["head"]["w"] is None
+        from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+            split_params_for_pipe_axis,
+        )
+
+        stages = split_params_for_pipe_axis(segs, 2)
+        assert [len(s) for s in stages] == [2, 2]  # 1 layer + tail
+        assert stages[0][-1] is segs[-1] is stages[1][-1]
+        with pytest.raises(ValueError, match="do not split"):
+            split_params_for_pipe_axis(segs, 4)
+
+
+# ---------------------------------------------------------------------------
+# on-mesh: pp=2 parity with pp=1, guard skip-revert
+# ---------------------------------------------------------------------------
+
+@multi8
+class TestPipelineStep3D:
+    def test_pp2_matches_pp1_losses(self):
+        sp = _model()
+        losses = {}
+        for pipe in (1, 2):
+            mesh = pipeline.mesh_3d(2, 2, pipe)
+            step, state = pipeline.build_pipeline_step(
+                mesh, sp, hidden=HID, heads=HEADS, microbatches=M)
+            tokens, labels = pipeline.make_batch_3d(
+                mesh, microbatches=M, batch_per_replica=2, seq=SEQ,
+                vocab=VOCAB)
+            out = step(*state, tokens, labels)
+            out = step(*out[:3], tokens, labels)
+            losses[pipe] = [float(out[3])]
+            out = step(*out[:3], tokens, labels)
+            losses[pipe].append(float(out[3]))
+        np.testing.assert_allclose(losses[2], losses[1], rtol=2e-5,
+                                   atol=2e-6)
+        assert losses[2][1] < losses[2][0]  # it trains
+
+    def test_guard_nan_skip_reverts_bit_exact(self):
+        """NaN at (step 1, stage 1, microbatch 2): the flag ORs over
+        (data, model, pipe), every rank skips, and params + EF
+        residual revert bit-exactly."""
+        mesh = pipeline.mesh_3d(2, 2, 2)
+        sp = _model()
+        step, state = pipeline.build_pipeline_step(
+            mesh, sp, hidden=HID, heads=HEADS, microbatches=M,
+            mode="guarded", guard_nan=(1, 1, 2))
+        tokens, labels = pipeline.make_batch_3d(
+            mesh, microbatches=M, batch_per_replica=2, seq=SEQ,
+            vocab=VOCAB)
+        out = step(*state, jnp.zeros((), jnp.int32), tokens, labels)
+        assert int(out[3].total_skips) == 0
+        assert np.isfinite(float(out[4]))
+        before = jax.tree_util.tree_map(np.asarray,
+                                        (out[0], out[1], out[2]))
+        out2 = step(out[0], out[1], out[2], out[3],
+                    jnp.ones((), jnp.int32), tokens, labels)
+        assert int(out2[3].total_skips) == 1
+        for b, a in zip(
+                jax.tree_util.tree_leaves(before),
+                jax.tree_util.tree_leaves((out2[0], out2[1],
+                                           out2[2]))):
+            np.testing.assert_array_equal(b, np.asarray(a))
+
+
+# ---------------------------------------------------------------------------
+# host-side: supervisor 3-D shrink policy
+# ---------------------------------------------------------------------------
+
+class TestSupervisor3D:
+    def test_half_world_gives_up_pipe_then_model(self):
+        from apex_tpu.resilience.supervisor import _half_world
+
+        assert _half_world((2, 2, 2)) == (2, 2, 1)
+        assert _half_world((2, 2, 1)) == (2, 1, 1)
+        assert _half_world((2, 1, 1)) == (1, 1, 1)
+        assert _half_world((1, 1, 1)) == (1, 1, 1)
+        assert _half_world((2, 2, 4)) == (2, 2, 2)
+
+
+# ---------------------------------------------------------------------------
+# compat: the retired transformer.pipeline_parallel surface
+# ---------------------------------------------------------------------------
+
+class TestCompatShims:
+    def test_shims_reexport_and_warn_once(self):
+        import importlib
+
+        import apex_tpu.transformer.pipeline_parallel.p2p_communication \
+            as p2p
+        import apex_tpu.transformer.pipeline_parallel.schedules \
+            as schedules
+
+        assert schedules.pipeline_schedule_plan \
+            is pipeline.pipeline_schedule_plan
+        assert schedules.get_forward_backward_func \
+            is pipeline.get_forward_backward_func
+        assert p2p.send_forward is pipeline.send_forward
+        assert p2p.recv_forward is pipeline.recv_forward
+        # one DeprecationWarning per process, total, across both shims
+        prev = pipeline._MOVED_WARNED
+        try:
+            pipeline._MOVED_WARNED = False
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                importlib.reload(schedules)
+                importlib.reload(p2p)
+            dep = [w for w in rec
+                   if issubclass(w.category, DeprecationWarning)
+                   and "apex_tpu.parallel.pipeline" in str(w.message)]
+            assert len(dep) == 1
+        finally:
+            pipeline._MOVED_WARNED = prev

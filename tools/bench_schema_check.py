@@ -221,6 +221,24 @@ TP_DP_AXIS_FIELDS = ("measured_comm_bytes_per_axis",
 TP_DP_BOOL_FIELD = "reshard_bitexact"
 TP_DP_REQUIRED_FIELDS = (TP_DP_NUM_FIELDS + TP_DP_AXIS_FIELDS
                          + (TP_DP_BOOL_FIELD,))
+# the 3-D pipeline-mesh contract (apex_tpu.parallel.pipeline, round
+# 22): a pp_tp_dp metric line must carry the measured 1F1B bubble
+# fraction next to its analytic model, the schedule shape
+# (pipeline_stages, microbatches), the baseline-vs-overlapped step
+# times, the per-axis comm-byte dicts WITH the pipe axis priced, and
+# the elastic 3-D ZeRO reshard verdict; pre-round-22 records carrying
+# the pipeline-only fields are flagged — the fields did not exist
+PP_TP_DP_FIELDS_SINCE_ROUND = 22
+PP_TP_DP_METRIC_PREFIX = "pp_tp_dp"
+PP_TP_DP_NUM_FIELDS = ("bubble_fraction", "bubble_fraction_model",
+                       "pipeline_stages", "microbatches",
+                       "baseline_step_ms", "overlapped_step_ms")
+# presence-gated pre-22: the fields no earlier bench ever emitted
+PP_TP_DP_NEW_FIELDS = ("bubble_fraction", "bubble_fraction_model",
+                       "pipeline_stages", "microbatches")
+PP_TP_DP_PIPE_AXIS = "pipe"
+PP_TP_DP_REQUIRED_FIELDS = (PP_TP_DP_NUM_FIELDS + TP_DP_AXIS_FIELDS
+                            + (TP_DP_BOOL_FIELD,))
 # the fused computation-collective contract (apex_tpu.kernels
 # .fused_cc, round 21): a fused_cc metric line carries per-family
 # fused-vs-unfused timings plus the traced-jaxpr HBM-intermediate
@@ -541,6 +559,44 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
             if TP_DP_BOOL_FIELD not in obj:
                 bad(f"tp_dp line missing {TP_DP_BOOL_FIELD!r} "
                     f"(required since round {TP_DP_FIELDS_SINCE_ROUND})")
+            elif not (obj[TP_DP_BOOL_FIELD] is None
+                      or isinstance(obj[TP_DP_BOOL_FIELD], bool)):
+                bad(f"{TP_DP_BOOL_FIELD} must be a boolean or null")
+        is_pp_tp_dp = str(obj.get("metric", "")).startswith(
+            PP_TP_DP_METRIC_PREFIX)
+        present_pp = [k for k in PP_TP_DP_NEW_FIELDS if k in obj]
+        if present_pp and (round_n is not None
+                           and round_n < PP_TP_DP_FIELDS_SINCE_ROUND):
+            bad(f"pp_tp_dp fields {present_pp} are only defined from "
+                f"round {PP_TP_DP_FIELDS_SINCE_ROUND}")
+        elif is_pp_tp_dp and (round_n is None
+                              or round_n >= PP_TP_DP_FIELDS_SINCE_ROUND):
+            for key in PP_TP_DP_NUM_FIELDS:
+                if key not in obj:
+                    bad(f"pp_tp_dp line missing {key!r} (required "
+                        f"since round {PP_TP_DP_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"pp_tp_dp field {key!r} must be numeric or "
+                        f"null")
+            for key in TP_DP_AXIS_FIELDS:
+                if key not in obj:
+                    bad(f"pp_tp_dp line missing {key!r} (required "
+                        f"since round {PP_TP_DP_FIELDS_SINCE_ROUND})")
+                elif obj[key] is not None and not (
+                        isinstance(obj[key], dict)
+                        and all(isinstance(k, str)
+                                and (v is None or _type_ok(v, _NUM))
+                                for k, v in obj[key].items())):
+                    bad(f"pp_tp_dp field {key!r} must be an axis-name "
+                        f"-> bytes dict or null")
+                elif (isinstance(obj[key], dict)
+                      and PP_TP_DP_PIPE_AXIS not in obj[key]):
+                    bad(f"pp_tp_dp field {key!r} must price the "
+                        f"{PP_TP_DP_PIPE_AXIS!r} axis")
+            if TP_DP_BOOL_FIELD not in obj:
+                bad(f"pp_tp_dp line missing {TP_DP_BOOL_FIELD!r} "
+                    f"(required since round "
+                    f"{PP_TP_DP_FIELDS_SINCE_ROUND})")
             elif not (obj[TP_DP_BOOL_FIELD] is None
                       or isinstance(obj[TP_DP_BOOL_FIELD], bool)):
                 bad(f"{TP_DP_BOOL_FIELD} must be a boolean or null")

@@ -56,6 +56,10 @@ PER_METRIC_BAND = {
     # step rate — the default training band, named here so the config
     # is explicitly calibrated rather than silently defaulted
     "tp_dp_steps_per_sec": 0.25,
+    # 3-D (data, model, pipe) pipeline mesh: the host-unrolled 1F1B
+    # schedule dispatches m + pp - 1 ticks of small kernels per step,
+    # so dispatch-overhead jitter weighs heavier than in the 2-D step
+    "pp_tp_dp_steps_per_sec": 0.30,
     # fused computation-collective geomean: a ratio of two timings of
     # the same computation, so host noise enters twice — and on
     # cpu-mesh captures the fused leg runs the Pallas interpreter,

@@ -1187,6 +1187,124 @@ def _tp_dp_smoke(bench):
             "guard_skip_revert": "bit-exact"}
 
 
+def _pp_tp_dp_smoke(bench):
+    """3-D pipeline-mesh smoke (round 22): run ``pp_tp_dp`` at a small
+    size and assert (a) exactly ONE compile for the overlapped 1F1B
+    step, (b) the overlapped step (DP bucket psums in the cooldown
+    bubbles) beat or matched the bubble-serialized baseline at
+    identical per-axis wire bytes, (c) the measured bubble fraction
+    landed inside the band around the 1F1B model ``(pp-1)/(m+pp-1)``
+    (the bench gates this itself), (d) the elastic 3-D ZeRO reshard
+    2x2x2 -> 2x2x1 -> back was bit-exact, and — on a multi-device
+    host — (e) all 13 lint rules came back clean and (f) the
+    telemetry JSONL carries per-axis collective events for ALL THREE
+    mesh axes (the per-axis rollup's reason to exist). Then (g) a
+    guarded 3-D step with a NaN injected at (step 1, stage, microbatch
+    2) skips and reverts params + the DP-scoped EF residual
+    bit-exactly over the 3-axis OR'd flag. Raises on any missing piece
+    so the stage shows up as ERROR rather than silently passing."""
+    import glob
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import mesh2d, pipeline
+
+    multi = len(jax.devices()) >= 8 and len(jax.devices()) % 8 == 0
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_pp_tp_dp_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            ret = bench.bench_pp_tp_dp(2, 2, hidden=64, layers=2,
+                                       heads=4, vocab=64, seq=16,
+                                       microbatches=4)
+        telemetry.get_registry().flush()
+    finally:
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+    if ret["compile_count"] != 1:
+        raise RuntimeError(
+            f"pp_tp_dp smoke: compile_count == "
+            f"{ret['compile_count']!r}, wanted exactly 1")
+    if ret["overlapped_step_ms"] > ret["baseline_step_ms"]:
+        raise RuntimeError(
+            f"pp_tp_dp smoke: overlapped 1F1B step "
+            f"({ret['overlapped_step_ms']} ms) did not beat the "
+            f"bubble-serialized baseline "
+            f"({ret['baseline_step_ms']} ms)")
+    if not ret["reshard_bitexact"]:
+        raise RuntimeError("pp_tp_dp smoke: elastic 3-D reshard "
+                           "round-trip not bit-exact")
+    if multi and ret["lint_violations"] != 0:
+        raise RuntimeError(
+            f"pp_tp_dp smoke: lint_violations == "
+            f"{ret['lint_violations']!r}, wanted 0")
+    if multi:
+        events = []
+        for p in glob.glob(os.path.join(tel_dir, "*.jsonl")):
+            with open(p) as f:
+                events.extend(json.loads(line) for line in f
+                              if line.strip())
+        axes = {e.get("axis") for e in events
+                if e.get("kind") == "collective"}
+        if not {"data", "model", "pipe"} <= axes:
+            raise RuntimeError(
+                f"pp_tp_dp smoke: per-axis collective events missing "
+                f"from the JSONL (saw axes "
+                f"{sorted(a for a in axes if a)})")
+    # (g) guard skip-revert on the 3-D mesh: step 1 is poisoned at one
+    # (stage, microbatch) coordinate; the flag ORs over all three axes
+    # so EVERY rank must skip, and params + the bucket-domain DP
+    # residual must come back bit-identical
+    mesh = (pipeline.mesh_3d(2, 2, 2) if multi
+            else pipeline.mesh_3d(1, 1, 1,
+                                  devices=jax.devices()[:1]))
+    pp = mesh.shape[pipeline.PIPE_AXIS]
+    sp = mesh2d.gpt2_init(hidden=32, layers=2, heads=4, vocab=32,
+                          max_seq=8)
+    step, state = pipeline.build_pipeline_step(
+        mesh, sp, hidden=32, heads=4, microbatches=4, mode="guarded",
+        guard_nan=(1, pp - 1, 2))
+    tokens, labels = pipeline.make_batch_3d(
+        mesh, microbatches=4, batch_per_replica=2, seq=8, vocab=32)
+    out = step(*state, jnp.zeros((), jnp.int32), tokens, labels)
+    if int(out[3].total_skips) != 0:
+        raise RuntimeError("pp_tp_dp smoke: clean 3-D step was "
+                           "skipped")
+    before = jax.tree_util.tree_map(np.asarray,
+                                    (out[0], out[1], out[2]))
+    out2 = step(out[0], out[1], out[2], out[3],
+                jnp.ones((), jnp.int32), tokens, labels)
+    if int(out2[3].total_skips) != 1:
+        raise RuntimeError("pp_tp_dp smoke: the poisoned 3-D step "
+                           "was not skipped")
+    for b_leaf, a_leaf in zip(
+            jax.tree_util.tree_leaves(before),
+            jax.tree_util.tree_leaves((out2[0], out2[1], out2[2]))):
+        if not np.array_equal(b_leaf, np.asarray(a_leaf)):
+            raise RuntimeError("pp_tp_dp smoke: guard skip did not "
+                               "revert bit-exactly on the 3-D mesh")
+    return {"telemetry_dir": tel_dir,
+            "compile_count": ret["compile_count"],
+            "baseline_step_ms": ret["baseline_step_ms"],
+            "overlapped_step_ms": ret["overlapped_step_ms"],
+            "bubble_fraction": ret["bubble_fraction"],
+            "bubble_fraction_model": ret["bubble_fraction_model"],
+            "lint_violations": ret["lint_violations"],
+            "reshard_bitexact": ret["reshard_bitexact"],
+            "measured_comm_bytes_per_axis":
+                ret["measured_comm_bytes_per_axis"],
+            "guard_skip_revert": "bit-exact"}
+
+
 def _recovery_smoke(bench):
     """Supervised-recovery smoke (round 13): run ``ddp_recovery`` (the
     all-in-one chaos acceptance — NaN escalation + synthetic OOM +
@@ -1283,6 +1401,7 @@ def _stages(smoke):
             ("sharding", None, lambda: _sharding_smoke(bench)),
             ("overlap", None, lambda: _overlap_smoke(bench)),
             ("tp_dp", None, lambda: _tp_dp_smoke(bench)),
+            ("pp_tp_dp", None, lambda: _pp_tp_dp_smoke(bench)),
             ("kernels", None, lambda: _kernels_smoke(bench)),
             ("fused_cc", None, lambda: bench.bench_fused_cc(128, 2)),
             ("trend", None, _trend_gate),
@@ -1424,6 +1543,14 @@ def _stages(smoke):
         # fused-vs-unfused timings with the static comm-byte parity and
         # HBM-intermediate reduction invariants enforced in-run
         ("fused_cc", None, spec("fused_cc")),
+        # round-22 3-D pipeline-mesh captures: the pp_tp_dp config at
+        # bench size (measured bubble fraction vs the 1F1B analytic
+        # model, overlapped vs bubble-serialized baseline at identical
+        # per-axis comm bytes incl. pipe, one compile, 3-D
+        # reshard_bitexact, all 13 rules clean) and the smoke proving
+        # the three-axis events + the guarded 3-D skip-revert
+        ("pp_tp_dp", None, spec("pp_tp_dp")),
+        ("pp_tp_dp_smoke", None, lambda: _pp_tp_dp_smoke(bench)),
         # round-5 kernels (VERDICT items 3, 4)
         ("mla_decode", None, spec("mla_decode")),
         ("moe_serve", None, spec("moe_serve")),

@@ -41,7 +41,7 @@ KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
     "compile", "memory", "serve", "recovery", "lint", "overlap",
-    "fleet", "kernel",
+    "fleet", "kernel", "pipeline",
 })
 
 # fleet timeline rows kept per report (replica state transitions +
@@ -51,6 +51,10 @@ _FLEET_TIMELINE_CAP = 128
 # timeline rows kept per report — enough for dozens of segments/buckets
 # without letting a long capture balloon the aggregate
 _OVERLAP_TIMELINE_CAP = 256
+
+# 1F1B tick spans kept per report — one schedule's worth
+# (m + 2*pp - 2 ticks) times a few traced steps
+_PIPELINE_TICKS_CAP = 256
 
 
 def aggregate(events):
@@ -83,6 +87,8 @@ def aggregate(events):
     kernels = {}
     overlap = {"plans": [], "summaries": [], "timeline": [],
                "timeline_truncated": 0}
+    pipeline = {"plans": [], "summaries": [], "ticks": [],
+                "ticks_truncated": 0}
     fleet = {"starts": [], "migrations": 0, "migrated_requests": 0,
              "lost_requests": 0, "respawns": 0, "rebalances": [],
              "scale_ups": 0, "scale_downs": 0, "timeline": [],
@@ -117,6 +123,20 @@ def aggregate(events):
                         })
                     else:
                         overlap["timeline_truncated"] += 1
+                elif str(name).startswith("pp_tick_"):
+                    # the 1F1B tick stream: each span carries the
+                    # (rank, microbatch) fwd/bwd units the schedule
+                    # table assigned to that tick
+                    if len(pipeline["ticks"]) < _PIPELINE_TICKS_CAP:
+                        pipeline["ticks"].append({
+                            "tick": ev.get("tick"),
+                            "phase": ev.get("phase"),
+                            "fwd": ev.get("fwd") or [],
+                            "bwd": ev.get("bwd") or [],
+                            "duration_s": d,
+                        })
+                    else:
+                        pipeline["ticks_truncated"] += 1
             elif kind == "collective":
                 key = (ev.get("name", "?"), ev.get("dtype", "?"))
                 c = collectives.setdefault(key, {
@@ -124,8 +144,9 @@ def aggregate(events):
                 c["calls"] += 1
                 c["wire_bytes"] += int(ev.get("wire_bytes") or 0)
                 c["elements"] += int(ev.get("elements") or 0)
-                # per-mesh-axis rollup (the 2-D mesh composition view:
-                # DP compression savings vs TP psum volume, separable)
+                # per-mesh-axis rollup (the mesh composition view: DP
+                # compression savings vs TP psum volume vs pipe-axis
+                # stage-transfer traffic, separable by axis name)
                 ax = collectives_by_axis.setdefault(
                     str(ev.get("axis") or "?"),
                     {"calls": 0, "wire_bytes": 0})
@@ -323,6 +344,19 @@ def aggregate(events):
                             "segments", "buckets", "baseline_step_ms",
                             "overlapped_step_ms", "compute_step_ms",
                             "comm_hidden_pct")})
+            elif kind == "pipeline":
+                if ev.get("name") == "plan":
+                    pipeline["plans"].append({
+                        k: ev.get(k) for k in (
+                            "stages", "microbatches", "warmup",
+                            "steady", "cooldown", "total", "stash")})
+                elif ev.get("name") == "summary":
+                    pipeline["summaries"].append({
+                        k: ev.get(k) for k in (
+                            "stages", "microbatches",
+                            "baseline_step_ms", "overlapped_step_ms",
+                            "bubble_fraction",
+                            "bubble_fraction_model")})
             elif kind == "fleet":
                 fname = ev.get("name")
                 if fname == "fleet_start":
@@ -413,6 +447,7 @@ def aggregate(events):
         "lint": lint,
         "kernels": kernels,
         "overlap": overlap,
+        "pipeline": pipeline,
         "unknown_kinds": unknown,
         "malformed_events": malformed,
         "counters": (last_summary or {}).get("counters", {}),
@@ -759,6 +794,67 @@ def print_report(report, out=None):
               f"compute-only {s.get('compute_step_ms')} ms -> "
               f"{hidden if hidden is not None else '?'}% of baseline "
               f"comm cost hidden\n")
+    pl = report.get("pipeline") or {}
+    if pl.get("plans") or pl.get("ticks") or pl.get("summaries"):
+        w("\npipeline (parallel/pipeline.py, 1F1B):\n")
+        plans = pl.get("plans") or []
+        if plans:
+            # first plan = first traced program = the schedule the
+            # timeline below renders (later plans are probe variants)
+            p = plans[0]
+            w(f"  plan: {p.get('stages')} stage(s) x "
+              f"{p.get('microbatches')} microbatch(es) — warmup "
+              f"{p.get('warmup')}, steady {p.get('steady')}, "
+              f"cooldown {p.get('cooldown')}, {p.get('total')} "
+              f"tick(s), stash depth {p.get('stash')}\n")
+        ticks = pl.get("ticks") or []
+        if ticks:
+            # several programs may have traced (baseline, 2M probe),
+            # each re-emitting ticks from 0 — render the FIRST
+            # complete schedule: the stream-ordered run of
+            # consecutively increasing tick ids starting at 0
+            order = []
+            for row in ticks:
+                if row.get("tick") == len(order):
+                    order.append(row)
+                elif order:
+                    break
+            units = [u for row in order
+                     for u in (list(row.get("fwd") or [])
+                               + list(row.get("bwd") or []))]
+            n_stages = ((plans[0].get("stages") if plans else None)
+                        or (max((int(u[0]) for u in units),
+                                default=0) + 1))
+            w("  per-stage microbatch timeline (F<m> forward, B<m> "
+              "backward, . idle):\n")
+            head = "".join(f"{str(row.get('tick')):>6}"
+                           for row in order)
+            w(f"    {'tick':<10}{head}\n")
+            phs = "".join(f"{str(row.get('phase') or '?')[:4]:>6}"
+                          for row in order)
+            w(f"    {'phase':<10}{phs}\n")
+            for r in range(int(n_stages)):
+                cells = []
+                for row in order:
+                    cell = "".join(
+                        [f"F{int(u[1])}" for u in (row.get("fwd")
+                                                   or [])
+                         if int(u[0]) == r]
+                        + [f"B{int(u[1])}" for u in (row.get("bwd")
+                                                     or [])
+                           if int(u[0]) == r])
+                    cells.append(f"{cell or '.':>6}")
+                w(f"    stage {r:<4}{''.join(cells)}\n")
+            if pl.get("ticks_truncated"):
+                w(f"    ... {pl['ticks_truncated']} more tick "
+                  f"span(s) truncated\n")
+        summaries = pl.get("summaries") or []
+        if summaries:
+            s = summaries[-1]
+            w(f"  measured: baseline {s.get('baseline_step_ms')} ms, "
+              f"overlapped {s.get('overlapped_step_ms')} ms; bubble "
+              f"fraction {s.get('bubble_fraction')} (1F1B model "
+              f"{s.get('bubble_fraction_model')})\n")
     unknown = report.get("unknown_kinds") or {}
     skipped = sum(unknown.values()) + report.get("malformed_events", 0)
     if skipped:
