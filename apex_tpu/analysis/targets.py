@@ -352,6 +352,68 @@ def serve_decode_step():
     return fn, args, {}
 
 
+@functools.lru_cache(maxsize=1)
+def _tiny_engine_tp():
+    """The ``serve_decode`` tiny model served tensor-parallel over a
+    (data=1, tp=2) mesh slice — the big-model configuration where the
+    KV store is sharded on the head axis and the decode body psums
+    partial logits over ``tp``. Leaves parallel_state initialized at
+    tp=2 (the lowering the caller is about to run needs it)."""
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.serving import ServeConfig, ServeEngine
+    from apex_tpu.transformer import parallel_state
+
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=64,
+        compute_dtype=jnp.bfloat16, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=4, ffn_hidden_size=128)
+    # full-size params FIRST (tp unbound): the engine splits them into
+    # per-rank stacks itself — initializing under tp=2 would hand it
+    # already-local params and double-split
+    parallel_state.destroy_model_parallel()
+    rng = np.random.RandomState(0)
+    params = GPTModel(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8))))["params"]
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, devices=jax.devices()[:2])
+    model = GPTModel(cfg, decode=True)
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2),
+                ("data", "tp"))
+    serve_cfg = ServeConfig(batch_buckets=(2,), prefill_buckets=(8,),
+                            num_slots=4, eos_token_id=None,
+                            temperature=0.0)
+    return ServeEngine(model, params, serve_cfg, mesh=mesh)
+
+
+def serve_decode_tp_step():
+    """The TP serving hot loop: the same decode body ``serve_decode``
+    lints, wrapped in the engine's jit(shard_map) manual-SPMD ladder
+    entry — KV store sharded over ``tp`` at the head axis, stacked
+    per-rank params unstacked inside, logits psummed on ``tp``. Lint
+    pricing this entry is what keeps the model-axis comm bill honest
+    (static == measured on ``tp``)."""
+    from apex_tpu.transformer import parallel_state
+
+    engine = _tiny_engine_tp()
+    # the cached engine outlives test-harness parallel_state resets;
+    # re-tracing its body needs tp=2 rebound exactly as built
+    if parallel_state.get_tensor_model_parallel_world_size() != 2:
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=2, devices=jax.devices()[:2])
+    b = engine.config.batch_buckets[0]
+    args = (engine._store, engine._params,
+            engine._put(np.zeros((b,), np.int32)),
+            engine._put(np.zeros((b,), np.int32)),
+            jax.random.PRNGKey(0), engine._put(np.int32(-1)))
+    fn = jax.jit(engine._tp_decode_body(),
+                 donate_argnums=(0,) if engine.config.donate else ())
+    return fn, args, {}
+
+
 # config name -> builder; the CLI's column set and the tier-1
 # clean-pass parametrization both read this
 TARGETS = {
@@ -364,4 +426,5 @@ TARGETS = {
     "tp_dp_overlapped": tp_dp_overlapped_step,
     "pp_tp_dp": pp_tp_dp_step,
     "serve_decode": serve_decode_step,
+    "serve_decode_tp": serve_decode_tp_step,
 }

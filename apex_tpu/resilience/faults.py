@@ -109,6 +109,8 @@ PLAN_KINDS = {
     "ckpt_torn": None,
     "ckpt_fail": None,  # step field = number of failing writes
     "replica_loss": "fleet replica index to kill (default 0)",
+    "kv_corrupt": "donor replica index whose migration payload is "
+                  "corrupted (default 0)",
 }
 
 
@@ -666,6 +668,64 @@ def replica_loss_for(fleet_step):
         _replica_loss_state = {"replica": int(e["arg"] or 0),
                                "step": e["step"], "fired": 0}
     st = _replica_loss_state
+    if not st or st["fired"] or int(fleet_step) != st["step"]:
+        return None
+    st["fired"] += 1
+    return st["replica"]
+
+
+_kv_corrupt_state = None   # {"replica", "step", "fired"}
+
+
+def arm_kv_corrupt(replica, step):
+    """Arm a one-shot KV-payload corruption: at fleet step ``step``
+    (the fleet's lifetime step counter, 0-based), the migration
+    payload extracted FROM donor replica ``replica`` gets one byte
+    flipped in flight — the checksum-fallback drill. The survivor must
+    detect the mismatch, count a loud fallback, and re-prefill from
+    tokens with the stream still completing. Returns the armed-state
+    dict (``"fired"`` counts firings). Overwrites any previous
+    arming."""
+    global _kv_corrupt_state
+    _kv_corrupt_state = {"replica": int(replica), "step": int(step),
+                         "fired": 0}
+    return _kv_corrupt_state
+
+
+def disarm_kv_corrupt():
+    global _kv_corrupt_state
+    _kv_corrupt_state = None
+
+
+@contextlib.contextmanager
+def inject_kv_corrupt(replica, step):
+    """Context-manager form of :func:`arm_kv_corrupt`; disarms on
+    exit. Yields the state dict so tests can assert
+    ``state["fired"] == 1``."""
+    state = arm_kv_corrupt(replica, step)
+    try:
+        yield state
+    finally:
+        disarm_kv_corrupt()
+
+
+def kv_corrupt_for(fleet_step):
+    """The donor replica index whose extracted KV payload is corrupted
+    at fleet step ``fleet_step``, or None.
+
+    Polled by ``serving.fleet.ServeFleet`` at KV-state capture time —
+    the payload-integrity sibling of :func:`replica_loss_for`, keyed
+    on the same lifetime step counter (arm both at the same step to
+    corrupt the handoff of the replica being killed). One-shot: a
+    matching call marks the arming fired. Env arming
+    (``APEX_TPU_FAULT_PLAN="kv_corrupt@N:R"``) is read lazily on first
+    consult and follows the same one-shot contract."""
+    global _kv_corrupt_state
+    if _kv_corrupt_state is None and fault_plan().get("kv_corrupt"):
+        e = fault_plan().get("kv_corrupt")
+        _kv_corrupt_state = {"replica": int(e["arg"] or 0),
+                             "step": e["step"], "fired": 0}
+    st = _kv_corrupt_state
     if not st or st["fired"] or int(fleet_step) != st["step"]:
         return None
     st["fired"] += 1

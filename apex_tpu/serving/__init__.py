@@ -25,19 +25,31 @@ Latency multipliers: ``ServeConfig(draft_model=..., draft_params=...)``
 turns every decode dispatch into one speculative draft-k -> verify ->
 rollback round inside the SAME bucket ladder (per-slot mixed
 acceptance, greedy token-identical to the plain engine), and
-``ServeConfig(prefix_cache=True)`` keeps a per-engine host-side
-:class:`~apex_tpu.serving.prefix_cache.PrefixStore` so prompts sharing
-a system prefix seed their KV rows from the cached copy and prefill
-only the suffix bucket. Both leave the AOT compile count exactly at
-the ladder size.
+``ServeConfig(prefix_cache=True)`` keeps a host-side
+:class:`~apex_tpu.serving.prefix_cache.PrefixStore` — FLEET-scoped:
+one instance is shared across every replica (``adopt_prefix_store``)
+with per-scope hit accounting, so a system prompt prefilled by one
+replica hits on all of them — and prompts sharing a cached prefix
+seed their KV rows from the stored copy and prefill only the suffix
+bucket. Both leave the AOT compile count exactly at the ladder size.
 
 Fleet (:mod:`~apex_tpu.serving.fleet`): a host-side router over N
 engines on distinct mesh slices — load-aware dispatch, per-tier SLOs
 (``Request.tier`` -> tier-default deadlines), a replica health state
 machine (healthy -> degraded -> quarantined -> respawning) with
-drain + request migration (re-prefill from prompt + emitted tokens;
-greedy continuations are token-identical), and elastic
-scale-up/down driven by sustained pending depth.
+drain + request migration, and elastic scale-up/down driven by
+sustained pending depth. Engines span a ``(data, model)`` slice when
+``FleetConfig(model_parallel=m)`` is set — TP-sharded KV cache and
+in-executable psums on the ``"tp"`` axis, same ladder invariants.
+Migration carries KV *state*, not just tokens:
+:meth:`~apex_tpu.serving.engine.ServeEngine.extract_kv_state` hands
+the survivor a crc32-checksummed host payload
+(:func:`~apex_tpu.serving.engine.kv_payload_crc`) that seeds the
+shared prefix store, so a migrated request re-prefills a ONE-token
+suffix — constant cost in context length. A failed checksum or
+layout mismatch falls back loudly (``fleet/kv_fallback_reprefills``
++ ``kv_fallback`` event) to token re-prefill; greedy continuations
+stay token-identical either way.
 
 Quickstart (docs/serving.md has the full tour)::
 
@@ -51,7 +63,11 @@ Quickstart (docs/serving.md has the full tour)::
         robust=RobustConfig(max_pending=64, ttft_deadline_s=30.0))
 """
 
-from apex_tpu.serving.engine import ServeConfig, ServeEngine  # noqa: F401
+from apex_tpu.serving.engine import (  # noqa: F401
+    ServeConfig,
+    ServeEngine,
+    kv_payload_crc,
+)
 from apex_tpu.serving.fleet import (  # noqa: F401
     DEFAULT_TIERS,
     FleetConfig,

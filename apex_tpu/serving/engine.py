@@ -87,6 +87,12 @@ class ServeConfig:
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     data_axis: str = "data"             # mesh axis the slot dim shards over
+    # mesh axis the model shards over in tensor-parallel serving. Must
+    # stay "tp": GPTModel hardwires its collectives to axis name "tp",
+    # and an unbound axis makes those psums silently vanish (axis size
+    # 1) — wrong results, not an error — so the engine validates the
+    # name loudly instead of accepting an alias.
+    model_axis: str = "tp"
     donate: bool = True                 # donate the store through the step
     preflight: bool = True
     preflight_strict: bool = False
@@ -105,6 +111,19 @@ class ServeConfig:
     # fused_cc gate is live; False pins the einsum formulation for
     # this engine's traced executables regardless of the gate
     fused_verify: bool = True
+
+
+def kv_payload_crc(payload):
+    """Recompute a migration payload's checksum from its contents —
+    the verification side of :meth:`ServeEngine.extract_kv_state`.
+    Folds the target rows, the draft rows (when present), and the
+    fill length into one crc32; any flipped byte anywhere in the
+    pytree (or a tampered length) changes the result."""
+    crc = kvc.payload_checksum(payload["rows"])
+    if payload.get("draft_rows") is not None:
+        crc = kvc.payload_checksum(payload["draft_rows"], crc)
+    return kvc.payload_checksum(
+        [np.asarray(int(payload["length"]), np.int64)], crc)
 
 
 class ServeEngine:
@@ -126,10 +145,8 @@ class ServeEngine:
             get_tensor_model_parallel_world_size,
         )
 
-        if get_tensor_model_parallel_world_size() > 1:
-            raise NotImplementedError(
-                "ServeEngine drives a tp=1 model (shard the cache over "
-                "the data axis; a TP serving loop composes later)")
+        tp = get_tensor_model_parallel_world_size()
+        self._tp = int(tp)
         if not getattr(model, "decode", False):
             raise ValueError("ServeEngine needs a model built with "
                              "decode=True")
@@ -147,7 +164,39 @@ class ServeEngine:
             raise ValueError(
                 f"largest prefill bucket ({sb[-1]}) exceeds "
                 f"max_position_embeddings ({limit})")
-        if mesh is not None and config.num_slots % mesh.devices.size:
+        if tp > 1:
+            # tensor-parallel serving: the model was built under
+            # parallel_state tp=m, so its cache template is the LOCAL
+            # per-rank layout and its collectives name axis "tp" — the
+            # engine's job is to give that axis a mesh to live on and
+            # shard the store's head dimension over it.
+            if mesh is None:
+                raise ValueError(
+                    f"tensor parallel serving (tp={tp}) needs a (data, "
+                    f"model) mesh — pass mesh=Mesh(devs.reshape(1, "
+                    f"{tp}), ('{config.data_axis}', "
+                    f"'{config.model_axis}'))")
+            if config.model_axis != "tp":
+                raise ValueError(
+                    f"model_axis ({config.model_axis!r}) must be 'tp': "
+                    f"the model's collectives are hardwired to that "
+                    f"axis name, and an unbound axis would silently "
+                    f"skip every psum (axis size 1) instead of failing")
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if sizes.get(config.model_axis) != tp:
+                raise ValueError(
+                    f"mesh axis {config.model_axis!r} has size "
+                    f"{sizes.get(config.model_axis)} but "
+                    f"parallel_state says tp={tp} — the mesh must "
+                    f"match the process-group layout the model was "
+                    f"built under")
+            if sizes.get(config.data_axis, 1) != 1:
+                raise ValueError(
+                    f"a TP-sharded engine serves one replica: the "
+                    f"{config.data_axis!r} axis must have size 1 "
+                    f"(got {sizes.get(config.data_axis)}) — scale out "
+                    f"with fleet replicas, not a wide data axis")
+        elif mesh is not None and config.num_slots % mesh.devices.size:
             raise ValueError(
                 f"num_slots ({config.num_slots}) must divide evenly "
                 f"over the {mesh.devices.size}-device mesh")
@@ -182,6 +231,11 @@ class ServeEngine:
         self.config = dataclasses.replace(config, batch_buckets=bb,
                                           prefill_buckets=sb)
         self._prefix = bool(config.prefix_cache)
+        # per-caller attribution on a possibly-shared store: the
+        # fleet swaps in one fleet-scoped PrefixStore via
+        # adopt_prefix_store, and each engine generation's distinct
+        # name keeps its hit columns separate from its predecessors'
+        self._scope = name or "engine"
         self.prefix_store = PrefixStore(
             max_entries=config.prefix_max_entries,
             min_len=config.prefix_min_len) if self._prefix else None
@@ -211,24 +265,71 @@ class ServeEngine:
         # post-mortem handler, then commit shardings ---------------------
         labels = {"params": params}
         dstore = dparams = None
+        self._row_shardings = {}
         with tmemory.oom_guard(registry=registry, labels=labels):
-            store = self.spec.allocate()
-            if self._spec_decode:
-                dstore = self.draft_spec.allocate()
-                dparams = config.draft_params
-            if mesh is not None:
+            if self._tp > 1:
+                # TP placement: params stacked [tp, ...] in tp_split's
+                # column/row-parallel layout and sharded over the model
+                # axis; the store allocated as host numpy GLOBAL zeros
+                # and device_put against the per-leaf spec tree — a
+                # traced per-rank allocate would register a compile
+                # OUTSIDE the AOT ladder and poison the fleet's
+                # recompile accounting on respawn.
                 from jax.sharding import NamedSharding, PartitionSpec
+                from apex_tpu.models.tp_split import split_params_for_tp
 
-                self._sharded = NamedSharding(
-                    mesh, PartitionSpec(config.data_axis))
+                def shardings(pspecs):
+                    return jax.tree_util.tree_map(
+                        lambda ps: NamedSharding(mesh, ps), pspecs,
+                        is_leaf=lambda l: isinstance(l, PartitionSpec))
+
+                ax = config.model_axis
                 self._replicated = NamedSharding(mesh, PartitionSpec())
-                store = jax.device_put(store, self._sharded)
-                params = jax.device_put(params, self._replicated)
+                self._param_sharding = NamedSharding(
+                    mesh, PartitionSpec(ax))
+                self._sharded = shardings(
+                    self.spec.store_pspecs(config.data_axis, ax))
+                store = jax.device_put(
+                    self.spec.host_global_store(self._tp), self._sharded)
+                params = jax.device_put(
+                    split_params_for_tp(model.config, params, self._tp),
+                    self._param_sharding)
+                self._row_shardings["target"] = shardings(
+                    self.spec.row_pspecs(ax, lead=1))
                 if self._spec_decode:
-                    dstore = jax.device_put(dstore, self._sharded)
-                    dparams = jax.device_put(dparams, self._replicated)
+                    self._draft_sharded = shardings(
+                        self.draft_spec.store_pspecs(config.data_axis,
+                                                     ax))
+                    dstore = jax.device_put(
+                        self.draft_spec.host_global_store(self._tp),
+                        self._draft_sharded)
+                    dparams = jax.device_put(
+                        split_params_for_tp(config.draft_model.config,
+                                            config.draft_params,
+                                            self._tp),
+                        self._param_sharding)
+                    self._row_shardings["draft"] = shardings(
+                        self.draft_spec.row_pspecs(ax, lead=1))
             else:
-                self._sharded = self._replicated = None
+                store = self.spec.allocate()
+                if self._spec_decode:
+                    dstore = self.draft_spec.allocate()
+                    dparams = config.draft_params
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    self._sharded = NamedSharding(
+                        mesh, PartitionSpec(config.data_axis))
+                    self._replicated = NamedSharding(mesh,
+                                                     PartitionSpec())
+                    store = jax.device_put(store, self._sharded)
+                    params = jax.device_put(params, self._replicated)
+                    if self._spec_decode:
+                        dstore = jax.device_put(dstore, self._sharded)
+                        dparams = jax.device_put(dparams,
+                                                 self._replicated)
+                else:
+                    self._sharded = self._replicated = None
         self._store = store
         self._draft_store = dstore
         self._params = params
@@ -259,6 +360,14 @@ class ServeEngine:
         aot = f"{name}/serve" if name else "serve"
         decode_body = self._spec_decode_fn if self._spec_decode \
             else self._decode_fn
+        prefill_body = self._prefill_fn
+        if self._tp > 1:
+            # manual-SPMD ladder: every executable is jit(shard_map)
+            # over the (data=1, tp=m) mesh — the model's 'tp' psums
+            # bind inside, the store stays head-sharded through the
+            # step, and nothing ever lowers through GSPMD propagation
+            decode_body = self._tp_decode_body()
+            prefill_body = self._tp_prefill_body()
         decode_tag = "spec_decode" if self._spec_decode else "decode"
         prefill_tag = "seeded_prefill" if self._prefix else "prefill"
         donate = ((0, 1) if self._spec_decode else (0,)) \
@@ -284,7 +393,7 @@ class ServeEngine:
                         self._seed_rows_dev(b, "target"),
                         self._seed_rows_dev(b, "draft"), self._key0)
                     plow = jax.jit(
-                        self._prefill_fn, donate_argnums=donate
+                        prefill_body, donate_argnums=donate
                     ).lower(*pargs)
                     self._prefill_exec[(b, s)] = self._compile(
                         plow,
@@ -404,7 +513,7 @@ class ServeEngine:
         key = (b, which)
         if key not in self._zero_rows_np:
             spec = self.spec if which == "target" else self.draft_spec
-            zero = spec.host_zero_row()
+            zero = spec.host_zero_row(tp=self._tp)
             self._zero_rows_np[key] = jax.tree_util.tree_map(
                 lambda l: np.zeros((b,) + l.shape, l.dtype), zero)
         return self._zero_rows_np[key]
@@ -417,9 +526,113 @@ class ServeEngine:
             return None
         key = (b, which)
         if key not in self._zero_rows_dev:
-            self._zero_rows_dev[key] = jax.tree_util.tree_map(
-                self._put, rows)
+            self._zero_rows_dev[key] = self._put_rows(rows, which)
         return self._zero_rows_dev[key]
+
+    def _put_rows(self, rows, which):
+        """Place a [b]-stacked CANONICAL seed-row tree: in TP mode the
+        K/V groups axis shards over the model axis (each rank receives
+        exactly its head slice — the reshard half of the migration
+        pair); otherwise replicated like every other host operand."""
+        if self._tp > 1:
+            return jax.device_put(rows, self._row_shardings[which])
+        return jax.tree_util.tree_map(self._put, rows)
+
+    # -- tensor-parallel ladder bodies (jit(shard_map) manual SPMD) --------
+
+    def _tp_decode_body(self):
+        """The decode body wrapped in one ``shard_map`` over the whole
+        step: store rows arrive head-sharded, params arrive as each
+        rank's stacked slice (unstacked inside, the
+        ``tensor_parallel_generate`` idiom), and the model's own 'tp'
+        collectives — attention/MLP psums, the vocab gather before
+        sampling — bind against the mesh axis. Everything downstream
+        of the gather is rank-identical (shared key), so tokens and
+        flags leave as replicated outputs."""
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self.config
+        ax = cfg.model_axis
+        store_ps = self.spec.store_pspecs(cfg.data_axis, ax)
+        unstack = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)  # noqa: E731
+        if self._spec_decode:
+            dstore_ps = self.draft_spec.store_pspecs(cfg.data_axis, ax)
+
+            def body(store, dstore, params, dparams, slot_ids, tokens,
+                     key, poison):
+                return self._spec_decode_fn(
+                    store, dstore, unstack(params), unstack(dparams),
+                    slot_ids, tokens, key, poison)
+
+            return jax.shard_map(
+                body, mesh=self.mesh,
+                in_specs=(store_ps, dstore_ps, P(ax), P(ax), P(), P(),
+                          P(), P()),
+                out_specs=(store_ps, dstore_ps, P(), P(), P()),
+                check_vma=False)
+
+        def body(store, params, slot_ids, tokens, key, poison):
+            return self._decode_fn(store, unstack(params), slot_ids,
+                                   tokens, key, poison)
+
+        return jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(store_ps, P(ax), P(), P(), P(), P()),
+            out_specs=(store_ps, P(), P()),
+            check_vma=False)
+
+    def _tp_prefill_body(self):
+        """The prefill body under the same ``shard_map`` treatment.
+        Seed rows cross the boundary in CANONICAL layout and the
+        in_specs slice each rank's head shard out (so entries cached
+        by an engine of a different tp size seed here unchanged); the
+        raw-row outputs reassemble to canonical through the matching
+        out_specs — together the consolidate/reshard pair the
+        KV-state migration is built on."""
+        from jax.sharding import PartitionSpec as P
+
+        cfg = self.config
+        ax = cfg.model_axis
+        store_ps = self.spec.store_pspecs(cfg.data_axis, ax)
+        row_ps = self.spec.row_pspecs(ax, lead=1)
+        unstack = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)  # noqa: E731
+        in_specs = [store_ps]
+        out_specs = [store_ps]
+        if self._spec_decode:
+            dstore_ps = self.draft_spec.store_pspecs(cfg.data_axis, ax)
+            drow_ps = self.draft_spec.row_pspecs(ax, lead=1)
+            in_specs.append(dstore_ps)
+            out_specs.append(dstore_ps)
+        in_specs.append(P(ax))
+        if self._spec_decode:
+            in_specs.append(P(ax))
+        in_specs += [P(), P(), P()]         # slot_ids, tokens, true_len
+        if self._prefix:
+            in_specs += [P(), row_ps]       # start, prefix_rows
+            if self._spec_decode:
+                in_specs.append(drow_ps)
+        in_specs.append(P())                # key
+        out_specs.append(P())               # first sampled token
+        if self._prefix:
+            out_specs.append(row_ps)
+            if self._spec_decode:
+                out_specs.append(drow_ps)
+
+        def body(*args):
+            it = iter(args)
+            a2 = [next(it)]
+            if self._spec_decode:
+                a2.append(next(it))
+            a2.append(unstack(next(it)))
+            if self._spec_decode:
+                a2.append(unstack(next(it)))
+            a2.extend(it)
+            return self._prefill_fn(*a2)
+
+        return jax.shard_map(body, mesh=self.mesh,
+                             in_specs=tuple(in_specs),
+                             out_specs=tuple(out_specs),
+                             check_vma=False)
 
     @property
     def compile_count(self):
@@ -445,15 +658,32 @@ class ServeEngine:
 
     @property
     def prefix_hits(self):
-        return self.prefix_store.hits if self._prefix else 0
+        """THIS engine's hits — per-scope numbers, so a fleet-shared
+        store still reports each replica's own column truthfully."""
+        return self.prefix_store.scope_stats(self._scope)["hits"] \
+            if self._prefix else 0
 
     @property
     def prefix_lookups(self):
-        return self.prefix_store.lookups if self._prefix else 0
+        return self.prefix_store.scope_stats(self._scope)["lookups"] \
+            if self._prefix else 0
 
     @property
     def prefix_hit_tokens(self):
-        return self.prefix_store.hit_tokens if self._prefix else 0
+        return self.prefix_store.scope_stats(
+            self._scope)["hit_tokens"] if self._prefix else 0
+
+    def adopt_prefix_store(self, store):
+        """Swap in a shared (fleet-scoped) :class:`PrefixStore`. Host-
+        only and compile-free, so the fleet calls it right after
+        construction; per-scope accounting keeps this engine's hit
+        columns separate on the shared store. Returns the store."""
+        if not self._prefix:
+            raise ValueError(
+                "engine was built without prefix_cache=True — there "
+                "is no seeded-prefill ladder to serve a shared store")
+        self.prefix_store = store
+        return store
 
     def kv_cache_bytes(self):
         return self.spec.total_bytes()
@@ -478,6 +708,58 @@ class ServeEngine:
     def slot_lengths(self):
         """Host copy of the per-slot fill levels (one tiny fetch)."""
         return np.asarray(kvc.store_lengths(self._store))
+
+    def seed_row_template(self, which="target"):
+        """The CANONICAL (cross-rank) host row layout this engine
+        seeds slots from — the shape/dtype contract a migration
+        payload's rows must satisfy. tp-independent by construction:
+        a tp=m engine's local groups axis times m is exactly the tp=1
+        model layout, so engines of any TP size agree on it."""
+        spec = self.spec if which == "target" else self.draft_spec
+        return spec.host_zero_row(tp=self._tp) if spec is not None \
+            else None
+
+    def extract_kv_state(self, slot_ids):
+        """Device-get each slot's KV state and consolidate it into a
+        checksummed host payload — the donor half of constant-cost
+        migration. Per slot: fetch the (possibly head-sharded) store
+        rows, consolidate them to CANONICAL raw model-layout rows
+        (per-rank int8 blocks dequantize and concatenate in head
+        order — ``KVCacheSpec.consolidate_host_rows``), fetch the
+        draft rows the same way on a speculative engine, and fold
+        rows + fill length into a crc32 (:func:`kv_payload_crc`).
+
+        Returns ``{slot: {"slot", "length", "tp", "cache_mode",
+        "rows", "draft_rows", "crc"}}``. Call AFTER
+        ``Scheduler.extract_unfinished`` (slot release only forgets
+        the id — the rows stay resident) and BEFORE anything prefills
+        into the freed slots."""
+        lengths = self.slot_lengths()
+        out = {}
+        for slot in slot_ids:
+            slot = int(slot)
+            rows = jax.tree_util.tree_map(
+                lambda l: np.asarray(jax.device_get(l[slot])),
+                self._store)
+            canon = self.spec.consolidate_host_rows(rows, tp=self._tp)
+            dcanon = None
+            if self._spec_decode:
+                drows = jax.tree_util.tree_map(
+                    lambda l: np.asarray(jax.device_get(l[slot])),
+                    self._draft_store)
+                dcanon = self.draft_spec.consolidate_host_rows(
+                    drows, tp=self._tp)
+            payload = {
+                "slot": slot,
+                "length": int(lengths[slot]),
+                "tp": self._tp,
+                "cache_mode": self.config.cache_mode,
+                "rows": canon,
+                "draft_rows": dcanon,
+            }
+            payload["crc"] = kv_payload_crc(payload)
+            out[slot] = payload
+        return out
 
     def _pick_bucket(self, ladder, n, what):
         for b in ladder:
@@ -802,7 +1084,8 @@ class ServeEngine:
         per-slot seed stack (cached entry rows on a hit, zeros on a
         miss), prefill only the suffix bucket, then cache the merged
         rows of every newly-seen prompt."""
-        lookups = [self.prefix_store.lookup(p) for p in prompts]
+        lookups = [self.prefix_store.lookup(p, scope=self._scope)
+                   for p in prompts]
         cuts = [c for c, _ in lookups]
         suffix_lens = [plen - c for plen, c in zip(plens, cuts)]
         sbucket = self._pick_bucket(self.config.prefill_buckets,
@@ -849,7 +1132,7 @@ class ServeEngine:
         key = ("zero_row", attr)
         if key not in self._zero_rows_np:
             spec = self.spec if attr == "rows" else self.draft_spec
-            self._zero_rows_np[key] = spec.host_zero_row()
+            self._zero_rows_np[key] = spec.host_zero_row(tp=self._tp)
         return self._zero_rows_np[key]
 
     def _stack_seed_rows(self, lookups, bbucket, attr):
@@ -863,7 +1146,8 @@ class ServeEngine:
         picks += [zero] * (bbucket - len(picks))
         stacked = jax.tree_util.tree_map(
             lambda *leaves: np.stack(leaves), *picks)
-        return jax.tree_util.tree_map(self._put, stacked)
+        return self._put_rows(
+            stacked, "target" if attr == "rows" else "draft")
 
     def _record_prefix(self, prompts, plens, cuts, hits, sbucket, rows,
                        drows):
@@ -899,7 +1183,8 @@ class ServeEngine:
             drow_i = jax.tree_util.tree_map(
                 lambda l: np.copy(l[i]), host_drows) \
                 if host_drows is not None else None
-            self.prefix_store.insert(prompts[i], row_i, drow_i)
+            self.prefix_store.insert(prompts[i], row_i, drow_i,
+                                     scope=self._scope)
 
     def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
                guarded=True, retries=0, backoff_s=0.05,

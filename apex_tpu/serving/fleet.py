@@ -39,12 +39,26 @@ hard-loss drill: the engine drops dead mid-trace). A soft-quarantined
 replica is drained via ``Scheduler.drain()`` — its queue migrates
 immediately, in-flight slots finish inside the drain window — while a
 lost replica migrates everything at once. **Migration** re-admits each
-unfinished request as a continuation: re-prefill from the original
-prompt plus the tokens already emitted; because the engine's
-``cache_index`` rollback makes a right-padded prefill equivalent to
-having decoded the same prefix, resumed greedy decode is
-token-identical to an unkilled run (the e2e acceptance pins it; for
-sampled decode the RNG stream differs — see docs/serving.md).
+unfinished request as a continuation (prompt + emitted tokens,
+remaining budget) and, when the fleet runs the shared prefix store,
+moves the KV-cache *state* with it: the donor's slots are device_get
+into checksummed canonical host payloads
+(``ServeEngine.extract_kv_state``), verified (crc32 + layout) and
+inserted into the fleet-wide :class:`PrefixStore` keyed by each
+continuation's prefix — so the survivor's seeded prefill hits the
+carried state and runs a ONE-token suffix bucket regardless of
+context length (constant-cost failover; docs/serving.md#kv-state-
+migration). A failed checksum or incompatible layout falls back
+LOUDLY (``fleet/kv_fallback_reprefills`` + ``kv_fallback`` event) to
+plain token re-prefill; without the store, token re-prefill is the
+only path. Either way, because the engine's ``cache_index`` rollback
+makes a right-padded prefill equivalent to having decoded the same
+prefix, resumed greedy decode is token-identical to an unkilled run
+(the e2e acceptance pins it; for sampled decode the RNG stream
+differs — see docs/serving.md). ``FleetConfig.model_parallel`` turns
+each replica slice into a (data=1, tp=m) mesh — a model too big for
+one DP slice serves under the same quarantine/respawn machinery, and
+canonical payloads hand off between replicas of ANY tp size.
 A respawned replica builds a fresh engine on the same device slice and
 re-registers its AOT ladder with the CompileWatcher under a fresh
 generation name (same ladder + new name = zero false recompiles).
@@ -136,8 +150,17 @@ class FleetConfig:
     scale_down_pending: Optional[int] = None
     scale_sustain_ticks: int = 3
     data_axis: str = "data"
+    # tensor-parallel width per replica: each replica becomes a
+    # (data=1, tp=m) mesh slice, so a model too big for one DP slice
+    # serves under the fleet. Requires parallel_state initialized with
+    # the same tp (the engine validates it); auto-partition gives each
+    # replica exactly m devices.
+    model_parallel: int = 1
 
     def __post_init__(self):
+        if self.model_parallel < 1:
+            raise ValueError(
+                f"model_parallel ({self.model_parallel}) must be >= 1")
         if self.num_replicas < 1:
             raise ValueError(
                 f"num_replicas ({self.num_replicas}) must be >= 1")
@@ -268,11 +291,11 @@ class Replica:
             "evicted": self.evicted,
             "respawns": self.respawns,
             "compile_count": getattr(self.engine, "compile_count", None),
-            # the serving multipliers are per-replica state: each
-            # replica keeps its own prefix store (a migrated
-            # continuation re-prefills on the survivor and hits
-            # whatever the SURVIVOR's traffic already cached) and its
-            # own acceptance counters
+            # the serving multipliers stay per-replica columns even on
+            # the fleet-SHARED prefix store: each engine reads its own
+            # per-scope counters, so hits earned by this replica's
+            # traffic (including migrated continuations hitting their
+            # own carried prefixes) land here and nowhere else
             "prefix_hits": getattr(self.engine, "prefix_hits", 0),
             "spec_accepted": getattr(self.sched, "spec_accepted", 0),
             "spec_proposed": getattr(self.sched, "spec_proposed", 0),
@@ -326,6 +349,23 @@ class ServeFleet:
         self.scale_ups = 0
         self.scale_downs = 0
         self.lost_requests = 0
+        self.kv_handoffs = 0
+        self.kv_handoff_bytes = 0
+        self.kv_fallback_reprefills = 0
+        # ONE fleet-scoped prefix store shared by every replica (and
+        # every respawn generation): a system prompt prefilled by
+        # replica 0 hits on replica 3, a dead replica's prefix work
+        # survives it, and KV-state handoff seeds migrated requests
+        # through it. Per-scope counters keep each replica's hit
+        # columns truthful on the shared store.
+        self.prefix_store = None
+        if serve_config is not None and getattr(serve_config,
+                                                "prefix_cache", False):
+            from apex_tpu.serving.prefix_cache import PrefixStore
+
+            self.prefix_store = PrefixStore(
+                max_entries=serve_config.prefix_max_entries,
+                min_len=serve_config.prefix_min_len)
         # lifetime prefix/spec totals folded in when an engine drops
         # (quarantine/retire) so respawns never erase the accounting
         self._multiplier_totals = {"prefix_lookups": 0, "prefix_hits": 0,
@@ -378,18 +418,30 @@ class ServeFleet:
         import jax
 
         devices = jax.devices()
+        m = int(self.config.model_parallel)
         dpr = self.config.devices_per_replica
         if dpr == 0 and len(devices) >= n_replicas:
-            dpr = len(devices) // n_replicas
+            dpr = m if m > 1 else len(devices) // n_replicas
         if dpr < 1 or len(devices) < n_replicas * dpr:
             return [(None, None)] * n_replicas
+        if m > 1 and dpr % m:
+            raise ValueError(
+                f"devices_per_replica ({dpr}) must be a multiple of "
+                f"model_parallel ({m}) — each replica is a (data, tp) "
+                f"slice")
         from jax.sharding import Mesh
 
         slices = []
         for i in range(n_replicas):
             devs = tuple(devices[i * dpr:(i + 1) * dpr])
-            slices.append((devs, Mesh(np.asarray(devs),
-                                      (self.config.data_axis,))))
+            if m > 1:
+                # a (data, tp) slice per replica; the engine enforces
+                # data size 1 (scale out with replicas, not DP width)
+                mesh = Mesh(np.asarray(devs).reshape(dpr // m, m),
+                            (self.config.data_axis, "tp"))
+            else:
+                mesh = Mesh(np.asarray(devs), (self.config.data_axis,))
+            slices.append((devs, mesh))
         return slices
 
     def _spawn(self, rep, reason):
@@ -401,6 +453,11 @@ class ServeFleet:
         name = (f"replica{rep.idx}" if rep.generation == 0
                 else f"replica{rep.idx}.g{rep.generation}")
         rep.engine = self._factory(rep.idx, rep.mesh, name)
+        if self.prefix_store is not None and hasattr(
+                rep.engine, "adopt_prefix_store"):
+            # host-only and compile-free, so post-construction is safe;
+            # the fresh generation name doubles as a fresh scope
+            rep.engine.adopt_prefix_store(self.prefix_store)
         rep.sched = Scheduler(rep.engine, registry=self._registry,
                               robust=self._robust, clock=self._clock)
         rep.generation += 1
@@ -609,12 +666,18 @@ class ServeFleet:
 
     def _lose_replica(self, rep, reason="replica_loss"):
         """Hard loss: the engine is gone — migrate EVERYTHING now,
-        then count down to respawn."""
+        then count down to respawn. KV capture runs between the
+        scheduler sweep and the re-admission: slot release only
+        forgets ids (rows stay resident), so the donor's cache is
+        still intact and each active request's state rides out as a
+        checksummed host payload."""
         self._collect(rep)
         t0 = self._clock()
         records = rep.sched.extract_unfinished(reason=reason)
+        kv_payloads = self._capture_kv(rep, records)
         self._set_state(rep, "quarantined", reason)
-        self._migrate(rep, records, t0, reason=reason)
+        self._migrate(rep, records, t0, reason=reason,
+                      kv_payloads=kv_payloads)
         self._drop_engine(rep)
         self._schedule_respawn(rep, reason)
 
@@ -641,7 +704,9 @@ class ServeFleet:
         t0 = self._clock()
         records = rep.sched.extract_unfinished(reason="quarantine_drain")
         if records:
-            self._migrate(rep, records, t0, reason="quarantine_drain")
+            kv_payloads = self._capture_kv(rep, records)
+            self._migrate(rep, records, t0, reason="quarantine_drain",
+                          kv_payloads=kv_payloads)
         self._drop_engine(rep)
         self._schedule_respawn(rep, "quarantine_drain")
 
@@ -679,14 +744,152 @@ class ServeFleet:
             widest = max(self._serve_config.prefill_buckets)
         return widest or 10 ** 9
 
-    def _migrate(self, rep, records, t0, reason):
+    def _capture_kv(self, rep, records):
+        """Donor-side half of KV-state handoff: map each ACTIVE
+        record's slot to a checksummed host payload
+        (``ServeEngine.extract_kv_state``). Returns ``{rid: payload}``
+        — empty when the fleet has no shared prefix store (the seeding
+        path), the engine has no KV surface (stub engines), or
+        extraction itself fails (logged loudly; migration then falls
+        back to token re-prefill for every request). The armed
+        ``kv_corrupt`` fault flips one byte here, in flight — the
+        checksum-fallback drill."""
+        from apex_tpu.resilience import faults
+
+        eng = rep.engine
+        if (eng is None or self.prefix_store is None
+                or not hasattr(eng, "extract_kv_state")):
+            return {}
+        slots = {r["request"].rid: r["slot"] for r in records
+                 if r.get("slot") is not None}
+        if not slots:
+            return {}
+        try:
+            payloads = eng.extract_kv_state(sorted(set(slots.values())))
+        except Exception as e:  # noqa: BLE001 — degraded, never dead
+            reg = self._reg()
+            reg.counter("fleet/kv_extract_failures").inc()
+            reg.event("fleet", "kv_extract_failed", replica=rep.idx,
+                      error=type(e).__name__, detail=str(e)[:200],
+                      tick=self.tick)
+            return {}
+        if faults.kv_corrupt_for(self.step_count) == rep.idx:
+            self._corrupt_payload(rep, payloads)
+        return {rid: payloads[slot] for rid, slot in slots.items()
+                if slot in payloads}
+
+    def _corrupt_payload(self, rep, payloads):
+        """The ``kv_corrupt`` injection point: XOR one byte of the
+        largest leaf of the first payload's rows — exactly the kind of
+        in-flight bit rot the crc32 must catch downstream."""
+        import jax
+
+        if not payloads:
+            return
+        slot = sorted(payloads)[0]
+        leaf = max(jax.tree_util.tree_leaves(payloads[slot]["rows"]),
+                   key=lambda a: a.nbytes)
+        leaf.reshape(-1).view(np.uint8)[0] ^= 0xFF
+        reg = self._reg()
+        reg.counter("fleet/kv_corrupt_injected").inc()
+        reg.event("fleet", "kv_corrupt_injected", replica=rep.idx,
+                  slot=int(slot), tick=self.tick)
+
+    def _survivor_template(self, donor):
+        """The canonical seed-row layout migrated state must match: a
+        serving survivor's template when one exists, else the donor's
+        own (layouts are tp-independent by construction, so any engine
+        of the same model agrees)."""
+        for rep in self.replicas:
+            if (rep is not donor and rep.serving()
+                    and hasattr(rep.engine, "seed_row_template")):
+                return rep.engine.seed_row_template()
+        if donor.engine is not None and hasattr(donor.engine,
+                                                "seed_row_template"):
+            return donor.engine.seed_row_template()
+        return None
+
+    @staticmethod
+    def _layout_matches(rows, tmpl):
+        import jax
+
+        try:
+            tl, tdef = jax.tree_util.tree_flatten(tmpl)
+            rl, rdef = jax.tree_util.tree_flatten(rows)
+            if tdef != rdef:
+                return False
+            return all(
+                np.shape(a) == np.shape(b)
+                and np.asarray(a).dtype == np.asarray(b).dtype
+                for a, b in zip(rl, tl))
+        except Exception:  # noqa: BLE001 — malformed payload = mismatch
+            return False
+
+    def _seed_prefix_from_payload(self, rep, rid, cont, payload):
+        """Survivor-side half of KV-state handoff: verify the crc32,
+        validate the canonical layout against a serving survivor's
+        template, then insert the carried rows into the SHARED prefix
+        store keyed by the continuation's prefix — the survivor's
+        seeded prefill hits it and runs a one-token suffix bucket, so
+        migration cost is flat in context length. Any failed check
+        falls back LOUDLY (``fleet/kv_fallback_reprefills`` +
+        ``kv_fallback`` event) to the token re-prefill the fleet
+        always had: degraded, never poisoned, never silent. Returns
+        True when the handoff landed."""
+        import jax
+
+        from apex_tpu.serving.engine import kv_payload_crc
+
+        reg = self._reg()
+        why = None
+        try:
+            if kv_payload_crc(payload) != payload.get("crc"):
+                why = "checksum_mismatch"
+        except Exception:  # noqa: BLE001 — unhashable payload = corrupt
+            why = "checksum_mismatch"
+        if why is None:
+            tmpl = self._survivor_template(rep)
+            if tmpl is None or not self._layout_matches(
+                    payload.get("rows"), tmpl):
+                why = "incompatible_layout"
+        if why is not None:
+            self.kv_fallback_reprefills += 1
+            reg.counter("fleet/kv_fallback_reprefills").inc()
+            reg.event("fleet", "kv_fallback", rid=rid, replica=rep.idx,
+                      reason=why, tick=self.tick)
+            return False
+        carry = np.asarray(cont.prompt, np.int32)
+        cut = min(int(payload["length"]), len(carry) - 1)
+        if cut <= self.prefix_store.min_len:
+            # too short to key — a normal miss, not a fallback
+            return False
+        self.prefix_store.insert(carry[:cut], payload["rows"],
+                                 payload.get("draft_rows"),
+                                 scope=f"handoff.replica{rep.idx}")
+        nbytes = int(sum(
+            l.nbytes for l in jax.tree_util.tree_leaves(
+                (payload["rows"], payload.get("draft_rows")))))
+        self.kv_handoffs += 1
+        self.kv_handoff_bytes += nbytes
+        reg.counter("fleet/kv_handoffs").inc()
+        reg.counter("fleet/kv_handoff_bytes").inc(nbytes)
+        reg.event("fleet", "kv_handoff", rid=rid, replica=rep.idx,
+                  slot=int(payload.get("slot", -1)),
+                  length=int(payload["length"]), cut=int(cut),
+                  bytes=nbytes, tick=self.tick)
+        return True
+
+    def _migrate(self, rep, records, t0, reason, kv_payloads=None):
         """Re-admit a dead/draining replica's unfinished requests as
         continuations: prompt + emitted tokens, remaining token
         budget, same tier/deadlines. Greedy continuations are
         token-identical to an unkilled run (the cache_index-rollback
         prefill equivalence); a continuation too long for every
         prefill ladder is a non-silent loss (terminal ``failed`` +
-        ``fleet/lost_requests``)."""
+        ``fleet/lost_requests``). With KV payloads in hand
+        (``_capture_kv``) each continuation's carried state seeds the
+        shared prefix store first, so the survivor re-prefills a
+        one-token suffix instead of the whole context."""
         migrated, tokens_carried = 0, 0
         readmitted = []
         max_prefill = self._max_prefill()
@@ -728,6 +931,9 @@ class ServeFleet:
             cont = dataclasses.replace(
                 orig, prompt=prompt, max_new_tokens=remaining,
                 arrival=self.tick)
+            if kv_payloads and rid in kv_payloads:
+                self._seed_prefix_from_payload(rep, rid, cont,
+                                               kv_payloads[rid])
             self.pending.append(cont)
             readmitted.append(rid)
             migrated += 1
@@ -1032,6 +1238,17 @@ class ServeFleet:
                 tiers.get("batch", {}).get("ttft_p99_ms"),
             "migrated_requests": len(self.migrated_rids),
             "lost_requests": self.lost_requests,
+            "kv_handoffs": self.kv_handoffs,
+            "kv_handoff_bytes": self.kv_handoff_bytes,
+            "kv_fallback_reprefills": self.kv_fallback_reprefills,
+            # the SHARED store's global hit rate: cross-replica reuse
+            # included, which is exactly what per-replica accounting
+            # can't see (None when the fleet runs without the store)
+            "fleet_prefix_hit_rate": (
+                round(self.prefix_store.hits
+                      / self.prefix_store.lookups, 4)
+                if self.prefix_store is not None
+                and self.prefix_store.lookups else None),
             "rebalance_latency_ms": (round(self.rebalance_ms[-1], 3)
                                      if self.rebalance_ms else None),
             "replicas_quarantined": self.quarantine_count,

@@ -16,9 +16,27 @@ per row (each slot carries its own scalar ``cache_index``, so mixed
 sequence lengths coexist), and scatters the rows back — admission is a
 prefill-scatter into free slots, eviction is just forgetting a slot id.
 
-Sharding: every leaf's leading axis is the slot axis, so one
-``NamedSharding(mesh, P(data_axis))`` spreads the store — byte-for-byte
-the dominant HBM cost of serving — across the data axis of the mesh.
+Sharding: every leaf's leading axis is the slot axis, so on a 1-D data
+mesh one ``NamedSharding(mesh, P(data_axis))`` spreads the store —
+byte-for-byte the dominant HBM cost of serving — across the data axis.
+On a 2-D ``(data, model)`` mesh (:meth:`KVCacheSpec.store_pspecs`) the
+K/V leaves shard over the *model* axis on the head dimension instead:
+the spec is built under ``parallel_state`` tp=m, so its template is the
+LOCAL (``groups/m``) layout, and the global store is the rank shards
+concatenated in head order (bf16: the groups axis; int8: the blocks
+axis — per-rank block grids, so the blockwise quantization stays
+rank-local and collective-free). Slots replicate across ``data`` in
+that mode (the fleet gives a TP replica its own ``(1, m)`` slice).
+
+Migration (:meth:`KVCacheSpec.consolidate_host_rows` +
+:func:`payload_checksum`): one slot's host-fetched store rows
+consolidate into canonical RAW model-layout rows — per-rank int8
+blocks dequantize and the head shards concatenate, mirroring the
+consolidate half of ``reshard_zero_state_2d`` — so a survivor of ANY
+tp size re-slices the same canonical payload through its own prefill
+``in_specs`` (the reshard half). The crc32 checksum over the canonical
+leaves is what the fleet verifies before seeding; a mismatch falls
+back loudly to token re-prefill.
 
 int8 mode (``mode="int8"``): K/V leaves are stored as blockwise
 symmetric int8 with fp32 scales per ``block_size``-lane block —
@@ -34,6 +52,8 @@ by half a quantization step, ``absmax_block / 254`` — the same
 per-block bound the compression tests pin, inherited verbatim here
 (tests/L0/test_serving.py holds a 64-token decode to it).
 """
+
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +92,17 @@ def zero_row(template):
     (trace-friendly: the serving prefill builds fresh rows in-graph)."""
     return jax.tree_util.tree_map(
         lambda sd: jnp.zeros(sd.shape, sd.dtype), template)
+
+
+def payload_checksum(tree, crc=0):
+    """crc32 over every leaf of a host pytree, in flatten order — the
+    migration payload's integrity check (same zlib.crc32 convention as
+    ``apex_tpu.checkpoint``). Chainable: pass a previous checksum as
+    ``crc`` to fold several trees (target + draft rows) into one."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        crc = zlib.crc32(
+            np.ascontiguousarray(np.asarray(leaf)).tobytes(), crc)
+    return int(crc)
 
 
 def store_lengths(store):
@@ -152,7 +183,7 @@ class KVCacheSpec:
 
         return jax.tree_util.tree_map_with_path(leaf, self.template)
 
-    def host_zero_row(self):
+    def host_zero_row(self, tp=1):
         """Host numpy zero row in MODEL layout (one slot, no leading
         axis, full-precision K/V even in int8 mode) — the
         prefix-cache's seed template: cached entries are RAW rows (a
@@ -160,11 +191,171 @@ class KVCacheSpec:
         full-precision prefix K/V a cold prefill computed — seeding
         dequantized int8 would perturb every suffix K/V), and a miss
         seeds from these zeros (``cache_index`` 0 masks every
-        position, so the content is never attended)."""
+        position, so the content is never attended).
+
+        ``tp > 1`` returns the CANONICAL (cross-rank) layout for a
+        tensor-parallel engine: the local template's groups axis scaled
+        by ``tp`` — the wire format the fleet-wide prefix store and
+        the migration payload both speak, so engines of different tp
+        sizes seed from the same entries (each re-slices through its
+        prefill ``in_specs``)."""
         # sd.dtype is numpy-compatible (ml_dtypes registers bf16)
-        return jax.tree_util.tree_map(
-            lambda sd: np.zeros(tuple(sd.shape), sd.dtype),
+        return jax.tree_util.tree_map_with_path(
+            lambda p, sd: np.zeros(
+                self._canonical_shape(p, sd, tp), sd.dtype),
             self.template)
+
+    def _canonical_shape(self, path, sd, tp):
+        """One template leaf's cross-rank shape: K/V leaves scale the
+        groups axis (-2) by ``tp``; everything else is rank-replicated
+        (``cache_index`` scalars agree across ranks)."""
+        shape = tuple(sd.shape)
+        if int(tp) > 1 and _is_kv(_names(path)):
+            shape = shape[:-2] + (shape[-2] * int(tp),) + shape[-1:]
+        return shape
+
+    def store_pspecs(self, data_axis="data", model_axis=None):
+        """Per-leaf ``PartitionSpec`` tree for the slotted store.
+
+        Without ``model_axis`` this is the classic 1-D design: every
+        leaf shards its leading slot axis over ``data_axis``. With
+        ``model_axis`` set (tensor-parallel serving) the K/V leaves
+        shard over the model axis on the head dimension — the groups
+        axis in bf16 mode, the blocks axis in int8 mode (both axis -2
+        of the store leaf, so per-rank block grids stay rank-local) —
+        and every other leaf (``cache_index``, slots) replicates: the
+        fleet gives each TP replica a ``(data=1, model=m)`` slice, so
+        global slot ids gather locally on every rank."""
+        from jax.sharding import PartitionSpec as P
+
+        def leaf(path, sd):
+            names = _names(path)
+            if model_axis is None:
+                spec = P(data_axis)
+                if self.mode == "int8" and _is_kv(names):
+                    return {"q": spec, "scale": spec}
+                return spec
+            if not _is_kv(names):
+                return P()
+            if self.mode == "int8":
+                # q: [slots, *mid, T, nb, block]; scale shares nb at -2
+                nd = 1 + len(sd.shape[:-3]) + 2
+                spec = P(*((None,) * (nd - 2) + (model_axis,)))
+                return {"q": spec, "scale": spec}
+            # bf16: [slots, *sd.shape]; groups axis at -2
+            nd = 1 + len(sd.shape)
+            return P(*((None,) * (nd - 2) + (model_axis,)))
+
+        return jax.tree_util.tree_map_with_path(leaf, self.template)
+
+    def row_pspecs(self, model_axis, lead=1):
+        """``PartitionSpec`` tree for RAW model-layout rows with
+        ``lead`` extra leading axes (the batch-stacked seed/raw rows a
+        tensor-parallel prefill moves): K/V leaves shard their groups
+        axis over ``model_axis``, everything else replicates — the
+        in/out_specs that re-slice a canonical row into rank shards
+        (and reassemble the raw outputs into canonical host rows)."""
+        from jax.sharding import PartitionSpec as P
+
+        def leaf(path, sd):
+            if not _is_kv(_names(path)):
+                return P()
+            nd = int(lead) + len(sd.shape)
+            return P(*((None,) * (nd - 2) + (model_axis,)))
+
+        return jax.tree_util.tree_map_with_path(leaf, self.template)
+
+    def host_global_store(self, tp=1):
+        """Host numpy zeroed GLOBAL store for a ``tp``-way engine:
+        :meth:`allocate`'s layout with every K/V leaf's sharded axis
+        (groups in bf16, blocks in int8) scaled by ``tp``. Zeros are
+        rank-independent, so one ``device_put`` against
+        :meth:`store_pspecs` places it with no traced allocation (an
+        in-graph per-rank allocate would register a compile outside
+        the AOT ladder)."""
+        tp = int(tp)
+
+        def leaf(path, sd):
+            names = _names(path)
+            if self.mode == "int8" and _is_kv(names):
+                lead = (self.num_slots,) + tuple(sd.shape[:-3])
+                nb = self._num_blocks(sd) * tp
+                return {
+                    "q": np.zeros(lead + (nb, self._block_size(sd)),
+                                  np.int8),
+                    "scale": np.zeros(lead + (nb, 1), np.float32),
+                }
+            shape = self._canonical_shape(path, sd, tp)
+            return np.zeros((self.num_slots,) + shape, sd.dtype)
+
+        return jax.tree_util.tree_map_with_path(leaf, self.template)
+
+    def consolidate_host_rows(self, rows, tp=1):
+        """Host-side consolidation of one slot's device-fetched STORE
+        rows into canonical RAW model-layout rows — the migration
+        payload's wire format (and the fleet-wide prefix store's entry
+        layout). Mirrors the consolidate half of
+        ``reshard_zero_state_2d``: per-rank int8 blocks dequantize
+        against their own scales and trim their own zero-pad, then the
+        head shards concatenate in rank order; bf16 shards are already
+        head-concatenated by the global view, so consolidation is a
+        dtype-checked pass-through. The reshard half is the survivor's
+        prefill ``in_specs`` (:meth:`row_pspecs`), which re-slice the
+        canonical rows for ANY tp size whose head count divides.
+
+        Raises ``ValueError`` on any leaf whose shape or dtype does
+        not match this spec's ``tp``-scaled layout — the incompatible-
+        layout signal the fleet turns into a LOUD re-prefill fallback,
+        never a silently mis-seeded slot."""
+        tp = int(tp)
+
+        def fix(path, leaf):
+            names = _names(path)
+            sd = self._by_path.get(names)
+            if sd is None:
+                raise ValueError(
+                    f"kv payload leaf {names!r} is not in this engine's "
+                    f"cache layout")
+            if self.mode == "int8" and _is_kv(names):
+                if not (isinstance(leaf, dict) and "q" in leaf):
+                    raise ValueError(
+                        f"kv payload leaf {names!r}: expected an int8 "
+                        f"q/scale subtree, got {type(leaf).__name__}")
+                q = np.asarray(leaf["q"])
+                s = np.asarray(leaf["scale"], np.float32)
+                nb = self._num_blocks(sd)
+                block = self._block_size(sd)
+                lead = tuple(sd.shape[:-3])
+                if q.shape != lead + (tp * nb, block) or q.dtype != np.int8:
+                    raise ValueError(
+                        f"kv payload leaf {names!r}: int8 blocks "
+                        f"{q.shape}/{q.dtype} do not match the "
+                        f"tp={tp} layout {lead + (tp * nb, block)}")
+                width = self._kv_feature_width(sd)
+                deq = (q.astype(np.float32)
+                       * s.reshape(lead + (tp, nb, 1)).astype(np.float32)
+                       .reshape(lead + (tp * nb, 1)))
+                deq = deq.reshape(lead + (tp, nb * block))[..., :width]
+                # local flattened lanes -> (1, g_local, hd), ranks
+                # concatenated on the groups axis in head order
+                deq = deq.reshape(lead + (tp,) + tuple(sd.shape[-3:]))
+                deq = np.moveaxis(deq, len(lead), len(lead) + 1)
+                out = deq.reshape(
+                    lead + self._canonical_shape(path, sd, tp)[-3:])
+                return out.astype(sd.dtype)
+            want = self._canonical_shape(path, sd, tp) if _is_kv(names) \
+                else tuple(sd.shape)
+            arr = np.asarray(leaf)
+            if arr.shape != want or arr.dtype != np.dtype(sd.dtype):
+                raise ValueError(
+                    f"kv payload leaf {names!r}: {arr.shape}/{arr.dtype} "
+                    f"does not match the tp={tp} canonical layout "
+                    f"{want}/{np.dtype(sd.dtype)}")
+            return np.copy(arr)
+
+        return jax.tree_util.tree_map_with_path(
+            fix, rows,
+            is_leaf=lambda l: isinstance(l, dict) and "q" in l)
 
     # -- bytes accounting --------------------------------------------------
 

@@ -30,12 +30,23 @@ Two properties make this safe without any new executables:
 
 Everything here is plain numpy + dict bookkeeping: nothing traces,
 nothing compiles, so the engine's flat-compile invariant is untouched.
-The store is per-engine — in a fleet that means per-replica (a
-migrated continuation re-prefills on the survivor and hits whatever
-the *survivor's* traffic already cached). Memory is bounded by
-``max_entries`` x bytes-per-entry (one slot row, plus the draft row
-when speculative decode is on) with LRU eviction; docs/serving.md has
-the accounting worked example.
+The store is **fleet-scoped**: `ServeFleet` builds one shared store
+and every replica adopts it (``ServeEngine.adopt_prefix_store``), so
+a system prompt prefilled once by replica 0 hits on replica 3, a dead
+replica's prefix work survives it, and a migrated continuation hits
+its own carried prefix on the survivor. Because entries are CANONICAL
+(cross-rank, full-precision) rows, engines of different tensor-
+parallel sizes share the same store — each re-slices at seed time
+through its prefill in_specs. Per-caller attribution goes through the
+``scope=`` keyword on :meth:`lookup` / :meth:`insert`: the store
+keeps per-scope lookup/hit/hit-token/insertion counters next to the
+globals (``stats()["by_scope"]``), which is what keeps each replica's
+hit-rate column truthful when the store itself is shared. A
+standalone engine passes its own name and behaves exactly as the old
+per-engine store did. Memory is bounded by ``max_entries`` x
+bytes-per-entry (one slot row, plus the draft row when speculative
+decode is on) with LRU eviction; docs/serving.md has the accounting
+worked example.
 """
 
 import hashlib
@@ -103,6 +114,11 @@ class PrefixStore:
         self.hit_tokens = 0
         self.insertions = 0
         self.evictions = 0
+        self._scopes = {}            # scope name -> per-scope counters
+
+    def _scope(self, name):
+        return self._scopes.setdefault(str(name), {
+            "lookups": 0, "hits": 0, "hit_tokens": 0, "insertions": 0})
 
     def _key(self, tokens):
         return hashlib.sha1(
@@ -125,13 +141,18 @@ class PrefixStore:
         if not bucket:
             del self._index[self._key(entry.tokens)]
 
-    def lookup(self, prompt):
+    def lookup(self, prompt, *, scope=None):
         """Longest usable cached prefix of ``prompt``: returns
         ``(cut, entry)`` with ``cut`` the number of prefix tokens the
         entry covers (``0, None`` on a miss). ``cut`` never exceeds
-        ``len(prompt) - 1`` and never undershoots ``min_len``."""
+        ``len(prompt) - 1`` and never undershoots ``min_len``.
+        ``scope`` attributes the lookup (and any hit) to that caller's
+        per-scope counters on top of the store-wide ones."""
         prompt = np.asarray(prompt, np.int32)
         self.lookups += 1
+        sc = self._scope(scope) if scope is not None else None
+        if sc is not None:
+            sc["lookups"] += 1
         if prompt.shape[0] <= self.min_len:
             return 0, None
         best_cut, best = 0, None
@@ -146,6 +167,9 @@ class PrefixStore:
         best.hits += 1
         self.hits += 1
         self.hit_tokens += best_cut
+        if sc is not None:
+            sc["hits"] += 1
+            sc["hit_tokens"] += best_cut
         return best_cut, best
 
     def covers(self, prompt):
@@ -157,7 +181,7 @@ class PrefixStore:
             _common_prefix_len(e.tokens, prompt) >= prompt.shape[0]
             for e in self._index.get(self._key(prompt), ()))
 
-    def insert(self, prompt, rows, draft_rows=None):
+    def insert(self, prompt, rows, draft_rows=None, *, scope=None):
         """Cache one prefilled prompt (host numpy copies of the raw
         model-layout rows). Refuses prompts shorter than ``min_len`` + 1 (nothing
         to key on plus a suffix) and exact re-covers; an entry whose
@@ -176,6 +200,8 @@ class PrefixStore:
         self._order.append(entry)
         self._index.setdefault(key, []).append(entry)
         self.insertions += 1
+        if scope is not None:
+            self._scope(scope)["insertions"] += 1
         while len(self._order) > self.max_entries:
             self._drop(self._order[0])
             self.evictions += 1
@@ -192,4 +218,15 @@ class PrefixStore:
             "hit_tokens": self.hit_tokens,
             "insertions": self.insertions,
             "evictions": self.evictions,
+            "by_scope": {
+                name: dict(c) for name, c in sorted(self._scopes.items())
+            },
         }
+
+    def scope_stats(self, scope):
+        """One scope's counters (zeros if the scope never called in) —
+        what a fleet replica reads back to report its OWN hit rate
+        against the shared store."""
+        c = self._scopes.get(str(scope))
+        return dict(c) if c else {
+            "lookups": 0, "hits": 0, "hit_tokens": 0, "insertions": 0}

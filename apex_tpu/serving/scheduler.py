@@ -531,10 +531,14 @@ class Scheduler:
         lost replica's unfinished work is re-admitted to survivors, so
         the requests must leave this scheduler accounted-for but not
         finished. Returns one record per request — ``{"request",
-        "tokens" (emitted so far), "ttft_s", "latencies", "where"}`` —
-        everything the fleet needs to build the re-prefill
+        "tokens" (emitted so far), "ttft_s", "latencies", "where",
+        "slot"}`` — everything the fleet needs to build the re-prefill
         continuation (prompt + emitted tokens; greedy decode resumes
-        token-identically). Each extraction ticks ``serve/extracted``
+        token-identically). ``"slot"`` is the store slot the request
+        occupied (None for pending records): slot release only returns
+        the id to the free pool — the KV rows stay resident — so the
+        fleet can still ``extract_kv_state`` the donor's cache AFTER
+        this sweep, as long as nothing prefills in between. Each extraction ticks ``serve/extracted``
         and lands a ``serve``/``extracted`` JSONL event; ``which``
         scopes the sweep (``"all"`` | ``"active"`` | ``"pending"`` —
         a draining replica migrates its queue immediately but lets
@@ -551,13 +555,14 @@ class Scheduler:
                             "tokens": list(st.tokens),
                             "ttft_s": st.ttft_s,
                             "latencies": list(st.latencies),
-                            "where": "active"})
+                            "where": "active",
+                            "slot": slot})
         if which in ("all", "pending"):
             for r in list(self.pending):
                 self.pending.remove(r)
                 out.append({"request": r, "tokens": [],
                             "ttft_s": float("nan"), "latencies": [],
-                            "where": "pending"})
+                            "where": "pending", "slot": None})
         reg = self._reg()
         for rec in out:
             rid = rec["request"].rid

@@ -53,6 +53,25 @@ def axis_label(axis_name):
     return str(axis_name)
 
 
+def traced_elements(x):
+    """Physical element count of ``x``, batch axes included.
+
+    Inside ``jax.vmap`` a tracer's visible aval is the UNBATCHED view,
+    so ``x.size`` under-counts by the batch factor and the trace-time
+    accounting would drift below the lowered HLO's batched collectives
+    (static==measured per axis is a checked invariant — the serving
+    decode body psums under a slot vmap). Unwrap the batch-tracer
+    chain and count the underlying value's shape instead."""
+    val = x
+    try:
+        from jax.interpreters import batching
+        while isinstance(val, batching.BatchTracer):
+            val = val.val
+    except Exception:
+        val = x
+    return int(np.prod([int(d) for d in np.shape(val)]))
+
+
 def axis_world(axis_name):
     """Concrete size of a (possibly tuple) mesh axis, resolved at trace
     time; 1 when no axis is bound (single-device fallback paths)."""

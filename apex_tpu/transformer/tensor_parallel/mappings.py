@@ -35,7 +35,8 @@ def _axis_size(axis_name) -> int:
 
 # TP collectives are accounted like every DP collective
 # (telemetry/comm.py record_collective, trace-time): full-width
-# activation payloads tagged with the model axis name, so a 2-D
+# activation payloads (vmap batch axes included — traced_elements)
+# tagged with the model axis name, so a 2-D
 # (data, model) report separates compressed DP grad bytes from fp32
 # TP psum volume per axis.
 
@@ -43,7 +44,8 @@ def _reduce(x, axis_name=TENSOR_PARALLEL_AXIS):
     if _axis_size(axis_name) == 1:
         return x
     _telemetry_comm.record_collective(
-        "psum", elements=x.size, dtype=x.dtype, axis_name=axis_name)
+        "psum", elements=_telemetry_comm.traced_elements(x),
+        dtype=x.dtype, axis_name=axis_name)
     return lax.psum(x, axis_name)
 
 
@@ -61,8 +63,8 @@ def _gather(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
     if size == 1:
         return x
     _telemetry_comm.record_collective(
-        "all_gather", elements=x.size, dtype=x.dtype,
-        axis_name=axis_name)
+        "all_gather", elements=_telemetry_comm.traced_elements(x),
+        dtype=x.dtype, axis_name=axis_name)
     return lax.all_gather(x, axis_name, axis=dim, tiled=True)
 
 
@@ -71,8 +73,8 @@ def _reduce_scatter(x, dim, axis_name=TENSOR_PARALLEL_AXIS):
     if size == 1:
         return x
     _telemetry_comm.record_collective(
-        "psum_scatter", elements=x.size, dtype=x.dtype,
-        axis_name=axis_name)
+        "psum_scatter", elements=_telemetry_comm.traced_elements(x),
+        dtype=x.dtype, axis_name=axis_name)
     return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
 
 

@@ -3361,6 +3361,164 @@ def bench_serve_fleet(requests, steps):
     return ret
 
 
+def bench_serve_migrate(requests, steps):
+    """KV-state migration cost bench (round-23 contract): measures the
+    constant-cost claim of the fleet handoff path head-on.
+
+    Leg 1 (microbench, the headline): a donor engine serves a request
+    to a SHORT and a LONG context, then the exact survivor-side
+    handoff sequence runs timed — ``extract_kv_state`` (host payload +
+    crc32), checksum verify, prefix-store insert keyed by the
+    continuation prefix, and the survivor's SEEDED prefill (1-token
+    suffix = smallest seq bucket). Because the extracted rows are
+    full-length slot buffers and the seeded suffix never grows, the
+    wall clock is flat in context length: ``migration_ms_long_ctx /
+    migration_ms_short_ctx`` must stay <= 1.25. The linear comparator
+    is measured next to it: a cold token re-prefill of the same carry
+    (prefix miss, bucket >= context), whose long/short ratio is
+    emitted as ``reprefill_ratio`` — the cost curve migration avoids.
+
+    Leg 2 (fleet counters): a 2-replica fleet with the shared prefix
+    store serves a diurnal trace while ``inject_replica_loss`` kills
+    replica 0 mid-trace; the emitted ``kv_handoff_bytes``,
+    ``fallback_reprefills`` (must be 0 on the clean path), and
+    ``fleet_prefix_hit_rate`` come from the fleet's own accounting of
+    that chaos leg, with zero lost requests.
+    """
+    from apex_tpu.resilience import faults
+    from apex_tpu.serving import (FleetConfig, ServeConfig, ServeEngine,
+                                  ServeFleet, diurnal_trace)
+    from apex_tpu.serving.engine import kv_payload_crc
+    from apex_tpu.telemetry import CompileWatcher
+
+    smoke, cfg, model, params, _, _ = _serve_bench_setup()
+    buckets = (4, 16, 64) if smoke else (8, 64, 512)
+    # carry = prompt + emitted must land exactly in the mid/widest
+    # buckets so the re-prefill comparator prices the real ladder rungs
+    emit_n = 4
+    ctx_short = buckets[1] - emit_n
+    ctx_long = buckets[2] - emit_n
+    donor_cfg = ServeConfig(
+        batch_buckets=(2,), prefill_buckets=buckets, num_slots=4,
+        cache_mode="bf16", eos_token_id=None, temperature=0.0)
+    surv_cfg = ServeConfig(
+        batch_buckets=(2,), prefill_buckets=buckets, num_slots=6,
+        cache_mode="bf16", eos_token_id=None, temperature=0.0,
+        prefix_cache=True, prefix_min_len=2)
+    watcher = CompileWatcher(enabled=True)
+    donor = ServeEngine(model, params, donor_cfg, watcher=watcher)
+    surv = ServeEngine(model, params, surv_cfg, watcher=watcher)
+    rng = np.random.RandomState(0)
+
+    def carry_for(ctx):
+        """Serve a fresh prompt of length ``ctx`` on the donor for
+        ``emit_n`` greedy tokens; returns (carry_tokens, payload)."""
+        prompt = rng.randint(0, cfg.vocab_size, (ctx,)).astype(np.int32)
+        toks = [int(donor.prefill([0], [prompt],
+                                  pad_slot_ids=[1])[0])]
+        for _ in range(emit_n - 1):
+            nxt, _fin = donor.decode(
+                [0], np.asarray([toks[-1]], np.int32),
+                pad_slot_ids=[1])
+            toks.append(int(nxt[0]))
+        payload = donor.extract_kv_state([0])[0]
+        return np.concatenate([prompt, np.asarray(toks, np.int32)]), \
+            payload
+
+    reps = 3
+    t_total = time.perf_counter()
+
+    def measure(ctx, slot):
+        """Median timed handoff + cold-reprefill pair at one context
+        length; also returns the handoff payload byte count."""
+        mig, rep, nbytes = [], [], 0
+        for r in range(reps):
+            carry, payload = carry_for(ctx)
+            t0 = time.perf_counter()
+            if kv_payload_crc(payload) != payload["crc"]:
+                raise AssertionError("kv payload checksum broke in "
+                                     "transit — migration bench void")
+            cut = min(int(payload["length"]), len(carry) - 1)
+            surv.prefix_store.insert(carry[:cut], payload["rows"],
+                                     payload.get("draft_rows"))
+            jax.block_until_ready(surv.prefill([slot], [carry],
+                                               pad_slot_ids=[5]))
+            mig.append((time.perf_counter() - t0) * 1e3)
+            if surv.last_prefill_hits[0] != cut:
+                raise AssertionError(
+                    "seeded prefill missed the handoff entry "
+                    f"(hit={surv.last_prefill_hits[0]}, cut={cut})")
+            nbytes = int(sum(
+                l.nbytes for l in jax.tree_util.tree_leaves(
+                    (payload["rows"], payload.get("draft_rows")))))
+            # comparator: the same carry cold — a prefix miss pays the
+            # full bucket >= context, the linear curve migration dodges
+            cold = rng.randint(0, cfg.vocab_size,
+                               (len(carry),)).astype(np.int32)
+            t0 = time.perf_counter()
+            jax.block_until_ready(surv.prefill([slot + 1], [cold],
+                                               pad_slot_ids=[5]))
+            rep.append((time.perf_counter() - t0) * 1e3)
+        return sorted(mig)[reps // 2], sorted(rep)[reps // 2], nbytes
+
+    mig_short, rep_short, _ = measure(ctx_short, 0)
+    mig_long, rep_long, handoff_bytes_one = measure(ctx_long, 2)
+    migration_ratio = mig_long / mig_short if mig_short else None
+    reprefill_ratio = rep_long / rep_short if rep_short else None
+
+    # leg 2: the fleet's own chaos-path accounting for the handoff
+    # counters the schema carries
+    fleet_cfg = FleetConfig(num_replicas=2, respawn_delay_ticks=1)
+    plens = (4, 8, 12) if smoke else (8, 24, 48)
+    widest = buckets[-1]
+    max_new = tuple(min(m, widest - max(plens))
+                    for m in (max(steps // 2, 2), steps, steps * 2))
+    fleet_serve_cfg = ServeConfig(
+        batch_buckets=(2,), prefill_buckets=buckets, num_slots=4,
+        cache_mode="bf16", eos_token_id=None, temperature=0.0,
+        prefix_cache=True, prefix_min_len=2)
+    fleet = ServeFleet(model, params, fleet_serve_cfg, fleet_cfg,
+                       watcher=watcher)
+    with faults.inject_replica_loss(0, 3):
+        fleet.run(diurnal_trace(
+            requests, seed=0, prompt_lens=plens, max_new=max_new,
+            vocab_size=cfg.vocab_size, base_interarrival=0.6,
+            burst_at=1.0, burst_n=max(requests // 4, 2),
+            batch_every=4))
+    fl = fleet.stats()
+
+    dt = time.perf_counter() - t_total
+    ladder = (len(donor_cfg.batch_buckets) * len(buckets)
+              + len(donor_cfg.batch_buckets))
+    _stage_aot_compile_count(ladder)
+    flops = emit_n * _transformer_fwd_flops_per_token(cfg, ctx_long)
+    ret = {
+        "migration_ms_short_ctx": round(mig_short, 3),
+        "migration_ms_long_ctx": round(mig_long, 3),
+        "migration_ratio": round(migration_ratio, 4)
+        if migration_ratio is not None else None,
+        "reprefill_ms_short_ctx": round(rep_short, 3),
+        "reprefill_ms_long_ctx": round(rep_long, 3),
+        "reprefill_ratio": round(reprefill_ratio, 4)
+        if reprefill_ratio is not None else None,
+        "kv_handoff_bytes": fl["kv_handoff_bytes"],
+        "fallback_reprefills": fl["kv_fallback_reprefills"],
+        "fleet_prefix_hit_rate": round(fl["fleet_prefix_hit_rate"], 4)
+        if fl["fleet_prefix_hit_rate"] is not None else None,
+        "kv_handoffs": fl["kv_handoffs"],
+        "lost_requests": fl["lost_requests"],
+        "compile_count": ladder,
+    }
+    _emit("serve_migrate_migration_ms", mig_long, "ms", flops, 1, dt,
+          ctx_short=ctx_short + emit_n, ctx_long=ctx_long + emit_n,
+          handoff_payload_bytes=handoff_bytes_one,
+          migrated_requests=fl["migrated_requests"],
+          requests_ok=fl["requests_ok"],
+          **{k: v for k, v in ret.items() if k != "compile_count"},
+          **_comm_fields(training=False))
+    return ret
+
+
 # The canonical (size, steps) per bench — the ONLY place these defaults
 # live; both the CLI dispatch below and the one-process capture plan
 # (tools/oneproc_capture.py) read them, so a tuning change (like resnet
@@ -3383,6 +3541,7 @@ BENCH_SPECS = {
     "serve_spec": ((16, 16), bench_serve_spec),
     "serve_chaos": ((24, 16), bench_serve_chaos),
     "serve_fleet": ((16, 8), bench_serve_fleet),
+    "serve_migrate": ((8, 6), bench_serve_migrate),
     "resnet": ((256, 50), bench_resnet),
     "kernels": ((1024, 5), bench_kernels),
     "fused_cc": ((512, 5), bench_fused_cc),

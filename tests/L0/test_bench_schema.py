@@ -490,6 +490,59 @@ def test_pp_tp_dp_fields_gated_at_round22():
                                     errors=[]) == []
 
 
+def test_serve_migrate_fields_gated_at_round23():
+    """ISSUE 18 satellite: a serve_migrate metric line must carry the
+    KV-state migration contract from round 23 — the short/long-context
+    migration wall-times (the flat-cost claim), the fleet handoff byte
+    count, the loud checksum-fallback count, and the fleet-wide prefix
+    hit rate, all nullable; pre-23 records carrying any of them are
+    flagged, other configs never need them."""
+    base = {"metric": "serve_migrate_migration_ms", "value": 12.7,
+            "unit": "ms", "vs_baseline": 1.0,
+            "tflops_per_sec": 0.0, "mfu": 0.0,
+            "comm_bytes_per_step": 0,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": None, "lint_violations": None,
+            "static_comm_bytes_per_step": None,
+            "backend": "cpu-mesh"}
+    full = dict(base, migration_ms_short_ctx=14.5,
+                migration_ms_long_ctx=12.7, kv_handoff_bytes=131080,
+                fallback_reprefills=0, fleet_prefix_hit_rate=0.09)
+    assert schema.check_metric_line(dict(full), round_n=23,
+                                    errors=[]) == []
+    # round 23: every migration field is required on serve_migrate lines
+    msgs = schema.check_metric_line(dict(base), round_n=23, errors=[])
+    for key in schema.SERVE_MIGRATE_REQUIRED_FIELDS:
+        assert any(key in m for m in msgs)
+    # nullable (a smoke host that skipped a leg stays honest) and typed
+    assert schema.check_metric_line(
+        dict(full, fleet_prefix_hit_rate=None,
+             migration_ms_long_ctx=None), round_n=23, errors=[]) == []
+    msgs = schema.check_metric_line(
+        dict(full, kv_handoff_bytes="lots"), round_n=23, errors=[])
+    assert any("must be numeric" in m for m in msgs)
+    # pre-23 checked-in records carrying the migration-only fields are
+    # flagged — the fields did not exist at capture time
+    wrapper = {"n": 22, "cmd": "python bench.py serve_migrate",
+               "rc": 0, "tail": "", "parsed": dict(full)}
+    msgs = schema.check_wrapper(wrapper, errors=[])
+    assert any("only defined from round 23" in m for m in msgs)
+    assert schema.check_wrapper(
+        {"n": 23, "cmd": "c", "rc": 0, "tail": "",
+         "parsed": dict(full)}, errors=[]) == []
+    # other configs never need the migration fields at round 23, and
+    # serve_fleet lines keep their own (round-16) contract untouched
+    assert schema.check_metric_line(dict(base, metric="resnet50_amp_o2"),
+                                    round_n=23, errors=[]) == []
+    fleet = dict(base, metric="serve_fleet_tokens_per_sec",
+                 ttft_p99_ms_interactive=1.0, ttft_p99_ms_batch=2.0,
+                 rebalance_latency_ms=3.0, replicas_respawned=1)
+    assert schema.check_metric_line(dict(fleet), round_n=23,
+                                    errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint

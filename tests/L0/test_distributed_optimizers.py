@@ -328,6 +328,7 @@ class TestElasticReshard:
         assert topo["world"] == 8 and topo["grad_compress"] == "int8"
 
     @pytest.mark.multi_device
+    @pytest.mark.slow  # tier-1 budget (round 23): roundtrip_8_4_1_8 + residual invariant cover resharding
     def test_resharded_state_steps_on_smaller_mesh(self, rng, dp_mesh):
         """Integration: a world=4 state resharded to world=2 actually
         STEPS on a 2-way mesh — bit-identically to a native world=2

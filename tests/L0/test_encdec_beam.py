@@ -78,6 +78,7 @@ class TestT5Beam:
         assert (ours[:, ref.shape[1]:] == 0).all()  # HF right-pad layout
         assert np.isfinite(np.asarray(scores)).all()
 
+    @pytest.mark.slow  # tier-1 budget (round 23): matches_hf_beam + beam_eos_freezes cover eos semantics
     def test_matches_hf_with_eos_firing(self):
         """EOS chosen as a token the model actually emits, so beams
         finish mid-generation and the hypothesis pool + length

@@ -84,6 +84,7 @@ class TestGenerate:
         params = GPTModel(cfg).init(jax.random.PRNGKey(0), prompt)["params"]
         return cfg, model, params, prompt
 
+    @pytest.mark.slow  # tier-1 budget (round 23): rope_gqa + TP2-vs-TP1 greedy cover generate()
     def test_greedy_matches_naive_resampling(self):
         """generate() greedy == argmax loop over full forwards."""
         cfg, model, params, prompt = self._setup()
@@ -96,6 +97,7 @@ class TestGenerate:
             toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
 
+    @pytest.mark.slow  # tier-1 budget (round 23): tp2_matches_tp1_greedy[gqa_swiglu] covers rope+gqa greedy
     def test_greedy_rope_gqa(self):
         cfg, model, params, prompt = self._setup(
             position_embedding_type="rope", num_query_groups=2)
@@ -156,6 +158,7 @@ class TestBeamSearch:
         tok_lp = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
         return np.asarray(tok_lp[:, plen - 1:]).sum(axis=-1)
 
+    @pytest.mark.slow  # tier-1 budget (round 23): no_worse_sequences + encdec beam1==greedy cover it
     def test_beam1_equals_greedy(self):
         from apex_tpu.models.generation import beam_search
 

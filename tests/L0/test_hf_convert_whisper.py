@@ -63,6 +63,7 @@ def test_logits_match_hf_whisper():
                                atol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 budget (round 23): cached_generate_matches_oracle + logits_match cover it
 def test_whisper_greedy_matches_hf_manual_loop():
     """Token parity against a manual HF greedy loop (hf.generate applies
     Whisper-specific token suppression that is tokenizer policy, not

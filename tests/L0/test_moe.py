@@ -772,6 +772,7 @@ class TestMoECheckpoint:
 
 
 class TestGPTMoE:
+    @pytest.mark.slow  # tier-1 budget (round 23): bert_with_moe_layers + ep4_matches_local cover MoE training
     def test_gpt_with_moe_layers_trains(self):
         from apex_tpu.models import GPTModel, TransformerConfig
 

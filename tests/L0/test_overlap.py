@@ -209,6 +209,7 @@ class TestParity:
             _assert_tree_equal(
                 {k: p_b[k] for k in sp_o[i]}, sp_o[i], "fp32 ")
 
+    @pytest.mark.slow  # tier-1 budget (round 23): int8_ragged_within_block_bound covers the int8 path
     def test_int8_block_aligned_bit_identical_50_steps(self, dp_mesh):
         """EF residual equivalence over 50 steps: with block-aligned
         segment buckets (every leaf a multiple of 256 elements) and

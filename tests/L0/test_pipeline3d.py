@@ -269,6 +269,7 @@ class TestZero3D:
 
 @multi8
 class TestPipelineStep3D:
+    @pytest.mark.slow  # tier-1 budget (round 23): guard revert + schedule units cover the 3-D step
     def test_pp2_matches_pp1_losses(self):
         sp = _model()
         losses = {}

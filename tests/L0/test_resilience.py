@@ -447,6 +447,38 @@ def test_replica_loss_plan_grammar_and_injector(monkeypatch):
     faults.disarm_replica_loss()
 
 
+def test_kv_corrupt_plan_grammar_and_injector(monkeypatch):
+    """ISSUE 18 satellite: the ``kv_corrupt@N:R`` plan entry and the
+    one-shot migration-payload corruption injector (the KV-handoff
+    sibling of replica_loss — names the donor replica whose extracted
+    payload the fleet flips a byte in, exercising the checksum-verify
+    -> loud re-prefill fallback path end to end)."""
+    monkeypatch.delenv(faults.ENV_FAULT_PLAN, raising=False)
+    faults.disarm_kv_corrupt()
+    # unarmed: no step fires
+    assert faults.kv_corrupt_for(0) is None
+    # grammar: kind@step:replica parses next to the other kinds
+    plan = faults.parse_fault_plan("kv_corrupt@4:1;replica_loss@4:1")
+    assert plan.get("kv_corrupt") == {"kind": "kv_corrupt",
+                                      "step": 4, "arg": "1"}
+    with pytest.raises(ValueError, match="duplicate entry"):
+        faults.parse_fault_plan("kv_corrupt@1;kv_corrupt@2")
+    # API arming: fires exactly once at the named fleet step
+    with faults.inject_kv_corrupt(1, 6) as st:
+        assert faults.kv_corrupt_for(5) is None
+        assert faults.kv_corrupt_for(6) == 1
+        assert st["fired"] == 1
+        assert faults.kv_corrupt_for(6) is None     # one-shot
+    assert faults.kv_corrupt_for(6) is None         # disarmed on exit
+    # env arming via the plan; arg defaults to replica 0
+    monkeypatch.setenv(faults.ENV_FAULT_PLAN, "kv_corrupt@2")
+    faults.disarm_kv_corrupt()
+    assert faults.kv_corrupt_for(1) is None
+    assert faults.kv_corrupt_for(2) == 0
+    assert faults.kv_corrupt_for(2) is None
+    faults.disarm_kv_corrupt()
+
+
 def test_inject_device_loss(monkeypatch):
     monkeypatch.delenv(faults.ENV_FAULT_PLAN, raising=False)
     faults.inject_device_loss(3)  # unarmed: no-op

@@ -398,6 +398,7 @@ class TestMeshServing:
         lens = eng.slot_lengths()
         assert lens.shape == (8,)
 
+    @pytest.mark.slow  # tier-1 budget (round 23): no_recompiles_across_ladder is the stronger gate
     def test_two_traces_same_executables(self, tiny, dp_mesh):
         """Different arrival patterns through one engine: compile
         count identical (trivially — nothing compiled at all)."""
@@ -486,3 +487,300 @@ class TestSchemaGate:
         # non-serve metrics are unaffected at round 11
         other = dict(base, metric="gpt2_345m_tokens_per_sec_per_chip")
         assert bsc.check_metric_line(other, round_n=11, errors=[]) == []
+
+
+# ---------------------------------------------------------------------------
+# canonical KV payloads: checksums, consolidation, the migration wire format
+# ---------------------------------------------------------------------------
+
+class TestKVCanonical:
+    def _spec(self, mode="int8"):
+        parallel_state.destroy_model_parallel()
+        cfg = _cfg()
+        return KVCacheSpec(GPTModel(cfg, decode=True), 4, mode=mode)
+
+    def test_payload_checksum_chains_and_detects_flip(self):
+        from apex_tpu.serving.kv_cache import payload_checksum
+
+        tree = {"a": np.arange(8, dtype=np.float32),
+                "b": np.ones((2, 3), np.int8)}
+        crc = payload_checksum(tree)
+        assert crc == payload_checksum(tree)  # deterministic
+        # chaining folds state forward
+        assert payload_checksum(tree, crc) != crc
+        flipped = jax.tree_util.tree_map(np.copy, tree)
+        flipped["b"].reshape(-1).view(np.uint8)[0] ^= 0xFF
+        assert payload_checksum(flipped) != crc
+
+    def test_host_zero_row_canonical_scales_groups(self):
+        spec = self._spec(mode="bf16")
+        r1 = spec.host_zero_row(tp=1)
+        r2 = spec.host_zero_row(tp=2)
+        l1 = jax.tree_util.tree_flatten_with_path(r1)[0]
+        l2 = {_n(p): v for p, v in
+              jax.tree_util.tree_flatten_with_path(r2)[0]}
+        from apex_tpu.serving.kv_cache import _is_kv, _names
+        for path, v in l1:
+            w = l2[_names(path)]
+            if _is_kv(_names(path)):
+                # groups axis (-2) doubles; everything else identical
+                assert w.shape == v.shape[:-2] + (2 * v.shape[-2],
+                                                  v.shape[-1:][0],)
+            else:
+                assert w.shape == v.shape
+
+    def test_store_and_row_pspecs_shard_head_axis(self):
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.serving.kv_cache import KV_LEAF_PREFIX, _names
+
+        def is_kv_path(path):
+            return any(n.startswith(KV_LEAF_PREFIX)
+                       for n in _names(path))
+
+        for mode in ("bf16", "int8"):
+            spec = self._spec(mode=mode)
+            sps = jax.tree_util.tree_flatten_with_path(
+                spec.store_pspecs("data", "tp"),
+                is_leaf=lambda l: isinstance(l, P))[0]
+            for path, p in sps:
+                if is_kv_path(path):
+                    assert p[-1] == "tp" and all(
+                        a is None for a in p[:-1])
+                else:
+                    assert p == P()
+            rps = jax.tree_util.tree_flatten_with_path(
+                spec.row_pspecs("tp", lead=1),
+                is_leaf=lambda l: isinstance(l, P))[0]
+            for path, p in rps:
+                if is_kv_path(path):
+                    assert p[-1] == "tp"
+                else:
+                    assert p == P()
+
+    def test_host_global_store_scales_sharded_axis(self):
+        spec = self._spec(mode="int8")
+        from apex_tpu.serving.kv_cache import _is_kv, _names
+        g1 = jax.tree_util.tree_flatten_with_path(
+            spec.host_global_store(tp=1),
+            is_leaf=lambda l: isinstance(l, dict) and "q" in l)[0]
+        g2 = {_names(p): v for p, v in jax.tree_util.tree_flatten_with_path(
+            spec.host_global_store(tp=2),
+            is_leaf=lambda l: isinstance(l, dict) and "q" in l)[0]}
+        for path, v in g1:
+            w = g2[_names(path)]
+            if isinstance(v, dict):
+                assert w["q"].shape[-2] == 2 * v["q"].shape[-2]
+                assert w["scale"].shape[-2] == 2 * v["scale"].shape[-2]
+            else:
+                assert w.shape == v.shape
+
+    def test_int8_requant_idempotent_bit_exact(self):
+        """Dequantize -> requantize reproduces the int8 codes exactly:
+        the invariant that makes seeding a survivor's store from the
+        dequantized migration payload reproduce the donor's store."""
+        spec = self._spec(mode="int8")
+        rng = np.random.RandomState(3)
+        row = jax.tree_util.tree_map(
+            lambda sd: jnp.asarray(
+                rng.standard_normal(sd.shape).astype(np.float32),
+                sd.dtype),
+            spec.template)
+        q1 = spec.quantize_rows(row)
+        deq = spec.materialize_rows(q1)
+        q2 = spec.quantize_rows(deq)
+
+        def codes(t):
+            return [np.asarray(l["q"]) for l in
+                    jax.tree_util.tree_leaves(
+                        t, is_leaf=lambda l: isinstance(l, dict)
+                        and "q" in l)
+                    if isinstance(l, dict)]
+
+        for a, b in zip(codes(q1), codes(q2)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_consolidate_roundtrips_global_store_row(self):
+        """device-get a global-store slot (tp=2 layout) ->
+        consolidate -> canonical rows match the tp-scaled zero
+        template exactly (and a filled bf16 row passes through)."""
+        spec = self._spec(mode="bf16")
+        store = spec.host_global_store(tp=2)
+        rows = jax.tree_util.tree_map(lambda l: l[1], store)
+        canon = spec.consolidate_host_rows(rows, tp=2)
+        tmpl = spec.host_zero_row(tp=2)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            canon, tmpl)
+
+    def test_consolidate_int8_dequantizes_per_rank(self):
+        spec = self._spec(mode="int8")
+        store = spec.host_global_store(tp=2)
+        rows = jax.tree_util.tree_map(
+            lambda l: np.copy(l[0]), store)
+        # stamp rank-distinct codes into one K leaf and check they land
+        # in rank order on the canonical groups axis
+        from apex_tpu.serving.kv_cache import _names
+        flat = jax.tree_util.tree_flatten_with_path(
+            rows, is_leaf=lambda l: isinstance(l, dict) and "q" in l)[0]
+        kv = [(p, l) for p, l in flat if isinstance(l, dict)][0][1]
+        nb = kv["q"].shape[-2] // 2
+        kv["q"][..., :nb, :] = 1          # rank 0 codes
+        kv["q"][..., nb:, :] = 2          # rank 1 codes
+        kv["scale"][..., :nb, :] = 1.0
+        kv["scale"][..., nb:, :] = 0.5
+        canon = spec.consolidate_host_rows(rows, tp=2)
+        leaf = [l for p, l in jax.tree_util.tree_flatten_with_path(
+            canon)[0] if not isinstance(l, dict)]
+        got = [np.asarray(l, np.float32) for l in leaf
+               if l.ndim >= 3 and l.shape[-2] > 1][0]
+        g = got.shape[-2] // 2
+        assert np.allclose(got[..., :g, :], 1.0)   # rank 0: 1 * 1.0
+        assert np.allclose(got[..., g:, :], 1.0)   # rank 1: 2 * 0.5
+
+    def test_consolidate_rejects_incompatible_layout(self):
+        spec = self._spec(mode="bf16")
+        rows = spec.host_zero_row(tp=2)
+        with pytest.raises(ValueError, match="canonical layout"):
+            spec.consolidate_host_rows(rows, tp=4)  # wrong tp scale
+        bad = jax.tree_util.tree_map(
+            lambda l: l.astype(np.float32), rows)
+        with pytest.raises(ValueError):
+            spec.consolidate_host_rows(bad, tp=2)   # wrong dtype
+
+
+def _n(path):
+    from apex_tpu.serving.kv_cache import _names
+    return _names(path)
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel serving: big-model engines on a (data, model) slice
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multi_device
+class TestTPServing:
+    def _tp_engine(self):
+        """The shared tiny TP=2 engine (same instance the
+        serve_decode_tp lint target builds — lru-cached, so tier-1
+        pays its ladder once across analysis + serving tests)."""
+        from apex_tpu.analysis.targets import serve_decode_tp_step
+        serve_decode_tp_step()  # builds engine + rebinds parallel_state
+        from apex_tpu.analysis.targets import _tiny_engine_tp
+        return _tiny_engine_tp()
+
+    def test_validation_refuses_tp_without_mesh(self, tiny):
+        cfg, model, params = tiny
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=2, devices=jax.devices()[:2])
+        try:
+            with pytest.raises(ValueError, match="mesh"):
+                _engine(model, params)
+            from jax.sharding import Mesh
+            bad = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                       ("data", "tp"))
+            with pytest.raises(ValueError, match="data"):
+                _engine(model, params, mesh=bad)
+            bad_ax = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2),
+                          ("data", "model"))
+            with pytest.raises(ValueError, match="mesh axis 'tp'"):
+                _engine(model, params, mesh=bad_ax)
+            ok_mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2),
+                           ("data", "tp"))
+            with pytest.raises(ValueError, match="hardwired"):
+                _engine(model, params, mesh=ok_mesh,
+                        model_axis="model")
+        finally:
+            parallel_state.destroy_model_parallel()
+
+    def test_extract_kv_state_layout_and_crc(self):
+        from apex_tpu.serving.engine import kv_payload_crc
+
+        engine = self._tp_engine()
+        payloads = engine.extract_kv_state([0, 2])
+        assert sorted(payloads) == [0, 2]
+        for slot, payload in payloads.items():
+            assert payload["slot"] == slot
+            assert payload["tp"] == 2
+            assert payload["crc"] == kv_payload_crc(payload)
+            tmpl = engine.seed_row_template()
+            jax.tree_util.tree_map(
+                lambda a, b: (np.shape(a) == np.shape(b)) or
+                (_ for _ in ()).throw(AssertionError((a.shape, b.shape))),
+                payload["rows"], tmpl)
+            # corruption breaks the crc
+            leaf = jax.tree_util.tree_leaves(payload["rows"])[0]
+            leaf.reshape(-1).view(np.uint8)[0] ^= 0xFF
+            assert payload["crc"] != kv_payload_crc(payload)
+
+    def test_tp_ladder_static_matches_measured_on_model_axis(self):
+        """ISSUE-18 acceptance: the TP decode ladder entry's statically
+        priced model-axis wire bytes equal the trace-measured
+        ``comm/axis/tp_bytes`` counter exactly."""
+        from apex_tpu.analysis import sharding
+        from apex_tpu.analysis.targets import TARGETS
+
+        fn, args, _ = TARGETS["serve_decode_tp"]()
+        reg = MetricsRegistry(enabled=True)
+        with use_registry(reg):
+            lowered = fn.lower(*args)
+        measured = reg.counter_value("comm/axis/tp_bytes")
+        traced = fn.trace(*args)
+        static = sharding.static_comm_bytes_by_axis(
+            lowered.as_text(), traced.jaxpr)
+        assert measured > 0
+        assert static.get("tp") == int(round(measured))
+        assert "?" not in static
+
+    def test_prefix_scope_accounting_and_adoption(self, tiny):
+        from apex_tpu.serving.prefix_cache import PrefixStore
+
+        store = PrefixStore(max_entries=4, min_len=2)
+        row = {"k": np.zeros((4,), np.float32)}
+        store.insert(np.arange(8), row, scope="engine_a")
+        cut, entry = store.lookup(np.arange(8), scope="engine_b")
+        assert cut == 7 and entry is not None
+        s = store.stats()
+        assert s["by_scope"]["engine_a"]["insertions"] == 1
+        assert s["by_scope"]["engine_b"]["hits"] == 1
+        assert store.scope_stats("engine_b")["hit_tokens"] == 7
+        assert store.scope_stats("nobody")["lookups"] == 0
+
+    @pytest.mark.slow
+    def test_tp2_engine_token_identical_to_tp1(self, tiny):
+        """A GPT served over a (data=1, tp=2) slice decodes greedily
+        token-identically to the single-chip engine, with the same
+        flat compile accounting."""
+        cfg, model, params = tiny
+        from jax.sharding import Mesh
+
+        rs = np.random.RandomState(5)
+        prompts = [rs.randint(0, cfg.vocab_size, n).astype(np.int32)
+                   for n in (3, 7, 5)]
+
+        def run(tp):
+            parallel_state.destroy_model_parallel()
+            if tp > 1:
+                parallel_state.initialize_model_parallel(
+                    tensor_model_parallel_size_=tp,
+                    devices=jax.devices()[:tp])
+            mesh = (Mesh(np.asarray(jax.devices()[:tp]).reshape(1, tp),
+                         ("data", "tp")) if tp > 1 else None)
+            watcher = CompileWatcher()
+            eng = _engine(GPTModel(cfg, decode=True), params,
+                          mesh=mesh, watcher=watcher,
+                          batch_buckets=(2,), prefill_buckets=(8,),
+                          eos_token_id=None, temperature=0.0)
+            reqs = [Request(rid=i, prompt=p, max_new_tokens=6)
+                    for i, p in enumerate(prompts)]
+            completed, _ = eng.serve(reqs)
+            parallel_state.destroy_model_parallel()
+            return ({c.rid: list(c.tokens) for c in completed},
+                    watcher)
+
+        ref, w1 = run(1)
+        got, w2 = run(2)
+        assert got == ref
+        # identical flat-compile accounting on both engines
+        assert w2.compile_count() == w1.compile_count()
+        assert w2.recompile_count() == 0

@@ -644,6 +644,47 @@ class TestFleetReportKind:
         assert "tier batch" in text
         assert "event timeline" in text
 
+    def test_report_rolls_up_kv_handoff_events(self, tmp_path):
+        """ISSUE-18: the fleet kind learns the KV-state handoff
+        events — kv_handoff totals (count + bytes carried), the
+        per-reason kv_fallback split, and the injector's
+        kv_corrupt_injected — in the rollup, the timeline, and the
+        rendered report."""
+        with use_registry(MetricsRegistry(jsonl_dir=str(tmp_path))) \
+                as reg:
+            reg.event("fleet", "kv_handoff", rid=1, replica=1, slot=0,
+                      length=14, cut=13, bytes=65540, tick=3)
+            reg.event("fleet", "kv_handoff", rid=2, replica=1, slot=1,
+                      length=10, cut=9, bytes=65540, tick=3)
+            reg.event("fleet", "kv_fallback", rid=3, replica=1,
+                      reason="checksum_mismatch", tick=3)
+            reg.event("fleet", "kv_corrupt_injected", replica=0,
+                      slot=0, tick=3)
+            reg.flush()
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        import telemetry_report
+
+        paths = [str(p) for p in tmp_path.glob("telemetry-rank*.jsonl")]
+        report = telemetry_report.aggregate(
+            telemetry_report.load_events(paths))
+        f = report["fleet"]
+        assert f["kv_handoffs"] == 2
+        assert f["kv_handoff_bytes"] == 131080
+        assert f["kv_fallbacks"] == {"checksum_mismatch": 1}
+        assert f["kv_corrupt_injected"] == 1
+        events = [row["event"] for row in f["timeline"]]
+        assert "kv_handoff" in events and "kv_fallback" in events
+        row = next(r for r in f["timeline"]
+                   if r["event"] == "kv_handoff")
+        assert row["detail"]["bytes"] == 65540
+        assert row["detail"]["cut"] == 13
+        buf = io.StringIO()
+        telemetry_report.print_report(report, out=buf)
+        text = buf.getvalue()
+        assert "kv handoffs: 2" in text
+        assert "checksum_mismatch=1" in text
+        assert "1 corrupt injection(s)" in text
+
 
 # ---------------------------------------------------------------------------
 # misc edges
@@ -697,3 +738,187 @@ class TestFleetEdges:
             assert reg.counter_value("fleet/migrated") >= 0
             assert reg.counter_value("fleet/respawns") == 1
             assert reg.counter_value("fleet/replicas_quarantined") == 1
+
+
+# ---------------------------------------------------------------------------
+# KV-state migration (ISSUE 18): capture, handoff, corruption fallback
+# ---------------------------------------------------------------------------
+
+class TestKVMigrationPolicy:
+    def test_stats_carry_migration_fields(self):
+        fleet = _stub_fleet(FleetConfig(num_replicas=2))
+        fleet.run([_req(0), _req(1)])
+        s = fleet.stats()
+        assert s["kv_handoffs"] == 0
+        assert s["kv_handoff_bytes"] == 0
+        assert s["kv_fallback_reprefills"] == 0
+        # stubs have no prefix cache -> no fleet-wide store
+        assert s["fleet_prefix_hit_rate"] is None
+
+    def test_capture_is_empty_for_stub_engines(self):
+        """Engines without ``extract_kv_state`` (stubs, legacy)
+        degrade to the token re-prefill migration — no handoff, no
+        crash, zero lost."""
+        fleet = _stub_fleet(FleetConfig(num_replicas=2,
+                                        respawn_delay_ticks=1))
+        with faults.inject_replica_loss(0, 1) as st:
+            fleet.run([_req(i, max_new=6) for i in range(4)])
+        s = fleet.stats()
+        assert st["fired"] == 1
+        assert s["lost_requests"] == 0
+        assert s["kv_handoffs"] == 0
+
+    def test_model_parallel_fleet_partition(self):
+        with pytest.raises(ValueError, match="model_parallel"):
+            FleetConfig(model_parallel=0)
+        fleet = _stub_fleet(FleetConfig(num_replicas=2,
+                                        model_parallel=2))
+        for rep in fleet.replicas:
+            assert rep.mesh is not None
+            assert rep.mesh.axis_names == ("data", "tp")
+            assert dict(zip(rep.mesh.axis_names,
+                            rep.mesh.devices.shape))["tp"] == 2
+
+
+@pytest.mark.multi_device
+@pytest.mark.slow
+class TestFleetTPMigrationE2E:
+    """ISSUE-18 chaos acceptance: TP-sharded replicas under the fleet,
+    constant-cost KV-state migration, loud checksum fallback."""
+
+    def _cfg(self):
+        return TransformerConfig(
+            hidden_size=64, num_layers=2, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            compute_dtype=jnp.bfloat16, use_flash_attention=False,
+            normalization="rmsnorm", position_embedding_type="rope",
+            activation="swiglu", num_query_groups=4,
+            ffn_hidden_size=128)
+
+    def _params(self, cfg):
+        parallel_state.destroy_model_parallel()
+        return GPTModel(cfg).init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+
+    def _serve_cfg(self):
+        return ServeConfig(batch_buckets=(2,), prefill_buckets=(4, 16),
+                           num_slots=4, eos_token_id=None,
+                           temperature=0.0, prefix_cache=True,
+                           prefix_min_len=2)
+
+    def _trace(self, vocab):
+        rs = np.random.RandomState(7)
+        return [Request(rid=i,
+                        prompt=rs.randint(0, vocab, 12).astype(np.int32),
+                        max_new_tokens=8, arrival=0.0)
+                for i in range(4)]
+
+    def _run(self, cfg, params, *, kill=None, corrupt=None,
+             jsonl_dir=None):
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=2, devices=jax.devices()[:2])
+        model = GPTModel(cfg, decode=True)
+        reg = MetricsRegistry(enabled=True, jsonl_dir=jsonl_dir)
+        watcher = CompileWatcher(enabled=True)
+        fleet = ServeFleet(model, params, self._serve_cfg(),
+                           FleetConfig(num_replicas=2, model_parallel=2,
+                                       respawn_delay_ticks=1),
+                           registry=reg, watcher=watcher)
+        try:
+            if kill is not None:
+                faults.arm_replica_loss(*kill)
+            if corrupt is not None:
+                faults.arm_kv_corrupt(*corrupt)
+            done = fleet.run(self._trace(cfg.vocab_size))
+        finally:
+            faults.disarm_replica_loss()
+            faults.disarm_kv_corrupt()
+            parallel_state.destroy_model_parallel()
+        return ({c.rid: list(map(int, c.tokens)) for c in done},
+                fleet.stats(), watcher)
+
+    def test_tp_kill_migrates_kv_token_identical(self, tmp_path):
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices")
+        cfg = self._cfg()
+        params = self._params(cfg)
+        clean, s0, _ = self._run(cfg, params)
+        assert s0["lost_requests"] == 0
+        chaos, s1, watcher = self._run(
+            cfg, params, kill=(0, 3), jsonl_dir=str(tmp_path))
+        assert s1["lost_requests"] == 0
+        assert s1["migrated_requests"] >= 1
+        assert s1["kv_handoffs"] >= 1
+        assert s1["kv_handoff_bytes"] > 0
+        assert s1["kv_fallback_reprefills"] == 0
+        assert chaos == clean                     # greedy identity
+        assert s1["fleet_prefix_hit_rate"] is not None
+        assert watcher.recompile_count() == 0
+        events = []
+        for p in tmp_path.glob("*.jsonl"):
+            events += [json.loads(l) for l in p.open()]
+        handoffs = [e for e in events if e.get("name") == "kv_handoff"]
+        assert len(handoffs) == s1["kv_handoffs"]
+        for e in handoffs:
+            assert e["bytes"] > 0 and e["cut"] > 0
+
+    def test_kv_corrupt_falls_back_loudly_once(self, tmp_path):
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices")
+        cfg = self._cfg()
+        params = self._params(cfg)
+        got, s, _ = self._run(cfg, params, kill=(0, 3),
+                              corrupt=(0, 3), jsonl_dir=str(tmp_path))
+        assert s["lost_requests"] == 0
+        assert s["requests_ok"] == 4              # streams complete
+        assert s["kv_fallback_reprefills"] == 1   # exactly one, loud
+        events = []
+        for p in tmp_path.glob("*.jsonl"):
+            events += [json.loads(l) for l in p.open()]
+        fb = [e for e in events if e.get("name") == "kv_fallback"]
+        assert len(fb) == 1
+        assert fb[0]["reason"] == "checksum_mismatch"
+        assert any(e.get("name") == "kv_corrupt_injected"
+                   for e in events)
+
+    def test_fleet_wide_prefix_beats_single_replica(self):
+        """A system prompt prefilled by one replica hits on the other:
+        the shared store's fleet-wide hit rate is never below what a
+        single replica achieves on the same trace."""
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices")
+        cfg = self._cfg()
+        params = self._params(cfg)
+        rs = np.random.RandomState(11)
+        system = rs.randint(0, cfg.vocab_size, 8).astype(np.int32)
+
+        def trace():
+            return [Request(
+                rid=i,
+                prompt=np.concatenate(
+                    [system,
+                     rs.randint(0, cfg.vocab_size, 3).astype(np.int32)]),
+                max_new_tokens=4, arrival=0.0) for i in range(6)]
+
+        def run(n_replicas):
+            parallel_state.destroy_model_parallel()
+            parallel_state.initialize_model_parallel(
+                tensor_model_parallel_size_=2,
+                devices=jax.devices()[:2])
+            model = GPTModel(cfg, decode=True)
+            fleet = ServeFleet(model, params, self._serve_cfg(),
+                               FleetConfig(num_replicas=n_replicas,
+                                           model_parallel=2))
+            rs.seed(11); rs.randint(0, cfg.vocab_size, 8)  # re-sync tails
+            done = fleet.run(trace())
+            s = fleet.stats()
+            parallel_state.destroy_model_parallel()
+            assert len(done) == 6
+            return s["fleet_prefix_hit_rate"]
+
+        single = run(1)
+        fleet_wide = run(2)
+        assert single is not None and fleet_wide is not None
+        assert fleet_wide >= single > 0

@@ -77,6 +77,17 @@ number ``n`` (old checked-in records stay valid):
   and the HBM-intermediate counts
   (``hbm_intermediates_{unfused,fused}_<family>``); pre-round-21
   records carrying any of them are flagged.
+- ``n >= 22``: ``pp_tp_dp`` metric lines (the 3-D pipeline mesh) must
+  carry ``bubble_fraction`` / ``bubble_fraction_model``, the schedule
+  shape (``pipeline_stages``, ``microbatches``), the step times, the
+  per-axis comm dicts WITH the ``pipe`` axis priced, and
+  ``reshard_bitexact``; pre-round-22 records carrying the
+  pipeline-only fields are flagged.
+- ``n >= 23``: ``serve_migrate`` metric lines (KV-state migration)
+  must carry ``migration_ms_short_ctx`` / ``migration_ms_long_ctx``
+  (the flat-cost claim), ``kv_handoff_bytes``,
+  ``fallback_reprefills`` and ``fleet_prefix_hit_rate`` — all
+  nullable; pre-round-23 records carrying any of them are flagged.
 
 Usage::
 
@@ -239,6 +250,21 @@ PP_TP_DP_NEW_FIELDS = ("bubble_fraction", "bubble_fraction_model",
 PP_TP_DP_PIPE_AXIS = "pipe"
 PP_TP_DP_REQUIRED_FIELDS = (PP_TP_DP_NUM_FIELDS + TP_DP_AXIS_FIELDS
                             + (TP_DP_BOOL_FIELD,))
+# the KV-state migration contract (apex_tpu.serving.fleet, round 23):
+# a serve_migrate metric line must carry the short/long-context
+# migration wall-times (the flat-cost claim next to the linear
+# re-prefill comparator), the fleet handoff byte count, the loud
+# checksum-fallback count, and the fleet-wide prefix hit rate —
+# required-nullable so a smoke host that skipped a leg stays honest;
+# pre-round-23 records carrying any of them are flagged — the fields
+# did not exist
+SERVE_MIGRATE_FIELDS_SINCE_ROUND = 23
+SERVE_MIGRATE_METRIC_PREFIX = "serve_migrate"
+SERVE_MIGRATE_NUM_FIELDS = (
+    "migration_ms_short_ctx", "migration_ms_long_ctx",
+    "kv_handoff_bytes", "fallback_reprefills",
+    "fleet_prefix_hit_rate")
+SERVE_MIGRATE_REQUIRED_FIELDS = SERVE_MIGRATE_NUM_FIELDS
 # the fused computation-collective contract (apex_tpu.kernels
 # .fused_cc, round 21): a fused_cc metric line carries per-family
 # fused-vs-unfused timings plus the traced-jaxpr HBM-intermediate
@@ -600,6 +626,25 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
             elif not (obj[TP_DP_BOOL_FIELD] is None
                       or isinstance(obj[TP_DP_BOOL_FIELD], bool)):
                 bad(f"{TP_DP_BOOL_FIELD} must be a boolean or null")
+        is_migrate = str(obj.get("metric", "")).startswith(
+            SERVE_MIGRATE_METRIC_PREFIX)
+        present_mig = [k for k in SERVE_MIGRATE_NUM_FIELDS if k in obj]
+        if present_mig and (round_n is not None
+                            and round_n
+                            < SERVE_MIGRATE_FIELDS_SINCE_ROUND):
+            bad(f"serve_migrate fields {present_mig} are only defined "
+                f"from round {SERVE_MIGRATE_FIELDS_SINCE_ROUND}")
+        elif is_migrate and (round_n is None
+                             or round_n
+                             >= SERVE_MIGRATE_FIELDS_SINCE_ROUND):
+            for key in SERVE_MIGRATE_NUM_FIELDS:
+                if key not in obj:
+                    bad(f"serve_migrate line missing {key!r} (required "
+                        f"since round "
+                        f"{SERVE_MIGRATE_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
+                    bad(f"serve_migrate field {key!r} must be numeric "
+                        f"or null")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

@@ -690,6 +690,184 @@ def _fleet_smoke(bench):
             "fleet_events": len(fleet_events)}
 
 
+def _migrate_smoke(bench):
+    """KV-state migration smoke (round 23): (a) drive
+    ``serve_migrate`` on the tiny model (APEX_TPU_SERVE_SMOKE=1) and
+    assert the flat-cost claim held — long/short-context migration
+    ratio <= 1.25 with the linear re-prefill comparator recorded, at
+    least one fleet handoff, zero fallbacks, zero lost requests; (b) a
+    2-replica fleet of TP-sharded engines (model axis 2 when the host
+    has the devices) killed mid-stream: every in-flight request
+    finishes token-identically to an unkilled run via the KV handoff,
+    with the ``kv_handoff`` events and the handoff counters in the
+    JSONL; (c) the same kill with a corrupted payload: exactly ONE
+    loud checksum fallback (``kv_fallback`` event, reason
+    ``checksum_mismatch``, next to ``kv_corrupt_injected``) and every
+    stream still completes. Raises on any missing piece so the stage
+    shows up as ERROR rather than silently passing."""
+    import glob
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import telemetry
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.resilience import faults
+    from apex_tpu.serving import (FleetConfig, Request, ServeConfig,
+                                  ServeFleet)
+    from apex_tpu.telemetry import MetricsRegistry
+    from apex_tpu.transformer import parallel_state
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_migrate_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    prev_smoke = os.environ.get("APEX_TPU_SERVE_SMOKE")
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    os.environ["APEX_TPU_SERVE_SMOKE"] = "1"
+    telemetry.get_registry().enable(jsonl_dir=tel_dir)
+    try:
+        ret = bench.bench_serve_migrate(6, 3)
+    finally:
+        for var, old in ((telemetry.registry.ENV_DIR, prev),
+                         ("APEX_TPU_SERVE_SMOKE", prev_smoke)):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+    if ret["lost_requests"] != 0:
+        raise RuntimeError(
+            f"migrate smoke: {ret['lost_requests']} request(s) LOST "
+            f"in the replica kill")
+    if ret["kv_handoffs"] < 1:
+        raise RuntimeError("migrate smoke: the chaos leg performed no "
+                           "KV handoff — migration fell back silently")
+    if ret["fallback_reprefills"] != 0:
+        raise RuntimeError(
+            f"migrate smoke: {ret['fallback_reprefills']} checksum "
+            f"fallback(s) on the clean handoff path")
+    ratio = ret["migration_ratio"]
+    if ratio is None or ratio > 1.25:
+        raise RuntimeError(
+            f"migrate smoke: migration cost is NOT flat in context "
+            f"length — long/short ratio {ratio!r} over the 1.25 "
+            f"ceiling (re-prefill comparator: "
+            f"{ret['reprefill_ratio']!r})")
+    if ret["reprefill_ratio"] is None:
+        raise RuntimeError("migrate smoke: the linear re-prefill "
+                           "comparator was not measured")
+
+    # (b)+(c): TP-sharded fleet kill, token identity, loud fallback
+    tp = 2 if len(jax.devices()) >= 4 else 1
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        vocab_size=128, max_position_embeddings=64,
+        compute_dtype=jnp.bfloat16, use_flash_attention=False,
+        normalization="rmsnorm", position_embedding_type="rope",
+        activation="swiglu", num_query_groups=4, ffn_hidden_size=128)
+    parallel_state.destroy_model_parallel()
+    params = GPTModel(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    if tp > 1:
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=tp,
+            devices=jax.devices()[:tp])
+    model = GPTModel(cfg, decode=True)
+    serve_cfg = ServeConfig(
+        batch_buckets=(2,), prefill_buckets=(4, 16), num_slots=4,
+        eos_token_id=None, temperature=0.0, prefix_cache=True,
+        prefix_min_len=2)
+
+    def trace():
+        rs = np.random.RandomState(7)
+        return [Request(rid=i,
+                        prompt=rs.randint(0, cfg.vocab_size,
+                                          12).astype(np.int32),
+                        max_new_tokens=8, arrival=0.0)
+                for i in range(4)]
+
+    def run(leg, kill=None, corrupt=None):
+        leg_dir = os.path.join(tel_dir, leg)
+        os.makedirs(leg_dir, exist_ok=True)
+        reg = MetricsRegistry(enabled=True, jsonl_dir=leg_dir)
+        fleet = ServeFleet(
+            model, params, serve_cfg,
+            FleetConfig(num_replicas=2, model_parallel=tp,
+                        respawn_delay_ticks=1), registry=reg)
+        try:
+            if kill is not None:
+                faults.arm_replica_loss(*kill)
+            if corrupt is not None:
+                faults.arm_kv_corrupt(*corrupt)
+            done = fleet.run(trace())
+        finally:
+            faults.disarm_replica_loss()
+            faults.disarm_kv_corrupt()
+        events = []
+        for p in glob.glob(os.path.join(leg_dir, "*.jsonl")):
+            with open(p) as f:
+                events.extend(json.loads(line) for line in f
+                              if line.strip())
+        return ({c.rid: list(map(int, c.tokens)) for c in done},
+                fleet.stats(), reg, events)
+
+    try:
+        clean, _, _, _ = run("clean")
+        chaos, st, reg, events = run("kill", kill=(0, 3))
+        if st["lost_requests"] != 0:
+            raise RuntimeError(
+                f"migrate smoke: TP kill lost {st['lost_requests']} "
+                f"request(s)")
+        if chaos != clean:
+            raise RuntimeError(
+                "migrate smoke: the killed run's greedy token streams "
+                "differ from the clean run — the KV handoff did not "
+                "resume token-identically")
+        if st["kv_handoffs"] < 1:
+            raise RuntimeError("migrate smoke: TP kill performed no "
+                               "KV handoff")
+        handoffs = [e for e in events if e.get("name") == "kv_handoff"]
+        if len(handoffs) != st["kv_handoffs"] or any(
+                e["bytes"] <= 0 or e["cut"] <= 0 for e in handoffs):
+            raise RuntimeError(
+                f"migrate smoke: {len(handoffs)} kv_handoff event(s) "
+                f"in the JSONL vs {st['kv_handoffs']} counted handoffs")
+        if reg.counter_value("fleet/kv_handoff_bytes") <= 0:
+            raise RuntimeError("migrate smoke: the kv_handoff_bytes "
+                               "counter never moved")
+        got, st2, reg2, events2 = run("corrupt", kill=(0, 3),
+                                      corrupt=(0, 3))
+        if st2["requests_ok"] != 4:
+            raise RuntimeError(
+                f"migrate smoke: only {st2['requests_ok']}/4 streams "
+                f"completed under the corrupted payload")
+        if st2["kv_fallback_reprefills"] != 1:
+            raise RuntimeError(
+                f"migrate smoke: {st2['kv_fallback_reprefills']} "
+                f"checksum fallback(s) — a corrupted payload must fall "
+                f"back exactly once, loudly")
+        fb = [e for e in events2 if e.get("name") == "kv_fallback"]
+        if len(fb) != 1 or fb[0].get("reason") != "checksum_mismatch":
+            raise RuntimeError(
+                f"migrate smoke: kv_fallback events {fb!r} — expected "
+                f"exactly one with reason checksum_mismatch")
+        if not any(e.get("name") == "kv_corrupt_injected"
+                   for e in events2):
+            raise RuntimeError("migrate smoke: the injector never "
+                               "logged kv_corrupt_injected")
+    finally:
+        parallel_state.destroy_model_parallel()
+    return {"telemetry_dir": tel_dir, "tp": tp,
+            "migration_ms_short_ctx": ret["migration_ms_short_ctx"],
+            "migration_ms_long_ctx": ret["migration_ms_long_ctx"],
+            "migration_ratio": ratio,
+            "reprefill_ratio": ret["reprefill_ratio"],
+            "kv_handoffs": st["kv_handoffs"],
+            "kv_handoff_bytes": st["kv_handoff_bytes"],
+            "fallback_reprefills": st2["kv_fallback_reprefills"],
+            "fleet_prefix_hit_rate": st["fleet_prefix_hit_rate"]}
+
+
 def _lint_smoke(bench):
     """Static-analysis smoke (round 14): (a) run a clean DDP config
     under APEX_TPU_HLO_LINT=1 and assert its emitted JSON carries
@@ -1396,6 +1574,7 @@ def _stages(smoke):
             ("serve_chaos", None, lambda: _serve_chaos_smoke(bench)),
             ("spec", None, lambda: _spec_smoke(bench)),
             ("fleet", None, lambda: _fleet_smoke(bench)),
+            ("migrate", None, lambda: _migrate_smoke(bench)),
             ("recovery", None, lambda: _recovery_smoke(bench)),
             ("lint", None, lambda: _lint_smoke(bench)),
             ("sharding", None, lambda: _sharding_smoke(bench)),
@@ -1497,6 +1676,15 @@ def _stages(smoke):
         # fleet events landing in the JSONL
         ("serve_fleet", None, spec("serve_fleet")),
         ("fleet", None, lambda: _fleet_smoke(bench)),
+        # round-23 KV-state migration captures: the serve_migrate
+        # config at bench size (short/long-context migration wall-times
+        # with the flat <=1.25 ratio next to the linear re-prefill
+        # comparator, fleet handoff bytes, loud fallback count,
+        # fleet-wide prefix hit rate) and the smoke proving the TP
+        # kill -> token-identical KV handoff plus the corrupted-payload
+        # loud fallback with the events in the JSONL
+        ("serve_migrate", None, spec("serve_migrate")),
+        ("migrate", None, lambda: _migrate_smoke(bench)),
         # round-13 training-recovery captures: the supervised chaos
         # campaign at bench size (restarts / mttr_steps /
         # snapshot_restores / goodput_step_ratio / final_loss_delta in

@@ -92,7 +92,9 @@ def aggregate(events):
     fleet = {"starts": [], "migrations": 0, "migrated_requests": 0,
              "lost_requests": 0, "respawns": 0, "rebalances": [],
              "scale_ups": 0, "scale_downs": 0, "timeline": [],
-             "timeline_truncated": 0, "last_report": None}
+             "timeline_truncated": 0, "last_report": None,
+             "kv_handoffs": 0, "kv_handoff_bytes": 0,
+             "kv_fallbacks": {}, "kv_corrupt_injected": 0}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -379,6 +381,16 @@ def aggregate(events):
                     fleet["scale_ups"] += 1
                 elif fname == "scale_down":
                     fleet["scale_downs"] += 1
+                elif fname == "kv_handoff":
+                    fleet["kv_handoffs"] += 1
+                    fleet["kv_handoff_bytes"] += int(
+                        ev.get("bytes") or 0)
+                elif fname == "kv_fallback":
+                    why = str(ev.get("reason") or "unknown")
+                    fleet["kv_fallbacks"][why] = \
+                        fleet["kv_fallbacks"].get(why, 0) + 1
+                elif fname == "kv_corrupt_injected":
+                    fleet["kv_corrupt_injected"] += 1
                 elif fname == "fleet_report":
                     fleet["last_report"] = {
                         k: ev.get(k) for k in (
@@ -391,7 +403,9 @@ def aggregate(events):
                             "replicas")}
                 if fname in ("replica_state", "migration",
                              "migration_failed", "rebalance",
-                             "respawn", "scale_up", "scale_down"):
+                             "respawn", "scale_up", "scale_down",
+                             "kv_handoff", "kv_fallback",
+                             "kv_corrupt_injected"):
                     if len(fleet["timeline"]) < _FLEET_TIMELINE_CAP:
                         fleet["timeline"].append({
                             "event": fname,
@@ -400,7 +414,8 @@ def aggregate(events):
                             "detail": {k: ev.get(k) for k in (
                                 "old", "new", "reason", "requests",
                                 "tokens_carried", "latency_ms", "rid",
-                                "pending_depth")
+                                "pending_depth", "length", "cut",
+                                "bytes", "slot")
                                 if ev.get(k) is not None},
                         })
                     else:
@@ -673,6 +688,17 @@ def print_report(report, out=None):
                 w(f"  tier {tier}: {t.get('requests')} request(s), "
                   f"{t.get('ok')} ok, ttft p99 "
                   f"{f'{p99:.2f}ms' if p99 is not None else '-'}\n")
+        if fleet.get("kv_handoffs") or fleet.get("kv_fallbacks") \
+                or fleet.get("kv_corrupt_injected"):
+            falls = ", ".join(
+                f"{k}={v}" for k, v in
+                sorted((fleet.get("kv_fallbacks") or {}).items())) \
+                or "none"
+            w(f"  kv handoffs: {fleet.get('kv_handoffs', 0)} "
+              f"({_fmt_bytes(fleet.get('kv_handoff_bytes') or 0)} "
+              f"carried), fallback re-prefills: {falls}, "
+              f"{fleet.get('kv_corrupt_injected', 0)} corrupt "
+              f"injection(s)\n")
         rebalances = fleet.get("rebalances") or []
         if rebalances:
             w(f"  rebalance latency: last {rebalances[-1]:.2f}ms over "
