@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from apex_tpu.telemetry.trace import span as _span
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region as _copy_to,
     reduce_from_tensor_model_parallel_region as _reduce_from,
@@ -409,14 +410,21 @@ def build_train_step(mesh, seg_params, *, hidden, heads,
                     else jnp.zeros(()))
 
         def fn(sp, res, tokens, labels):
-            loss, grads = jax.value_and_grad(
-                lambda q: gpt2_loss(q, tokens, labels, head_dim,
-                                    fused=fused))(tuple(sp))
+            # phase spans open at trace time (once per compile — the
+            # per-step accounting for a compiled step) and join the
+            # ambient TraceContext, so the supervisor's train/step
+            # trace shows fwd_bwd -> sync -> optimizer as children
+            with _span("train/fwd_bwd"):
+                loss, grads = jax.value_and_grad(
+                    lambda q: gpt2_loss(q, tokens, labels, head_dim,
+                                        fused=fused))(tuple(sp))
             if stateful:
                 grads, res = ddp.sync(grads, res)
             else:
                 grads = ddp.sync(grads)
-            return _sgd(sp, grads, lr), res, loss
+            with _span("train/optimizer"):
+                new_sp = _sgd(sp, grads, lr)
+            return new_sp, res, loss
 
     elif mode in ("overlapped", "guarded"):
         odp = OverlappedDataParallel(axis_name=DATA_AXIS,
@@ -431,13 +439,19 @@ def build_train_step(mesh, seg_params, *, hidden, heads,
             def fn(sp, res, tokens, labels):
                 segs = gpt2_segments(labels, layers, head_dim,
                                      fused=fused)
-                if stateful:
-                    loss, synced, res = odp.value_and_sync(
-                        segs, list(sp), tokens, residual=res)
-                else:
-                    loss, synced = odp.value_and_sync(segs, list(sp),
-                                                      tokens)
-                return _sgd(sp, synced, lr), res, loss
+                # the overlap module's per-segment/bucket spans open
+                # inside this one, so they parent under train/fwd_bwd
+                # in the step's trace
+                with _span("train/fwd_bwd"):
+                    if stateful:
+                        loss, synced, res = odp.value_and_sync(
+                            segs, list(sp), tokens, residual=res)
+                    else:
+                        loss, synced = odp.value_and_sync(
+                            segs, list(sp), tokens)
+                with _span("train/optimizer"):
+                    new_sp = _sgd(sp, synced, lr)
+                return new_sp, res, loss
         else:
             def fn(sp, res, gst, step_idx, tokens, labels):
                 poison = faults.inject_nan(
@@ -445,16 +459,18 @@ def build_train_step(mesh, seg_params, *, hidden, heads,
                     nan_step=guard_nan_step)
                 segs = gpt2_segments(labels, layers, head_dim,
                                      poison=poison, fused=fused)
-                loss, synced, new_res, flag = odp.value_and_sync(
-                    segs, list(sp), tokens, residual=res)
+                with _span("train/fwd_bwd"):
+                    loss, synced, new_res, flag = odp.value_and_sync(
+                        segs, list(sp), tokens, residual=res)
 
                 def commit(g, st):
                     prev_sp, _ = st
                     return (_sgd(prev_sp, g, lr), new_res)
 
-                (sp, res), gst = resilience.guarded_update(
-                    synced, commit, (tuple(sp), res), gst,
-                    axis_name=(DATA_AXIS, MODEL_AXIS), flag=flag)
+                with _span("train/optimizer"):
+                    (sp, res), gst = resilience.guarded_update(
+                        synced, commit, (tuple(sp), res), gst,
+                        axis_name=(DATA_AXIS, MODEL_AXIS), flag=flag)
                 return sp, res, gst, loss
     else:
         raise ValueError(f"unknown mode {mode!r}")

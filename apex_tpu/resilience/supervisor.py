@@ -77,6 +77,7 @@ import jax
 from apex_tpu.resilience.faults import DeviceLostError
 from apex_tpu.resilience.guard import NonFiniteError
 from apex_tpu.telemetry.registry import get_registry
+from apex_tpu.telemetry.trace import span, trace_context
 
 # -- failure classes ---------------------------------------------------------
 
@@ -594,7 +595,17 @@ class Supervisor:
                         and self.step % self.checkpoint_every == 0:
                     self.save_checkpoint()
                 self.dispatches += 1
-                new_state = self._step_fn(self.state, self.step)
+                # one trace per dispatched step: phase spans the step
+                # function opens at trace time (overlap psum buckets,
+                # 1F1B microbatch ticks, ZeRO reduce/gather, ddp/sync)
+                # join this context, so the compiling call's timeline
+                # is a causal tree under train/step. Telemetry off:
+                # trace_context yields None, span records nothing —
+                # the compiled program never sees any of this.
+                with trace_context(registry=self._reg()), \
+                        span("train/step", registry=self._reg(),
+                             step=self.step):
+                    new_state = self._step_fn(self.state, self.step)
             except (KeyboardInterrupt, LedgerError,
                     RecoveryExhaustedError):
                 raise

@@ -87,6 +87,7 @@ import numpy as np
 from apex_tpu.serving import robust as robust_mod
 from apex_tpu.serving.scheduler import CompletedRequest, Request, Scheduler
 from apex_tpu.telemetry.registry import get_registry
+from apex_tpu.telemetry.trace import emit_flow, emit_span, new_trace_id
 
 TIERS = ("interactive", "batch")
 
@@ -459,7 +460,8 @@ class ServeFleet:
             # the fresh generation name doubles as a fresh scope
             rep.engine.adopt_prefix_store(self.prefix_store)
         rep.sched = Scheduler(rep.engine, registry=self._registry,
-                              robust=self._robust, clock=self._clock)
+                              robust=self._robust, clock=self._clock,
+                              trace_label=f"replica{rep.idx}")
         rep.generation += 1
         rep.respawn_at = None
         rep.spawn_seconds = self._clock() - t0
@@ -522,8 +524,16 @@ class ServeFleet:
                 request, "duplicate_rid",
                 f"rid {request.rid} is already tracked by this fleet")
         tc = self.tiers[tier]
+        # trace identity is allocated HERE (not at the replica
+        # scheduler) so the fleet's canonical copy carries it: a
+        # migration continuation is dataclasses.replace'd from
+        # info["orig"], and the donor + survivor span trees must share
+        # one trace_id
+        trace_id = request.trace_id
+        if trace_id is None and self._reg().enabled:
+            trace_id = new_trace_id()
         req = dataclasses.replace(
-            request, tier=tier,
+            request, tier=tier, trace_id=trace_id,
             ttft_deadline_s=(request.ttft_deadline_s
                              if request.ttft_deadline_s is not None
                              else tc.ttft_deadline_s),
@@ -606,6 +616,15 @@ class ServeFleet:
             self._reg().counter("fleet/dispatched").inc()
             if self._rebalance and r.rid in self._rebalance["rids"]:
                 self._rebalance["rids"].discard(r.rid)
+                if r.trace_id is not None:
+                    # survivor end of the handoff arrow: flow_id must
+                    # match the donor's "out" record in _migrate
+                    emit_flow("migrate",
+                              f"{r.trace_id}:m{info['migrations']}",
+                              "in", registry=self._reg(),
+                              trace_id=r.trace_id, rid=r.rid,
+                              replica=rep.idx,
+                              label=f"replica{rep.idx}")
                 if not self._rebalance["rids"]:
                     self._finish_rebalance()
 
@@ -856,7 +875,8 @@ class ServeFleet:
             self.kv_fallback_reprefills += 1
             reg.counter("fleet/kv_fallback_reprefills").inc()
             reg.event("fleet", "kv_fallback", rid=rid, replica=rep.idx,
-                      reason=why, tick=self.tick)
+                      reason=why, tick=self.tick,
+                      trace_id=cont.trace_id)
             return False
         carry = np.asarray(cont.prompt, np.int32)
         cut = min(int(payload["length"]), len(carry) - 1)
@@ -876,7 +896,8 @@ class ServeFleet:
         reg.event("fleet", "kv_handoff", rid=rid, replica=rep.idx,
                   slot=int(payload.get("slot", -1)),
                   length=int(payload["length"]), cut=int(cut),
-                  bytes=nbytes, tick=self.tick)
+                  bytes=nbytes, tick=self.tick,
+                  trace_id=cont.trace_id)
         return True
 
     def _migrate(self, rep, records, t0, reason, kv_payloads=None):
@@ -931,9 +952,27 @@ class ServeFleet:
             cont = dataclasses.replace(
                 orig, prompt=prompt, max_new_tokens=remaining,
                 arrival=self.tick)
-            if kv_payloads and rid in kv_payloads:
+            kv = bool(kv_payloads and rid in kv_payloads)
+            if kv:
                 self._seed_prefix_from_payload(rep, rid, cont,
                                                kv_payloads[rid])
+            if cont.trace_id is not None:
+                # donor-side handoff: a serve/migrate span covering
+                # extract -> re-admission plus the "out" end of the
+                # flow arrow the survivor's dispatch closes
+                now_p = time.perf_counter()
+                start_p = (t0 if self._clock is time.perf_counter
+                           else now_p)
+                emit_span("serve/migrate", start_p, now_p,
+                          registry=self._reg(),
+                          trace_id=cont.trace_id, rid=rid,
+                          reason=reason, kv_handoff=kv,
+                          replica=f"replica{rep.idx}")
+                emit_flow("migrate",
+                          f"{cont.trace_id}:m{info['migrations']}",
+                          "out", registry=self._reg(),
+                          trace_id=cont.trace_id, rid=rid,
+                          replica=rep.idx, label=f"replica{rep.idx}")
             self.pending.append(cont)
             readmitted.append(rid)
             migrated += 1

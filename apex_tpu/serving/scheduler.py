@@ -57,6 +57,7 @@ import numpy as np
 
 from apex_tpu.serving import robust as robust_mod
 from apex_tpu.telemetry.registry import get_registry
+from apex_tpu.telemetry.trace import emit_span, new_span_id, new_trace_id
 
 
 @dataclasses.dataclass
@@ -72,7 +73,12 @@ class Request:
     the fleet's default tier). The scheduler itself is tier-blind —
     :class:`~apex_tpu.serving.fleet.ServeFleet` resolves a tier into
     the per-request deadline fields above at admission and keeps the
-    per-tier latency accounting."""
+    per-tier latency accounting.
+
+    ``trace_id`` is the request's causal identity (None = allocate at
+    submit when telemetry is on). It survives ``dataclasses.replace``,
+    so a migration continuation keeps the donor's id and the donor +
+    survivor span trees stitch into ONE trace."""
 
     rid: int
     prompt: np.ndarray
@@ -81,6 +87,7 @@ class Request:
     ttft_deadline_s: Optional[float] = None
     total_deadline_s: Optional[float] = None
     tier: Optional[str] = None
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -151,10 +158,20 @@ class Scheduler:
     """
 
     def __init__(self, engine, *, registry=None,
-                 clock=time.perf_counter, robust=None, guard=None):
+                 clock=time.perf_counter, robust=None, guard=None,
+                 trace_label=None):
         self.engine = engine
         self._registry = registry
         self._clock = clock
+        # process-row key on every span this scheduler emits; the fleet
+        # sets "replica<N>" so trace_export gives each replica a row
+        self.trace_label = trace_label or "serve"
+        # rid -> {"trace_id", "root" (root span id), "submit_perf",
+        # "eligible_perf", "admit_perf"} — populated only while the
+        # registry is enabled; span timestamps use time.perf_counter()
+        # directly (NOT self._clock, which tests may virtualize) so
+        # they live on the registry's epoch clock
+        self._tr = {}
         self.robust = robust or robust_mod.RobustConfig()
         self.guard = guard
         self.num_slots = engine.config.num_slots
@@ -251,10 +268,20 @@ class Scheduler:
             # the most patience — shed it to make room
             oldest = self.pending.pop(0)
             self._known_rids.discard(oldest.rid)
+            self._tr.pop(oldest.rid, None)
             self._reject(oldest, "shed",
                          f"shed for rid {request.rid} "
                          f"(max_pending {rc.max_pending})")
         self._known_rids.add(request.rid)
+        reg = self._reg()
+        if reg.enabled:
+            if request.trace_id is None:
+                request.trace_id = new_trace_id()
+            self._tr[request.rid] = {
+                "trace_id": request.trace_id,
+                "root": new_span_id(),
+                "submit_perf": time.perf_counter(),
+            }
         self.pending.append(request)
         self.pending.sort(key=lambda r: (r.arrival, r.rid))
         return True
@@ -273,7 +300,10 @@ class Scheduler:
                   latencies=(), **event_fields):
         """Land one request in a terminal state: completed-list record,
         per-status counter, ``serve`` JSONL event. Every failure path
-        funnels through here so no request ever vanishes silently."""
+        funnels through here so no request ever vanishes silently.
+        When the request is traced, the phase spans close here too:
+        ``serve/decode`` (admission -> terminal), an instant
+        ``serve/evict``, and the root ``serve/request`` span."""
         rec = CompletedRequest(
             rid=req.rid,
             tokens=np.asarray(list(tokens), np.int32),
@@ -288,14 +318,40 @@ class Scheduler:
             reg.counter(counter).inc()
         reg.counter("serve/requests_completed").inc()
         reg.counter("serve/tokens_generated").inc(len(rec.tokens))
+        tr = self._tr.pop(req.rid, None)
+        trace_fields = {}
+        if tr is not None:
+            trace_fields["trace_id"] = tr["trace_id"]
+            self._close_request_trace(tr, req, reason, reg,
+                                      tokens=len(rec.tokens))
         reg.event("serve", "request_done", rid=req.rid,
                   tokens=len(rec.tokens), prompt_len=len(req.prompt),
                   ttft_ms=(round(rec.ttft_s * 1e3, 3)
                            if np.isfinite(rec.ttft_s) else None),
                   mean_tok_latency_ms=round(
                       rec.mean_tok_latency_s * 1e3, 3),
-                  finish_reason=reason, **event_fields)
+                  finish_reason=reason, **trace_fields, **event_fields)
         return rec
+
+    def _close_request_trace(self, tr, req, reason, reg, *, tokens):
+        """Emit the end-of-life spans for one traced request (see
+        :meth:`_terminal`). A request that never reached admission has
+        no ``serve/decode`` span — its whole life was the queue."""
+        now_p = time.perf_counter()
+        admit = tr.get("admit_perf")
+        if admit is not None:
+            emit_span("serve/decode", admit, now_p, registry=reg,
+                      trace_id=tr["trace_id"], parent_id=tr["root"],
+                      rid=req.rid, replica=self.trace_label,
+                      tokens=tokens)
+        emit_span("serve/evict", now_p, now_p, registry=reg,
+                  trace_id=tr["trace_id"], parent_id=tr["root"],
+                  rid=req.rid, reason=reason, replica=self.trace_label)
+        start = tr.get("eligible_perf", tr["submit_perf"])
+        emit_span("serve/request", start, now_p, registry=reg,
+                  trace_id=tr["trace_id"], span_id=tr["root"],
+                  rid=req.rid, tier=req.tier, finish_reason=reason,
+                  replica=self.trace_label, tokens=tokens)
 
     # -- the phases --------------------------------------------------------
 
@@ -312,12 +368,15 @@ class Scheduler:
         their TTFT deadline, active ones past their total-latency
         deadline — with the ``deadline_exceeded`` terminal status."""
         now = self._clock()
+        now_p = time.perf_counter() if self._tr else None
         # eligibility is stamped here (not only at admission) so a
         # request stuck in the queue accrues wait time toward its
         # TTFT deadline from the moment it became eligible
         for r in self.pending:
             if r.arrival <= self.tick:
                 self._eligible_wall.setdefault(r.rid, now)
+                if now_p is not None and r.rid in self._tr:
+                    self._tr[r.rid].setdefault("eligible_perf", now_p)
         for r in list(self.pending):
             limit = self._ttft_deadline(r)
             t0 = self._eligible_wall.get(r.rid)
@@ -343,9 +402,12 @@ class Scheduler:
 
     def _admit(self):
         now = self._clock()
+        now_p = time.perf_counter() if self._tr else None
         eligible = [r for r in self.pending if r.arrival <= self.tick]
         for r in eligible:
             self._eligible_wall.setdefault(r.rid, now)
+            if now_p is not None and r.rid in self._tr:
+                self._tr[r.rid].setdefault("eligible_perf", now_p)
         buckets = self.engine.config.batch_buckets
         while eligible and self.free:
             # the prefill call occupies a whole batch bucket (real +
@@ -359,10 +421,12 @@ class Scheduler:
             for r in group:
                 self.pending.remove(r)
             slots = [self.free.pop(0) for _ in group]
+            p0 = time.perf_counter() if self._tr else None
             first = self.engine.prefill(
                 slots, [r.prompt for r in group],
                 pad_slot_ids=self.free)
             t1 = self._clock()
+            p1 = time.perf_counter() if self._tr else None
             self.prefill_calls += 1
             cuts = list(getattr(self.engine, "last_prefill_hits",
                                 ()) or [0] * len(group))
@@ -377,6 +441,20 @@ class Scheduler:
                     reg.histogram("serve/ttft_prefix_hit").observe(
                         ttft * 1e3)
                 reg.counter("serve/requests_admitted").inc()
+                tr = self._tr.get(r.rid)
+                if tr is not None:
+                    emit_span("serve/queued",
+                              tr.get("eligible_perf",
+                                     tr["submit_perf"]), p0,
+                              registry=reg, trace_id=tr["trace_id"],
+                              parent_id=tr["root"], rid=r.rid,
+                              replica=self.trace_label)
+                    emit_span("serve/prefill", p0, p1, registry=reg,
+                              trace_id=tr["trace_id"],
+                              parent_id=tr["root"], rid=r.rid,
+                              slot=slot, prefix_cut=int(cut),
+                              replica=self.trace_label)
+                    tr["admit_perf"] = p1
                 self.tokens_generated += 1
                 st = _Active(r, tok, ttft)
                 if self._finished(st):
@@ -397,10 +475,12 @@ class Scheduler:
         spec = bool(getattr(self.engine, "spec_enabled", False))
         max_bucket = self.engine.config.batch_buckets[-1]
         slots = sorted(self.active)
+        trace_on = self._reg().enabled
         for i in range(0, len(slots), max_bucket):
             chunk = slots[i:i + max_bucket]
             toks = [self.active[s].last for s in chunk]
             t0 = self._clock()
+            p0 = time.perf_counter() if trace_on else None
             try:
                 out = self.engine.decode(
                     chunk, toks, pad_slot_ids=self.free,
@@ -433,6 +513,12 @@ class Scheduler:
             self.decode_steps += 1
             reg = self._reg()
             reg.counter("serve/decode_steps").inc()
+            if p0 is not None and reg.enabled:
+                # engine-row span: one per dispatch, covering the whole
+                # chunk (spec engines verify drafts inside this call)
+                emit_span("serve/decode_chunk", p0, registry=reg,
+                          slots=len(chunk), spec=spec,
+                          replica=self.trace_label, tick=self.tick)
             if rc.quarantine and len(chunk) >= 2 and not finite.any():
                 # every slot non-finite at once: that is poisoned
                 # weights/activations, not one poisoned request — the
@@ -569,9 +655,19 @@ class Scheduler:
             self._known_rids.discard(rid)
             self._eligible_wall.pop(rid, None)
             reg.counter("serve/extracted").inc()
+            tr = self._tr.pop(rid, None)
+            trace_fields = {}
+            if tr is not None:
+                # close the donor side of the trace: the survivor's
+                # scheduler opens a fresh serve/request root under the
+                # SAME trace_id when the continuation is re-submitted
+                trace_fields["trace_id"] = tr["trace_id"]
+                self._close_request_trace(tr, rec["request"], reason,
+                                          reg,
+                                          tokens=len(rec["tokens"]))
             reg.event("serve", "extracted", rid=rid, reason=reason,
                       where=rec["where"], tokens=len(rec["tokens"]),
-                      tick=self.tick)
+                      tick=self.tick, **trace_fields)
         return out
 
     # -- drain -------------------------------------------------------------

@@ -6,8 +6,12 @@ The observability layer under the parallel/optimizer/bench stack:
   event sink under ``$APEX_TPU_TELEMETRY_DIR`` (rank-aware).
 - :mod:`trace`     — named :func:`span` context managers (optional
   device-sync fencing, nested under ``jax.profiler.TraceAnnotation`` /
-  ``jax.named_scope``) and a ``start_profiler_trace``/``stop`` pair
-  gated by ``APEX_TPU_PROFILE_DIR``.
+  ``jax.named_scope``), causal identity (:class:`TraceContext` on a
+  contextvar; spans emit begin/end events carrying
+  trace/span/parent ids — the substrate ``tools/trace_export.py``
+  turns into a Perfetto-loadable Chrome trace), and a
+  ``start_profiler_trace``/``stop`` pair gated by
+  ``APEX_TPU_PROFILE_DIR``.
 - :mod:`xla_cost`  — ``lower().cost_analysis()`` extraction for a
   jitted step + achieved MFU / HBM-utilization against a per-backend
   peak table.
@@ -57,10 +61,17 @@ from apex_tpu.telemetry.registry import (  # noqa: F401
 )
 from apex_tpu.telemetry.trace import (  # noqa: F401
     Span,
+    TraceContext,
+    current_trace,
     device_sync,
+    emit_flow,
+    emit_span,
+    new_span_id,
+    new_trace_id,
     span,
     start_profiler_trace,
     stop_profiler_trace,
+    trace_context,
 )
 from apex_tpu.telemetry import comm  # noqa: F401
 from apex_tpu.telemetry import compile_watch  # noqa: F401

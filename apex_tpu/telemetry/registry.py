@@ -20,6 +20,14 @@ Rank discipline: on multi-process runs each process writes its own
 ``telemetry-rank<N>.jsonl``; ``APEX_TPU_TELEMETRY_RANK0_ONLY=1``
 restricts both the sink and the ``log_summary`` logging path (built on
 :mod:`apex_tpu._logging`'s rank-aware formatter) to process 0.
+
+Clock discipline: every event carries two stamps — ``t`` (wall clock,
+human-facing, NTP-skewable) and ``ts`` (seconds on one monotonic
+``perf_counter`` epoch per registry). A ``trace_epoch`` header record
+written at sink open carries ``epoch_unix`` (the wall-clock value of
+``ts == 0``), so ``tools/trace_export.py`` can place every rank's
+monotonic timeline on one absolute axis without trusting per-event
+wall clocks to agree across processes.
 """
 
 import collections
@@ -178,6 +186,23 @@ class MetricsRegistry:
         self._sink = None
         self._rank0_only = (os.environ.get(ENV_RANK0_ONLY) == "1"
                             if rank0_only is None else bool(rank0_only))
+        # Sampled back-to-back so epoch_unix ~= the wall clock at ts=0;
+        # residual skew is one statement, not an NTP step.
+        self._perf_origin = time.perf_counter()
+        self._epoch_unix = time.time()
+
+    # -- clock --------------------------------------------------------------
+
+    def now(self):
+        """Seconds since this registry's ``perf_counter`` epoch — the
+        monotonic clock every event ``ts`` shares."""
+        return time.perf_counter() - self._perf_origin
+
+    def to_ts(self, perf_t):
+        """Convert a raw ``time.perf_counter()`` reading (e.g. a span
+        start captured before the event is emitted) onto the ``ts``
+        clock."""
+        return perf_t - self._perf_origin
 
     # -- enablement ---------------------------------------------------------
 
@@ -251,8 +276,9 @@ class MetricsRegistry:
             return
         if self._rank0_only and _process_index() != 0:
             return
-        rec = {"t": round(time.time(), 6), "kind": kind, "name": name}
-        rec.update(fields)
+        rec = {"t": round(time.time(), 6), "ts": round(self.now(), 9),
+               "kind": kind, "name": name}
+        rec.update(fields)  # an explicit ts= overrides the stamp
         line = json.dumps(rec, default=str)
         with self._lock:
             sink = self._open_sink_locked()
@@ -268,6 +294,18 @@ class MetricsRegistry:
                     self._jsonl_dir,
                     f"telemetry-rank{_process_index()}.jsonl")
                 self._sink = open(path, "a")
+                # Clock-alignment header: epoch_unix is the wall clock
+                # at ts=0 for everything this registry writes below it.
+                header = {
+                    "t": round(time.time(), 6),
+                    "ts": round(self.now(), 9),
+                    "kind": "trace_epoch", "name": "epoch",
+                    "epoch_unix": round(time.time() - self.now(), 6),
+                    "pid": os.getpid(),
+                    "rank": _process_index(),
+                }
+                self._sink.write(json.dumps(header) + "\n")
+                self._sink.flush()
             except OSError:
                 # an unwritable sink dir must never take down training
                 self._jsonl_dir = None

@@ -3519,6 +3519,129 @@ def bench_serve_migrate(requests, steps):
     return ret
 
 
+def bench_trace_overhead(batch, steps, *, hidden=128, layers=2,
+                         heads=4, vocab=128, seq=16):
+    """Causal-tracing tax (round-24 contract): the SAME compiled mesh2d
+    train step driven through the supervisor-style host loop — a
+    ``trace_context`` + ``train/step`` span per step, exactly what
+    ``resilience.supervisor`` wraps around ``step_fn`` — twice:
+
+    - **off**: a fresh disabled registry (the library default). The
+      proof obligations ride in-bench: the disabled leg must record
+      ZERO events (the registry's ``event`` is counted via a shim and
+      must never fire), mint no span ids, and leave the ambient
+      TraceContext untouched — the zero-overhead-off contract of
+      docs/observability.md, asserted, not assumed;
+    - **on**: a fresh registry with a JSONL sink. ``span_count`` is
+      read back from the file it wrote (>= 2 events/step: span_begin +
+      span), and ``tracing_overhead_pct`` is the on-vs-off per-step
+      delta — the number the 'leave tracing on in production' claim
+      rests on.
+
+    Both legs execute the one compiled program (trace-time spans inside
+    ``jit`` never re-fire at execution), so the delta prices only the
+    host-side identity + event-write path.
+    """
+    import glob as _glob
+    import tempfile
+
+    from apex_tpu.parallel import mesh2d
+    from apex_tpu.telemetry import current_trace, span, trace_context
+    from apex_tpu.telemetry.registry import MetricsRegistry, use_registry
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    devices = jax.devices()
+    multi = len(devices) >= 2 and len(devices) % 2 == 0
+    mesh = mesh2d.mesh_2d(2 if multi else 1, None if multi else 1)
+    seg_params = mesh2d.gpt2_init(hidden=hidden, layers=layers,
+                                  heads=heads, vocab=vocab, max_seq=seq)
+    step, state = mesh2d.build_train_step(
+        mesh, seg_params, hidden=hidden, heads=heads, mode="baseline")
+    tokens, labels = mesh2d.make_batch(mesh, batch_per_replica=batch,
+                                       seq=seq, vocab=vocab)
+    out = step(*state, tokens, labels)
+    float(out[2])                       # compile, shared by both legs
+    carry = out[:2]                     # state buffers are donated —
+                                        # thread the carry through legs
+
+    def timed_loop(reg, carry):
+        o = step(*carry, tokens, labels)
+        float(o[2])                     # steady warmup
+        t0 = time.perf_counter()
+        for i in range(steps):
+            with trace_context(registry=reg), \
+                    span("train/step", registry=reg, step=i):
+                o = step(*o[:2], tokens, labels)
+        float(o[2])                     # completion barrier
+        return (time.perf_counter() - t0) / steps, o[:2]
+
+    # off leg: disabled registry + an event-counting shim that must
+    # stay silent, and one probe span proving no ids were minted
+    off_reg = MetricsRegistry()
+    off_events = []
+    _orig_event = off_reg.event
+    off_reg.event = lambda *a, **k: (off_events.append(a),
+                                     _orig_event(*a, **k))
+    with use_registry(off_reg):
+        t_off, carry = timed_loop(off_reg, carry)
+        probe = span("train/step", registry=off_reg)
+        with probe:
+            if current_trace() is not None:
+                raise AssertionError(
+                    "disabled tracing leaked a TraceContext")
+    if off_events:
+        raise AssertionError(
+            f"disabled registry recorded {len(off_events)} event(s) — "
+            f"the zero-overhead-off contract is broken")
+    if probe.span_id is not None:
+        raise AssertionError("disabled tracing minted a span id")
+
+    # on leg: fresh registry with a JSONL sink; span_count read back
+    # from what it actually wrote
+    on_dir = tempfile.mkdtemp(prefix="apex_trace_overhead_")
+    on_reg = MetricsRegistry()
+    on_reg.enable(jsonl_dir=on_dir)
+    with use_registry(on_reg):
+        t_on, carry = timed_loop(on_reg, carry)
+    on_reg.disable()
+    span_count = 0
+    for path in _glob.glob(os.path.join(on_dir, "*.jsonl")):
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") in ("span", "span_begin"):
+                    span_count += 1
+    if span_count < 2 * steps:
+        raise AssertionError(
+            f"enabled tracing wrote {span_count} span event(s) for "
+            f"{steps} step(s) — expected >= {2 * steps}")
+
+    overhead_pct = ((t_on - t_off) / t_off * 100.0) if t_off else None
+    _stage_compile_count(step)
+    compile_count = _PENDING_MEASURED.get("compile_count")
+    n_params = _tree_size(seg_params)
+    dp_world = mesh.shape[mesh2d.DATA_AXIS]
+    flops = 6 * batch * dp_world * seq * n_params
+    ret = {
+        "untraced_step_ms": round(t_off * 1e3, 3),
+        "traced_step_ms": round(t_on * 1e3, 3),
+        "tracing_overhead_pct": round(overhead_pct, 2)
+        if overhead_pct is not None else None,
+        "span_count": span_count,
+        "disabled_leg_events": len(off_events),
+        "spans_per_step": round(span_count / steps, 2),
+    }
+    _emit("trace_overhead_step_ms", t_on * 1e3, "ms", flops, steps,
+          t_on * steps, **ret,
+          **_comm_fields(n_elements=n_params, compress=None))
+    ret["compile_count"] = compile_count
+    return ret
+
+
 # The canonical (size, steps) per bench — the ONLY place these defaults
 # live; both the CLI dispatch below and the one-process capture plan
 # (tools/oneproc_capture.py) read them, so a tuning change (like resnet
@@ -3542,6 +3665,7 @@ BENCH_SPECS = {
     "serve_chaos": ((24, 16), bench_serve_chaos),
     "serve_fleet": ((16, 8), bench_serve_fleet),
     "serve_migrate": ((8, 6), bench_serve_migrate),
+    "trace_overhead": ((4, 30), bench_trace_overhead),
     "resnet": ((256, 50), bench_resnet),
     "kernels": ((1024, 5), bench_kernels),
     "fused_cc": ((512, 5), bench_fused_cc),

@@ -543,6 +543,65 @@ def test_serve_migrate_fields_gated_at_round23():
                                     errors=[]) == []
 
 
+def test_trace_overhead_fields_gated_at_round24():
+    """ISSUE 19 satellite: a trace_overhead metric line must carry the
+    causal-tracing contract from round 24 — span_count, the on-vs-off
+    overhead percentage, both leg step times, and the disabled-leg
+    event count (which must be 0 — the zero-overhead-off proof), all
+    nullable; pre-24 records carrying any of them are flagged, other
+    configs never need them."""
+    base = {"metric": "trace_overhead_step_ms", "value": 11.5,
+            "unit": "ms", "vs_baseline": 1.0,
+            "tflops_per_sec": 0.01, "mfu": 0.0001,
+            "comm_bytes_per_step": 0,
+            "measured_comm_bytes_per_step": None,
+            "model_flops_per_step_xla": None,
+            "peak_hbm_bytes": None, "hbm_headroom_pct": None,
+            "compile_count": 1, "lint_violations": None,
+            "static_comm_bytes_per_step": None,
+            "backend": "cpu-mesh"}
+    full = dict(base, span_count=60, tracing_overhead_pct=0.8,
+                untraced_step_ms=11.1, traced_step_ms=11.2,
+                disabled_leg_events=0)
+    assert schema.check_metric_line(dict(full), round_n=24,
+                                    errors=[]) == []
+    # round 24: every tracing field is required on trace_overhead lines
+    msgs = schema.check_metric_line(dict(base), round_n=24, errors=[])
+    for key in schema.TRACE_OVERHEAD_REQUIRED_FIELDS:
+        assert any(key in m for m in msgs)
+    # nullable (a host that skipped a leg stays honest) and typed
+    assert schema.check_metric_line(
+        dict(full, tracing_overhead_pct=None, untraced_step_ms=None),
+        round_n=24, errors=[]) == []
+    msgs = schema.check_metric_line(
+        dict(full, span_count="many"), round_n=24, errors=[])
+    assert any("must be numeric" in m for m in msgs)
+    # a nonzero disabled-leg event count is a contract violation, not
+    # just a number — the disabled registry recorded something
+    msgs = schema.check_metric_line(
+        dict(full, disabled_leg_events=3), round_n=24, errors=[])
+    assert any("zero-overhead-off" in m for m in msgs)
+    # pre-24 checked-in records carrying the tracing-only fields are
+    # flagged — the fields did not exist at capture time
+    wrapper = {"n": 23, "cmd": "python bench.py trace_overhead",
+               "rc": 0, "tail": "", "parsed": dict(full)}
+    msgs = schema.check_wrapper(wrapper, errors=[])
+    assert any("only defined from round 24" in m for m in msgs)
+    assert schema.check_wrapper(
+        {"n": 24, "cmd": "c", "rc": 0, "tail": "",
+         "parsed": dict(full)}, errors=[]) == []
+    # other configs never need the tracing fields at round 24, and
+    # serve_migrate lines keep their own (round-23) contract untouched
+    assert schema.check_metric_line(dict(base, metric="resnet50_amp_o2"),
+                                    round_n=24, errors=[]) == []
+    migrate = dict(base, metric="serve_migrate_migration_ms",
+                   migration_ms_short_ctx=14.5,
+                   migration_ms_long_ctx=12.7, kv_handoff_bytes=131080,
+                   fallback_reprefills=0, fleet_prefix_hit_rate=0.09)
+    assert schema.check_metric_line(dict(migrate), round_n=24,
+                                    errors=[]) == []
+
+
 def test_live_emit_passes_current_schema(capsys):
     """What bench._emit prints today must satisfy the round-14
     (current) metric-line contract — telemetry + memwatch + lint

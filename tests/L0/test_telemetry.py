@@ -74,9 +74,13 @@ def test_registry_instruments_and_jsonl_sink(tmp_path):
     assert len(files) == 1
     events = [json.loads(l) for l in files[0].read_text().splitlines()]
     kinds = [e["kind"] for e in events]
-    assert kinds == ["custom", "summary"]
-    assert events[0]["detail"] == 42
-    assert events[1]["counters"]["c"] == 3.5
+    # every sink opens with the clock-anchor header (epoch_unix = wall
+    # time at this registry's monotonic ts == 0) — the contract
+    # tools/trace_export.py uses to align ranks on one absolute axis
+    assert kinds == ["trace_epoch", "custom", "summary"]
+    assert events[0]["epoch_unix"] > 0
+    assert events[1]["detail"] == 42
+    assert events[2]["counters"]["c"] == 3.5
 
 
 def test_use_registry_scopes_process_wide(tmp_path):

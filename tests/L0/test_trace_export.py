@@ -1,0 +1,507 @@
+"""End-to-end causal tracing (ISSUE 19): TraceContext identity +
+span trees, cross-replica trace stitching through a fleet migration,
+Chrome-trace export (tools/trace_export.py), critical-path latency
+attribution, and the telemetry_report per-tier request-latency rollup.
+
+Covers:
+
+- identity minting: spans opened under an enabled registry form a tree
+  (one trace_id, parent/child span ids) via the contextvar; a disabled
+  registry mints NOTHING and never touches the contextvar (the
+  zero-overhead-off contract, asserted at the API edge);
+- clock discipline: every JSONL record carries ``ts`` on the
+  registry's perf_counter epoch next to wall ``t``, and the sink opens
+  with a ``trace_epoch`` header whose ``epoch_unix`` anchors ts=0 so
+  per-rank streams align without NTP-skewed wall clocks;
+- the golden export: a synthetic JSONL capture -> ``to_chrome_trace``
+  produces schema-valid Chrome trace events (ph X with µs ts/dur,
+  process/thread metadata, paired s/f flow arrows) that round-trip
+  ``json.loads``;
+- the stitch acceptance (tier-1, trace-only — stub engines, no
+  compiles): a 2-replica fleet, replica 0 killed mid-stream, every
+  migrated request ends up as ONE trace_id whose spans cross both
+  replica process rows with a migrate flow arrow between them, and
+  ``critical_path`` attributes its latency across
+  queued/prefill/decode/migrate;
+- the report rollup: the same capture folded by
+  tools/telemetry_report.py yields per-tier TTFT/total p50/p99 with a
+  phase breakdown and zero unknown kinds.
+"""
+
+import json
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from apex_tpu.resilience import faults
+from apex_tpu.serving import FleetConfig, Request, Scheduler, ServeFleet
+from apex_tpu.telemetry import (
+    MetricsRegistry,
+    TraceContext,
+    current_trace,
+    emit_flow,
+    emit_span,
+    span,
+    trace_context,
+    use_registry,
+)
+
+ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import telemetry_report  # noqa: E402
+import trace_export  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# helpers: stub engines (host-only router policy, no jax, no compiles)
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self, num_slots=4):
+        self.config = types.SimpleNamespace(
+            num_slots=num_slots, batch_buckets=(2, 4),
+            prefill_buckets=(64,), eos_token_id=None, pad_token_id=0)
+        self.max_len = 10_000
+        self.decode_retries_total = 0
+        self.compile_count = 6
+        self.spec = types.SimpleNamespace(
+            bytes_per_slot=lambda: 0, cache_dtype_name=lambda: "stub")
+
+    def kv_cache_bytes(self):
+        return 0
+
+    def prefill(self, slot_ids, prompts, *, pad_slot_ids=None):
+        return np.ones(len(prompts), np.int32)
+
+    def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
+               retries=0, backoff_s=0.0, backoff_cap_s=0.0):
+        return np.ones(len(slot_ids), np.int32), \
+            np.ones(len(slot_ids), bool)
+
+
+def _req(rid, plen=3, max_new=4, arrival=0.0, **kw):
+    return Request(rid=rid, prompt=np.arange(plen, dtype=np.int32) % 7,
+                   max_new_tokens=max_new, arrival=arrival, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm_replica_loss()
+
+
+def _read_events(tmp_path):
+    events = []
+    for p in sorted(tmp_path.glob("*.jsonl")):
+        with open(p) as f:
+            events.extend(json.loads(line) for line in f
+                          if line.strip())
+    return events
+
+
+# ---------------------------------------------------------------------------
+# identity: TraceContext + span trees + the disabled no-op contract
+# ---------------------------------------------------------------------------
+
+
+class TestTraceIdentity:
+    def test_span_tree_shares_trace_id_and_parents(self, tmp_path):
+        reg = MetricsRegistry(enabled=True, jsonl_dir=str(tmp_path))
+        with use_registry(reg):
+            with trace_context() as ctx:
+                with span("outer") as outer:
+                    assert outer.trace_id == ctx.trace_id
+                    assert current_trace().span_id == outer.span_id
+                    with span("inner") as inner:
+                        assert inner.trace_id == outer.trace_id
+                        assert inner.parent_id == outer.span_id
+            assert current_trace() is None
+        reg.disable()
+        events = _read_events(tmp_path)
+        begins = [e for e in events if e["kind"] == "span_begin"]
+        spans = [e for e in events if e["kind"] == "span"]
+        assert {e["name"] for e in begins} == {"outer", "inner"}
+        assert {e["name"] for e in spans} == {"outer", "inner"}
+        # one trace, parented: begin and close carry the same identity
+        ids = {e["name"]: e for e in spans}
+        assert ids["inner"]["trace_id"] == ids["outer"]["trace_id"]
+        assert ids["inner"]["parent_id"] == ids["outer"]["span_id"]
+
+    def test_disabled_registry_mints_nothing(self):
+        reg = MetricsRegistry()  # disabled default
+        with use_registry(reg):
+            with trace_context(registry=reg) as ctx:
+                assert ctx is None
+                assert current_trace() is None
+                sp = span("noop", registry=reg)
+                with sp:
+                    assert current_trace() is None
+                assert sp.span_id is None
+            assert emit_span("noop", 0.0, 1.0, registry=reg) is None
+            emit_flow("noop", "f1", "out", registry=reg)  # no-op
+
+    def test_trace_context_inherits_and_carries_baggage(self, tmp_path):
+        reg = MetricsRegistry(enabled=True, jsonl_dir=str(tmp_path))
+        with use_registry(reg):
+            with trace_context(baggage={"tier": "interactive"}) as root:
+                with trace_context() as child:
+                    assert child.trace_id == root.trace_id
+                    assert child.bag()["tier"] == "interactive"
+            with trace_context(trace_id="feedbeef" * 2) as pinned:
+                assert pinned.trace_id == "feedbeef" * 2
+        reg.disable()
+
+    def test_wire_roundtrip(self):
+        ctx = TraceContext(trace_id="ab" * 8, span_id="cd" * 4,
+                           parent_id="ef" * 4,
+                           baggage=(("tier", "batch"),))
+        assert TraceContext.from_wire(ctx.to_wire()) == ctx
+
+    def test_epoch_header_and_ts_stamps(self, tmp_path):
+        """Clock discipline: the sink opens with a trace_epoch header
+        anchoring the perf_counter epoch to wall time, and every event
+        carries a monotonic ``ts`` next to wall ``t``."""
+        reg = MetricsRegistry(enabled=True, jsonl_dir=str(tmp_path))
+        reg.event("span", "tick", duration_s=0.0)
+        reg.event("span", "tock", duration_s=0.0)
+        reg.disable()
+        events = _read_events(tmp_path)
+        header = events[0]
+        assert header["kind"] == "trace_epoch"
+        assert header["epoch_unix"] == pytest.approx(header["t"],
+                                                     abs=5.0)
+        ticks = [e for e in events if e["kind"] == "span"]
+        assert all("ts" in e and "t" in e for e in ticks)
+        assert ticks[0]["ts"] <= ticks[1]["ts"]  # monotonic
+        # epoch + ts reconstructs wall time without NTP skew
+        for e in ticks:
+            assert header["epoch_unix"] + e["ts"] == \
+                pytest.approx(e["t"], abs=5.0)
+
+
+# ---------------------------------------------------------------------------
+# the golden export: synthetic JSONL -> schema-valid Chrome trace
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_capture(tmp_path):
+    """Two ranks' JSONL files with a known span tree + one flow pair,
+    hand-written so the export contract is tested against a fixed
+    input, not against whatever the scheduler happens to emit."""
+    rank0 = [
+        {"t": 100.0, "ts": 0.0, "kind": "trace_epoch", "name": "epoch",
+         "epoch_unix": 100.0, "pid": 1, "rank": 0},
+        {"t": 100.001, "ts": 0.001, "kind": "span_begin",
+         "name": "serve/request", "trace_id": "t1", "span_id": "r1",
+         "parent_id": "", "rid": 7, "replica": "replica0"},
+        {"t": 100.002, "ts": 0.002, "kind": "span",
+         "name": "serve/queued", "duration_s": 0.001, "trace_id": "t1",
+         "span_id": "q1", "parent_id": "r1", "rid": 7,
+         "replica": "replica0"},
+        {"t": 100.004, "ts": 0.004, "kind": "span",
+         "name": "serve/prefill", "duration_s": 0.002,
+         "trace_id": "t1", "span_id": "p1", "parent_id": "r1",
+         "rid": 7, "replica": "replica0"},
+        {"t": 100.005, "ts": 0.005, "kind": "span",
+         "name": "serve/migrate", "duration_s": 0.001,
+         "trace_id": "t1", "span_id": "m1", "parent_id": "", "rid": 7,
+         "replica": "replica0", "reason": "replica_loss"},
+        {"t": 100.005, "ts": 0.005, "kind": "trace_flow",
+         "name": "migrate", "flow_id": "t1:m1", "phase": "out",
+         "trace_id": "t1", "rid": 7, "replica": "replica0"},
+        {"t": 100.006, "ts": 0.006, "kind": "span",
+         "name": "serve/request", "duration_s": 0.005,
+         "trace_id": "t1", "span_id": "r1", "parent_id": "",
+         "rid": 7, "replica": "replica0"},
+    ]
+    # rank 1's perf epoch started 50 wall-seconds later — its ts values
+    # are small but its epoch_unix is larger; alignment must use both
+    rank1 = [
+        {"t": 150.0, "ts": 0.0, "kind": "trace_epoch", "name": "epoch",
+         "epoch_unix": 150.0, "pid": 2, "rank": 1},
+        {"t": 150.001, "ts": 0.001, "kind": "trace_flow",
+         "name": "migrate", "flow_id": "t1:m1", "phase": "in",
+         "trace_id": "t1", "rid": 7, "replica": "replica1"},
+        {"t": 150.004, "ts": 0.004, "kind": "span",
+         "name": "serve/decode", "duration_s": 0.003,
+         "trace_id": "t1", "span_id": "d1", "parent_id": "r2",
+         "rid": 7, "replica": "replica1"},
+        {"t": 150.005, "ts": 0.005, "kind": "span",
+         "name": "serve/request", "duration_s": 0.004,
+         "trace_id": "t1", "span_id": "r2", "parent_id": "",
+         "rid": 7, "replica": "replica1"},
+    ]
+    for rank, rows in ((0, rank0), (1, rank1)):
+        path = tmp_path / f"telemetry-rank{rank}.jsonl"
+        path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return tmp_path
+
+
+class TestChromeExport:
+    def test_golden_export_schema(self, tmp_path):
+        _synthetic_capture(tmp_path)
+        events = trace_export.load_dir(str(tmp_path))
+        trace = trace_export.to_chrome_trace(events)
+        # the export must round-trip json (Perfetto loads files, not
+        # python dicts)
+        trace = json.loads(json.dumps(trace))
+        rows = trace["traceEvents"]
+        assert trace["displayTimeUnit"] == "ms"
+        assert all(e["ph"] in ("X", "i", "s", "f", "M") for e in rows)
+        # process/thread metadata names both replica rows
+        pnames = {e["args"]["name"] for e in rows
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+        assert any("replica0" in n for n in pnames)
+        assert any("replica1" in n for n in pnames)
+        completes = [e for e in rows if e["ph"] == "X"]
+        assert completes, "no complete (ph=X) span events"
+        for e in completes:
+            assert isinstance(e["ts"], (int, float))
+            assert e["dur"] >= 0
+            assert isinstance(e["pid"], int)
+            assert isinstance(e["tid"], int)
+            assert e["name"].startswith("serve/")
+        # cross-rank alignment: rank1's spans land ~50s after rank0's
+        # on the shared absolute axis despite smaller raw ts values
+        t_r0 = [e["ts"] for e in completes
+                if e["args"]["trace_id"] == "t1"
+                and "queued" in e["name"]]
+        t_r1 = [e["ts"] for e in completes if "decode" in e["name"]]
+        assert t_r1[0] - t_r0[0] == pytest.approx(50.0 * 1e6, rel=0.01)
+        # the flow pair: one s and one f sharing an id, s before f
+        starts = [e for e in rows if e["ph"] == "s"]
+        finishes = [e for e in rows if e["ph"] == "f"]
+        assert len(starts) == 1 and len(finishes) == 1
+        assert starts[0]["id"] == finishes[0]["id"]
+        assert finishes[0]["ts"] > starts[0]["ts"]
+        assert finishes[0]["bp"] == "e"
+        # the two ranks render as distinct process rows
+        assert {e["pid"] for e in completes
+                if e["args"].get("replica") == "replica0"} != \
+            {e["pid"] for e in completes
+             if e["args"].get("replica") == "replica1"}
+
+    def test_unclosed_span_begin_exports_as_instant(self, tmp_path):
+        rows = [
+            {"t": 10.0, "ts": 0.0, "kind": "trace_epoch",
+             "name": "epoch", "epoch_unix": 10.0, "pid": 1, "rank": 0},
+            {"t": 10.1, "ts": 0.1, "kind": "span_begin",
+             "name": "train/step", "trace_id": "tx", "span_id": "s1",
+             "parent_id": ""},
+        ]
+        path = tmp_path / "telemetry-rank0.jsonl"
+        path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        trace = trace_export.to_chrome_trace(
+            trace_export.load_dir(str(tmp_path)))
+        instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+        assert [e["name"] for e in instants] == ["train/step (unclosed)"]
+
+    def test_critical_path_on_synthetic(self, tmp_path):
+        _synthetic_capture(tmp_path)
+        records = trace_export.critical_path(
+            trace_export.load_dir(str(tmp_path)))
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["rid"] == 7
+        assert rec["migrations"] == 1
+        assert rec["replicas"] == ["replica0", "replica1"]
+        assert rec["queued_ms"] == pytest.approx(1.0)
+        assert rec["prefill_ms"] == pytest.approx(2.0)
+        assert rec["decode_ms"] == pytest.approx(3.0)
+        # total spans the donor's first start to the survivor's last
+        # end on the ALIGNED clock: 150.005 - 100.001 wall seconds
+        assert rec["total_ms"] == pytest.approx(50_004.0, rel=0.01)
+
+    def test_cli_writes_trace_json(self, tmp_path, capsys):
+        _synthetic_capture(tmp_path)
+        out = tmp_path / "trace.json"
+        assert trace_export.main([str(tmp_path), "-o", str(out)]) == 0
+        trace = json.loads(out.read_text())
+        assert trace["traceEvents"]
+        assert "wrote" in capsys.readouterr().out
+        assert trace_export.main([str(tmp_path),
+                                  "--critical-path"]) == 0
+        assert "critical path" in capsys.readouterr().out
+
+    def test_torn_lines_and_missing_dir(self, tmp_path):
+        path = tmp_path / "telemetry-rank0.jsonl"
+        path.write_text('{"kind": "span", "name": "x", "t": 1.0, '
+                        '"ts": 0.1, "duration_s": 0.01}\n{"torn')
+        events = trace_export.load_dir(str(tmp_path))
+        assert len(events) == 1  # torn tail skipped, not fatal
+        with pytest.raises(FileNotFoundError):
+            trace_export.load_dir(str(tmp_path / "nope"))
+
+
+# ---------------------------------------------------------------------------
+# the stitch acceptance: fleet + kill -> ONE trace across two replicas
+# ---------------------------------------------------------------------------
+
+
+def _run_fleet_with_kill(tmp_path):
+    reg = MetricsRegistry(enabled=True, jsonl_dir=str(tmp_path))
+    fleet = ServeFleet(
+        engine_factory=lambda idx, mesh, name: _StubEngine(),
+        config=FleetConfig(num_replicas=2, respawn_delay_ticks=1),
+        registry=reg)
+    with faults.inject_replica_loss(0, 2):
+        for i in range(6):
+            fleet.submit(_req(i, tier="interactive" if i % 2
+                              else "batch"))
+        done = fleet.run(max_steps=400)
+    reg.disable()
+    assert len(done) == 6 and fleet.stats()["lost_requests"] == 0
+    return fleet
+
+
+class TestCrossReplicaStitch:
+    def test_migrated_request_is_one_trace(self, tmp_path):
+        fleet = _run_fleet_with_kill(tmp_path)
+        assert fleet.stats()["migrated_requests"] >= 1
+        events = _read_events(tmp_path)
+        spans = [e for e in events if e["kind"] == "span"
+                 and str(e.get("name", "")).startswith("serve/")]
+        flows = [e for e in events if e["kind"] == "trace_flow"]
+        # every per-request span carries identity (decode_chunk is the
+        # engine-row batch span — it covers many requests, so it has
+        # slots, not a single trace_id)
+        assert all(e.get("trace_id") for e in spans
+                   if e["name"] != "serve/decode_chunk")
+        # the migrate flow pair: out on the donor, in on the survivor,
+        # sharing flow_id and trace_id
+        outs = {e["flow_id"]: e for e in flows if e["phase"] == "out"}
+        ins = {e["flow_id"]: e for e in flows if e["phase"] == "in"}
+        paired = set(outs) & set(ins)
+        assert paired, (outs, ins)
+        for fid in paired:
+            assert outs[fid]["trace_id"] == ins[fid]["trace_id"]
+        # the acceptance: at least one trace_id whose spans name BOTH
+        # replicas — donor and survivor stitched into one trace
+        by_trace = {}
+        for e in spans:
+            if e.get("replica") in ("replica0", "replica1"):
+                by_trace.setdefault(e["trace_id"],
+                                    set()).add(e["replica"])
+        stitched = [t for t, reps in by_trace.items() if len(reps) == 2]
+        assert stitched, by_trace
+        # terminal request_done events carry the trace_id too, so logs
+        # join against traces without the span stream
+        done = [e for e in events if e.get("name") == "request_done"]
+        assert done and all(e.get("trace_id") for e in done)
+
+    def test_export_and_critical_path_attribute_migration(
+            self, tmp_path):
+        _run_fleet_with_kill(tmp_path)
+        events = trace_export.load_dir(str(tmp_path))
+        trace = json.loads(json.dumps(
+            trace_export.to_chrome_trace(events)))
+        rows = trace["traceEvents"]
+        by_trace = {}
+        for e in rows:
+            tid = e.get("args", {}).get("trace_id")
+            if e.get("ph") == "X" and tid:
+                by_trace.setdefault(tid, set()).add(e["pid"])
+        assert any(len(p) >= 2 for p in by_trace.values()), \
+            "no trace crosses two process rows in the export"
+        assert [e for e in rows if e.get("ph") == "s"]
+        assert [e for e in rows if e.get("ph") == "f"]
+        records = trace_export.critical_path(events)
+        assert len(records) == 6
+        migrated = [r for r in records if r["migrations"] >= 1]
+        assert migrated
+        for rec in migrated:
+            assert len(rec["replicas"]) == 2
+            assert rec["migrate_ms"] > 0
+            assert rec["total_ms"] >= rec["migrate_ms"]
+
+    def test_scheduler_emits_request_phase_spans(self, tmp_path):
+        """Single-scheduler span tree: queued/prefill/decode/evict
+        phases parent under one serve/request root per request."""
+        reg = MetricsRegistry(enabled=True, jsonl_dir=str(tmp_path))
+        sched = Scheduler(_StubEngine(), registry=reg)
+        sched.run([_req(0), _req(1, arrival=0.1)])
+        reg.disable()
+        spans = [e for e in _read_events(tmp_path)
+                 if e["kind"] == "span"]
+        by_name = {}
+        for e in spans:
+            by_name.setdefault(e["name"], []).append(e)
+        for name in ("serve/queued", "serve/prefill", "serve/decode",
+                     "serve/evict", "serve/request"):
+            assert len(by_name.get(name, [])) == 2, name
+        roots = {e["trace_id"]: e["span_id"]
+                 for e in by_name["serve/request"]}
+        assert len(roots) == 2  # one trace per request
+        for name in ("serve/queued", "serve/prefill", "serve/decode",
+                     "serve/evict"):
+            for e in by_name[name]:
+                assert e["parent_id"] == roots[e["trace_id"]], name
+
+    def test_disabled_fleet_emits_no_ids(self):
+        """Tracing off: the same fleet + kill run mints no trace ids
+        anywhere — the scheduler's per-request trace table stays empty
+        and the run still completes cleanly."""
+        sched = Scheduler(_StubEngine(), registry=MetricsRegistry())
+        assert sched.submit(_req(0))
+        assert sched._tr == {}  # no identity allocated when disabled
+        fleet = ServeFleet(
+            engine_factory=lambda idx, mesh, name: _StubEngine(),
+            config=FleetConfig(num_replicas=2, respawn_delay_ticks=1),
+            registry=MetricsRegistry())
+        with faults.inject_replica_loss(0, 2):
+            for i in range(4):
+                fleet.submit(_req(i))
+            done = fleet.run(max_steps=400)
+        assert len(done) == 4
+        for rep in fleet.replicas:
+            if getattr(rep, "sched", None) is not None:
+                assert rep.sched._tr == {}
+
+
+# ---------------------------------------------------------------------------
+# the report rollup: per-tier TTFT/total latency from the span tree
+# ---------------------------------------------------------------------------
+
+
+class TestReportRollup:
+    def test_per_tier_latency_rollup(self, tmp_path):
+        _run_fleet_with_kill(tmp_path)
+        paths = sorted(str(p) for p in tmp_path.glob("*.jsonl"))
+        report = telemetry_report.aggregate(
+            telemetry_report.load_events(paths))
+        tr = report["traces"]
+        assert tr["requests"] == 6
+        assert tr["flows"] >= 2
+        assert set(tr["by_tier"]) == {"batch", "interactive"}
+        total_migrated = 0
+        for tier in tr["by_tier"].values():
+            assert tier["requests"] == 3
+            for key in ("ttft_p50_ms", "ttft_p99_ms", "total_p50_ms",
+                        "total_p99_ms"):
+                assert tier[key] is not None and tier[key] >= 0
+            assert tier["ttft_p50_ms"] <= tier["ttft_p99_ms"]
+            assert tier["total_p50_ms"] <= tier["total_p99_ms"]
+            assert set(tier["phase_mean_ms"]) >= {"queued", "prefill",
+                                                  "decode"}
+            total_migrated += tier["migrated"]
+        assert total_migrated >= 1
+        # the new kinds are known — nothing lands in the unknown bin
+        assert report["unknown_kinds"] == {}
+        assert report["malformed_events"] == 0
+
+    def test_report_renders_trace_section(self, tmp_path, capsys):
+        _run_fleet_with_kill(tmp_path)
+        paths = sorted(str(p) for p in tmp_path.glob("*.jsonl"))
+        report = telemetry_report.aggregate(
+            telemetry_report.load_events(paths))
+        telemetry_report.print_report(report)
+        out = capsys.readouterr().out
+        assert "request traces (causal span trees)" in out
+        assert "interactive" in out
+        assert "mean phase breakdown" in out

@@ -88,6 +88,13 @@ number ``n`` (old checked-in records stay valid):
   (the flat-cost claim), ``kv_handoff_bytes``,
   ``fallback_reprefills`` and ``fleet_prefix_hit_rate`` — all
   nullable; pre-round-23 records carrying any of them are flagged.
+- ``n >= 24``: ``trace_overhead`` metric lines (causal-tracing tax)
+  must carry ``span_count`` / ``tracing_overhead_pct`` (the
+  enabled-vs-disabled step-time delta), the two leg step times
+  (``untraced_step_ms`` / ``traced_step_ms``) and
+  ``disabled_leg_events`` (must aggregate to 0 — the
+  zero-overhead-off proof) — all nullable; pre-round-24 records
+  carrying any of them are flagged.
 
 Usage::
 
@@ -265,6 +272,19 @@ SERVE_MIGRATE_NUM_FIELDS = (
     "kv_handoff_bytes", "fallback_reprefills",
     "fleet_prefix_hit_rate")
 SERVE_MIGRATE_REQUIRED_FIELDS = SERVE_MIGRATE_NUM_FIELDS
+# the causal-tracing contract (apex_tpu.telemetry.trace, round 24): a
+# trace_overhead metric line must carry the enabled-leg span event
+# count, the on-vs-off per-step overhead, both leg step times, and the
+# disabled-leg event count (0 on a healthy run — the zero-overhead-off
+# contract, measured not assumed) — required-nullable so a host that
+# skipped a leg stays honest; pre-round-24 records carrying any of
+# them are flagged — the fields did not exist
+TRACE_OVERHEAD_FIELDS_SINCE_ROUND = 24
+TRACE_OVERHEAD_METRIC_PREFIX = "trace_overhead"
+TRACE_OVERHEAD_NUM_FIELDS = (
+    "span_count", "tracing_overhead_pct", "untraced_step_ms",
+    "traced_step_ms", "disabled_leg_events")
+TRACE_OVERHEAD_REQUIRED_FIELDS = TRACE_OVERHEAD_NUM_FIELDS
 # the fused computation-collective contract (apex_tpu.kernels
 # .fused_cc, round 21): a fused_cc metric line carries per-family
 # fused-vs-unfused timings plus the traced-jaxpr HBM-intermediate
@@ -645,6 +665,32 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                 elif not (obj[key] is None or _type_ok(obj[key], _NUM)):
                     bad(f"serve_migrate field {key!r} must be numeric "
                         f"or null")
+        is_trace = str(obj.get("metric", "")).startswith(
+            TRACE_OVERHEAD_METRIC_PREFIX)
+        present_tr = [k for k in TRACE_OVERHEAD_NUM_FIELDS if k in obj]
+        if present_tr and (round_n is not None
+                           and round_n
+                           < TRACE_OVERHEAD_FIELDS_SINCE_ROUND):
+            bad(f"trace_overhead fields {present_tr} are only defined "
+                f"from round {TRACE_OVERHEAD_FIELDS_SINCE_ROUND}")
+        elif is_trace and (round_n is None
+                           or round_n
+                           >= TRACE_OVERHEAD_FIELDS_SINCE_ROUND):
+            for key in TRACE_OVERHEAD_NUM_FIELDS:
+                if key not in obj:
+                    bad(f"trace_overhead line missing {key!r} "
+                        f"(required since round "
+                        f"{TRACE_OVERHEAD_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None
+                          or _type_ok(obj[key], _NUM)):
+                    bad(f"trace_overhead field {key!r} must be "
+                        f"numeric or null")
+            if _type_ok(obj.get("disabled_leg_events"), _NUM) \
+                    and obj["disabled_leg_events"] != 0:
+                bad(f"trace_overhead disabled_leg_events = "
+                    f"{obj['disabled_leg_events']} — the disabled "
+                    f"registry recorded events (zero-overhead-off "
+                    f"contract broken)")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

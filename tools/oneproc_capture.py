@@ -868,6 +868,160 @@ def _migrate_smoke(bench):
             "fleet_prefix_hit_rate": st["fleet_prefix_hit_rate"]}
 
 
+def _trace_smoke(bench):
+    """Causal-tracing smoke (round 24): (a) run the ``trace_overhead``
+    bench leg (its in-bench proof obligations: zero events + no ids on
+    the disabled leg, span_count read back from the enabled leg's
+    JSONL) and schema-check the emitted metric line at round 24; (b)
+    drive a 2-replica stub fleet with a mid-stream replica kill under
+    the live sink, then run ``tools/trace_export.py`` over the capture
+    and assert the whole export contract: the trace.json round-trips
+    ``json.loads``, both replica process rows are named, the migrated
+    request is ONE ``trace_id`` whose complete spans cross two pids
+    with a paired migrate flow arrow, and ``critical_path`` attributes
+    its latency with ``migrations >= 1``. Raises on any missing piece
+    so the stage shows up as ERROR rather than silently passing."""
+    import tempfile
+    import types
+
+    import numpy as np
+
+    from apex_tpu import telemetry
+    from apex_tpu.resilience import faults
+    from apex_tpu.serving import FleetConfig, Request, ServeFleet
+
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import bench_schema_check
+    import trace_export
+
+    # (a) the bench leg + round-24 metric-line schema
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        ret = bench.bench_trace_overhead(2, 6)
+    if ret["disabled_leg_events"] != 0:
+        raise RuntimeError(
+            f"trace smoke: {ret['disabled_leg_events']} event(s) on "
+            f"the disabled leg — zero-overhead-off contract broken")
+    if ret["span_count"] < 12:
+        raise RuntimeError(
+            f"trace smoke: enabled leg wrote {ret['span_count']} span "
+            f"event(s) for 6 steps — expected >= 12")
+    metric = None
+    for line in buf.getvalue().splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("metric") == "trace_overhead_step_ms":
+            metric = obj
+    if metric is None:
+        raise RuntimeError("trace smoke: bench_trace_overhead printed "
+                           "no trace_overhead_step_ms metric line")
+    bench_schema_check.check_metric_line(metric, round_n=24,
+                                         where="trace smoke")
+
+    # (b) capture -> export: a 2-replica stub fleet (host-only router
+    # policy, no compiles), one replica killed mid-stream, exported to
+    # Chrome trace format and verified structurally
+    class _StubEngine:
+        def __init__(self):
+            self.config = types.SimpleNamespace(
+                num_slots=4, batch_buckets=(2, 4),
+                prefill_buckets=(64,), eos_token_id=None,
+                pad_token_id=0)
+            self.max_len = 10_000
+            self.decode_retries_total = 0
+            self.compile_count = 6
+            self.spec = types.SimpleNamespace(
+                bytes_per_slot=lambda: 0,
+                cache_dtype_name=lambda: "stub")
+
+        def kv_cache_bytes(self):
+            return 0
+
+        def prefill(self, slot_ids, prompts, *, pad_slot_ids=None):
+            return np.ones(len(prompts), np.int32)
+
+        def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
+                   retries=0, backoff_s=0.0, backoff_cap_s=0.0):
+            return (np.ones(len(slot_ids), np.int32),
+                    np.ones(len(slot_ids), bool))
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_trace_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    reg = telemetry.MetricsRegistry(enabled=True, jsonl_dir=tel_dir)
+    fleet = ServeFleet(
+        engine_factory=lambda idx, mesh, name: _StubEngine(),
+        config=FleetConfig(num_replicas=2, respawn_delay_ticks=1),
+        registry=reg)
+    try:
+        with faults.inject_replica_loss(0, 2):
+            for i in range(6):
+                fleet.submit(Request(
+                    rid=i,
+                    prompt=np.arange(3, dtype=np.int32) % 7,
+                    max_new_tokens=4, arrival=0.0,
+                    tier="interactive" if i % 2 else "batch"))
+            fleet.run(max_steps=400)
+    finally:
+        faults.disarm_replica_loss()
+        reg.disable()
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+
+    events = trace_export.load_dir(tel_dir)
+    trace = trace_export.to_chrome_trace(events)
+    out_path = os.path.join(tel_dir, "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    with open(out_path) as f:
+        trace = json.load(f)  # the round-trip IS part of the contract
+    rows = trace["traceEvents"]
+    names = {e.get("args", {}).get("name") for e in rows
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    for label in ("replica0", "replica1"):
+        if not any(label in str(n) for n in names):
+            raise RuntimeError(
+                f"trace smoke: no process row named for {label} in "
+                f"the exported trace (rows: {sorted(map(str, names))})")
+    flows = [e for e in rows if e.get("ph") in ("s", "f")]
+    if not ([e for e in flows if e["ph"] == "s"]
+            and [e for e in flows if e["ph"] == "f"]):
+        raise RuntimeError("trace smoke: the migrate flow arrow is "
+                           "missing an out/in end")
+    # the migrated request: ONE trace_id whose complete spans cross
+    # two process rows
+    by_trace = {}
+    for e in rows:
+        tid = e.get("args", {}).get("trace_id")
+        if e.get("ph") == "X" and tid:
+            by_trace.setdefault(tid, set()).add(e["pid"])
+    crossing = [t for t, pids in by_trace.items() if len(pids) >= 2]
+    if not crossing:
+        raise RuntimeError(
+            "trace smoke: no trace_id spans two replica process rows "
+            "— donor + survivor spans did not stitch")
+    cp = trace_export.critical_path(events)
+    migrated = [r for r in cp if r["migrations"] >= 1]
+    if not migrated:
+        raise RuntimeError("trace smoke: critical_path attributed no "
+                           "migrated request")
+    if not any(r["migrate_ms"] for r in migrated):
+        raise RuntimeError("trace smoke: the migrated request's "
+                           "critical path has no migrate time")
+    return {"telemetry_dir": tel_dir, "trace_json": out_path,
+            "span_count": ret["span_count"],
+            "tracing_overhead_pct": ret["tracing_overhead_pct"],
+            "stitched_traces": len(crossing),
+            "flow_events": len(flows),
+            "critical_path_requests": len(cp)}
+
+
 def _lint_smoke(bench):
     """Static-analysis smoke (round 14): (a) run a clean DDP config
     under APEX_TPU_HLO_LINT=1 and assert its emitted JSON carries
@@ -1575,6 +1729,7 @@ def _stages(smoke):
             ("spec", None, lambda: _spec_smoke(bench)),
             ("fleet", None, lambda: _fleet_smoke(bench)),
             ("migrate", None, lambda: _migrate_smoke(bench)),
+            ("trace", None, lambda: _trace_smoke(bench)),
             ("recovery", None, lambda: _recovery_smoke(bench)),
             ("lint", None, lambda: _lint_smoke(bench)),
             ("sharding", None, lambda: _sharding_smoke(bench)),
@@ -1685,6 +1840,14 @@ def _stages(smoke):
         # loud fallback with the events in the JSONL
         ("serve_migrate", None, spec("serve_migrate")),
         ("migrate", None, lambda: _migrate_smoke(bench)),
+        # round-24 causal-tracing captures: the trace_overhead config
+        # at bench size (enabled-vs-disabled step delta, span_count,
+        # the asserted zero-events disabled leg) and the smoke proving
+        # the capture -> trace_export -> Perfetto contract — stitched
+        # cross-replica trace_id, paired migrate flow arrow, critical-
+        # path attribution — plus the round-24 metric-line schema
+        ("trace_overhead", None, spec("trace_overhead")),
+        ("trace", None, lambda: _trace_smoke(bench)),
         # round-13 training-recovery captures: the supervised chaos
         # campaign at bench size (restarts / mttr_steps /
         # snapshot_restores / goodput_step_ratio / final_loss_delta in

@@ -41,7 +41,8 @@ KNOWN_KINDS = frozenset({
     "span", "collective", "bench", "summary", "profiler", "xla_cost",
     "guard", "checkpoint", "preemption", "numerics", "amp",
     "compile", "memory", "serve", "recovery", "lint", "overlap",
-    "fleet", "kernel", "pipeline",
+    "fleet", "kernel", "pipeline", "span_begin", "trace_epoch",
+    "trace_flow",
 })
 
 # fleet timeline rows kept per report (replica state transitions +
@@ -55,6 +56,11 @@ _OVERLAP_TIMELINE_CAP = 256
 # 1F1B tick spans kept per report — one schedule's worth
 # (m + 2*pp - 2 ticks) times a few traced steps
 _PIPELINE_TICKS_CAP = 256
+
+# per-request trace rollups kept per report — covers every request of a
+# capture-sized serve run; a production stream past the cap degrades to
+# a truncation count instead of an unbounded dict
+_TRACE_CAP = 512
 
 
 def aggregate(events):
@@ -95,6 +101,8 @@ def aggregate(events):
              "timeline_truncated": 0, "last_report": None,
              "kv_handoffs": 0, "kv_handoff_bytes": 0,
              "kv_fallbacks": {}, "kv_corrupt_injected": 0}
+    traces = {"by_id": {}, "truncated": 0, "flows": 0,
+              "span_begins": 0, "epochs": 0}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -139,6 +147,41 @@ def aggregate(events):
                         })
                     else:
                         pipeline["ticks_truncated"] += 1
+                trace_id = ev.get("trace_id")
+                if trace_id and str(name).startswith("serve/"):
+                    rec = traces["by_id"].get(trace_id)
+                    if rec is None:
+                        if len(traces["by_id"]) >= _TRACE_CAP:
+                            traces["truncated"] += 1
+                        else:
+                            rec = traces["by_id"].setdefault(
+                                str(trace_id), {
+                                    "tier": None, "total_ms": None,
+                                    "phase_ms": {}, "migrations": 0,
+                                    "finish_reason": None})
+                    if rec is not None:
+                        phase = str(name)[len("serve/"):]
+                        if phase == "request":
+                            # a migrated request closes once per
+                            # replica it visited — sum the segments
+                            rec["total_ms"] = \
+                                (rec["total_ms"] or 0.0) + d * 1e3
+                            if ev.get("tier") is not None:
+                                rec["tier"] = ev.get("tier")
+                            rec["finish_reason"] = \
+                                ev.get("finish_reason")
+                        elif phase != "evict":
+                            if phase == "migrate":
+                                rec["migrations"] += 1
+                            rec["phase_ms"][phase] = \
+                                rec["phase_ms"].get(phase, 0.0) \
+                                + d * 1e3
+            elif kind == "trace_flow":
+                traces["flows"] += 1
+            elif kind == "span_begin":
+                traces["span_begins"] += 1
+            elif kind == "trace_epoch":
+                traces["epochs"] += 1
             elif kind == "collective":
                 key = (ev.get("name", "?"), ev.get("dtype", "?"))
                 c = collectives.setdefault(key, {
@@ -443,6 +486,7 @@ def aggregate(events):
         k[path] = max(k[path], int(val))
     return {
         "events": n_events,
+        "traces": _trace_rollup(traces),
         "spans": {name: dict(s, mean_s=(s["total_s"] / s["count"])
                              if s["count"] else None)
                   for name, s in spans.items()},
@@ -468,6 +512,59 @@ def aggregate(events):
         "counters": (last_summary or {}).get("counters", {}),
         "gauges": (last_summary or {}).get("gauges", {}),
         "histograms": (last_summary or {}).get("histograms", {}),
+    }
+
+
+def _percentile(vals, q):
+    """Nearest-rank percentile of a pre-sorted list (None if empty)."""
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+
+def _trace_rollup(traces):
+    """Fold per-trace request records into a per-tier latency table:
+    TTFT (queued + prefill phases) and end-to-end total at p50/p99,
+    plus a mean per-phase breakdown so 'where did the time go' is
+    answerable without opening the Chrome trace."""
+    by_tier = {}
+    for rec in traces["by_id"].values():
+        tier = str(rec["tier"] if rec["tier"] is not None else "?")
+        t = by_tier.setdefault(tier, {
+            "requests": 0, "migrated": 0, "ttft": [], "total": [],
+            "phase_ms": {}})
+        t["requests"] += 1
+        if rec["migrations"]:
+            t["migrated"] += 1
+        ph = rec["phase_ms"]
+        if "queued" in ph or "prefill" in ph:
+            t["ttft"].append(ph.get("queued", 0.0)
+                             + ph.get("prefill", 0.0))
+        if rec["total_ms"] is not None:
+            t["total"].append(rec["total_ms"])
+        for k, v in ph.items():
+            t["phase_ms"][k] = t["phase_ms"].get(k, 0.0) + v
+    rollup = {}
+    for tier, t in sorted(by_tier.items()):
+        ttft = sorted(t["ttft"])
+        total = sorted(t["total"])
+        rollup[tier] = {
+            "requests": t["requests"],
+            "migrated": t["migrated"],
+            "ttft_p50_ms": _percentile(ttft, 0.50),
+            "ttft_p99_ms": _percentile(ttft, 0.99),
+            "total_p50_ms": _percentile(total, 0.50),
+            "total_p99_ms": _percentile(total, 0.99),
+            "phase_mean_ms": {
+                k: v / t["requests"]
+                for k, v in sorted(t["phase_ms"].items())},
+        }
+    return {
+        "requests": len(traces["by_id"]),
+        "truncated": traces["truncated"],
+        "flows": traces["flows"],
+        "span_begins": traces["span_begins"],
+        "by_tier": rollup,
     }
 
 
@@ -717,6 +814,30 @@ def print_report(report, out=None):
             if fleet.get("timeline_truncated"):
                 w(f"    ... {fleet['timeline_truncated']} more row(s) "
                   f"truncated\n")
+    traces = report.get("traces") or {}
+    if traces.get("requests"):
+        def _ms(v):
+            return f"{v:.2f}ms" if v is not None else "-"
+        w("\nrequest traces (causal span trees):\n")
+        w(f"  {traces['requests']} traced request(s), "
+          f"{traces.get('flows', 0)} migration flow event(s)")
+        if traces.get("truncated"):
+            w(f", {traces['truncated']} span(s) past the "
+              f"{_TRACE_CAP}-trace cap dropped")
+        w("\n")
+        w(f"  {'tier':<10} {'reqs':>5} {'migr':>5} {'ttft p50':>10} "
+          f"{'ttft p99':>10} {'total p50':>11} {'total p99':>11}\n")
+        for tier, t in sorted((traces.get("by_tier") or {}).items()):
+            w(f"  {tier:<10} {t['requests']:>5} {t['migrated']:>5} "
+              f"{_ms(t['ttft_p50_ms']):>10} "
+              f"{_ms(t['ttft_p99_ms']):>10} "
+              f"{_ms(t['total_p50_ms']):>11} "
+              f"{_ms(t['total_p99_ms']):>11}\n")
+            phases = t.get("phase_mean_ms") or {}
+            if phases:
+                detail = ", ".join(f"{k} {v:.2f}ms"
+                                   for k, v in phases.items())
+                w(f"    mean phase breakdown: {detail}\n")
     recovery = report.get("recovery") or {}
     if recovery.get("failures") or recovery.get("snapshots") \
             or recovery.get("preempted_exits"):

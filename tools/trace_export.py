@@ -1,0 +1,348 @@
+"""Export rank-aware telemetry JSONL as a Chrome trace (Perfetto-loadable).
+
+Reads every ``telemetry-rank<N>.jsonl`` under a telemetry dir (the
+``APEX_TPU_TELEMETRY_DIR`` sink) and converts span/flow events into
+Chrome trace event format (the JSON the Perfetto UI and
+``chrome://tracing`` load):
+
+- each ``(rank, replica-label)`` pair becomes one **process row**
+  (``pid`` + a ``process_name`` metadata record), so a 2-replica fleet
+  shows two replica rows and the training host a third;
+- each closed span (``kind="span"`` with ``ts``/``duration_s``) becomes
+  a ``ph="X"`` complete event; spans that began (``span_begin``) but
+  never closed become ``ph="i"`` instants — a crash leaves visible
+  evidence, not silence;
+- ``trace_flow`` out/in pairs sharing a ``flow_id`` become ``ph="s"``/
+  ``ph="f"`` flow events — the arrow from a donor replica's migration
+  extract to the survivor's re-dispatch;
+- timestamps are aligned across ranks via each file's ``trace_epoch``
+  header (``epoch_unix`` = the wall clock at that registry's monotonic
+  ``ts == 0``), so two processes' rows share one absolute axis without
+  trusting per-event wall clocks.
+
+``--critical-path`` skips the JSON and prints per-request latency
+attribution instead: for every trace_id with ``serve/*`` spans, where
+its wall time went — queued vs prefill vs decode vs migrate — and which
+replicas it crossed. The slowest requests print first; a request whose
+``queued`` dominates is admission-starved, one whose ``migrate``
+dominates paid a failover.
+
+    python tools/trace_export.py /tmp/tel -o trace.json
+    python tools/trace_export.py /tmp/tel --critical-path
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: span names that are request phases (critical-path buckets); any
+#: other serve/* span in a trace lands in "other"
+PHASES = ("queued", "prefill", "decode", "migrate")
+
+
+def load_events(path):
+    """Parse one rank's JSONL into absolute-time event dicts.
+
+    Returns ``(rank, events)`` where every event gains ``_abs`` — its
+    absolute time in SECONDS (unix epoch) — from the most recent
+    ``trace_epoch`` header above it (multiple registries appending to
+    one file each re-anchor the clock). Files from before the epoch
+    discipline (no ``ts``) fall back to the wall-clock ``t`` field.
+    Unparseable lines are skipped, not fatal: a crashed process tears
+    its last line."""
+    base = os.path.basename(path)
+    rank = 0
+    if "rank" in base:
+        digits = "".join(c for c in base.split("rank", 1)[1]
+                         if c.isdigit())
+        rank = int(digits) if digits else 0
+    events = []
+    epoch = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if e.get("kind") == "trace_epoch":
+                epoch = float(e.get("epoch_unix", 0.0))
+                continue
+            ts = e.get("ts")
+            if ts is not None and epoch is not None:
+                e["_abs"] = epoch + float(ts)
+            elif e.get("t") is not None:
+                e["_abs"] = float(e["t"])
+            else:
+                continue
+            e["_rank"] = rank
+            events.append(e)
+    return rank, events
+
+
+def load_dir(telemetry_dir):
+    paths = sorted(glob.glob(os.path.join(telemetry_dir, "*.jsonl")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no .jsonl files under {telemetry_dir!r} — is this an "
+            f"APEX_TPU_TELEMETRY_DIR sink?")
+    events = []
+    for p in paths:
+        events.extend(load_events(p)[1])
+    return events
+
+
+class _Rows:
+    """Stable pid/tid assignment. One pid per (rank, replica-label);
+    within a pid, one tid per lane key (the request rid for serve
+    spans, the span-name family otherwise)."""
+
+    def __init__(self):
+        self.pids = {}
+        self.tids = {}
+        self.meta = []
+
+    def pid(self, rank, label):
+        key = (rank, label or "host")
+        if key not in self.pids:
+            self.pids[key] = len(self.pids) + 1
+            self.meta.append({
+                "ph": "M", "name": "process_name",
+                "pid": self.pids[key], "tid": 0,
+                "args": {"name": f"rank{key[0]}/{key[1]}"}})
+        return self.pids[key]
+
+    def tid(self, pid, lane):
+        key = (pid, str(lane))
+        if key not in self.tids:
+            self.tids[key] = len([k for k in self.tids
+                                  if k[0] == pid]) + 1
+            self.meta.append({
+                "ph": "M", "name": "thread_name",
+                "pid": pid, "tid": self.tids[key],
+                "args": {"name": str(lane)}})
+        return self.tids[key]
+
+
+def _lane(e):
+    """Thread key within a process row: serve spans lane per request
+    (rid), everything else per span-name family."""
+    if e.get("rid") is not None:
+        return f"rid{e['rid']}"
+    return str(e.get("name", "span")).split("/")[0].split("_")[0]
+
+
+def _args(e):
+    drop = {"t", "ts", "kind", "name", "_abs", "_rank", "duration_s"}
+    return {k: v for k, v in e.items()
+            if k not in drop and v is not None}
+
+
+def to_chrome_trace(events, *, origin=None):
+    """Convert parsed events to the Chrome trace-event JSON object.
+
+    ``origin`` (unix seconds) rebases timestamps so ``ts`` stays in
+    comfortable µs magnitudes; defaults to the earliest event."""
+    spans = [e for e in events if e.get("kind") == "span"]
+    begins = [e for e in events if e.get("kind") == "span_begin"]
+    flows = [e for e in events if e.get("kind") == "trace_flow"]
+    if origin is None:
+        origin = min((e["_abs"] for e in spans + begins + flows),
+                     default=0.0)
+
+    def us(abs_s):
+        return max(0.0, round((abs_s - origin) * 1e6, 3))
+
+    rows = _Rows()
+    out = []
+    closed = {e.get("span_id") for e in spans if e.get("span_id")}
+    for e in spans:
+        dur_s = float(e.get("duration_s") or 0.0)
+        pid = rows.pid(e["_rank"], e.get("replica"))
+        rec = {
+            "name": e.get("name", "span"), "ph": "X", "cat": "span",
+            "ts": us(e["_abs"] - dur_s),
+            "dur": max(0.0, round(dur_s * 1e6, 3)),
+            "pid": pid, "tid": rows.tid(pid, _lane(e)),
+            "args": _args(e),
+        }
+        if e.get("trace_id"):
+            rec["args"]["trace_id"] = e["trace_id"]
+        out.append(rec)
+    for e in begins:
+        if e.get("span_id") in closed:
+            continue            # its "span" end event already drew it
+        pid = rows.pid(e["_rank"], e.get("replica"))
+        out.append({
+            "name": f"{e.get('name', 'span')} (unclosed)", "ph": "i",
+            "cat": "span", "s": "t", "ts": us(e["_abs"]),
+            "pid": pid, "tid": rows.tid(pid, _lane(e)),
+            "args": _args(e)})
+    # flow pairs: the "s" record must start strictly before the "f"
+    # binds; pair by flow_id and keep only complete out->in pairs
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e.get("flow_id"), {})[e.get("phase")] = e
+    flow_seq = 0
+    for fid in sorted(k for k in by_id if k is not None):
+        pair = by_id[fid]
+        src, dst = pair.get("out"), pair.get("in")
+        if src is None or dst is None:
+            continue
+        flow_seq += 1
+        for ph, e in (("s", src), ("f", dst)):
+            label = e.get("label") or (
+                f"replica{e['replica']}" if e.get("replica") is not None
+                else None)
+            pid = rows.pid(e["_rank"], label)
+            rec = {
+                "name": e.get("name", "flow"), "ph": ph, "cat": "flow",
+                "id": flow_seq, "ts": us(e["_abs"]),
+                "pid": pid, "tid": rows.tid(pid, _lane(e)),
+                "args": _args(e)}
+            if ph == "f":
+                rec["bp"] = "e"
+                # a zero-width pair confuses the renderer; nudge the
+                # finish ahead of the start by 1us if they collide
+                rec["ts"] = max(rec["ts"], us(src["_abs"]) + 1.0)
+            out.append(rec)
+    return {"traceEvents": rows.meta + out, "displayTimeUnit": "ms",
+            "otherData": {"origin_unix_s": origin,
+                          "exporter": "apex_tpu trace_export"}}
+
+
+def critical_path(events):
+    """Per-request latency attribution from the span tree.
+
+    Returns one record per trace_id that carries ``serve/*`` spans:
+    total wall (first span start -> last span end), per-phase sums
+    (``queued``/``prefill``/``decode``/``migrate``; everything else in
+    ``other``), the replicas crossed, and the tier — slowest first."""
+    traces = {}
+    for e in events:
+        if e.get("kind") != "span" or not e.get("trace_id"):
+            continue
+        name = str(e.get("name", ""))
+        if not name.startswith("serve/"):
+            continue
+        tr = traces.setdefault(e["trace_id"], {
+            "trace_id": e["trace_id"], "rid": e.get("rid"),
+            "tier": None, "replicas": set(), "migrations": 0,
+            "t0": None, "t1": None,
+            "phases": {p: 0.0 for p in PHASES}, "other": 0.0})
+        dur = float(e.get("duration_s") or 0.0)
+        start, end = e["_abs"] - dur, e["_abs"]
+        if e.get("replica"):
+            tr["replicas"].add(str(e["replica"]))
+        phase = name.split("/", 1)[1]
+        if phase == "request":
+            tr["t0"] = start if tr["t0"] is None else min(tr["t0"], start)
+            tr["t1"] = end if tr["t1"] is None else max(tr["t1"], end)
+            if e.get("tier"):
+                tr["tier"] = e["tier"]
+            if e.get("rid") is not None:
+                tr["rid"] = e["rid"]
+        elif phase in tr["phases"]:
+            tr["phases"][phase] += dur
+            if phase == "migrate":
+                tr["migrations"] += 1
+        elif phase != "evict":
+            tr["other"] += dur
+    out = []
+    for tr in traces.values():
+        if tr["t0"] is None:
+            continue
+        total = tr["t1"] - tr["t0"]
+        accounted = sum(tr["phases"].values()) + tr["other"]
+        rec = {
+            "trace_id": tr["trace_id"], "rid": tr["rid"],
+            "tier": tr["tier"],
+            "replicas": sorted(tr["replicas"]),
+            "migrations": tr["migrations"],
+            "total_ms": round(total * 1e3, 3),
+            "unattributed_ms": round(max(0.0, total - accounted) * 1e3,
+                                     3),
+        }
+        for p in PHASES:
+            rec[f"{p}_ms"] = round(tr["phases"][p] * 1e3, 3)
+        rec["other_ms"] = round(tr["other"] * 1e3, 3)
+        out.append(rec)
+    out.sort(key=lambda r: -r["total_ms"])
+    return out
+
+
+def print_critical_path(records, stream=None, top=20):
+    # resolve sys.stdout at CALL time — a def-time default would pin
+    # whatever stdout object was installed at first import (observed:
+    # a pytest capture file from another test)
+    w = (stream if stream is not None else sys.stdout).write
+    if not records:
+        w("no request traces found (were serve spans enabled?)\n")
+        return
+    cols = ("rid", "tier", "total_ms", "queued_ms", "prefill_ms",
+            "decode_ms", "migrate_ms", "other_ms", "migrations",
+            "replicas")
+    w("request critical path (slowest first; phase = sum of that "
+      "phase's spans)\n")
+    w("  " + "  ".join(f"{c:>10}" for c in cols) + "  trace_id\n")
+    for r in records[:top]:
+        vals = []
+        for c in cols:
+            v = r[c]
+            if isinstance(v, list):
+                v = "+".join(x.replace("replica", "r") for x in v)
+            elif v is None:
+                v = "-"
+            vals.append(f"{v:>10}")
+        w("  " + "  ".join(vals) + f"  {r['trace_id']}\n")
+    if len(records) > top:
+        w(f"  ... {len(records) - top} more\n")
+    n = len(records)
+    agg = {c: sum(r[c] for r in records) / n
+           for c in ("total_ms", "queued_ms", "prefill_ms",
+                     "decode_ms", "migrate_ms")}
+    w(f"  mean over {n} request(s): "
+      + "  ".join(f"{k}={v:.3f}" for k, v in agg.items()) + "\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="telemetry JSONL -> Chrome trace / request "
+                    "critical-path attribution")
+    ap.add_argument("telemetry_dir",
+                    help="APEX_TPU_TELEMETRY_DIR sink directory")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output path (default: <dir>/trace.json)")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="print per-request latency attribution "
+                         "instead of writing the trace JSON")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows to print in --critical-path mode")
+    args = ap.parse_args(argv)
+
+    events = load_dir(args.telemetry_dir)
+    if args.critical_path:
+        print_critical_path(critical_path(events), top=args.top)
+        return 0
+    trace = to_chrome_trace(events)
+    out_path = args.output or os.path.join(args.telemetry_dir,
+                                           "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    n_spans = sum(1 for e in trace["traceEvents"]
+                  if e.get("ph") in ("X", "i"))
+    n_flows = sum(1 for e in trace["traceEvents"] if e.get("ph") == "s")
+    n_rows = sum(1 for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name")
+    print(f"wrote {out_path}: {n_spans} span(s), {n_flows} flow "
+          f"arrow(s), {n_rows} process row(s) — load it at "
+          f"https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
