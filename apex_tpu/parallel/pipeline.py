@@ -79,6 +79,7 @@ at module scope — only jax/numpy and telemetry. All substrate imports
 function-local.
 """
 
+import time
 import warnings
 from typing import Callable, Optional
 
@@ -833,7 +834,7 @@ def make_batch_3d(mesh, *, microbatches, batch_per_replica=2, seq=16,
 def build_pipeline_step(mesh, seg_params, *, hidden, heads, microbatches,
                         mode="overlapped", compress="int8", lr=0.05,
                         fold_average=True, message_size=10000000,
-                        guard_nan=None, donate=True):
+                        guard_nan=None, straggler=None, donate=True):
     """One jitted 3-D ``(data, model, pipe)`` train step.
 
     The schedule is the same 1F1B tick math as the reference machine
@@ -860,6 +861,15 @@ def build_pipeline_step(mesh, seg_params, *, hidden, heads, microbatches,
     labels) -> (blocks, edge, res, gst, loss)``. ``guard_nan=(step,
     stage, microbatch)`` arms ``faults.inject_nan`` at that exact
     schedule unit's stage input.
+
+    ``straggler=(stage, delay_s)`` is the trace-time straggler fault
+    for the online attribution acceptance
+    (``telemetry.attribution``): every tick in which ``stage`` has a
+    forward or backward unit sleeps ``delay_s`` host seconds inside
+    its ``pp_tick_<t>`` span. The sleep happens while the schedule is
+    being *traced* — the compiled program is unchanged — so the
+    measured span deltas carry a genuine per-stage slowdown that the
+    exposure-difference estimator must name.
 
     Returns ``(jitted_step, state)`` where ``state`` is the placed
     carry tuple (blocks, edge, residual[, guard state]).
@@ -986,6 +996,11 @@ def build_pipeline_step(mesh, seg_params, *, hidden, heads, microbatches,
             with _telemetry_trace.span(
                     f"pp_tick_{t}", role="tick", phase=tk["phase"],
                     tick=t, fwd=tk["fwd"], bwd=tk["bwd"]):
+                if straggler is not None:
+                    s_stage, s_delay = straggler
+                    if any(u[0] == s_stage
+                           for u in tk["fwd"] + tk["bwd"]):
+                        time.sleep(float(s_delay))
                 if t < w + s:  # ------------------------ forward half
                     if pp > 1 and t >= 1:
                         # tick 0's upstream is an all-zeros constant:

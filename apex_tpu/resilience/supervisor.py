@@ -615,6 +615,13 @@ class Supervisor:
             self.state = new_state
             self.ledger.record_apply(self.step)
             self.step += 1
+            reg = self._reg()
+            if reg.enabled and self.dispatches:
+                # keep the goodput ratio live (not just end-of-run) so
+                # the monitor's goodput-drop rule sees it in-window
+                reg.gauge("recovery/goodput_step_ratio").set(
+                    (self.step - self.ledger.start_step)
+                    / self.dispatches)
         report = self._report(exit_reason)
         reg = self._reg()
         if reg.enabled:
@@ -657,6 +664,13 @@ class Supervisor:
             self.policies[FailureClass.UNKNOWN]
         self._count("recovery/restarts")
         self._count(f"recovery/cause/{cls}")
+        reg = self._reg()
+        if reg.enabled:
+            # live-monitor feed: 1 from failure until the recovery
+            # lands (a gave_up raise leaves it raised — correctly: the
+            # run is down). telemetry.monitor escalates the failure
+            # event to an alert and resolves it off this gauge.
+            reg.gauge("recovery/in_recovery").set(1)
         self._event("failure", cls=cls, step=self.step,
                     action=policy.action,
                     error=f"{type(exc).__name__}: {str(exc)[:300]}")
@@ -773,3 +787,6 @@ class Supervisor:
             self._event("recovered", cls=cls, action="mesh_shrink",
                         resume_step=snap.step, steps_lost=lost,
                         world=_world_json(new_world), attempt=attempt)
+        reg = self._reg()
+        if reg.enabled:
+            reg.gauge("recovery/in_recovery").set(0)

@@ -150,6 +150,11 @@ class FleetConfig:
     scale_up_pending: Optional[int] = None
     scale_down_pending: Optional[int] = None
     scale_sustain_ticks: int = 3
+    # live-monitoring feed: emit a ``fleet``/``health`` event (the
+    # :meth:`ServeFleet.health_snapshot` dict) every N ticks; 0 = off
+    # (the default — offline JSONL volume is unchanged unless a
+    # monitor/dashboard opts in)
+    health_event_every: int = 0
     data_axis: str = "data"
     # tensor-parallel width per replica: each replica becomes a
     # (data=1, tp=m) mesh slice, so a model too big for one DP slice
@@ -1033,6 +1038,31 @@ class ServeFleet:
     def _serving_count(self):
         return sum(1 for rep in self.replicas if rep.serving())
 
+    def _expected_count(self):
+        """Replicas that *should* be serving right now: everything but
+        empty slots and deliberate retirements. ``expected - serving``
+        is therefore the count of replicas currently lost to faults —
+        the live monitor's replica-health signal (a
+        ``fleet/replicas_serving < fleet/replicas_expected`` window
+        breach), and it self-resolves on respawn without the monitor
+        knowing the fleet's scale policy."""
+        return sum(1 for rep in self.replicas
+                   if rep.state not in ("idle", "retiring"))
+
+    def health_snapshot(self):
+        """Point-in-time fleet health view (host-side, registry-free) —
+        the feed ``telemetry.monitor`` and ``tools/monitor_dash.py``
+        render: queue depth, serving/expected counts, the per-replica
+        state table, and the per-tier SLO rollup."""
+        return {
+            "tick": self.tick,
+            "pending": self.pending_depth(),
+            "serving": self._serving_count(),
+            "expected": self._expected_count(),
+            "replicas": [rep.table_row() for rep in self.replicas],
+            "tiers": self._tier_rollup(),
+        }
+
     def _autoscale(self):
         cfg = self.config
         depth = self.pending_depth()
@@ -1145,6 +1175,10 @@ class ServeFleet:
         reg = self._reg()
         reg.gauge("fleet/pending_depth").set(self.pending_depth())
         reg.gauge("fleet/replicas_serving").set(self._serving_count())
+        reg.gauge("fleet/replicas_expected").set(self._expected_count())
+        every = self.config.health_event_every
+        if every and self.step_count % every == 0 and reg.enabled:
+            reg.event("fleet", "health", **self.health_snapshot())
         self.tick += 1.0
         self.step_count += 1
 

@@ -27,6 +27,18 @@ The observability layer under the parallel/optimizer/bench stack:
   a device-side ring buffer of the last K steps' stats, fetched once
   for a ``numerics-postmortem-rank<N>.json`` when the resilience guard
   trips.
+- :mod:`monitor`   — the live control plane
+  (:class:`~apex_tpu.telemetry.monitor.Monitor`): rolling windows over
+  registry snapshots + tailed cross-rank JSONL, a declarative
+  :class:`~apex_tpu.telemetry.monitor.AlertRule` table with
+  firing/resolved ``alert`` events, OpenMetrics exposition
+  (:func:`~apex_tpu.telemetry.monitor.render_openmetrics`, scrape
+  endpoint gated by ``APEX_TPU_MONITOR_PORT``), and the
+  ``tools/monitor_dash.py`` terminal view.
+- :mod:`attribution` — online 3-D-mesh attribution
+  (:class:`~apex_tpu.telemetry.attribution.PipelineAttributor`):
+  exposure-difference straggler detection over ``pp_tick_<t>`` spans,
+  measured vs analytic bubble fraction, per-axis exposed-comm split.
 - :mod:`compile_watch` — trace/compile accounting per jitted function
   (:class:`~apex_tpu.telemetry.compile_watch.CompileWatcher`):
   ``compile`` events that name exactly which argument changed on a
@@ -79,10 +91,21 @@ from apex_tpu.telemetry import memory  # noqa: F401
 from apex_tpu.telemetry import numerics  # noqa: F401
 from apex_tpu.telemetry import recorder  # noqa: F401
 from apex_tpu.telemetry import xla_cost  # noqa: F401
+from apex_tpu.telemetry.attribution import (  # noqa: F401
+    PipelineAttributor,
+)
 from apex_tpu.telemetry.compile_watch import (  # noqa: F401
     CompileWatcher,
     RecompileError,
     assert_no_recompiles,
+)
+from apex_tpu.telemetry.monitor import (  # noqa: F401
+    AlertRule,
+    JsonlTailer,
+    Monitor,
+    default_rules,
+    parse_openmetrics,
+    render_openmetrics,
 )
 from apex_tpu.telemetry.memory import (  # noqa: F401
     HBMExhaustedError,

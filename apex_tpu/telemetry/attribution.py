@@ -1,0 +1,205 @@
+"""Online straggler / bubble / exposed-comm attribution for the 3-D mesh.
+
+The pipeline step (``parallel/pipeline.py``) is host-unrolled: every
+1F1B tick runs under a ``pp_tick_<t>`` span stamped with the tick's
+schedule entry — ``phase`` and the ``fwd``/``bwd`` ``[rank,
+microbatch]`` unit lists. Those spans are the only per-tick timing the
+stack emits, and they cover *all* stages of a tick at once (one SPMD
+program), so a per-stage time cannot be read off directly. This module
+recovers it online with an **exposure-difference estimator**:
+
+    for stage r:  delta(r) = mean(tick duration | r active)
+                           - mean(tick duration | r inactive)
+
+Identifiability comes from the 1F1B ramp itself: warmup ticks run
+without the late stages and cooldown ticks without the early ones, so
+every stage has both exposed and unexposed ticks (except at ``pp == 1``
+or when too few ticks were seen — then the estimator abstains rather
+than guess). A stage whose work is slow lengthens exactly the ticks it
+appears in, so its delta stands out; :meth:`PipelineAttributor.report`
+names the stage with the largest delta once it clears both a relative
+and an absolute floor.
+
+The same span stream yields two more online fractions:
+
+- **measured bubble fraction** — duration-weighted idle stage-slots,
+  ``sum(dur_t * idle_stages_t) / (pp * sum(dur_t))``; its analytic
+  counterpart is ``(pp-1)/(m+pp-1)``
+  (:func:`~apex_tpu.parallel.pipeline.analytic_bubble_fraction`).
+- **per-axis comm exposure** — ``ddp_overlap_bucket_<n>`` spans are the
+  ``data``-axis gradient collectives; a span carrying ``bubble=True``
+  was traced into the cooldown bubble region (overlappable, counted
+  *hidden*), one without rides the critical path (counted *exposed*).
+  ``pipe``-axis exposure is the bubble fraction itself — idle stage
+  slots are exactly where pipe transfers are not hidden by compute.
+
+Like every telemetry reader, the attributor consumes plain event
+*records* (the dicts the registry taps/sinks carry) — it works
+identically fed live from a :class:`~apex_tpu.telemetry.monitor
+.Monitor` tap or offline from parsed JSONL lines, and it never touches
+compiled programs.
+"""
+
+import collections
+
+_TICK_PREFIX = "pp_tick_"
+_BUCKET_PREFIX = "ddp_overlap_bucket_"
+
+
+def _units(rec, key):
+    """The ``[rank, microbatch]`` unit list of a tick record, tolerant
+    of JSON round-trips (lists) and live records (lists of lists)."""
+    out = []
+    for u in rec.get(key) or ():
+        try:
+            out.append((int(u[0]), int(u[1])))
+        except (TypeError, ValueError, IndexError):
+            continue
+    return out
+
+
+class PipelineAttributor:
+    """Streaming consumer of ``pp_tick_<t>`` / ``ddp_overlap_bucket_<n>``
+    span records; :meth:`report` computes the attribution on demand.
+
+    Bounded state: the last ``max_ticks`` tick observations (a repeated
+    step re-traces nothing — ticks fire at trace time — so the window
+    covers every tick of the latest compilation and then some).
+    """
+
+    def __init__(self, *, max_ticks=4096):
+        self._ticks = collections.deque(maxlen=max_ticks)
+        self._pp = 0
+        self._microbatches = 0
+        self._comm = {"hidden_s": 0.0, "exposed_s": 0.0,
+                      "hidden_n": 0, "exposed_n": 0}
+
+    # -- intake -------------------------------------------------------------
+
+    def add_span(self, rec):
+        """Feed one ``span`` event record; non-matching spans are
+        ignored, so the whole event stream can be piped through.
+        Returns True iff the record was consumed."""
+        if rec.get("kind") != "span":
+            return False
+        name = rec.get("name", "")
+        if name.startswith(_TICK_PREFIX):
+            return self._add_tick(rec)
+        if name.startswith(_BUCKET_PREFIX):
+            return self._add_bucket(rec)
+        return False
+
+    def _add_tick(self, rec):
+        try:
+            dur = float(rec["duration_s"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        fwd = _units(rec, "fwd")
+        bwd = _units(rec, "bwd")
+        active = {r for r, _ in fwd} | {r for r, _ in bwd}
+        for r in active:
+            self._pp = max(self._pp, r + 1)
+        for _, mb in fwd + bwd:
+            self._microbatches = max(self._microbatches, mb + 1)
+        self._ticks.append((dur, frozenset(active),
+                            rec.get("phase", "")))
+        return True
+
+    def _add_bucket(self, rec):
+        try:
+            dur = float(rec["duration_s"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        if rec.get("bubble"):
+            self._comm["hidden_s"] += dur
+            self._comm["hidden_n"] += 1
+        else:
+            self._comm["exposed_s"] += dur
+            self._comm["exposed_n"] += 1
+        return True
+
+    # -- reporting ----------------------------------------------------------
+
+    @property
+    def ticks_seen(self):
+        return len(self._ticks)
+
+    def report(self, *, rel_threshold=0.5, min_delta_s=0.001):
+        """The attribution snapshot.
+
+        ``straggler`` is the stage with the largest exposure delta,
+        or None when no stage clears ``max(rel_threshold *
+        mean_inactive, min_delta_s)`` with at least one tick on each
+        side of the split (the abstain case: uniform load, pp == 1, or
+        not enough ticks yet).
+        """
+        pp = self._pp
+        ticks = list(self._ticks)
+        per_stage = []
+        straggler = None
+        best_delta = 0.0
+        for r in range(pp):
+            act = [d for d, a, _ in ticks if r in a]
+            inact = [d for d, a, _ in ticks if r not in a]
+            mean_a = sum(act) / len(act) if act else None
+            mean_i = sum(inact) / len(inact) if inact else None
+            delta = (mean_a - mean_i
+                     if mean_a is not None and mean_i is not None
+                     else None)
+            per_stage.append({
+                "stage": r,
+                "active_ticks": len(act),
+                "inactive_ticks": len(inact),
+                "mean_active_s": mean_a,
+                "mean_inactive_s": mean_i,
+                "delta_s": delta,
+            })
+            if delta is None:
+                continue
+            floor = max(rel_threshold * mean_i, min_delta_s)
+            if delta > floor and delta > best_delta:
+                best_delta = delta
+                straggler = r
+
+        total_s = sum(d for d, _, _ in ticks)
+        idle_weighted = sum(d * (pp - len(a)) for d, a, _ in ticks)
+        bubble_measured = (idle_weighted / (pp * total_s)
+                          if pp and total_s > 0 else None)
+        bubble_analytic = None
+        if pp > 0 and self._microbatches > 0:
+            bubble_analytic = ((pp - 1)
+                               / float(self._microbatches + pp - 1))
+
+        comm = self._comm
+        data_total = comm["hidden_s"] + comm["exposed_s"]
+        axes = {
+            "data": {
+                "hidden_s": comm["hidden_s"],
+                "exposed_s": comm["exposed_s"],
+                "exposed_fraction": (comm["exposed_s"] / data_total
+                                     if data_total > 0 else None),
+                "buckets": comm["hidden_n"] + comm["exposed_n"],
+            },
+            "pipe": {
+                "exposed_fraction": bubble_measured,
+            },
+        }
+        return {
+            "pp": pp,
+            "microbatches": self._microbatches,
+            "ticks": len(ticks),
+            "per_stage": per_stage,
+            "straggler": straggler,
+            "straggler_delta_s": best_delta if straggler is not None
+            else None,
+            "bubble_fraction_measured": bubble_measured,
+            "bubble_fraction_analytic": bubble_analytic,
+            "comm_exposure": axes,
+        }
+
+    def reset(self):
+        self._ticks.clear()
+        self._pp = 0
+        self._microbatches = 0
+        self._comm = {"hidden_s": 0.0, "exposed_s": 0.0,
+                      "hidden_n": 0, "exposed_n": 0}

@@ -67,6 +67,12 @@ class Counter:
         with self._lock:
             self.value += float(amount)
 
+    def read(self):
+        """Locked read — pairs with :meth:`inc` so a snapshot never
+        observes a torn update."""
+        with self._lock:
+            return self.value
+
 
 class Gauge:
     """Last-value-wins instrument."""
@@ -117,11 +123,8 @@ class Histogram:
             self.last = value
             self._samples.append(value)
 
-    def percentile(self, q):
-        """Linear-interpolated percentile (``q`` in [0, 100]) over the
-        reservoir window; None before the first observation."""
-        with self._lock:
-            samples = sorted(self._samples)
+    @staticmethod
+    def _interp(samples, q):
         if not samples:
             return None
         if len(samples) == 1:
@@ -131,16 +134,31 @@ class Histogram:
         hi = min(lo + 1, len(samples) - 1)
         return samples[lo] + (samples[hi] - samples[lo]) * (pos - lo)
 
+    def percentile(self, q):
+        """Linear-interpolated percentile (``q`` in [0, 100]) over the
+        reservoir window; None before the first observation."""
+        with self._lock:
+            samples = sorted(self._samples)
+        return self._interp(samples, q)
+
     def summary(self):
+        """Consistent summary: every field — aggregates AND the
+        percentile pair — is read under ONE lock acquisition, so
+        ``count`` always agrees with the reservoir it was taken with
+        (the torn read the old piecemeal version allowed)."""
+        with self._lock:
+            count, total = self.count, self.total
+            mn, mx, last = self.min, self.max, self.last
+            samples = sorted(self._samples)
         return {
-            "count": self.count,
-            "total": self.total,
-            "mean": (self.total / self.count) if self.count else None,
-            "min": self.min,
-            "max": self.max,
-            "last": self.last,
-            "p50": self.percentile(50),
-            "p99": self.percentile(99),
+            "count": count,
+            "total": total,
+            "mean": (total / count) if count else None,
+            "min": mn,
+            "max": mx,
+            "last": last,
+            "p50": self._interp(samples, 50),
+            "p99": self._interp(samples, 99),
         }
 
 
@@ -186,6 +204,10 @@ class MetricsRegistry:
         self._sink = None
         self._rank0_only = (os.environ.get(ENV_RANK0_ONLY) == "1"
                             if rank0_only is None else bool(rank0_only))
+        # In-process event taps (the live monitor's intake). A tuple so
+        # event() can iterate a consistent view without holding the
+        # lock; mutation replaces the tuple under the lock.
+        self._taps = ()
         # Sampled back-to-back so epoch_unix ~= the wall clock at ts=0;
         # residual skew is one statement, not an NTP step.
         self._perf_origin = time.perf_counter()
@@ -269,16 +291,45 @@ class MetricsRegistry:
 
     # -- events -------------------------------------------------------------
 
+    def add_event_tap(self, fn):
+        """Register an in-process event consumer: ``fn(record)`` is
+        called synchronously for every event an *enabled* registry
+        emits, before (and regardless of) the JSONL write — the live
+        monitor's intake. Taps see events even when no sink dir is
+        configured and are NOT gated by rank0-only mode (that gates the
+        on-disk/logging paths; a tap is this process watching itself).
+        Taps must treat the record as read-only. Returns ``fn``."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps = self._taps + (fn,)
+        return fn
+
+    def remove_event_tap(self, fn):
+        with self._lock:
+            self._taps = tuple(t for t in self._taps if t is not fn)
+
     def event(self, kind, name, **fields):
-        """Append one structured event to the JSONL sink (no-op unless
-        enabled AND a sink dir is configured AND this rank may write)."""
-        if not self._enabled or self._jsonl_dir is None:
+        """Dispatch one structured event: to every registered tap, and
+        appended to the JSONL sink (when a sink dir is configured and
+        this rank may write). No-op — and no record is even built —
+        unless enabled and at least one consumer exists."""
+        if not self._enabled:
             return
-        if self._rank0_only and _process_index() != 0:
+        taps = self._taps
+        sink_ok = (self._jsonl_dir is not None
+                   and not (self._rank0_only and _process_index() != 0))
+        if not taps and not sink_ok:
             return
         rec = {"t": round(time.time(), 6), "ts": round(self.now(), 9),
                "kind": kind, "name": name}
         rec.update(fields)  # an explicit ts= overrides the stamp
+        for tap in taps:
+            try:
+                tap(rec)
+            except Exception:
+                pass  # a broken monitor must never take down training
+        if not sink_ok:
+            return
         line = json.dumps(rec, default=str)
         with self._lock:
             sink = self._open_sink_locked()
@@ -322,14 +373,28 @@ class MetricsRegistry:
     # -- snapshots ----------------------------------------------------------
 
     def snapshot(self):
-        """Plain-dict view of every instrument (JSON-serializable)."""
+        """Atomic plain-dict view of every instrument
+        (JSON-serializable) — the monitor's read primitive.
+
+        Consistency contract: the instrument *set* is frozen under the
+        registry lock (no instrument appears or vanishes mid-walk), and
+        each instrument is then read through its own locked read path
+        (``Counter.read``, ``Histogram.summary`` — one lock acquisition
+        per instrument, so no summary is ever internally torn between
+        its aggregate fields and its percentile reservoir). ``ts`` is
+        the registry-monotonic stamp of the snapshot itself, so two
+        snapshots bound a well-defined rate window."""
         with self._lock:
-            return {
-                "counters": {k: c.value for k, c in self._counters.items()},
-                "gauges": {k: g.value for k, g in self._gauges.items()},
-                "histograms": {k: h.summary()
-                               for k, h in self._histograms.items()},
-            }
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            histograms = list(self._histograms.items())
+            ts = self.now()
+        return {
+            "ts": round(ts, 9),
+            "counters": {k: c.read() for k, c in counters},
+            "gauges": {k: g.value for k, g in gauges},
+            "histograms": {k: h.summary() for k, h in histograms},
+        }
 
     def flush(self):
         """Write one ``kind="summary"`` event carrying the full

@@ -78,6 +78,17 @@
                                            flight-recorder ring; emits
                                            numerics_overhead_pct vs the
                                            numerics-off step
+    python bench.py monitor_overhead [reqs] [len]  live-monitoring tax:
+                                           the fleet chaos leg run
+                                           unmonitored (disabled
+                                           registry — asserts ZERO
+                                           monitor/alert events) vs
+                                           monitored (stock rule table
+                                           tapped in, 20 ms poll loop);
+                                           emits monitor_overhead_pct /
+                                           alerts_fired /
+                                           alerts_firing_final /
+                                           disabled_leg_monitor_events
     python bench.py ddp_memwatch [batch] [steps]  guarded DDP step under
                                            the compile watcher + HBM
                                            accounting (+ optional
@@ -3642,6 +3653,135 @@ def bench_trace_overhead(batch, steps, *, hidden=128, layers=2,
     return ret
 
 
+def bench_monitor_overhead(requests, steps):
+    """Live-monitoring tax (round-25 contract): the SAME fleet chaos
+    leg (2 replicas, ``inject_replica_loss`` killing replica 0
+    mid-trace) run twice:
+
+    - **unmonitored**: a fresh DISABLED registry — the library
+      default. A :class:`~apex_tpu.telemetry.monitor.Monitor` is still
+      constructed against it to prove the zero-overhead-off contract
+      head-on: it must come up inert (``enabled`` False, ``poll()``
+      -> None) and the registry's ``event`` — shimmed with a counter —
+      must see ZERO ``monitor``/``alert`` kind events across the whole
+      leg (AssertionError otherwise; lowered programs are untouched by
+      construction — the monitor never enters jit);
+    - **monitored**: a fresh registry with a JSONL sink, the stock
+      rule table tapped in, a background poll loop at 20 ms, and a
+      final deterministic ``poll()``. The replica loss must fire the
+      ``replica_health`` rule and the respawn must resolve it —
+      ``alerts_fired`` >= 1 and ``alerts_firing_final`` == 0 are
+      emitted next to the headline ``monitor_overhead_pct``
+      (monitored-vs-unmonitored wall-clock delta), the number the
+      'leave the monitor on in production' claim rests on.
+    """
+    import tempfile
+
+    from apex_tpu.resilience import faults
+    from apex_tpu.serving import (FleetConfig, ServeConfig, ServeFleet,
+                                  diurnal_trace)
+    from apex_tpu.telemetry import CompileWatcher, Monitor, default_rules
+    from apex_tpu.telemetry.registry import MetricsRegistry, use_registry
+
+    smoke, cfg, model, params, _, _ = _serve_bench_setup()
+    serve_cfg = ServeConfig(
+        batch_buckets=(2, 4),
+        prefill_buckets=(16, 32) if smoke else (32, 64, 128),
+        num_slots=4, cache_mode="bf16",
+        eos_token_id=None, temperature=0.0)
+    fleet_cfg = FleetConfig(num_replicas=2, respawn_delay_ticks=1)
+    plens = (4, 8, 12) if smoke else (8, 24, 48)
+    widest = serve_cfg.prefill_buckets[-1]
+    max_new = tuple(min(m, widest - max(plens))
+                    for m in (max(steps // 2, 2), steps, steps * 2))
+
+    def trace():
+        return diurnal_trace(
+            requests, seed=0, prompt_lens=plens, max_new=max_new,
+            vocab_size=cfg.vocab_size, base_interarrival=0.6,
+            burst_at=1.0, burst_n=max(requests // 4, 2),
+            batch_every=4)
+
+    watcher = CompileWatcher(enabled=True)
+
+    def chaos_leg(reg):
+        fleet = ServeFleet(model, params, serve_cfg, fleet_cfg,
+                           watcher=watcher)
+        t0 = time.perf_counter()
+        with faults.inject_replica_loss(0, 3):
+            fleet.run(trace())
+        return time.perf_counter() - t0, fleet.stats()
+
+    # unmonitored leg: disabled registry, inert monitor, and a shim
+    # counting any monitor-plane event that dares to fire
+    off_reg = MetricsRegistry()
+    off_events = []
+    _orig_event = off_reg.event
+
+    def _counting_event(kind, name, **fields):
+        if kind in ("monitor", "alert"):
+            off_events.append((kind, name))
+        return _orig_event(kind, name, **fields)
+
+    off_reg.event = _counting_event
+    mon_off = Monitor(off_reg, rules=default_rules())
+    if mon_off.enabled or mon_off.poll() is not None:
+        raise AssertionError(
+            "Monitor on a disabled registry came up live — the "
+            "zero-overhead-off contract is broken")
+    with use_registry(off_reg):
+        t_off, stats_off = chaos_leg(off_reg)
+    mon_off.close()
+    if off_events:
+        raise AssertionError(
+            f"disabled leg emitted {len(off_events)} monitor/alert "
+            f"event(s) — the zero-overhead-off contract is broken")
+
+    # monitored leg: JSONL sink + stock rules + live poll loop
+    on_dir = tempfile.mkdtemp(prefix="apex_monitor_overhead_")
+    on_reg = MetricsRegistry()
+    on_reg.enable(jsonl_dir=on_dir)
+    mon = Monitor(on_reg, rules=default_rules())
+    mon.start(interval_s=0.02)
+    with use_registry(on_reg):
+        t_on, stats_on = chaos_leg(on_reg)
+    final = mon.poll()
+    rows = mon.alerts()
+    mon.close()
+    on_reg.disable()
+    alerts_fired = sum(r["fired_count"] for r in rows)
+    firing_final = final["firing"] if final else None
+
+    ladder = (len(serve_cfg.batch_buckets)
+              * len(serve_cfg.prefill_buckets)
+              + len(serve_cfg.batch_buckets))
+    _stage_aot_compile_count(ladder)
+    overhead_pct = ((t_on - t_off) / t_off * 100.0) if t_off else None
+    avg_len = float(np.mean(plens)) + float(np.mean(max_new))
+    flops = stats_on["tokens_generated"] * \
+        _transformer_fwd_flops_per_token(cfg, int(avg_len))
+    ret = {
+        "unmonitored_run_s": round(t_off, 4),
+        "monitored_run_s": round(t_on, 4),
+        "monitor_overhead_pct": round(overhead_pct, 2)
+        if overhead_pct is not None else None,
+        "alerts_fired": int(alerts_fired),
+        "alerts_firing_final": firing_final,
+        "disabled_leg_monitor_events": len(off_events),
+        "replicas_respawned": stats_on["replicas_respawned"],
+        "lost_requests": stats_on["lost_requests"],
+    }
+    _emit("monitor_overhead_pct", overhead_pct or 0.0, "%", flops, 1,
+          t_on, requests=requests, replicas=2,
+          unmonitored_goodput_tokens=stats_off["goodput_tokens"],
+          monitored_goodput_tokens=stats_on["goodput_tokens"],
+          **{k: v for k, v in ret.items()
+             if k != "monitor_overhead_pct"},
+          **_comm_fields(training=False))
+    ret["compile_count"] = ladder
+    return ret
+
+
 # The canonical (size, steps) per bench — the ONLY place these defaults
 # live; both the CLI dispatch below and the one-process capture plan
 # (tools/oneproc_capture.py) read them, so a tuning change (like resnet
@@ -3666,6 +3806,7 @@ BENCH_SPECS = {
     "serve_fleet": ((16, 8), bench_serve_fleet),
     "serve_migrate": ((8, 6), bench_serve_migrate),
     "trace_overhead": ((4, 30), bench_trace_overhead),
+    "monitor_overhead": ((12, 6), bench_monitor_overhead),
     "resnet": ((256, 50), bench_resnet),
     "kernels": ((1024, 5), bench_kernels),
     "fused_cc": ((512, 5), bench_fused_cc),

@@ -1,0 +1,829 @@
+"""Live monitoring control plane (ISSUE 20): rolling-window alert
+engine, OpenMetrics exposition, online straggler/bubble attribution,
+and the zero-overhead-off contract.
+
+Covers:
+
+- ``AlertRule`` validation and the stock ``default_rules()`` table;
+- every rule kind end to end through ``Monitor.poll()``: gauge
+  above/below, counter increase/rate over the snapshot window,
+  histogram p99, EWMA z-score span anomalies, fleet replica health,
+  supervisor recovery — including ``for_polls``/``resolve_polls``
+  hysteresis and the firing -> resolved ``alert`` event transitions
+  (with evidence, the ``monitor/alerts_firing`` gauge, and the
+  ``monitor/alerts_fired`` counter);
+- ``JsonlTailer`` incremental cross-rank intake (byte offsets,
+  complete-lines-only, own-rank skip);
+- OpenMetrics: renderer output round-trips the strict conformance
+  parser, counter ``_total`` / summary-quantile discipline, firing
+  alert samples, and the parser's rejection cases; the stdlib scrape
+  endpoint serves it over HTTP on an ephemeral port;
+- zero-overhead-off: a Monitor on a disabled registry is fully inert
+  (no tap, no thread, no socket, no events) and the lowered HLO of a
+  guarded train step is byte-identical with the monitor on or off;
+- the chaos acceptance (tier-1, stub fleet — no compiles): a replica
+  kill fires ``replica_health`` and the respawn resolves it; a REAL
+  jitted ``guarded_update`` fed NaN gradients fires ``guard_skips``
+  through ``check_guard`` and a clean step resolves it, with
+  ``alerts_firing()`` back to 0;
+- ``PipelineAttributor``: exposure-difference straggler naming on
+  synthetic tick spans, the pp == 1 / uniform-load abstain cases,
+  measured bubble fraction, per-axis comm exposure — plus (slow) the
+  real ``build_pipeline_step(..., straggler=)`` trace naming the
+  delayed stage through the Monitor's live tap;
+- ``tools/monitor_dash.py --once`` renders a captured dir with the
+  firing count as exit code, and ``tools/telemetry_report.py`` folds
+  ``alert``/``monitor`` events into the per-rule rollup.
+"""
+
+import io
+import json
+import os
+import sys
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from apex_tpu.telemetry import MetricsRegistry, use_registry
+from apex_tpu.telemetry.attribution import PipelineAttributor
+from apex_tpu.telemetry.monitor import (
+    AlertRule,
+    JsonlTailer,
+    Monitor,
+    default_rules,
+    parse_openmetrics,
+    render_openmetrics,
+)
+
+ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import monitor_dash  # noqa: E402
+import telemetry_report  # noqa: E402
+
+
+def _reg(tmp_path=None):
+    return MetricsRegistry(
+        enabled=True,
+        jsonl_dir=str(tmp_path) if tmp_path is not None else None)
+
+
+def _rule(**kw):
+    kw.setdefault("name", "r")
+    return AlertRule(kw.pop("name"), kw.pop("kind"), **kw)
+
+
+def _capture_alerts(reg):
+    rows = []
+    reg.add_event_tap(
+        lambda rec: rows.append(rec) if rec.get("kind") == "alert"
+        else None)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# AlertRule + default table
+# ---------------------------------------------------------------------------
+
+
+class TestAlertRule:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown rule kind"):
+            _rule(kind="nope", metric="x", threshold=1.0)
+
+    def test_unknown_severity_rejected(self):
+        with pytest.raises(ValueError, match="unknown severity"):
+            _rule(kind="gauge_above", metric="x", threshold=1.0,
+                  severity="catastrophic")
+
+    def test_metric_and_threshold_required(self):
+        with pytest.raises(ValueError, match="needs a metric"):
+            _rule(kind="gauge_above", threshold=1.0)
+        with pytest.raises(ValueError, match="needs a threshold"):
+            _rule(kind="gauge_above", metric="x")
+
+    def test_event_driven_kinds_take_no_metric(self):
+        assert _rule(kind="replica_health").metric is None
+        assert _rule(kind="recovery").threshold is None
+
+    def test_default_rules_cover_the_contract(self):
+        names = {r.name for r in default_rules()}
+        assert {"ttft_slo_interactive", "guard_skips", "pending_depth",
+                "recompiles", "hbm_headroom", "goodput_ratio",
+                "step_time_anomaly", "replica_health",
+                "recovery_escalation"} <= names
+
+    def test_duplicate_rule_names_rejected(self):
+        rules = [_rule(name="dup", kind="recovery"),
+                 _rule(name="dup", kind="replica_health")]
+        with pytest.raises(ValueError, match="duplicate"):
+            Monitor(_reg(), rules=rules)
+
+    def test_describe_round_trips_the_knobs(self):
+        d = _rule(name="x", kind="gauge_above", metric="m",
+                  threshold=2.0, for_polls=3, severity="page").describe()
+        assert d["for_polls"] == 3 and d["severity"] == "page"
+
+
+# ---------------------------------------------------------------------------
+# rule kinds through poll()
+# ---------------------------------------------------------------------------
+
+
+class TestRuleKinds:
+    def test_gauge_above_fires_and_resolves(self):
+        reg = _reg()
+        events = _capture_alerts(reg)
+        mon = Monitor(reg, rules=[_rule(
+            name="g", kind="gauge_above", metric="q/depth",
+            threshold=5.0)])
+        reg.gauge("q/depth").set(3.0)
+        assert mon.poll()["firing"] == 0
+        reg.gauge("q/depth").set(9.0)
+        res = mon.poll()
+        assert res["firing"] == 1
+        row = res["alerts"][0]
+        assert row["firing"] and row["value"] == 9.0
+        assert row["evidence"] == {"q/depth": 9.0}
+        reg.gauge("q/depth").set(1.0)
+        assert mon.poll()["firing"] == 0
+        states = [e["state"] for e in events]
+        assert states == ["firing", "resolved"]
+        assert events[1]["duration_s"] is not None
+        assert reg.gauge("monitor/alerts_firing").value == 0.0
+        assert reg.counter("monitor/alerts_fired").value == 1.0
+        mon.close()
+
+    def test_gauge_pattern_matches_many_names(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(
+            name="g", kind="gauge_above", metric="*/pending_depth",
+            threshold=2.0)])
+        reg.gauge("fleet/pending_depth").set(1.0)
+        reg.gauge("serve/pending_depth").set(7.0)
+        row = mon.poll()["alerts"][0]
+        assert row["firing"]
+        assert row["evidence"] == {"serve/pending_depth": 7.0}
+        mon.close()
+
+    def test_gauge_below_floor(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(
+            name="hbm", kind="gauge_below",
+            metric="memory/hbm_headroom", threshold=0.05)])
+        reg.gauge("memory/hbm_headroom").set(0.5)
+        assert mon.poll()["firing"] == 0
+        reg.gauge("memory/hbm_headroom").set(0.01)
+        assert mon.poll()["firing"] == 1
+        mon.close()
+
+    def test_for_polls_and_resolve_polls_hysteresis(self):
+        reg = _reg()
+        events = _capture_alerts(reg)
+        mon = Monitor(reg, rules=[_rule(
+            name="g", kind="gauge_above", metric="d", threshold=0.0,
+            for_polls=3, resolve_polls=2)])
+        reg.gauge("d").set(1.0)
+        assert mon.poll()["firing"] == 0    # breach 1
+        assert mon.poll()["firing"] == 0    # breach 2
+        assert mon.poll()["firing"] == 1    # breach 3 -> fires
+        reg.gauge("d").set(-1.0)
+        assert mon.poll()["firing"] == 1    # ok 1 — still firing
+        assert mon.poll()["firing"] == 0    # ok 2 -> resolves
+        reg.gauge("d").set(1.0)
+        assert mon.poll()["firing"] == 0    # streak restarted
+        assert [e["state"] for e in events] == ["firing", "resolved"]
+        mon.close()
+
+    def test_counter_increase_over_window(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(
+            name="c", kind="counter_increase", metric="compile/count",
+            threshold=0.0, window_s=60.0)])
+        reg.counter("compile/count").inc()
+        # first poll: no window base yet — never fires
+        assert mon.poll()["firing"] == 0
+        assert mon.poll()["firing"] == 0    # no growth since base
+        reg.counter("compile/count").inc(2.0)
+        res = mon.poll()
+        assert res["firing"] == 1
+        assert res["alerts"][0]["evidence"]["compile/count"][
+            "delta"] == 2.0
+        mon.close()
+
+    def test_counter_rate_above(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(
+            name="c", kind="counter_rate_above", metric="tok",
+            threshold=1e9, window_s=60.0)])
+        reg.counter("tok").inc()
+        mon.poll()
+        reg.counter("tok").inc()
+        assert mon.poll()["firing"] == 0    # rate nowhere near 1e9/s
+        mon.close()
+
+    def test_hist_p99_above(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(
+            name="slo", kind="hist_p99_above",
+            metric="fleet/ttft_*", threshold=100.0)])
+        for _ in range(20):
+            reg.histogram("fleet/ttft_interactive").observe(10.0)
+        assert mon.poll()["firing"] == 0
+        for _ in range(20):
+            reg.histogram("fleet/ttft_interactive").observe(500.0)
+        row = mon.poll()["alerts"][0]
+        assert row["firing"]
+        assert row["evidence"]["fleet/ttft_interactive"]["p99"] > 100.0
+        mon.close()
+
+    def test_ewma_z_span_anomaly(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(
+            name="z", kind="ewma_z", metric="train/step",
+            threshold=4.0)], ewma_warmup=8)
+        for i in range(12):                 # warmup with some variance
+            reg.event("span", "train/step",
+                      duration_s=1.0 + 0.01 * (i % 2))
+        assert mon.poll()["firing"] == 0
+        reg.event("span", "train/step", duration_s=30.0)
+        res = mon.poll()
+        assert res["firing"] == 1
+        assert abs(res["alerts"][0]["value"]) > 4.0
+        assert res["alerts"][0]["evidence"]["value_s"] == 30.0
+        # anomaly is consume-once: the next poll resolves
+        assert mon.poll()["firing"] == 0
+        mon.close()
+
+    def test_replica_health_from_events_and_gauges(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(name="rh",
+                                        kind="replica_health")])
+        reg.event("fleet", "replica_state", replica=0, old="serving",
+                  new="quarantined", reason="kill")
+        row = mon.poll()["alerts"][0]
+        assert row["firing"]
+        assert row["evidence"]["replicas"] == {"0": "quarantined"}
+        reg.event("fleet", "replica_state", replica=0,
+                  old="respawning", new="serving", reason="respawn")
+        assert mon.poll()["firing"] == 0
+        # the serving < expected gauge path fires without any event
+        reg.gauge("fleet/replicas_serving").set(1.0)
+        reg.gauge("fleet/replicas_expected").set(2.0)
+        assert mon.poll()["firing"] == 1
+        reg.gauge("fleet/replicas_serving").set(2.0)
+        assert mon.poll()["firing"] == 0
+        mon.close()
+
+    def test_recovery_rule_tracks_supervisor_window(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(name="rec",
+                                        kind="recovery")])
+        reg.event("recovery", "failure", cls="numerics", step=7)
+        row = mon.poll()["alerts"][0]
+        assert row["firing"] and row["evidence"]["cls"] == "numerics"
+        reg.event("recovery", "recovered", cls="numerics")
+        assert mon.poll()["firing"] == 0
+        # the gauge path: in_recovery == 1 fires without an event
+        reg.gauge("recovery/in_recovery").set(1.0)
+        assert mon.poll()["firing"] == 1
+        reg.gauge("recovery/in_recovery").set(0.0)
+        assert mon.poll()["firing"] == 0
+        mon.close()
+
+    def test_own_alert_events_never_feed_back(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(
+            name="g", kind="gauge_above", metric="d", threshold=0.0)])
+        reg.gauge("d").set(1.0)
+        for _ in range(5):
+            mon.poll()                      # alert + monitor events
+        assert mon.alerts()[0]["fired_count"] == 1
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-rank JSONL tailing
+# ---------------------------------------------------------------------------
+
+
+class TestJsonlTailer:
+    def test_incremental_complete_lines_only(self, tmp_path):
+        p = tmp_path / "telemetry-rank7.jsonl"
+        t = JsonlTailer(str(tmp_path))
+        assert t.poll() == []
+        with open(p, "w") as f:
+            f.write(json.dumps({"kind": "fleet", "name": "a"}) + "\n")
+            f.write('{"kind": "fleet", "na')   # torn write
+        recs = t.poll()
+        assert [r["name"] for r in recs] == ["a"]
+        with open(p, "a") as f:
+            f.write('me": "b"}\n')              # completed now
+        assert [r["name"] for r in t.poll()] == ["b"]
+        assert t.poll() == []                   # nothing new
+
+    def test_skip_files_and_garbage_lines(self, tmp_path):
+        (tmp_path / "telemetry-rank0.jsonl").write_text(
+            '{"kind": "x", "name": "mine"}\n')
+        (tmp_path / "telemetry-rank1.jsonl").write_text(
+            'not json\n{"kind": "x", "name": "theirs"}\n[1,2]\n')
+        t = JsonlTailer(str(tmp_path),
+                        skip_files=("telemetry-rank0.jsonl",))
+        assert [r["name"] for r in t.poll()] == ["theirs"]
+
+    def test_monitor_tails_other_ranks(self, tmp_path):
+        rank_dir = tmp_path / "tel"
+        rank_dir.mkdir()
+        reg = _reg()
+        mon = Monitor(reg, rules=[_rule(name="rh",
+                                        kind="replica_health")],
+                      tail_dir=str(rank_dir))
+        (rank_dir / "telemetry-rank3.jsonl").write_text(json.dumps(
+            {"kind": "fleet", "name": "replica_state", "replica": 2,
+             "new": "respawning"}) + "\n")
+        assert mon.poll()["firing"] == 1    # remote rank's kill seen
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+
+class TestOpenMetrics:
+    def _snapshot(self):
+        reg = _reg()
+        reg.counter("fleet/submitted").inc(3.0)
+        reg.gauge("memory/hbm_headroom").set(0.42)
+        for v in (1.0, 2.0, 3.0):
+            reg.histogram("fleet/ttft_interactive").observe(v)
+        return reg.snapshot()
+
+    def test_render_parse_round_trip(self):
+        text = render_openmetrics(self._snapshot())
+        fams = parse_openmetrics(text)
+        c = fams["apex_tpu_fleet_submitted"]
+        assert c["type"] == "counter"
+        assert c["samples"][0][0] == "apex_tpu_fleet_submitted_total"
+        g = fams["apex_tpu_memory_hbm_headroom"]
+        assert g["samples"][0][2] == "0.42"
+        s = fams["apex_tpu_fleet_ttft_interactive"]
+        assert s["type"] == "summary"
+        quantiles = {lab.get("quantile") for (_, lab, _) in
+                     s["samples"] if lab}
+        assert quantiles == {"0.5", "0.99"}
+        names = {n for (n, _, _) in s["samples"]}
+        assert "apex_tpu_fleet_ttft_interactive_count" in names
+        assert "apex_tpu_fleet_ttft_interactive_sum" in names
+
+    def test_firing_alerts_render_as_labeled_samples(self):
+        rows = [{"rule": "guard_skips", "severity": "page",
+                 "firing": True},
+                {"rule": "quiet", "severity": "info", "firing": False}]
+        text = render_openmetrics(self._snapshot(), alerts=rows)
+        fams = parse_openmetrics(text)
+        samples = fams["apex_tpu_monitor_alert"]["samples"]
+        assert len(samples) == 1
+        assert samples[0][1] == {"rule": "guard_skips",
+                                 "severity": "page"}
+
+    def test_nan_and_inf_values_render_legally(self):
+        reg = _reg()
+        reg.gauge("weird").set(float("nan"))
+        reg.gauge("hot").set(float("inf"))
+        fams = parse_openmetrics(render_openmetrics(reg.snapshot()))
+        vals = {fams[k]["samples"][0][2] for k in
+                ("apex_tpu_weird", "apex_tpu_hot")}
+        assert vals == {"NaN", "+Inf"}
+
+    @pytest.mark.parametrize("text,msg", [
+        ("apex_tpu_x 1\n# EOF\n", "no preceding TYPE"),
+        ("# TYPE apex_tpu_x counter\napex_tpu_x 1\n# EOF\n",
+         "_total"),
+        ("# TYPE apex_tpu_x gauge\napex_tpu_x_total 1\n# EOF\n",
+         "must not carry suffix"),
+        ("# TYPE apex_tpu_x gauge\napex_tpu_x 1\n", "EOF"),
+        ("# TYPE apex_tpu_x gauge\n# TYPE apex_tpu_x gauge\n# EOF\n",
+         "duplicate TYPE"),
+        ("# TYPE apex_tpu_x gauge\napex_tpu_x 1e\n# EOF\n",
+         "malformed value"),
+        ('# TYPE apex_tpu_x gauge\napex_tpu_x{a=b} 1\n# EOF\n',
+         "malformed"),
+        ("# TYPE apex_tpu_x summary\napex_tpu_x 1\n# EOF\n",
+         "quantile"),
+    ])
+    def test_parser_rejects_nonconformant(self, text, msg):
+        with pytest.raises(ValueError, match=msg):
+            parse_openmetrics(text)
+
+    def test_scrape_endpoint_serves_the_exposition(self):
+        reg = _reg()
+        reg.gauge("memory/hbm_headroom").set(0.3)
+        mon = Monitor(reg, rules=default_rules())
+        try:
+            srv = mon.serve(port=0)
+            assert srv is not None and mon.bound_port
+            url = f"http://127.0.0.1:{mon.bound_port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                assert resp.status == 200
+                assert "openmetrics-text" in resp.headers[
+                    "Content-Type"]
+                body = resp.read().decode("utf-8")
+            fams = parse_openmetrics(body)
+            assert "apex_tpu_memory_hbm_headroom" in fams
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{mon.bound_port}/nope",
+                    timeout=10)
+        finally:
+            mon.close()
+        assert mon.bound_port is None
+
+    def test_no_port_configured_means_no_server(self, monkeypatch):
+        monkeypatch.delenv("APEX_TPU_MONITOR_PORT", raising=False)
+        mon = Monitor(_reg(), rules=[])
+        assert mon.serve() is None
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead-off
+# ---------------------------------------------------------------------------
+
+
+class TestZeroOverheadOff:
+    def test_disabled_monitor_is_fully_inert(self):
+        reg = MetricsRegistry()             # disabled
+        events = []
+        orig = reg.event
+
+        def counting(kind, name, **fields):
+            events.append(kind)
+            return orig(kind, name, **fields)
+
+        reg.event = counting
+        mon = Monitor(reg, rules=default_rules())
+        assert not mon.enabled
+        assert mon.poll() is None
+        assert mon.render_openmetrics() == "# EOF\n"
+        assert mon.serve(port=0) is None
+        assert mon.start() is mon and mon._thread is None
+        mon.close()
+        assert events == []                 # not even start/stop
+
+    def test_lowered_hlo_byte_identical_monitor_on_vs_off(self):
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.resilience import guard
+
+        def opt_update(g, p):
+            return jax.tree_util.tree_map(
+                lambda pv, gv: pv - 0.1 * gv, p, g)
+
+        def train_step(g, p, gs):
+            return guard.guarded_update(g, opt_update, p, gs)
+
+        g = {"w": jnp.ones((8,), jnp.float32)}
+        p = {"w": jnp.ones((8,), jnp.float32)}
+        gs = guard.init_guard_state()
+
+        def lowered_text():
+            return jax.jit(train_step).lower(g, p, gs).as_text()
+
+        off = lowered_text()
+        reg = _reg()
+        mon = Monitor(reg, rules=default_rules())
+        with use_registry(reg):
+            on = lowered_text()
+            mon.poll()
+        mon.close()
+        assert on == off
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: stub fleet kill + real guard NaN, fire -> resolve
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self, num_slots=4):
+        self.config = types.SimpleNamespace(
+            num_slots=num_slots, batch_buckets=(2, 4),
+            prefill_buckets=(64,), eos_token_id=None, pad_token_id=0)
+        self.max_len = 10_000
+        self.decode_retries_total = 0
+        self.compile_count = 6
+        self.spec = types.SimpleNamespace(
+            bytes_per_slot=lambda: 0, cache_dtype_name=lambda: "stub")
+
+    def kv_cache_bytes(self):
+        return 0
+
+    def prefill(self, slot_ids, prompts, *, pad_slot_ids=None):
+        return np.ones(len(prompts), np.int32)
+
+    def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
+               retries=0, backoff_s=0.0, backoff_cap_s=0.0):
+        return np.ones(len(slot_ids), np.int32), \
+            np.ones(len(slot_ids), bool)
+
+
+class TestChaosAcceptance:
+    def test_replica_kill_fires_and_respawn_resolves(self, tmp_path):
+        from apex_tpu.resilience import faults
+        from apex_tpu.serving import FleetConfig, Request, ServeFleet
+
+        reg = _reg(tmp_path)
+        events = _capture_alerts(reg)
+        mon = Monitor(reg, rules=default_rules())
+        fleet = ServeFleet(
+            engine_factory=lambda idx, mesh, name: _StubEngine(),
+            config=FleetConfig(num_replicas=2, respawn_delay_ticks=1),
+            registry=reg)
+        try:
+            saw_firing = False
+            with faults.inject_replica_loss(0, 2):
+                for i in range(6):
+                    fleet.submit(Request(
+                        rid=i,
+                        prompt=np.arange(3, dtype=np.int32) % 7,
+                        max_new_tokens=4, arrival=0.0,
+                        tier="interactive" if i % 2 else "batch"))
+                for _ in range(400):
+                    if not fleet._work_remaining():
+                        break
+                    fleet.step()
+                    res = mon.poll()
+                    rh = next(r for r in res["alerts"]
+                              if r["rule"] == "replica_health")
+                    saw_firing = saw_firing or rh["firing"]
+            for _ in range(3):
+                mon.poll()
+        finally:
+            faults.disarm_replica_loss()
+        assert saw_firing, "the kill never fired replica_health"
+        rows = {r["rule"]: r for r in mon.alerts()}
+        assert rows["replica_health"]["fired_count"] >= 1
+        assert not rows["replica_health"]["firing"]
+        transitions = [(e["name"], e["state"]) for e in events
+                       if e["name"] == "replica_health"]
+        assert ("replica_health", "firing") in transitions
+        assert ("replica_health", "resolved") in transitions
+        assert mon.alerts_firing() == 0
+        mon.close()
+        reg.disable()
+
+    def test_real_guard_nan_fires_and_clean_step_resolves(self):
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.resilience import guard
+
+        reg = _reg()
+        mon = Monitor(reg, rules=default_rules())
+
+        def opt_update(g, p):
+            return jax.tree_util.tree_map(
+                lambda pv, gv: pv - 0.1 * gv, p, g)
+
+        step = jax.jit(lambda g, p, gs: guard.guarded_update(
+            g, opt_update, p, gs))
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        gs = guard.init_guard_state()
+        params, gs = step({"w": jnp.full((4,), jnp.nan)}, params, gs)
+        guard.check_guard(gs, 8, registry=reg)
+        res = mon.poll()
+        gsk = next(r for r in res["alerts"]
+                   if r["rule"] == "guard_skips")
+        assert gsk["firing"] and gsk["value"] == 1.0
+        params, gs = step({"w": jnp.ones((4,), jnp.float32)},
+                          params, gs)
+        guard.check_guard(gs, 8, registry=reg)
+        res = mon.poll()
+        assert not next(r for r in res["alerts"]
+                        if r["rule"] == "guard_skips")["firing"]
+        assert mon.alerts_firing() == 0
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# online straggler / bubble attribution
+# ---------------------------------------------------------------------------
+
+
+def _tick(t, dur, fwd=(), bwd=(), phase="steady"):
+    return {"kind": "span", "name": f"pp_tick_{t}",
+            "duration_s": dur, "phase": phase,
+            "fwd": [list(u) for u in fwd],
+            "bwd": [list(u) for u in bwd]}
+
+
+def _feed_1f1b(attr, pp=4, m=8, base=0.010, slow_stage=None,
+               slow_extra=0.030):
+    """Synthetic 1F1B ramp: tick i runs stages active in a sliding
+    window, so every stage gets exposed and unexposed ticks."""
+    t = 0
+    for start in range(m + pp - 1):
+        active = [r for r in range(pp) if 0 <= start - r < m]
+        dur = base + (slow_extra if slow_stage in active else 0.0)
+        attr.add_span(_tick(t, dur,
+                            fwd=[(r, start - r) for r in active]))
+        t += 1
+
+
+class TestPipelineAttributor:
+    def test_straggler_named_with_delta(self):
+        attr = PipelineAttributor()
+        _feed_1f1b(attr, pp=4, m=8, slow_stage=2)
+        rep = attr.report()
+        assert rep["pp"] == 4 and rep["microbatches"] == 8
+        assert rep["straggler"] == 2
+        assert rep["straggler_delta_s"] == pytest.approx(0.030,
+                                                         rel=0.3)
+
+    def test_uniform_load_abstains(self):
+        attr = PipelineAttributor()
+        _feed_1f1b(attr, pp=4, m=8, slow_stage=None)
+        assert attr.report()["straggler"] is None
+
+    def test_pp1_abstains(self):
+        attr = PipelineAttributor()
+        for t in range(8):
+            attr.add_span(_tick(t, 0.01, fwd=[(0, t)]))
+        rep = attr.report()
+        assert rep["pp"] == 1 and rep["straggler"] is None
+
+    def test_bubble_fraction_measured_vs_analytic(self):
+        attr = PipelineAttributor()
+        _feed_1f1b(attr, pp=4, m=8)
+        rep = attr.report()
+        assert rep["bubble_fraction_analytic"] == pytest.approx(
+            3 / 11)
+        assert 0.0 < rep["bubble_fraction_measured"] < 1.0
+
+    def test_comm_exposure_split(self):
+        attr = PipelineAttributor()
+        attr.add_span({"kind": "span", "name": "ddp_overlap_bucket_0",
+                       "duration_s": 0.02, "bubble": True})
+        attr.add_span({"kind": "span", "name": "ddp_overlap_bucket_1",
+                       "duration_s": 0.06})
+        data = attr.report()["comm_exposure"]["data"]
+        assert data["buckets"] == 2
+        assert data["exposed_fraction"] == pytest.approx(0.75)
+
+    def test_non_matching_spans_ignored(self):
+        attr = PipelineAttributor()
+        assert not attr.add_span({"kind": "span", "name": "train/step",
+                                  "duration_s": 1.0})
+        assert not attr.add_span({"kind": "event", "name": "pp_tick_0"})
+        assert attr.ticks_seen == 0
+
+    def test_monitor_feeds_attributor_from_tap(self):
+        reg = _reg()
+        mon = Monitor(reg, rules=[])
+        for t in range(6):
+            active = [(0, t)] if t % 2 else [(0, t), (1, t)]
+            reg.event("span", f"pp_tick_{t}",
+                      duration_s=0.01 + 0.02 * (len(active) > 1),
+                      fwd=[list(u) for u in active], bwd=[])
+        rep = mon.straggler_report()
+        assert rep["pp"] == 2 and rep["ticks"] == 6
+        mon.close()
+
+    @pytest.mark.slow  # compiles a 2-stage 3-D pipeline step
+    def test_real_pipeline_straggler_named_via_trace(self):
+        import jax
+
+        from apex_tpu.parallel import mesh2d, pipeline
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices for a pipe axis")
+        reg = _reg()
+        mon = Monitor(reg, rules=[])
+        mesh = pipeline.mesh_3d(1, 1, 2, devices=jax.devices()[:2])
+        sp = mesh2d.gpt2_init(hidden=32, layers=2, heads=4, vocab=32,
+                              max_seq=8)
+        step, state = pipeline.build_pipeline_step(
+            mesh, sp, hidden=32, heads=4, microbatches=4,
+            straggler=(1, 0.05))
+        tokens, labels = pipeline.make_batch_3d(
+            mesh, microbatches=4, batch_per_replica=2, seq=8,
+            vocab=32)
+        with use_registry(reg):
+            out = step(*state, tokens, labels)
+            jax.block_until_ready(out[-1])
+        rep = mon.straggler_report()
+        assert rep["pp"] == 2 and rep["ticks"] > 0
+        assert rep["straggler"] == 1
+        assert rep["bubble_fraction_measured"] is not None
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + registry snapshot
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycle:
+    def test_start_close_emits_monitor_events(self, tmp_path):
+        reg = _reg(tmp_path)
+        mon = Monitor(reg, rules=default_rules())
+        mon.start(interval_s=0.01)
+        mon.poll()
+        mon.close()
+        mon.close()                         # idempotent
+        reg.disable()
+        kinds = {}
+        for p in sorted(tmp_path.glob("*.jsonl")):
+            for line in p.read_text().splitlines():
+                rec = json.loads(line)
+                if rec.get("kind") == "monitor":
+                    kinds[rec["name"]] = rec
+        assert "start" in kinds and "stop" in kinds
+        assert kinds["stop"]["polls"] >= 1
+        assert "guard_skips" in kinds["start"]["rules"]
+
+    def test_context_manager_closes(self):
+        reg = _reg()
+        with Monitor(reg, rules=[]) as mon:
+            mon.poll()
+        assert mon._closed
+
+    def test_snapshot_is_a_point_in_time_copy(self):
+        reg = _reg()
+        reg.counter("c").inc(2.0)
+        reg.gauge("g").set(1.0)
+        reg.histogram("h").observe(5.0)
+        snap = reg.snapshot()
+        reg.counter("c").inc(10.0)
+        reg.gauge("g").set(99.0)
+        assert snap["counters"]["c"] == 2.0
+        assert snap["gauges"]["g"] == 1.0
+        assert snap["histograms"]["h"]["count"] == 1
+        assert "ts" in snap
+
+
+# ---------------------------------------------------------------------------
+# the human ends: dash + report
+# ---------------------------------------------------------------------------
+
+
+def _write_capture(tmp_path):
+    recs = [
+        {"kind": "monitor", "name": "start", "rules": ["guard_skips"]},
+        {"kind": "alert", "name": "guard_skips", "state": "firing",
+         "severity": "page", "value": 2.0, "ts": 1.0},
+        {"kind": "alert", "name": "guard_skips", "state": "resolved",
+         "severity": "page", "duration_s": 0.5, "ts": 2.0},
+        {"kind": "alert", "name": "pending_depth", "state": "firing",
+         "severity": "warn", "value": 70.0, "ts": 3.0},
+        {"kind": "fleet", "name": "replica_state", "replica": 0,
+         "old": "serving", "new": "respawning", "ts": 3.5},
+        {"kind": "span", "name": "pp_tick_0", "duration_s": 0.01,
+         "fwd": [[0, 0]], "bwd": [], "phase": "warmup"},
+        {"kind": "summary",
+         "gauges": {"monitor/alerts_firing": 1.0,
+                    "guard/consecutive_skips": 0.0},
+         "counters": {}, "histograms": {}},
+    ]
+    path = tmp_path / "telemetry-rank0.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return recs
+
+
+class TestDashAndReport:
+    def test_dash_once_exit_code_is_firing_count(self, tmp_path,
+                                                 capsys):
+        _write_capture(tmp_path)
+        rc = monitor_dash.main([str(tmp_path), "--once"])
+        out = capsys.readouterr().out
+        assert rc == 1                      # pending_depth unresolved
+        assert "pending_depth" in out and "guard_skips" in out
+        assert "0:respawning" in out
+
+    def test_dash_missing_dir_is_loud(self, tmp_path, capsys):
+        assert monitor_dash.main([str(tmp_path / "nope"),
+                                  "--once"]) == 2
+
+    def test_report_folds_alert_and_monitor_kinds(self, tmp_path):
+        _write_capture(tmp_path)
+        report = telemetry_report.aggregate(
+            telemetry_report.load_events(
+                [str(tmp_path / "telemetry-rank0.jsonl")]))
+        alerts = report["alerts"]
+        assert alerts["by_rule"]["guard_skips"]["fired"] == 1
+        assert alerts["by_rule"]["guard_skips"]["resolved"] == 1
+        assert alerts["by_rule"]["pending_depth"][
+            "last_state"] == "firing"
+        assert alerts["monitor"]["starts"] == 1
+        assert len(alerts["timeline"]) == 3
+        assert report["unknown_kinds"] == {}
+        buf = io.StringIO()
+        telemetry_report.print_report(report, out=buf)
+        text = buf.getvalue()
+        assert "alerts (telemetry.monitor)" in text
+        assert "STILL FIRING" in text

@@ -581,8 +581,9 @@ def test_loss_scaler_disabled_registry_records_nothing():
     scaler = LossScaler("dynamic", init_scale=8.0)
     with use_registry(reg):
         scaler.update(scaler.init_state(), jnp.asarray(1.0))
-    assert reg.snapshot() == {"counters": {}, "gauges": {},
-                              "histograms": {}}
+    snap = reg.snapshot()
+    snap.pop("ts")  # snapshot's own timestamp, not an instrument
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
 
 
 def test_loss_scaler_update_lowering_identical_and_callback_free():

@@ -95,6 +95,13 @@ number ``n`` (old checked-in records stay valid):
   ``disabled_leg_events`` (must aggregate to 0 — the
   zero-overhead-off proof) — all nullable; pre-round-24 records
   carrying any of them are flagged.
+- ``n >= 25``: ``monitor_overhead`` metric lines (live-monitoring tax)
+  must carry the two leg wall-clocks (``unmonitored_run_s`` /
+  ``monitored_run_s``), ``alerts_fired`` (the rule table actually
+  evaluated under chaos), ``alerts_firing_final`` (0 on a healthy
+  run — everything resolved) and ``disabled_leg_monitor_events``
+  (must be 0 — the monitor-plane zero-overhead-off proof) — all
+  nullable; pre-round-25 records carrying any of them are flagged.
 
 Usage::
 
@@ -285,6 +292,20 @@ TRACE_OVERHEAD_NUM_FIELDS = (
     "span_count", "tracing_overhead_pct", "untraced_step_ms",
     "traced_step_ms", "disabled_leg_events")
 TRACE_OVERHEAD_REQUIRED_FIELDS = TRACE_OVERHEAD_NUM_FIELDS
+# the live-monitoring contract (apex_tpu.telemetry.monitor, round 25):
+# a monitor_overhead metric line carries both leg wall-clocks, the
+# fired-alert count (the rule table actually evaluated under the
+# injected replica loss), the final firing count (0 = everything
+# resolved after respawn) and the disabled-leg monitor/alert event
+# count (0 on a healthy run — a Monitor on a disabled registry must be
+# inert, measured not assumed); pre-round-25 records carrying any of
+# them are flagged — the fields did not exist
+MONITOR_OVERHEAD_FIELDS_SINCE_ROUND = 25
+MONITOR_OVERHEAD_METRIC_PREFIX = "monitor_overhead"
+MONITOR_OVERHEAD_NUM_FIELDS = (
+    "unmonitored_run_s", "monitored_run_s", "alerts_fired",
+    "alerts_firing_final", "disabled_leg_monitor_events")
+MONITOR_OVERHEAD_REQUIRED_FIELDS = MONITOR_OVERHEAD_NUM_FIELDS
 # the fused computation-collective contract (apex_tpu.kernels
 # .fused_cc, round 21): a fused_cc metric line carries per-family
 # fused-vs-unfused timings plus the traced-jaxpr HBM-intermediate
@@ -691,6 +712,34 @@ def check_metric_line(obj, *, round_n=None, errors=None, where=""):
                     f"{obj['disabled_leg_events']} — the disabled "
                     f"registry recorded events (zero-overhead-off "
                     f"contract broken)")
+        is_monitor = str(obj.get("metric", "")).startswith(
+            MONITOR_OVERHEAD_METRIC_PREFIX)
+        present_mon = [k for k in MONITOR_OVERHEAD_NUM_FIELDS
+                       if k in obj]
+        if present_mon and (round_n is not None
+                            and round_n
+                            < MONITOR_OVERHEAD_FIELDS_SINCE_ROUND):
+            bad(f"monitor_overhead fields {present_mon} are only "
+                f"defined from round "
+                f"{MONITOR_OVERHEAD_FIELDS_SINCE_ROUND}")
+        elif is_monitor and (round_n is None
+                             or round_n
+                             >= MONITOR_OVERHEAD_FIELDS_SINCE_ROUND):
+            for key in MONITOR_OVERHEAD_NUM_FIELDS:
+                if key not in obj:
+                    bad(f"monitor_overhead line missing {key!r} "
+                        f"(required since round "
+                        f"{MONITOR_OVERHEAD_FIELDS_SINCE_ROUND})")
+                elif not (obj[key] is None
+                          or _type_ok(obj[key], _NUM)):
+                    bad(f"monitor_overhead field {key!r} must be "
+                        f"numeric or null")
+            if _type_ok(obj.get("disabled_leg_monitor_events"), _NUM) \
+                    and obj["disabled_leg_monitor_events"] != 0:
+                bad(f"monitor_overhead disabled_leg_monitor_events = "
+                    f"{obj['disabled_leg_monitor_events']} — the "
+                    f"disabled leg saw monitor-plane events "
+                    f"(zero-overhead-off contract broken)")
         if "numerics_overhead_pct" in obj:
             if (round_n is not None
                     and round_n < NUMERICS_OVERHEAD_SINCE_ROUND):

@@ -65,6 +65,12 @@ PER_METRIC_BAND = {
     # cpu-mesh captures the fused leg runs the Pallas interpreter,
     # whose constant overhead swings with load
     "fused_cc_speedup_geomean": 0.40,
+    # live-monitoring tax: a ratio of two wall-clocks of the fleet
+    # chaos leg (replica loss + respawn sleeps inside), so host noise
+    # enters twice and the absolute value sits near zero — the widest
+    # band in the table; the hard gates on this config (alerts fired,
+    # disabled-leg events == 0) live in bench_schema_check.py, not here
+    "monitor_overhead_pct": 0.60,
 }
 
 # per-config extra timing fields tracked cross-round (lower is

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Terminal dashboard over a telemetry directory — the human end of
+the live monitoring plane (apex_tpu.telemetry.monitor).
+
+Folds the ``telemetry-rank*.jsonl`` stream into the *current* state —
+firing/last-state per alert rule, fleet replica table, per-tier TTFT,
+a key-gauge strip, and online pipeline straggler/bubble attribution —
+and renders it as one screen. Two modes:
+
+    python tools/monitor_dash.py --once /tmp/tel     # snapshot, exit
+    python tools/monitor_dash.py /tmp/tel            # live, 2s refresh
+
+Live mode tails the files incrementally (same
+:class:`~apex_tpu.telemetry.monitor.JsonlTailer` the Monitor uses for
+cross-rank intake) and repaints until interrupted; it is a pure
+reader — point it at the telemetry dir of a running job from another
+terminal. Exit code in ``--once`` mode is the number of rules still
+firing (capped at 100), so scripts can gate on it.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from apex_tpu.telemetry.attribution import PipelineAttributor  # noqa: E402
+from apex_tpu.telemetry.monitor import JsonlTailer  # noqa: E402
+
+# severity sort weight — pages float to the top of the alert table
+_SEV_ORDER = {"page": 0, "warn": 1, "info": 2}
+
+# the gauge strip: first match per pattern group, in this order
+_GAUGE_WATCH = (
+    "monitor/alerts_firing",
+    "guard/consecutive_skips",
+    "fleet/pending_depth",
+    "serve/pending_depth",
+    "fleet/replicas_serving",
+    "fleet/replicas_expected",
+    "memory/hbm_headroom",
+    "recovery/goodput_step_ratio",
+    "recovery/in_recovery",
+    "mfu",
+)
+
+
+class DashState:
+    """Streaming fold of the event stream into 'what is true now'."""
+
+    def __init__(self):
+        self.events = 0
+        self.alerts = {}          # rule -> row
+        self.replicas = {}        # idx -> state
+        self.fleet_report = None
+        self.gauges = {}          # merged last-summary gauges
+        self.counters = {}
+        self.histograms = {}
+        self.monitor_seen = False
+        self.attribution = PipelineAttributor()
+        self.last_ts = None
+
+    def feed(self, rec):
+        self.events += 1
+        kind = rec.get("kind")
+        if rec.get("ts") is not None:
+            self.last_ts = rec["ts"]
+        if kind == "span":
+            self.attribution.add_span(rec)
+        elif kind == "alert":
+            rule = str(rec.get("name"))
+            row = self.alerts.setdefault(rule, {
+                "severity": None, "state": None, "fired": 0,
+                "resolved": 0, "value": None})
+            state = rec.get("state")
+            row["state"] = state
+            if rec.get("severity") is not None:
+                row["severity"] = rec["severity"]
+            if state == "firing":
+                row["fired"] += 1
+                row["value"] = rec.get("value")
+            elif state == "resolved":
+                row["resolved"] += 1
+        elif kind == "monitor":
+            self.monitor_seen = True
+        elif kind == "fleet":
+            name = rec.get("name")
+            if name == "replica_state":
+                self.replicas[rec.get("replica")] = rec.get("new")
+            elif name in ("fleet_report", "health"):
+                self.fleet_report = rec
+        elif kind == "summary":
+            # later summaries win per key; ranks merge (disjoint
+            # prefixes in practice — each rank owns its instruments)
+            self.gauges.update(rec.get("gauges") or {})
+            self.counters.update(rec.get("counters") or {})
+            self.histograms.update(rec.get("histograms") or {})
+
+    def firing(self):
+        return sorted(r for r, a in self.alerts.items()
+                      if a.get("state") == "firing")
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(state, *, source="", out=None):
+    w = (out if out is not None else sys.stdout).write
+    firing = state.firing()
+    w(f"apex_tpu monitor dash — {source} — {state.events} event(s)")
+    if not state.monitor_seen:
+        w("  [no monitor events: offline fold of raw telemetry]")
+    w("\n")
+    w(f"alerts firing: {len(firing)}"
+      + (f"  <<< {', '.join(firing)}" if firing else "  (all clear)")
+      + "\n")
+    if state.alerts:
+        w(f"  {'rule':<28} {'sev':<6} {'state':<10} {'fired':>6} "
+          f"{'resolved':>9} {'value':>10}\n")
+        rows = sorted(
+            state.alerts.items(),
+            key=lambda kv: (_SEV_ORDER.get(kv[1].get("severity"), 9),
+                            kv[0]))
+        for rule, a in rows:
+            w(f"  {rule:<28} {str(a.get('severity')):<6} "
+              f"{str(a.get('state')):<10} {a['fired']:>6} "
+              f"{a['resolved']:>9} {_fmt(a.get('value')):>10}\n")
+    watch = [(k, state.gauges[k]) for k in _GAUGE_WATCH
+             if k in state.gauges]
+    if watch:
+        w("gauges: " + "  ".join(f"{k}={_fmt(v)}" for k, v in watch)
+          + "\n")
+    if state.replicas:
+        w("replicas: " + "  ".join(
+            f"{idx}:{st}" for idx, st in sorted(
+                state.replicas.items(),
+                key=lambda kv: str(kv[0]))) + "\n")
+    report = state.fleet_report
+    if report:
+        tiers = report.get("by_tier") or report.get("tiers") or {}
+        for tier in sorted(tiers):
+            t = tiers[tier]
+            p99 = t.get("ttft_p99_ms")
+            w(f"  tier {tier}: {t.get('requests')} request(s), "
+              f"{t.get('ok')} ok, ttft p99 "
+              f"{f'{p99:.2f}ms' if p99 is not None else '-'}\n")
+    # histogram strip: ttft summaries straight off the last registry
+    # summary (present even when no fleet report event was cut)
+    ttfts = {k: v for k, v in sorted(state.histograms.items())
+             if k.startswith("fleet/ttft_")}
+    for name, summ in ttfts.items():
+        w(f"  {name}: count {summ.get('count')}, p50 "
+          f"{_fmt(summ.get('p50'))}ms, p99 {_fmt(summ.get('p99'))}ms\n")
+    if state.attribution.ticks_seen:
+        rep = state.attribution.report()
+        strag = rep["straggler"]
+        w(f"pipeline: pp={rep['pp']} m={rep['microbatches']} over "
+          f"{rep['ticks']} tick(s); straggler: ")
+        if strag is None:
+            w("none detected")
+        else:
+            w(f"stage {strag} "
+              f"(+{rep['straggler_delta_s'] * 1e3:.2f}ms/tick)")
+        bm, ba = (rep["bubble_fraction_measured"],
+                  rep["bubble_fraction_analytic"])
+        w(f"; bubble {_fmt(bm)} (analytic {_fmt(ba)})\n")
+        data = rep["comm_exposure"]["data"]
+        if data["buckets"]:
+            w(f"  data-axis comm: {data['buckets']} bucket(s), "
+              f"exposed fraction {_fmt(data['exposed_fraction'])}\n")
+    return len(firing)
+
+
+def fold_dir(dirpath):
+    state = DashState()
+    paths = sorted(glob.glob(os.path.join(dirpath,
+                                          JsonlTailer.PATTERN)))
+    for path in paths:
+        try:
+            with open(path, errors="replace") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        state.feed(rec)
+        except OSError:
+            continue
+    return state, len(paths)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", nargs="?",
+                    default=os.environ.get("APEX_TPU_TELEMETRY_DIR"),
+                    help="telemetry directory "
+                         "(default: $APEX_TPU_TELEMETRY_DIR)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit (exit code = "
+                         "rules still firing)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live refresh period in seconds")
+    args = ap.parse_args(argv)
+    if not args.dir:
+        print("monitor_dash: no telemetry dir (arg or "
+              "$APEX_TPU_TELEMETRY_DIR)", file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.dir):
+        print(f"monitor_dash: not a directory: {args.dir}",
+              file=sys.stderr)
+        return 2
+    if args.once:
+        state, n_files = fold_dir(args.dir)
+        firing = render(state,
+                        source=f"{args.dir} ({n_files} file(s))")
+        return min(firing, 100)
+    state = DashState()
+    tailer = JsonlTailer(args.dir)
+    try:
+        while True:
+            for rec in tailer.poll():
+                state.feed(rec)
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            render(state, source=args.dir)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1022,6 +1022,275 @@ def _trace_smoke(bench):
             "critical_path_requests": len(cp)}
 
 
+def _monitor_smoke(bench):
+    """Live-monitoring smoke (round 25): (a) run the
+    ``monitor_overhead`` bench leg on the tiny model
+    (APEX_TPU_SERVE_SMOKE=1) — its in-bench proof obligations: an
+    inert Monitor plus ZERO monitor/alert events on the disabled leg —
+    and schema-check the emitted metric line at round 25; (b) the
+    chaos acceptance on live machinery: a 2-replica stub fleet with a
+    mid-stream replica kill, driven tick-by-tick with ``poll()``
+    interleaved — ``replica_health`` must FIRE on the kill and RESOLVE
+    after the respawn — then a REAL jitted ``guarded_update`` step fed
+    NaN gradients must fire ``guard_skips`` through ``check_guard``'s
+    gauge and resolve on the next clean step, ending with
+    ``alerts_firing() == 0``; (c) ``render_openmetrics()`` round-trips
+    the strict conformance parser with the monitor families present;
+    (d) online attribution: a straggler-delayed 3-D pipeline trace
+    under the monitored registry — on a multi-device host the delayed
+    stage must be NAMED by the exposure-difference estimator, on one
+    device (pp == 1) it must abstain rather than guess; (e)
+    ``tools/monitor_dash.py --once`` renders the captured dir with
+    zero rules still firing. Raises on any missing piece so the stage
+    shows up as ERROR rather than silently passing."""
+    import tempfile
+    import types
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import telemetry
+    from apex_tpu.parallel import mesh2d, pipeline
+    from apex_tpu.resilience import faults, guard
+    from apex_tpu.serving import FleetConfig, Request, ServeFleet
+    from apex_tpu.telemetry.monitor import (Monitor, default_rules,
+                                            parse_openmetrics)
+    from apex_tpu.telemetry.registry import use_registry
+
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import bench_schema_check
+    import monitor_dash
+
+    # (a) the bench leg + round-25 metric-line schema
+    prev_smoke = os.environ.get("APEX_TPU_SERVE_SMOKE")
+    os.environ["APEX_TPU_SERVE_SMOKE"] = "1"
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            ret = bench.bench_monitor_overhead(8, 4)
+    finally:
+        if prev_smoke is None:
+            os.environ.pop("APEX_TPU_SERVE_SMOKE", None)
+        else:
+            os.environ["APEX_TPU_SERVE_SMOKE"] = prev_smoke
+    if ret["disabled_leg_monitor_events"] != 0:
+        raise RuntimeError(
+            f"monitor smoke: {ret['disabled_leg_monitor_events']} "
+            f"monitor/alert event(s) on the disabled leg — the "
+            f"zero-overhead-off contract is broken")
+    if ret["alerts_fired"] < 1:
+        raise RuntimeError(
+            "monitor smoke: the replica-kill chaos leg fired no alert "
+            "— replica_health never saw the loss")
+    metric = None
+    for line in buf.getvalue().splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("metric") == "monitor_overhead_pct":
+            metric = obj
+    if metric is None:
+        raise RuntimeError(
+            "monitor smoke: bench_monitor_overhead printed no "
+            "monitor_overhead_pct metric line")
+    bench_schema_check.check_metric_line(metric, round_n=25,
+                                         where="monitor smoke")
+
+    # (b) fire -> resolve on live machinery: same stub-fleet shape as
+    # the trace smoke (host-only router policy, no compiles)
+    class _StubEngine:
+        def __init__(self):
+            self.config = types.SimpleNamespace(
+                num_slots=4, batch_buckets=(2, 4),
+                prefill_buckets=(64,), eos_token_id=None,
+                pad_token_id=0)
+            self.max_len = 10_000
+            self.decode_retries_total = 0
+            self.compile_count = 6
+            self.spec = types.SimpleNamespace(
+                bytes_per_slot=lambda: 0,
+                cache_dtype_name=lambda: "stub")
+
+        def kv_cache_bytes(self):
+            return 0
+
+        def prefill(self, slot_ids, prompts, *, pad_slot_ids=None):
+            return np.ones(len(prompts), np.int32)
+
+        def decode(self, slot_ids, tokens, *, pad_slot_ids=None,
+                   retries=0, backoff_s=0.0, backoff_cap_s=0.0):
+            return (np.ones(len(slot_ids), np.int32),
+                    np.ones(len(slot_ids), bool))
+
+    tel_dir = tempfile.mkdtemp(prefix="apex_tpu_monitor_smoke_")
+    prev = os.environ.get(telemetry.registry.ENV_DIR)
+    os.environ[telemetry.registry.ENV_DIR] = tel_dir
+    reg = telemetry.MetricsRegistry(enabled=True, jsonl_dir=tel_dir)
+    # this smoke compiles fresh programs by design (the guard step,
+    # the straggler pipeline trace), and the backend-compile listener
+    # feeds compile/count on the active registry — the recompiles rule
+    # targets STEADY-STATE shape instability, so it would latch on
+    # those intentional compiles for its whole 60 s window; every
+    # other stock rule runs
+    mon = Monitor(reg, rules=[r for r in default_rules()
+                              if r.name != "recompiles"])
+    fleet = ServeFleet(
+        engine_factory=lambda idx, mesh, name: _StubEngine(),
+        config=FleetConfig(num_replicas=2, respawn_delay_ticks=1),
+        registry=reg)
+    try:
+        saw_replica_firing = False
+        with faults.inject_replica_loss(0, 2):
+            for i in range(6):
+                fleet.submit(Request(
+                    rid=i,
+                    prompt=np.arange(3, dtype=np.int32) % 7,
+                    max_new_tokens=4, arrival=0.0,
+                    tier="interactive" if i % 2 else "batch"))
+            # fleet.run()'s loop with a poll() interleaved per tick —
+            # the monitor sees every replica_state transition live
+            for _ in range(400):
+                if not fleet._work_remaining():
+                    break
+                fleet.step()
+                res = mon.poll()
+                rh = next(r for r in res["alerts"]
+                          if r["rule"] == "replica_health")
+                saw_replica_firing = saw_replica_firing or rh["firing"]
+        for _ in range(3):  # post-run polls settle the resolve
+            mon.poll()
+        rows = {r["rule"]: r for r in mon.alerts()}
+        if not saw_replica_firing \
+                or rows["replica_health"]["fired_count"] < 1:
+            raise RuntimeError(
+                "monitor smoke: the replica kill never fired "
+                "replica_health")
+        if rows["replica_health"]["firing"]:
+            raise RuntimeError(
+                "monitor smoke: replica_health did not RESOLVE after "
+                "the respawn")
+
+        # the real non-finite guard: a NaN-grad jitted guarded_update
+        # skips, check_guard reconciles the gauge, the rule fires —
+        # then one clean step resets the streak and it resolves
+        def opt_update(g, p):
+            return jax.tree_util.tree_map(
+                lambda pv, gv: pv - 0.1 * gv, p, g)
+
+        gstep = jax.jit(lambda g, p, gs: guard.guarded_update(
+            g, opt_update, p, gs))
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        gs = guard.init_guard_state()
+        with use_registry(reg):
+            params, gs = gstep({"w": jnp.full((4,), jnp.nan)},
+                               params, gs)
+            guard.check_guard(gs, 8, registry=reg)
+            res = mon.poll()
+            if not next(r for r in res["alerts"]
+                        if r["rule"] == "guard_skips")["firing"]:
+                raise RuntimeError(
+                    "monitor smoke: the NaN-skipped step did not fire "
+                    "guard_skips")
+            params, gs = gstep({"w": jnp.ones((4,), jnp.float32)},
+                               params, gs)
+            guard.check_guard(gs, 8, registry=reg)
+            res = mon.poll()
+            if next(r for r in res["alerts"]
+                    if r["rule"] == "guard_skips")["firing"]:
+                raise RuntimeError(
+                    "monitor smoke: guard_skips did not resolve after "
+                    "the clean step")
+        rows = {r["rule"]: r for r in mon.alerts()}
+        if mon.alerts_firing() != 0:
+            raise RuntimeError(
+                f"monitor smoke: {mon.alerts_firing()} rule(s) still "
+                f"firing after the chaos legs resolved")
+
+        # (c) the exposition round-trips the strict parser
+        fams = parse_openmetrics(mon.render_openmetrics())
+        for fam in ("apex_tpu_monitor_alerts_firing",
+                    "apex_tpu_guard_consecutive_skips",
+                    "apex_tpu_monitor_alerts_fired"):
+            if fam not in fams:
+                raise RuntimeError(
+                    f"monitor smoke: family {fam} missing from the "
+                    f"OpenMetrics exposition")
+
+        # (d) online straggler attribution off the live span tap: a
+        # trace-time delay on the last stage must be named (multi-dev)
+        # or the estimator must abstain at pp == 1 (single device)
+        mon.attribution.reset()
+        pp2 = 2 if len(jax.devices()) >= 2 else 1
+        mesh = pipeline.mesh_3d(1, 1, pp2,
+                                devices=jax.devices()[:pp2])
+        delayed = pp2 - 1
+        sp = mesh2d.gpt2_init(hidden=32, layers=2, heads=4, vocab=32,
+                              max_seq=8)
+        pstep, pstate = pipeline.build_pipeline_step(
+            mesh, sp, hidden=32, heads=4, microbatches=4,
+            straggler=(delayed, 0.05))
+        tokens, labels = pipeline.make_batch_3d(
+            mesh, microbatches=4, batch_per_replica=2, seq=8,
+            vocab=32)
+        with use_registry(reg):
+            out = pstep(*pstate, tokens, labels)
+            jax.block_until_ready(out[-1])
+        mon.poll()
+        rep = mon.straggler_report()
+        if rep["ticks"] == 0:
+            raise RuntimeError("monitor smoke: no pp_tick spans "
+                               "reached the monitor's event tap")
+        if pp2 >= 2 and rep["straggler"] != delayed:
+            raise RuntimeError(
+                f"monitor smoke: straggler attributor named stage "
+                f"{rep['straggler']!r}, wanted the delayed stage "
+                f"{delayed}")
+        if pp2 == 1 and rep["straggler"] is not None:
+            raise RuntimeError(
+                f"monitor smoke: pp == 1 must abstain, but the "
+                f"attributor named stage {rep['straggler']!r}")
+    finally:
+        faults.disarm_replica_loss()
+        mon.close()
+        reg.disable()
+        if prev is None:
+            os.environ.pop(telemetry.registry.ENV_DIR, None)
+        else:
+            os.environ[telemetry.registry.ENV_DIR] = prev
+
+    # (e) the terminal dashboard folds the captured dir; exit code is
+    # the number of rules still firing — must be 0 after the resolves
+    dash_buf = io.StringIO()
+    with contextlib.redirect_stdout(dash_buf):
+        rc = monitor_dash.main([tel_dir, "--once"])
+    if rc != 0:
+        raise RuntimeError(
+            f"monitor smoke: monitor_dash --once reports {rc} rule(s) "
+            f"still firing at end of stream")
+    dash = dash_buf.getvalue()
+    for needle in ("replica_health", "guard_skips"):
+        if needle not in dash:
+            raise RuntimeError(
+                f"monitor smoke: dash render missing the {needle} "
+                f"alert row")
+    return {"telemetry_dir": tel_dir,
+            "monitor_overhead_pct": ret["monitor_overhead_pct"],
+            "bench_alerts_fired": ret["alerts_fired"],
+            "replica_health_fired":
+                rows["replica_health"]["fired_count"],
+            "guard_skips_fired": rows["guard_skips"]["fired_count"],
+            "openmetrics_families": len(fams),
+            "straggler": rep["straggler"],
+            "straggler_pp": rep["pp"],
+            "bubble_fraction_measured":
+                rep["bubble_fraction_measured"],
+            "dash_rules_firing": rc}
+
+
 def _lint_smoke(bench):
     """Static-analysis smoke (round 14): (a) run a clean DDP config
     under APEX_TPU_HLO_LINT=1 and assert its emitted JSON carries
@@ -1730,6 +1999,7 @@ def _stages(smoke):
             ("fleet", None, lambda: _fleet_smoke(bench)),
             ("migrate", None, lambda: _migrate_smoke(bench)),
             ("trace", None, lambda: _trace_smoke(bench)),
+            ("monitor", None, lambda: _monitor_smoke(bench)),
             ("recovery", None, lambda: _recovery_smoke(bench)),
             ("lint", None, lambda: _lint_smoke(bench)),
             ("sharding", None, lambda: _sharding_smoke(bench)),
@@ -1848,6 +2118,15 @@ def _stages(smoke):
         # path attribution — plus the round-24 metric-line schema
         ("trace_overhead", None, spec("trace_overhead")),
         ("trace", None, lambda: _trace_smoke(bench)),
+        # round-25 live-monitoring captures: the monitor_overhead
+        # config at bench size (monitored-vs-unmonitored wall-clock on
+        # the same fleet chaos leg, alerts fired/resolved, the
+        # asserted zero-events disabled leg) and the smoke proving the
+        # fire -> resolve chaos acceptance — replica kill, real
+        # guarded_update NaN skip, OpenMetrics round-trip, straggler
+        # attribution, dash render — plus the round-25 metric schema
+        ("monitor_overhead", None, spec("monitor_overhead")),
+        ("monitor", None, lambda: _monitor_smoke(bench)),
         # round-13 training-recovery captures: the supervised chaos
         # campaign at bench size (restarts / mttr_steps /
         # snapshot_restores / goodput_step_ratio / final_loss_delta in

@@ -42,8 +42,13 @@ KNOWN_KINDS = frozenset({
     "guard", "checkpoint", "preemption", "numerics", "amp",
     "compile", "memory", "serve", "recovery", "lint", "overlap",
     "fleet", "kernel", "pipeline", "span_begin", "trace_epoch",
-    "trace_flow",
+    "trace_flow", "alert", "monitor",
 })
+
+# alert firing/resolved transitions kept per report (stream order) —
+# the monitor emits one event per transition, not per poll, so even a
+# noisy run stays small; past the cap we count instead of grow
+_ALERT_TIMELINE_CAP = 128
 
 # fleet timeline rows kept per report (replica state transitions +
 # migrations + rebalances + scale events, stream order)
@@ -103,6 +108,9 @@ def aggregate(events):
              "kv_fallbacks": {}, "kv_corrupt_injected": 0}
     traces = {"by_id": {}, "truncated": 0, "flows": 0,
               "span_begins": 0, "epochs": 0}
+    alerts = {"by_rule": {}, "timeline": [], "timeline_truncated": 0,
+              "monitor": {"starts": 0, "stops": 0, "polls": None,
+                          "rules": None, "scrape_ports": []}}
     last_summary = None
     n_events = 0
     unknown = {}
@@ -463,6 +471,42 @@ def aggregate(events):
                         })
                     else:
                         fleet["timeline_truncated"] += 1
+            elif kind == "alert":
+                rule = str(ev.get("name"))
+                a = alerts["by_rule"].setdefault(rule, {
+                    "fired": 0, "resolved": 0, "severity": None,
+                    "last_state": None, "last_value": None,
+                    "last_evidence": None})
+                state = ev.get("state")
+                if state == "firing":
+                    a["fired"] += 1
+                    a["last_value"] = ev.get("value")
+                    a["last_evidence"] = ev.get("evidence")
+                elif state == "resolved":
+                    a["resolved"] += 1
+                a["last_state"] = state
+                if ev.get("severity") is not None:
+                    a["severity"] = ev.get("severity")
+                if len(alerts["timeline"]) < _ALERT_TIMELINE_CAP:
+                    alerts["timeline"].append({
+                        "rule": rule, "state": state,
+                        "severity": ev.get("severity"),
+                        "value": ev.get("value"),
+                        "duration_s": ev.get("duration_s"),
+                        "ts": ev.get("ts")})
+                else:
+                    alerts["timeline_truncated"] += 1
+            elif kind == "monitor":
+                mname = ev.get("name")
+                mon = alerts["monitor"]
+                if mname == "start":
+                    mon["starts"] += 1
+                    mon["rules"] = ev.get("rules")
+                elif mname == "stop":
+                    mon["stops"] += 1
+                    mon["polls"] = ev.get("polls")
+                elif mname == "scrape_endpoint":
+                    mon["scrape_ports"].append(ev.get("port"))
             elif kind in KNOWN_KINDS:
                 pass  # known but needs no aggregation (checkpoint, ...)
             else:
@@ -503,6 +547,7 @@ def aggregate(events):
         "serve": serve,
         "fleet": fleet,
         "recovery": recovery,
+        "alerts": alerts,
         "lint": lint,
         "kernels": kernels,
         "overlap": overlap,
@@ -865,6 +910,51 @@ def print_report(report, out=None):
               f"{last.get('final_step')}, {last.get('restarts')} "
               f"restart(s), mttr {last.get('mttr_steps')} step(s), "
               f"goodput ratio {last.get('goodput_step_ratio')}\n")
+    alerts = report.get("alerts") or {}
+    mon = alerts.get("monitor") or {}
+    if alerts.get("by_rule") or mon.get("starts"):
+        w("\nalerts (telemetry.monitor):\n")
+        if mon.get("starts"):
+            line = (f"  monitor: {mon['starts']} start(s), "
+                    f"{mon.get('stops', 0)} stop(s)")
+            if mon.get("polls") is not None:
+                line += f", {mon['polls']} poll(s)"
+            ports = [p for p in (mon.get("scrape_ports") or [])
+                     if p is not None]
+            if ports:
+                line += f", scrape port(s) {ports}"
+            w(line + "\n")
+        by_rule = alerts.get("by_rule") or {}
+        if by_rule:
+            w(f"  {'rule':<28} {'sev':<6} {'fired':>6} {'resolved':>9} "
+              f" last state\n")
+            for rule in sorted(by_rule):
+                a = by_rule[rule]
+                w(f"  {rule:<28} {str(a.get('severity')):<6} "
+                  f"{a['fired']:>6} {a['resolved']:>9}  "
+                  f"{a.get('last_state')}\n")
+            unresolved = sorted(
+                r for r, a in by_rule.items()
+                if a.get("last_state") == "firing")
+            if unresolved:
+                w(f"  STILL FIRING at end of stream: "
+                  f"{', '.join(unresolved)}\n")
+        timeline = alerts.get("timeline") or []
+        if timeline:
+            w("  transition timeline (stream order):\n")
+            for i, row in enumerate(timeline):
+                extra = ""
+                if row.get("state") == "firing" \
+                        and row.get("value") is not None:
+                    extra = f" value={row['value']}"
+                elif row.get("duration_s") is not None:
+                    extra = f" after {row['duration_s']:.3f}s"
+                w(f"    {i:>3} {row.get('state', '?'):<9} "
+                  f"[{str(row.get('severity')):<4}] "
+                  f"{row['rule']}{extra}\n")
+            if alerts.get("timeline_truncated"):
+                w(f"    ... {alerts['timeline_truncated']} more "
+                  f"row(s) truncated\n")
     lint = report.get("lint") or {}
     if lint.get("programs") or lint.get("violations") \
             or lint.get("errors"):
